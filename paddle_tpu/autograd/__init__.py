@@ -1,0 +1,178 @@
+"""Autograd public API.
+
+Analog of python/paddle/autograd: ``backward``, ``grad``, ``no_grad``,
+``PyLayer`` (paddle/fluid/eager/pylayer), hooks.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from . import tape
+from .tape import enable_grad, is_grad_enabled, no_grad
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    tape.run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """Analog of paddle.grad (partial-graph gradients without touching
+    ``.grad`` — the reference's GeneralGrad path, fluid/eager/general_grad.h)."""
+    from ..core.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported; "
+            "use the compiled path (paddle_tpu.jit) with jax-level autodiff."
+        )
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = False
+
+    captured = [None] * len(inputs)
+
+    hooks_installed = []
+    for i, t in enumerate(inputs):
+        node, slot = t._grad_edge()
+        if node is None:
+            if not allow_unused:
+                raise ValueError(f"input {i} has stop_gradient=True")
+            continue
+
+        def mk_hook(i, slot, is_leaf):
+            if is_leaf:
+                def leaf_hook(g):
+                    captured[i] = g if captured[i] is None else captured[i] + g
+                    return None
+                return leaf_hook
+
+            def node_hook(cotangents):
+                g = cotangents[slot]
+                if g is not None:
+                    captured[i] = g if captured[i] is None else captured[i] + g
+                return None
+            return node_hook
+
+        is_leaf = isinstance(node, tape.AccumulateNode)
+        hook = mk_hook(i, slot, is_leaf)
+        node.hooks.append(hook)
+        hooks_installed.append((node, hook, is_leaf, t))
+
+    try:
+        # accumulate_to_leaf=False: capture hooks fire but no tensor's .grad
+        # is touched (matches the reference's GeneralGrad partial-graph path)
+        tape.run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                          accumulate_to_leaf=False)
+    finally:
+        for node, hook, _, _ in hooks_installed:
+            if hook in node.hooks:
+                node.hooks.remove(hook)
+
+    results = []
+    for i, g in enumerate(captured):
+        if g is None:
+            if not allow_unused and inputs[i]._grad_edge()[0] is not None:
+                # unreached input: return zeros to match reference behavior
+                import jax.numpy as jnp
+
+                g = jnp.zeros(tuple(inputs[i].shape), inputs[i].dtype)
+            else:
+                results.append(None)
+                continue
+        results.append(Tensor(g, stop_gradient=True))
+    return results
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined autograd function (analog of paddle.autograd.PyLayer,
+    paddle/fluid/eager/pylayer/py_layer_node.h).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = x.exp()
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import Tensor
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+
+        diff_inputs = [a for a in args if isinstance(a, Tensor) and a._requires_grad()]
+        if tape.is_grad_enabled() and diff_inputs:
+            out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+            def vjp_fn(cotangents):
+                cot_tensors = [Tensor(c) if c is not None else None for c in cotangents]
+                with no_grad():
+                    grads = cls.backward(ctx, *cot_tensors)
+                if not isinstance(grads, (list, tuple)):
+                    grads = (grads,)
+                vals = []
+                gi = 0
+                for a in args:
+                    if isinstance(a, Tensor) and a._requires_grad():
+                        g = grads[gi] if gi < len(grads) else None
+                        gi += 1
+                        vals.append(g._value if isinstance(g, Tensor) else g)
+                return tuple(vals)
+
+            node = tape.record_op(
+                f"pylayer_{cls.__name__}",
+                [o._value for o in out_tensors],
+                vjp_fn,
+                diff_inputs,
+            )
+            for slot, o in enumerate(out_tensors):
+                o.stop_gradient = False
+                o._set_grad_node(node, slot)
+
+        return out_list[0] if single else tuple(out_list)

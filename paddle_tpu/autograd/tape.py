@@ -1,0 +1,309 @@
+"""Eager autograd tape.
+
+TPU-native analog of the reference's eager autograd machinery:
+``GradNodeBase`` (paddle/fluid/eager/grad_node_info.h:197), ``AutogradMeta``,
+``TensorWrapper`` residual capture, and the dual-queue backward walk in
+``egr::RunBackward`` (paddle/fluid/eager/backward.cc:105).
+
+Design difference (deliberate, TPU-first): instead of per-op hand-written
+C++ grad kernels, each recorded op stores the ``jax.vjp`` closure of its
+forward function. Residuals are whatever XLA's linearization keeps, so the
+backward of a fused forward is itself fused by XLA. The tape is pure graph
+bookkeeping; all math stays inside jax/XLA.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "grad_enabled"):
+        _state.grad_enabled = True
+    return _state
+
+
+def is_grad_enabled() -> bool:
+    return _tls().grad_enabled
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording
+    (analog of paddle.no_grad)."""
+
+    def __enter__(self):
+        s = _tls()
+        self._prev = s.grad_enabled
+        s.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tls().grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        s = _tls()
+        self._prev = s.grad_enabled
+        s.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls().grad_enabled = self._prev
+        return False
+
+
+class Edge:
+    """A directed edge to a producer node's output slot
+    (analog of egr::Edge in grad_node_info.h)."""
+
+    __slots__ = ("node", "slot")
+
+    def __init__(self, node: "GradNode", slot: int):
+        self.node = node
+        self.slot = slot
+
+
+class GradNode:
+    """One recorded differentiable op.
+
+    ``vjp_fn(cotangents_tuple) -> tuple(input cotangents)`` where cotangents
+    correspond 1:1 with ``input_edges``.
+    """
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "input_edges",
+        "num_outputs",
+        "out_shapes",
+        "out_dtypes",
+        "hooks",
+        "released",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        vjp_fn: Optional[Callable],
+        input_edges: List[Optional[Edge]],
+        num_outputs: int,
+        out_shapes: List[Tuple[int, ...]],
+        out_dtypes: List[Any],
+    ):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.input_edges = input_edges
+        self.num_outputs = num_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self.hooks: List[Callable] = []
+        self.released = False
+
+    def apply(self, grads: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        if self.released:
+            raise RuntimeError(
+                f"GradNode {self.name} already released; call backward(retain_graph=True) "
+                "to backprop through the same graph twice."
+            )
+        out = self.vjp_fn(grads)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return out
+
+    def release(self):
+        self.vjp_fn = None
+        self.released = True
+
+    def __repr__(self):
+        return f"<GradNode {self.name} outs={self.num_outputs}>"
+
+
+class AccumulateNode(GradNode):
+    """Terminal node accumulating into a leaf tensor's ``.grad``
+    (analog of egr::GradNodeAccumulation)."""
+
+    __slots__ = ("tensor_ref",)
+
+    def __init__(self, tensor):
+        import weakref
+
+        super().__init__("accumulate_grad", None, [], 1, [tuple(tensor.shape)], [tensor.dtype])
+        self.tensor_ref = weakref.ref(tensor)
+
+    def accumulate(self, grad, accumulate_to_leaf: bool = True):
+        t = self.tensor_ref()
+        if t is None:
+            return
+        for hook in self.hooks:
+            new = hook(grad)
+            if new is not None:
+                grad = new
+        if accumulate_to_leaf:
+            t._accumulate_grad(grad)
+
+    def release(self):
+        pass
+
+
+def record_op(
+    name: str,
+    outputs_vals: Sequence[Any],
+    vjp_fn: Callable,
+    diff_inputs: Sequence[Any],
+) -> GradNode:
+    """Create a GradNode for an executed op and wire edges from its
+    differentiable input Tensors."""
+    edges: List[Optional[Edge]] = []
+    for t in diff_inputs:
+        edges.append(Edge(*t._grad_edge()))
+    node = GradNode(
+        name,
+        vjp_fn,
+        edges,
+        len(outputs_vals),
+        [tuple(v.shape) for v in outputs_vals],
+        [v.dtype for v in outputs_vals],
+    )
+    return node
+
+
+# ---------------------------------------------------------------------------
+# Backward engine (analog of egr::RunBackward, backward.cc:105)
+# ---------------------------------------------------------------------------
+
+
+def _ones_like(shape, dtype):
+    return jnp.ones(shape, dtype=dtype)
+
+
+def run_backward(
+    tensors: Sequence[Any],
+    grad_tensors: Optional[Sequence[Any]] = None,
+    retain_graph: bool = False,
+    accumulate_to_leaf: bool = True,
+) -> None:
+    """Topological reverse walk accumulating gradients into leaf ``.grad``.
+
+    ``tensors`` are root Tensors (typically the loss); ``grad_tensors`` the
+    seed cotangents (defaults to ones, matching the reference's behavior for
+    scalar losses). With ``accumulate_to_leaf=False`` leaf hooks still fire
+    but ``.grad`` is untouched (the paddle.grad / GeneralGrad path).
+    """
+    roots: List[Tuple[GradNode, int, Any]] = []
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        node, slot = t._grad_edge(create=False)
+        if node is None:
+            continue
+        seed = g._value if hasattr(g, "_value") else g
+        if seed is None:
+            seed = _ones_like(tuple(t.shape), t.dtype)
+        roots.append((node, slot, seed))
+    if not roots:
+        return
+
+    # Pass 1: discover reachable graph, count in-degrees (number of consumers
+    # whose cotangents flow into each node) — the reference's dependency map.
+    indeg: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+    stack = [n for n, _, _ in roots]
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        nodes[id(node)] = node
+        for e in node.input_edges:
+            if e is None:
+                continue
+            indeg[id(e.node)] = indeg.get(id(e.node), 0) + 1
+            if id(e.node) not in seen:
+                stack.append(e.node)
+
+    # Pass 2: ready-queue walk.
+    pending: Dict[int, List[Optional[Any]]] = {}
+
+    def _stage(node: GradNode, slot: int, grad):
+        buf = pending.setdefault(id(node), [None] * node.num_outputs)
+        buf[slot] = grad if buf[slot] is None else buf[slot] + grad
+
+    queue: deque = deque()
+    remaining = dict(indeg)
+    for node, slot, seed in roots:
+        _stage(node, slot, seed)
+    # roots with zero in-degree are immediately ready
+    for node, _, _ in roots:
+        if remaining.get(id(node), 0) == 0 and id(node) not in [id(q) for q in queue]:
+            queue.append(node)
+
+    done = set()
+    while queue:
+        node = queue.popleft()
+        if id(node) in done:
+            continue
+        done.add(id(node))
+        grads_in = pending.pop(id(node), [None] * node.num_outputs)
+        if isinstance(node, AccumulateNode):
+            if grads_in[0] is not None:
+                node.accumulate(grads_in[0], accumulate_to_leaf)
+            continue
+        if all(g is None for g in grads_in):
+            # nothing flowed into this node; propagate "no gradient" onward
+            if not retain_graph:
+                node.release()
+            for e in node.input_edges:
+                if e is None:
+                    continue
+                remaining[id(e.node)] = remaining.get(id(e.node), 1) - 1
+                if remaining[id(e.node)] <= 0 and id(e.node) not in done:
+                    queue.append(e.node)
+            continue
+        # zero-fill missing output cotangents (unconsumed outputs)
+        cotangents = tuple(
+            g if g is not None else jnp.zeros(s, d)
+            for g, s, d in zip(grads_in, node.out_shapes, node.out_dtypes)
+        )
+        for hook in node.hooks:
+            out = hook(cotangents)
+            if out is not None:
+                cotangents = out
+        in_grads = node.apply(cotangents)
+        if not retain_graph:
+            node.release()
+        for e, g in zip(node.input_edges, in_grads):
+            if e is None:
+                continue
+            if g is not None:
+                _stage(e.node, e.slot, g)
+            # decrement even for a None cotangent: this consumer has delivered
+            # (a producer must not deadlock because one consumer path
+            # contributed nothing — e.g. a PyLayer backward returning None)
+            remaining[id(e.node)] = remaining.get(id(e.node), 1) - 1
+            if remaining[id(e.node)] <= 0 and id(e.node) not in done:
+                queue.append(e.node)
+
+    # Flush any accumulate nodes that were staged but not queued (can happen
+    # when a leaf feeds a released subgraph).
+    for nid, buf in list(pending.items()):
+        node = nodes.get(nid)
+        if isinstance(node, AccumulateNode) and buf[0] is not None and nid not in done:
+            node.accumulate(buf[0], accumulate_to_leaf)

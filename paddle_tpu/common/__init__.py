@@ -1,0 +1,4 @@
+from . import flags
+from .flags import define_flag, get_flag, get_flags, set_flags
+
+__all__ = ["flags", "define_flag", "get_flag", "get_flags", "set_flags"]

@@ -1,0 +1,101 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's exported-flag registry
+(paddle/common/flags.h:93 ``PD_DEFINE_*`` + ``GetExportedFlagInfoMap``
+flags.h:337; 183 definitions in paddle/common/flags.cc). Flags are
+settable from the environment (``FLAGS_*``), from Python via
+``set_flags``/``get_flags``, and are queried by subsystems at call time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Optional, Union
+
+_LOCK = threading.RLock()
+
+
+@dataclass
+class FlagInfo:
+    name: str
+    default: Any
+    doc: str
+    type: type
+    value: Any
+
+
+_REGISTRY: Dict[str, FlagInfo] = {}
+
+
+def _coerce(raw: str, ty: type) -> Any:
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ty(raw)
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    """Register a flag. Environment variable ``name`` overrides the default."""
+    ty = type(default)
+    value = default
+    env = os.environ.get(name)
+    if env is not None:
+        try:
+            value = _coerce(env, ty)
+        except (TypeError, ValueError):
+            value = default
+    with _LOCK:
+        _REGISTRY[name] = FlagInfo(name=name, default=default, doc=doc, type=ty, value=value)
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    with _LOCK:
+        if flags is None:
+            return {k: v.value for k, v in _REGISTRY.items()}
+        if isinstance(flags, str):
+            flags = [flags]
+        out = {}
+        for name in flags:
+            if name not in _REGISTRY:
+                raise ValueError(f"unknown flag {name!r}")
+            out[name] = _REGISTRY[name].value
+        return out
+
+
+def get_flag(name: str) -> Any:
+    with _LOCK:
+        return _REGISTRY[name].value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    with _LOCK:
+        for name, value in flags.items():
+            if name not in _REGISTRY:
+                raise ValueError(f"unknown flag {name!r}")
+            info = _REGISTRY[name]
+            info.value = _coerce(value, info.type) if isinstance(value, str) else info.type(value)
+
+
+def flag_info_map() -> Dict[str, FlagInfo]:
+    with _LOCK:
+        return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Behavior-critical flags mirrored from the reference (paddle/common/flags.cc)
+# plus TPU-native additions.
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_check_nan_inf", False, "Check every op output for NaN/Inf (debug).")
+define_flag("FLAGS_check_nan_inf_level", 0, "0: error on nan/inf; >0 only report.")
+define_flag("FLAGS_use_autotune", False, "Enable runtime autotuning of kernel variants.")
+define_flag("FLAGS_benchmark", False, "Synchronize after every op (benchmark mode).")
+define_flag("FLAGS_tpu_eager_compile_cache", True, "Cache per-op compiled executables.")
+define_flag("FLAGS_tpu_default_matmul_precision", "default", "default|high|highest")
+define_flag("FLAGS_host_trace_level", 1, "Host profiler verbosity level.")
+define_flag("FLAGS_enable_async_trace", False, "Enable async dispatch tracing.")
+define_flag("FLAGS_tensor_operants_mode", "eager", "eager|static tensor operants mode.")
+define_flag("FLAGS_comm_timeout_s", 1800, "Collective timeout (watchdog) in seconds.")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "Allocator strategy name (compat).")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "Compat only; XLA manages HBM.")
+define_flag("FLAGS_log_memory_stats", False, "Log live/peak memory stats per step.")

@@ -1,0 +1,106 @@
+"""Device / place management.
+
+Analog of the reference's paddle.device (python/paddle/device/__init__.py:281
+``set_device``, :201 ``_convert_to_place``) and the phi Place hierarchy,
+mapped onto JAX devices. ``set_device('tpu')`` routes all subsequent eager op
+execution onto the TPU backend — the reference's north-star API shape.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+_state = threading.local()
+
+
+class Place:
+    """A concrete device placement (analog of phi::Place)."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    @property
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_matches(d.platform, self.device_type)]
+        if not devs:
+            # fall back to cpu host platform
+            devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+def _platform_matches(platform: str, device_type: str) -> bool:
+    if device_type == "tpu":
+        # 'axon' is a tunneled TPU platform; treat any accelerator as tpu
+        return platform in ("tpu", "axon")
+    return platform == device_type
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def _default_device_type() -> str:
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return "cpu"
+    if backend in ("tpu", "axon"):
+        return "tpu"
+    return backend
+
+
+def set_device(device: str) -> Place:
+    """Set the global default device, e.g. ``set_device('tpu')`` / ``'tpu:0'``."""
+    if ":" in device:
+        dev_type, _, idx = device.partition(":")
+        place = Place(dev_type, int(idx))
+    else:
+        place = Place(device, 0)
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    place = current_place()
+    return f"{place.device_type}:{place.device_id}"
+
+
+def current_place() -> Place:
+    place = getattr(_state, "place", None)
+    if place is None:
+        place = Place(_default_device_type(), 0)
+        _state.place = place
+    return place
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    dt = device_type or current_place().device_type
+    return len([d for d in jax.devices() if _platform_matches(d.platform, dt)]) or 1
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except Exception:
+        return False

@@ -1,0 +1,78 @@
+"""Dtype system.
+
+Analog of the reference's phi DataType (paddle/phi/common/data_type.h) and the
+promotion logic in the generated API layer, mapped onto numpy/jax dtypes.
+bfloat16 is the native TPU compute dtype (MXU-friendly).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects are jax/numpy dtypes.
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_ALIASES = {
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int": int32,
+    "int64": int64, "long": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize str/np.dtype/jnp dtype into a canonical numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return np.dtype(_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def promote_types(a, b):
+    return jnp.promote_types(a, b)
+
+
+def dtype_name(dtype) -> str:
+    d = np.dtype(dtype)
+    return d.name

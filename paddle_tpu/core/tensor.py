@@ -1,0 +1,275 @@
+"""Eager Tensor.
+
+Analog of the reference's public ``paddle::Tensor`` facade
+(paddle/phi/api/include/tensor.h:82) + ``AutogradMeta``
+(paddle/fluid/eager/autograd_meta.h): a thin handle over a device buffer with
+an autograd slot. Here the buffer is a ``jax.Array`` (PJRT buffer on TPU) or
+a JAX tracer when executing under a compiled (traced) region — the same
+Tensor type flows through eager and compiled paths.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape as _tape
+from . import device as _device
+from .dtype import convert_dtype, is_floating
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_grad_slot",
+        "_accum_node",
+        "_retain_grads",
+        "name",
+        "persistable",
+        "is_parameter",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = bool(stop_gradient)
+        self._grad = None
+        self._grad_node = None
+        self._grad_slot = 0
+        self._accum_node = None
+        self._retain_grads = False
+        self.name = name
+        self.persistable = False
+        self.is_parameter = False
+
+    # -- basic meta --------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        return _device.current_place()
+
+    def numel(self):
+        return self.size
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    # -- value access ------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __repr__(self):
+        grad_flag = "" if self.stop_gradient else ", stop_gradient=False"
+        return f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_flag},\n{self._value})"
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd ----------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g
+
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def _grad_edge(self, create: bool = True):
+        """Return (node, slot) this tensor reads its cotangent from."""
+        if self._grad_node is not None:
+            return self._grad_node, self._grad_slot
+        if self.stop_gradient:
+            return None, 0
+        if self._accum_node is None and create:
+            self._accum_node = _tape.AccumulateNode(self)
+        return self._accum_node, 0
+
+    def _set_grad_node(self, node, slot: int):
+        self._grad_node = node
+        self._grad_slot = slot
+
+    def _requires_grad(self) -> bool:
+        return (not self.stop_gradient) and is_floating(self.dtype)
+
+    def _accumulate_grad(self, g):
+        if isinstance(g, Tensor):
+            g = g._value
+        if self._grad is None:
+            self._grad = Tensor(g, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._value + g, stop_gradient=True)
+
+    def retain_grads(self):
+        """Keep .grad for a non-leaf tensor (analog of Tensor.retain_grads)."""
+        self._retain_grads = True
+        if self._grad_node is not None:
+            me = self
+
+            def _hook(cotangents):
+                g = cotangents[me._grad_slot]
+                if g is not None:
+                    me._accumulate_grad(g)
+                return None
+
+            self._grad_node.hooks.append(_hook)
+
+    def register_hook(self, hook):
+        """Register a gradient hook: ``new_grad = hook(grad)``
+        (analog of Tensor._register_grad_hook)."""
+        node, slot = self._grad_edge()
+        if node is None:
+            raise RuntimeError("cannot register hook on a tensor with stop_gradient=True")
+        if isinstance(node, _tape.AccumulateNode):
+
+            def _leaf_hook(g):
+                out = hook(Tensor(g))
+                if out is None:
+                    return None
+                return out._value if isinstance(out, Tensor) else out
+
+            node.hooks.append(_leaf_hook)
+            return
+
+        def _hook(cotangents):
+            g = cotangents[slot]
+            if g is None:
+                return None
+            out = hook(Tensor(g))
+            if out is None:
+                return None
+            lst = list(cotangents)
+            lst[slot] = out._value if isinstance(out, Tensor) else out
+            return tuple(lst)
+
+        node.hooks.append(_hook)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._value))
+        else:
+            self._grad = None
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        from ..ops.registry import dispatch
+
+        return dispatch("clone", self)
+
+    def set_value(self, value):
+        """Rebind the underlying buffer in-place (parameter update path)."""
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value, dtype=self.dtype)
+        self._value = value
+
+    def block_until_ready(self):
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+    # -- conversion --------------------------------------------------------
+    def astype(self, dtype):
+        from ..ops.registry import dispatch
+
+        return dispatch("cast", self, dtype=convert_dtype(dtype))
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        # minimal: dtype conversion or device move
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("tpu", "cpu") or isinstance(a, _device.Place):
+                place = a if isinstance(a, _device.Place) else _device.Place(a)
+                self._value = jax.device_put(self._value, place.jax_device)
+            else:
+                return self.astype(a)
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]), self.stop_gradient)
+
+    def tpu(self):
+        return Tensor(jax.device_put(self._value, _device.TPUPlace().jax_device), self.stop_gradient)
+
+    # Arithmetic dunders are attached by paddle_tpu.ops at import time
+    # (see ops/tensor_methods.py) to avoid an import cycle.
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """Analog of paddle.to_tensor."""
+    if isinstance(data, Tensor):
+        v = data._value
+    else:
+        v = data
+    dtype = convert_dtype(dtype)
+    if not isinstance(v, (jax.Array, jax.core.Tracer)):
+        v = np.asarray(v)
+        if dtype is None and v.dtype == np.float64:
+            dtype = np.dtype("float32")  # match the reference's default fp32
+        v = jnp.asarray(v, dtype=dtype)
+    elif dtype is not None and v.dtype != dtype:
+        v = v.astype(dtype)
+    if place is not None and not isinstance(v, jax.core.Tracer):
+        p = place if isinstance(place, _device.Place) else _device.Place(str(place))
+        v = jax.device_put(v, p.jax_device)
+    return Tensor(v, stop_gradient=stop_gradient)

@@ -1,0 +1,27 @@
+"""paddle_tpu.device — device management namespace
+(analog of python/paddle/device/__init__.py)."""
+
+from ..core.device import (
+    CPUPlace, Place, TPUPlace, current_place, device_count, get_device,
+    is_compiled_with_tpu, set_device,
+)
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (analog of
+    paddle.device.synchronize). PJRT executes async; this drains it."""
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]

@@ -1,0 +1,5 @@
+"""paddle_tpu.distributed — hybrid-parallel stack (filled in by
+mesh/fleet/dtensor modules; see SURVEY.md §2.6-2.7)."""
+
+from . import env
+from .env import ParallelEnv, get_rank, get_world_size, init_distributed

@@ -1,0 +1,97 @@
+"""Process-level distributed environment.
+
+Analog of the launcher↔runtime env contract (SURVEY.md §5:
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_CURRENT_ENDPOINT ...,
+launch/controllers/collective.py:126) consumed by ParallelEnv
+(python/paddle/distributed/parallel.py:677). On TPU the same role is played
+by jax.distributed + these env vars; single-process multi-device (one host,
+N chips) is the common case and needs no env at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+
+def get_rank() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("RANK")
+    if v is not None:
+        return int(v)
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM") or os.environ.get("WORLD_SIZE")
+    if v is not None:
+        return int(v)
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_local_rank() -> int:
+    v = os.environ.get("PADDLE_RANK_IN_NODE") or os.environ.get("LOCAL_RANK")
+    return int(v) if v is not None else 0
+
+
+def get_endpoints() -> List[str]:
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return eps.split(",") if eps else []
+
+
+def get_master() -> Optional[str]:
+    return os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+
+
+class ParallelEnv:
+    """Analog of paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_local_rank()
+
+    @property
+    def trainer_endpoints(self):
+        return get_endpoints()
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None):
+    """Multi-host bootstrap over the JAX coordination service (the TCPStore
+    analog — SURVEY.md §2.6 Store/rendezvous)."""
+    if get_world_size() <= 1 and coordinator_address is None:
+        return
+    addr = coordinator_address or get_master()
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=num_processes if num_processes is not None else get_world_size(),
+        process_id=process_id if process_id is not None else get_rank(),
+    )

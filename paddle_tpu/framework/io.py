@@ -1,0 +1,56 @@
+"""Serialization: paddle_tpu.save / load.
+
+Analog of python/paddle/framework/io.py:773 (save) / :1020 (load): pickles
+nested state dicts with tensors converted to numpy; reload wraps back into
+Tensors. Distributed sharded checkpointing lives in
+paddle_tpu.distributed.checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_storable(obj: Any):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_storable(v) for v in obj)
+    return obj
+
+
+def _from_storable(obj: Any, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"])
+            t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_storable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _from_storable(data, return_numpy=return_numpy)

@@ -1,0 +1,194 @@
+"""paddle_tpu.hapi — Keras-like high-level Model API
+(analog of python/paddle/hapi/model.py:1082 Model, fit :1808)."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..nn.layer import Layer
+
+
+class Callback:
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                              for k, v in (logs or {}).items())
+            print(f"step {step} - {items}")
+
+
+class Model:
+    """paddle.Model analog wrapping a Layer for fit/evaluate/predict."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*[to_tensor(x) for x in inputs])
+        losses = []
+        if self._loss is not None and labels is not None:
+            labels = labels if isinstance(labels, (list, tuple)) else [labels]
+            loss = self._loss(outputs, *[to_tensor(l) for l in labels])
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses, outputs
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..autograd import no_grad
+
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            outputs = self.network(*[to_tensor(x) for x in inputs])
+            losses = []
+            if self._loss is not None and labels is not None:
+                labels = labels if isinstance(labels, (list, tuple)) else [labels]
+                loss = self._loss(outputs, *[to_tensor(l) for l in labels])
+                losses.append(float(loss.numpy()))
+        return losses, outputs
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            loader = DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                                drop_last=drop_last, num_workers=num_workers)
+        else:
+            loader = train_data
+        callbacks = callbacks or [ProgBarLogger(log_freq, verbose)]
+        for cb in callbacks:
+            cb.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            for step, batch in enumerate(loader):
+                *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
+                losses, _ = self.train_batch(xs, [y])
+                logs = {"loss": losses[0] if losses else 0.0}
+                history["loss"].append(logs["loss"])
+                for cb in callbacks:
+                    cb.on_train_batch_end(step, logs)
+            for cb in callbacks:
+                cb.on_epoch_end(epoch)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch{epoch}")
+        for cb in callbacks:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        total_loss, n = 0.0, 0
+        for batch in loader:
+            *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
+            losses, outputs = self.eval_batch(xs, [y])
+            if losses:
+                total_loss += losses[0]
+                n += 1
+            for m in self._metrics:
+                m.update(Tensor(np.asarray(m.compute(outputs, to_tensor(y)))))
+        result = {"loss": total_loss / max(n, 1)}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        return result
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd import no_grad
+
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            return self.network(*[to_tensor(x) for x in inputs])
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size)
+        else:
+            loader = test_data
+        outs = []
+        for batch in loader:
+            xs = batch[:-1] if isinstance(batch, (list, tuple)) and len(batch) > 1 else batch
+            outs.append(self.predict_batch(xs))
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+
+        self.network.set_state_dict(_load(path + ".pdparams"))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines = []
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            lines.append(f"{name:60s} {str(p.shape):24s} {n}")
+        lines.append(f"Total params: {total:,}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": total}
+
+
+def summary(net, input_size=None, dtypes=None):
+    return Model(net).summary(input_size)

@@ -1,0 +1,9 @@
+from . import attention, fused
+from .fused import (
+    fused_layer_norm, fused_linear_activation, fused_matmul_bias,
+    fused_rms_norm, fused_rotary_position_embedding, swiglu,
+)
+from .attention import flash_attention
+
+# paddle-compat namespace: paddle.incubate.nn.functional.*
+from . import fused as functional

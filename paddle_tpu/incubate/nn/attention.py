@@ -1,0 +1,50 @@
+"""Flash attention entry point.
+
+Analog of the reference's FlashAttention integration
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu +
+python/paddle/nn/functional/flash_attention.py:195). On TPU the fused
+attention kernel is a Pallas kernel (paddle_tpu/ops/pallas/flash_attention.py);
+on CPU (tests) or when Pallas is unavailable we fall back to the XLA softmax
+path, which XLA still fuses well.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ...ops.registry import dispatch
+
+_PALLAS_OK = None
+
+
+def _pallas_available() -> bool:
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        _PALLAS_OK = jax.default_backend() in ("tpu", "axon")
+    return _PALLAS_OK
+
+
+def flash_attention(query, key, value, causal=False, dropout=0.0,
+                    attn_mask=None, scale=None):
+    """(batch, seq, heads, head_dim) attention, flash-style."""
+    if _pallas_available() and attn_mask is None and dropout == 0.0:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention_op
+
+            return dispatch("pallas_flash_attention", query, key, value,
+                            causal=causal, scale=scale)
+        except Exception:
+            pass
+    dropout_mask = None
+    if dropout > 0.0:
+        from ...core.tensor import Tensor
+        from ...ops import random as _random
+        import jax.numpy as jnp
+
+        b, sq, h, _ = query.shape
+        sk = key.shape[1]
+        k_ = _random.default_generator().next_key()
+        dropout_mask = Tensor(jax.random.bernoulli(k_, 1.0 - dropout, (b, h, sq, sk)))
+    return dispatch("scaled_dot_product_attention", query, key, value,
+                    attn_mask=attn_mask, dropout_mask=dropout_mask,
+                    dropout_p=dropout, is_causal=causal, scale=scale)

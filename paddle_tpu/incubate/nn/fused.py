@@ -1,0 +1,133 @@
+"""Fused LLM ops (analog of python/paddle/incubate/nn/functional/:
+fused_rms_norm.py, fused_layer_norm.py, fused_rotary_position_embedding.py,
+swiglu.py, fused_matmul_bias.py).
+
+On TPU "fusion" is XLA's job: these are single jnp expressions that XLA
+fuses into one kernel; the Pallas variants (paddle_tpu.ops.pallas) replace
+them on hot paths when profitable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.registry import register, dispatch
+
+
+@register("fused_rms_norm", amp="black")
+def _fused_rms_norm_op(x, weight=None, epsilon=1e-6):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(dtype)
+
+
+def fused_rms_norm(x, weight=None, epsilon=1e-6):
+    return dispatch("fused_rms_norm", x, weight, epsilon=epsilon)
+
+
+@register("fused_layer_norm", amp="black")
+def _fused_layer_norm_op(x, weight=None, bias=None, epsilon=1e-5,
+                         residual=None):
+    if residual is not None:
+        x = x + residual
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(dtype)
+
+
+def fused_layer_norm(x, weight=None, bias=None, epsilon=1e-5, residual=None):
+    return dispatch("fused_layer_norm", x, weight, bias, epsilon=epsilon,
+                    residual=residual)
+
+
+@register("swiglu")
+def _swiglu_op(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def swiglu(x, y=None):
+    return dispatch("swiglu", x, y)
+
+
+def _rope_rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+@register("fused_rotary_position_embedding")
+def _fused_rope_op(q, k=None, v=None, sin=None, cos=None, position_ids=None,
+                   use_neox_rotary_style=True):
+    """Rotary embedding; layout (batch, seq, heads, head_dim).
+    Reference: fused_rotary_position_embedding.py (incubate)."""
+
+    def apply(x):
+        if x is None:
+            return None
+        if use_neox_rotary_style:
+            return x * cos + _rope_rotate_half(x) * sin
+        # interleaved (GPT-J) style
+        x1 = x[..., ::2]
+        x2 = x[..., 1::2]
+        c = cos[..., ::2]
+        s = sin[..., ::2]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+    return tuple(r for r in (apply(q), apply(k), apply(v)) if r is not None)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    outs = dispatch("fused_rotary_position_embedding", q, k, v, sin=sin, cos=cos,
+                    position_ids=position_ids,
+                    use_neox_rotary_style=use_neox_rotary_style)
+    return outs
+
+
+@register("fused_matmul_bias", amp="white")
+def _fused_matmul_bias_op(x, y, bias=None, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False):
+    return dispatch("fused_matmul_bias", x, y, bias,
+                    transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+@register("fused_linear_activation", amp="white")
+def _fused_linear_activation_op(x, y, bias=None, activation="gelu"):
+    out = jnp.matmul(x, y)
+    if bias is not None:
+        out = out + bias
+    if activation == "gelu":
+        return jax.nn.gelu(out)
+    if activation == "relu":
+        return jax.nn.relu(out)
+    return out
+
+
+def fused_linear_activation(x, y, bias=None, activation="gelu"):
+    return dispatch("fused_linear_activation", x, y, bias, activation=activation)

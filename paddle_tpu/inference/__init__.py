@@ -1,0 +1,77 @@
+"""paddle_tpu.inference — deployment predictor.
+
+Analog of the reference's AnalysisPredictor/AnalysisConfig
+(paddle/fluid/inference/api/analysis_predictor.h:105). TPU-native: a saved
+model is params + a traced function; the predictor jit-compiles once per
+input signature and caches PJRT executables (the ~400 IR passes of the
+reference collapse into XLA's pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+class Config:
+    """Analog of AnalysisConfig (subset of knobs that are meaningful on TPU)."""
+
+    def __init__(self, model_path: Optional[str] = None):
+        self.model_path = model_path
+        self._device = "tpu"
+        self.memory_optim = True
+
+    def enable_use_tpu(self):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def switch_ir_optim(self, on=True):
+        pass
+
+
+class Predictor:
+    """Create from a live Layer or a jit.save'd path."""
+
+    def __init__(self, config_or_layer, layer: Optional[Layer] = None):
+        from ..jit import TracedLayer
+
+        if isinstance(config_or_layer, Layer):
+            self._layer = config_or_layer
+        elif layer is not None:
+            self._layer = layer
+        else:
+            raise ValueError("Predictor requires a Layer (load path support "
+                             "via paddle_tpu.jit.load + model class)")
+        self._layer.eval()
+        self._traced = TracedLayer(self._layer)
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._input_names: List[str] = ["input_0"]
+
+    def get_input_names(self):
+        return self._input_names
+
+    def set_input(self, name, value):
+        self._inputs[name] = np.asarray(value)
+
+    def run(self, inputs=None):
+        if inputs is None:
+            inputs = [self._inputs[n] for n in self._input_names]
+        tensors = [Tensor(np.asarray(x)) for x in inputs]
+        out = self._traced(*tensors)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o._value) for o in out]
+        return [np.asarray(out._value)]
+
+
+def create_predictor(config_or_layer, layer=None):
+    return Predictor(config_or_layer, layer)

@@ -1,0 +1,296 @@
+"""paddle_tpu.io — Dataset / DataLoader.
+
+Analog of python/paddle/io: Dataset family + DataLoader with single- and
+multi-worker prefetch iterators (io/dataloader/dataloader_iter.py:155,370).
+TPU-first notes: the loader produces host numpy batches; device transfer is
+overlapped by a double-buffer (prefetch to device while the current step
+runs) — the analog of the reference's pin-memory + async H2D stream.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import random as _random
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset does not support indexing")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        self.tensors = [t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+                        for t in tensors]
+        n = len(self.tensors[0])
+        assert all(len(t) == n for t in self.tensors)
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets])
+
+    def __getitem__(self, idx):
+        di = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if di == 0 else int(self.cum[di - 1])
+        return self.datasets[di][idx - prev]
+
+    def __len__(self):
+        return int(self.cum[-1])
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = datasets
+
+    def __iter__(self):
+        return itertools.chain(*self.datasets)
+
+
+def random_split(dataset, lengths, generator=None):
+    n = len(dataset)
+    assert sum(lengths) == n
+    perm = np.random.RandomState(0).permutation(n)
+    out = []
+    offset = 0
+    for ln in lengths:
+        out.append(Subset(dataset, perm[offset:offset + ln].tolist()))
+        offset += ln
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+        self._epoch = 0
+
+    def __iter__(self):
+        n = len(self.data_source)
+        self._epoch += 1
+        rng = np.random.RandomState(self._epoch * 2654435761 % (2 ** 31))
+        if self.replacement:
+            return iter(rng.randint(0, n, size=self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
+                 drop_last=False):
+        super().__init__(dataset)
+        if sampler is None:
+            sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Analog of paddle.io.DistributedBatchSampler: shards indices over the
+    data-parallel axis."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        from ..distributed import env as _env
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else _env.get_world_size()
+        self.local_rank = rank if rank is not None else _env.get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n)
+        # pad to divisible
+        total = ((n + self.nranks - 1) // self.nranks) * self.nranks
+        indices = np.concatenate([indices, indices[: total - n]])
+        local = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in local:
+            batch.append(int(idx))
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = (len(self.dataset) + self.nranks - 1) // self.nranks
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(b._value) for b in batch]))
+    arr = np.stack([np.asarray(b) for b in batch])
+    return Tensor(arr)
+
+
+class _PrefetchIter:
+    """Background-thread prefetch iterator (analog of the reference's
+    _DataLoaderIterMultiProcess; threads suffice since batch assembly is
+    numpy and releases the GIL)."""
+
+    def __init__(self, loader, num_prefetch=2):
+        self._loader = loader
+        self._q: "queue.Queue" = queue.Queue(maxsize=num_prefetch)
+        self._done = object()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for batch in self._loader._batches():
+                self._q.put(batch)
+        finally:
+            self._q.put(self._done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._done:
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=False, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        if isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def _batches(self):
+        if isinstance(self.dataset, IterableDataset):
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+            return
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers > 0 or self.use_buffer_reader:
+            return _PrefetchIter(self, num_prefetch=max(2, self.prefetch_factor))
+        return iter(self._batches())
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)

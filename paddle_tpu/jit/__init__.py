@@ -1,0 +1,157 @@
+"""paddle_tpu.jit — trace-and-compile path.
+
+Analog of the reference's paddle.jit.to_static stack (SURVEY.md §3.4: SOT
+bytecode capture → PIR program → CINN → executor). TPU-native design: we do
+NOT rebuild an IR or a bytecode interpreter — tracing is jax-style. The
+layer's forward runs once on tracers through the exact same op dispatch as
+eager (the tape is bypassed because tracers flow through the no-grad path
+dtype-wise), producing a jaxpr; XLA compiles it (fusion = XLA's job,
+replacing CINN). The executable cache is keyed on input shapes/dtypes —
+the analog of PartialProgramLayer's program cache
+(python/paddle/jit/dy2static/partial_program.py:146).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape as _tape
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap(x):
+    return Tensor(x) if isinstance(x, (jax.Array, jax.core.Tracer)) else x
+
+
+class TracedLayer:
+    """A compiled wrapper over a Layer or function.
+
+    For a Layer, parameters/buffers are threaded as jit inputs, so parameter
+    updates (opt.step rebinding buffers) are picked up without retrace.
+    """
+
+    def __init__(self, fn_or_layer, donate_params: bool = False,
+                 static_argnames: Optional[Sequence[str]] = None):
+        self._target = fn_or_layer
+        self._is_layer = isinstance(fn_or_layer, Layer)
+        self._static_argnames = tuple(static_argnames or ())
+        self._cache: Dict[Any, Any] = {}
+        self._compiled = None
+        if self._is_layer:
+            layer = fn_or_layer
+
+            def pure(state, args, kwargs):
+                with _tape.no_grad():
+                    wargs = jax.tree_util.tree_map(_wrap, args)
+                    wkwargs = jax.tree_util.tree_map(_wrap, kwargs)
+                    out = layer.functional_call(state, *wargs, **wkwargs)
+                return jax.tree_util.tree_map(_unwrap, out,
+                                              is_leaf=lambda x: isinstance(x, Tensor))
+
+            self._pure = jax.jit(pure)
+        else:
+            fn = fn_or_layer
+
+            def pure(args, kwargs):
+                with _tape.no_grad():
+                    wargs = jax.tree_util.tree_map(_wrap, args)
+                    wkwargs = jax.tree_util.tree_map(_wrap, kwargs)
+                    out = fn(*wargs, **wkwargs)
+                return jax.tree_util.tree_map(_unwrap, out,
+                                              is_leaf=lambda x: isinstance(x, Tensor))
+
+            self._pure = jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        uargs = jax.tree_util.tree_map(_unwrap, args,
+                                       is_leaf=lambda x: isinstance(x, Tensor))
+        ukwargs = jax.tree_util.tree_map(_unwrap, kwargs,
+                                         is_leaf=lambda x: isinstance(x, Tensor))
+        if self._is_layer:
+            state = self._target.functional_state()
+            out = self._pure(state, uargs, ukwargs)
+        else:
+            out = self._pure(uargs, ukwargs)
+        return jax.tree_util.tree_map(_wrap, out)
+
+    # introspection ---------------------------------------------------------
+    def lower(self, *args, **kwargs):
+        uargs = jax.tree_util.tree_map(_unwrap, args,
+                                       is_leaf=lambda x: isinstance(x, Tensor))
+        if self._is_layer:
+            return self._pure.lower(self._target.functional_state(), uargs, kwargs)
+        return self._pure.lower(uargs, kwargs)
+
+    def stablehlo(self, *args, **kwargs) -> str:
+        """The compiled module's StableHLO text (the PIR-program analog)."""
+        return str(self.lower(*args, **kwargs).compiler_ir(dialect="stablehlo"))
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=True, **kwargs):
+    """Analog of @paddle.jit.to_static (python/paddle/jit/api.py:195).
+    backend is accepted for compatibility; XLA is always the compiler."""
+
+    def decorate(fn):
+        traced = TracedLayer(fn)
+        if isinstance(fn, Layer):
+            return traced
+        functools.wraps(fn)(traced.__call__)
+        return traced
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save analog: persist params + a StableHLO module for the
+    predictor (reference: jit.save producing ProgramDesc + params)."""
+    import pickle
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {k: __import__("numpy").asarray(v)
+             for k, v in layer.functional_state().items()}
+    payload = {"state": state, "class": type(layer).__name__}
+    if input_spec is not None:
+        traced = TracedLayer(layer)
+        from ..static import InputSpec
+
+        example = []
+        for spec in input_spec:
+            if isinstance(spec, InputSpec):
+                example.append(Tensor(jnp.zeros(spec.shape, dtype=spec.dtype)))
+            else:
+                example.append(spec)
+        payload["stablehlo"] = traced.stablehlo(*example)
+        payload["input_spec"] = [
+            (tuple(s.shape), str(s.dtype)) if isinstance(s, InputSpec) else None
+            for s in input_spec
+        ]
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load(path):
+    import pickle
+
+    with open(path + ".pdmodel", "rb") as f:
+        return pickle.load(f)
+
+
+def not_to_static(fn):
+    return fn
+
+
+def ignore_module(modules):
+    return None

@@ -1,0 +1,45 @@
+"""paddle_tpu.nn — neural network layers (analog of paddle.nn)."""
+
+from . import functional
+from . import initializer
+from .layer import Layer, Parameter
+from .common import (
+    Linear, Embedding, Dropout, Dropout2D, Flatten, Identity, Pad2D, Upsample,
+    PixelShuffle, CosineSimilarity, Bilinear, PReLU,
+    ReLU, ReLU6, LeakyReLU, ELU, SELU, CELU, GELU, Silu, Swish, Mish,
+    Hardswish, Hardsigmoid, Hardtanh, Hardshrink, Softshrink, Tanhshrink,
+    ThresholdedReLU, Softplus, Softsign, Sigmoid, Tanh, LogSigmoid, Softmax,
+    LogSoftmax, Maxout, GLU,
+)
+from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose
+from .norm import (
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm2D,
+    LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm,
+)
+from .pooling import (
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, MaxPool1D,
+    MaxPool2D,
+)
+from .container import LayerDict, LayerList, ParameterList, Sequential
+from .loss import (
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss, MSELoss,
+    NLLLoss, SmoothL1Loss,
+)
+from .transformer import (
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)
+
+# paddle compat: nn.initializer.* style access is already available.
+ClipGradByNorm = None  # set by optimizer.clip at import
+ClipGradByGlobalNorm = None
+ClipGradByValue = None
+
+
+def _late_bind_clip():
+    global ClipGradByNorm, ClipGradByGlobalNorm, ClipGradByValue
+    from ..optimizer import clip as _clip
+
+    ClipGradByNorm = _clip.ClipGradByNorm
+    ClipGradByGlobalNorm = _clip.ClipGradByGlobalNorm
+    ClipGradByValue = _clip.ClipGradByValue

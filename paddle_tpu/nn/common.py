@@ -1,0 +1,215 @@
+"""Common layers: Linear, Embedding, Dropout, activations, padding, Flatten,
+Upsample. Analog of python/paddle/nn/layer/common.py + activation.py."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from . import functional as F
+from . import initializer as init
+from .layer import Layer, Parameter
+
+
+class Linear(Layer):
+    """y = x @ W + b, W shape (in, out) — matches the reference layout
+    (python/paddle/nn/layer/common.py Linear)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        w_init = weight_attr if isinstance(weight_attr, init.Initializer) else init.XavierUniform()
+        self.weight = Parameter(w_init((in_features, out_features), jnp.float32))
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            b_init = bias_attr if isinstance(bias_attr, init.Initializer) else init.Constant(0.0)
+            self.bias = Parameter(b_init((out_features,), jnp.float32))
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self._parameters.get("bias"))
+
+    def __repr__(self):
+        return f"Linear(in={self.in_features}, out={self.out_features})"
+
+
+class Embedding(Layer):
+    """Analog of paddle.nn.Embedding (phi embedding kernel)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = padding_idx
+        w_init = weight_attr if isinstance(weight_attr, init.Initializer) else init.Normal(0.0, 1.0)
+        w = w_init((num_embeddings, embedding_dim), jnp.float32)
+        if padding_idx is not None:
+            w = w.at[padding_idx].set(0.0)
+        self.weight = Parameter(w)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, axis=self.axis, training=self.training, mode=self.mode)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, p=self.p, training=self.training, data_format=self.data_format)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        return x.flatten(self.start_axis, self.stop_axis)
+
+
+def _act_layer(name, fn_name, **defaults):
+    def forward(self, x):
+        from ..ops.registry import dispatch
+
+        return dispatch(fn_name, x, **{k: getattr(self, k) for k in defaults})
+
+    def __init__(self, **kwargs):
+        Layer.__init__(self)
+        for k, v in defaults.items():
+            setattr(self, k, kwargs.get(k, v))
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _act_layer("ReLU", "relu")
+ReLU6 = _act_layer("ReLU6", "relu6")
+LeakyReLU = _act_layer("LeakyReLU", "leaky_relu", negative_slope=0.01)
+ELU = _act_layer("ELU", "elu", alpha=1.0)
+SELU = _act_layer("SELU", "selu")
+CELU = _act_layer("CELU", "celu", alpha=1.0)
+GELU = _act_layer("GELU", "gelu", approximate=False)
+Silu = _act_layer("Silu", "silu")
+Swish = _act_layer("Swish", "swish")
+Mish = _act_layer("Mish", "mish")
+Hardswish = _act_layer("Hardswish", "hardswish")
+Hardsigmoid = _act_layer("Hardsigmoid", "hardsigmoid")
+Hardtanh = _act_layer("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Hardshrink = _act_layer("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _act_layer("Softshrink", "softshrink", threshold=0.5)
+Tanhshrink = _act_layer("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _act_layer("ThresholdedReLU", "thresholded_relu", threshold=1.0, value=0.0)
+Softplus = _act_layer("Softplus", "softplus", beta=1.0, threshold=20.0)
+Softsign = _act_layer("Softsign", "softsign")
+Sigmoid = _act_layer("Sigmoid", "sigmoid")
+Tanh = _act_layer("Tanh", "tanh")
+LogSigmoid = _act_layer("LogSigmoid", "logsigmoid")
+Softmax = _act_layer("Softmax", "softmax", axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", "log_softmax", axis=-1)
+Maxout = _act_layer("Maxout", "maxout", groups=2, axis=1)
+GLU = _act_layer("GLU", "glu", axis=-1)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init_value=0.25, name=None):
+        super().__init__()
+        self.weight = Parameter(jnp.full((num_parameters,), init_value, dtype=jnp.float32))
+
+    def forward(self, x):
+        from ..ops.registry import dispatch
+
+        w = self.weight
+        if w.shape[0] != 1:
+            shape = [1] * x.ndim
+            shape[1] = w.shape[0]
+            w = w.reshape(shape)
+        return dispatch("prelu", x, w)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW"):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value,
+                     data_format=self.data_format)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW"):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.align_corners, self.data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, data_format=self.data_format)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features, name=None):
+        super().__init__()
+        bound = 1.0 / math.sqrt(in1_features)
+        self.weight = Parameter(init.Uniform(-bound, bound)(
+            (out_features, in1_features, in2_features), jnp.float32))
+        self.bias = Parameter(jnp.zeros((1, out_features), dtype=jnp.float32))
+
+    def forward(self, x1, x2):
+        from ..ops.registry import dispatch
+
+        out = dispatch("einsum", "bi,oij,bj->bo", x1, self.weight, x2)
+        return out + self.bias

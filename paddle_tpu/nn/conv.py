@@ -1,0 +1,111 @@
+"""Conv layers (analog of python/paddle/nn/layer/conv.py). Weight layout is
+(out_channels, in_channels/groups, *kernel) matching the reference; XLA maps
+these onto the MXU via conv_general_dilated."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from . import functional as F
+from . import initializer as init
+from .layer import Layer, Parameter
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, ndim, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 weight_attr=None, data_format="NCHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * ndim
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        w_shape = (out_channels, in_channels // groups, *self.kernel_size)
+        w_init = weight_attr if isinstance(weight_attr, init.Initializer) else init.KaimingUniform()
+        self.weight = Parameter(w_init(w_shape, jnp.float32))
+        if bias_attr is False:
+            self._parameters["bias"] = None
+        else:
+            fan_in = in_channels // groups * int(math.prod(self.kernel_size))
+            bound = 1.0 / math.sqrt(fan_in)
+            b_init = bias_attr if isinstance(bias_attr, init.Initializer) else init.Uniform(-bound, bound)
+            self.bias = Parameter(b_init((out_channels,), jnp.float32))
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, bias_attr, weight_attr, data_format)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self._parameters.get("bias"),
+                        stride=self.stride, padding=self.padding,
+                        dilation=self.dilation, groups=self.groups,
+                        data_format=self.data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, bias_attr, weight_attr, data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self._parameters.get("bias"),
+                        stride=self.stride, padding=self.padding,
+                        dilation=self.dilation, groups=self.groups,
+                        data_format=self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, bias_attr, weight_attr, data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self._parameters.get("bias"),
+                        stride=self.stride, padding=self.padding,
+                        dilation=self.dilation, groups=self.groups,
+                        data_format=self.data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        w_shape = (in_channels, out_channels // groups, *kernel_size)
+        w_init = weight_attr if isinstance(weight_attr, init.Initializer) else init.KaimingUniform()
+        self.weight = Parameter(w_init(w_shape, jnp.float32))
+        if bias_attr is False:
+            self._parameters["bias"] = None
+        else:
+            self.bias = Parameter(jnp.zeros((out_channels,), dtype=jnp.float32))
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, self._parameters.get("bias"),
+                                  stride=self.stride, padding=self.padding,
+                                  output_padding=self.output_padding,
+                                  dilation=self.dilation, groups=self.groups,
+                                  data_format=self.data_format)

@@ -1,0 +1,152 @@
+"""paddle_tpu.nn.functional — functional nn API.
+
+Analog of python/paddle/nn/functional/*: thin Tensor-level wrappers over the
+registered nn ops, plus dropout/attention conveniences that thread RNG
+through the global generator.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.registry import dispatch
+from ...ops import random as _random
+
+# re-export op-level entry points (Tensor in/out via dispatch)
+from ...ops.nn_ops import (  # noqa: F401
+    relu, relu6, leaky_relu, prelu, elu, selu, celu, gelu, silu, swish, mish,
+    hardswish, hardsigmoid, hardtanh, hardshrink, softshrink, tanhshrink,
+    thresholded_relu, softplus, softsign, maxout, glu, softmax, log_softmax,
+    layer_norm, rms_norm, group_norm, instance_norm,
+    linear, conv1d, conv2d, conv3d, conv2d_transpose,
+    max_pool1d, max_pool2d, avg_pool1d, avg_pool2d,
+    adaptive_avg_pool2d, adaptive_max_pool2d,
+    embedding, scaled_dot_product_attention,
+    softmax_with_cross_entropy, binary_cross_entropy,
+    binary_cross_entropy_with_logits, mse_loss, l1_loss, smooth_l1_loss,
+    kl_div, nll_loss, cosine_similarity, pixel_shuffle, unfold,
+)
+from ...ops.math import sigmoid, tanh  # noqa: F401
+from ...ops.manip import pad, one_hot  # noqa: F401
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """Analog of paddle.nn.functional.dropout (phi dropout kernel)."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return dispatch("scale", x, scale=1.0 - p)
+        return x
+    key = _random.default_generator().next_key()
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    mask = jax.random.bernoulli(key, 1.0 - p, shape)
+    mask = jnp.broadcast_to(mask, tuple(x.shape))
+    return dispatch("dropout_impl", x, Tensor(mask), p=p, mode=mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0):
+    """Analog of paddle.nn.functional.cross_entropy
+    (phi cross_entropy_with_softmax kernel + python wrapper)."""
+    if label_smoothing > 0.0 and not soft_label:
+        num_classes = input.shape[axis]
+        oh = dispatch("one_hot", label, num_classes=num_classes)
+        oh = dispatch("cast", oh, dtype=jnp.float32)
+        smooth = oh * (1.0 - label_smoothing) + label_smoothing / num_classes
+        return cross_entropy(input, smooth, weight=weight, reduction=reduction,
+                             soft_label=True, axis=axis, use_softmax=use_softmax)
+    if use_softmax:
+        nll = dispatch("softmax_with_cross_entropy", input, label,
+                       soft_label=soft_label, ignore_index=ignore_index, axis=axis)
+    else:
+        logp = dispatch("log", input)
+        if soft_label:
+            nll = -(label * logp).sum(axis=axis, keepdim=True)
+        else:
+            return nll_loss(logp, label, weight=weight, ignore_index=ignore_index,
+                            reduction=reduction)
+    if weight is not None and not soft_label:
+        w = dispatch("gather", weight, label, axis=0)
+        nll = nll * w.unsqueeze(-1)
+    if reduction == "none":
+        return nll
+    if reduction == "sum":
+        return nll.sum()
+    if not soft_label:
+        lblv = label._value if isinstance(label, Tensor) else label
+        valid = (lblv != ignore_index)
+        denom = jnp.maximum(valid.sum(), 1).astype(jnp.float32)
+        return nll.sum() / Tensor(denom)
+    return nll.mean()
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    return dispatch("normalize_op", x, p=p, axis=axis, epsilon=epsilon)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    if not training:
+        return dispatch("batch_norm_infer", x, running_mean, running_var,
+                        weight, bias, epsilon=epsilon, data_format=data_format)
+    out, mean, var = dispatch("batch_norm_train", x, weight, bias,
+                              epsilon=epsilon, data_format=data_format)
+    # update running stats in-place (host side, matches reference semantics)
+    if running_mean is not None:
+        running_mean.set_value(momentum * running_mean._value + (1 - momentum) * mean._value)
+        running_var.set_value(momentum * running_var._value + (1 - momentum) * var._value)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    if size is None:
+        h_axis, w_axis = (2, 3) if data_format == "NCHW" else (1, 2)
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
+        size = (int(x.shape[h_axis] * sf[0]), int(x.shape[w_axis] * sf[1]))
+    if mode == "nearest":
+        return dispatch("interpolate_nearest", x, size=tuple(size), data_format=data_format)
+    if mode in ("bilinear", "linear"):
+        return dispatch("interpolate_bilinear", x, size=tuple(size),
+                        align_corners=align_corners, data_format=data_format)
+    raise NotImplementedError(f"interpolate mode {mode!r}")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, training=True):
+    """Analog of paddle.nn.functional.flash_attention.flash_attention
+    (python/paddle/nn/functional/flash_attention.py:195). On TPU this routes
+    to the Pallas flash kernel when available, else the XLA softmax path."""
+    from ...incubate.nn import attention as _attn
+
+    out = _attn.flash_attention(query, key, value, causal=causal,
+                                dropout=dropout if training else 0.0)
+    if return_softmax:
+        return out, None
+    return out
+
+
+def scaled_dot_product_attention_(q, k, v, attn_mask=None, dropout_p=0.0,
+                                  is_causal=False, training=True):
+    mask_t = None
+    if dropout_p > 0.0 and training:
+        key_ = _random.default_generator().next_key()
+        b, sq, h, _ = q.shape
+        sk = k.shape[1]
+        mask_t = Tensor(jax.random.bernoulli(key_, 1.0 - dropout_p, (b, h, sq, sk)))
+    return dispatch("scaled_dot_product_attention", q, k, v, attn_mask=attn_mask,
+                    dropout_mask=mask_t, dropout_p=dropout_p, is_causal=is_causal)

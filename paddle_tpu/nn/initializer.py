@@ -1,0 +1,145 @@
+"""Weight initializers (analog of paddle.nn.initializer /
+python/paddle/nn/initializer/*). Initializers are host-side: they produce a
+jax array for a given (shape, dtype) using the global generator."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import random as _random
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weight (out, in, *k): fan_in = in * k, fan_out = out * k
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        k = _random.default_generator().next_key()
+        return jax.random.uniform(k, tuple(shape), dtype=jnp.float32,
+                                  minval=self.low, maxval=self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        k = _random.default_generator().next_key()
+        return (self.mean + self.std * jax.random.normal(k, tuple(shape), dtype=jnp.float32)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        k = _random.default_generator().next_key()
+        z = jax.random.truncated_normal(k, self.a, self.b, tuple(shape), dtype=jnp.float32)
+        return (self.mean + self.std * z).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        k = _random.default_generator().next_key()
+        return jax.random.uniform(k, tuple(shape), dtype=jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        k = _random.default_generator().next_key()
+        return (std * jax.random.normal(k, tuple(shape), dtype=jnp.float32)).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, negative_slope=0.0, nonlinearity="leaky_relu", fan_mode="fan_in"):
+        self.negative_slope = negative_slope
+        self.fan_mode = fan_mode
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        fan = fan_in if self.fan_mode == "fan_in" else fan_out
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fan)
+        k = _random.default_generator().next_key()
+        return jax.random.uniform(k, tuple(shape), dtype=jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, negative_slope=0.0, nonlinearity="leaky_relu", fan_mode="fan_in"):
+        self.negative_slope = negative_slope
+        self.fan_mode = fan_mode
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        fan = fan_in if self.fan_mode == "fan_in" else fan_out
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fan)
+        k = _random.default_generator().next_key()
+        return (std * jax.random.normal(k, tuple(shape), dtype=jnp.float32)).astype(dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        k = _random.default_generator().next_key()
+        return (self.gain * jax.nn.initializers.orthogonal()(k, tuple(shape), jnp.float32)).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        v = jnp.asarray(self.value, dtype=dtype)
+        assert tuple(v.shape) == tuple(shape), f"Assign shape {v.shape} != {shape}"
+        return v
+
+
+# paddle-compat aliases
+constant = Constant
+uniform = Uniform
+normal = Normal

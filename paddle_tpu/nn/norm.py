@@ -1,0 +1,177 @@
+"""Normalization layers (analog of python/paddle/nn/layer/norm.py).
+BatchNorm keeps running stats as non-trainable buffers; LayerNorm/RMSNorm
+compute in fp32 and cast back (TPU-friendly, matches phi kernel semantics)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import functional as F
+from .layer import Layer, Parameter
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self._parameters["weight"] = None
+        else:
+            self.weight = Parameter(jnp.ones(self._normalized_shape, dtype=jnp.float32))
+        if bias_attr is False:
+            self._parameters["bias"] = None
+        else:
+            self.bias = Parameter(jnp.zeros(self._normalized_shape, dtype=jnp.float32))
+
+    def forward(self, x):
+        begin = x.ndim - len(self._normalized_shape)
+        return F.layer_norm(x, self._parameters.get("weight"),
+                            self._parameters.get("bias"),
+                            epsilon=self._epsilon, begin_norm_axis=begin)
+
+
+class RMSNorm(Layer):
+    """TPU-first norm used by Llama-family models (analog of
+    paddle.incubate.nn.functional.fused_rms_norm)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = Parameter(jnp.ones((hidden_size,), dtype=jnp.float32))
+
+    def forward(self, x):
+        from ..incubate.nn import fused as _fused
+
+        return _fused.fused_rms_norm(x, self.weight, epsilon=self._epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is False:
+            self._parameters["weight"] = None
+        else:
+            self.weight = Parameter(jnp.ones((num_features,), dtype=jnp.float32))
+        if bias_attr is False:
+            self._parameters["bias"] = None
+        else:
+            self.bias = Parameter(jnp.zeros((num_features,), dtype=jnp.float32))
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,), dtype=jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,), dtype=jnp.float32)))
+
+    def forward(self, x):
+        training = self.training and not (self._use_global_stats is True)
+        return F.batch_norm(x, self._mean, self._variance,
+                            self._parameters.get("weight"),
+                            self._parameters.get("bias"),
+                            training=training, momentum=self._momentum,
+                            epsilon=self._epsilon, data_format=self._data_format)
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, **kw):
+        kw.setdefault("data_format", "NCL")
+        kw["data_format"] = "NCHW" if kw["data_format"] == "NCL" else "NHWC"
+        super().__init__(num_features, **kw)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Under GSPMD data parallelism the batch statistics are computed over the
+    global (sharded) batch automatically inside jit; eager single-process
+    behavior equals BatchNorm. (Reference: paddle.nn.SyncBatchNorm backed by
+    NCCL allreduce of stats.)"""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False:
+            self._parameters["weight"] = None
+        else:
+            self.weight = Parameter(jnp.ones((num_channels,), dtype=jnp.float32))
+        if bias_attr is False:
+            self._parameters["bias"] = None
+        else:
+            self.bias = Parameter(jnp.zeros((num_channels,), dtype=jnp.float32))
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._parameters.get("weight"),
+                            self._parameters.get("bias"), epsilon=self._epsilon,
+                            data_format=self._data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self._parameters["weight"] = None
+        else:
+            self.weight = Parameter(jnp.ones((num_features,), dtype=jnp.float32))
+        if bias_attr is False:
+            self._parameters["bias"] = None
+        else:
+            self.bias = Parameter(jnp.zeros((num_features,), dtype=jnp.float32))
+
+    def forward(self, x):
+        return F.instance_norm(x, self._parameters.get("weight"),
+                               self._parameters.get("bias"), epsilon=self._epsilon)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW"):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def forward(self, x):
+        import jax
+
+        v = x._value if hasattr(x, "_value") else x
+        sq = jnp.square(v)
+        half = self.size // 2
+        summed = jnp.zeros_like(sq)
+        c = v.shape[1]
+        for i in range(-half, half + 1):
+            if i < 0:
+                summed = summed.at[:, :c + i].add(sq[:, -i:])
+            elif i > 0:
+                summed = summed.at[:, i:].add(sq[:, :-i])
+            else:
+                summed = summed + sq
+        denom = jnp.power(self.k + self.alpha * summed / self.size, self.beta)
+        return Tensor(v / denom)

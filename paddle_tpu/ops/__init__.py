@@ -1,0 +1,18 @@
+"""Op library: importing this package registers every op and attaches
+Tensor methods (the analog of the reference's build-time codegen pipeline,
+SURVEY.md §2.11 — here registration happens at import)."""
+
+from . import registry
+from .registry import dispatch, register, get_op, all_ops
+
+from .math import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manip import *  # noqa: F401,F403
+from .nn_ops import *  # noqa: F401,F403
+from .creation import (  # noqa: F401
+    arange, assign, diag, diagflat, empty, empty_like, eye, full, full_like,
+    linspace, logspace, meshgrid, ones, ones_like, tril_indices, triu_indices,
+    zeros, zeros_like,
+)
+from . import random  # noqa: F401
+from . import tensor_methods  # noqa: F401

@@ -1,0 +1,139 @@
+"""Tensor creation ops (paddle.zeros/ones/full/arange/linspace/eye/...).
+
+Analog of the reference's creation API (python/paddle/tensor/creation.py).
+Creation ops are non-recorded (no grad history), matching the reference
+where ``stop_gradient=True`` on fresh tensors.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, to_tensor
+
+
+def _d(dtype, default="float32"):
+    return convert_dtype(dtype) or np.dtype(default)
+
+
+def zeros(shape, dtype="float32"):
+    return Tensor(jnp.zeros(shape, dtype=_d(dtype)))
+
+
+def ones(shape, dtype="float32"):
+    return Tensor(jnp.ones(shape, dtype=_d(dtype)))
+
+
+def full(shape, fill_value, dtype="float32"):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    return Tensor(jnp.full(shape, fill_value, dtype=_d(dtype)))
+
+
+def zeros_like(x, dtype=None):
+    v = x._value if isinstance(x, Tensor) else x
+    return Tensor(jnp.zeros_like(v, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    v = x._value if isinstance(x, Tensor) else x
+    return Tensor(jnp.ones_like(v, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    v = x._value if isinstance(x, Tensor) else x
+    return Tensor(jnp.full_like(v, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32"):
+    return Tensor(jnp.zeros(shape, dtype=_d(dtype)))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be Python numbers")
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, int) for v in (start, end, step)) else "float32"
+    return Tensor(jnp.arange(start, end, step, dtype=_d(dtype, "int64")))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype="float32"):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_d(dtype)))
+
+
+def diag(x, offset=0, padding_value=0):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if v.ndim == 1 and padding_value != 0:
+        n = v.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, dtype=v.dtype)
+        out = base + jnp.diag(v, k=offset) - jnp.diag(jnp.full(v.shape, padding_value, v.dtype), k=offset)
+        return Tensor(out)
+    return Tensor(jnp.diag(v, k=offset))
+
+
+def diagflat(x, offset=0):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.diagflat(v, k=offset))
+
+
+def tril(x, diagonal=0):
+    from .registry import dispatch
+
+    return dispatch("tril", x, diagonal=diagonal)
+
+
+def triu(x, diagonal=0):
+    from .registry import dispatch
+
+    return dispatch("triu", x, diagonal=diagonal)
+
+
+def meshgrid(*args):
+    vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(v) for v in jnp.meshgrid(*vals, indexing="ij")]
+
+
+def assign(x, output=None):
+    t = to_tensor(x) if not isinstance(x, Tensor) else Tensor(x._value)
+    if output is not None:
+        output.set_value(t._value)
+        return output
+    return t
+
+
+def clone(x):
+    return x.clone()
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_d(dtype, "int64")))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_d(dtype, "int64")))
+
+
+def one_hot(x, num_classes):
+    from .registry import dispatch
+
+    return dispatch("one_hot", x, num_classes=num_classes)

@@ -1,0 +1,232 @@
+"""Linear algebra ops.
+
+Analog of python/paddle/tensor/linalg.py (e.g. ``matmul`` linalg.py:191) and
+the phi blas/lapack kernels. Matmuls are AMP-white (bf16 on the MXU) and use
+jax.lax.dot_general so XLA tiles them onto the systolic array.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("matmul", amp="white")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@register("bmm", amp="white")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register("dot", amp="white")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register("mm", amp="white")
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register("mv", amp="white")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@register("outer", amp="white")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register("inner", amp="white")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@register("einsum", amp="white")
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@register("addmm", amp="white")
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register("cross")
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@register("norm", amp="black")
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (tuple, list)) else None,
+                               axis=tuple(axis) if isinstance(axis, list) else axis,
+                               keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(x, ord="nuc",
+                               axis=tuple(axis) if isinstance(axis, (tuple, list)) else axis,
+                               keepdims=keepdim)
+    if axis is None:
+        return jnp.linalg.norm(jnp.ravel(x), ord=p, keepdims=keepdim)
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis) if isinstance(axis, list) else axis,
+                           keepdims=keepdim)
+
+
+@register("dist", amp="black")
+def dist(x, y, p=2.0):
+    return jnp.linalg.norm(jnp.ravel(x - y), ord=p)
+
+
+@register("t")
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register("transpose2", amp=None)
+def transpose2(x):
+    return x.T
+
+
+@register("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register("inverse", amp="black")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register("pinv", amp="black")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@register("det", amp="black")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register("slogdet", amp="black")
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@register("cholesky", amp="black")
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@register("cholesky_solve", amp="black")
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@register("qr", amp="black")
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@register("svd", amp="black")
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@register("eig", amp="black", nondiff=True)
+def eig(x):
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@register("eigh", amp="black")
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, symmetrize_input=(UPLO == "L"))
+
+
+@register("eigvals", amp="black", nondiff=True)
+def eigvals(x):
+    import numpy as np
+
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+@register("eigvalsh", amp="black")
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x)
+
+
+@register("solve", amp="black")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register("triangular_solve", amp="black")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+@register("lstsq", amp="black", nondiff=True)
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register("matrix_rank", amp="black", nondiff=True)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register("cond", amp="black", nondiff=True)
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register("lu", amp="black", nondiff=True)
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype("int32")
+
+
+@register("multi_dot", amp="white")
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@register("histogram", nondiff=True)
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):  # noqa: A002
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng, weights=weight, density=density)
+    return hist
+
+
+@register("corrcoef", amp="black")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register("cov", amp="black")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)

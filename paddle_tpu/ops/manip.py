@@ -1,0 +1,393 @@
+"""Shape / layout / indexing manipulation ops.
+
+Analog of the reference's manipulation op set
+(python/paddle/tensor/manipulation.py + kernels). All static-shape,
+XLA-friendly: no data-dependent output shapes except ``nonzero``-style ops
+which are marked host-only.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ------------------------------ reshape family ------------------------------
+
+
+@register("reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+@register("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = list(x.shape[:start]) + [-1] + list(x.shape[stop + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+@register("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@register("unsqueeze")
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    out = x
+    for a in sorted(a % (out.ndim + 1) for a in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@register("transpose")
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+@register("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register("swapaxes")
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@register("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+@register("expand")
+def expand(x, shape):
+    shape = list(shape)
+    # paddle semantics: -1 keeps the original dim
+    x_shape = [1] * (len(shape) - x.ndim) + list(x.shape)
+    out_shape = [xs if s == -1 else s for s, xs in zip(shape, x_shape)]
+    return jnp.broadcast_to(x, out_shape)
+
+
+@register("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+@register("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("concat")
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register("stack")
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("split")
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    # sections list: allow one -1
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    idx = jnp.cumsum(jnp.array(sections))[:-1]
+    return tuple(jnp.split(x, [int(i) for i in idx], axis=axis))
+
+
+@register("chunk")
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+
+@register("unstack")
+def unstack(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+@register("unbind")
+def unbind(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+@register("flip")
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@register("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@register("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    # pad: flat list [lo_last, hi_last, lo_prev, hi_prev, ...] (torch/paddle style)
+    # or full per-dim list of (lo, hi)
+    if len(pad) == 2 * x.ndim and all(isinstance(p, (list, tuple)) for p in pad):
+        width = pad
+    else:
+        width = [(0, 0)] * x.ndim
+        n = len(pad) // 2
+        for i in range(n):
+            dim = x.ndim - 1 - i
+            width[dim] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, width, mode=jmode)
+
+
+# ------------------------------ indexing ------------------------------------
+
+
+@register("slice")
+def slice_op(x, idx):
+    return x[idx]
+
+
+@register("index_put")
+def index_put(x, idx, value):
+    return x.at[idx].set(value)
+
+
+@register("gather")
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True):
+    if broadcast:
+        indices = jnp.broadcast_to(
+            indices,
+            tuple(indices.shape[d] if d == axis % x.ndim else x.shape[d] for d in range(x.ndim)),
+        )
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@register("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    if reduce == "add":
+        zeros = jnp.zeros_like(x)
+        scattered = jnp.put_along_axis(zeros, indices, values, axis=axis, inplace=False)
+        return x + scattered
+    if reduce in ("multiply", "mul"):
+        ones = jnp.ones_like(x)
+        scattered = jnp.put_along_axis(ones, indices, values, axis=axis, inplace=False)
+        return x * scattered
+    raise ValueError(f"unsupported reduce {reduce!r}")
+
+
+@register("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register("scatter")
+def scatter(x, index, updates, overwrite=True):
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@register("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register("index_add")
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register("masked_select", nondiff=True)
+def masked_select(x, mask):
+    # data-dependent shape: host-only op (documented limitation; the
+    # reference has the same dynamic-output problem in static graphs)
+    import numpy as np
+
+    xv = np.asarray(x)
+    mv = np.asarray(mask)
+    return jnp.asarray(xv[mv])
+
+
+@register("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+@register("index_fill")
+def index_fill(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(jnp.asarray(value, dtype=x.dtype))
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register("select_scatter")
+def select_scatter(x, values, axis, index):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(values)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register("nonzero", nondiff=True)
+def nonzero(x, as_tuple=False):
+    import numpy as np
+
+    nz = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in nz)
+    return jnp.asarray(np.stack(nz, axis=-1))
+
+
+@register("where_index", nondiff=True)
+def where_index(condition):
+    import numpy as np
+
+    nz = np.nonzero(np.asarray(condition))
+    return jnp.asarray(np.stack(nz, axis=-1))
+
+
+# ------------------------------ tri / sort / search -------------------------
+
+
+@register("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register("sort")
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", nondiff=True)
+def argsort(x, axis=-1, descending=False, stable=False):
+    idx = jnp.argsort(x, axis=axis, stable=stable)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype("int64")
+
+
+@register("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if axis != -1 and axis != x.ndim - 1:
+        moved = jnp.moveaxis(x, axis, -1)
+        vals, idx = topk.raw_fn(moved, k, -1, largest, sorted)
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    if largest:
+        vals, idx = lax.top_k(x, k)
+    else:
+        vals, idx = lax.top_k(-x, k)
+        vals = -vals
+    return vals, idx.astype("int64")
+
+
+@register("searchsorted", nondiff=True)
+def searchsorted(sorted_sequence, values, right=False):
+    side = "right" if right else "left"
+    return jnp.searchsorted(sorted_sequence, values, side=side).astype("int64")
+
+
+@register("bucketize", nondiff=True)
+def bucketize(x, sorted_sequence, right=False):
+    side = "right" if right else "left"
+    return jnp.searchsorted(sorted_sequence, x, side=side).astype("int64")
+
+
+@register("unique", nondiff=True)
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+
+    res = np.unique(
+        np.asarray(x), return_index=return_index,
+        return_inverse=return_inverse, return_counts=return_counts, axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@register("one_hot", nondiff=True)
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@register("bincount", nondiff=True)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@register("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register("as_strided")
+def as_strided(x, shape, stride, offset=0):
+    # emulate via gather on flattened buffer (XLA has no strided view)
+    flat = jnp.ravel(x)
+    idx = jnp.zeros(tuple(shape), dtype=jnp.int32) + offset
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s, dtype=jnp.int32) * st
+        idx = idx + jnp.expand_dims(r, tuple(i for i in range(len(shape)) if i != d))
+    return flat[idx]

@@ -1,0 +1,593 @@
+"""Elementwise / binary / reduction math ops.
+
+Analog of the reference's math op set (paddle/phi/ops/yaml/ops.yaml entries
+like ``add``, ``multiply``, ``exp`` …; kernels in paddle/phi/kernels/*).
+Each op is a pure jnp function; XLA fuses chains of these into single
+kernels, which on TPU is the entire fusion story the reference needs CINN
+for (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# --------------------------- binary elementwise ---------------------------
+
+
+@register("add")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@register("subtract")
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@register("multiply")
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@register("divide")
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@register("floor_divide")
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@register("remainder")
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+@register("pow")
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@register("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@register("fmax")
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@register("fmin")
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@register("atan2")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@register("hypot")
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@register("logaddexp")
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@register("heaviside")
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@register("nextafter", nondiff=True)
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@register("copysign")
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+# --------------------------- unary elementwise ----------------------------
+
+
+@register("clone")
+def clone(x):
+    return x + jnp.zeros((), dtype=x.dtype) if jnp.issubdtype(x.dtype, jnp.number) else jnp.array(x)
+
+
+@register("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@register("expm1")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@register("log", amp="black")
+def log(x):
+    return jnp.log(x)
+
+
+@register("log2", amp="black")
+def log2(x):
+    return jnp.log2(x)
+
+
+@register("log10", amp="black")
+def log10(x):
+    return jnp.log10(x)
+
+
+@register("log1p", amp="black")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@register("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register("rsqrt")
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+@register("square")
+def square(x):
+    return jnp.square(x)
+
+
+@register("abs")
+def abs(x):  # noqa: A001
+    return jnp.abs(x)
+
+
+@register("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@register("neg")
+def neg(x):
+    return jnp.negative(x)
+
+
+@register("reciprocal")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@register("floor")
+def floor(x):
+    return jnp.floor(x)
+
+
+@register("ceil")
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@register("round")
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+@register("trunc")
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@register("frac")
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@register("sin")
+def sin(x):
+    return jnp.sin(x)
+
+
+@register("cos")
+def cos(x):
+    return jnp.cos(x)
+
+
+@register("tan")
+def tan(x):
+    return jnp.tan(x)
+
+
+@register("asin")
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@register("acos")
+def acos(x):
+    return jnp.arccos(x)
+
+
+@register("atan")
+def atan(x):
+    return jnp.arctan(x)
+
+
+@register("sinh")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@register("cosh")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("asinh")
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@register("acosh")
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@register("atanh")
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("logsigmoid")
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register("erf")
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@register("erfinv")
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@register("lgamma")
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@register("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@register("isnan", nondiff=True)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register("isinf", nondiff=True)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@register("isfinite", nondiff=True)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register("clip")
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@register("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register("rint")
+def rint(x):
+    return jnp.rint(x)
+
+
+# ------------------------------- logical ----------------------------------
+
+
+@register("logical_and", nondiff=True)
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@register("logical_or", nondiff=True)
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@register("logical_xor", nondiff=True)
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@register("logical_not", nondiff=True)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@register("bitwise_and", nondiff=True)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@register("bitwise_or", nondiff=True)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@register("bitwise_xor", nondiff=True)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@register("bitwise_not", nondiff=True)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@register("equal", nondiff=True)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@register("not_equal", nondiff=True)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@register("greater_than", nondiff=True)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@register("greater_equal", nondiff=True)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@register("less_than", nondiff=True)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@register("less_equal", nondiff=True)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@register("isclose", nondiff=True)
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register("allclose", nondiff=True)
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+# ------------------------------ reductions ---------------------------------
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+@register("sum")
+def sum(x, axis=None, keepdim=False, dtype=None):  # noqa: A001
+    return jnp.sum(x, axis=_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@register("mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("max")
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("min")
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@register("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("nansum")
+def nansum(x, axis=None, keepdim=False, dtype=None):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@register("logsumexp", amp="black")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("all", nondiff=True)
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("any", nondiff=True)
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("argmax", nondiff=True)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype)
+
+
+@register("argmin", nondiff=True)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype)
+
+
+@register("cumsum")
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@register("cumprod")
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = jnp.ravel(x)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+@register("cummax", nondiff=True)
+def cummax(x, axis=-1):
+    return lax.cummax(x, axis=axis)
+
+
+@register("cummin", nondiff=True)
+def cummin(x, axis=-1):
+    return lax.cummin(x, axis=axis)
+
+
+@register("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register("count_nonzero", nondiff=True)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+# ------------------------------ misc math ----------------------------------
+
+
+@register("cast")
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@register("trace_op")
+def trace_op(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("diff")
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@register("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+@register("real")
+def real(x):
+    return jnp.real(x)
+
+
+@register("imag")
+def imag(x):
+    return jnp.imag(x)
+
+
+@register("conj")
+def conj(x):
+    return jnp.conj(x)

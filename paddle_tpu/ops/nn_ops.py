@@ -1,0 +1,739 @@
+"""Neural-net primitive ops: activations, normalization, conv/pool,
+embedding, dropout, attention, losses.
+
+Analog of the reference's nn functional kernels (paddle/phi/kernels:
+softmax, conv, pool2d, layer_norm, batch_norm, embedding,
+cross_entropy_with_softmax, flash_attn, dropout, ...) expressed as XLA ops.
+Convs/matmul-like ops are AMP-white (bf16 → MXU); softmax/norm/losses are
+AMP-black (fp32 accumulate), mirroring the reference AMP lists
+(python/paddle/amp/amp_lists.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ------------------------------ activations --------------------------------
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@register("prelu")
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+@register("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@register("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register("celu")
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@register("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@register("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@register("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register("hardswish")
+def hardswish(x):
+    return jax.nn.hard_swish(x)
+
+
+@register("hardsigmoid")
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@register("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros((), dtype=x.dtype))
+
+
+@register("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)).astype(x.dtype)
+
+
+@register("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@register("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, jnp.asarray(value, dtype=x.dtype))
+
+
+@register("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register("maxout")
+def maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
+
+
+@register("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register("softmax", amp="black")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax", amp="black")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("gumbel_softmax_impl", amp="black")
+def gumbel_softmax_impl(x, g, temperature=1.0, hard=False, axis=-1):
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y).at[...].set(0.0)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+        y = onehot + y - lax.stop_gradient(y)
+    return y
+
+
+# ------------------------------ normalization -------------------------------
+
+
+@register("layer_norm", amp="black")
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim)) if begin_norm_axis != -1 else (x.ndim - 1,)
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + epsilon)
+    out = out.astype(dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register("rms_norm", amp="black")
+def rms_norm(x, weight=None, epsilon=1e-6):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * lax.rsqrt(var + epsilon)).astype(dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@register("batch_norm_infer", amp="black")
+def batch_norm_infer(x, mean, variance, weight=None, bias=None, epsilon=1e-5,
+                     data_format="NCHW"):
+    caxis = 1 if data_format == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[caxis] = x.shape[caxis]
+    inv = lax.rsqrt(variance.reshape(shape) + epsilon)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("batch_norm_train", amp="black")
+def batch_norm_train(x, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    caxis = 1 if data_format == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[caxis] = x.shape[caxis]
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+@register("group_norm", amp="black")
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    orig = x.shape
+    xg = jnp.reshape(x, (n, g, c // g, *orig[2:]))
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = (xg - mean) * lax.rsqrt(var + epsilon)
+    out = jnp.reshape(out, orig)
+    shape = [1, c] + [1] * (len(orig) - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register("instance_norm", amp="black")
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("normalize_op", amp="black")
+def normalize_op(x, p=2, axis=1, epsilon=1e-12):
+    n = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(n, epsilon)
+
+
+# ------------------------------ linear/conv ---------------------------------
+
+
+@register("linear", amp="white")
+def linear(x, weight, bias=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _conv_dn(ndim, channel_last):
+    if ndim == 3:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim == 4:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _conv_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+@register("conv2d", amp="white")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _conv_dn(4, channel_last))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_norm_tuple(stride, 2),
+        padding=_conv_padding(padding, 2),
+        rhs_dilation=_norm_tuple(dilation, 2),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        shape = [1, -1, 1, 1] if not channel_last else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("conv1d", amp="white")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    channel_last = data_format == "NLC"
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _conv_dn(3, channel_last))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_norm_tuple(stride, 1),
+        padding=_conv_padding(padding, 1),
+        rhs_dilation=_norm_tuple(dilation, 1),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        shape = [1, -1, 1] if not channel_last else [1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("conv3d", amp="white")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    channel_last = data_format == "NDHWC"
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _conv_dn(5, channel_last))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_norm_tuple(stride, 3),
+        padding=_conv_padding(padding, 3),
+        rhs_dilation=_norm_tuple(dilation, 3),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        shape = [1, -1, 1, 1, 1] if not channel_last else [1, 1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("conv2d_transpose", amp="white")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCHW"):
+    """Transposed conv with the reference's semantics
+    (phi conv2d_transpose kernel): out = (in-1)*s - 2p + d*(k-1) + 1 + op.
+    Implemented as an input-dilated forward conv so XLA maps it to the MXU;
+    weight layout (in, out//groups, kh, kw)."""
+    channel_last = data_format == "NHWC"
+    strides = _norm_tuple(stride, 2)
+    dils = _norm_tuple(dilation, 2)
+    out_pads = _norm_tuple(output_padding, 2)
+    pads = _conv_padding(padding, 2)
+    if isinstance(pads, str):
+        raise ValueError("string padding unsupported for conv_transpose")
+    cin = weight.shape[0]
+    cout_g = weight.shape[1]
+    kh, kw = weight.shape[2], weight.shape[3]
+    # (in, out//g, kh, kw) -> (g, in//g, out//g, kh, kw) -> (out, in//g, kh, kw)
+    w = jnp.reshape(weight, (groups, cin // groups, cout_g, kh, kw))
+    w = jnp.transpose(w, (0, 2, 1, 3, 4))
+    w = jnp.reshape(w, (groups * cout_g, cin // groups, kh, kw))
+    w = jnp.flip(w, axis=(2, 3))
+    eff_pads = [
+        (dils[i] * (weight.shape[2 + i] - 1) - pads[i][0],
+         dils[i] * (weight.shape[2 + i] - 1) - pads[i][1] + out_pads[i])
+        for i in range(2)
+    ]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dn(4, channel_last))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=eff_pads,
+        lhs_dilation=strides, rhs_dilation=dils,
+        dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        shape = [1, -1, 1, 1] if not channel_last else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+# ------------------------------ pooling -------------------------------------
+
+
+def _pool(x, init, op, kernel, stride, padding, data_format, count_include_pad=True, is_avg=False):
+    n = x.ndim - 2
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pads = _conv_padding(padding, n)
+    if channel_last:
+        window = (1, *kernel, 1)
+        strides = (1, *stride, 1)
+        pad_cfg = [(0, 0), *(pads if not isinstance(pads, str) else []), (0, 0)] if not isinstance(pads, str) else pads
+    else:
+        window = (1, 1, *kernel)
+        strides = (1, 1, *stride)
+        pad_cfg = [(0, 0), (0, 0), *pads] if not isinstance(pads, str) else pads
+    out = lax.reduce_window(x, init, op, window, strides, pad_cfg)
+    if is_avg:
+        if count_include_pad or (isinstance(pads, list) and all(p == (0, 0) for p in pads)):
+            denom = 1
+            for k in kernel:
+                denom *= k
+            out = out / denom
+        else:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad_cfg)
+            out = out / counts
+    return out
+
+
+@register("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    return _pool(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+                 lax.max, kernel_size, stride, padding, data_format)
+
+
+@register("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, count_include_pad=True,
+               data_format="NCHW"):
+    return _pool(x, 0.0, lax.add, kernel_size, stride, padding, data_format,
+                 count_include_pad=count_include_pad, is_avg=True)
+
+
+@register("max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, data_format="NCL"):
+    return _pool(x, -jnp.inf, lax.max, kernel_size, stride, padding, data_format)
+
+
+@register("avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, count_include_pad=True,
+               data_format="NCL"):
+    return _pool(x, 0.0, lax.add, kernel_size, stride, padding, data_format,
+                 count_include_pad=count_include_pad, is_avg=True)
+
+
+@register("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    channel_last = data_format == "NHWC"
+    h_axis, w_axis = (1, 2) if channel_last else (2, 3)
+    h, w = x.shape[h_axis], x.shape[w_axis]
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+        return _pool(x, 0.0, lax.add, (kh, kw), (kh, kw), 0, data_format, is_avg=True)
+    # general case: mean over computed bins (static shapes)
+    outs = []
+    for i in range(oh):
+        hs, he = (i * h) // oh, -(-((i + 1) * h) // oh)
+        rows = []
+        for j in range(ow):
+            ws, we = (j * w) // ow, -(-((j + 1) * w) // ow)
+            sl = [slice(None)] * x.ndim
+            sl[h_axis] = slice(hs, he)
+            sl[w_axis] = slice(ws, we)
+            rows.append(jnp.mean(x[tuple(sl)], axis=(h_axis, w_axis), keepdims=True))
+        outs.append(jnp.concatenate(rows, axis=w_axis))
+    return jnp.concatenate(outs, axis=h_axis)
+
+
+@register("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    channel_last = data_format == "NHWC"
+    h_axis, w_axis = (1, 2) if channel_last else (2, 3)
+    h, w = x.shape[h_axis], x.shape[w_axis]
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+        return _pool(x, -jnp.inf, lax.max, (kh, kw), (kh, kw), 0, data_format)
+    # general case: max over computed bins (mirrors adaptive_avg_pool2d)
+    outs = []
+    for i in range(oh):
+        hs, he = (i * h) // oh, -(-((i + 1) * h) // oh)
+        rows = []
+        for j in range(ow):
+            ws, we = (j * w) // ow, -(-((j + 1) * w) // ow)
+            sl = [slice(None)] * x.ndim
+            sl[h_axis] = slice(hs, he)
+            sl[w_axis] = slice(ws, we)
+            rows.append(jnp.max(x[tuple(sl)], axis=(h_axis, w_axis), keepdims=True))
+        outs.append(jnp.concatenate(rows, axis=w_axis))
+    return jnp.concatenate(outs, axis=h_axis)
+
+
+@register("global_avg_pool")
+def global_avg_pool(x, data_format="NCHW"):
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    return jnp.mean(x, axis=axes, keepdims=True)
+
+
+# ------------------------------ embedding / dropout -------------------------
+
+
+@register("embedding")
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), dtype=out.dtype), out)
+    return out
+
+
+@register("dropout_impl")
+def dropout_impl(x, mask, p=0.5, mode="upscale_in_train"):
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / (1.0 - p), jnp.zeros((), dtype=x.dtype))
+    return jnp.where(mask, x, jnp.zeros((), dtype=x.dtype))
+
+
+@register("interpolate_nearest")
+def interpolate_nearest(x, size, data_format="NCHW"):
+    channel_last = data_format == "NHWC"
+    h_axis, w_axis = (1, 2) if channel_last else (2, 3)
+    oh, ow = size
+    h, w = x.shape[h_axis], x.shape[w_axis]
+    ridx = (jnp.arange(oh) * h // oh).astype(jnp.int32)
+    cidx = (jnp.arange(ow) * w // ow).astype(jnp.int32)
+    out = jnp.take(x, ridx, axis=h_axis)
+    out = jnp.take(out, cidx, axis=w_axis)
+    return out
+
+
+@register("interpolate_bilinear")
+def interpolate_bilinear(x, size, align_corners=False, data_format="NCHW"):
+    channel_last = data_format == "NHWC"
+    if not channel_last:
+        x = jnp.moveaxis(x, 1, -1)
+    out = jax.image.resize(
+        x, (x.shape[0], size[0], size[1], x.shape[-1]),
+        method="bilinear",
+    )
+    if not channel_last:
+        out = jnp.moveaxis(out, -1, 1)
+    return out
+
+
+@register("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, r, r, c // (r * r)))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h * r, w * r, c // (r * r)))
+
+
+@register("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    n, c, h, w = x.shape
+    kh, kw = _norm_tuple(kernel_sizes, 2)
+    sh, sw = _norm_tuple(strides, 2)
+    dh, dw = _norm_tuple(dilations, 2)
+    ph, pw = _norm_tuple(paddings, 2)
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            sl = xp[:, :, i * dh:i * dh + oh * sh:sh, j * dw:j * dw + ow * sw:sw]
+            patches.append(sl)
+    out = jnp.stack(patches, axis=2)  # n, c, kh*kw, oh, ow
+    return jnp.reshape(out, (n, c * kh * kw, oh * ow))
+
+
+# ------------------------------ attention -----------------------------------
+
+
+@register("scaled_dot_product_attention", amp="white")
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_mask=None,
+                                 dropout_p=0.0, is_causal=False, scale=None):
+    """Reference: paddle.nn.functional.scaled_dot_product_attention /
+    flash_attn kernel (paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+    Layout: (batch, seq, heads, head_dim) — the reference's flash layout."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qt = jnp.einsum("bshd->bhsd", q)
+    kt = jnp.einsum("bshd->bhsd", k)
+    vt = jnp.einsum("bshd->bhsd", v)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_mask is not None and dropout_p > 0.0:
+        probs = jnp.where(dropout_mask, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.einsum("bhsd->bshd", out)
+
+
+# ------------------------------ losses --------------------------------------
+
+
+@register("softmax_with_cross_entropy", amp="black")
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               axis=-1):
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis, keepdims=True)
+    lbl = label
+    if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    nll = -jnp.take_along_axis(logp, jnp.expand_dims(
+        jnp.where(lbl == ignore_index, 0, lbl), axis), axis=axis)
+    nll = jnp.where(jnp.expand_dims(lbl == ignore_index, axis), 0.0, nll)
+    return nll
+
+
+@register("nll_loss", amp="black")
+def nll_loss(logp, label, weight=None, ignore_index=-100, reduction="mean"):
+    nll = -jnp.take_along_axis(logp, jnp.expand_dims(
+        jnp.where(label == ignore_index, 0, label), -1), axis=-1)[..., 0]
+    valid = label != ignore_index
+    if weight is not None:
+        w = jnp.take(weight, jnp.where(valid, label, 0))
+        nll = nll * w
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "none":
+        return nll
+    if reduction == "sum":
+        return jnp.sum(nll)
+    if weight is not None:
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+
+
+@register("binary_cross_entropy", amp="black")
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    eps = 1e-12
+    out = -(label * jnp.log(jnp.maximum(input, eps)) +
+            (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        out = out * weight
+    if reduction == "none":
+        return out
+    return jnp.sum(out) if reduction == "sum" else jnp.mean(out)
+
+
+@register("binary_cross_entropy_with_logits", amp="black")
+def binary_cross_entropy_with_logits(logit, label, weight=None, pos_weight=None,
+                                     reduction="mean"):
+    logit = logit.astype(jnp.float32)
+    max_val = jnp.maximum(-logit, 0.0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register("mse_loss", amp="black")
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    out = jnp.square(input - label)
+    if reduction == "none":
+        return out
+    return jnp.sum(out) if reduction == "sum" else jnp.mean(out)
+
+
+@register("l1_loss", amp="black")
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    out = jnp.abs(input - label)
+    if reduction == "none":
+        return out
+    return jnp.sum(out) if reduction == "sum" else jnp.mean(out)
+
+
+@register("smooth_l1_loss", amp="black")
+def smooth_l1_loss(input, label, delta=1.0, reduction="mean"):  # noqa: A002
+    diff = jnp.abs(input - label)
+    out = jnp.where(diff < delta, 0.5 * jnp.square(diff) / delta, diff - 0.5 * delta)
+    if reduction == "none":
+        return out
+    return jnp.sum(out) if reduction == "sum" else jnp.mean(out)
+
+
+@register("kl_div", amp="black")
+def kl_div(input, label, reduction="mean", log_target=False):  # noqa: A002
+    if log_target:
+        out = jnp.exp(label) * (label - input)
+    else:
+        out = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "none":
+        return out
+    if reduction == "sum":
+        return jnp.sum(out)
+    if reduction == "batchmean":
+        return jnp.sum(out) / input.shape[0]
+    return jnp.mean(out)
+
+
+@register("hinge_loss", amp="black")
+def hinge_loss(input, label):  # noqa: A002
+    return jnp.mean(jnp.maximum(0.0, 1.0 - input * label))
+
+
+@register("cosine_similarity", amp="black")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot_ = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot_ / jnp.maximum(n1 * n2, eps)

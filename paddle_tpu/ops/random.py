@@ -1,0 +1,156 @@
+"""Random ops and the global generator.
+
+Analog of the reference's generator (paddle/phi/core/generator.h) and
+python/paddle/tensor/random.py. TPU-native design: a counter-based global
+``jax.random`` key stream — ``seed(n)`` resets the root key, every sampling
+op folds in a fresh counter (cheap on TPU, reproducible, and per-device
+streams for model-parallel RNG are derived by folding in mesh coordinates,
+the analog of fleet's RNGStatesTracker, fleet/layers/mpu/random.py:34).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+_state = threading.local()
+
+
+class Generator:
+    def __init__(self, seed_val: int = 0):
+        self._root = jax.random.key(seed_val)
+        self._counter = 0
+        self._seed = seed_val
+
+    def manual_seed(self, seed_val: int):
+        self._root = jax.random.key(seed_val)
+        self._counter = 0
+        self._seed = seed_val
+        return self
+
+    def next_key(self):
+        self._counter += 1
+        return jax.random.fold_in(self._root, self._counter)
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+        self._root = jax.random.key(self._seed)
+
+
+def default_generator() -> Generator:
+    if not hasattr(_state, "gen"):
+        _state.gen = Generator(0)
+    return _state.gen
+
+
+def seed(seed_val: int):
+    """Analog of paddle.seed."""
+    default_generator().manual_seed(int(seed_val))
+
+
+def get_rng_state():
+    return default_generator().get_state()
+
+
+def set_rng_state(state):
+    default_generator().set_state(state)
+
+
+def _key():
+    return default_generator().next_key()
+
+
+def _d(dtype, default="float32"):
+    return convert_dtype(dtype) or np.dtype(default)
+
+
+def rand(shape, dtype="float32"):
+    return Tensor(jax.random.uniform(_key(), tuple(shape), dtype=_d(dtype)))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):  # noqa: A002
+    return Tensor(jax.random.uniform(_key(), tuple(shape), dtype=_d(dtype),
+                                     minval=min, maxval=max))
+
+
+def randn(shape, dtype="float32"):
+    return Tensor(jax.random.normal(_key(), tuple(shape), dtype=_d(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = []
+    return Tensor(mean + std * jax.random.normal(_key(), tuple(shape)))
+
+
+def standard_normal(shape, dtype="float32"):
+    return Tensor(jax.random.normal(_key(), tuple(shape), dtype=_d(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), tuple(shape), low, high, dtype=_d(dtype, "int64")))
+
+
+def randperm(n, dtype="int64"):
+    return Tensor(jax.random.permutation(_key(), n).astype(_d(dtype, "int64")))
+
+
+def shuffle(x, axis=0):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.permutation(_key(), v, axis=axis, independent=False))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_key(), logits, shape=(*v.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype("int64"))
+
+
+def bernoulli(x):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(_key(), v).astype(v.dtype))
+
+
+def poisson(x):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(_key(), v).astype(v.dtype))
+
+
+def exponential_(x, lam=1.0):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    out = jax.random.exponential(_key(), v.shape, dtype=v.dtype) / lam
+    if isinstance(x, Tensor):
+        x.set_value(out)
+        return x
+    return Tensor(out)
+
+
+def binomial(count, prob):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(_key(), c, p).astype("int64"))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from .registry import dispatch
+
+    v = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    g = Tensor(jax.random.gumbel(_key(), tuple(v.shape), dtype=v.dtype))
+    return dispatch("gumbel_softmax_impl", v, g, temperature=temperature, hard=hard, axis=axis)

@@ -1,0 +1,205 @@
+"""Op registry and eager dispatch.
+
+TPU-native analog of the reference's op-schema-driven stack:
+- ``KernelFactory`` string-keyed dispatch (paddle/phi/core/kernel_factory.h:316)
+- generated ``paddle::experimental::foo`` API with AMP cast + InferMeta
+  (paddle/phi/api/generator/api_gen.py, paddle/fluid/eager/amp_auto_cast.h)
+- generated ``foo_ad_func`` GradNode creation
+  (paddle/fluid/eager/auto_code_generator/generator/eager_gen.py)
+
+Here an "op" is a pure JAX function. Eager dispatch:
+  1. AMP auto-cast per op list (white -> bf16 on MXU, black -> fp32)
+  2. if any differentiable input requires grad: run through ``jax.vjp`` and
+     record a GradNode on the tape (residuals live in the vjp closure)
+  3. wrap outputs as Tensors
+XLA compiles + caches each op's executable per (shapes, dtypes), which is our
+analog of the kernel cache; under a traced (to_static) region the same
+dispatch runs on tracers and the tape is bypassed.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape as _tape
+from ..common import flags as _flags
+from ..core.tensor import Tensor
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: Callable
+    amp: Optional[str] = None  # 'white' (bf16), 'black' (fp32), None
+    nondiff: bool = False  # op has no differentiable outputs (argmax, equal, ...)
+    spmd_rule: Optional[Callable] = None  # sharding propagation rule (dist use)
+    backward_name: Optional[str] = None
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+_amp_state = threading.local()
+
+
+def amp_state():
+    if not hasattr(_amp_state, "stack"):
+        _amp_state.stack = []
+    return _amp_state.stack[-1] if _amp_state.stack else None
+
+
+def push_amp_state(st):
+    if not hasattr(_amp_state, "stack"):
+        _amp_state.stack = []
+    _amp_state.stack.append(st)
+
+
+def pop_amp_state():
+    _amp_state.stack.pop()
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"op {name!r} is not registered") from None
+
+
+def all_ops() -> Dict[str, OpDef]:
+    return dict(_REGISTRY)
+
+
+def register(name: str, amp: Optional[str] = None, nondiff: bool = False,
+             spmd_rule: Optional[Callable] = None):
+    """Register a pure-JAX function as a framework op and return its public
+    eager entry point (Tensor-in/Tensor-out)."""
+
+    def deco(fn: Callable):
+        _REGISTRY[name] = OpDef(name=name, fn=fn, amp=amp, nondiff=nondiff,
+                                spmd_rule=spmd_rule)
+
+        @functools.wraps(fn)
+        def public(*args, **kwargs):
+            return dispatch(name, *args, **kwargs)
+
+        public.op_name = name
+        public.raw_fn = fn
+        return public
+
+    return deco
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _check_numerics(name: str, vals: Sequence[Any]):
+    for v in vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            if isinstance(v, jax.core.Tracer):
+                continue
+            bad = bool(jnp.any(~jnp.isfinite(v)))
+            if bad:
+                level = _flags.get_flag("FLAGS_check_nan_inf_level")
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if level == 0:
+                    raise FloatingPointError(msg)
+                print(f"[check_nan_inf] {msg}")
+
+
+def _amp_cast_leaves(op: OpDef, leaves: List[Any]) -> List[Any]:
+    st = amp_state()
+    if st is None or not st.enabled:
+        return leaves
+    # custom per-context lists override the op's static category (the
+    # reference's custom_white_list/custom_black_list, amp/auto_cast.py)
+    category = op.amp
+    if op.name in getattr(st, "custom_black", ()):
+        category = "black"
+    elif op.name in getattr(st, "custom_white", ()):
+        category = "white"
+    if category == "white":
+        target = st.dtype
+    elif category == "black":
+        target = jnp.float32
+    else:
+        return leaves
+    out = []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor) and jnp.issubdtype(leaf.dtype, jnp.floating) \
+                and leaf.dtype != jnp.float64 and leaf.dtype != target:
+            # route through the registered cast op so the tape records the
+            # dtype round-trip (the reference's AmpAutoCast inserts cast ops
+            # the same way — fluid/eager/amp_auto_cast.h)
+            out.append(dispatch("cast", leaf, dtype=target))
+        else:
+            out.append(leaf)
+    return out
+
+
+def dispatch(name: str, *args, **kwargs):
+    """Execute op ``name`` eagerly with tape recording."""
+    op = get_op(name)
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    leaves = _amp_cast_leaves(op, leaves)
+
+    tensor_pos = [i for i, leaf in enumerate(leaves) if isinstance(leaf, Tensor)]
+    need_grad = (
+        not op.nondiff
+        and _tape.is_grad_enabled()
+        and any(leaves[i]._requires_grad() for i in tensor_pos)
+    )
+
+    if not need_grad:
+        flat = [leaf._value if isinstance(leaf, Tensor) else leaf for leaf in leaves]
+        a, k = jax.tree_util.tree_unflatten(treedef, flat)
+        out = op.fn(*a, **k)
+        return _wrap_outputs(op, out, recorded=False)
+
+    diff_pos = [i for i in tensor_pos if leaves[i]._requires_grad()]
+    diff_tensors = [leaves[i] for i in diff_pos]
+
+    def pure(*diff_vals):
+        flat = []
+        it = iter(diff_vals)
+        for i, leaf in enumerate(leaves):
+            if i in diff_pos:
+                flat.append(next(it))
+            elif isinstance(leaf, Tensor):
+                flat.append(jax.lax.stop_gradient(leaf._value))
+            else:
+                flat.append(leaf)
+        a, k = jax.tree_util.tree_unflatten(treedef, flat)
+        return op.fn(*a, **k)
+
+    primals = [t._value for t in diff_tensors]
+    out, vjp_fn = jax.vjp(pure, *primals)
+
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+
+    def node_vjp(flat_cots):
+        cots = jax.tree_util.tree_unflatten(out_treedef, list(flat_cots))
+        return vjp_fn(cots)
+
+    node = _tape.record_op(name, out_leaves, node_vjp, diff_tensors)
+    return _wrap_outputs(op, out, recorded=True, node=node)
+
+
+def _wrap_outputs(op: OpDef, out, recorded: bool, node=None):
+    if _flags.get_flag("FLAGS_check_nan_inf"):
+        flat, _ = jax.tree_util.tree_flatten(out)
+        _check_numerics(op.name, flat)
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    wrapped = []
+    for slot, v in enumerate(out_leaves):
+        t = Tensor(v, stop_gradient=True)
+        if recorded and jnp.issubdtype(v.dtype, jnp.floating):
+            t.stop_gradient = False
+            t._set_grad_node(node, slot)
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)

@@ -1,0 +1,129 @@
+"""Attach operator methods / dunders to Tensor.
+
+Analog of the reference's monkey-patching of math methods onto the eager
+Tensor (python/paddle/base/dygraph/math_op_patch.py + tensor_patch_methods).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .registry import dispatch
+
+
+def _coerce(other, like: Tensor):
+    if isinstance(other, Tensor):
+        return other
+    if isinstance(other, bool):
+        return Tensor(jnp.asarray(other))
+    if isinstance(other, float) and jnp.issubdtype(like.dtype, jnp.integer):
+        # float scalar against an int tensor promotes to float (matches the
+        # reference's type promotion; casting to int would truncate, e.g.
+        # int_t * 0.5 -> 0)
+        return Tensor(jnp.asarray(other, dtype=jnp.float32))
+    return Tensor(jnp.asarray(other, dtype=like.dtype))
+
+
+def _binop(name, reverse=False):
+    def fn(self, other):
+        other = _coerce(other, self)
+        if reverse:
+            return dispatch(name, other, self)
+        return dispatch(name, self, other)
+
+    return fn
+
+
+def _install():
+    T = Tensor
+    T.__add__ = _binop("add")
+    T.__radd__ = _binop("add", reverse=True)
+    T.__sub__ = _binop("subtract")
+    T.__rsub__ = _binop("subtract", reverse=True)
+    T.__mul__ = _binop("multiply")
+    T.__rmul__ = _binop("multiply", reverse=True)
+    T.__truediv__ = _binop("divide")
+    T.__rtruediv__ = _binop("divide", reverse=True)
+    T.__floordiv__ = _binop("floor_divide")
+    T.__mod__ = _binop("remainder")
+    T.__pow__ = _binop("pow")
+    T.__rpow__ = _binop("pow", reverse=True)
+    T.__matmul__ = lambda self, other: dispatch("matmul", self, _coerce(other, self))
+    T.__rmatmul__ = lambda self, other: dispatch("matmul", _coerce(other, self), self)
+    T.__neg__ = lambda self: dispatch("neg", self)
+    T.__abs__ = lambda self: dispatch("abs", self)
+    T.__eq__ = lambda self, other: dispatch("equal", self, _coerce(other, self))
+    T.__ne__ = lambda self, other: dispatch("not_equal", self, _coerce(other, self))
+    T.__lt__ = lambda self, other: dispatch("less_than", self, _coerce(other, self))
+    T.__le__ = lambda self, other: dispatch("less_equal", self, _coerce(other, self))
+    T.__gt__ = lambda self, other: dispatch("greater_than", self, _coerce(other, self))
+    T.__ge__ = lambda self, other: dispatch("greater_equal", self, _coerce(other, self))
+    T.__invert__ = lambda self: dispatch("logical_not", self)
+    T.__and__ = lambda self, other: dispatch(
+        "logical_and" if self.dtype == jnp.bool_ else "bitwise_and", self, _coerce(other, self))
+    T.__or__ = lambda self, other: dispatch(
+        "logical_or" if self.dtype == jnp.bool_ else "bitwise_or", self, _coerce(other, self))
+    T.__xor__ = lambda self, other: dispatch(
+        "logical_xor" if self.dtype == jnp.bool_ else "bitwise_xor", self, _coerce(other, self))
+
+    def _getitem(self, idx):
+        if isinstance(idx, Tensor):
+            idx = idx._value
+        elif isinstance(idx, tuple):
+            idx = tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+        return dispatch("slice", self, idx=idx)
+
+    T.__getitem__ = _getitem
+
+    def _setitem(self, idx, value):
+        if isinstance(idx, Tensor):
+            idx = idx._value
+        elif isinstance(idx, tuple):
+            idx = tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+        out = dispatch("index_put", self, idx=idx, value=value)
+        # in-place semantics: rebind buffer and inherit the new grad history
+        self._value = out._value
+        self._grad_node = out._grad_node
+        self._grad_slot = out._grad_slot
+        self.stop_gradient = out.stop_gradient
+
+    T.__setitem__ = _setitem
+
+    # ---- named methods (mirror paddle.Tensor methods) ----
+    method_ops = [
+        "add", "subtract", "multiply", "divide", "pow", "matmul", "mm", "bmm",
+        "dot", "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+        "square", "abs", "sign", "reciprocal", "floor", "ceil", "round",
+        "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+        "tanh", "sigmoid", "erf", "erfinv", "lgamma", "digamma", "clip",
+        "maximum", "minimum", "sum", "mean", "max", "min", "prod", "std",
+        "var", "median", "logsumexp", "all", "any", "argmax", "argmin",
+        "cumsum", "cumprod", "isnan", "isinf", "isfinite",
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "allclose", "isclose", "norm", "dist", "t", "matrix_power",
+        "inverse", "cholesky", "reshape", "flatten", "squeeze", "unsqueeze",
+        "transpose", "tile", "expand", "expand_as", "broadcast_to", "flip",
+        "roll", "gather", "gather_nd", "scatter", "index_select", "masked_fill",
+        "sort", "argsort", "topk", "split", "chunk", "unbind", "tril", "triu",
+        "diagonal", "kron", "where", "concat", "stack",
+    ]
+    for name in method_ops:
+        def mk(opname):
+            def method(self, *args, **kwargs):
+                return dispatch(opname, self, *args, **kwargs)
+
+            method.__name__ = opname
+            return method
+
+        if not hasattr(T, name):
+            setattr(T, name, mk(name))
+
+    def _scale(self, scale=1.0, bias=0.0, bias_after_scale=True):
+        return dispatch("scale", self, scale=scale, bias=bias, bias_after_scale=bias_after_scale)
+
+    T.scale = _scale
+    T.numpy_ = T.numpy
+
+
+_install()

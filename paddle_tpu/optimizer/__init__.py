@@ -1,0 +1,12 @@
+"""paddle_tpu.optimizer (analog of paddle.optimizer)."""
+
+from . import lr
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .optimizer import (
+    SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer, RMSProp,
+)
+
+# make nn.ClipGradBy* available (reference exposes them under paddle.nn)
+from .. import nn as _nn
+
+_nn._late_bind_clip()

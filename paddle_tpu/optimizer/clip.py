@@ -1,0 +1,87 @@
+"""Gradient clipping (analog of python/paddle/nn/clip.py:
+ClipGradByValue / ClipGradByNorm / ClipGradByGlobalNorm)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class GradClipBase:
+    def __call__(self, params, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(GradClipBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params, grads):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            v = g._value if isinstance(g, Tensor) else g
+            out.append(Tensor(jnp.clip(v, self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(GradClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params, grads):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            v = g._value if isinstance(g, Tensor) else g
+            n = jnp.linalg.norm(v.astype(jnp.float32))
+            factor = jnp.where(n > self.clip_norm, self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append(Tensor((v.astype(jnp.float32) * factor).astype(v.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(GradClipBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params, grads):
+        sq = []
+        for p, g in zip(params, grads):
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            v = g._value if isinstance(g, Tensor) else g
+            sq.append(jnp.sum(jnp.square(v.astype(jnp.float32))))
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+        factor = jnp.where(global_norm > self.clip_norm,
+                           self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        out = []
+        for p, g in zip(params, grads):
+            if g is None:
+                out.append(None)
+                continue
+            v = g._value if isinstance(g, Tensor) else g
+            if getattr(p, "need_clip", True):
+                out.append(Tensor((v.astype(jnp.float32) * factor).astype(v.dtype)))
+            else:
+                out.append(g if isinstance(g, Tensor) else Tensor(g))
+        return out
+
+
+def clip_grads_functional(grads: dict, clip_norm: float):
+    """Pure pytree global-norm clip for the compiled train step."""
+    import jax
+
+    leaves = [g for g in jax.tree_util.tree_leaves(grads) if g is not None]
+    global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+    factor = jnp.where(global_norm > clip_norm,
+                       clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype), grads), global_norm

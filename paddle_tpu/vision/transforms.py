@@ -1,0 +1,120 @@
+"""Vision transforms (numpy host-side; analog of
+python/paddle/vision/transforms). Images are HWC uint8/float numpy on the
+host; ToTensor converts to CHW float32."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            return (img - self.mean[:, None, None]) / self.std[:, None, None]
+        return (img - self.mean) / self.std
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        oh, ow = self.size
+        ridx = (np.arange(oh) * h // oh)
+        cidx = (np.arange(ow) * w // ow)
+        return img[ridx][:, cidx]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        ch, cw = self.size
+        top = (h - ch) // 2
+        left = (w - cw) // 2
+        return img[top:top + ch, left:left + cw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, [(p, p), (p, p)] + [(0, 0)] * (img.ndim - 2), mode="reflect")
+        h, w = img.shape[:2]
+        ch, cw = self.size
+        top = np.random.randint(0, h - ch + 1)
+        left = np.random.randint(0, w - cw + 1)
+        return img[top:top + ch, left:left + cw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return np.asarray(img)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)

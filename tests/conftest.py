@@ -1,0 +1,42 @@
+"""Test config: force an 8-device virtual CPU platform so distributed tests
+exercise real mesh sharding without TPU hardware (SURVEY.md §4 takeaway:
+host-platform fake devices replace the reference's subprocess-per-GPU
+harness).
+
+Note: the session's sitecustomize pre-imports jax with JAX_PLATFORMS=axon
+(TPU tunnel), so env vars alone are too late — we must also override via
+jax.config before the first backend is instantiated.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield
+
+
+@pytest.fixture
+def cpu_mesh8():
+    """8-device CPU mesh for sharding tests."""
+    import jax
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest must force 8 host devices"
+    return devs

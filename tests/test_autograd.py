@@ -1,0 +1,195 @@
+"""Autograd: tape correctness vs jax.grad numeric references, hooks,
+retain_graph, paddle.grad, PyLayer — the OpTest gradient-check analog
+(reference: test/legacy_test/op_test.py:418 check_grad)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _leaf(shape, seed=0):
+    rng = np.random.RandomState(seed)
+    t = paddle.to_tensor(rng.randn(*shape).astype("float32"))
+    t.stop_gradient = False
+    return t
+
+
+def check_grad_vs_jax(op_fn, jax_fn, *shapes, rtol=1e-4):
+    """Run op on leaves, backward from sum, compare each grad to jax.grad."""
+    leaves = [_leaf(s, i) for i, s in enumerate(shapes)]
+    out = op_fn(*leaves)
+    out.sum().backward()
+
+    def scalar(*vals):
+        return jnp.sum(jax_fn(*vals))
+
+    refs = jax.grad(scalar, argnums=tuple(range(len(leaves))))(
+        *[l._value for l in leaves])
+    for leaf, ref in zip(leaves, refs):
+        np.testing.assert_allclose(np.asarray(leaf.grad._value), np.asarray(ref),
+                                   rtol=rtol, atol=1e-5)
+
+
+def test_add_grad():
+    check_grad_vs_jax(lambda a, b: a + b, jnp.add, (3, 4), (3, 4))
+
+
+def test_broadcast_grad():
+    check_grad_vs_jax(lambda a, b: a * b, jnp.multiply, (3, 4), (4,))
+
+
+def test_matmul_grad():
+    check_grad_vs_jax(paddle.matmul, jnp.matmul, (3, 4), (4, 5))
+
+
+def test_chain_grad():
+    check_grad_vs_jax(lambda a: paddle.tanh(a).exp().mean(),
+                      lambda a: jnp.mean(jnp.exp(jnp.tanh(a))), (5, 5))
+
+
+def test_softmax_ce_grad():
+    logits = _leaf((4, 10))
+    label = paddle.to_tensor(np.array([1, 2, 3, 4], dtype="int64"))
+    loss = paddle.nn.functional.cross_entropy(logits, label)
+    loss.backward()
+
+    def ref(lv):
+        lp = jax.nn.log_softmax(lv, axis=-1)
+        return -jnp.mean(lp[jnp.arange(4), jnp.array([1, 2, 3, 4])])
+
+    g = jax.grad(ref)(logits._value)
+    np.testing.assert_allclose(np.asarray(logits.grad._value), np.asarray(g), rtol=1e-4)
+
+
+def test_reused_tensor_accumulates():
+    x = _leaf((3,))
+    y = x * x  # x used twice
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), 2 * x.numpy(), rtol=1e-5)
+
+
+def test_grad_accumulation_across_backwards():
+    x = _leaf((3,))
+    (x * 2.0).sum().backward()
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), np.full(3, 5.0), rtol=1e-6)
+
+
+def test_retain_graph():
+    x = _leaf((3,))
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), 4 * x.numpy(), rtol=1e-5)
+
+
+def test_double_backward_without_retain_raises():
+    x = _leaf((3,))
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad():
+    x = _leaf((3,))
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_stop_gradient_blocks():
+    x = _leaf((3,))
+    y = x.detach() * 2
+    assert y.stop_gradient
+
+
+def test_tensor_hook():
+    x = _leaf((3,))
+    seen = []
+
+    y = x * 2.0
+    y.register_hook(lambda g: seen.append(g) or (g * 10))
+    y.sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(np.asarray(x.grad._value), np.full(3, 20.0), rtol=1e-6)
+
+
+def test_paddle_grad_api():
+    x = _leaf((4,))
+    y = (x ** 2).sum()
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(np.asarray(gx._value), 2 * x.numpy(), rtol=1e-5)
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_grad_non_leaf_input():
+    x = _leaf((4,))
+    h = x * 3.0
+    y = (h ** 2).sum()
+    (gh,) = paddle.grad(y, [h], retain_graph=True)
+    np.testing.assert_allclose(np.asarray(gh._value), 2 * h.numpy(), rtol=1e-5)
+
+
+def test_retain_grads_non_leaf():
+    x = _leaf((3,))
+    h = x * 2.0
+    h.retain_grads()
+    (h * 3.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(h.grad._value), np.full(3, 3.0), rtol=1e-6)
+
+
+def test_backward_with_grad_tensor():
+    x = _leaf((3,))
+    y = x * 2.0
+    y.backward(paddle.to_tensor([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(x.grad._value), [2, 4, 6], rtol=1e-6)
+
+
+def test_pylayer():
+    class Exp(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = x.exp()
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor
+            return dy * y
+
+    x = _leaf((4,))
+    y = Exp.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), np.exp(x.numpy()), rtol=1e-5)
+
+
+def test_multi_output_op_grad():
+    x = _leaf((6,))
+    a, b = paddle.split(x, 2)
+    (a.sum() + (b * 2).sum()).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               np.array([1, 1, 1, 2, 2, 2], dtype="float32"))
+
+
+def test_conv_grad():
+    x = _leaf((2, 3, 8, 8))
+    w = _leaf((4, 3, 3, 3), seed=1)
+    out = paddle.ops.nn_ops.conv2d(x, w, padding=1)
+    out.sum().backward()
+
+    def ref(xv, wv):
+        from jax import lax
+
+        dn = lax.conv_dimension_numbers(xv.shape, wv.shape, ("NCHW", "OIHW", "NCHW"))
+        return jnp.sum(lax.conv_general_dilated(xv, wv, (1, 1), [(1, 1), (1, 1)],
+                                                dimension_numbers=dn))
+
+    gx, gw = jax.grad(ref, argnums=(0, 1))(x._value, w._value)
+    np.testing.assert_allclose(np.asarray(x.grad._value), np.asarray(gx), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(w.grad._value), np.asarray(gw), rtol=1e-4)

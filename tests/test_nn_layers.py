@@ -1,0 +1,144 @@
+"""nn layers: shapes, state_dict, hooks, train/eval, e2e training parity."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear():
+    l = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    y = l(x)
+    assert y.shape == [2, 4]
+    np.testing.assert_allclose(y.numpy(), x.numpy() @ l.weight.numpy() + l.bias.numpy(),
+                               rtol=1e-5)
+
+
+def test_linear_no_bias():
+    l = nn.Linear(8, 4, bias_attr=False)
+    assert l._parameters["bias"] is None
+    assert len(l.parameters()) == 1
+
+
+def test_conv2d_shape():
+    c = nn.Conv2D(3, 16, 3, stride=2, padding=1)
+    y = c(paddle.randn([2, 3, 32, 32]))
+    assert y.shape == [2, 16, 16, 16]
+
+
+def test_grouped_conv():
+    c = nn.Conv2D(8, 8, 3, padding=1, groups=8)
+    assert c.weight.shape == [8, 1, 3, 3]
+    y = c(paddle.randn([1, 8, 8, 8]))
+    assert y.shape == [1, 8, 8, 8]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5]) * 3 + 1
+    bn.train()
+    y = bn(x)
+    # normalized output should have ~0 mean, ~1 std per channel
+    yv = y.numpy()
+    assert abs(yv.mean()) < 0.1
+    assert abs(yv.std() - 1.0) < 0.1
+    # running stats moved off init
+    assert abs(bn._mean.numpy().mean()) > 1e-4
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16]) * 5 + 2
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-4)
+    np.testing.assert_allclose(y.std(-1), 1, atol=2e-2)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(16)
+    x = paddle.randn([4, 16])
+    y = rn(x).numpy()
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_padding_idx():
+    e = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([0, 1, 2], dtype="int64"))
+    y = e(idx)
+    np.testing.assert_array_equal(y.numpy()[0], np.zeros(4))
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    y = d(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(s) == 3
+    y = s(paddle.randn([3, 4]))
+    assert y.shape == [3, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_state_dict_roundtrip(tmp_path):
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    paddle.save(m1.state_dict(), str(tmp_path / "m.pdparams"))
+    loaded = paddle.load(str(tmp_path / "m.pdparams"))
+    m2.set_state_dict(loaded)
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_forward_hooks():
+    l = nn.Linear(4, 4)
+    calls = []
+    h1 = l.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = l.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+    l(paddle.randn([1, 4]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    l(paddle.randn([1, 4]))
+    assert calls == []
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(32, 4)
+    x = paddle.randn([2, 6, 32])
+    y = mha(x)
+    assert y.shape == [2, 6, 32]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(32, 4, 64, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    y = enc(paddle.randn([2, 5, 32]))
+    assert y.shape == [2, 5, 32]
+
+
+def test_named_parameters_unique():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert len(names) == len(set(names)) == 4
+
+
+def test_to_dtype():
+    m = nn.Linear(4, 4)
+    m.to(dtype="bfloat16")
+    assert str(m.weight.dtype) == "bfloat16"

@@ -1,0 +1,148 @@
+"""Optimizers: convergence, state, LR schedulers, clipping, AMP scaler."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quadratic_problem():
+    w = nn.Parameter(np.array([5.0, -3.0], dtype="float32"))
+    return w
+
+
+def _train(opt_cls, steps=200, **kw):
+    w = _quadratic_problem()
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.abs(w.numpy()).max()
+
+
+def test_sgd_converges():
+    assert _train(optimizer.SGD, learning_rate=0.1) < 1e-3
+
+
+def test_momentum_converges():
+    assert _train(optimizer.Momentum, learning_rate=0.05, momentum=0.9) < 1e-3
+
+
+def test_adam_converges():
+    assert _train(optimizer.Adam, learning_rate=0.1) < 1e-2
+
+
+def test_adamw_decoupled_decay():
+    # with huge decoupled decay and zero grads, weights shrink
+    w = nn.Parameter(np.array([1.0], dtype="float32"))
+    opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[w])
+    for _ in range(10):
+        loss = (w * 0.0).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert w.numpy()[0] < 1.0
+
+
+def test_adam_master_weights_bf16():
+    w = nn.Parameter(np.array([1.0, 2.0], dtype="float32"))
+    w.set_value(w._value.astype("bfloat16"))
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[w])
+    loss = (w.astype("float32") ** 2).sum()
+    loss.backward()
+    opt.step()
+    st = opt._state[id(w)]
+    assert "master" in st
+    assert str(st["master"].dtype) == "float32"
+
+
+def test_lr_scheduler_cosine():
+    sched = optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+    opt = optimizer.SGD(learning_rate=sched, parameters=[_quadratic_problem()])
+    lrs = []
+    for _ in range(10):
+        lrs.append(opt.get_lr())
+        sched.step()
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[-1] < lrs[0]
+
+
+def test_warmup_scheduler():
+    sched = optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(6):
+        vals.append(sched())
+        sched.step()
+    assert vals[0] == pytest.approx(0.0)
+    assert vals[-1] == pytest.approx(0.1)
+
+
+def test_grad_clip_global_norm():
+    w1 = nn.Parameter(np.ones(4, dtype="float32"))
+    w2 = nn.Parameter(np.ones(4, dtype="float32"))
+    clip = paddle.optimizer.ClipGradByGlobalNorm(1.0)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w1, w2], grad_clip=clip)
+    loss = (w1 * 10).sum() + (w2 * 10).sum()
+    loss.backward()
+    opt.step()
+    # grads were [10]*8 -> norm ~28.3 -> clipped to 1.0
+    delta = 1.0 - w1.numpy()[0]
+    assert abs(np.sqrt((delta ** 2) * 8) - 1.0) < 1e-3
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = nn.Parameter(np.array([1.0], dtype="float32"))
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+    (w ** 2).sum().backward()
+    opt.step()
+    state = opt.state_dict()
+    opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w])
+    opt2.set_state_dict(state)
+    assert opt2._global_step == opt._global_step
+
+
+def test_grad_scaler_bf16_identity():
+    scaler = paddle.amp.GradScaler(enable=False)
+    w = nn.Parameter(np.array([2.0], dtype="float32"))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    loss = (w ** 2).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    np.testing.assert_allclose(w.numpy(), [2.0 - 0.1 * 4.0], rtol=1e-6)
+
+
+def test_grad_scaler_fp16_skips_inf():
+    scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=2.0)
+    w = nn.Parameter(np.array([1.0], dtype="float32"))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    loss = (w * float("inf")).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)  # inf grad -> step skipped
+    np.testing.assert_allclose(w.numpy(), [1.0])
+
+
+def test_amp_autocast_bf16():
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        c = paddle.matmul(a, b)
+    assert str(c.dtype) == "bfloat16"
+    # black-list op stays fp32
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        s = paddle.nn.functional.softmax(paddle.randn([4, 4]).astype("bfloat16"))
+    assert str(s.dtype) == "float32"
+
+
+def test_amp_backward_through_cast():
+    w = nn.Parameter(np.ones((4, 4), dtype="float32"))
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        x = paddle.ones([2, 4])
+        y = paddle.matmul(x, w)
+        loss = y.astype("float32").sum()
+    loss.backward()
+    assert w.grad is not None
+    assert str(w.grad.dtype) == "float32" or str(w.grad.dtype) == "bfloat16"

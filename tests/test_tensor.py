@@ -1,0 +1,139 @@
+"""Tensor basics: creation, meta, conversion, methods, indexing.
+Mirrors the reference's API unit-test style (test/legacy_test/test_*_api.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_float64_default_demotion():
+    t = paddle.to_tensor(np.zeros((2,), dtype=np.float64))
+    assert str(t.dtype) == "float32"
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.eye(3).numpy().trace() == 3
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+
+
+def test_comparison_and_logical():
+    a = paddle.to_tensor([1.0, 5.0])
+    b = paddle.to_tensor([2.0, 2.0])
+    assert (a < b).numpy().tolist() == [True, False]
+    assert (a >= b).numpy().tolist() == [False, True]
+    m = paddle.to_tensor([True, False])
+    n = paddle.to_tensor([True, True])
+    assert (m & n).numpy().tolist() == [True, False]
+    assert (m | n).numpy().tolist() == [True, True]
+
+
+def test_indexing_and_setitem():
+    t = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    assert t[1, 2].item() == 6
+    np.testing.assert_array_equal(t[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_array_equal(t[:, 1].numpy(), [1, 5, 9])
+    t[0, 0] = 100.0
+    assert t[0, 0].item() == 100
+
+
+def test_reshape_family():
+    t = paddle.arange(24, dtype="float32")
+    assert t.reshape([2, 3, 4]).shape == [2, 3, 4]
+    assert t.reshape([2, -1]).shape == [2, 12]
+    assert t.reshape([2, 3, 4]).flatten(1, 2).shape == [2, 12]
+    assert t.reshape([1, 24]).squeeze(0).shape == [24]
+    assert t.unsqueeze(0).shape == [1, 24]
+    assert t.reshape([2, 3, 4]).transpose([2, 0, 1]).shape == [4, 2, 3]
+
+
+def test_concat_split_stack():
+    a = paddle.ones([2, 3])
+    b = paddle.zeros([2, 3])
+    c = paddle.concat([a, b], axis=0)
+    assert c.shape == [4, 3]
+    s = paddle.stack([a, b], axis=0)
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+    parts = paddle.split(c, [1, -1], axis=0)
+    assert parts[1].shape == [3, 3]
+
+
+def test_reductions():
+    t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    assert t.sum().item() == 15
+    assert t.mean().item() == 2.5
+    assert t.max().item() == 5
+    assert t.min(axis=1).numpy().tolist() == [0, 3]
+    assert t.argmax(axis=1).numpy().tolist() == [2, 2]
+    np.testing.assert_allclose(t.cumsum(axis=1).numpy(), np.cumsum(t.numpy(), 1))
+
+
+def test_matmul_and_linalg():
+    a = paddle.randn([3, 4])
+    b = paddle.randn([4, 5])
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+    m = paddle.to_tensor(np.array([[2.0, 0], [0, 4.0]], dtype="float32"))
+    np.testing.assert_allclose(paddle.inverse(m).numpy(), np.linalg.inv(m.numpy()), rtol=1e-5)
+    sq = paddle.randn([4, 4])
+    sym = sq + sq.t()
+    w = paddle.ops.linalg.eigvalsh(sym)
+    np.testing.assert_allclose(np.sort(w.numpy()), np.sort(np.linalg.eigvalsh(sym.numpy())), rtol=1e-4, atol=1e-4)
+
+
+def test_where_gather_scatter():
+    cond = paddle.to_tensor([True, False, True])
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([10.0, 20.0, 30.0])
+    np.testing.assert_array_equal(paddle.where(cond, a, b).numpy(), [1, 20, 3])
+    x = paddle.to_tensor(np.arange(10, dtype="float32"))
+    idx = paddle.to_tensor(np.array([1, 3, 5]))
+    np.testing.assert_array_equal(paddle.gather(x, idx).numpy(), [1, 3, 5])
+
+
+def test_sort_topk():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    np.testing.assert_array_equal(paddle.ops.manip.sort(x).numpy(), [1, 2, 3])
+    vals, idx = paddle.ops.manip.topk(x, 2)
+    assert vals.numpy().tolist() == [3, 2]
+    assert idx.numpy().tolist() == [0, 2]
+
+
+def test_cast_astype():
+    t = paddle.to_tensor([1.5, 2.5])
+    i = t.astype("int32")
+    assert str(i.dtype) == "int32"
+    b = t.astype("bfloat16")
+    assert str(b.dtype) == "bfloat16"
+
+
+def test_random_reproducible():
+    paddle.seed(7)
+    a = paddle.randn([4])
+    paddle.seed(7)
+    b = paddle.randn([4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
