@@ -33,6 +33,7 @@ class Tensor:
         "name",
         "persistable",
         "is_parameter",
+        "_partial_axes",  # pending-reduction mesh axes of a DTensor
         "__weakref__",
     )
 
@@ -51,6 +52,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self.is_parameter = False
+        self._partial_axes = ()
 
     # -- basic meta --------------------------------------------------------
     @property

@@ -1,5 +1,42 @@
-"""paddle_tpu.distributed — hybrid-parallel stack (filled in by
-mesh/fleet/dtensor modules; see SURVEY.md §2.6-2.7)."""
+"""paddle_tpu.distributed — hybrid-parallel stack.
+
+Analog of python/paddle/distributed (SURVEY.md §2.6-2.7). Layering:
+
+- ``process_mesh`` / ``placements`` / ``auto_parallel`` — DTensor API
+  (shard_tensor/reshard/shard_layer/shard_optimizer) over GSPMD.
+- ``topology`` — HybridCommunicateGroup: degrees → one named-axis Mesh.
+- ``functional`` — in-program collectives (shard_map bodies / compiled).
+- ``collective`` — eager ProcessGroup-style API on DTensors.
+- ``fleet`` — strategy-driven wrappers (DataParallel, TP layers, sharding).
+- ``env`` — launcher env contract (PADDLE_TRAINER_ID etc.).
+"""
 
 from . import env
 from .env import ParallelEnv, get_rank, get_world_size, init_distributed
+
+from .placements import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh, auto_mesh, get_mesh, init_mesh, set_mesh
+from .topology import (AxisGroup, CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+from . import functional
+from .functional import ReduceOp
+from .collective import (Group, all_gather, all_reduce, alltoall, barrier,
+                         broadcast, destroy_process_group, get_group,
+                         is_initialized, new_group, reduce_scatter, scatter,
+                         wait)
+from . import auto_parallel
+from .auto_parallel import (ShardingStage1, ShardingStage2, ShardingStage3,
+                            dtensor_from_local, dtensor_to_local,
+                            get_placements, is_dist, reshard, shard_dataloader,
+                            shard_layer, shard_optimizer, shard_tensor,
+                            unshard_dtensor)
+
+
+def init_parallel_env():
+    """Analog of paddle.distributed.init_parallel_env
+    (python/paddle/distributed/parallel.py:978). Under a single controller
+    no rendezvous is needed; multi-host initialisation goes through
+    jax.distributed (see env.init_distributed)."""
+    from .collective import _ensure_default
+    return _ensure_default()

@@ -1,0 +1,32 @@
+"""paddle_tpu.distributed.auto_parallel — DTensor API.
+
+Analog of python/paddle/distributed/auto_parallel in the reference; see
+api.py for the mapping table.
+"""
+
+from .api import (
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    dtensor_from_local,
+    dtensor_to_local,
+    get_placements,
+    is_dist,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    shard_dataloader,
+    unshard_dtensor,
+)
+from ..process_mesh import ProcessMesh, get_mesh, set_mesh, init_mesh, auto_mesh
+from ..placements import Partial, Placement, Replicate, Shard
+
+__all__ = [
+    "ProcessMesh", "get_mesh", "set_mesh", "init_mesh", "auto_mesh",
+    "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+    "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
+    "get_placements", "is_dist", "shard_dataloader",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+]

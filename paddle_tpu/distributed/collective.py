@@ -1,0 +1,314 @@
+"""Eager collective API (ProcessGroup analog).
+
+Analog of the reference's ProcessGroup hierarchy
+(paddle/phi/core/distributed/collective/process_group.h:48, NCCL impl
+process_group_nccl.h:37) and the Python collectives thin-wrapped over it
+(python/paddle/distributed/communication/).
+
+TPU-native semantics under a single controller: there is no "my rank" —
+the controller owns global arrays whose shards live on all devices.  So the
+eager collectives here operate on DTensors:
+
+- ``all_reduce(t)``: resolves a pending-Partial tensor (psum over the group
+  axis) or, given a tensor Shard()ed over the group axis on some dim,
+  reduces across that axis. For a replicated tensor it is the identity —
+  exactly what allreduce of identical per-rank values computes.
+- ``all_gather(list, t)`` / ``reduce_scatter`` / ``alltoall`` similarly map
+  to resharding over the group's mesh axis.
+
+In multi-process (one controller per host) these same entry points work on
+globally-sharded arrays spanning hosts; XLA runs the collective over
+ICI+DCN.  The reference's per-rank blocking semantics (NCCL stream sync)
+don't apply: XLA dispatch is async, `.block_until_ready()` is the wait().
+
+For schedule-explicit SPMD code (inside shard_map), use
+``paddle_tpu.distributed.functional`` instead — that layer is the analog of
+the collective *kernels* the compiled program embeds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .functional import ReduceOp
+from .placements import Replicate, Shard
+from .process_mesh import ProcessMesh
+from . import topology as topo_mod
+
+
+class Group:
+    """A communication group bound to one mesh axis.
+
+    Reference: python/paddle/distributed/communication/group.py:29.  Groups
+    are cheap — no NCCL ring bootstrap; the axis already exists in the mesh.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str, gid: int, ranks: List[int]):
+        self.mesh = mesh
+        self.axis = axis
+        self.id = gid
+        self.ranks = ranks
+        self.nranks = len(ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return 0  # single-controller: the controller acts for all ranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis!r}, nranks={self.nranks})"
+
+
+_groups: List[Group] = []
+_default_group: Optional[Group] = None
+
+
+def _world_mesh() -> Mesh:
+    hcg = topo_mod.get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.mesh
+    devs = np.asarray(jax.devices(), dtype=object)
+    return Mesh(devs, axis_names=("world",))
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    for g in _groups:
+        if g.id == gid:
+            return g
+    return _default_group
+
+
+def _ensure_default() -> Group:
+    global _default_group
+    if _default_group is None:
+        mesh = _world_mesh()
+        axis = mesh.axis_names[0]
+        _default_group = Group(mesh, axis, 0, list(range(mesh.shape[axis])))
+        _groups.append(_default_group)
+    return _default_group
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              timeout=None, axis: Optional[str] = None) -> Group:
+    """Create a group. TPU-native extension: pass ``axis`` to bind an
+    existing mesh axis (the idiomatic path).  A ranks list creates a
+    sub-mesh over those devices."""
+    gid = len(_groups) + 1
+    if axis is not None:
+        mesh = _world_mesh()
+        g = Group(mesh, axis, gid, list(range(mesh.shape[axis])))
+    else:
+        devs = jax.devices()
+        ranks = list(ranks) if ranks is not None else list(range(len(devs)))
+        sub = np.asarray([devs[r] for r in ranks], dtype=object)
+        g = Group(Mesh(sub, axis_names=("group",)), "group", gid, ranks)
+    _groups.append(g)
+    return g
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    elif group in _groups:
+        _groups.remove(group)
+
+
+def is_initialized() -> bool:
+    return _default_group is not None
+
+
+# --------------------------------------------------------------------------
+# collectives on DTensors
+# --------------------------------------------------------------------------
+
+def _group_of(group) -> Group:
+    return group if isinstance(group, Group) else _ensure_default()
+
+
+def _axis_partial(t: Tensor, g: Group):
+    return [p for p in getattr(t, "_partial_axes", ()) if p[0] == g.axis]
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group: Group = None,
+               sync_op: bool = True):
+    """AllReduce across the group axis. Pending-Partial tensors are reduced;
+    tensors Shard()ed over the axis are treated per-rank (reduced across
+    shards, result replicated); replicated tensors pass through.
+
+    A Tensor argument is updated in place (reference semantics) and
+    returned; a raw array argument gets the new value returned."""
+    from .auto_parallel.api import resolve_partial
+
+    g = _group_of(group)
+    is_tensor = isinstance(tensor, Tensor)
+    t = tensor if is_tensor else Tensor(jnp.asarray(tensor))
+    partial = _axis_partial(t, g)
+
+    def _finish(val, remaining_partial=()):
+        if is_tensor:
+            tensor.set_value(val)
+            tensor._partial_axes = tuple(remaining_partial)
+            return tensor
+        return val
+
+    if partial:
+        val = resolve_partial(t._value, partial, default_mesh=g.mesh, op=op)
+        remaining = tuple(p for p in getattr(t, "_partial_axes", ())
+                          if p[0] != g.axis)
+        return _finish(val, remaining)
+    # sharded-over-axis → per-rank allreduce: reduce shards, replicate result
+    s = getattr(t._value, "sharding", None)
+    if isinstance(s, NamedSharding) and g.axis in _spec_axes(s.spec):
+        dim = _sharded_dim(s.spec, g.axis)
+        n = g.nranks
+        chunks = jnp.split(t._value, n, axis=dim)
+        stacked = jnp.stack(chunks, axis=0)
+        if op == ReduceOp.SUM:
+            red = stacked.sum(axis=0)
+        elif op == ReduceOp.AVG:
+            red = stacked.mean(axis=0)
+        elif op == ReduceOp.MAX:
+            red = stacked.max(axis=0)
+        elif op == ReduceOp.MIN:
+            red = stacked.min(axis=0)
+        elif op == ReduceOp.PROD:
+            red = stacked.prod(axis=0)
+        else:
+            raise ValueError(f"unknown reduce op {op!r}")
+        return _finish(jnp.concatenate([red] * n, axis=dim))
+    return _finish(t._value)  # replicated: identity
+
+
+def _spec_axes(spec: PartitionSpec):
+    axes = []
+    for e in tuple(spec):
+        if e is None:
+            continue
+        axes.extend(e if isinstance(e, tuple) else (e,))
+    return axes
+
+
+def _sharded_dim(spec: PartitionSpec, axis: str) -> int:
+    for i, e in enumerate(tuple(spec)):
+        if e is None:
+            continue
+        if axis in (e if isinstance(e, tuple) else (e,)):
+            return i
+    raise ValueError(f"axis {axis} not in spec {spec}")
+
+
+def all_gather(tensor_list: Optional[List], tensor: Tensor, group: Group = None,
+               sync_op: bool = True):
+    """AllGather: given a tensor Shard()ed over the group axis, materialise
+    the replicated full tensor. Appends per-rank shards to ``tensor_list``
+    (reference list-out API) and also returns the concatenated tensor."""
+    g = _group_of(group)
+    t = tensor if isinstance(tensor, Tensor) else Tensor(jnp.asarray(tensor))
+    s = getattr(t._value, "sharding", None)
+    if isinstance(s, NamedSharding) and g.axis in _spec_axes(s.spec):
+        dim = _sharded_dim(s.spec, g.axis)
+        rep = NamedSharding(s.mesh, PartitionSpec())
+        full = Tensor(jax.device_put(t._value, rep), stop_gradient=True)
+        if tensor_list is not None:
+            for c in jnp.split(full._value, g.nranks, axis=dim):
+                tensor_list.append(Tensor(c))
+        return full
+    # replicated input: every rank contributes the same value
+    if tensor_list is not None:
+        tensor_list.extend(Tensor(t._value) for _ in range(g.nranks))
+    return Tensor(jnp.concatenate([t._value] * g.nranks, axis=0))
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: str = ReduceOp.SUM,
+                   group: Group = None, sync_op: bool = True):
+    """ReduceScatter: reduce a pending-Partial (or replicated) tensor across
+    the group and leave it Shard(0) over the axis."""
+    g = _group_of(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        src = Tensor(jnp.concatenate([x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                                      for x in src], axis=0))
+    elif isinstance(src, Tensor):
+        copy = Tensor(src._value)
+        copy._partial_axes = tuple(getattr(src, "_partial_axes", ()))
+        src = copy
+    t = all_reduce(src, op, g)
+    s = getattr(t._value, "sharding", None)
+    mesh = s.mesh if isinstance(s, NamedSharding) else g.mesh
+    shard = NamedSharding(mesh, PartitionSpec(g.axis))
+    tensor.set_value(jax.device_put(t._value, shard))
+    return tensor
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Group = None, sync_op: bool = True):
+    """Broadcast: under single-controller the global tensor already has one
+    logical value; ensure it is replicated over the group axis."""
+    g = _group_of(group)
+    s = getattr(tensor._value, "sharding", None)
+    if isinstance(s, NamedSharding) and g.axis in _spec_axes(s.spec):
+        rep_spec = _spec_without(s.spec, g.axis)
+        tensor.set_value(jax.device_put(tensor._value, NamedSharding(s.mesh, rep_spec)))
+    return tensor
+
+
+def _spec_without(spec: PartitionSpec, axis: str) -> PartitionSpec:
+    entries = []
+    for e in tuple(spec):
+        if e is None:
+            entries.append(None)
+        elif isinstance(e, tuple):
+            kept = tuple(a for a in e if a != axis)
+            entries.append(kept if kept else None)
+        else:
+            entries.append(None if e == axis else e)
+    return PartitionSpec(*entries)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group: Group = None, sync_op: bool = True):
+    """AllToAll on explicit per-rank lists (reference list API): rank i's
+    j-th input chunk becomes rank j's i-th output chunk."""
+    g = _group_of(group)
+    n = g.nranks
+    ins = [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in in_tensor_list]
+    assert len(ins) == n, f"alltoall needs {n} input chunks, got {len(ins)}"
+    # single-controller: transpose the chunk matrix
+    for j in range(n):
+        out_tensor_list.append(Tensor(ins[j]))
+    return out_tensor_list
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Group = None,
+            sync_op: bool = True):
+    g = _group_of(group)
+    if tensor_list:
+        vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in tensor_list]
+        stacked = jnp.concatenate([v[None] for v in vals], axis=0)
+        shard = NamedSharding(g.mesh, PartitionSpec(g.axis))
+        tensor.set_value(jax.device_put(stacked, shard).reshape(
+            (-1,) + tuple(vals[0].shape[1:]) if vals[0].ndim else (-1,)))
+    return tensor
+
+
+def barrier(group: Group = None):
+    jax.effects_barrier()
+    return None
+
+
+def wait(tensor: Tensor, group: Group = None, use_calc_stream: bool = True):
+    if isinstance(tensor, Tensor):
+        tensor.block_until_ready()
+    return None

@@ -1,0 +1,138 @@
+"""Placement types for distributed tensors.
+
+Analog of the reference's ``Shard``/``Replicate``/``Partial`` placements
+(paddle/phi/core/distributed/auto_parallel/placement_types.h) describing how
+one tensor dimension relates to one process-mesh dimension.
+
+TPU-native mapping: a list of placements over a ``ProcessMesh`` lowers to a
+``jax.sharding.PartitionSpec`` over a ``jax.sharding.Mesh`` — GSPMD then
+propagates shardings through every op, which replaces the reference's
+hand-written SPMD rules (paddle/phi/infermeta/spmd_rules/) for the common
+case.  ``Partial`` has no first-class jax.Array representation outside
+``shard_map``; DTensors carry it as metadata and ``reshard`` materialises the
+pending reduction with a ``psum`` (see auto_parallel/api.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(self.__dict__.items()))))
+
+
+class Replicate(Placement):
+    """Tensor is fully replicated along this mesh dimension."""
+
+    def is_replicated(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    """Tensor dim ``dim`` is split evenly along this mesh dimension."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self.dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    """Tensor holds per-device partial values pending a reduction along this
+    mesh dimension (reduce_type: 'sum' | 'max' | 'min' | 'avg')."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type!r})"
+
+
+def placements_to_spec(placements: Sequence[Placement],
+                       dim_names: Sequence[str],
+                       ndim: int) -> Tuple[PartitionSpec, List[Tuple[str, str]]]:
+    """Lower a placement list to (PartitionSpec, partial_axes).
+
+    ``placements[i]`` describes mesh dim i (named ``dim_names[i]``).  Returns
+    the PartitionSpec over the *tensor* dims plus the list of
+    (mesh_axis_name, reduce_type) pairs that are Partial (carried as DTensor
+    metadata, not representable in the jax.Array itself).
+    """
+    if len(placements) > len(dim_names):
+        raise ValueError(
+            f"got {len(placements)} placements for mesh with {len(dim_names)} dims")
+    per_tensor_dim: List[List[str]] = [[] for _ in range(ndim)]
+    partial_axes: List[Tuple[str, str]] = []
+    for mesh_dim, p in enumerate(placements):
+        if p is None or p.is_replicated():
+            continue
+        if p.is_partial():
+            partial_axes.append((dim_names[mesh_dim], p.reduce_type))
+        elif p.is_shard():
+            d = p.get_dim()
+            if d < -ndim or d >= ndim:
+                raise ValueError(f"Shard(dim={d}) out of range for ndim={ndim}")
+            per_tensor_dim[d % ndim].append(dim_names[mesh_dim])
+        else:
+            raise TypeError(f"unknown placement {p!r}")
+    spec_entries = []
+    for axes in per_tensor_dim:
+        if not axes:
+            spec_entries.append(None)
+        elif len(axes) == 1:
+            spec_entries.append(axes[0])
+        else:
+            spec_entries.append(tuple(axes))
+    # trim trailing Nones for a canonical spec
+    while spec_entries and spec_entries[-1] is None:
+        spec_entries.pop()
+    return PartitionSpec(*spec_entries), partial_axes
+
+
+def spec_to_placements(spec: PartitionSpec, dim_names: Sequence[str],
+                       ndim: int,
+                       partial_axes: Sequence[Tuple[str, str]] = ()) -> List[Placement]:
+    """Inverse of placements_to_spec (best effort)."""
+    placements: List[Placement] = [Replicate() for _ in dim_names]
+    name_to_mesh_dim = {n: i for i, n in enumerate(dim_names)}
+    entries = tuple(spec) if spec is not None else ()
+    for tensor_dim, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            if ax in name_to_mesh_dim:
+                placements[name_to_mesh_dim[ax]] = Shard(tensor_dim)
+    for ax, reduce_type in partial_axes:
+        if ax in name_to_mesh_dim:
+            placements[name_to_mesh_dim[ax]] = Partial(reduce_type)
+    return placements

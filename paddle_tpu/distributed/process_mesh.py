@@ -1,0 +1,156 @@
+"""ProcessMesh — the logical N-D device grid.
+
+Analog of the reference's ``ProcessMesh``
+(paddle/phi/core/distributed/auto_parallel/process_mesh.h:34 and
+python/paddle/distributed/auto_parallel/process_mesh.py).  TPU-native
+design: a ProcessMesh is a thin, picklable description (shape + dim names +
+flat rank ids) that lowers to a ``jax.sharding.Mesh`` over real devices; all
+sharding math is delegated to GSPMD.  Rank ids index ``jax.devices()`` in
+enumeration order, which on TPU follows the physical ICI torus order that
+XLA's collective lowering expects — so neighbouring mesh coordinates ride
+ICI links, matching the reference's intent of mapping inner axes (tp) to
+fast interconnect.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if process_ids is not None:
+            # reference allows (shape, process_ids) ctor
+            arr = np.asarray(process_ids, dtype=np.int64).reshape(arr)
+        self._mesh = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
+        self._dim_names = list(dim_names)
+
+    # -------------------------- reference API ---------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._mesh
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(x) for x in self._mesh.flatten()]
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index: Optional[int] = None):
+        """Move ``dim_name`` to the front; optionally slice one coordinate
+        (reference: ProcessMesh.get_mesh_with_dim)."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        new_mesh = self._mesh.transpose(order)
+        new_names = [self._dim_names[i] for i in order]
+        if index is not None:
+            return ProcessMesh(new_mesh[index], new_names[1:])
+        return ProcessMesh(new_mesh, new_names)
+
+    def __getitem__(self, item):
+        sub = self._mesh[item]
+        # track which original dims survive: an int index drops that dim
+        idx = item if isinstance(item, tuple) else (item,)
+        if Ellipsis in idx:
+            pos = idx.index(Ellipsis)
+            fill = self.ndim - (len(idx) - 1)
+            idx = idx[:pos] + (slice(None),) * fill + idx[pos + 1:]
+        names = []
+        for i, name in enumerate(self._dim_names):
+            if i >= len(idx) or not isinstance(idx[i], int):
+                names.append(name)
+        return ProcessMesh(sub, names[:sub.ndim] if sub.ndim != len(names) else names)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names}, "
+                f"process_ids={self.process_ids})")
+
+    # -------------------------- TPU lowering -----------------------------
+    def get_jax_mesh(self) -> Mesh:
+        """Lower to a jax.sharding.Mesh over real devices."""
+        devices = _global_devices()
+        try:
+            dev_arr = np.asarray(
+                [devices[i] for i in self.process_ids], dtype=object
+            ).reshape(self._mesh.shape)
+        except IndexError as e:
+            raise RuntimeError(
+                f"ProcessMesh refers to rank ids up to {max(self.process_ids)} "
+                f"but only {len(devices)} devices are visible") from e
+        return Mesh(dev_arr, axis_names=tuple(self._dim_names))
+
+
+_lock = threading.Lock()
+_state = {"mesh": None}
+
+
+def _global_devices():
+    return jax.devices()
+
+
+def set_mesh(mesh: "ProcessMesh | Mesh") -> None:
+    """Install the global default mesh (reference:
+    python/paddle/distributed/auto_parallel/api.py set_mesh)."""
+    with _lock:
+        _state["mesh"] = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _state["mesh"]
+
+
+def init_mesh(dim_names: Sequence[str], shape: Sequence[int]) -> ProcessMesh:
+    """Create + install a ProcessMesh over all visible devices."""
+    n = int(np.prod(shape))
+    mesh = ProcessMesh(np.arange(n).reshape(shape), dim_names)
+    set_mesh(mesh)
+    return mesh
+
+
+def auto_mesh(**axis_sizes: int) -> ProcessMesh:
+    """Build a mesh from named axis sizes, inferring one -1 axis from the
+    visible device count, e.g. ``auto_mesh(dp=-1, tp=4)``."""
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    ndev = len(_global_devices())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = max(1, ndev // known)
+    return ProcessMesh(np.arange(int(np.prod(sizes))).reshape(sizes), names)

@@ -1,0 +1,246 @@
+"""Hybrid-parallel topology.
+
+Analog of the reference's ``CommunicateTopology`` + ``HybridCommunicateGroup``
+(python/paddle/distributed/fleet/base/topology.py:189; axis getters
+:462-:544) which builds an N-D rank grid from strategy degrees in a
+user-chosen order and hands out per-axis communication groups.
+
+TPU-native design: the whole topology IS one ``jax.sharding.Mesh`` with
+named axes.  There are no per-axis NCCL communicators to create — XLA
+partitions collectives over mesh axes (GSPMD over ICI/DCN) — so a "group"
+here is just (mesh, axis name(s)): enough for shard_map bodies, PartitionSpec
+construction, and rank bookkeeping, at zero setup cost versus the
+reference's TCPStore + per-ring NCCL bootstrap (topology.py:189 →
+paddle.distributed.new_group per axis).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from .process_mesh import ProcessMesh
+
+# canonical axis order, outermost (slowest, DCN-friendly) first — matches the
+# reference default order dp×pp×sharding×sep×mp (fleet/fleet.py:674) with pp
+# outermost so pipeline stages land on distinct hosts and tp innermost so its
+# collectives ride ICI.
+DEFAULT_ORDER = ["pp", "dp", "sharding", "sep", "mp"]
+
+
+class AxisGroup:
+    """A communication group = one (or a fused set of) mesh axis(es).
+
+    Stands in for the reference's ``Group`` of ranks bound to an NCCL ring;
+    here it names mesh axes for use in PartitionSpecs / shard_map collectives.
+    """
+
+    def __init__(self, topo: "HybridCommunicateGroup", axes: Tuple[str, ...]):
+        self._topo = topo
+        self.axes = axes
+
+    @property
+    def nranks(self) -> int:
+        n = 1
+        for a in self.axes:
+            n *= self._topo.get_dim_size(a)
+        return n
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def name(self) -> str:
+        return "+".join(self.axes)
+
+    def __repr__(self):
+        return f"AxisGroup(axes={self.axes}, nranks={self.nranks})"
+
+
+class CommunicateTopology:
+    """Rank-grid arithmetic (reference: topology.py CommunicateTopology)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str], dims: Sequence[int]):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = {}
+        self._world = int(np.prod(dims)) if dims else 1
+        self._grid = np.arange(self._world).reshape(dims)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self) -> int:
+        return self._world
+
+    def get_rank(self, **args) -> int:
+        coord = tuple(args[name] for name in self._parallel_names)
+        return int(self._grid[coord])
+
+    def get_coord(self, rank: int) -> Dict[str, int]:
+        idx = np.unravel_index(rank, self._grid.shape)
+        return {n: int(i) for n, i in zip(self._parallel_names, idx)}
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return [int(r) for r in np.take(self._grid, index, axis=axis).flatten()]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along ``axis_name``: one list of ranks per combination
+        of the other axes (reference: CommunicateTopology.get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._grid, axis, -1)
+        return [[int(r) for r in row] for row in moved.reshape(-1, self._grid.shape[axis])]
+
+
+class HybridCommunicateGroup:
+    """The N-D hybrid-parallel topology over one jax Mesh.
+
+    Reference: python/paddle/distributed/fleet/base/topology.py:189.
+    Axis naming follows the reference: dp (data), pp (pipeline), sharding
+    (ZeRO/FSDP), sep (segment/sequence), mp (tensor/model parallel); an
+    optional ep axis may be fused out of dp×sharding for MoE.
+    """
+
+    def __init__(self, dp_degree: int = 1, mp_degree: int = 1,
+                 pp_degree: int = 1, sharding_degree: int = 1,
+                 sep_degree: int = 1,
+                 order: Optional[Sequence[str]] = None,
+                 devices: Optional[Sequence] = None):
+        self._degrees = {"dp": dp_degree, "mp": mp_degree, "pp": pp_degree,
+                         "sharding": sharding_degree, "sep": sep_degree}
+        order = list(order or DEFAULT_ORDER)
+        assert sorted(order) == sorted(DEFAULT_ORDER), \
+            f"order must be a permutation of {DEFAULT_ORDER}, got {order}"
+        self._order = order
+        dims = [self._degrees[a] for a in order]
+        self._topo = CommunicateTopology(order, dims)
+
+        devices = list(devices if devices is not None else jax.devices())
+        world = self._topo.world_size()
+        if len(devices) < world:
+            raise RuntimeError(
+                f"hybrid topology needs {world} devices "
+                f"({'x'.join(f'{a}={d}' for a, d in self._degrees.items() if d > 1)}) "
+                f"but only {len(devices)} are visible")
+        dev_grid = np.asarray(devices[:world], dtype=object).reshape(dims)
+        self._mesh = Mesh(dev_grid, axis_names=tuple(order))
+        self._process_mesh = ProcessMesh(
+            np.arange(world).reshape(dims), order)
+        self._global_rank = 0  # single-controller: rank 0 sees all devices
+
+    # ---------------------- mesh access (TPU-native) ----------------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def process_mesh(self) -> ProcessMesh:
+        return self._process_mesh
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_dim_size(self, axis: str) -> int:
+        return self._degrees[axis]
+
+    def axis_group(self, *axes: str) -> AxisGroup:
+        return AxisGroup(self, tuple(axes))
+
+    # ---------------------- reference-parity getters ----------------------
+    def get_global_rank(self) -> int:
+        return self._global_rank
+
+    def get_hybrid_group_names(self):
+        return self._topo.get_hybrid_group_names()
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._degrees["dp"]
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._degrees["mp"]
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._degrees["pp"]
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._degrees["sharding"]
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._degrees["sep"]
+
+    def _rank_in(self, axis: str, rank: Optional[int] = None) -> int:
+        rank = self._global_rank if rank is None else rank
+        return self._topo.get_coord(rank)[axis]
+
+    def get_data_parallel_rank(self) -> int:
+        return self._rank_in("dp")
+
+    def get_model_parallel_rank(self) -> int:
+        return self._rank_in("mp")
+
+    def get_stage_id(self) -> int:
+        return self._rank_in("pp")
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._rank_in("sharding")
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._rank_in("sep")
+
+    def get_data_parallel_group(self) -> AxisGroup:
+        return self.axis_group("dp")
+
+    def get_model_parallel_group(self) -> AxisGroup:
+        return self.axis_group("mp")
+
+    def get_pipe_parallel_group(self) -> AxisGroup:
+        return self.axis_group("pp")
+
+    def get_sharding_parallel_group(self) -> AxisGroup:
+        return self.axis_group("sharding")
+
+    def get_sep_parallel_group(self) -> AxisGroup:
+        return self.axis_group("sep")
+
+    def get_dp_sep_parallel_group(self) -> AxisGroup:
+        # fused dp×sep group used for grad allreduce of sep-parallel params
+        # (reference: hybrid_parallel_util.py:254-267)
+        return self.axis_group("dp", "sep")
+
+    def get_check_parallel_group(self, sharding: bool = False) -> AxisGroup:
+        axes = tuple(a for a in self._order
+                     if a not in ("dp",) and self._degrees[a] > 1)
+        return AxisGroup(self, axes)
+
+    # spec helpers ---------------------------------------------------------
+    def data_axes(self) -> Tuple[str, ...]:
+        """Axes the global batch is sharded over (dp + sharding fused, the
+        FSDP convention: batch over both, params over sharding)."""
+        axes = tuple(a for a in ("dp", "sharding")
+                     if self._degrees[a] > 1)
+        return axes or ("dp",)
+
+    def __repr__(self):
+        degs = ", ".join(f"{a}={self._degrees[a]}" for a in self._order)
+        return f"HybridCommunicateGroup({degs}, order={self._order})"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup) -> None:
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
