@@ -26,6 +26,7 @@ from .collective import (Group, all_gather, all_reduce, alltoall, barrier,
                          is_initialized, new_group, reduce_scatter, scatter,
                          wait)
 from . import auto_parallel
+from . import fleet
 from .auto_parallel import (ShardingStage1, ShardingStage2, ShardingStage3,
                             dtensor_from_local, dtensor_to_local,
                             get_placements, is_dist, reshard, shard_dataloader,

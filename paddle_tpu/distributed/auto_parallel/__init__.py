@@ -5,6 +5,7 @@ api.py for the mapping table.
 """
 
 from .api import (
+    sharding_constraint,
     ShardingStage1,
     ShardingStage2,
     ShardingStage3,

@@ -59,6 +59,23 @@ def _sharding_for(mesh, placements, ndim):
     return NamedSharding(_as_jax_mesh(mesh), spec), partial_axes
 
 
+from ...ops.registry import register as _register_op
+
+
+@_register_op("sharding_constraint")
+def _sharding_constraint_op(x, sharding=None):
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def sharding_constraint(t: Tensor, mesh, placements: Sequence[Placement]) -> Tensor:
+    """Annotate an activation's sharding (tape-recorded, so the constraint
+    also pins the backward layout).  The GSPMD analog of the reference's
+    per-op SPMD rules (phi/infermeta/spmd_rules/) — applied only where
+    propagation needs a hint."""
+    sharding, _ = _sharding_for(mesh, placements, t.ndim)
+    return _sharding_constraint_op(t, sharding=sharding)
+
+
 def is_dist(t: Tensor) -> bool:
     """True if the tensor carries a non-trivial NamedSharding."""
     v = t._value if isinstance(t, Tensor) else t
@@ -320,7 +337,7 @@ def shard_optimizer(optimizer, shard_fn: Optional[_ShardingStage] = None):
         if mesh is None:
             raise RuntimeError("shard_optimizer needs a shard_fn or a global "
                                "mesh (dist.auto_parallel.set_mesh)")
-        shard_fn = ShardingStage1(mesh, axis=mesh.dim_names[0])
+        shard_fn = ShardingStage1(mesh, axis=_dim_names(mesh)[0])
 
     params = getattr(optimizer, "_parameter_list", None) or optimizer._parameters
     if getattr(shard_fn, "shard_param", False):
@@ -357,33 +374,53 @@ def shard_optimizer(optimizer, shard_fn: Optional[_ShardingStage] = None):
 
 class ShardDataloader:
     """Wrap a DataLoader so each batch becomes a DTensor sharded over the
-    data axes (reference: api.py:3016).  Single-controller: the loader
-    yields the GLOBAL batch; we shard dim 0 over ``shard_dims``."""
+    data axes (reference: api.py:3016).
+
+    Single-controller: by default the loader yields the GLOBAL batch and we
+    shard dim 0 over ``shard_dims``.  With ``is_dataset_splitted=True`` the
+    loader yields this PROCESS's local split (reference multi-host
+    semantics) and batches are assembled via dtensor_from_local.
+    ``input_keys`` restricts sharding to those keys of dict batches."""
 
     def __init__(self, dataloader, meshes, shard_dims: Union[str, Sequence[str], None] = None,
-                 input_keys=None):
+                 input_keys=None, is_dataset_splitted: bool = False):
         self._loader = dataloader
         self._mesh = meshes if not isinstance(meshes, (list, tuple)) else meshes[0]
         if shard_dims is None:
             shard_dims = _dim_names(self._mesh)[0]
         self._axes = (shard_dims,) if isinstance(shard_dims, str) else tuple(shard_dims)
-        self._input_keys = input_keys
+        self._input_keys = set(input_keys) if input_keys else None
+        self._splitted = is_dataset_splitted
+        if is_dataset_splitted and jax.process_count() == 1:
+            # one process = local split IS the global batch; nothing to do
+            self._splitted = False
+
+    def _placements(self, ndim) -> List[Placement]:
+        names = _dim_names(self._mesh)
+        placements: List[Placement] = [Replicate()] * len(names)
+        for ax in self._axes:
+            placements[names.index(ax)] = Shard(0)
+        return placements
 
     def _shard(self, x):
         if isinstance(x, (Tensor, jax.Array, np.ndarray)):
             t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
-            names = _dim_names(self._mesh)
-            placements: List[Placement] = [Replicate()] * len(names)
-            for ax in self._axes:
-                placements[names.index(ax)] = Shard(0)
-            return shard_tensor(t, self._mesh, placements)
+            if self._splitted:
+                return dtensor_from_local(t, self._mesh, self._placements(t.ndim))
+            return shard_tensor(t, self._mesh, self._placements(t.ndim))
         return x
+
+    def _shard_batch(self, batch):
+        if isinstance(batch, dict) and self._input_keys is not None:
+            return {k: (self._shard(v) if k in self._input_keys else v)
+                    for k, v in batch.items()}
+        return jax.tree_util.tree_map(
+            self._shard, batch,
+            is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))
 
     def __iter__(self):
         for batch in self._loader:
-            yield jax.tree_util.tree_map(
-                self._shard, batch,
-                is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))
+            yield self._shard_batch(batch)
 
     def __len__(self):
         return len(self._loader)
@@ -391,4 +428,5 @@ class ShardDataloader:
 
 def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=False,
                      input_keys=None) -> ShardDataloader:
-    return ShardDataloader(dataloader, meshes, shard_dims, input_keys)
+    return ShardDataloader(dataloader, meshes, shard_dims, input_keys,
+                           is_dataset_splitted)

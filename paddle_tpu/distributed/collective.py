@@ -255,13 +255,17 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: str = ReduceOp.SUM
 
 
 def broadcast(tensor: Tensor, src: int = 0, group: Group = None, sync_op: bool = True):
-    """Broadcast: under single-controller the global tensor already has one
-    logical value; ensure it is replicated over the group axis."""
+    """Broadcast: every rank's local value becomes rank ``src``'s.  For a
+    tensor Shard()ed over the group axis (per-rank-distinct values), each
+    rank receives src's chunk — globally, n copies of chunk src.  Replicated
+    tensors already hold one logical value and pass through."""
     g = _group_of(group)
     s = getattr(tensor._value, "sharding", None)
     if isinstance(s, NamedSharding) and g.axis in _spec_axes(s.spec):
-        rep_spec = _spec_without(s.spec, g.axis)
-        tensor.set_value(jax.device_put(tensor._value, NamedSharding(s.mesh, rep_spec)))
+        dim = _sharded_dim(s.spec, g.axis)
+        chunk = jnp.split(tensor._value, g.nranks, axis=dim)[src]
+        out = jnp.concatenate([chunk] * g.nranks, axis=dim)
+        tensor.set_value(jax.device_put(out, s))
     return tensor
 
 
@@ -279,15 +283,37 @@ def _spec_without(spec: PartitionSpec, axis: str) -> PartitionSpec:
 
 
 def alltoall(out_tensor_list, in_tensor_list, group: Group = None, sync_op: bool = True):
-    """AllToAll on explicit per-rank lists (reference list API): rank i's
-    j-th input chunk becomes rank j's i-th output chunk."""
+    """AllToAll on explicit per-rank lists (reference list API): rank r
+    sends in[j] to rank j and receives rank j's in[r] into out[j].
+
+    Single-controller semantics: ``in_tensor_list[k]`` is a DTensor whose
+    shard on device r is rank r's k-th send buffer.  Then
+    ``out[j]``'s shard on device r must be rank j's in[r], i.e.
+    out[j] = concat_r(chunk_j(in[r])).  Replicated inputs mean every rank
+    sends the same list, so out[j]'s shard r = in[r] for every j."""
     g = _group_of(group)
     n = g.nranks
     ins = [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in in_tensor_list]
     assert len(ins) == n, f"alltoall needs {n} input chunks, got {len(ins)}"
-    # single-controller: transpose the chunk matrix
-    for j in range(n):
-        out_tensor_list.append(Tensor(ins[j]))
+    shard = NamedSharding(g.mesh, PartitionSpec(g.axis))
+
+    def _is_axis_sharded(v):
+        s = getattr(v, "sharding", None)
+        return isinstance(s, NamedSharding) and g.axis in _spec_axes(s.spec)
+
+    if all(_is_axis_sharded(v) for v in ins):
+        dims = [_sharded_dim(v.sharding.spec, g.axis) for v in ins]
+        chunks = [jnp.split(v, n, axis=d) for v, d in zip(ins, dims)]
+        for j in range(n):
+            out = jnp.concatenate([chunks[r][j] for r in range(n)], axis=dims[j])
+            out_tensor_list.append(Tensor(jax.device_put(out, shard)
+                                          if dims[j] == 0 else out))
+    else:
+        # replicated inputs: out[j] shard r = in[r], identical for all j
+        stacked = jnp.concatenate([v[None] for v in ins], axis=0)
+        placed = jax.device_put(stacked, NamedSharding(g.mesh, PartitionSpec(g.axis)))
+        for _ in range(n):
+            out_tensor_list.append(Tensor(placed))
     return out_tensor_list
 
 

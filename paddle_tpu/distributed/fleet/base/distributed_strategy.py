@@ -1,0 +1,71 @@
+"""DistributedStrategy — all Fleet knobs.
+
+Analog of the reference's protobuf-backed DistributedStrategy
+(paddle/fluid/framework/distributed_strategy.proto wrapped by
+python/paddle/distributed/fleet/base/distributed_strategy.py).  TPU-native:
+a plain dataclass-style object — no protobuf; the knobs configure mesh
+axes, placement presets, and jit options rather than program rewrites.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel degrees (reference: fleet.py:674 parsing)
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "order": None,  # default dp/pp/sharding/sep/mp handled by topology
+        }
+        # AMP (reference: strategy.amp_configs consumed in fleet/model.py:89)
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_fp16": False,
+            "use_bf16": True,  # TPU default: bf16 needs no loss scaling
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        # recompute (reference: strategy.recompute → program rewrite; here:
+        # jax.checkpoint policy applied by the model wrappers)
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        # sharding (ZeRO) stage config (reference: sharding_configs)
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {
+            "stage": 1,
+            "degree": 1,
+            "offload": False,
+        }
+        self.tensor_parallel_configs: Dict[str, Any] = {
+            "tensor_parallel_degree": 1,
+            "tensor_init_seed": -1,
+        }
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {
+            "accumulate_steps": 1,
+            "schedule_mode": "1F1B",
+            "micro_batch_size": 1,
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1}
+        self.gradient_scale_configs: Dict[str, Any] = {"scale_strategy": "avg"}
+        # misc parity knobs (accepted, mostly no-op on TPU)
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = False
+        self.heter_ccl_mode = False
+
+    def __repr__(self):
+        on = [k for k in ("amp", "recompute", "sharding", "pipeline",
+                          "gradient_merge") if getattr(self, k)]
+        return (f"DistributedStrategy(hybrid={self.hybrid_configs}, "
+                f"enabled={on})")

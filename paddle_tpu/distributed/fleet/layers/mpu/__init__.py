@@ -1,0 +1,4 @@
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random import (RNGStatesTracker, get_rng_state_tracker,
+                     model_parallel_random_seed)

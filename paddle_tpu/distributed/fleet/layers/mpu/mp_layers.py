@@ -1,0 +1,178 @@
+"""Megatron-style tensor-parallel layers.
+
+Analog of python/paddle/distributed/fleet/layers/mpu/mp_layers.py:
+VocabParallelEmbedding (:47), ColumnParallelLinear (:334),
+RowParallelLinear (:541), ParallelCrossEntropy (:742).
+
+TPU-native design: the reference implements TP with explicit identity/
+allreduce PyLayers (mp_ops.py) around per-rank local matmuls.  Here a TP
+layer is an ordinary layer whose WEIGHT carries a Shard placement over the
+``mp`` mesh axis, plus a sharding constraint on the activation; XLA's SPMD
+partitioner then emits exactly the Megatron collectives (identity fwd /
+allreduce bwd for column, allreduce fwd for row) — no custom autograd
+rules, and the same code runs un-sharded when mp_degree == 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..... import nn
+from .....core.tensor import Tensor
+from .....nn import functional as F
+from .....nn.layer import Layer, Parameter
+from ....placements import Replicate, Shard
+from ....topology import get_hybrid_communicate_group
+
+
+def _mp_mesh_axis():
+    """(jax_mesh, 'mp') if a hybrid topology with mp>1 is active else
+    (None, None) — layers degrade to their serial forms (reference
+    behavior when world_size==1, mp_layers.py:69)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.get_model_parallel_world_size() > 1:
+        return hcg.mesh, "mp"
+    return None, None
+
+
+def _place(param: Parameter, mesh, spec: PartitionSpec):
+    param.set_value(jax.device_put(param._value, NamedSharding(mesh, spec)))
+    return param
+
+
+def _constrain(x: Tensor, mesh, spec: PartitionSpec) -> Tensor:
+    from ....auto_parallel.api import _sharding_constraint_op
+    return _sharding_constraint_op(x, sharding=NamedSharding(mesh, spec))
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp
+    (reference: mp_layers.py:47 — per-rank range lookup + allreduce;
+    here: Shard(0) weight, XLA partitions the gather)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self._inner = nn.Embedding(num_embeddings, embedding_dim,
+                                   weight_attr=weight_attr)
+        mesh, axis = _mp_mesh_axis()
+        self.is_mp = mesh is not None
+        if self.is_mp:
+            if num_embeddings % mesh.shape[axis] != 0:
+                raise ValueError(
+                    f"vocab size {num_embeddings} not divisible by mp degree "
+                    f"{mesh.shape[axis]} (reference asserts the same)")
+            _place(self._inner.weight, mesh, PartitionSpec(axis, None))
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    def forward(self, x):
+        return self._inner(x)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the OUT dim sharded over mp (reference:
+    mp_layers.py:334).  gather_output=False leaves the activation sharded
+    on its last dim (feeding RowParallelLinear)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self._inner = nn.Linear(in_features, out_features, weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        mesh, axis = _mp_mesh_axis()
+        self.is_mp = mesh is not None
+        self._mesh, self._axis = mesh, axis
+        if self.is_mp:
+            if out_features % mesh.shape[axis] != 0:
+                raise ValueError(
+                    f"out_features {out_features} not divisible by mp degree")
+            _place(self._inner.weight, mesh, PartitionSpec(None, axis))
+            if self._inner._parameters.get("bias") is not None:
+                _place(self._inner.bias, mesh, PartitionSpec(axis))
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    @property
+    def bias(self):
+        return self._inner._parameters.get("bias")
+
+    def forward(self, x):
+        y = self._inner(x)
+        if self.is_mp:
+            spec = (PartitionSpec() if self.gather_output
+                    else PartitionSpec(*([None] * (y.ndim - 1) + [self._axis])))
+            y = _constrain(y, self._mesh, spec)
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear with the IN dim sharded over mp (reference: mp_layers.py:541).
+    input_is_parallel=True expects the activation already sharded on its
+    last dim; the partial products are allreduced by XLA."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self._inner = nn.Linear(in_features, out_features, weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        mesh, axis = _mp_mesh_axis()
+        self.is_mp = mesh is not None
+        self._mesh, self._axis = mesh, axis
+        if self.is_mp:
+            if in_features % mesh.shape[axis] != 0:
+                raise ValueError(
+                    f"in_features {in_features} not divisible by mp degree")
+            _place(self._inner.weight, mesh, PartitionSpec(axis, None))
+            # bias is applied after the reduction → replicated (reference
+            # keeps bias on the full output too)
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    @property
+    def bias(self):
+        return self._inner._parameters.get("bias")
+
+    def forward(self, x):
+        if self.is_mp and self.input_is_parallel:
+            x = _constrain(x, self._mesh,
+                           PartitionSpec(*([None] * (x.ndim - 1) + [self._axis])))
+        y = self._inner(x)
+        if self.is_mp:
+            y = _constrain(y, self._mesh, PartitionSpec())
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over vocab-sharded logits (reference:
+    mp_layers.py:742 — per-rank max/sum + allreduce; here the constraint
+    keeps logits sharded and XLA partitions the log-softmax reduction)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+        mesh, axis = _mp_mesh_axis()
+        self.is_mp = mesh is not None
+        self._mesh, self._axis = mesh, axis
+
+    def forward(self, input, label):
+        if self.is_mp:
+            input = _constrain(
+                input, self._mesh,
+                PartitionSpec(*([None] * (input.ndim - 1) + [self._axis])))
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,86 @@
+"""Model-parallel RNG state tracking.
+
+Analog of the reference's RNGStatesTracker
+(python/paddle/distributed/fleet/layers/mpu/random.py:34): dropout inside
+TP regions must use a per-mp-rank seed (so each shard drops differently),
+while dropout outside must be identical across mp ranks.
+
+TPU-native: jax PRNG keys are values, not global state — per-rank streams
+are ``jax.random.fold_in(key, axis_index(axis))``.  Under GSPMD
+single-controller the controller holds one global key; "local" streams only
+matter inside shard_map bodies, where ``model_parallel_key`` folds in the
+axis index.  The tracker keeps named seeds for API parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        from .....ops.random import Generator
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        """Context manager: ops inside consume the named stream.  Swaps the
+        framework-global Generator (paddle_tpu.ops.random) for the
+        duration."""
+        if name not in self.states_:
+            raise ValueError(f"state {name} not added")
+        from .....ops import random as rng_mod
+
+        saved = rng_mod.default_generator()
+        rng_mod._state.gen = self.states_[name]
+        try:
+            yield
+        finally:
+            rng_mod._state.gen = saved
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed: int = 1024):
+    """Install global + mp-local seeds (reference: random.py
+    model_parallel_random_seed: local = base + 1024 + mp_rank; under a
+    single controller the fold happens at use time via axis_index)."""
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add(MODEL_PARALLEL_RNG, seed + 1024)
+    from .....ops.random import seed as set_seed
+    set_seed(seed)
+
+
+def model_parallel_key(key: jax.Array, axis: str = "mp") -> jax.Array:
+    """Per-mp-rank key inside a shard_map body."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis))

@@ -1,0 +1,182 @@
+"""Pipeline model description & stage partitioner.
+
+Analog of python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py: ``LayerDesc`` lazy layer spec, ``SharedLayerDesc`` (:76,
+shared embedding/head weights across stages), ``SegmentLayers`` (:92,
+uniform / param-weighted stage partitioning), ``PipelineLayer`` (:257).
+
+TPU-native: the stage partition is a *logical* grouping.  Under a single
+controller all stages are materialised; the compiled pipeline engine
+(paddle_tpu.distributed.pipelining) stacks the repeated middle stages and
+runs them as a shard_map ring over the ``pp`` mesh axis, so the partition
+here mainly decides the seg boundaries + which params are stage-stacked.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ....nn.layer import Layer
+from ...topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    """Lazy layer constructor (reference pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a paddle_tpu.nn.Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer whose weight is shared across stages (reference :76 — e.g.
+    tied embedding/output head; the reference allreduces the shared-weight
+    grads between the owning stages, we let GSPMD handle it since both uses
+    reference the same Parameter)."""
+
+    def __init__(self, key: str, layer_cls, *inputs,
+                 forward_func: Optional[Callable] = None,
+                 shared_weight_attr: str = "weight", **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layers into ``num_parts`` stages (reference :92)."""
+
+    def __init__(self, layers_desc: Sequence, num_parts: int,
+                 method: str = "uniform", num_virtual_pipeline_stage: int = 1):
+        self.descs = list(layers_desc)
+        self.num_parts = num_parts * num_virtual_pipeline_stage
+        self.method = method
+        assert len(self.descs) >= self.num_parts, \
+            f"cannot split {len(self.descs)} layers into {self.num_parts} stages"
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(len(self.descs), self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment so each part holds the same count of the named layer
+            name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.descs)
+                     if (d.layer_cls.__name__ if isinstance(d, LayerDesc)
+                         else type(d).__name__) == name]
+            per = len(marks) // self.num_parts
+            assert per > 0, f"fewer {name} layers than stages"
+            bounds = [0]
+            for p in range(1, self.num_parts):
+                bounds.append(marks[p * per])
+            bounds.append(len(self.descs))
+            return bounds
+        raise ValueError(f"unknown segment method {self.method!r}")
+
+    @staticmethod
+    def uniform(num_items: int, num_parts: int) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """Pipeline-partitioned sequential model (reference pp_layers.py:257).
+
+    Holds the full layer list; ``get_stage_layers(i)`` gives stage i's
+    chunk.  forward() runs the whole model (single-controller semantics) —
+    the pipelined execution schedule lives in PipelineParallel /
+    paddle_tpu.distributed.pipelining.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, num_virtual_pipeline_stages: int = 1,
+                 **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._num_virtual_stages = num_virtual_pipeline_stages
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = (hcg.get_pipe_parallel_world_size()
+                          if hcg is not None else 1)
+        self._num_stages = max(1, num_stages)
+
+        self._descs = list(layers)
+        built: List[Layer] = []
+        self.shared_layers: Dict[str, Layer] = {}
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self.shared_layers:
+                    self.shared_layers[d.layer_name] = d.build_layer()
+                built.append(_SharedUse(self.shared_layers[d.layer_name],
+                                        d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"bad pipeline item {d!r}")
+        for i, l in enumerate(built):
+            self.add_sublayer(str(i), l)
+        self._layers_list = built
+
+        seg = SegmentLayers(self._descs, self._num_stages, seg_method,
+                            num_virtual_pipeline_stages)
+        self.segment_parts = seg.do_segment()
+
+    # ------------------------------------------------------------------
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def get_stage_layers(self, stage: int) -> List[Layer]:
+        lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+        return self._layers_list[lo:hi]
+
+    def stage_of_layer(self, idx: int) -> int:
+        return int(np.searchsorted(self.segment_parts, idx, side="right") - 1)
+
+    def forward(self, x):
+        for l in self._layers_list:
+            x = l(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _SharedUse(Layer):
+    """A reuse site of a shared layer: same Parameter objects, optional
+    alternate forward (e.g. logits = x @ embedding.T)."""
+
+    def __init__(self, shared: Layer, forward_func: Optional[Callable]):
+        super().__init__()
+        self.add_sublayer("shared", shared)
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is not None:
+            return self._forward_func(self._sub_layers["shared"], *args, **kwargs)
+        return self._sub_layers["shared"](*args, **kwargs)

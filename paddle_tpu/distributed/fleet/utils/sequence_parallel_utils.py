@@ -1,0 +1,130 @@
+"""Megatron-style sequence parallelism.
+
+Analog of python/paddle/distributed/fleet/utils/sequence_parallel_utils.py:
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-:127),
+ColumnSequenceParallelLinear (:427), RowSequenceParallelLinear (:562),
+mark_as_sequence_parallel_parameter + allreduce hooks (:192).
+
+TPU-native design: sequence parallelism = the activation's SEQ dim carries a
+Shard placement over the mp axis outside the TP block and the HIDDEN dim
+inside it.  The scatter/gather ops become sharding-constraint re-annotations;
+XLA's partitioner inserts the exact all_gather / reduce_scatter pairs the
+reference writes by hand — and fuses them into the adjacent matmuls
+(deferred-gather), which the hand-written version cannot.  The PyLayer-based
+grad-sync hooks (:192) are unnecessary: the backward layouts follow from the
+forward constraints.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....core.tensor import Tensor
+from ..layers.mpu.mp_layers import ColumnParallelLinear, RowParallelLinear, _mp_mesh_axis
+
+
+def _constrain_dim(x: Tensor, dim: int) -> Tensor:
+    mesh, ax = _mp_mesh_axis()
+    if mesh is None:
+        return x
+    spec = [None] * x.ndim
+    spec[dim] = ax
+    from ...auto_parallel.api import _sharding_constraint_op
+    return _sharding_constraint_op(x, sharding=NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def scatter(x, seq_dim: int = 1):
+    """ScatterOp analog (:85): full seq → seq sharded over mp."""
+    return _constrain_dim(x, seq_dim)
+
+
+def all_gather(x, seq_dim: int = 1):
+    """GatherOp/AllGatherOp analog (:105): seq sharded → replicated."""
+    mesh, ax = _mp_mesh_axis()
+    if mesh is None:
+        return x
+    from ...auto_parallel.api import _sharding_constraint_op
+    spec = [None] * x.ndim
+    return _sharding_constraint_op(x, sharding=NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def reduce_scatter(x, seq_dim: int = 1):
+    """ReduceScatterOp analog (:118): partial-summed full seq → reduced +
+    seq-sharded.  Under GSPMD the partial never materialises; constraining
+    the output is enough."""
+    return _constrain_dim(x, seq_dim)
+
+
+# PyLayer-class-style aliases (reference exposes classes with .apply)
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class GatherOp:
+    apply = staticmethod(all_gather)
+
+
+class AllGatherOp:
+    apply = staticmethod(all_gather)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(reduce_scatter)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Reference (:192) tags params whose grads need mp-allreduce because
+    they live outside TP blocks (LayerNorm etc.).  Under GSPMD grads follow
+    the replicated param layout automatically; the tag is kept for parity
+    and used by HybridParallelOptimizer for bookkeeping."""
+    parameter.sequence_parallel = True
+    return parameter
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return getattr(parameter, "sequence_parallel", False)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column TP linear whose input arrives seq-sharded (reference: :427 —
+    it all_gathers seq before the matmul).  We re-annotate: input seq
+    replicated, output hidden-sharded; XLA fuses the gather into the
+    matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         fuse_matmul_bias=fuse_matmul_bias, mp_group=mp_group,
+                         name=name)
+
+    def forward(self, x, seq_dim: int = 1):
+        if self.is_mp:
+            x = all_gather(x, seq_dim)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row TP linear whose output leaves seq-sharded (reference: :562 —
+    reduce_scatter after the matmul)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, input_is_parallel=input_is_parallel,
+                         fuse_matmul_bias=fuse_matmul_bias, mp_group=mp_group,
+                         name=name)
+
+    def forward(self, x, seq_dim: int = 1):
+        y = super().forward(x)
+        if self.is_mp:
+            y = reduce_scatter(y, seq_dim)
+        return y
+
+
+def create_fused_allreduce_gradient_hooks(model, accumulation_steps=1):
+    """No-op on TPU (reference: :192 installs bucketed mp-allreduce hooks);
+    XLA emits fused collectives from the sharding layout."""
+    return []
