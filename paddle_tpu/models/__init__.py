@@ -1,1 +1,6 @@
+"""paddle_tpu.models — model families (flagships for the north-star
+benchmark configs, BASELINE.md)."""
 
+from .llama import (LLAMA_SHARDING_PLAN, LlamaConfig, LlamaForCausalLM,
+                    LlamaModel, apply_llama_sharding, build_train_step,
+                    make_batch_shardings)

@@ -1,0 +1,361 @@
+"""Llama family — the flagship causal-LM (north-star config 4).
+
+Capability analog of the reference's Llama path: PaddleNLP Llama on top of
+paddle.incubate fused ops (fused_rms_norm.py, fused_rotary_position_embedding
+.py, swiglu.py — python/paddle/incubate/nn/functional/) + the flash-attention
+kernel (paddle/phi/kernels/gpu/flash_attn_kernel.cu, SPMD rule
+phi/infermeta/spmd_rules/flash_attention.cc) trained under Fleet hybrid
+parallelism.
+
+TPU-first design decisions:
+- bf16 compute / fp32 master weights (MXU-native; no GradScaler needed),
+- GQA attention through incubate.flash_attention (Pallas kernel on TPU,
+  XLA-fused softmax path elsewhere),
+- rotary embeddings precomputed once as buffers (no per-step gather),
+- one GSPMD sharding PLAN (param-name pattern → PartitionSpec) instead of
+  per-layer wrapper classes: FSDP ('sharding') × tensor ('mp') × data
+  ('dp') × sequence ('sep') axes on a single mesh; XLA inserts all
+  collectives,
+- the train step is a single jitted, donated, functional program
+  (build_train_step) — the analog of the reference's whole
+  dygraph-hybrid-runtime hot loop (§3.3) collapsed into one XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn.layer import Layer, Parameter
+from ..incubate.nn.fused import fused_rms_norm, fused_rotary_position_embedding, swiglu
+from ..incubate.nn.attention import flash_attention
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"  # param dtype; compute casts via amp
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_hidden_layers=32,
+                           num_attention_heads=32, num_key_value_heads=8)
+
+    @staticmethod
+    def debug(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2,
+              inter=128, max_pos=256) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                           intermediate_size=inter, num_hidden_layers=layers,
+                           num_attention_heads=heads, num_key_value_heads=kv_heads,
+                           max_position_embeddings=max_pos, rope_theta=10000.0)
+
+
+class LlamaRMSNorm(Layer):
+    def __init__(self, hidden_size: int, eps: float = 1e-5):
+        super().__init__()
+        self.weight = Parameter(jnp.ones((hidden_size,), dtype=jnp.float32))
+        self.eps = eps
+
+    def forward(self, x):
+        return fused_rms_norm(x, self.weight, epsilon=self.eps)
+
+
+def _rope_tables(head_dim: int, max_pos: int, theta: float):
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    t = np.arange(max_pos, dtype=np.float64)
+    freqs = np.outer(t, inv_freq)                       # [max_pos, head_dim/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)       # [max_pos, head_dim]
+    return (jnp.asarray(np.cos(emb), dtype=jnp.float32),
+            jnp.asarray(np.sin(emb), dtype=jnp.float32))
+
+
+class LlamaAttention(Layer):
+    """GQA attention. Layout [b, s, h, d] throughout (flash kernel layout)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        h, d = cfg.hidden_size, cfg.head_dim
+        self.q_proj = nn.Linear(h, cfg.num_attention_heads * d, bias_attr=False)
+        self.k_proj = nn.Linear(h, cfg.num_key_value_heads * d, bias_attr=False)
+        self.v_proj = nn.Linear(h, cfg.num_key_value_heads * d, bias_attr=False)
+        self.o_proj = nn.Linear(cfg.num_attention_heads * d, h, bias_attr=False)
+
+    def forward(self, x, cos, sin, position_ids=None):
+        from ..ops.manip import repeat_interleave
+
+        cfg = self.cfg
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, cfg.num_attention_heads, cfg.head_dim])
+        k = self.k_proj(x).reshape([b, s, cfg.num_key_value_heads, cfg.head_dim])
+        v = self.v_proj(x).reshape([b, s, cfg.num_key_value_heads, cfg.head_dim])
+        # sin/cos arrive [s, d] (prefix positions) or [b, s, d] (explicit
+        # position_ids); broadcast over (b, ·, h, ·)
+        lead = 1 if cos.ndim == 2 else b
+        cos_b = cos.reshape([lead, s, 1, cfg.head_dim])
+        sin_b = sin.reshape([lead, s, 1, cfg.head_dim])
+        q, k = fused_rotary_position_embedding(q, k, sin=sin_b, cos=cos_b,
+                                               position_ids=position_ids)
+        # GQA: repeat kv heads to match q heads (XLA turns this into a
+        # broadcast inside the attention einsum, no materialised copy)
+        rep = cfg.num_attention_heads // cfg.num_key_value_heads
+        if rep > 1:
+            k = repeat_interleave(k, rep, axis=2)
+            v = repeat_interleave(v, rep, axis=2)
+        out = flash_attention(q, k, v, causal=True)
+        return self.o_proj(out.reshape([b, s, -1]))
+
+
+class LlamaMLP(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size, bias_attr=False)
+        self.up_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size, bias_attr=False)
+        self.down_proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos, sin, position_ids=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, position_ids)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.remat = False  # set by build_train_step(remat=True)
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        cos, sin = _rope_tables(cfg.head_dim, cfg.max_position_embeddings,
+                                cfg.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, position_ids=None):
+        from ..autograd import is_grad_enabled
+
+        s = input_ids.shape[-1]
+        x = self.embed_tokens(input_ids)
+        if position_ids is not None:
+            # gather per-token rotary phases: [b, s, head_dim]
+            pid = position_ids._value if isinstance(position_ids, Tensor) \
+                else jnp.asarray(position_ids)
+            cos = Tensor(jnp.take(self._buffers["rope_cos"]._value, pid, axis=0))
+            sin = Tensor(jnp.take(self._buffers["rope_sin"]._value, pid, axis=0))
+        else:
+            cos = Tensor(self._buffers["rope_cos"]._value[:s])
+            sin = Tensor(self._buffers["rope_sin"]._value[:s])
+        # remat only on the functional (jit) path — tape-eager keeps
+        # activations anyway, and jax.checkpoint needs pure callees
+        use_remat = self.remat and not is_grad_enabled()
+        for layer in self.layers:
+            if use_remat:
+                x = _remat_layer_call(layer, x, cos, sin)
+            else:
+                x = layer(x, cos, sin)
+        return self.norm(x)
+
+
+def _remat_layer_call(layer: "LlamaDecoderLayer", x: Tensor, cos: Tensor,
+                      sin: Tensor) -> Tensor:
+    """Run one decoder layer under jax.checkpoint: activations inside the
+    layer are recomputed in backward (the analog of the reference's
+    recompute pass, strategy.recompute / fleet recompute_configs)."""
+    from ..autograd import no_grad
+
+    state = {k: (t._value if isinstance(t, Tensor) else t)
+             for k, t in layer.state_dict().items()}
+
+    @jax.checkpoint
+    def body(state, xv, cosv, sinv):
+        with no_grad():
+            out = layer.functional_call(state, Tensor(xv), Tensor(cosv),
+                                        Tensor(sinv))
+        return out._value
+
+    return Tensor(body(state, x._value, cos._value, sin._value))
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        from ..ops.linalg import matmul
+
+        h = self.model(input_ids, position_ids)
+        if self.cfg.tie_word_embeddings:
+            # tape-recorded matmul against the embedding Parameter itself so
+            # the head contributes gradients to embed_tokens in eager mode
+            return matmul(h, self.model.embed_tokens.weight, transpose_y=True)
+        return self.lm_head(h)
+
+
+# --------------------------------------------------------------------------
+# GSPMD sharding plan (the analog of the reference's per-layer TP wrappers +
+# sharded-param init in PaddleNLP; see SURVEY.md §2.7)
+# --------------------------------------------------------------------------
+
+# param-name suffix → logical placement (fsdp = ZeRO-3 axis, mp = tensor axis)
+LLAMA_SHARDING_PLAN = {
+    "embed_tokens.weight":  P("mp", "sharding"),   # [vocab, hidden]
+    "q_proj.weight":        P("sharding", "mp"),   # [hidden, heads*d]
+    "k_proj.weight":        P("sharding", "mp"),
+    "v_proj.weight":        P("sharding", "mp"),
+    "o_proj.weight":        P("mp", "sharding"),   # [heads*d, hidden]
+    "gate_proj.weight":     P("sharding", "mp"),
+    "up_proj.weight":       P("sharding", "mp"),
+    "down_proj.weight":     P("mp", "sharding"),   # [inter, hidden]
+    "lm_head.weight":       P("sharding", "mp"),   # [hidden, vocab]
+    "input_layernorm.weight": P(None),
+    "post_attention_layernorm.weight": P(None),
+    "norm.weight":          P(None),
+}
+
+
+def plan_spec_for(name: str, plan: Dict[str, P] = None) -> P:
+    plan = plan or LLAMA_SHARDING_PLAN
+    for suffix, spec in plan.items():
+        if name.endswith(suffix):
+            return spec
+    return P()
+
+
+def _filter_spec_to_mesh(spec: P, mesh: Mesh) -> P:
+    """Drop axes absent from the mesh (e.g. mp when running pure FSDP)."""
+    names = set(mesh.axis_names)
+
+    def keep(e):
+        if e is None:
+            return None
+        if isinstance(e, tuple):
+            kept = tuple(a for a in e if a in names and mesh.shape[a] > 1)
+            return kept if kept else None
+        return e if (e in names and mesh.shape[e] > 1) else None
+
+    return P(*(keep(e) for e in tuple(spec)))
+
+
+def apply_llama_sharding(model: Layer, mesh: Mesh,
+                         plan: Optional[Dict[str, P]] = None) -> None:
+    """Place every parameter per the plan (divisibility-checked; falls back
+    to replication for non-divisible dims)."""
+    for name, p in model.named_parameters():
+        spec = _filter_spec_to_mesh(plan_spec_for(name, plan), mesh)
+        entries = list(tuple(spec))
+        for i, e in enumerate(entries):
+            if e is None:
+                continue
+            axes = e if isinstance(e, tuple) else (e,)
+            size = int(np.prod([mesh.shape[a] for a in axes]))
+            if i >= p.ndim or p.shape[i] % size != 0:
+                entries[i] = None
+        p.set_value(jax.device_put(p._value, NamedSharding(mesh, P(*entries))))
+
+
+# --------------------------------------------------------------------------
+# The compiled train step
+# --------------------------------------------------------------------------
+
+def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = None,
+                     data_axes: Tuple[str, ...] = ("dp", "sharding"),
+                     remat: bool = False, compute_dtype=jnp.bfloat16):
+    """Build a single donated, jitted train step:
+
+        step_fn(params, opt_state, step_no, lr, input_ids, labels)
+            -> (loss, new_params, new_opt_state)
+
+    - params/opt_state keep their NamedShardings (FSDP/TP at rest),
+    - with ``mesh``, the batch and logits are constrained to the data axes
+      (pins GSPMD's layout choice for the loss reduction),
+    - ``remat=True`` checkpoints each decoder layer (jax.checkpoint) —
+      activations recomputed in backward; the analog of the reference's
+      recompute pass (strategy.recompute),
+    - forward/backward math in ``compute_dtype`` (bf16 on the MXU),
+      optimizer math fp32 (master weights in Adam state,
+      optimizer.py multi_precision).
+    """
+    from ..autograd import no_grad
+
+    model.model.remat = remat
+    names = [n for n, _ in model.named_parameters()]
+    no_decay = {n for n in names if "layernorm" in n or n.endswith("norm.weight")
+                or n.endswith(".bias")}
+    batch_sharding = make_batch_shardings(mesh, data_axes) if mesh is not None \
+        else None
+
+    def loss_fn(params: Dict[str, Any], input_ids, labels):
+        cast = {k: (v.astype(compute_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in params.items()}
+        with no_grad():  # tape off: jax.grad provides the gradients
+            logits = model.functional_call(cast, Tensor(input_ids))
+        lv = logits._value.astype(jnp.float32)
+        if batch_sharding is not None:
+            lv = jax.lax.with_sharding_constraint(
+                lv, NamedSharding(mesh, P(batch_sharding.spec[0])))
+        logp = jax.nn.log_softmax(lv, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def step_fn(params, opt_state, step_no, lr, input_ids, labels):
+        if batch_sharding is not None:
+            input_ids = jax.lax.with_sharding_constraint(input_ids, batch_sharding)
+            labels = jax.lax.with_sharding_constraint(labels, batch_sharding)
+        loss, grads = grad_fn(params, input_ids, labels)
+        new_params, new_opt_state = optimizer.apply(
+            params, grads, opt_state, lr, step_no + 1,
+            decay_mask={n: n not in no_decay for n in names})
+        return loss, new_params, new_opt_state
+
+    return jax.jit(step_fn, donate_argnums=(0, 1))
+
+
+def make_batch_shardings(mesh: Mesh, data_axes: Tuple[str, ...] = ("dp", "sharding")):
+    axes = tuple(a for a in data_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    spec = P(axes if len(axes) > 1 else (axes[0] if axes else None))
+    return NamedSharding(mesh, spec)

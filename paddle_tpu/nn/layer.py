@@ -142,13 +142,17 @@ class Layer:
     def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
         return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
 
-    def named_buffers(self, prefix: str = "") -> Iterator[Tuple[str, Tensor]]:
+    def named_buffers(self, prefix: str = "", persistable_only: bool = False
+                      ) -> Iterator[Tuple[str, Tensor]]:
         for name, b in self._buffers.items():
-            if b is not None:
-                yield (f"{prefix}.{name}" if prefix else name), b
+            if b is None:
+                continue
+            if persistable_only and name in self._non_persistable_buffer_names:
+                continue
+            yield (f"{prefix}.{name}" if prefix else name), b
         for lname, layer in self._sub_layers.items():
             sub_prefix = f"{prefix}.{lname}" if prefix else lname
-            yield from layer.named_buffers(sub_prefix)
+            yield from layer.named_buffers(sub_prefix, persistable_only)
 
     def buffers(self) -> List[Tensor]:
         return [b for _, b in self.named_buffers()]
@@ -230,10 +234,10 @@ class Layer:
         out = OrderedDict() if destination is None else destination
         for name, p in self.named_parameters():
             out[structured_name_prefix + name] = p
-        for name, b in self.named_buffers():
-            short = name.rsplit(".", 1)[-1]
-            if short not in self._non_persistable_buffer_names:
-                out[structured_name_prefix + name] = b
+        # non-persistable buffers are filtered by their OWNING layer's set
+        # (a root-level check would miss sublayer registrations)
+        for name, b in self.named_buffers(persistable_only=True):
+            out[structured_name_prefix + name] = b
         return out
 
     def set_state_dict(self, state_dict, use_structured_name: bool = True):
