@@ -26,7 +26,9 @@ def _pallas_available() -> bool:
 
 def flash_attention(query, key, value, causal=False, dropout=0.0,
                     attn_mask=None, scale=None):
-    """(batch, seq, heads, head_dim) attention, flash-style."""
+    """(batch, seq, heads, head_dim) attention, flash-style.  GQA (fewer
+    kv heads) is accepted: the Pallas kernel routes q heads to kv groups
+    natively; the XLA fallback repeats kv heads."""
     if _pallas_available() and attn_mask is None and dropout == 0.0:
         try:
             from ...ops.pallas.flash_attention import flash_attention_op
@@ -35,6 +37,12 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
                             causal=causal, scale=scale)
         except Exception:
             pass
+    rep = query.shape[2] // key.shape[2]
+    if rep > 1:
+        from ...ops.manip import repeat_interleave
+
+        key = repeat_interleave(key, rep, axis=2)
+        value = repeat_interleave(value, rep, axis=2)
     dropout_mask = None
     if dropout > 0.0:
         from ...core.tensor import Tensor

@@ -103,27 +103,21 @@ class LlamaAttention(Layer):
         self.v_proj = nn.Linear(h, cfg.num_key_value_heads * d, bias_attr=False)
         self.o_proj = nn.Linear(cfg.num_attention_heads * d, h, bias_attr=False)
 
-    def forward(self, x, cos, sin, position_ids=None):
-        from ..ops.manip import repeat_interleave
-
+    def forward(self, x, cos, sin):
         cfg = self.cfg
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, cfg.num_attention_heads, cfg.head_dim])
         k = self.k_proj(x).reshape([b, s, cfg.num_key_value_heads, cfg.head_dim])
         v = self.v_proj(x).reshape([b, s, cfg.num_key_value_heads, cfg.head_dim])
         # sin/cos arrive [s, d] (prefix positions) or [b, s, d] (explicit
-        # position_ids); broadcast over (b, ·, h, ·)
+        # position_ids, pre-gathered by LlamaModel); broadcast over (b,·,h,·)
         lead = 1 if cos.ndim == 2 else b
         cos_b = cos.reshape([lead, s, 1, cfg.head_dim])
         sin_b = sin.reshape([lead, s, 1, cfg.head_dim])
-        q, k = fused_rotary_position_embedding(q, k, sin=sin_b, cos=cos_b,
-                                               position_ids=position_ids)
-        # GQA: repeat kv heads to match q heads (XLA turns this into a
-        # broadcast inside the attention einsum, no materialised copy)
-        rep = cfg.num_attention_heads // cfg.num_key_value_heads
-        if rep > 1:
-            k = repeat_interleave(k, rep, axis=2)
-            v = repeat_interleave(v, rep, axis=2)
+        q, k = fused_rotary_position_embedding(q, k, sin=sin_b, cos=cos_b)
+        # GQA goes to the attention entry unexpanded: the Pallas kernel
+        # routes q heads to kv groups via index maps (no HBM repeat); the
+        # XLA fallback repeats internally
         out = flash_attention(q, k, v, causal=True)
         return self.o_proj(out.reshape([b, s, -1]))
 
@@ -147,8 +141,8 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cos, sin, position_ids=None):
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin, position_ids)
+    def forward(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -255,8 +249,8 @@ LLAMA_SHARDING_PLAN = {
 }
 
 
-def plan_spec_for(name: str, plan: Dict[str, P] = None) -> P:
-    plan = plan or LLAMA_SHARDING_PLAN
+def plan_spec_for(name: str, plan: Optional[Dict[str, P]] = None) -> P:
+    plan = plan if plan is not None else LLAMA_SHARDING_PLAN
     for suffix, spec in plan.items():
         if name.endswith(suffix):
             return spec
@@ -319,7 +313,6 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
     """
     from ..autograd import no_grad
 
-    model.model.remat = remat
     names = [n for n, _ in model.named_parameters()]
     no_decay = {n for n in names if "layernorm" in n or n.endswith("norm.weight")
                 or n.endswith(".bias")}
@@ -330,8 +323,16 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
         cast = {k: (v.astype(compute_dtype)
                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
                 for k, v in params.items()}
-        with no_grad():  # tape off: jax.grad provides the gradients
-            logits = model.functional_call(cast, Tensor(input_ids))
+        # set the remat flag only for the duration of THIS trace: jit
+        # traces lazily, so a build-time flag would leak across steps
+        # built with different remat settings (and into eager inference)
+        saved_remat = model.model.remat
+        model.model.remat = remat
+        try:
+            with no_grad():  # tape off: jax.grad provides the gradients
+                logits = model.functional_call(cast, Tensor(input_ids))
+        finally:
+            model.model.remat = saved_remat
         lv = logits._value.astype(jnp.float32)
         if batch_sharding is not None:
             lv = jax.lax.with_sharding_constraint(

@@ -1,0 +1,7 @@
+"""paddle_tpu.ops.pallas — hand-written TPU kernels.
+
+The analog of the reference's fused CUDA kernels
+(paddle/phi/kernels/fusion/, third_party/flashattn): where XLA's automatic
+fusion isn't enough, we drop to Pallas (VMEM-tiled, MXU-scheduled).  Every
+kernel has an interpret-mode path so the same code runs in CPU CI
+(SURVEY.md §4: fake-backend testing)."""
