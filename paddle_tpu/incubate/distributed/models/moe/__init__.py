@@ -1,0 +1,2 @@
+from .gate import GShardGate, NaiveGate, SwitchGate
+from .moe_layer import MoELayer

@@ -1,0 +1,101 @@
+"""MoE gates.
+
+Analog of the reference's gate zoo
+(python/paddle/incubate/distributed/models/moe/gate/{naive,gshard,switch}
+_gate.py).  Each gate maps token logits to (combine_weights [G,E,C],
+dispatch_mask [G,E,C], aux_loss) in the GShard masked-einsum formulation —
+the dispatch XLA partitions into an alltoall over the expert axis, versus
+the reference's explicit global_scatter/global_gather CUDA ops
+(paddle/fluid/operators/collective/global_scatter_op.cu.cc).
+
+The mask math lives in pure functions (jit/tape friendly); the Layer
+classes hold the gate weight Parameter and the per-gate aux-loss choice.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer import Layer, Parameter
+
+
+def _one_hot(idx, num):
+    return jax.nn.one_hot(idx, num, dtype=jnp.float32)
+
+
+def load_balance_aux_loss(probs):
+    """GShard eq.(4) / Switch: E * sum(frac_top1_tokens * mean_prob)."""
+    e = probs.shape[-1]
+    top1 = jnp.argmax(probs, axis=-1)
+    frac = _one_hot(top1, e).mean(axis=0)
+    return e * jnp.sum(frac * probs.mean(axis=0))
+
+
+def zero_aux_loss(probs):
+    return jnp.asarray(0.0, jnp.float32)
+
+
+def top_k_masks(probs, topk: int, capacity: int):
+    """Greedy top-k routing with per-expert capacity.
+
+    probs: [G, E].  Returns (combine [G,E,C], dispatch [G,E,C]); tokens
+    beyond an expert's capacity are dropped (reference semantics)."""
+    g, e = probs.shape
+    combine = jnp.zeros((g, e, capacity), jnp.float32)
+    dispatch = jnp.zeros((g, e, capacity), jnp.float32)
+    remaining = probs
+    position_in_expert = jnp.zeros((e,), jnp.int32)
+    for _ in range(topk):
+        idx = jnp.argmax(remaining, axis=-1)          # [G]
+        mask = _one_hot(idx, e)                       # [G, E]
+        # token's slot within its expert: running prefix count
+        pos = (jnp.cumsum(mask, axis=0) - 1) * mask + \
+            position_in_expert[None, :] * mask
+        keep = (pos < capacity) & (mask > 0)
+        w = (probs * mask).sum(-1, keepdims=True)     # [G, 1] gate weight
+        oh_pos = _one_hot(jnp.where(keep, pos.astype(jnp.int32), 0), capacity)
+        sel = keep.astype(jnp.float32)[..., None] * oh_pos  # [G, E, C]
+        combine = combine + w[..., None] * sel
+        dispatch = jnp.maximum(dispatch, sel)
+        position_in_expert = position_in_expert + mask.sum(0).astype(jnp.int32)
+        remaining = remaining * (1.0 - mask)
+    return combine, dispatch
+
+
+class NaiveGate(Layer):
+    """Top-k softmax gate, no aux loss (reference naive_gate.py)."""
+
+    aux_loss_fn = staticmethod(zero_aux_loss)
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2):
+        super().__init__()
+        self.num_expert = num_expert * world_size
+        self.topk = topk
+        self.weight = Parameter(
+            jnp.zeros((d_model, self.num_expert), dtype=jnp.float32))
+
+    def capacity(self, num_tokens: int, capacity_factor: float) -> int:
+        return int(capacity_factor * num_tokens * self.topk
+                   / self.num_expert + 1)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balancing aux loss (reference gshard_gate.py)."""
+
+    aux_loss_fn = staticmethod(load_balance_aux_loss)
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=topk)
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 gate (reference switch_gate.py; Switch Transformer)."""
+
+    aux_loss_fn = staticmethod(load_balance_aux_loss)
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)

@@ -32,6 +32,19 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _sds(shape, dtype):
+    """ShapeDtypeStruct that works inside shard_map bodies: when manual
+    mesh axes are bound, tag outputs as varying over them (jax's vma check
+    requires it for pallas_call outputs)."""
+    try:
+        axes = jax.core.unsafe_get_axis_names_DO_NOT_USE()
+    except Exception:
+        axes = []
+    if axes:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(axes))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _attn_reference(q, k, v, causal, scale):
     """XLA reference path (GQA handled by a materialised head repeat)."""
     rep = q.shape[2] // k.shape[2]
@@ -154,8 +167,8 @@ def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32),
+            _sds((bh, sq, d), q.dtype),
+            _sds((bh, 8, sq), jnp.float32),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # m (lane-replicated)
@@ -311,7 +324,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
         grid=(bh, nq, pl.cdiv(sk, block_k)),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=_sds((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -332,8 +345,8 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
         grid=(bkv, pl.cdiv(sk, block_k), rep * nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=(kspec2, kspec2),
-        out_shape=(jax.ShapeDtypeStruct((bkv, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bkv, sk, d), v.dtype)),
+        out_shape=(_sds((bkv, sk, d), k.dtype),
+                   _sds((bkv, sk, d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(

@@ -1,0 +1,14 @@
+"""paddle_tpu.parallel — schedule-explicit SPMD building blocks.
+
+Where GSPMD's automatic partitioning isn't the right tool (pipelining,
+ring attention, Ulysses head/seq exchange), these modules write the
+schedule explicitly with shard_map + collectives.  Capability analogs in
+the reference: sep/segment parallel (fleet/meta_parallel/segment_parallel
+.py), pipeline schedules (pipeline_parallel.py, pipeline_scheduler_pass/),
+MoE alltoall (incubate/distributed/models/moe/moe_layer.py) — see
+SURVEY.md §2.7.
+"""
+
+from .ring_attention import ring_flash_attention
+from .sep import ulysses_attention
+from .pipelining import pipeline_apply

@@ -1,0 +1,83 @@
+"""Compiled pipeline parallelism: GPipe/1F1B inside one XLA program.
+
+Analog of the reference's pipeline runtimes — the eager 1F1B scheduler
+(fleet/meta_parallel/pipeline_parallel.py:547), the static scheduler passes
+(passes/pipeline_scheduler_pass/pipeline_1f1b.py:39, pipeline_zero_bubble
+.py:62), and the P2P layer (pp_utils/p2p_communication.py) — collapsed the
+TPU way: ONE jitted shard_map over the ``pp`` mesh axis.  Per-stage
+parameters are stacked on a leading axis and sharded over pp, so each
+device holds its stage; micro-batch activations advance one stage per tick
+via collective_permute (ICI neighbour hop).  XLA overlaps each tick's
+ppermute with the next tick's compute — the 1F1B "steady state" falls out
+of dataflow rather than an actor runtime (FleetExecutor, SURVEY §2.6).
+
+The schedule below is the forward pass; backward through it is jax.grad
+(XLA reverses the scan, recomputing per-tick state under remat) — so the
+bubble count matches GPipe: (P-1) ticks each direction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
+                   axis: str = "pp", num_microbatches: int | None = None):
+    """Run a P-stage pipeline inside a shard_map body.
+
+    stage_fn(params_slice, activation) -> activation  — one stage's compute
+    stage_params: pytree whose leaves have leading dim 1 (this device's
+        stage slice of the stacked [P, ...] parameters)
+    x: [M, mb, ...] this call's micro-batched input — every device receives
+        the same x (replicated); only stage 0 consumes it.
+    Returns [M, mb, ...] outputs (valid on the LAST stage; other devices
+        hold zeros — callers usually ppermute/psum or read stage P-1).
+    """
+    p = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    m = x.shape[0] if num_microbatches is None else num_microbatches
+    ticks = m + p - 1
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def _varying(v):
+        try:
+            return lax.pcast(v, (axis,), to="varying")
+        except AttributeError:
+            return v
+
+    params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    state = _varying(jnp.zeros_like(x[0]))            # current activation
+    outs = _varying(jnp.zeros((m,) + tuple(x.shape[1:]), x.dtype))
+
+    def tick(t, carry):
+        state, outs = carry
+        # stage 0 ingests micro-batch t (while it exists); other stages use
+        # what arrived from the left neighbour
+        feed = lax.dynamic_index_in_dim(x, jnp.minimum(t, m - 1), axis=0,
+                                        keepdims=False)
+        inp = jnp.where(me == 0, feed, state)
+        out = stage_fn(params, inp)
+        # last stage emits micro-batch t-(p-1); masked write (a cond would
+        # trip the vma type check: branches differ in axis-variance)
+        emit_idx = t - (p - 1)
+        valid = (me == p - 1) & (emit_idx >= 0)
+        emit = (jnp.arange(m) == emit_idx) & valid
+        emit = emit.reshape((m,) + (1,) * (outs.ndim - 1))
+        outs = jnp.where(emit, out.astype(outs.dtype)[None], outs)
+        # advance the ring: stage i's output becomes stage i+1's input
+        state = lax.ppermute(out, axis, perm)
+        return state, outs
+
+    _, outs = lax.fori_loop(0, ticks, tick, (state, outs))
+    return outs
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack a list of per-stage param pytrees into [P, ...] leaves (the
+    layout pipeline_apply shards over pp)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0),
+                                  *per_stage_params)

@@ -1,0 +1,117 @@
+"""Ring attention — exact long-context attention over a seq-sharded axis.
+
+The reference snapshot has NO ring/context parallelism (SURVEY.md §2.7 "Ring
+attention: not present"); its long-context story is the sep axis + SP +
+FlashAttention.  This module EXCEEDS reference capability: blockwise-exact
+attention for sequences sharded over a mesh axis, k/v blocks rotating the
+ring via collective_permute (ICI neighbour hops) while each hop's compute
+runs the Pallas flash kernel — communication hidden behind the flash tiles.
+
+Algorithm (per device, inside shard_map over ``axis``):
+  local q block stays; k/v blocks make P-1 ring hops.  Each hop computes
+  (o_i, lse_i) for the visiting block — causal structure decided by
+  (my_rank, src_rank): src < me full block, src == me causal, src > me
+  skipped — then merges online:  m' = max(m, lse_i),
+  acc' = acc*e^{m-m'} + o_i*l_i*e^{lse_i-m'}, l' likewise.  Final
+  o = acc / l.  This is blockwise-exact (same math as flash across blocks).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _local_flash(q, k, v, causal, scale):
+    """Per-block flash on [b, s, h, d]; returns (o, lse[b,h,s])."""
+    from ..ops.pallas.flash_attention import (_flash_forward, _to_bh,
+                                              _attn_reference)
+
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    interpret = jax.default_backend() == "cpu"
+    of, lse = _flash_forward(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
+                             h=h, kvh=kvh, interpret=interpret)
+    o = of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return o.astype(jnp.float32), lse[:, 0, :].reshape(b, h, sq)
+
+
+def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = True,
+                         scale: Optional[float] = None):
+    """Exact attention for seq-sharded q,k,v inside a shard_map body.
+
+    q: [b, s_local, h, d]; k,v: [b, s_local, kvh, d], all sharded on dim 1
+    over ``axis``.  Returns [b, s_local, h, d] (same sharding).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    p = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, sl, h, d = q.shape
+
+    def _varying(x):
+        # initial carries are constants (axis-invariant in jax's vma
+        # typing); the loop makes them device-varying — pre-cast so the
+        # scan carry types match
+        try:
+            return lax.pcast(x, (axis,), to="varying")
+        except AttributeError:
+            return x
+
+    m = _varying(jnp.full((b, h, sl, 1), -jnp.inf, dtype=jnp.float32))
+    l = _varying(jnp.zeros((b, h, sl, 1), dtype=jnp.float32))
+    acc = _varying(jnp.zeros((b, sl, h, d), dtype=jnp.float32))
+    perm = [(i, (i + 1) % p) for i in range(p)]  # send k/v to the right
+
+    def merge(carry, block_kv, src):
+        m_prev, l_prev, acc_prev = carry
+        kb, vb = block_kv
+
+        def attend(causal_flag):
+            def f():
+                o_i, lse_i = _local_flash(q, kb, vb, causal_flag, scale)
+                return o_i, lse_i.reshape(b, h, sl, 1)
+            return f
+
+        if causal:
+            def skip():
+                # src > me: q tokens all precede the visiting k block
+                return (jnp.zeros((b, sl, h, d), jnp.float32),
+                        jnp.full((b, h, sl, 1), -jnp.inf, jnp.float32))
+
+            # one branch executes per hop (lax.switch, not where-over-both)
+            branch = (src == me).astype(jnp.int32) + \
+                     (src > me).astype(jnp.int32) * 2
+            o_i, lse_i = lax.switch(branch, [attend(False), attend(True), skip])
+        else:
+            o_i, lse_i = attend(False)()
+
+        m_new = jnp.maximum(m_prev, lse_i)
+        # guard -inf - -inf
+        safe = lambda x, mn: jnp.where(jnp.isinf(mn) & (mn < 0), 0.0,
+                                       jnp.exp(x - mn))
+        alpha = safe(m_prev, m_new)                     # rescale old
+        beta = safe(lse_i, m_new)                       # weight of new block
+        l_new = l_prev * alpha + beta
+        # o_i is already softmax-normalised within its block (divided by
+        # l_i = e^{lse_i - m_i} sums); re-weight by beta
+        acc_new = acc_prev * alpha.transpose(0, 2, 1, 3) + \
+            o_i * beta.transpose(0, 2, 1, 3)
+        return m_new, l_new, acc_new
+
+    def body(i, carry):
+        m_, l_, acc_, kb, vb = carry
+        src = (me - i) % p  # after i hops we hold rank (me - i)'s block
+        m_, l_, acc_ = merge((m_, l_, acc_), (kb, vb), src)
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return m_, l_, acc_, kb, vb
+
+    m, l, acc, _, _ = lax.fori_loop(0, p, body, (m, l, acc, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l.transpose(0, 2, 1, 3)).astype(q.dtype)
