@@ -27,6 +27,9 @@ from .collective import (Group, all_gather, all_reduce, alltoall, barrier,
                          wait)
 from . import auto_parallel
 from . import fleet
+from . import checkpoint
+from .checkpoint import load_state_dict, save_state_dict
+from .spawn import spawn
 from .auto_parallel import (ShardingStage1, ShardingStage2, ShardingStage3,
                             dtensor_from_local, dtensor_to_local,
                             get_placements, is_dist, reshard, shard_dataloader,

@@ -1,0 +1,1 @@
+from .main import build_env, launch, parse_args

@@ -1,0 +1,124 @@
+"""Launcher — ``python -m paddle_tpu.distributed.launch``.
+
+Analog of the reference's launch CLI (python/paddle/distributed/launch/
+main.py:23, __main__.py; collective controller launch/controllers/
+collective.py:126-132 which sets the env contract, master rendezvous
+controllers/master.py).  TPU-native notes: on a TPU pod each HOST runs ONE
+process (jax.distributed + PJRT own the per-chip fan-out), so
+``--nproc_per_node`` defaults to 1; the env contract (PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINER_ENDPOINTS /
+PADDLE_RANK_IN_NODE / PADDLE_MASTER — SURVEY §5 launcher contract) is kept
+verbatim so reference scripts port unchanged, and is also mapped onto
+jax.distributed's coordinator env for in-process consumption.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training processes")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="N or N1:N2 elastic range")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator host:port (default: self)")
+    p.add_argument("--rank", type=int, default=0, help="this node's rank")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--devices", "--gpus", type=str, default=None)
+    p.add_argument("--max_restart", type=int, default=0)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def build_env(rank: int, local_rank: int, world: int, endpoints: List[str],
+              master: str) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_RANK_IN_NODE": str(local_rank),
+        "PADDLE_MASTER": master,
+        # jax.distributed consumption (multi-host TPU)
+        "JAX_COORDINATOR_ADDRESS": master,
+        "JAX_NUM_PROCESSES": str(world),
+        "JAX_PROCESS_ID": str(rank),
+        # generic torch-style aliases some scripts read
+        "RANK": str(rank),
+        "WORLD_SIZE": str(world),
+        "LOCAL_RANK": str(local_rank),
+        "MASTER_ADDR": master.split(":")[0],
+        "MASTER_PORT": master.split(":")[-1],
+    })
+    return env
+
+
+def launch(args=None) -> int:
+    args = args if args is not None else parse_args()
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+    master = args.master or "127.0.0.1:49178"
+    base_port = 52700
+    endpoints = [f"127.0.0.1:{base_port + i}" if nnodes == 1
+                 else f"node{i // nproc}:{base_port + i % nproc}"
+                 for i in range(world)]
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    procs: List[subprocess.Popen] = []
+    logs = []
+    for local_rank in range(nproc):
+        rank = args.rank * nproc + local_rank
+        env = build_env(rank, local_rank, world, endpoints, master)
+        log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
+        logf = open(log_path, "w")
+        logs.append(logf)
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        procs.append(subprocess.Popen(cmd, env=env, stdout=logf,
+                                      stderr=subprocess.STDOUT))
+
+    def _terminate(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    code = 0
+    try:
+        while True:
+            done = [p.poll() for p in procs]
+            if any(c is not None and c != 0 for c in done):
+                code = next(c for c in done if c)  # first failure wins
+                _terminate()
+                break
+            if all(c == 0 for c in done):
+                break
+            time.sleep(0.5)
+    finally:
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs:
+            f.close()
+    if code:
+        sys.stderr.write(
+            f"launch: a worker failed with exit code {code}; logs in "
+            f"{args.log_dir}/workerlog.*\n")
+    return code

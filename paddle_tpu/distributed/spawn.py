@@ -1,0 +1,50 @@
+"""dist.spawn — multiprocess helper.
+
+Analog of python/paddle/distributed/spawn.py:463.  Each child gets the
+launcher env contract; on TPU this is a CPU/debug path (a real pod uses one
+process per host via paddle_tpu.distributed.launch).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Optional, Sequence
+
+from .launch.main import build_env
+
+
+def _worker(fn, rank, nprocs, env, args):
+    os.environ.update(env)
+    fn(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    ctx = mp.get_context("spawn")
+    master = "127.0.0.1:49179"
+    endpoints = [f"127.0.0.1:{52800 + i}" for i in range(nprocs)]
+    procs = []
+    for rank in range(nprocs):
+        env = build_env(rank, rank, nprocs, endpoints, master)
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, env, tuple(args)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class Context:
+        def __init__(self, processes):
+            self.processes = processes
+
+        def join(self, timeout=None):
+            for p in self.processes:
+                p.join(timeout)
+            codes = [p.exitcode for p in self.processes]
+            if any(c not in (0, None) for c in codes):
+                raise RuntimeError(f"spawned processes failed: {codes}")
+
+    c = Context(procs)
+    if join:
+        c.join()
+    return c
