@@ -31,7 +31,34 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
 
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(os.path.join(path, "state"))
+
+    def _restore_args(dst):
+        """Destination shardings → tensorstore reads only the byte ranges
+        each host's shards need, restoring directly into the sharded
+        layout (no full-array materialization per process)."""
+        out = {}
+        for k, v in dst.items():
+            if isinstance(v, Tensor):
+                sharding = getattr(v._value, "sharding", None)
+                if sharding is not None:
+                    out[k] = ocp.ArrayRestoreArgs(sharding=sharding,
+                                                  dtype=v._value.dtype)
+                else:
+                    out[k] = ocp.RestoreArgs()
+            elif isinstance(v, dict):
+                out[k] = _restore_args(v)
+            else:
+                out[k] = ocp.RestoreArgs()
+        return out
+
+    try:
+        restored = ckptr.restore(os.path.join(path, "state"),
+                                 restore_args=_restore_args(state_dict))
+    except (ValueError, KeyError):
+        # structure mismatch between destination and checkpoint (e.g.
+        # loading a subset) — fall back to an unconstrained restore and
+        # reshard below via device_put
+        restored = ckptr.restore(os.path.join(path, "state"))
 
     def _apply(dst: Dict[str, Any], src: Dict[str, Any], prefix=""):
         for k, v in dst.items():
@@ -39,8 +66,12 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
                 raise KeyError(f"checkpoint missing key {prefix + k!r}")
             s = src[k]
             if isinstance(v, Tensor):
-                val = jax.numpy.asarray(s).astype(v.dtype)
                 sharding = getattr(v._value, "sharding", None)
+                if (isinstance(s, jax.Array) and sharding is not None
+                        and s.sharding == sharding and s.dtype == v.dtype):
+                    v.set_value(s)  # already restored into place
+                    continue
+                val = jax.numpy.asarray(s).astype(v.dtype)
                 if sharding is not None:
                     val = jax.device_put(val, sharding)  # reshard-on-load
                 v.set_value(val)

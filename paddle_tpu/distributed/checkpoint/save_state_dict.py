@@ -14,6 +14,7 @@ hosts, and supports async commit.  This wrapper adapts the reference API
 
 from __future__ import annotations
 
+import atexit
 import os
 import threading
 from typing import Any, Dict, Optional
@@ -23,7 +24,13 @@ import jax
 from ...core.tensor import Tensor
 
 _async_lock = threading.Lock()
-_pending = []
+_pending: Dict[str, threading.Thread] = {}  # path -> in-flight save
+_path_locks: Dict[str, threading.Lock] = {}  # path -> writer serializer
+
+
+def _path_lock(path: str) -> threading.Lock:
+    with _async_lock:
+        return _path_locks.setdefault(path, threading.Lock())
 
 
 def _to_arrays(state_dict: Dict[str, Any]) -> Dict[str, Any]:
@@ -47,7 +54,10 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
 
     Sharded (DTensor) values are written shard-wise with their placements
     recorded; replicated values are written once.  ``async_save=True``
-    returns after dispatch; call ``wait_save()`` (or save again) to join.
+    returns after dispatch; call ``wait_save()`` to join.  Consecutive
+    saves to the SAME path are serialized: a new save (sync or async)
+    first joins any in-flight async save of that path, so two writers
+    never race on one Orbax directory.
     """
     import orbax.checkpoint as ocp
 
@@ -60,19 +70,32 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     def _do():
         ckptr.save(os.path.join(path, "state"), tree, force=True)
 
-    if async_save:
-        t = threading.Thread(target=_do, daemon=True)
+    # per-path lock: concurrent save_state_dict callers to the same path
+    # are fully serialized (pop + join + dispatch is atomic per path)
+    with _path_lock(path):
         with _async_lock:
-            _pending.append(t)
-        t.start()
-    else:
-        wait_save()
-        _do()
+            prior = _pending.pop(path, None)
+        if prior is not None:
+            prior.join()
+
+        if async_save:
+            t = threading.Thread(target=_do, daemon=True)
+            with _async_lock:
+                _pending[path] = t
+            t.start()
+        else:
+            _do()
 
 
 def wait_save() -> None:
     """Join outstanding async saves (reference: the task-queue flush)."""
     with _async_lock:
-        pending, _pending[:] = _pending[:], []
+        pending = list(_pending.values())
+        _pending.clear()
     for t in pending:
         t.join()
+
+
+# async save threads are daemons; flush them at interpreter exit so a
+# dispatched checkpoint is never killed mid-write
+atexit.register(wait_save)

@@ -319,6 +319,15 @@ def alltoall(out_tensor_list, in_tensor_list, group: Group = None, sync_op: bool
 
 def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Group = None,
             sync_op: bool = True):
+    """Scatter ``tensor_list`` across the group; shard r receives
+    ``tensor_list[r]``.
+
+    Note on ``src``: under single-controller DTensor semantics every rank
+    sees the SAME replicated ``tensor_list``, so — unlike the reference's
+    multi-controller API where only rank ``src``'s list is meaningful —
+    ``src`` does not select between per-rank-distinct inputs and is
+    accepted only for API parity.
+    """
     g = _group_of(group)
     if tensor_list:
         vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in tensor_list]

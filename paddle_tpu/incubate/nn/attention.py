@@ -15,6 +15,7 @@ import jax
 from ...ops.registry import dispatch
 
 _PALLAS_OK = None
+_WARNED_FALLBACK = False
 
 
 def _pallas_available() -> bool:
@@ -31,12 +32,28 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
     natively; the XLA fallback repeats kv heads."""
     if _pallas_available() and attn_mask is None and dropout == 0.0:
         try:
-            from ...ops.pallas.flash_attention import flash_attention_op
+            from ...ops.pallas.flash_attention import (FlashUnsupportedError,
+                                                       flash_attention_op)
 
             return dispatch("pallas_flash_attention", query, key, value,
                             causal=causal, scale=scale)
-        except Exception:
+        except (ImportError, FlashUnsupportedError):
+            # expected unsupported cases (e.g. causal sq != sk decode
+            # shapes) — the XLA path handles them
             pass
+        except Exception:
+            # a real kernel regression must not silently become a ~12x
+            # slowdown: warn once, then fall back
+            global _WARNED_FALLBACK
+            if not _WARNED_FALLBACK:
+                _WARNED_FALLBACK = True
+                import logging
+                import traceback
+
+                logging.getLogger(__name__).warning(
+                    "Pallas flash attention failed unexpectedly; falling "
+                    "back to the XLA softmax path:\n%s",
+                    traceback.format_exc())
     rep = query.shape[2] // key.shape[2]
     if rep > 1:
         from ...ops.manip import repeat_interleave

@@ -373,14 +373,22 @@ def _flash(q, k, v, causal, scale, interpret):
     return out
 
 
+class FlashUnsupportedError(ValueError):
+    """Shape/config outside the kernel's supported envelope — callers may
+    fall back to the XLA path.  A distinct type so routing code does not
+    conflate these expected cases with real Pallas lowering failures."""
+
+
 def _flash_fwd(q, k, v, causal, scale, interpret):
     b, sq, h, d = q.shape
     sk, kvh = k.shape[1], k.shape[2]
     if h % kvh != 0:
-        raise ValueError(f"q heads {h} not a multiple of kv heads {kvh}")
+        raise FlashUnsupportedError(
+            f"q heads {h} not a multiple of kv heads {kvh}")
     if causal and sq != sk:
-        raise ValueError("causal flash kernel assumes sq == sk (training "
-                         "self-attention); decode uses the cached path")
+        raise FlashUnsupportedError(
+            "causal flash kernel assumes sq == sk (training "
+            "self-attention); decode uses the cached path")
     of, lse = _flash_forward(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
                              h=h, kvh=kvh, interpret=interpret)
     return _from_bh(of, b, h), (q, k, v, _from_bh(of, b, h), lse)

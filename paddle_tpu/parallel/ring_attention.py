@@ -7,13 +7,21 @@ attention for sequences sharded over a mesh axis, k/v blocks rotating the
 ring via collective_permute (ICI neighbour hops) while each hop's compute
 runs the Pallas flash kernel — communication hidden behind the flash tiles.
 
-Algorithm (per device, inside shard_map over ``axis``):
+Forward algorithm (per device, inside shard_map over ``axis``):
   local q block stays; k/v blocks make P-1 ring hops.  Each hop computes
   (o_i, lse_i) for the visiting block — causal structure decided by
   (my_rank, src_rank): src < me full block, src == me causal, src > me
   skipped — then merges online:  m' = max(m, lse_i),
   acc' = acc*e^{m-m'} + o_i*l_i*e^{lse_i-m'}, l' likewise.  Final
   o = acc / l.  This is blockwise-exact (same math as flash across blocks).
+
+Backward is a second ring pass (custom_vjp): the forward saves the fully
+merged output o and GLOBAL row logsumexp.  Each hop re-runs the tiled
+Pallas flash backward on (q_local, k_src, v_src) with the global lse, which
+yields that hop's exact contribution to dq (accumulated locally) and to
+dk/dv of the VISITING block.  dk/dv accumulators travel the ring with
+their k/v blocks, so after P hops every device holds the complete gradient
+for its own block — the standard ring-attention backward schedule.
 """
 
 from __future__ import annotations
@@ -27,45 +35,46 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _interpret():
+    return jax.default_backend() == "cpu"
+
+
+def _varying(x, axis):
+    """Pre-cast axis-invariant constants to device-varying so shard_map's
+    vma typing accepts them as loop carries."""
+    try:
+        return lax.pcast(x, (axis,), to="varying")
+    except AttributeError:
+        return x
+
+
 def _local_flash(q, k, v, causal, scale):
     """Per-block flash on [b, s, h, d]; returns (o, lse[b,h,s])."""
-    from ..ops.pallas.flash_attention import (_flash_forward, _to_bh,
-                                              _attn_reference)
+    from ..ops.pallas.flash_attention import _flash_forward, _to_bh
 
     b, sq, h, d = q.shape
     kvh = k.shape[2]
-    interpret = jax.default_backend() == "cpu"
     of, lse = _flash_forward(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
-                             h=h, kvh=kvh, interpret=interpret)
+                             h=h, kvh=kvh, interpret=_interpret())
     o = of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     return o.astype(jnp.float32), lse[:, 0, :].reshape(b, h, sq)
 
 
-def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = True,
-                         scale: Optional[float] = None):
-    """Exact attention for seq-sharded q,k,v inside a shard_map body.
+def _hop_branch(src, me):
+    """0 = full block (src < me), 1 = diagonal causal (src == me),
+    2 = skip (src > me, all keys in the future)."""
+    return (src == me).astype(jnp.int32) + (src > me).astype(jnp.int32) * 2
 
-    q: [b, s_local, h, d]; k,v: [b, s_local, kvh, d], all sharded on dim 1
-    over ``axis``.  Returns [b, s_local, h, d] (same sharding).
-    """
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
+
+def _ring_forward_loop(q, k, v, axis, causal, scale):
+    """Returns (o [b,s,h,d] float32, lse_global [b,h,s,1] float32)."""
     p = lax.axis_size(axis)
     me = lax.axis_index(axis)
     b, sl, h, d = q.shape
 
-    def _varying(x):
-        # initial carries are constants (axis-invariant in jax's vma
-        # typing); the loop makes them device-varying — pre-cast so the
-        # scan carry types match
-        try:
-            return lax.pcast(x, (axis,), to="varying")
-        except AttributeError:
-            return x
-
-    m = _varying(jnp.full((b, h, sl, 1), -jnp.inf, dtype=jnp.float32))
-    l = _varying(jnp.zeros((b, h, sl, 1), dtype=jnp.float32))
-    acc = _varying(jnp.zeros((b, sl, h, d), dtype=jnp.float32))
+    m = _varying(jnp.full((b, h, sl, 1), -jnp.inf, dtype=jnp.float32), axis)
+    l = _varying(jnp.zeros((b, h, sl, 1), dtype=jnp.float32), axis)
+    acc = _varying(jnp.zeros((b, sl, h, d), dtype=jnp.float32), axis)
     perm = [(i, (i + 1) % p) for i in range(p)]  # send k/v to the right
 
     def merge(carry, block_kv, src):
@@ -85,9 +94,8 @@ def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = True,
                         jnp.full((b, h, sl, 1), -jnp.inf, jnp.float32))
 
             # one branch executes per hop (lax.switch, not where-over-both)
-            branch = (src == me).astype(jnp.int32) + \
-                     (src > me).astype(jnp.int32) * 2
-            o_i, lse_i = lax.switch(branch, [attend(False), attend(True), skip])
+            o_i, lse_i = lax.switch(_hop_branch(src, me),
+                                    [attend(False), attend(True), skip])
         else:
             o_i, lse_i = attend(False)()
 
@@ -113,5 +121,100 @@ def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = True,
         return m_, l_, acc_, kb, vb
 
     m, l, acc, _, _ = lax.fori_loop(0, p, body, (m, l, acc, k, v))
-    l = jnp.where(l == 0.0, 1.0, l)
-    return (acc / l.transpose(0, 2, 1, 3)).astype(q.dtype)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = acc / l_safe.transpose(0, 2, 1, 3)
+    # global logsumexp of each row (backward residual): lse = m + log(l)
+    lse = jnp.where(l > 0.0, m + jnp.log(l_safe), -jnp.inf)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis, causal, scale):
+    o, _ = _ring_forward_loop(q, k, v, axis, causal, scale)
+    return o.astype(q.dtype)
+
+
+def _ring_fwd(q, k, v, axis, causal, scale):
+    o, lse = _ring_forward_loop(q, k, v, axis, causal, scale)
+    return o.astype(q.dtype), (q, k, v, o.astype(q.dtype), lse)
+
+
+def _ring_bwd(axis, causal, scale, res, g):
+    from ..ops.pallas.flash_attention import (_flash_backward, _from_bh,
+                                              _to_bh)
+
+    q, k, v, o, lse = res
+    p = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, sl, h, d = q.shape
+    kvh = k.shape[2]
+    interpret = _interpret()
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    # the Pallas backward consumes lse as [b*h, 8, s] float32 (sublane-
+    # replicated rows); broadcasting the global lse here makes each hop's
+    # recomputed p_ij the TRUE global softmax prob, so per-hop dq/dk/dv
+    # are exact contributions that sum to the full gradient.
+    lse8 = jnp.broadcast_to(
+        lse[:, :, :, 0].reshape(b * h, 1, sl), (b * h, 8, sl))
+    qf, of, gf = _to_bh(q), _to_bh(o), _to_bh(g.astype(o.dtype))
+
+    def hop_grads(kb, vb, causal_flag):
+        def f():
+            dq_i, dk_i, dv_i = _flash_backward(
+                qf, _to_bh(kb), _to_bh(vb), of, lse8, gf,
+                causal_flag, scale, h=h, kvh=kvh, interpret=interpret)
+            return (_from_bh(dq_i, b, h).astype(jnp.float32),
+                    _from_bh(dk_i, b, kvh).astype(jnp.float32),
+                    _from_bh(dv_i, b, kvh).astype(jnp.float32))
+        return f
+
+    def body(i, carry):
+        dq, kb, vb, dkb, dvb = carry
+        src = (me - i) % p
+
+        def skip():
+            return (jnp.zeros((b, sl, h, d), jnp.float32),
+                    jnp.zeros((b, sl, kvh, d), jnp.float32),
+                    jnp.zeros((b, sl, kvh, d), jnp.float32))
+
+        if causal:
+            dq_i, dk_i, dv_i = lax.switch(
+                _hop_branch(src, me),
+                [hop_grads(kb, vb, False), hop_grads(kb, vb, True), skip])
+        else:
+            dq_i, dk_i, dv_i = hop_grads(kb, vb, False)()
+
+        dq = dq + dq_i
+        dkb = dkb + dk_i
+        dvb = dvb + dv_i
+        # dk/dv accumulators travel WITH their k/v block: after p hops
+        # every block is home again carrying all devices' contributions
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        dkb = lax.ppermute(dkb, axis, perm)
+        dvb = lax.ppermute(dvb, axis, perm)
+        return dq, kb, vb, dkb, dvb
+
+    dq0 = _varying(jnp.zeros((b, sl, h, d), jnp.float32), axis)
+    dkv0 = _varying(jnp.zeros((b, sl, kvh, d), jnp.float32), axis)
+    dq, _, _, dk, dv = lax.fori_loop(0, p, body, (dq0, k, v, dkv0, dkv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_flash_attention(q, k, v, axis: str = "sep", causal: bool = True,
+                         scale: Optional[float] = None):
+    """Exact (and exactly differentiable) attention for seq-sharded q,k,v
+    inside a shard_map body.
+
+    q: [b, s_local, h, d]; k,v: [b, s_local, kvh, d], all sharded on dim 1
+    over ``axis``.  Returns [b, s_local, h, d] (same sharding).  Supports
+    ``jax.grad`` through it — the backward runs a reverse ring schedule
+    reusing the tiled Pallas flash backward per hop.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _ring_flash(q, k, v, axis, bool(causal), float(scale))
