@@ -23,14 +23,13 @@ import jax
 
 from ...core.tensor import Tensor
 
-_async_lock = threading.Lock()
-_pending: Dict[str, threading.Thread] = {}  # path -> in-flight save
-_path_locks: Dict[str, threading.Lock] = {}  # path -> writer serializer
-
-
-def _path_lock(path: str) -> threading.Lock:
-    with _async_lock:
-        return _path_locks.setdefault(path, threading.Lock())
+# one condition variable guards the in-flight table; writers to a path wait
+# until no save for that path is in flight, then claim the slot.  Entries
+# are removed on completion, so the table stays bounded (per-step
+# checkpoint dirs don't leak), and nothing ever join()s a thread — waiters
+# sleep on the condition instead (no unstarted-thread join race).
+_cv = threading.Condition()
+_inflight: Dict[str, object] = {}  # path -> claim token / running marker
 
 
 def _to_arrays(state_dict: Dict[str, Any]) -> Dict[str, Any]:
@@ -70,30 +69,31 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     def _do():
         ckptr.save(os.path.join(path, "state"), tree, force=True)
 
-    # per-path lock: concurrent save_state_dict callers to the same path
-    # are fully serialized (pop + join + dispatch is atomic per path)
-    with _path_lock(path):
-        with _async_lock:
-            prior = _pending.pop(path, None)
-        if prior is not None:
-            prior.join()
+    with _cv:
+        while path in _inflight:
+            _cv.wait()
+        _inflight[path] = object()  # claim the slot before releasing
 
-        if async_save:
-            t = threading.Thread(target=_do, daemon=True)
-            with _async_lock:
-                _pending[path] = t
-            t.start()
-        else:
+    def _run():
+        try:
             _do()
+        finally:
+            with _cv:
+                _inflight.pop(path, None)
+                _cv.notify_all()
+
+    if async_save:
+        threading.Thread(target=_run, daemon=True).start()
+    else:
+        _run()
 
 
 def wait_save() -> None:
-    """Join outstanding async saves (reference: the task-queue flush)."""
-    with _async_lock:
-        pending = list(_pending.values())
-        _pending.clear()
-    for t in pending:
-        t.join()
+    """Block until no async save is in flight (reference: the task-queue
+    flush)."""
+    with _cv:
+        while _inflight:
+            _cv.wait()
 
 
 # async save threads are daemons; flush them at interpreter exit so a

@@ -24,6 +24,7 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional, Tuple
 
@@ -151,7 +152,15 @@ class LlamaModel(Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
-        self.remat = False  # set by build_train_step(remat=True)
+        self.remat = False  # set by build_train_step(remat=...)
+        self.remat_policy = None  # jax.checkpoint policy (None = full remat)
+        # optional NamedSharding pinned onto activations at layer
+        # boundaries (set by build_train_step when a mesh is given):
+        # without it GSPMD propagates the mp-sharded embed weight into a
+        # hidden-sharded activation, then has to fully rematerialize to
+        # reach the batch-sharded layout the loss wants (the round-1
+        # dryrun's "involuntary full rematerialization" warnings)
+        self.act_sharding = None
         self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
                                     for _ in range(cfg.num_hidden_layers)])
@@ -178,25 +187,40 @@ class LlamaModel(Layer):
         # remat only on the functional (jit) path — tape-eager keeps
         # activations anyway, and jax.checkpoint needs pure callees
         use_remat = self.remat and not is_grad_enabled()
+
+        def _pin(t):
+            if self.act_sharding is None:
+                return t
+            return Tensor(jax.lax.with_sharding_constraint(
+                t._value, self.act_sharding))
+
+        x = _pin(x)
         for layer in self.layers:
             if use_remat:
-                x = _remat_layer_call(layer, x, cos, sin)
+                x = _remat_layer_call(layer, x, cos, sin, self.remat_policy)
             else:
                 x = layer(x, cos, sin)
+            x = _pin(x)
         return self.norm(x)
 
 
 def _remat_layer_call(layer: "LlamaDecoderLayer", x: Tensor, cos: Tensor,
-                      sin: Tensor) -> Tensor:
+                      sin: Tensor, policy=None) -> Tensor:
     """Run one decoder layer under jax.checkpoint: activations inside the
     layer are recomputed in backward (the analog of the reference's
-    recompute pass, strategy.recompute / fleet recompute_configs)."""
+    recompute pass, strategy.recompute / fleet recompute_configs).
+
+    ``policy`` selects what to SAVE instead of recompute (e.g.
+    ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable`` keeps
+    matmul outputs and recomputes only the cheap elementwise chain — the
+    usual FLOPs/HBM trade on TPU where recomputing a matmul is 4x the cost
+    of recomputing the silu/norm around it)."""
     from ..autograd import no_grad
 
     state = {k: (t._value if isinstance(t, Tensor) else t)
              for k, t in layer.state_dict().items()}
 
-    @jax.checkpoint
+    @functools.partial(jax.checkpoint, policy=policy)
     def body(state, xv, cosv, sinv):
         with no_grad():
             out = layer.functional_call(state, Tensor(xv), Tensor(cosv),
@@ -234,7 +258,13 @@ class LlamaForCausalLM(Layer):
 
 # param-name suffix → logical placement (fsdp = ZeRO-3 axis, mp = tensor axis)
 LLAMA_SHARDING_PLAN = {
-    "embed_tokens.weight":  P("mp", "sharding"),   # [vocab, hidden]
+    # vocab sharded over BOTH parallel axes, hidden replicated: the lookup
+    # output is then batch-sharded x hidden-replicated — exactly the
+    # layer-boundary activation layout — so GSPMD never has to convert a
+    # hidden-sharded gather result (the round-1 "involuntary full
+    # rematerialization" on the embed path); at-rest memory matches the
+    # old P("mp", "sharding") 2-D plan (same total ways)
+    "embed_tokens.weight":  P(("mp", "sharding"), None),   # [vocab, hidden]
     "q_proj.weight":        P("sharding", "mp"),   # [hidden, heads*d]
     "k_proj.weight":        P("sharding", "mp"),
     "v_proj.weight":        P("sharding", "mp"),
@@ -295,7 +325,8 @@ def apply_llama_sharding(model: Layer, mesh: Mesh,
 
 def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = None,
                      data_axes: Tuple[str, ...] = ("dp", "sharding"),
-                     remat: bool = False, compute_dtype=jnp.bfloat16):
+                     remat: bool = False, remat_policy=None,
+                     compute_dtype=jnp.bfloat16):
     """Build a single donated, jitted train step:
 
         step_fn(params, opt_state, step_no, lr, input_ids, labels)
@@ -306,7 +337,10 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
       (pins GSPMD's layout choice for the loss reduction),
     - ``remat=True`` checkpoints each decoder layer (jax.checkpoint) —
       activations recomputed in backward; the analog of the reference's
-      recompute pass (strategy.recompute),
+      recompute pass (strategy.recompute).  ``remat_policy`` (a
+      jax.checkpoint_policies entry) selects SELECTIVE remat: e.g.
+      ``dots_with_no_batch_dims_saveable`` keeps matmul outputs and only
+      recomputes the elementwise chain,
     - forward/backward math in ``compute_dtype`` (bf16 on the MXU),
       optimizer math fp32 (master weights in Adam state,
       optimizer.py multi_precision).
@@ -327,19 +361,35 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
         # traces lazily, so a build-time flag would leak across steps
         # built with different remat settings (and into eager inference)
         saved_remat = model.model.remat
+        saved_policy = model.model.remat_policy
+        saved_act = model.model.act_sharding
         model.model.remat = remat
+        model.model.remat_policy = remat_policy
+        if batch_sharding is not None:
+            # activations ride the batch axes with hidden replicated
+            # (Megatron convention); pinning every layer boundary keeps
+            # GSPMD from flip-flopping between weight-induced layouts
+            model.model.act_sharding = NamedSharding(
+                mesh, P(batch_sharding.spec[0], None, None))
         try:
             with no_grad():  # tape off: jax.grad provides the gradients
                 logits = model.functional_call(cast, Tensor(input_ids))
         finally:
             model.model.remat = saved_remat
-        lv = logits._value.astype(jnp.float32)
+            model.model.remat_policy = saved_policy
+            model.model.act_sharding = saved_act
+        lv = logits._value
         if batch_sharding is not None:
             lv = jax.lax.with_sharding_constraint(
                 lv, NamedSharding(mesh, P(batch_sharding.spec[0])))
-        logp = jax.nn.log_softmax(lv, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        # streaming CE: lse + label-logit gather, fp32 accumulation over
+        # bf16 logits — never materializes a full fp32 log_softmax copy
+        # ([tokens, vocab] fp32 is >1GB at bench shapes; the cast and the
+        # extra read/write were pure HBM burn)
+        lse = jax.scipy.special.logsumexp(lv.astype(jnp.float32), axis=-1)
+        gold = jnp.take_along_axis(lv, labels[..., None],
+                                   axis=-1)[..., 0].astype(jnp.float32)
+        return (lse - gold).mean()
 
     grad_fn = jax.value_and_grad(loss_fn)
 

@@ -112,15 +112,16 @@ def _ring_forward_loop(q, k, v, axis, causal, scale):
             o_i * beta.transpose(0, 2, 1, 3)
         return m_new, l_new, acc_new
 
-    def body(i, carry):
-        m_, l_, acc_, kb, vb = carry
+    # p is static (mesh axis size), so unroll in Python: XLA overlaps each
+    # hop's ppermute with the previous hop's flash compute, and the final
+    # hop skips the k/v rotation entirely (its result would be discarded)
+    kb, vb = k, v
+    for i in range(p):
         src = (me - i) % p  # after i hops we hold rank (me - i)'s block
-        m_, l_, acc_ = merge((m_, l_, acc_), (kb, vb), src)
-        kb = lax.ppermute(kb, axis, perm)
-        vb = lax.ppermute(vb, axis, perm)
-        return m_, l_, acc_, kb, vb
-
-    m, l, acc, _, _ = lax.fori_loop(0, p, body, (m, l, acc, k, v))
+        m, l, acc = merge((m, l, acc), (kb, vb), src)
+        if i != p - 1:
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o = acc / l_safe.transpose(0, 2, 1, 3)
     # global logsumexp of each row (backward residual): lse = m + log(l)
@@ -169,8 +170,11 @@ def _ring_bwd(axis, causal, scale, res, g):
                     _from_bh(dv_i, b, kvh).astype(jnp.float32))
         return f
 
-    def body(i, carry):
-        dq, kb, vb, dkb, dvb = carry
+    dq = _varying(jnp.zeros((b, sl, h, d), jnp.float32), axis)
+    dkb = _varying(jnp.zeros((b, sl, kvh, d), jnp.float32), axis)
+    dvb = _varying(jnp.zeros((b, sl, kvh, d), jnp.float32), axis)
+    kb, vb = k, v
+    for i in range(p):  # p static: unrolled, final k/v rotation skipped
         src = (me - i) % p
 
         def skip():
@@ -189,17 +193,15 @@ def _ring_bwd(axis, causal, scale, res, g):
         dkb = dkb + dk_i
         dvb = dvb + dv_i
         # dk/dv accumulators travel WITH their k/v block: after p hops
-        # every block is home again carrying all devices' contributions
-        kb = lax.ppermute(kb, axis, perm)
-        vb = lax.ppermute(vb, axis, perm)
+        # (their rotation runs on the last hop too) every block is home
+        # again carrying all devices' contributions; the k/v blocks
+        # themselves are no longer needed after the last compute
+        if i != p - 1:
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
         dkb = lax.ppermute(dkb, axis, perm)
         dvb = lax.ppermute(dvb, axis, perm)
-        return dq, kb, vb, dkb, dvb
-
-    dq0 = _varying(jnp.zeros((b, sl, h, d), jnp.float32), axis)
-    dkv0 = _varying(jnp.zeros((b, sl, kvh, d), jnp.float32), axis)
-    dq, _, _, dk, dv = lax.fori_loop(0, p, body, (dq0, k, v, dkv0, dkv0))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq.astype(q.dtype), dkb.astype(k.dtype), dvb.astype(v.dtype)
 
 
 _ring_flash.defvjp(_ring_fwd, _ring_bwd)
