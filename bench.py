@@ -57,14 +57,18 @@ def main():
                                  multi_precision=True)
     step = build_train_step(model, opt, compute_dtype=compute_dtype)
     params = model.functional_state()
+    opt_state = opt.init_state(params)
     if param_dtype != jnp.float32:
         # bf16 at-rest params: halves param HBM and kills the per-step
         # fp32->bf16 cast; AdamW multi_precision keeps an fp32 master copy
-        # in the optimizer state for update accuracy
+        # in the optimizer state for update accuracy.  Cast AFTER
+        # init_state and seed the masters from the UNROUNDED fp32 values.
+        for k, st in opt_state.items():
+            if jnp.issubdtype(params[k].dtype, jnp.floating):
+                st["master"] = params[k].astype(jnp.float32)
         params = {k: (v.astype(param_dtype)
                       if jnp.issubdtype(v.dtype, jnp.floating) else v)
                   for k, v in params.items()}
-    opt_state = opt.init_state(params)
     ids = np.random.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
     labels = np.random.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
 

@@ -8,27 +8,32 @@ the interleaved variant :1143) plus the P2P layer
 TPU-native design: the reference hand-schedules per-rank send/recv because
 every GPU runs its own process.  Under XLA there are two regimes:
 
-1. **Compiled ring pipeline** (paddle_tpu.distributed.pipelining): stages
-   run inside ONE jitted shard_map over the ``pp`` axis, micro-batch
-   rotation via collective_permute; XLA overlaps the ppermute with compute
-   (the 1F1B steady state falls out of the dataflow).  This is the perf
-   path used by the flagship models.
-2. **This wrapper**: API-parity train_batch/eval_batch with micro-batch
-   splitting and gradient accumulation.  It executes stages in order on
-   the controller (correctness semantics identical to the reference's
-   F-then-B schedule, loss averaged over micro-batches) and defers device-
-   level pipelining to regime 1.
+1. **Compiled schedule** (paddle_tpu.parallel.pipelining +
+   parallel.schedules): ``schedule_mode`` selects a static schedule table
+   — FThenB, 1F1B, interleaved VPP, or zero-bubble ZBH1 — executed inside
+   ONE jitted shard_map over a ``pp`` mesh, one ppermute per direction per
+   tick.  Used whenever the PipelineLayer's stages are structurally
+   uniform (same param tree per stage — the same constraint the stacked
+   [P, ...] layout imposes in every compiled-pipeline system).
+2. **Eager fallback**: micro-batch F-then-B with grad accumulation on the
+   controller (identical math; used for structurally uneven stage
+   partitions).
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import logging
+from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ....core.tensor import Tensor
 from ....ops import registry as _reg
 from .pp_layers import PipelineLayer
+
+logger = logging.getLogger(__name__)
 
 
 class PipelineParallel:
@@ -45,6 +50,8 @@ class PipelineParallel:
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self.schedule_mode = cfg.get("schedule_mode", "1F1B")
         self.total_loss = None
+        self._compiled_cache: Dict[Tuple, Any] = {}
+        self._warned_fallback = False
 
     # Layer passthrough ----------------------------------------------------
     def __call__(self, *a, **k):
@@ -74,6 +81,142 @@ class PipelineParallel:
         return [(Tensor(a), Tensor(b)) for a, b in zip(xs, ys)]
 
     def forward_backward_pipeline(self, data, scaler=None):
+        """Run the selected schedule (reference :547).  ``schedule_mode``
+        in {FThenB, 1F1B, VPP, ZBH1} executes the compiled schedule table
+        when the stage partition is uniform; otherwise the eager F-then-B
+        loop (same math) runs."""
+        compiled = self._compiled_schedule_step(data, scaler)
+        if compiled is not None:
+            self.total_loss = compiled
+            return compiled
+        return self._eager_fthenb(data, scaler)
+
+    # -- compiled path -----------------------------------------------------
+    def _stage_states(self):
+        """Per-global-stage flat state dicts + the Parameter refs behind
+        them; None if stages are structurally uneven."""
+        pl = self._layers
+        n_global = len(pl.segment_parts) - 1
+        states, refs = [], []
+        for s in range(n_global):
+            st, rf = {}, {}
+            for j, layer in enumerate(pl.get_stage_layers(s)):
+                for k, t in layer.state_dict().items():
+                    st[f"{j}.{k}"] = t._value
+                params = dict(layer.named_parameters())
+                for k in params:
+                    rf[f"{j}.{k}"] = params[k]
+            states.append(st)
+            refs.append(rf)
+        sig = {tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in st.items())) for st in states}
+        if len(sig) != 1:
+            return None, None
+        return states, refs
+
+    def _compiled_schedule_step(self, data, scaler):
+        from ....parallel.pipelining import (pipeline_train_step,
+                                             stack_stage_params,
+                                             stack_stage_params_interleaved)
+        from ....parallel.schedules import build_schedule
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        pl = self._layers
+        p = pl.get_num_stages()
+        v = max(1, pl._num_virtual_stages)
+        mode = self.schedule_mode
+        if v > 1 and mode in ("1F1B", "FThenB"):
+            # reference semantics: virtual stages alone select interleaving
+            # (PipelineParallelWithInterleave is chosen by v>1, not by a
+            # mode string) — map to the interleaved table
+            mode = "VPP"
+        if mode not in ("FThenB", "1F1B", "VPP", "ZBH1") or \
+                (mode == "VPP") != (v > 1):
+            return self._fallback(f"schedule_mode {mode!r} with v={v}")
+        if p <= 1 or len(jax.devices()) < p or pl._loss_fn is None:
+            return self._fallback("needs >=p devices and a loss_fn")
+        states, refs = self._stage_states()
+        if states is None:
+            return self._fallback("stage partitions are structurally uneven")
+
+        x, y = data
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        m = self.accumulate_steps
+        if xv.shape[0] % m:
+            return self._fallback(f"batch {xv.shape[0]} % {m} microbatches")
+        xm = xv.reshape((m, xv.shape[0] // m) + xv.shape[1:])
+        ym = yv.reshape((m, yv.shape[0] // m) + yv.shape[1:])
+
+        key = (mode, p, v, m, xm.shape, str(xm.dtype))
+        if key not in self._compiled_cache:
+            sched = build_schedule(mode, p=p, m=m, v=v)
+            template = pl.get_stage_layers(0)
+            loss_ref = pl._loss_fn
+
+            def stage_fn(state, a):
+                from ....autograd import no_grad
+                t = Tensor(a)
+                with no_grad():
+                    for j, layer in enumerate(template):
+                        pre = f"{j}."
+                        sub = {k[len(pre):]: val for k, val in state.items()
+                               if k.startswith(pre)}
+                        t = layer.functional_call(sub, t)
+                return t._value
+
+            def loss_fn(a, yb):
+                from ....autograd import no_grad
+                with no_grad():
+                    out = loss_ref(Tensor(a), Tensor(yb))
+                val = out._value if isinstance(out, Tensor) else out
+                return val.mean() if val.ndim else val
+
+            mesh = Mesh(np.asarray(jax.devices()[:p], dtype=object), ("pp",))
+            leaf_spec = lambda a: P(*(("pp",) + (None,) * (a.ndim - 1)))
+            proto = (stack_stage_params_interleaved(states, p) if v > 1
+                     else stack_stage_params(states))
+            pspec = jax.tree_util.tree_map(leaf_spec, proto)
+
+            def body(sp, xb, yb):
+                return pipeline_train_step(stage_fn, loss_fn, sched, sp,
+                                           xb, yb, axis="pp")
+
+            fn = jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=(pspec, P(None), P(None)),
+                out_specs=(P(), pspec), check_vma=False))
+            self._compiled_cache[key] = fn
+        fn = self._compiled_cache[key]
+
+        stacked = (stack_stage_params_interleaved(states, p) if v > 1
+                   else stack_stage_params(states))
+        loss, grads = fn(stacked, xm, ym)
+
+        # scatter grads back onto the Parameters (accumulate, like the
+        # tape does across micro-batches); scaler parity: step() divides
+        # p.grad by the scale, so pre-multiply
+        factor = scaler._scale if scaler is not None else 1.0
+        order = ([j * p + r for r in range(p) for j in range(v)] if v > 1
+                 else list(range(p * v)))
+        for pos, stage in enumerate(order):
+            for k, param in refs[stage].items():
+                g = grads[k][pos].astype(param._value.dtype) * factor
+                if param._grad is None:
+                    param._grad = Tensor(g)
+                else:
+                    param._grad = Tensor(param._grad._value + g)
+        return Tensor(loss)
+
+    def _fallback(self, why: str):
+        if not self._warned_fallback:
+            self._warned_fallback = True
+            logger.warning(
+                "PipelineParallel: compiled %s schedule unavailable (%s); "
+                "using the eager F-then-B loop", self.schedule_mode, why)
+        return None
+
+    # -- eager fallback ----------------------------------------------------
+    def _eager_fthenb(self, data, scaler=None):
         """F-then-B over micro-batches with grad accumulation
         (reference :547; grads sum across micro-batches, loss averages)."""
         micro = self._split_micro(data)

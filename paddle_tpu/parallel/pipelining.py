@@ -81,3 +81,188 @@ def stack_stage_params(per_stage_params: list) -> Any:
     layout pipeline_apply shards over pp)."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0),
                                   *per_stage_params)
+
+
+def stack_stage_params_interleaved(per_stage_params: list, p: int) -> Any:
+    """Stack per-GLOBAL-stage params for a VPP run: with v chunks per rank,
+    device r holds global stages {r, r+p, ..., r+(v-1)p} (Megatron VPP
+    placement), so the stacked [p*v, ...] leading dim is ordered
+    device-major: position r*v + j holds stage j*p + r.  Sharding dim 0
+    over ``pp`` then gives each device exactly its chunks, in chunk order.
+    """
+    n = len(per_stage_params)
+    assert n % p == 0, f"{n} stages not divisible by {p} ranks"
+    v = n // p
+    order = [j * p + r for r in range(p) for j in range(v)]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([xs[i] for i in order], axis=0),
+        *per_stage_params)
+
+
+# --------------------------------------------------------------------------
+# schedule-explicit compiled train step (1F1B / VPP / zero-bubble / FThenB)
+# --------------------------------------------------------------------------
+
+def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
+                        stage_params: Any, x: jnp.ndarray, y: jnp.ndarray,
+                        axis: str = "pp"):
+    """Run one forward+backward over micro-batches under an explicit
+    pipeline schedule, inside a shard_map body.  Returns (mean_loss,
+    param_grads) where grads match ``stage_params``' layout.
+
+    The TPU translation of the reference's schedule runtimes
+    (fleet/meta_parallel/pipeline_parallel.py:547 1F1B, :1143 interleave,
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62): the
+    schedule is a static table (paddle_tpu.parallel.schedules) and each
+    tick dispatches one op — FWD, BWD (fused dx+dw), BWDX (dx only) or
+    BWDW (dw only) — with exactly one ppermute per direction per tick.
+    Backward recomputes the stage forward from the stashed input (per-op
+    remat; the schedule's memory bound is its ``num_slots``).
+
+    stage_fn(chunk_params, act) -> act             (uniform act shapes)
+    loss_fn(act, y_mb) -> scalar                   (applied at last stage)
+    sched: a ``schedules.Schedule`` for (p, m, v)
+    stage_params: pytree with leading dim v (this device's chunk slice —
+        shard a [p*v, ...] stack over ``axis``; use
+        stack_stage_params_interleaved for v > 1)
+    x, y: [m, ...] micro-batched inputs/targets, replicated.
+    """
+    from .schedules import BWD, BWDW, BWDX, FWD
+
+    p = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    assert p == sched.p, f"schedule built for p={sched.p}, mesh has {p}"
+    m, v = sched.m, sched.v
+    perm_r = [(i, (i + 1) % p) for i in range(p)]
+    perm_l = [(i, (i - 1) % p) for i in range(p)]
+
+    act_shape = x.shape[1:]
+    act_dtype = x.dtype
+
+    kind_t = jnp.asarray(sched.kind)
+    mb_t = jnp.asarray(sched.mb)
+    chunk_t = jnp.asarray(sched.chunk)
+    slot_t = jnp.asarray(sched.slot)
+    frs_t = jnp.asarray(sched.frecv_slot)
+    frm_t = jnp.asarray(sched.frecv_mask)
+    grs_t = jnp.asarray(sched.grecv_slot)
+    grm_t = jnp.asarray(sched.grecv_mask)
+
+    def _varying(z):
+        try:
+            return lax.pcast(z, (axis,), to="varying")
+        except AttributeError:
+            return z
+
+    S = sched.num_slots
+    stash0 = _varying(jnp.zeros((S,) + act_shape, act_dtype))
+    gin0 = _varying(jnp.zeros((S,) + act_shape, act_dtype))
+    fcarry0 = _varying(jnp.zeros(act_shape, act_dtype))
+    bcarry0 = _varying(jnp.zeros(act_shape, act_dtype))
+    gacc0 = jax.tree_util.tree_map(
+        lambda a: _varying(jnp.zeros(a.shape, jnp.float32)), stage_params)
+    loss0 = _varying(jnp.zeros((), jnp.float32))
+
+    is_last = (me == p - 1)      # last GLOBAL stage = chunk v-1 on rank p-1
+    is_first = (me == 0)         # first global stage = chunk 0 on rank 0
+
+    def _chunk_params(ch):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, ch, 0, keepdims=False),
+            stage_params)
+
+    def _upd(buf, val, idx):
+        return lax.dynamic_update_index_in_dim(buf, val.astype(buf.dtype),
+                                               idx, 0)
+
+    def tick(t, carry):
+        stash, gin, fcarry, bcarry, gacc, loss_acc = carry
+
+        # 1) store this tick's arrivals (what last tick's ppermute brought)
+        frs, frm = frs_t[me, t], frm_t[me, t]
+        cur = lax.dynamic_index_in_dim(stash, frs, 0, keepdims=False)
+        stash = _upd(stash, jnp.where(frm == 1, fcarry, cur), frs)
+        grs, grm = grs_t[me, t], grm_t[me, t]
+        curg = lax.dynamic_index_in_dim(gin, grs, 0, keepdims=False)
+        gin = _upd(gin, jnp.where(grm == 1, bcarry, curg), grs)
+
+        k = kind_t[me, t]
+        mb = jnp.maximum(mb_t[me, t], 0)
+        ch = chunk_t[me, t]
+        sl = slot_t[me, t]
+        pc = _chunk_params(ch)
+        xin = lax.dynamic_index_in_dim(x, mb, 0, keepdims=False)
+        yin = lax.dynamic_index_in_dim(y, mb, 0, keepdims=False)
+        stashed = lax.dynamic_index_in_dim(stash, sl, 0, keepdims=False)
+        g_up = lax.dynamic_index_in_dim(gin, sl, 0, keepdims=False)
+
+        zero_act = jnp.zeros(act_shape, act_dtype)
+
+        def _loss_grad(out):
+            """Upstream grad at this op's stage: the loss gradient if this
+            is the last global stage, else the stashed arrival.  Computed
+            unconditionally on every rank — uniform SPMD program; the
+            unused value is dead weight XLA overlaps, not a branch."""
+            l, lvjp = jax.vjp(lambda o: loss_fn(o, yin), out)
+            (gl,) = lvjp(jnp.ones((), l.dtype) / (m))
+            gl = gl.astype(act_dtype)
+            last_here = is_last & (ch == v - 1)
+            return (jnp.where(last_here, gl, g_up),
+                    jnp.where(last_here, l / m, 0.0).astype(jnp.float32))
+
+        def do_noop(stash, gin, gacc, loss_acc):
+            return stash, gin, gacc, loss_acc, zero_act, zero_act
+
+        def do_fwd(stash, gin, gacc, loss_acc):
+            first_here = is_first & (ch == 0)
+            inp = jnp.where(first_here, xin.astype(act_dtype), stashed)
+            stash = _upd(stash, inp, sl)      # stage-0 path stores x[mb]
+            out = stage_fn(pc, inp)
+            return stash, gin, gacc, loss_acc, out.astype(act_dtype), zero_act
+
+        def _accum(gacc, ch, dp):
+            return jax.tree_util.tree_map(
+                lambda acc, d: _upd(
+                    acc,
+                    lax.dynamic_index_in_dim(acc, ch, 0, keepdims=False)
+                    + d.astype(jnp.float32), ch),
+                gacc, dp)
+
+        def do_bwd(stash, gin, gacc, loss_acc):
+            out, vjp = jax.vjp(stage_fn, pc, stashed)
+            g, l = _loss_grad(out)
+            dp, dx = vjp(g)
+            gacc = _accum(gacc, ch, dp)
+            return (stash, gin, gacc, loss_acc + l, zero_act,
+                    dx.astype(act_dtype))
+
+        def do_bwdx(stash, gin, gacc, loss_acc):
+            out, vjpx = jax.vjp(lambda xx: stage_fn(pc, xx), stashed)
+            g, l = _loss_grad(out)
+            (dx,) = vjpx(g)
+            # the loss-grad case (last stage) must persist g for BWDW
+            gin = _upd(gin, g, sl)
+            return (stash, gin, gacc, loss_acc + l, zero_act,
+                    dx.astype(act_dtype))
+
+        def do_bwdw(stash, gin, gacc, loss_acc):
+            _, vjpw = jax.vjp(lambda pp: stage_fn(pp, stashed), pc)
+            (dp,) = vjpw(g_up)
+            gacc = _accum(gacc, ch, dp)
+            return stash, gin, gacc, loss_acc, zero_act, zero_act
+
+        branches = [do_noop] * 5
+        branches[FWD], branches[BWD] = do_fwd, do_bwd
+        branches[BWDX], branches[BWDW] = do_bwdx, do_bwdw
+        stash, gin, gacc, loss_acc, fsend, bsend = lax.switch(
+            k, branches, stash, gin, gacc, loss_acc)
+
+        fcarry = lax.ppermute(fsend, axis, perm_r)
+        bcarry = lax.ppermute(bsend, axis, perm_l)
+        return stash, gin, fcarry, bcarry, gacc, loss_acc
+
+    init = (stash0, gin0, fcarry0, bcarry0, gacc0, loss0)
+    _, _, _, _, gacc, loss_acc = lax.fori_loop(0, sched.ticks, tick, init)
+    # only the last rank accumulated real losses; share it
+    loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), axis)
+    return loss, gacc
