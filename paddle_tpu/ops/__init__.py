@@ -16,3 +16,5 @@ from .creation import (  # noqa: F401
 )
 from . import random  # noqa: F401
 from . import tensor_methods  # noqa: F401
+from . import generated  # noqa: F401  (YAML-schema ops; must come after
+#                          the hand-written modules so they keep their names)

@@ -1,0 +1,17 @@
+"""Ops generated from the YAML schema (paddle_tpu/ops/yaml/ops.yaml).
+
+Import-time codegen: every YAML entry whose name has no hand-written
+kernel becomes (a) a registry entry dispatchable by name and (b) a public
+Tensor-in/Tensor-out function on this module — the analog of the
+reference's generated ``paddle::experimental::*`` API + ``_C_ops``
+bindings (paddle/phi/api/generator/api_gen.py, python_c_gen.py).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .yaml import register_yaml_ops
+
+_fns = register_yaml_ops(sys.modules[__name__])
+__all__ = sorted(_fns)

@@ -1,0 +1,112 @@
+"""YAML op schema: single-source op definitions + generated registration.
+
+TPU-native analog of the reference's op-YAML pipeline (SURVEY §2.2/§2.11):
+``paddle/phi/ops/yaml/ops.yaml`` (464 ops) drives codegen of the C++ API,
+autograd nodes, spmd rules and test skeletons via
+``paddle/phi/api/generator/api_gen.py`` and friends.  Here the same idea
+collapses into import-time generation: ``ops.yaml`` entries carry
+
+  - op:       op name (registry key)
+  - fn:       implementation — a dotted path (``jax.scipy.special.i0``) or
+              a Python lambda expression evaluated in a {jax, jnp, lax,
+              np, optax} namespace
+  - amp:      AMP list membership ('white' casts to bf16 on MXU, 'black'
+              pins fp32) — the reference's amp_lists
+  - nondiff:  op has no differentiable outputs
+  - ref:      forward golden — an expression over the inputs evaluated
+              with {np, scipy, torch} (the OpTest numpy/torch reference)
+  - tests:    generated-test cases (see tests/test_ops_generated.py):
+              input specs, kwargs, grad-check inputs, tolerances
+
+Registration happens on import (``register_yaml_ops``); every generated
+op becomes a Tensor-in/Tensor-out public function in
+``paddle_tpu.ops.generated`` AND a registry entry dispatchable by name —
+exactly the two surfaces the reference generates (Python API + kernel
+registry).  The backward story is structural: every registered op gets
+its VJP from the tape/jax.vjp bridge (ops/registry.py), so the YAML only
+needs to mark the exceptions (``nondiff``), mirroring how the reference's
+``backward:`` entries bind to generated GradNodes.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import yaml
+
+_SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "ops.yaml")
+_schema_cache: Optional[List[Dict[str, Any]]] = None
+
+
+def _eval_namespace():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    ns = {"jax": jax, "jnp": jnp, "lax": lax, "np": np,
+          "functools": functools}
+    try:
+        import optax
+
+        ns["optax"] = optax
+    except ImportError:
+        pass
+    return ns
+
+
+def load_schema() -> List[Dict[str, Any]]:
+    """Parse ops.yaml once; entries are dicts with the fields above."""
+    global _schema_cache
+    if _schema_cache is None:
+        with open(_SCHEMA_PATH) as f:
+            _schema_cache = yaml.safe_load(f) or []
+        seen = set()
+        for e in _schema_cache:
+            assert "op" in e, f"schema entry missing 'op': {e}"
+            assert e["op"] not in seen, f"duplicate op {e['op']!r} in YAML"
+            seen.add(e["op"])
+    return _schema_cache
+
+
+def _resolve_fn(entry: Dict[str, Any]) -> Callable:
+    spec = entry.get("fn")
+    if spec is None:
+        raise ValueError(f"op {entry['op']!r}: YAML entry has no fn")
+    if spec.startswith("lambda"):
+        return eval(spec, _eval_namespace())  # noqa: S307 — our own schema
+    mod, _, attr = spec.rpartition(".")
+    try:
+        # import the module path directly — works even mid-initialization
+        # of a parent package (attribute walking would not)
+        return getattr(importlib.import_module(mod), attr)
+    except ImportError:
+        obj = importlib.import_module(mod.split(".")[0])
+        for part in (mod.split(".")[1:] + [attr]):
+            obj = getattr(obj, part)
+        return obj
+
+
+def register_yaml_ops(target_module=None) -> Dict[str, Callable]:
+    """Register every YAML op not already in the registry; returns
+    {name: public_fn}.  Ops already registered in Python keep their
+    hand-written kernels — the YAML then only contributes schema/tests
+    (the reference equivalently skips codegen for manual kernels)."""
+    from ..registry import all_ops, register
+
+    out: Dict[str, Callable] = {}
+    existing = all_ops()
+    for entry in load_schema():
+        name = entry["op"]
+        if name in existing:
+            continue
+        fn = _resolve_fn(entry)
+        public = register(name, amp=entry.get("amp"),
+                          nondiff=bool(entry.get("nondiff", False)))(fn)
+        out[name] = public
+        if target_module is not None:
+            setattr(target_module, name, public)
+    return out

@@ -1,0 +1,670 @@
+"""Implementations behind YAML-registered ops that need more than a
+lambda.  Referenced from ops.yaml by dotted path; semantics follow the
+reference kernels they mirror (cited per function).  Everything is pure
+JAX — elementwise chains fuse under XLA, windows/patches lower to MXU-
+friendly reduce_window/conv patches, random ops draw from the framework
+generator (paddle_tpu.ops.random) so seeding matches the rest of eager.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _key():
+    from ..random import default_generator
+
+    return default_generator().next_key()
+
+
+# --------------------------------------------------------------------------
+# random sampling (ref: paddle/phi/kernels/gpu/{bernoulli,multinomial,...})
+# --------------------------------------------------------------------------
+
+def bernoulli(x):
+    return jax.random.bernoulli(_key(), x).astype(x.dtype)
+
+
+def poisson(x):
+    return jax.random.poisson(_key(), x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    squeeze = x.ndim == 1
+    logits = jnp.log(jnp.maximum(jnp.atleast_2d(x), 1e-30))
+    if replacement:
+        out = jax.random.categorical(
+            _key(), logits, shape=(int(num_samples),) + logits.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1).astype(jnp.int32)
+    else:
+        # without replacement: Gumbel top-k
+        g = jax.random.gumbel(_key(), logits.shape, logits.dtype)
+        out = jnp.argsort(-(logits + g),
+                          axis=-1)[..., :int(num_samples)].astype(jnp.int32)
+    return out[0] if squeeze else out
+
+
+def randint(low, high=None, shape=(1,), dtype="int32"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(), tuple(shape), int(low), int(high),
+                              dtype=jnp.dtype(dtype))
+
+
+def randperm(n, dtype="int32"):
+    return jax.random.permutation(_key(), int(n)).astype(jnp.dtype(dtype))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0):   # noqa: A002
+    return jax.random.uniform(_key(), tuple(shape), jnp.dtype(dtype),
+                              float(min), float(max))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype="float32"):
+    return mean + std * jax.random.normal(_key(), tuple(shape),
+                                          jnp.dtype(dtype))
+
+
+def standard_gamma(x):
+    return jax.random.gamma(_key(), x).astype(x.dtype)
+
+
+def dirichlet(alpha):
+    return jax.random.dirichlet(_key(), alpha).astype(alpha.dtype)
+
+
+def exponential_(x, lam=1.0):
+    return jax.random.exponential(_key(), x.shape, x.dtype) / lam
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, dtype="float32",
+                              a=-2.0, b=2.0):
+    return mean + std * jax.random.truncated_normal(
+        _key(), float(a), float(b), tuple(shape), jnp.dtype(dtype))
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, is_test=False):
+    if is_test:
+        return jnp.where(x >= 0, x, x * ((lower + upper) / 2))
+    slope = jax.random.uniform(_key(), x.shape, x.dtype, lower, upper)
+    return jnp.where(x >= 0, x, x * slope)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                dtype=y.dtype, axis=axis)
+        y = lax.stop_gradient(onehot - y) + y   # straight-through
+    return y
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    return out.at[..., i + max(-offset, 0), i + max(offset, 0)].set(x)
+
+
+def mode(x, axis=-1, keepdim=False):
+    """Most frequent value along the last axis (ties -> smallest, matching
+    the sorted-scan approach of phi/kernels/cpu/mode_kernel.cc)."""
+    counts = (x[..., :, None] == x[..., None, :]).sum(-1)
+    # prefer smaller values on count ties: scan over sorted candidates
+    order = jnp.argsort(x, axis=-1)
+    sorted_counts = jnp.take_along_axis(counts, order, axis=-1)
+    best = jnp.take_along_axis(order, sorted_counts.argmax(-1)[..., None],
+                               axis=-1)
+    vals = jnp.take_along_axis(x, best, axis=-1)
+    if not keepdim:
+        vals, best = vals[..., 0], best[..., 0]
+    return vals, best.astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# interpolation (ref: paddle/phi/kernels/gpu/interpolate_kernel.cu);
+# jax.image.resize uses half-pixel centers == align_corners=False
+# --------------------------------------------------------------------------
+
+def _resize(x, size, method, scale_factor=None):
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    if size is None:
+        size = tuple(int(round(s * f)) for s, f in
+                     zip(spatial, (scale_factor if isinstance(scale_factor,
+                                   (tuple, list)) else
+                                   (scale_factor,) * len(spatial))))
+    out_shape = (n, c) + tuple(int(s) for s in size)
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def nearest_interp(x, size=None, scale_factor=None):
+    return _resize(x, size, "nearest", scale_factor)
+
+
+def bilinear_interp(x, size=None, scale_factor=None):
+    return _resize(x, size, "linear", scale_factor)
+
+
+def bicubic_interp(x, size=None, scale_factor=None):
+    return _resize(x, size, "cubic", scale_factor)
+
+
+def linear_interp(x, size=None, scale_factor=None):
+    return _resize(x, size, "linear", scale_factor)
+
+
+def trilinear_interp(x, size=None, scale_factor=None):
+    return _resize(x, size, "linear", scale_factor)
+
+
+# --------------------------------------------------------------------------
+# unfold / fold (ref: paddle/phi/kernels/impl/unfold_kernel_impl.h)
+# --------------------------------------------------------------------------
+
+def _quad(v):
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(v)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col: [N, C, H, W] -> [N, C*kh*kw, L]."""
+    kh, kw = _quad(kernel_sizes)
+    sh, sw = _quad(strides)
+    ph, pw = _quad(paddings)
+    dh, dw = _quad(dilations)
+    n, c = x.shape[:2]
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+        rhs_dilation=(dh, dw))          # [N, C*kh*kw, OH, OW]
+    return patches.reshape(n, c * kh * kw, -1)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — the exact adjoint of unfold (overlaps sum), so implement it
+    AS the vjp of unfold (same trick the reference's backward uses)."""
+    oh, ow = _quad(output_sizes)
+    kh, kw = _quad(kernel_sizes)
+    n = x.shape[0]
+    c = x.shape[1] // (kh * kw)
+    ref = jnp.zeros((n, c, oh, ow), x.dtype)
+    _, vjp = jax.vjp(lambda im: unfold(im, kernel_sizes, strides, paddings,
+                                       dilations), ref)
+    (out,) = vjp(x)
+    return out
+
+
+# --------------------------------------------------------------------------
+# pooling with argmax indices (ref: phi/kernels/funcs/pooling.cu MaxPoolWithIndex)
+# --------------------------------------------------------------------------
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    kh, kw = _quad(kernel_size)
+    sh, sw = _quad(stride if stride is not None else kernel_size)
+    ph, pw = _quad(padding)
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)])
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, kh * kw, oh, ow)
+    out = patches.max(axis=2)
+    local = patches.argmax(axis=2)
+    # convert window-local argmax to flat input index (reference layout)
+    wy, wx = local // kw, local % kw
+    oy = jnp.arange(oh)[:, None]
+    ox = jnp.arange(ow)[None, :]
+    iy = oy * sh - ph + wy
+    ix = ox * sw - pw + wx
+    return out, (iy * w + ix).astype(jnp.int32)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0):
+    kh, kw = _quad(kernel_size)
+    sh, sw = _quad(stride if stride is not None else kernel_size)
+    ph, pw = _quad(padding)
+    p = float(norm_type)
+    s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add,
+                          (1, 1, kh, kw), (1, 1, sh, sw),
+                          [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    return s ** (1.0 / p)
+
+
+# --------------------------------------------------------------------------
+# graph message passing (ref: phi/kernels/gpu/send_u_recv_kernel.cu etc.)
+# --------------------------------------------------------------------------
+
+def send_u_recv(x, src_index, dst_index, reduce_op="SUM", out_size=None):
+    n = int(out_size) if out_size else x.shape[0]
+    msg = x[src_index]
+    ops = {"SUM": jax.ops.segment_sum, "MEAN": None,
+           "MAX": jax.ops.segment_max, "MIN": jax.ops.segment_min}
+    if reduce_op.upper() == "MEAN":
+        s = jax.ops.segment_sum(msg, dst_index, n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), x.dtype),
+                                  dst_index, n)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (x.ndim - 1)]
+    out = ops[reduce_op.upper()](msg, dst_index, n)
+    if reduce_op.upper() in ("MAX", "MIN"):
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return out
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="ADD",
+                 reduce_op="SUM", out_size=None):
+    msg = x[src_index]
+    e = y
+    if message_op.upper() == "ADD":
+        msg = msg + e
+    else:
+        msg = msg * e
+    n = int(out_size) if out_size else x.shape[0]
+    if reduce_op.upper() == "SUM":
+        return jax.ops.segment_sum(msg, dst_index, n)
+    out = {"MAX": jax.ops.segment_max,
+           "MIN": jax.ops.segment_min}[reduce_op.upper()](msg, dst_index, n)
+    return jnp.where(jnp.isfinite(out), out, 0.0)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="ADD"):
+    a, b = x[src_index], y[dst_index]
+    return a + b if message_op.upper() == "ADD" else a * b
+
+
+# --------------------------------------------------------------------------
+# sequence / decoding
+# --------------------------------------------------------------------------
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    m = int(maxlen) if maxlen else None
+    if m is None:
+        raise ValueError("sequence_mask requires maxlen under jit "
+                         "(data-dependent shapes don't compile)")
+    return (jnp.arange(m) < x[..., None]).astype(jnp.dtype(dtype))
+
+
+def viterbi_decode(potentials, transition, lengths, include_bos_eos_tag=True):
+    """Batched Viterbi over a linear-chain CRF (ref:
+    phi/kernels/cpu/viterbi_decode_kernel.cc).  potentials [B, T, N],
+    transition [N, N] (+2 rows/cols for bos/eos when tagged)."""
+    b, t, n = potentials.shape
+    if include_bos_eos_tag:
+        bos, eos = n - 2, n - 1
+        start = potentials[:, 0] + transition[bos][None, :]
+    else:
+        start = potentials[:, 0]
+
+    def step(carry, emit_t):
+        score, hist = carry
+        # score [B, N] + transition [N, N] -> best previous tag
+        cand = score[:, :, None] + transition[None, :, :]
+        best = cand.max(axis=1) + emit_t
+        arg = cand.argmax(axis=1)
+        return (best, arg), arg
+
+    (score, _), args = lax.scan(step, (start, jnp.zeros((b, n), jnp.int32)),
+                                jnp.swapaxes(potentials[:, 1:], 0, 1))
+    if include_bos_eos_tag:
+        score = score + transition[:, eos][None, :]
+    last = score.argmax(axis=-1)
+
+    def backtrace(carry, arg_t):
+        tag = carry
+        prev = jnp.take_along_axis(arg_t, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path = lax.scan(backtrace, last, args, reverse=True)
+    path = jnp.concatenate([jnp.swapaxes(path, 0, 1), last[:, None]], axis=1)
+    return score.max(axis=-1), path.astype(jnp.int32)
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry walk (ref: phi/kernels/cpu/gather_tree_kernel.cc).
+    ids/parents: [T, B, beam]."""
+    t = ids.shape[0]
+
+    def step(carry, xs):
+        beam_sel = carry
+        id_t, par_t = xs
+        out = jnp.take_along_axis(id_t, beam_sel, axis=-1)
+        beam_sel = jnp.take_along_axis(par_t, beam_sel, axis=-1)
+        return beam_sel, out
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[-1], dtype=parents.dtype),
+                            ids.shape[1:])
+    _, out = lax.scan(step, init, (ids, parents), reverse=True)
+    return out
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None):
+    """Nucleus sampling (ref: phi/kernels/gpu/top_p_sampling_kernel.cu).
+    x [B, V] probabilities, ps [B] cumulative thresholds."""
+    sorted_p = jnp.sort(x, axis=-1)[:, ::-1]
+    sorted_i = jnp.argsort(-x, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    keep = cum - sorted_p < ps[:, None]
+    filt = jnp.where(keep, sorted_p, 0.0)
+    filt = filt / filt.sum(axis=-1, keepdims=True)
+    choice = jax.random.categorical(_key(), jnp.log(jnp.maximum(filt, 1e-30)))
+    ids = jnp.take_along_axis(sorted_i, choice[:, None], axis=-1)
+    scores = jnp.take_along_axis(x, ids, axis=-1)
+    return scores, ids.astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# metrics
+# --------------------------------------------------------------------------
+
+def accuracy(x, indices, label):
+    """Top-k accuracy given pre-computed top-k ``indices`` [N, k] and
+    labels [N, 1] (ref: phi/kernels/gpu/accuracy_kernel.cu)."""
+    correct = (indices == label).any(axis=-1)
+    num_correct = correct.sum().astype(jnp.int32)
+    total = jnp.asarray(indices.shape[0], jnp.int32)
+    return (num_correct.astype(jnp.float32) / total,
+            num_correct, total)
+
+
+def mean_all(x):
+    return jnp.mean(x)
+
+
+# --------------------------------------------------------------------------
+# optimizer update kernels (ref: phi/kernels/gpu/{sgd,adam,...}_kernel.cu);
+# functional: return the updated values instead of mutating
+# --------------------------------------------------------------------------
+
+def sgd_(param, learning_rate, grad):
+    return param - learning_rate * grad
+
+
+def momentum_(param, grad, velocity, learning_rate, mu=0.9,
+              use_nesterov=False):
+    v = mu * velocity + grad
+    if use_nesterov:
+        upd = grad + mu * v
+    else:
+        upd = v
+    return param - learning_rate * upd, v
+
+
+def adam_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+          learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8):
+    m = beta1 * moment1 + (1 - beta1) * grad
+    v = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    mhat = m / (1 - b1p)
+    vhat = v / (1 - b2p)
+    new_p = param - learning_rate * mhat / (jnp.sqrt(vhat) + epsilon)
+    return new_p, m, v, b1p, b2p
+
+
+def adamw_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+           learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8,
+           weight_decay=0.01):
+    decayed = param * (1 - learning_rate * weight_decay)
+    return adam_(decayed, grad, moment1, moment2, beta1_pow, beta2_pow,
+                 learning_rate, beta1, beta2, epsilon)
+
+
+def adamax_(param, grad, moment, inf_norm, beta1_pow, learning_rate,
+            beta1=0.9, beta2=0.999, epsilon=1e-8):
+    m = beta1 * moment + (1 - beta1) * grad
+    u = jnp.maximum(beta2 * inf_norm, jnp.abs(grad) + epsilon)
+    new_p = param - learning_rate / (1 - beta1_pow) * m / u
+    return new_p, m, u
+
+
+def adagrad_(param, grad, moment, learning_rate, epsilon=1e-6):
+    mo = moment + grad * grad
+    return param - learning_rate * grad / (jnp.sqrt(mo) + epsilon), mo
+
+
+def adadelta_(param, grad, avg_squared_grad, avg_squared_update, rho=0.95,
+              epsilon=1e-6, learning_rate=1.0):
+    g2 = rho * avg_squared_grad + (1 - rho) * grad * grad
+    upd = -jnp.sqrt(avg_squared_update + epsilon) / \
+        jnp.sqrt(g2 + epsilon) * grad
+    u2 = rho * avg_squared_update + (1 - rho) * upd * upd
+    return param + learning_rate * upd, g2, u2
+
+
+def rmsprop_(param, grad, mean_square, moment, learning_rate, rho=0.95,
+             epsilon=1e-10, momentum=0.0):
+    ms = rho * mean_square + (1 - rho) * grad * grad
+    mom = momentum * moment + learning_rate * grad / jnp.sqrt(ms + epsilon)
+    return param - mom, ms, mom
+
+
+def nadam_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+           learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8):
+    m = beta1 * moment1 + (1 - beta1) * grad
+    v = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p, b2p = beta1_pow * beta1, beta2_pow * beta2
+    mhat = beta1 * m / (1 - b1p) + (1 - beta1) * grad / (1 - b1p)
+    vhat = v / (1 - b2p)
+    return (param - learning_rate * mhat / (jnp.sqrt(vhat) + epsilon),
+            m, v, b1p, b2p)
+
+
+def radam_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+           learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8):
+    m = beta1 * moment1 + (1 - beta1) * grad
+    v = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p, b2p = beta1_pow * beta1, beta2_pow * beta2
+    rho_inf = 2.0 / (1 - beta2) - 1
+    t_b2p = b2p
+    rho_t = rho_inf - 2.0 * t_b2p / (1 - t_b2p)
+    mhat = m / (1 - b1p)
+    r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                 / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12))
+    adapt = r * mhat / (jnp.sqrt(v / (1 - t_b2p)) + epsilon)
+    plain = mhat
+    new_p = param - learning_rate * jnp.where(rho_t > 4, adapt, plain)
+    return new_p, m, v, b1p, b2p
+
+
+def asgd_(param, grad, d, y, n, learning_rate):
+    new_d = d - y + grad
+    new_y = grad
+    return param - learning_rate / n * new_d, new_d, new_y
+
+
+def rprop_(param, grad, prev, learning_rate, etas=(0.5, 1.2),
+           sizes=(1e-6, 50.0)):
+    sign = jnp.sign(grad * prev)
+    eta_minus, eta_plus = etas
+    factor = jnp.where(sign > 0, eta_plus, jnp.where(sign < 0, eta_minus, 1.0))
+    lr = jnp.clip(learning_rate * factor, sizes[0], sizes[1])
+    g = jnp.where(sign < 0, 0.0, grad)
+    return param - lr * jnp.sign(g), g, lr
+
+
+def ftrl(param, squared_accum, linear_accum, grad, learning_rate,
+         l1=0.0, l2=0.0, lr_power=-0.5):
+    new_sq = squared_accum + grad * grad
+    sigma = (new_sq ** (-lr_power) - squared_accum ** (-lr_power)) \
+        / learning_rate
+    new_lin = linear_accum + grad - sigma * param
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    denom = new_sq ** (-lr_power) / learning_rate + 2 * l2
+    new_p = pre / denom
+    return new_p, new_sq, new_lin
+
+
+def lamb_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+          learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-6,
+          weight_decay=0.01):
+    m = beta1 * moment1 + (1 - beta1) * grad
+    v = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p, b2p = beta1_pow * beta1, beta2_pow * beta2
+    mhat = m / (1 - b1p)
+    vhat = v / (1 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * param
+    w_norm = jnp.linalg.norm(param.astype(jnp.float32))
+    r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return param - learning_rate * trust * r, m, v, b1p, b2p
+
+
+# --------------------------------------------------------------------------
+# signal (ref: phi/kernels/cpu/{stft,frame,overlap_add}_kernel.cc)
+# --------------------------------------------------------------------------
+
+def frame(x, frame_length, hop_length, axis=-1):
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num)[:, None])
+    out = x[..., idx]                      # [..., num, frame_length]
+    if axis == -1 or axis == x.ndim:
+        out = jnp.swapaxes(out, -1, -2)    # paddle: [..., frame_length, num]
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1):
+    if axis in (-1, x.ndim - 1):
+        xs = jnp.swapaxes(x, -1, -2)       # [..., num, frame_length]
+    else:
+        xs = x
+    num, fl = xs.shape[-2], xs.shape[-1]
+    n = fl + hop_length * (num - 1)
+    ref = jnp.zeros(xs.shape[:-2] + (n,), x.dtype)
+    _, vjp = jax.vjp(lambda sig: jnp.swapaxes(
+        frame(sig, fl, hop_length, axis=-1), -1, -2), ref)
+    (out,) = vjp(xs)
+    return out
+
+
+def stft(x, n_fft, hop_length=None, window=None, center=True,
+         onesided=True):
+    hop = hop_length or n_fft // 4
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode="reflect")
+    fr = frame(x, n_fft, hop, axis=-1)     # [..., n_fft, num]
+    fr = jnp.swapaxes(fr, -1, -2)          # [..., num, n_fft]
+    if window is not None:
+        fr = fr * window
+    spec = jnp.fft.rfft(fr, axis=-1) if onesided else jnp.fft.fft(fr, axis=-1)
+    return jnp.swapaxes(spec, -1, -2)      # [..., freq, num]
+
+
+# --------------------------------------------------------------------------
+# misc structured ops
+# --------------------------------------------------------------------------
+
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    """[N*T, C, H, W] channel time-shift (ref:
+    phi/kernels/gpu/temporal_shift_kernel.cu)."""
+    nt, c, h, w = x.shape
+    t = seg_num
+    n = nt // t
+    xr = x.reshape(n, t, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    fwd = jnp.pad(xr[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    back = jnp.pad(xr[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    keep = xr[:, :, c2:]
+    return jnp.concatenate([fwd, back, keep], axis=2).reshape(nt, c, h, w)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, ignore_value)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)    # [K, N, ...]
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def crop(x, shape=None, offsets=None):
+    shape = tuple(int(s) for s in shape)
+    offsets = tuple(int(o) for o in (offsets or (0,) * x.ndim))
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def pixel_unshuffle(x, downscale_factor):
+    n, c, h, w = x.shape
+    r = downscale_factor
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    return (x.reshape(n, groups, c // groups, h, w)
+            .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w))
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """2-D affine sampling grid (ref: phi/kernels/impl/affine_grid_kernel_impl.h).
+    theta [N, 2, 3], out_shape (N, C, H, W) -> grid [N, H, W, 2]."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def line(num):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, num)
+        step = 2.0 / num
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, num)
+
+    ys, xs = line(h), line(w)
+    gx, gy = jnp.meshgrid(xs, ys)          # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+    return jnp.einsum("hwk,njk->nhwj", base, theta.astype(jnp.float32))
+
+
+def bilinear(x, y, weight, bias=None):
+    """Bilinear form x W y (ref: phi/kernels/impl/bilinear_kernel_impl.h):
+    x [N, d1], y [N, d2], weight [out, d1, d2] -> [N, out]."""
+    out = jnp.einsum("ni,oij,nj->no", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def warpctc(logits, label, logits_length, labels_length, blank=0,
+            norm_by_times=False):
+    """CTC loss via optax (ref: third-party warpctc binding,
+    phi/kernels/impl/warpctc_kernel_impl.h).  logits [T, B, V] ->
+    per-example loss [B]."""
+    import optax
+
+    logprobs = jax.nn.log_softmax(
+        jnp.swapaxes(logits, 0, 1).astype(jnp.float32))  # [B, T, V]
+    t = logprobs.shape[1]
+    lpad = (jnp.arange(t)[None, :] >= logits_length[:, None]).astype(
+        jnp.float32)
+    ln = label.shape[1]
+    ypad = (jnp.arange(ln)[None, :] >= labels_length[:, None]).astype(
+        jnp.float32)
+    return optax.ctc_loss(logprobs, lpad, label, ypad, blank_id=blank)
+
+
+def fused_softmax_mask(x, mask):
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def fused_softmax_mask_upper_triangle(x):
+    s = x.shape[-1]
+    mask = jnp.triu(jnp.full((s, s), -1e9, x.dtype), k=1)
+    return jax.nn.softmax(x + mask, axis=-1)

@@ -1,0 +1,130 @@
+"""NumPy golden references for YAML-generated op tests that need more
+than a one-line expression (the OpTest numpy-reference convention,
+ref test/legacy_test/op_test.py).  Each golden takes the case's numpy
+inputs/kwargs by name and returns the expected output (or the output at
+the case's ``out_index``)."""
+
+import numpy as np
+
+
+def send_u_recv_sum(x, src_index, dst_index, **kw):
+    out = np.zeros_like(x)
+    for s, d in zip(src_index, dst_index):
+        out[d] += x[s]
+    return out
+
+
+def send_ue_recv_add_sum(x, y, src_index, dst_index, **kw):
+    out = np.zeros_like(x)
+    for i, (s, d) in enumerate(zip(src_index, dst_index)):
+        out[d] += x[s] + y[i]
+    return out
+
+
+def mode(x, **kw):
+    vals = []
+    for row in x.reshape(-1, x.shape[-1]):
+        uniq, counts = np.unique(row, return_counts=True)
+        vals.append(uniq[counts.argmax()])
+    return np.asarray(vals, x.dtype).reshape(x.shape[:-1])
+
+
+def viterbi(potentials, transition, lengths, **kw):
+    """Reference Viterbi with bos/eos tags (last two states)."""
+    b, t, n = potentials.shape
+    bos, eos = n - 2, n - 1
+    paths = []
+    for bi in range(b):
+        score = potentials[bi, 0] + transition[bos]
+        hist = []
+        for ti in range(1, t):
+            cand = score[:, None] + transition
+            hist.append(cand.argmax(0))
+            score = cand.max(0) + potentials[bi, ti]
+        score = score + transition[:, eos]
+        tag = int(score.argmax())
+        path = [tag]
+        for h in reversed(hist):
+            tag = int(h[tag])
+            path.append(tag)
+        paths.append(list(reversed(path)))
+    return np.asarray(paths, np.int32)
+
+
+def gather_tree(ids, parents, **kw):
+    t, b, beam = ids.shape
+    out = np.zeros_like(ids)
+    for bi in range(b):
+        for k in range(beam):
+            sel = k
+            for ti in reversed(range(t)):
+                out[ti, bi, k] = ids[ti, bi, sel]
+                sel = parents[ti, bi, sel]
+    return out
+
+
+def accuracy(x, indices, label, **kw):
+    correct = (indices == label).any(axis=-1).sum()
+    return np.float32(correct / indices.shape[0])
+
+
+# ---------------------------------------------------------------- optimizers
+
+def momentum(param, grad, velocity, learning_rate, mu=0.9, **kw):
+    v = mu * velocity + grad
+    return param - learning_rate * v
+
+
+def adam(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+         learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+    m = beta1 * moment1 + (1 - beta1) * grad
+    v = beta2 * moment2 + (1 - beta2) * grad ** 2
+    b1p, b2p = beta1_pow * beta1, beta2_pow * beta2
+    return param - learning_rate * (m / (1 - b1p)) / (
+        np.sqrt(v / (1 - b2p)) + epsilon)
+
+
+def adamw(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+          learning_rate, weight_decay=0.01, **kw):
+    decayed = param * (1 - learning_rate * weight_decay)
+    return adam(decayed, grad, moment1, moment2, beta1_pow, beta2_pow,
+                learning_rate, **kw)
+
+
+def adamax(param, grad, moment, inf_norm, beta1_pow, learning_rate,
+           beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+    m = beta1 * moment + (1 - beta1) * grad
+    u = np.maximum(beta2 * inf_norm, np.abs(grad) + epsilon)
+    return param - learning_rate / (1 - beta1_pow * beta1) * m / u
+
+
+def adagrad(param, grad, moment, learning_rate, epsilon=1e-6, **kw):
+    mo = moment + grad ** 2
+    return param - learning_rate * grad / (np.sqrt(mo) + epsilon)
+
+
+def adadelta(param, grad, avg_squared_grad, avg_squared_update, rho=0.95,
+             epsilon=1e-6, **kw):
+    g2 = rho * avg_squared_grad + (1 - rho) * grad ** 2
+    upd = -np.sqrt(avg_squared_update + epsilon) / np.sqrt(g2 + epsilon) * grad
+    return param + upd
+
+
+def rmsprop(param, grad, mean_square, moment, learning_rate, rho=0.95,
+            epsilon=1e-10, momentum=0.0, **kw):
+    ms = rho * mean_square + (1 - rho) * grad ** 2
+    mom = momentum * moment + learning_rate * grad / np.sqrt(ms + epsilon)
+    return param - mom
+
+
+def lamb(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+         learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-6,
+         weight_decay=0.01, **kw):
+    m = beta1 * moment1 + (1 - beta1) * grad
+    v = beta2 * moment2 + (1 - beta2) * grad ** 2
+    mhat = m / (1 - beta1_pow * beta1)
+    vhat = v / (1 - beta2_pow * beta2)
+    r = mhat / (np.sqrt(vhat) + epsilon) + weight_decay * param
+    wn, rn = np.linalg.norm(param), np.linalg.norm(r)
+    trust = wn / rn if (wn > 0 and rn > 0) else 1.0
+    return param - learning_rate * trust * r
