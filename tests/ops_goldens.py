@@ -95,7 +95,7 @@ def adamax(param, grad, moment, inf_norm, beta1_pow, learning_rate,
            beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
     m = beta1 * moment + (1 - beta1) * grad
     u = np.maximum(beta2 * inf_norm, np.abs(grad) + epsilon)
-    return param - learning_rate / (1 - beta1_pow * beta1) * m / u
+    return param - learning_rate / (1 - beta1_pow) * m / u
 
 
 def adagrad(param, grad, moment, learning_rate, epsilon=1e-6, **kw):

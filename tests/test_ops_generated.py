@@ -123,7 +123,7 @@ def _grad_check(entry, name, inputs, kwargs, gname, out_index=None):
         return np.asarray(o, dtype=np.float64)
 
     base = run_raw(inputs)
-    cot = rng.randn(*base.shape)
+    cot = np.asarray(rng.randn(*base.shape))
 
     # analytic via the tape
     tin = {}
