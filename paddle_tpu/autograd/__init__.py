@@ -26,11 +26,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     ``.grad`` — the reference's GeneralGrad path, fluid/eager/general_grad.h)."""
     from ..core.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager grad) is not supported; "
-            "use the compiled path (paddle_tpu.jit) with jax-level autodiff."
-        )
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
     if not isinstance(inputs, (list, tuple)):
@@ -38,7 +33,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
     if retain_graph is None:
-        retain_graph = False
+        # matching double-grad semantics: creating the grad graph implies
+        # keeping the forward graph alive
+        retain_graph = create_graph
 
     captured = [None] * len(inputs)
 
@@ -73,7 +70,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         # accumulate_to_leaf=False: capture hooks fire but no tensor's .grad
         # is touched (matches the reference's GeneralGrad partial-graph path)
         tape.run_backward(outputs, grad_outputs, retain_graph=retain_graph,
-                          accumulate_to_leaf=False)
+                          accumulate_to_leaf=False, create_graph=create_graph)
     finally:
         for node, hook, _, _ in hooks_installed:
             if hook in node.hooks:
@@ -90,8 +87,82 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             else:
                 results.append(None)
                 continue
-        results.append(Tensor(g, stop_gradient=True))
+        if isinstance(g, Tensor):
+            # create_graph path: keep the tape-connected Tensor so the result
+            # can be differentiated again
+            results.append(g)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
     return results
+
+
+def jacobian(ys, xs, create_graph=False, batch_axis=None):
+    """Dense Jacobian of tensor(s) ``ys`` w.r.t. tensor(s) ``xs``.
+
+    Analog of paddle.autograd.jacobian (python/paddle/autograd/autograd.py);
+    eagerly materialized with shape ``ys.shape + x.shape`` per input (the
+    reference evaluates lazily row-by-row — same math, same row-seeded vjp).
+    """
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..ops import creation as _creation
+    from ..ops import manip as _manip
+
+    if batch_axis is not None:
+        raise NotImplementedError("batch_axis is not supported; vmap the "
+                                  "functional path instead")
+    single_y = not isinstance(ys, (list, tuple))
+    single_x = not isinstance(xs, (list, tuple))
+    ys_l = [ys] if single_y else list(ys)
+    xs_l = [xs] if single_x else list(xs)
+
+    import jax.numpy as jnp
+
+    per_y = []
+    for y in ys_l:
+        y_shape = tuple(y.shape)
+        m = int(np.prod(y_shape)) if y_shape else 1
+        cols = [[] for _ in xs_l]
+        for j in range(m):
+            seed = jnp.zeros((m,), y.dtype).at[j].set(1).reshape(y_shape)
+            gs = grad([y], xs_l, grad_outputs=[Tensor(seed, stop_gradient=True)],
+                      retain_graph=True, create_graph=create_graph,
+                      allow_unused=True)
+            for i, g in enumerate(gs):
+                if g is None:
+                    g = _creation.zeros_like(xs_l[i])
+                cols[i].append(g)
+        outs = []
+        for i, x in enumerate(xs_l):
+            j_t = _manip.stack(cols[i], axis=0)  # (m, *x.shape)
+            j_t = _manip.reshape(j_t, y_shape + tuple(x.shape))
+            outs.append(j_t)
+        per_y.append(outs[0] if single_x else tuple(outs))
+    return per_y[0] if single_y else tuple(per_y)
+
+
+def hessian(ys, xs, batch_axis=None):
+    """Hessian of a scalar ``ys`` w.r.t. ``xs``: shape ``x.shape + x.shape``
+    per input (nested tuple for multiple inputs). Analog of
+    paddle.autograd.hessian; exercises the double-grad (create_graph) path."""
+    if batch_axis is not None:
+        raise NotImplementedError("batch_axis is not supported")
+    if tuple(ys.shape) not in ((), (1,)):
+        raise ValueError("hessian expects a scalar output")
+    single_x = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single_x else list(xs)
+    gs = grad([ys], xs_l, create_graph=True, allow_unused=True)
+    rows = []
+    for i, g in enumerate(gs):
+        if g is None:
+            # input not connected to ys: its Hessian blocks are zero
+            from ..ops import creation as _creation
+
+            g = _creation.zeros_like(xs_l[i])
+        row = jacobian(g, xs_l if not single_x else xs_l[0])
+        rows.append(row)
+    return rows[0] if single_x else tuple(rows)
 
 
 class PyLayerContext:
@@ -171,6 +242,38 @@ class PyLayer:
                 vjp_fn,
                 diff_inputs,
             )
+
+            def apply_with_graph(cot_tensors):
+                # create_graph: run user backward with recording ON so any
+                # framework ops inside it land on the tape. Saved tensors
+                # that were intermediates created inside forward (under
+                # no_grad) are NOT connected to the inputs, so their
+                # second-order contribution is dropped — warn rather than be
+                # silently wrong.
+                import warnings
+
+                if any(isinstance(s, Tensor) and s._grad_edge(create=False)[0] is None
+                       for s in ctx._saved):
+                    warnings.warn(
+                        f"PyLayer {cls.__name__}: double grad treats saved "
+                        "tensors with no tape connection as constants; "
+                        "second-order terms through them are dropped. Save "
+                        "inputs/outputs (not no_grad intermediates) or "
+                        "recompute inside backward for exact higher-order "
+                        "gradients.", stacklevel=2)
+                grads = cls.backward(ctx, *cot_tensors)
+                if not isinstance(grads, (list, tuple)):
+                    grads = (grads,)
+                out, gi = [], 0
+                for a in args:
+                    if isinstance(a, Tensor) and a._requires_grad():
+                        g = grads[gi] if gi < len(grads) else None
+                        gi += 1
+                        out.append(g if (g is None or isinstance(g, Tensor))
+                                   else Tensor(g))
+                return tuple(out)
+
+            node.apply_with_graph = apply_with_graph
             for slot, o in enumerate(out_tensors):
                 o.stop_gradient = False
                 o._set_grad_node(node, slot)

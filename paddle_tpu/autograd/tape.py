@@ -96,6 +96,7 @@ class GradNode:
         "out_dtypes",
         "hooks",
         "released",
+        "apply_with_graph",
     )
 
     def __init__(
@@ -115,6 +116,11 @@ class GradNode:
         self.out_dtypes = out_dtypes
         self.hooks: List[Callable] = []
         self.released = False
+        # Optional create_graph path: re-derives this op's vjp as a *recorded*
+        # computation over Tensors, so the produced gradients are themselves
+        # differentiable (the reference's double-grad kernels,
+        # paddle/fluid/eager double_grad; set by ops/registry.py).
+        self.apply_with_graph: Optional[Callable] = None
 
     def apply(self, grads: Tuple[Any, ...]) -> Tuple[Any, ...]:
         if self.released:
@@ -129,6 +135,7 @@ class GradNode:
 
     def release(self):
         self.vjp_fn = None
+        self.apply_with_graph = None
         self.released = True
 
     def __repr__(self):
@@ -198,6 +205,7 @@ def run_backward(
     grad_tensors: Optional[Sequence[Any]] = None,
     retain_graph: bool = False,
     accumulate_to_leaf: bool = True,
+    create_graph: bool = False,
 ) -> None:
     """Topological reverse walk accumulating gradients into leaf ``.grad``.
 
@@ -205,7 +213,27 @@ def run_backward(
     seed cotangents (defaults to ones, matching the reference's behavior for
     scalar losses). With ``accumulate_to_leaf=False`` leaf hooks still fire
     but ``.grad`` is untouched (the paddle.grad / GeneralGrad path).
+
+    With ``create_graph=True`` cotangents flow as *Tensors* and every node is
+    applied through its ``apply_with_graph`` re-derivation, so produced
+    gradients are tape-connected and can be differentiated again (the
+    reference's double-grad machinery).
     """
+    _T = None
+    if create_graph:
+        from ..core.tensor import Tensor as _T
+
+        def _as_seed(t, g):
+            if g is None:
+                return _T(_ones_like(tuple(t.shape), t.dtype), stop_gradient=True)
+            return g if isinstance(g, _T) else _T(g, stop_gradient=True)
+    else:
+        def _as_seed(t, g):
+            seed = g._value if hasattr(g, "_value") else g
+            if seed is None:
+                seed = _ones_like(tuple(t.shape), t.dtype)
+            return seed
+
     roots: List[Tuple[GradNode, int, Any]] = []
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
@@ -213,10 +241,7 @@ def run_backward(
         node, slot = t._grad_edge(create=False)
         if node is None:
             continue
-        seed = g._value if hasattr(g, "_value") else g
-        if seed is None:
-            seed = _ones_like(tuple(t.shape), t.dtype)
-        roots.append((node, slot, seed))
+        roots.append((node, slot, _as_seed(t, g)))
     if not roots:
         return
 
@@ -278,15 +303,31 @@ def run_backward(
                     queue.append(e.node)
             continue
         # zero-fill missing output cotangents (unconsumed outputs)
-        cotangents = tuple(
-            g if g is not None else jnp.zeros(s, d)
-            for g, s, d in zip(grads_in, node.out_shapes, node.out_dtypes)
-        )
+        if create_graph:
+            cotangents = tuple(
+                g if g is not None else _T(jnp.zeros(s, d), stop_gradient=True)
+                for g, s, d in zip(grads_in, node.out_shapes, node.out_dtypes)
+            )
+        else:
+            cotangents = tuple(
+                g if g is not None else jnp.zeros(s, d)
+                for g, s, d in zip(grads_in, node.out_shapes, node.out_dtypes)
+            )
         for hook in node.hooks:
             out = hook(cotangents)
             if out is not None:
                 cotangents = out
-        in_grads = node.apply(cotangents)
+        if create_graph and node.apply_with_graph is not None:
+            in_grads = node.apply_with_graph(cotangents)
+        elif create_graph:
+            raw = tuple(c._value if isinstance(c, _T) else c for c in cotangents)
+            in_grads = tuple(
+                _T(g, stop_gradient=True) if g is not None and not isinstance(g, _T)
+                else g
+                for g in node.apply(raw)
+            )
+        else:
+            in_grads = node.apply(cotangents)
         if not retain_graph:
             node.release()
         for e, g in zip(node.input_edges, in_grads):

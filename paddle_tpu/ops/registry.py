@@ -141,6 +141,72 @@ def _amp_cast_leaves(op: OpDef, leaves: List[Any]) -> List[Any]:
     return out
 
 
+def _make_apply_with_graph(name: str, pure: Callable, out_treedef,
+                           diff_tensors: Sequence[Tensor]):
+    """Build a node's create_graph re-derivation: vjp of ``pure`` executed as
+    a recorded call over (saved inputs, cotangents), so output gradients are
+    tape-connected and differentiable again."""
+    n_in = len(diff_tensors)
+
+    def apply_with_graph(cot_tensors):
+        def grad_fn(*v):
+            ins = v[:n_in]
+            cots = jax.tree_util.tree_unflatten(out_treedef, list(v[n_in:]))
+            _, vjp = jax.vjp(pure, *ins)
+            return tuple(vjp(cots))
+
+        return record_call(name + "_grad", grad_fn,
+                           list(diff_tensors) + list(cot_tensors))
+
+    return apply_with_graph
+
+
+def record_call(name: str, fn: Callable, tensors: Sequence[Tensor]):
+    """Execute a pure jax function over all-Tensor positional args with tape
+    recording; returns a tuple of Tensors.
+
+    Used for the create_graph (double-grad) path: a node's vjp is itself
+    executed as a recorded call, and the node this produces gets its own
+    ``apply_with_graph``, so third and higher orders compose. Analog of the
+    reference's generated double-grad nodes (paddle/fluid/eager codegen +
+    paddle/fluid/primitive vjp rules)."""
+    diff_idx = [i for i, t in enumerate(tensors) if t._requires_grad()]
+    vals = [t._value for t in tensors]
+    if not _tape.is_grad_enabled() or not diff_idx:
+        out = fn(*vals)
+        return tuple(Tensor(v, stop_gradient=True) for v in out)
+
+    diff_set = set(diff_idx)
+    diff = [tensors[i] for i in diff_idx]
+
+    def pure(*dvals):
+        it = iter(dvals)
+        full = [
+            next(it) if i in diff_set else jax.lax.stop_gradient(vals[i])
+            for i in range(len(vals))
+        ]
+        return fn(*full)
+
+    out, vjp_fn = jax.vjp(pure, *[vals[i] for i in diff_idx])
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+
+    def node_vjp(flat_cots):
+        cots = jax.tree_util.tree_unflatten(out_treedef, list(flat_cots))
+        return vjp_fn(cots)
+
+    node = _tape.record_op(name, out_leaves, node_vjp, diff)
+    node.apply_with_graph = _make_apply_with_graph(name, pure, out_treedef, diff)
+
+    wrapped = []
+    for slot, v in enumerate(out_leaves):
+        t = Tensor(v, stop_gradient=True)
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            t.stop_gradient = False
+            t._set_grad_node(node, slot)
+        wrapped.append(t)
+    return tuple(wrapped)
+
+
 def dispatch(name: str, *args, **kwargs):
     """Execute op ``name`` eagerly with tape recording."""
     op = get_op(name)
@@ -187,6 +253,8 @@ def dispatch(name: str, *args, **kwargs):
         return vjp_fn(cots)
 
     node = _tape.record_op(name, out_leaves, node_vjp, diff_tensors)
+    node.apply_with_graph = _make_apply_with_graph(name, pure, out_treedef,
+                                                   diff_tensors)
     return _wrap_outputs(op, out, recorded=True, node=node)
 
 

@@ -193,3 +193,139 @@ def test_conv_grad():
     gx, gw = jax.grad(ref, argnums=(0, 1))(x._value, w._value)
     np.testing.assert_allclose(np.asarray(x.grad._value), np.asarray(gx), rtol=1e-4)
     np.testing.assert_allclose(np.asarray(w.grad._value), np.asarray(gw), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Higher-order autograd (double grad / jacobian / hessian) — analog of the
+# reference's double-grad kernels and paddle.autograd.jacobian/hessian
+# (python/paddle/autograd/autograd.py, test/autograd/).
+# ---------------------------------------------------------------------------
+
+
+def test_grad_of_grad_cubic():
+    x = _leaf((5,))
+    y = (x ** 3).sum()
+    (g,) = paddle.autograd.grad(y, x, create_graph=True)
+    assert not g.stop_gradient
+    (gg,) = paddle.autograd.grad(g.sum(), x)
+    np.testing.assert_allclose(np.asarray(gg._value), 6 * x.numpy(), rtol=1e-5)
+
+
+def test_grad_of_grad_mixed_inputs():
+    x = _leaf((4,))
+    w = _leaf((4,), seed=3)
+    y = (x * x * w).sum()           # dy/dx = 2xw ; d2y/dxdw = 2x
+    (gx,) = paddle.autograd.grad(y, x, create_graph=True)
+    (gxw,) = paddle.autograd.grad(gx.sum(), w)
+    np.testing.assert_allclose(np.asarray(gxw._value), 2 * x.numpy(), rtol=1e-5)
+
+
+def test_third_order_grad():
+    x = _leaf((3,))
+    y = (x ** 4).sum()
+    (g1,) = paddle.autograd.grad(y, x, create_graph=True)
+    (g2,) = paddle.autograd.grad(g1.sum(), x, create_graph=True)
+    (g3,) = paddle.autograd.grad(g2.sum(), x)
+    np.testing.assert_allclose(np.asarray(g3._value), 24 * x.numpy(), rtol=1e-4)
+
+
+def test_jacobian_matches_jax():
+    x = _leaf((3,))
+    A = _leaf((4, 3), seed=2)
+    y = paddle.matmul(A, x)
+    J = paddle.autograd.jacobian(y, x)
+    assert tuple(J.shape) == (4, 3)
+    np.testing.assert_allclose(np.asarray(J._value), np.asarray(A._value),
+                               rtol=1e-5)
+
+
+def test_hessian_quadratic():
+    rng = np.random.RandomState(7)
+    Anp = rng.randn(4, 4).astype("float32")
+    A = paddle.to_tensor(Anp)
+    x = _leaf((4,))
+    y = paddle.matmul(x, paddle.matmul(A, x))  # x^T A x
+    H = paddle.autograd.hessian(y, x)
+    np.testing.assert_allclose(np.asarray(H._value), Anp + Anp.T,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_hessian_matches_jax_mlp():
+    w = _leaf((3, 3), seed=5)
+    x0 = np.random.RandomState(11).randn(3).astype("float32")
+    xc = paddle.to_tensor(x0)
+
+    def f_paddle(wt):
+        h = paddle.tanh(paddle.matmul(wt, xc))
+        return (h * h).sum()
+
+    y = f_paddle(w)
+    H = paddle.autograd.hessian(y, w)
+
+    def f_jax(wv):
+        h = jnp.tanh(wv @ x0)
+        return jnp.sum(h * h)
+
+    H_ref = jax.hessian(f_jax)(w._value)
+    np.testing.assert_allclose(np.asarray(H._value), np.asarray(H_ref),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_gradient_penalty_training_use():
+    # WGAN-GP style double backward: penalty = (|dD/dx| - 1)^2 flows into
+    # parameter gradients.
+    w = _leaf((4, 4), seed=9)
+    x = _leaf((4,), seed=10)
+    out = paddle.matmul(x, paddle.matmul(w, x)).sum()
+    (gx,) = paddle.autograd.grad(out, x, create_graph=True)
+    penalty = ((gx * gx).sum() - 1.0) ** 2
+    penalty.backward()
+    assert w.grad is not None
+    g_ref = jax.grad(
+        lambda wv: (jnp.sum(jax.grad(
+            lambda xv: xv @ (wv @ xv))(x._value) ** 2) - 1.0) ** 2
+    )(w._value)
+    np.testing.assert_allclose(np.asarray(w.grad._value), np.asarray(g_ref),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_hessian_unused_input_zero_block():
+    x = _leaf((3,))
+    z = _leaf((2,), seed=1)
+    H = paddle.autograd.hessian((x * x).sum(), [x, z])
+    np.testing.assert_allclose(np.asarray(H[0][0]._value),
+                               2 * np.eye(3, dtype="float32"))
+    np.testing.assert_allclose(np.asarray(H[1][1]._value), 0)
+
+
+def test_jacobian_multiple_ys():
+    x = _leaf((3,))
+    J = paddle.autograd.jacobian([x * x, x * 3.0], x)
+    np.testing.assert_allclose(np.asarray(J[0]._value),
+                               np.diag(2 * x.numpy()), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(J[1]._value),
+                               3 * np.eye(3, dtype="float32"), rtol=1e-5)
+
+
+def test_pylayer_double_grad_warns_on_disconnected_saved():
+    import warnings
+
+    class Cube(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, t):
+            s = t * t            # intermediate under no_grad: disconnected
+            ctx.save_for_backward(s)
+            return t * s
+
+        @staticmethod
+        def backward(ctx, dy):
+            (s,) = ctx.saved_tensor
+            return dy * 3.0 * s
+
+    t = _leaf((2,))
+    y = Cube.apply(t)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        (g,) = paddle.autograd.grad(y.sum(), t, create_graph=True)
+        paddle.autograd.grad(g.sum(), t)
+    assert any("double grad" in str(x.message) for x in w)
