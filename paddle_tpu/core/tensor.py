@@ -170,12 +170,19 @@ class Tensor:
         node, slot = self._grad_edge()
         if node is None:
             raise RuntimeError("cannot register hook on a tensor with stop_gradient=True")
+        # Cotangents arrive as raw arrays (first-order backward) or as
+        # tape-connected Tensors (create_graph) — hand the user a Tensor
+        # either way, and keep the slot's kind so double-grad connectivity
+        # survives hook transformation.
         if isinstance(node, _tape.AccumulateNode):
 
             def _leaf_hook(g):
-                out = hook(Tensor(g))
+                is_t = isinstance(g, Tensor)
+                out = hook(g if is_t else Tensor(g))
                 if out is None:
                     return None
+                if is_t:
+                    return out if isinstance(out, Tensor) else Tensor(out)
                 return out._value if isinstance(out, Tensor) else out
 
             node.hooks.append(_leaf_hook)
@@ -185,11 +192,15 @@ class Tensor:
             g = cotangents[slot]
             if g is None:
                 return None
-            out = hook(Tensor(g))
+            is_t = isinstance(g, Tensor)
+            out = hook(g if is_t else Tensor(g))
             if out is None:
                 return None
             lst = list(cotangents)
-            lst[slot] = out._value if isinstance(out, Tensor) else out
+            if is_t:
+                lst[slot] = out if isinstance(out, Tensor) else Tensor(out)
+            else:
+                lst[slot] = out._value if isinstance(out, Tensor) else out
             return tuple(lst)
 
         node.hooks.append(_hook)

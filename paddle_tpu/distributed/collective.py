@@ -39,7 +39,22 @@ from ..core.tensor import Tensor
 from .functional import ReduceOp
 from .placements import Replicate, Shard
 from .process_mesh import ProcessMesh
+from .watchdog import comm_watch
 from . import topology as topo_mod
+
+
+def _watched(fn):
+    """Run a collective under the comm watchdog (CommTask analog,
+    paddle/phi/core/distributed/comm_task.h:36): if the call blocks past
+    FLAGS_comm_timeout_s the watchdog thread records + reports it."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with comm_watch(fn.__name__):
+            return fn(*args, **kwargs)
+
+    return wrapper
 
 
 class Group:
@@ -143,6 +158,7 @@ def _axis_partial(t: Tensor, g: Group):
     return [p for p in getattr(t, "_partial_axes", ()) if p[0] == g.axis]
 
 
+@_watched
 def all_reduce(tensor, op: str = ReduceOp.SUM, group: Group = None,
                sync_op: bool = True):
     """AllReduce across the group axis. Pending-Partial tensors are reduced;
@@ -211,6 +227,7 @@ def _sharded_dim(spec: PartitionSpec, axis: str) -> int:
     raise ValueError(f"axis {axis} not in spec {spec}")
 
 
+@_watched
 def all_gather(tensor_list: Optional[List], tensor: Tensor, group: Group = None,
                sync_op: bool = True):
     """AllGather: given a tensor Shard()ed over the group axis, materialise
@@ -233,6 +250,7 @@ def all_gather(tensor_list: Optional[List], tensor: Tensor, group: Group = None,
     return Tensor(jnp.concatenate([t._value] * g.nranks, axis=0))
 
 
+@_watched
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: str = ReduceOp.SUM,
                    group: Group = None, sync_op: bool = True):
     """ReduceScatter: reduce a pending-Partial (or replicated) tensor across
@@ -254,6 +272,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: str = ReduceOp.SUM
     return tensor
 
 
+@_watched
 def broadcast(tensor: Tensor, src: int = 0, group: Group = None, sync_op: bool = True):
     """Broadcast: every rank's local value becomes rank ``src``'s.  For a
     tensor Shard()ed over the group axis (per-rank-distinct values), each
@@ -282,6 +301,7 @@ def _spec_without(spec: PartitionSpec, axis: str) -> PartitionSpec:
     return PartitionSpec(*entries)
 
 
+@_watched
 def alltoall(out_tensor_list, in_tensor_list, group: Group = None, sync_op: bool = True):
     """AllToAll on explicit per-rank lists (reference list API): rank r
     sends in[j] to rank j and receives rank j's in[r] into out[j].
@@ -317,6 +337,7 @@ def alltoall(out_tensor_list, in_tensor_list, group: Group = None, sync_op: bool
     return out_tensor_list
 
 
+@_watched
 def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Group = None,
             sync_op: bool = True):
     """Scatter ``tensor_list`` across the group; shard r receives
@@ -338,6 +359,7 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Group = None,
     return tensor
 
 
+@_watched
 def barrier(group: Group = None):
     jax.effects_barrier()
     return None

@@ -1,0 +1,137 @@
+"""Elastic / fault-tolerance manager.
+
+Analog of the reference's ElasticManager
+(python/paddle/distributed/fleet/elastic/manager.py:125) and the launch
+watcher (launch/controllers/watcher.py). The reference watches ETCD for node
+join/leave and relaunches with new ranks; the TPU-native equivalent keeps
+the same decision core — gang liveness + restart budget + optional
+heartbeats — while membership itself is owned by the jax.distributed
+coordination service (a dead host fails the job, the launcher restarts it).
+
+Used by ``paddle_tpu.distributed.launch`` for restart-on-failure with
+``--max_restart`` and ``--nnodes min:max``, and usable in-process::
+
+    mgr = ElasticManager(nnodes="2:4", max_restart=3)
+    while True:
+        codes = poll_workers()
+        st = mgr.decide(codes)
+        if st is ElasticStatus.RESTART: relaunch(); continue
+        break
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import time
+from typing import List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_ENV = "PADDLE_ELASTIC_HEARTBEAT_DIR"
+
+
+class ElasticStatus(enum.Enum):
+    RUNNING = "running"
+    COMPLETED = "completed"
+    RESTART = "restart"
+    ERROR = "error"
+
+
+def parse_nnodes(nnodes: str):
+    """``"N"`` or ``"N1:N2"`` → (min, max). Reference: elastic/manager.py
+    parses the same form for scale-in/scale-out bounds."""
+    parts = str(nnodes).split(":")
+    lo = int(parts[0])
+    hi = int(parts[-1])
+    if lo < 1 or hi < lo:
+        raise ValueError(f"invalid nnodes range {nnodes!r}")
+    return lo, hi
+
+
+class ElasticManager:
+    """Gang restart policy: any worker failing kills the gang; if the
+    restart budget allows, the whole gang is relaunched (collective
+    semantics — a half-restarted ring cannot make progress)."""
+
+    def __init__(self, nnodes: str = "1", max_restart: int = 0,
+                 heartbeat_timeout: float = 30.0):
+        self.min_nodes, self.max_nodes = parse_nnodes(nnodes)
+        self.max_restart = max_restart
+        self.restart_count = 0
+        self.heartbeat_timeout = heartbeat_timeout
+
+    @property
+    def elastic_enabled(self) -> bool:
+        return self.max_nodes > self.min_nodes or self.max_restart > 0
+
+    def decide(self, exit_codes: Sequence[Optional[int]]) -> ElasticStatus:
+        """Decide from a poll of worker exit codes (None = still running)."""
+        if any(c is not None and c != 0 for c in exit_codes):
+            if self.restart_count < self.max_restart:
+                self.restart_count += 1
+                logger.warning(
+                    "[elastic] worker failed (codes=%s); gang restart %d/%d",
+                    list(exit_codes), self.restart_count, self.max_restart)
+                return ElasticStatus.RESTART
+            return ElasticStatus.ERROR
+        if all(c == 0 for c in exit_codes):
+            return ElasticStatus.COMPLETED
+        return ElasticStatus.RUNNING
+
+    # -- heartbeat (watcher.py analog) ------------------------------------
+    def stale_heartbeats(self, hb_dir: str, now: Optional[float] = None
+                         ) -> List[str]:
+        """Ranks whose heartbeat file went stale (dead-node detection when
+        process liveness alone can't be observed, e.g. remote nodes)."""
+        if not os.path.isdir(hb_dir):
+            return []
+        now = time.time() if now is None else now
+        stale = []
+        for name in sorted(os.listdir(hb_dir)):
+            if not name.startswith("hb."):
+                continue
+            age = now - os.path.getmtime(os.path.join(hb_dir, name))
+            if age > self.heartbeat_timeout:
+                stale.append(name[3:])
+        return stale
+
+
+class HeartbeatWriter:
+    """Worker-side heartbeat: touch ``hb.<rank>`` in the launcher-provided
+    dir every ``interval`` seconds from a daemon thread. No-op when the
+    launcher didn't request heartbeats."""
+
+    def __init__(self, rank: Optional[int] = None, interval: float = 2.0):
+        self.dir = os.environ.get(HEARTBEAT_ENV)
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.interval = interval
+        self._thread = None
+        self._stop = None
+
+    def start(self):
+        if not self.dir:
+            return self
+        import threading
+
+        os.makedirs(self.dir, exist_ok=True)
+        path = os.path.join(self.dir, f"hb.{self.rank}")
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                with open(path, "a"):
+                    os.utime(path)
+
+        with open(path, "a"):
+            os.utime(path)
+        self._thread = threading.Thread(
+            target=loop, name="elastic-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()

@@ -67,24 +67,20 @@ def build_env(rank: int, local_rank: int, world: int, endpoints: List[str],
     return env
 
 
-def launch(args=None) -> int:
-    args = args if args is not None else parse_args()
-    nnodes = int(str(args.nnodes).split(":")[0])
-    nproc = args.nproc_per_node
-    world = nnodes * nproc
-    master = args.master or "127.0.0.1:49178"
-    base_port = 52700
-    endpoints = [f"127.0.0.1:{base_port + i}" if nnodes == 1
-                 else f"node{i // nproc}:{base_port + i % nproc}"
-                 for i in range(world)]
-    os.makedirs(args.log_dir, exist_ok=True)
-
+def _run_gang(args, world: int, nproc: int, endpoints: List[str],
+              master: str, restart_count: int, shutdown_flag: dict
+              ) -> List[int]:
+    """Launch one generation of the worker gang and wait for it; returns
+    per-worker exit codes. Any failure terminates the whole gang
+    (collective semantics — a half-dead ring cannot progress)."""
     procs: List[subprocess.Popen] = []
     logs = []
+    suffix = f".restart{restart_count}" if restart_count else ""
     for local_rank in range(nproc):
         rank = args.rank * nproc + local_rank
         env = build_env(rank, local_rank, world, endpoints, master)
-        log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
+        env["PADDLE_RESTART_COUNT"] = str(restart_count)
+        log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}{suffix}")
         logf = open(log_path, "w")
         logs.append(logf)
         cmd = [sys.executable, "-u", args.training_script,
@@ -92,19 +88,23 @@ def launch(args=None) -> int:
         procs.append(subprocess.Popen(cmd, env=env, stdout=logf,
                                       stderr=subprocess.STDOUT))
 
-    def _terminate(*_):
+    def _kill_workers():
         for p in procs:
             if p.poll() is None:
                 p.terminate()
 
-    signal.signal(signal.SIGTERM, _terminate)
-    code = 0
+    def _on_sigterm(*_):
+        # operator-initiated shutdown must NOT look like a worker failure
+        # (which would trigger an elastic gang restart)
+        shutdown_flag["requested"] = True
+        _kill_workers()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
         while True:
             done = [p.poll() for p in procs]
             if any(c is not None and c != 0 for c in done):
-                code = next(c for c in done if c)  # first failure wins
-                _terminate()
+                _kill_workers()
                 break
             if all(c == 0 for c in done):
                 break
@@ -117,8 +117,42 @@ def launch(args=None) -> int:
                 p.kill()
         for f in logs:
             f.close()
-    if code:
+    return [p.returncode for p in procs]
+
+
+def launch(args=None) -> int:
+    from ..fleet.elastic import ElasticManager, ElasticStatus
+
+    args = args if args is not None else parse_args()
+    mgr = ElasticManager(nnodes=args.nnodes, max_restart=args.max_restart)
+    nnodes = mgr.min_nodes
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+    master = args.master or "127.0.0.1:49178"
+    base_port = 52700
+    endpoints = [f"127.0.0.1:{base_port + i}" if nnodes == 1
+                 else f"node{i // nproc}:{base_port + i % nproc}"
+                 for i in range(world)]
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    shutdown_flag = {"requested": False}
+    while True:
+        codes = _run_gang(args, world, nproc, endpoints, master,
+                          mgr.restart_count, shutdown_flag)
+        if shutdown_flag["requested"]:
+            sys.stderr.write("launch: shutdown requested (SIGTERM); not "
+                             "restarting\n")
+            return next((c for c in codes if c), 0)
+        status = mgr.decide(codes)
+        if status is ElasticStatus.COMPLETED:
+            return 0
+        if status is ElasticStatus.RESTART:
+            sys.stderr.write(
+                f"launch: worker failed (codes={codes}); elastic gang "
+                f"restart {mgr.restart_count}/{mgr.max_restart}\n")
+            continue
+        code = next(c for c in codes if c)  # first failure wins
         sys.stderr.write(
             f"launch: a worker failed with exit code {code}; logs in "
             f"{args.log_dir}/workerlog.*\n")
-    return code
+        return code

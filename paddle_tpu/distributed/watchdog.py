@@ -1,0 +1,185 @@
+"""Collective/step watchdog.
+
+Analog of the reference's comm-task watchdog: every NCCL collective wraps a
+``CommTask`` (paddle/phi/core/distributed/comm_task.h:36) and a background
+``CommTaskManager`` thread (comm_task_manager.h:37) detects timeout/error and
+stores trace records.
+
+TPU-native shape: XLA dispatch is async and a hung multi-host collective
+blocks inside the runtime where Python cannot see it — so the watchdog lives
+OUTSIDE the blocked call: a daemon thread scans in-flight tasks and, past
+``FLAGS_comm_timeout_s``, records a trace (op, group, start site, elapsed),
+logs it, and fires registered handlers (the default logs; an abort handler
+can take the process down so the launcher's elastic restart kicks in).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..common import flags as _flags
+
+logger = logging.getLogger(__name__)
+
+_SEQ = 0
+_SEQ_LOCK = threading.Lock()
+
+
+@dataclass
+class CommTask:
+    """One in-flight collective (or watched step)."""
+
+    name: str
+    group_desc: str = ""
+    timeout_s: float = 0.0
+    seq: int = 0
+    start_time: float = field(default_factory=time.monotonic)
+    _stack: Optional[object] = None  # raw StackSummary; formatted lazily
+    done: bool = False
+    timed_out: bool = False
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.start_time
+
+    @property
+    def start_site(self) -> str:
+        if self._stack is None:
+            return ""
+        return "".join(self._stack.format())
+
+
+class CommTaskManager:
+    """Background scanner for in-flight tasks (singleton via ``instance()``)."""
+
+    _instance: Optional["CommTaskManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, scan_interval: float = 0.1):
+        self._tasks: Dict[int, CommTask] = {}
+        self._lock = threading.Lock()
+        self._handlers: List[Callable[[CommTask], None]] = []
+        self.timed_out: List[CommTask] = []
+        self._scan_interval = scan_interval
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @classmethod
+    def instance(cls) -> "CommTaskManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add_handler(self, fn: Callable[[CommTask], None]):
+        self._handlers.append(fn)
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="comm-watchdog", daemon=True)
+            self._thread.start()
+
+    def register(self, name: str, group_desc: str = "",
+                 timeout_s: Optional[float] = None) -> CommTask:
+        global _SEQ
+        if timeout_s is None:
+            timeout_s = float(_flags.get_flag("FLAGS_comm_timeout_s"))
+        if timeout_s <= 0:
+            # watchdog disabled: no registration, no scanner thread, no
+            # stack capture — zero hot-loop cost
+            return CommTask(name=name, group_desc=group_desc, timeout_s=0.0)
+        with _SEQ_LOCK:
+            _SEQ += 1
+            seq = _SEQ
+        # capture frames without formatting (no linecache IO); format only
+        # if the task actually times out
+        import sys
+
+        stack = traceback.StackSummary.extract(
+            traceback.walk_stack(sys._getframe(1)), limit=5,
+            lookup_lines=False)
+        stack.reverse()
+        task = CommTask(name=name, group_desc=group_desc,
+                        timeout_s=timeout_s, seq=seq, _stack=stack)
+        with self._lock:
+            self._tasks[seq] = task
+        self._ensure_thread()
+        return task
+
+    def complete(self, task: CommTask):
+        task.done = True
+        with self._lock:
+            self._tasks.pop(task.seq, None)
+
+    def _loop(self):
+        while not self._stop.wait(self._scan_interval):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for seq, t in list(self._tasks.items()):
+                    if t.timeout_s > 0 and now - t.start_time > t.timeout_s:
+                        t.timed_out = True
+                        expired.append(t)
+                        del self._tasks[seq]
+            for t in expired:
+                self.timed_out.append(t)
+                logger.error(
+                    "[comm watchdog] task '%s' (group=%s, seq=%d) exceeded "
+                    "%.1fs (elapsed %.1fs); started at:\n%s",
+                    t.name, t.group_desc or "-", t.seq, t.timeout_s,
+                    t.elapsed(), t.start_site)
+                for h in self._handlers:
+                    try:
+                        h(t)
+                    except Exception:
+                        logger.exception("comm watchdog handler failed")
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class comm_watch:
+    """Context manager marking a collective in-flight for the watchdog.
+
+    Used by the eager collectives (distributed/collective.py) and usable
+    around a whole train step::
+
+        with comm_watch("train_step", timeout_s=120):
+            loss = step(batch)
+    """
+
+    def __init__(self, name: str, group_desc: str = "",
+                 timeout_s: Optional[float] = None):
+        self.name = name
+        self.group_desc = group_desc
+        self.timeout_s = timeout_s
+        self.task: Optional[CommTask] = None
+
+    def __enter__(self) -> CommTask:
+        self.task = CommTaskManager.instance().register(
+            self.name, self.group_desc, self.timeout_s)
+        return self.task
+
+    def __exit__(self, *exc):
+        CommTaskManager.instance().complete(self.task)
+        return False
+
+
+def abort_on_timeout(task: CommTask):
+    """Optional handler: take the process down on a hung collective so the
+    launcher's restart policy (elastic) can recover the job — the analog of
+    the reference's FLAGS_nccl_blocking_wait + async error handling."""
+    logger.critical("[comm watchdog] aborting process: task '%s' hung "
+                    "(%.1fs > %.1fs)", task.name, task.elapsed(),
+                    task.timeout_s)
+    os._exit(124)

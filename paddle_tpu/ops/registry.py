@@ -195,7 +195,9 @@ def record_call(name: str, fn: Callable, tensors: Sequence[Tensor]):
         return vjp_fn(cots)
 
     node = _tape.record_op(name, out_leaves, node_vjp, diff)
-    node.apply_with_graph = _make_apply_with_graph(name, pure, out_treedef, diff)
+    if _flags.get_flag("FLAGS_eager_double_grad"):
+        node.apply_with_graph = _make_apply_with_graph(name, pure,
+                                                       out_treedef, diff)
 
     wrapped = []
     for slot, v in enumerate(out_leaves):
@@ -253,8 +255,12 @@ def dispatch(name: str, *args, **kwargs):
         return vjp_fn(cots)
 
     node = _tape.record_op(name, out_leaves, node_vjp, diff_tensors)
-    node.apply_with_graph = _make_apply_with_graph(name, pure, out_treedef,
-                                                   diff_tensors)
+    # The saved-input capture (TensorWrapper analog) extends activation
+    # lifetimes beyond what first-order vjp residuals need; gated so
+    # memory-critical eager loops can opt out.
+    if _flags.get_flag("FLAGS_eager_double_grad"):
+        node.apply_with_graph = _make_apply_with_graph(name, pure, out_treedef,
+                                                       diff_tensors)
     return _wrap_outputs(op, out, recorded=True, node=node)
 
 

@@ -329,3 +329,29 @@ def test_pylayer_double_grad_warns_on_disconnected_saved():
         (g,) = paddle.autograd.grad(y.sum(), t, create_graph=True)
         paddle.autograd.grad(g.sum(), t)
     assert any("double grad" in str(x.message) for x in w)
+
+
+def test_register_hook_under_create_graph():
+    x = _leaf((4,))
+    y = x * x
+    y.register_hook(lambda g: g * 2.0)
+    z = y.sum()
+    (g,) = paddle.autograd.grad(z, x, create_graph=True)
+    # hook doubles dz/dy -> g = 4x; second order d(g.sum())/dx = 4
+    np.testing.assert_allclose(np.asarray(g._value), 4 * x.numpy(), rtol=1e-5)
+    (gg,) = paddle.autograd.grad(g.sum(), x)
+    np.testing.assert_allclose(np.asarray(gg._value), 4 * np.ones(4), rtol=1e-5)
+
+
+def test_eager_double_grad_flag_off():
+    paddle.set_flags({"FLAGS_eager_double_grad": False})
+    try:
+        x = _leaf((3,))
+        y = (x ** 3).sum()
+        (g,) = paddle.autograd.grad(y, x, create_graph=True)
+        # first order still exact; saved-input capture dropped, so the
+        # second grad treats primals as constants (documented fallback)
+        np.testing.assert_allclose(np.asarray(g._value), 3 * x.numpy() ** 2,
+                                   rtol=1e-5)
+    finally:
+        paddle.set_flags({"FLAGS_eager_double_grad": True})

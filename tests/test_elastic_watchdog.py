@@ -1,0 +1,155 @@
+"""Elastic manager + comm watchdog (analogs of fleet/elastic/manager.py:125
+and phi/core/distributed/comm_task_manager.h:37)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.watchdog import CommTaskManager, comm_watch
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, HeartbeatWriter, parse_nnodes)
+
+
+def test_watchdog_flags_hung_task():
+    mgr = CommTaskManager(scan_interval=0.02)
+    fired = []
+    mgr.add_handler(lambda t: fired.append(t.name))
+    task = mgr.register("fake_all_reduce", "tp", timeout_s=0.1)
+    deadline = time.monotonic() + 2.0
+    while not mgr.timed_out and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert task.timed_out
+    assert [t.name for t in mgr.timed_out] == ["fake_all_reduce"]
+    assert fired == ["fake_all_reduce"]
+    assert "test_watchdog_flags_hung_task" in task.start_site
+    mgr.shutdown()
+
+
+def test_watchdog_completed_task_not_flagged():
+    mgr = CommTaskManager(scan_interval=0.02)
+    task = mgr.register("quick_op", timeout_s=0.2)
+    mgr.complete(task)
+    time.sleep(0.4)
+    assert not mgr.timed_out
+    mgr.shutdown()
+
+
+def test_comm_watch_wraps_collectives():
+    # the eager collective runs inside a watch window and completes cleanly
+    mgr = CommTaskManager.instance()
+    before = len(mgr.timed_out)
+    t = paddle.to_tensor(np.ones(4, dtype=np.float32))
+    dist.all_reduce(t)
+    assert len(mgr.timed_out) == before
+    with comm_watch("manual_step", timeout_s=60) as task:
+        pass
+    assert task.done
+
+
+def test_parse_nnodes():
+    assert parse_nnodes("2") == (2, 2)
+    assert parse_nnodes("2:4") == (2, 4)
+    with pytest.raises(ValueError):
+        parse_nnodes("4:2")
+
+
+def test_elastic_decide():
+    mgr = ElasticManager(nnodes="1", max_restart=2)
+    assert mgr.decide([None, None]) is ElasticStatus.RUNNING
+    assert mgr.decide([0, 0]) is ElasticStatus.COMPLETED
+    assert mgr.decide([1, None]) is ElasticStatus.RESTART
+    assert mgr.decide([0, 7]) is ElasticStatus.RESTART
+    assert mgr.restart_count == 2
+    assert mgr.decide([1, 0]) is ElasticStatus.ERROR  # budget exhausted
+
+
+def test_heartbeat_staleness(tmp_path):
+    mgr = ElasticManager(heartbeat_timeout=0.2)
+    hb = tmp_path / "hb"
+    os.environ["PADDLE_ELASTIC_HEARTBEAT_DIR"] = str(hb)
+    try:
+        w = HeartbeatWriter(rank=0, interval=0.05).start()
+        time.sleep(0.1)
+        assert mgr.stale_heartbeats(str(hb)) == []
+        w.stop()
+        time.sleep(0.4)
+        assert mgr.stale_heartbeats(str(hb)) == ["0"]
+    finally:
+        del os.environ["PADDLE_ELASTIC_HEARTBEAT_DIR"]
+
+
+def test_launcher_gang_restart(tmp_path):
+    """Kill-a-worker recovery: the script fails on its first generation and
+    succeeds after restart (the reference's elastic relaunch path)."""
+    marker = tmp_path / "first_run_done"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "print('restart_count', os.environ.get('PADDLE_RESTART_COUNT'))\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(17)\n"
+        "sys.exit(0)\n")
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--max_restart", "2", "--log_dir", str(log_dir), str(script)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "elastic gang restart 1/2" in r.stderr
+    # both generations logged
+    assert (log_dir / "workerlog.0").exists()
+    assert (log_dir / "workerlog.0.restart1").exists()
+    assert "restart_count 1" in (log_dir / "workerlog.0.restart1").read_text()
+
+
+def test_launcher_restart_budget_exhausted(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(9)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--max_restart", "1", "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=120)
+    assert r.returncode == 9
+    assert "elastic gang restart 1/1" in r.stderr
+
+
+def test_watchdog_disabled_fast_path():
+    mgr = CommTaskManager(scan_interval=0.02)
+    task = mgr.register("noop", timeout_s=0)
+    assert task.seq == 0 and task._stack is None
+    mgr.complete(task)  # must not blow up
+    assert not mgr._tasks
+    mgr.shutdown()
+
+
+def test_launcher_sigterm_no_restart(tmp_path):
+    import signal as _signal
+
+    script = tmp_path / "sleepy.py"
+    script.write_text("import time; time.sleep(60)\n")
+    log_dir = tmp_path / "logs"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--max_restart", "3", "--log_dir", str(log_dir), str(script)],
+        cwd="/root/repo", stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    # the signal handler is installed once the gang is spawned; wait for
+    # the worker log to exist before delivering SIGTERM
+    deadline = time.monotonic() + 60
+    while not (log_dir / "workerlog.0").exists():
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    time.sleep(0.5)
+    p.send_signal(_signal.SIGTERM)
+    out, err = p.communicate(timeout=60)
+    assert "shutdown requested" in err, err
+    assert "gang restart" not in err, err
