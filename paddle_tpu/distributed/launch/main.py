@@ -93,15 +93,16 @@ def _run_gang(args, world: int, nproc: int, endpoints: List[str],
             if p.poll() is None:
                 p.terminate()
 
-    def _on_sigterm(*_):
-        # operator-initiated shutdown must NOT look like a worker failure
-        # (which would trigger an elastic gang restart)
-        shutdown_flag["requested"] = True
-        _kill_workers()
-
-    signal.signal(signal.SIGTERM, _on_sigterm)
+    # the SIGTERM handler is installed once in launch(); this generation's
+    # kill hook is published through the shared flag dict so a signal
+    # arriving between generations still stops the next one (the monitor
+    # loop below also polls the flag)
+    shutdown_flag["kill"] = _kill_workers
     try:
         while True:
+            if shutdown_flag["requested"]:
+                _kill_workers()
+                break
             done = [p.poll() for p in procs]
             if any(c is not None and c != 0 for c in done):
                 _kill_workers()
@@ -135,14 +136,27 @@ def launch(args=None) -> int:
                  for i in range(world)]
     os.makedirs(args.log_dir, exist_ok=True)
 
-    shutdown_flag = {"requested": False}
+    shutdown_flag = {"requested": False, "kill": lambda: None}
+
+    def _on_sigterm(*_):
+        # operator-initiated shutdown must NOT look like a worker failure
+        # (which would trigger an elastic gang restart)
+        shutdown_flag["requested"] = True
+        shutdown_flag["kill"]()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     while True:
+        if shutdown_flag["requested"]:
+            sys.stderr.write("launch: shutdown requested (SIGTERM); not "
+                             "starting a new gang\n")
+            return 0
         codes = _run_gang(args, world, nproc, endpoints, master,
                           mgr.restart_count, shutdown_flag)
         if shutdown_flag["requested"]:
+            # intentional stop is a clean exit, not a failure
             sys.stderr.write("launch: shutdown requested (SIGTERM); not "
                              "restarting\n")
-            return next((c for c in codes if c), 0)
+            return 0
         status = mgr.decide(codes)
         if status is ElasticStatus.COMPLETED:
             return 0

@@ -40,22 +40,40 @@ class Config:
 
 
 class Predictor:
-    """Create from a live Layer or a jit.save'd path."""
+    """Create from a live Layer, a jit.save'd path, or a Config whose
+    ``model_path`` points at one. The path form needs NO Python class — the
+    serialized jax.export module is the program (the AnalysisPredictor
+    load→run path, analysis_predictor.h:105)."""
 
     def __init__(self, config_or_layer, layer: Optional[Layer] = None):
-        from ..jit import TracedLayer
+        from ..jit import LoadedFunction, TracedLayer
 
-        if isinstance(config_or_layer, Layer):
-            self._layer = config_or_layer
+        self._layer = None
+        self._traced = None
+        source = config_or_layer
+        if isinstance(source, Config):
+            source = source.model_path
+        if isinstance(source, Layer):
+            self._layer = source
         elif layer is not None:
             self._layer = layer
+        elif isinstance(source, str):
+            from ..jit import load as jit_load
+
+            loaded = jit_load(source)
+            if not isinstance(loaded, LoadedFunction):
+                raise ValueError(
+                    f"{source!r} has no exported module; re-save with "
+                    "jit.save(layer, path, input_spec=[...])")
+            self._traced = loaded
         else:
-            raise ValueError("Predictor requires a Layer (load path support "
-                             "via paddle_tpu.jit.load + model class)")
-        self._layer.eval()
-        self._traced = TracedLayer(self._layer)
+            raise ValueError("Predictor requires a Layer or a saved-model path")
+        if self._layer is not None:
+            self._layer.eval()
+            self._traced = TracedLayer(self._layer)
         self._inputs: Dict[str, np.ndarray] = {}
-        self._input_names: List[str] = ["input_0"]
+        n_in = len(getattr(self._traced, "input_spec", None) or []) or 1
+        self._input_names: List[str] = [f"input_{i}" for i in range(n_in)]
 
     def get_input_names(self):
         return self._input_names

@@ -114,39 +114,125 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     return decorate
 
 
+def _spec_avals(input_spec):
+    """InputSpec list → ShapeDtypeStructs (example Tensors pass through)."""
+    from ..static import InputSpec
+
+    avals = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            avals.append(jax.ShapeDtypeStruct(spec.shape, jnp.dtype(spec.dtype)))
+        elif isinstance(spec, Tensor):
+            avals.append(jax.ShapeDtypeStruct(tuple(spec.shape), spec.dtype))
+        else:
+            a = jnp.asarray(spec)
+            avals.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    return avals
+
+
 def save(layer, path, input_spec=None, **configs):
-    """jit.save analog: persist params + a StableHLO module for the
-    predictor (reference: jit.save producing ProgramDesc + params)."""
+    """jit.save analog (reference: jit.save producing ProgramDesc + params,
+    reloadable by AnalysisPredictor without the Python class —
+    fluid/inference/api/analysis_predictor.h:105).
+
+    Persists params (numpy) + a serialized ``jax.export`` artifact of
+    ``fn(state, *inputs)``. ``jit.load``/``inference.Predictor`` rebuild a
+    callable from the artifact alone — no Python class needed."""
     import pickle
 
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    state = {k: __import__("numpy").asarray(v)
-             for k, v in layer.functional_state().items()}
-    payload = {"state": state, "class": type(layer).__name__}
-    if input_spec is not None:
-        traced = TracedLayer(layer)
-        from ..static import InputSpec
+    import numpy as np
 
-        example = []
-        for spec in input_spec:
-            if isinstance(spec, InputSpec):
-                example.append(Tensor(jnp.zeros(spec.shape, dtype=spec.dtype)))
-            else:
-                example.append(spec)
-        payload["stablehlo"] = traced.stablehlo(*example)
-        payload["input_spec"] = [
-            (tuple(s.shape), str(s.dtype)) if isinstance(s, InputSpec) else None
-            for s in input_spec
-        ]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {k: np.asarray(v) for k, v in layer.functional_state().items()}
+    payload = {"state": state, "class": type(layer).__name__,
+               "format": "jax_export_v1"}
+    if input_spec is not None:
+        from jax import export as jax_export
+
+        was_training = getattr(layer, "training", False)
+        if was_training and hasattr(layer, "eval"):
+            layer.eval()
+        try:
+            def pure(st, *xs):
+                with _tape.no_grad():
+                    wxs = [Tensor(x) for x in xs]
+                    out = layer.functional_call(st, *wxs)
+                return jax.tree_util.tree_map(
+                    _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+            state_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                           for k, v in state.items()}
+            in_avals = _spec_avals(input_spec)
+            try:
+                # portable artifact: lower for both host CPU and TPU so a
+                # model saved on one can be served on the other
+                exported = jax_export.export(
+                    jax.jit(pure),
+                    platforms=("cpu", "tpu"))(state_avals, *in_avals)
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    "multi-platform (cpu+tpu) export failed; saving a "
+                    f"{jax.default_backend()}-only artifact. It will NOT "
+                    f"load on other backends. Cause: {e}", stacklevel=2)
+                exported = jax_export.export(jax.jit(pure))(state_avals,
+                                                            *in_avals)
+            payload["exported"] = exported.serialize()
+            payload["stablehlo"] = exported.mlir_module()
+            payload["input_spec"] = [(tuple(a.shape), str(a.dtype))
+                                     for a in in_avals]
+        finally:
+            if was_training and hasattr(layer, "train"):
+                layer.train()
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(payload, f)
 
 
+class LoadedFunction:
+    """A model reloaded from a ``jit.save`` artifact — callable without the
+    original Python class (the AnalysisPredictor load path)."""
+
+    def __init__(self, payload):
+        from jax import export as jax_export
+
+        self._payload = payload
+        self._state = payload["state"]
+        self._exported = jax_export.deserialize(payload["exported"])
+        self.input_spec = payload.get("input_spec")
+        self.class_name = payload.get("class")
+
+    def state_dict(self):
+        return dict(self._state)
+
+    def set_state_dict(self, state):
+        import numpy as np
+
+        for k, v in state.items():
+            self._state[k] = np.asarray(v._value if isinstance(v, Tensor) else v)
+
+    @property
+    def stablehlo(self) -> str:
+        return self._payload.get("stablehlo", "")
+
+    def __call__(self, *args):
+        vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out = self._exported.call(self._state, *vals)
+        return jax.tree_util.tree_map(_wrap, out)
+
+
 def load(path):
+    """Reload a jit.save'd model. With an exported module present this
+    returns a :class:`LoadedFunction` (no Python class needed); otherwise
+    the raw payload dict (params-only save)."""
     import pickle
 
     with open(path + ".pdmodel", "rb") as f:
-        return pickle.load(f)
+        payload = pickle.load(f)
+    if "exported" in payload:
+        return LoadedFunction(payload)
+    return payload
 
 
 def not_to_static(fn):

@@ -24,11 +24,41 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kw):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.save(layer, path) — exports StableHLO for the "
-        "inference predictor")
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kw):
+    """Analog of paddle.static.save_inference_model (reference: serializes
+    the pruned inference Program + params for AnalysisPredictor).
+
+    TPU-native mapping: ``feed_vars`` are :class:`InputSpec`s describing the
+    inputs and ``fetch_vars`` is the model (a Layer or callable) whose traced
+    StableHLO module is exported via ``jit.save``; ``executor`` is accepted
+    for source compatibility and ignored (XLA/PJRT is the executor)."""
+    from ..jit import save as jit_save
+    from ..nn.layer import Layer
+
+    if isinstance(feed_vars, InputSpec):
+        feed_vars = [feed_vars]
+    model = fetch_vars
+    if not isinstance(model, Layer):
+        raise TypeError(
+            "fetch_vars must be the model Layer in the TPU build (the "
+            "reference's fetch Variables are bound to a Program; here the "
+            "traced layer IS the program)")
+    jit_save(model, path_prefix, input_spec=list(feed_vars))
+    return path_prefix
 
 
 def load_inference_model(path_prefix, executor=None, **kw):
-    raise NotImplementedError("use paddle_tpu.inference.Predictor(path)")
+    """Analog of paddle.static.load_inference_model: returns
+    ``(program, feed_names, fetch_names)`` where ``program`` is the loaded
+    callable (jax.export module + params, no Python class needed)."""
+    from ..jit import load as jit_load
+
+    loaded = jit_load(path_prefix)
+    if isinstance(loaded, dict):
+        raise ValueError(
+            f"{path_prefix!r} has no exported module; save with "
+            "save_inference_model or jit.save(..., input_spec=[...])")
+    n_in = len(loaded.input_spec or [])
+    feed_names = [f"feed_{i}" for i in range(n_in)]
+    return loaded, feed_names, ["fetch_0"]

@@ -153,3 +153,4 @@ def test_launcher_sigterm_no_restart(tmp_path):
     out, err = p.communicate(timeout=60)
     assert "shutdown requested" in err, err
     assert "gang restart" not in err, err
+    assert p.returncode == 0, p.returncode  # intentional stop = clean exit

@@ -1,0 +1,141 @@
+"""Model serialization: jit.save exports a serialized StableHLO module
+(jax.export) + params; reload runs WITHOUT the Python class — the analog of
+the reference's save_inference_model → AnalysisPredictor pipeline
+(paddle/fluid/inference/api/analysis_predictor.h:105)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.fc1(x)))
+
+
+def _save(tmp_path):
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+    x = np.random.RandomState(0).randn(2, 8).astype("float32")
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    return path, x, want
+
+
+def test_jit_save_load_no_class(tmp_path):
+    path, x, want = _save(tmp_path)
+    loaded = paddle.jit.load(path)
+    assert isinstance(loaded, paddle.jit.LoadedFunction)
+    assert loaded.class_name == "SmallNet"
+    assert "stablehlo" in loaded.stablehlo or "module" in loaded.stablehlo
+    got = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(got._value), want, rtol=1e-5)
+
+
+def test_load_in_fresh_process_without_class(tmp_path):
+    """The class is NOT defined in the loading process — the exported
+    module alone must reproduce the outputs."""
+    path, x, want = _save(tmp_path)
+    np.save(tmp_path / "x.npy", x)
+    script = tmp_path / "loader.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        f"loaded = paddle.jit.load({path!r})\n"
+        f"x = np.load({str(tmp_path / 'x.npy')!r})\n"
+        "out = loaded(paddle.to_tensor(x))\n"
+        f"np.save({str(tmp_path / 'out.npy')!r}, np.asarray(out._value))\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "out.npy")
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_predictor_from_path(tmp_path):
+    path, x, want = _save(tmp_path)
+    pred = paddle.inference.Predictor(path)
+    (got,) = pred.run([x])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # Config(model_path) form
+    cfg = paddle.inference.Config(path)
+    (got2,) = paddle.inference.create_predictor(cfg).run([x])
+    np.testing.assert_allclose(got2, want, rtol=1e-5)
+
+
+def test_static_save_load_inference_model(tmp_path):
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "inf")
+    paddle.static.save_inference_model(
+        prefix, [InputSpec([2, 8], "float32")], net)
+    program, feed_names, fetch_names = \
+        paddle.static.load_inference_model(prefix)
+    assert feed_names == ["feed_0"]
+    x = np.random.RandomState(1).randn(2, 8).astype("float32")
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    got = program(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(got._value), want, rtol=1e-5)
+
+
+def test_loaded_set_state_dict(tmp_path):
+    path, x, want = _save(tmp_path)
+    loaded = paddle.jit.load(path)
+    zeroed = {k: np.zeros_like(v) for k, v in loaded.state_dict().items()}
+    loaded.set_state_dict(zeroed)
+    got = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(got._value), 0.0, atol=1e-7)
+
+
+def test_params_only_save_still_loads(tmp_path):
+    net = SmallNet()
+    path = str(tmp_path / "params_only")
+    paddle.jit.save(net, path)  # no input_spec: params-only payload
+    payload = paddle.jit.load(path)
+    assert isinstance(payload, dict) and "state" in payload
+    with pytest.raises(ValueError):
+        paddle.inference.Predictor(path)
+
+
+class TwoInputNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, x, y):
+        return self.fc(x) + y
+
+
+def test_predictor_multi_input(tmp_path):
+    net = TwoInputNet()
+    net.eval()
+    path = str(tmp_path / "two")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32"),
+                                           InputSpec([2, 4], "float32")])
+    pred = paddle.inference.Predictor(path)
+    assert pred.get_input_names() == ["input_0", "input_1"]
+    x = np.random.RandomState(0).randn(2, 8).astype("float32")
+    y = np.random.RandomState(1).randn(2, 4).astype("float32")
+    pred.set_input("input_0", x)
+    pred.set_input("input_1", y)
+    (got,) = pred.run()
+    want = np.asarray(net(paddle.to_tensor(x), paddle.to_tensor(y))._value)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
