@@ -668,3 +668,441 @@ def fused_softmax_mask_upper_triangle(x):
     s = x.shape[-1]
     mask = jnp.triu(jnp.full((s, s), -1e9, x.dtype), k=1)
     return jax.nn.softmax(x + mask, axis=-1)
+
+
+# --------------------------------------------------------------------------
+# round-2 additions: dropout/losses, pooling, quantization, MoE helpers,
+# detection utilities. Reference analogs cited per function.
+# --------------------------------------------------------------------------
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
+    """ref: phi dropout kernel (ops.yaml `dropout`)."""
+    if not training or p == 0.0:
+        return x
+    keep = jax.random.bernoulli(_key(), 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def bce_loss(input, label):  # noqa: A002
+    """ref: phi/kernels/bce_loss_kernel.h."""
+    x = jnp.clip(input, 1e-12, 1.0 - 1e-12)
+    return -(label * jnp.log(x) + (1.0 - label) * jnp.log1p(-x))
+
+
+def cross_entropy_with_softmax(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100):
+    """ref: phi cross_entropy_with_softmax (ops.yaml) — returns
+    (softmax, per-example loss)."""
+    sm = jax.nn.softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -(label * logp).sum(axis=axis, keepdims=True)
+    else:
+        lab = label.astype(jnp.int32)
+        squeeze = lab.ndim == logits.ndim
+        if squeeze:
+            lab = lab.squeeze(axis)
+        picked = jnp.take_along_axis(
+            logp, lab[..., None] if axis in (-1, logits.ndim - 1)
+            else jnp.expand_dims(lab, axis), axis=axis)
+        loss = -picked
+        loss = jnp.where(jnp.expand_dims(lab, axis) == ignore_index
+                         if not squeeze else lab[..., None] == ignore_index,
+                         0.0, loss)
+    return sm, loss
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v), int(v))
+
+
+def depthwise_conv2d(x, filter, strides=1, paddings=0, dilations=1):  # noqa: A002
+    """ref: phi depthwise_conv2d kernel. x [N,C,H,W], filter [C,1,kh,kw]."""
+    s, p, d = _pair(strides), _pair(paddings), _pair(dilations)
+    c = x.shape[1]
+    dn = jax.lax.conv_dimension_numbers(x.shape, filter.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    # paddle depthwise filter layout: [C*mult, 1, kh, kw] == OIHW with
+    # feature_group_count=C
+    return jax.lax.conv_general_dilated(
+        x, filter, s, [(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=dn, feature_group_count=c)
+
+
+def conv3d_transpose(x, filter, strides=1, paddings=0, dilations=1):  # noqa: A002
+    """ref: phi conv3d_transpose. x [N,C,D,H,W], filter [C,Cout,kd,kh,kw]."""
+    def _t3(v):
+        return tuple(v) if isinstance(v, (tuple, list)) else (int(v),) * 3
+    s, p, d = _t3(strides), _t3(paddings), _t3(dilations)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, (filter.shape[1], filter.shape[0]) + filter.shape[2:],
+        ("NCDHW", "OIDHW", "NCDHW"))
+    k = filter.shape[2:]
+    pads = [(d[i] * (k[i] - 1) - p[i], d[i] * (k[i] - 1) - p[i])
+            for i in range(3)]
+    w = jnp.swapaxes(filter, 0, 1)[:, :, ::-1, ::-1, ::-1]
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1, 1), pads, lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=dn)
+
+
+def _pool(x, kernel, stride, padding, nd, pooling_type, exclusive=True):
+    k = tuple(kernel) if isinstance(kernel, (tuple, list)) else (int(kernel),) * nd
+    st = tuple(stride) if isinstance(stride, (tuple, list)) else (int(stride),) * nd
+    p = tuple(padding) if isinstance(padding, (tuple, list)) else (int(padding),) * nd
+    window = (1, 1) + k
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if pooling_type == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+        return out.astype(x.dtype)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if exclusive and any(p):
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return (s / cnt).astype(x.dtype)
+    import math
+
+    return (s / math.prod(k)).astype(x.dtype)
+
+
+def pool2d(x, kernel_size, stride=None, padding=0, pooling_type="max",
+           exclusive=True, **_):
+    """ref: phi pool2d kernel (NCHW)."""
+    return _pool(x, kernel_size, stride if stride is not None else kernel_size,
+                 padding, 2, pooling_type, exclusive)
+
+
+def pool3d(x, kernel_size, stride=None, padding=0, pooling_type="max",
+           exclusive=True, **_):
+    """ref: phi pool3d kernel (NCDHW)."""
+    return _pool(x, kernel_size, stride if stride is not None else kernel_size,
+                 padding, 3, pooling_type, exclusive)
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    """ref: phi pad3d kernel. paddings = [l, r, t, b, f, bk] (W, H, D)."""
+    pl, pr, pt, pb, pf, pk = [int(v) for v in paddings]
+    if data_format == "NCDHW":
+        pad = [(0, 0), (0, 0), (pf, pk), (pt, pb), (pl, pr)]
+    else:  # NDHWC
+        pad = [(0, 0), (pf, pk), (pt, pb), (pl, pr), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pad, mode=jmode, constant_values=value)
+    return jnp.pad(x, pad, mode=jmode)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """ref: phi grid_sample kernel. x [N,C,H,W], grid [N,Ho,Wo,2] in
+    [-1, 1]; bilinear + zeros padding (the common detection/flow path)."""
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+    if mode == "nearest":
+        ix = jnp.round(fx).astype(jnp.int32)
+        iy = jnp.round(fy).astype(jnp.int32)
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        out = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Ho,Wo,C]
+        out = jnp.where(valid[..., None], out, 0.0)
+        return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def gather(ix, iy):
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        v = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Ho,Wo,C]
+        return jnp.where(valid[..., None], v, 0.0)
+
+    wx1 = fx - x0
+    wy1 = fy - y0
+    wx0 = 1.0 - wx1
+    wy0 = 1.0 - wy1
+    out = (gather(x0, y0) * (wx0 * wy0)[..., None]
+           + gather(x1, y0) * (wx1 * wy0)[..., None]
+           + gather(x0, y1) * (wx0 * wy1)[..., None]
+           + gather(x1, y1) * (wx1 * wy1)[..., None])
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+
+
+def segment_pool(x, segment_ids, pooltype="SUM"):
+    """ref: phi segment_pool kernel."""
+    num = int(segment_ids.max()) + 1 if segment_ids.size else 0
+    if pooltype == "SUM":
+        return jax.ops.segment_sum(x, segment_ids, num)
+    if pooltype == "MEAN":
+        s = jax.ops.segment_sum(x, segment_ids, num)
+        cnt = jax.ops.segment_sum(jnp.ones_like(segment_ids, x.dtype),
+                                  segment_ids, num)
+        return s / jnp.maximum(cnt, 1)[(...,) + (None,) * (x.ndim - 1)]
+    if pooltype == "MAX":
+        return jax.ops.segment_max(x, segment_ids, num)
+    if pooltype == "MIN":
+        return jax.ops.segment_min(x, segment_ids, num)
+    raise ValueError(pooltype)
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """ref: phi spectral_norm kernel — weight / sigma with power iteration."""
+    w = jnp.moveaxis(weight, dim, 0).reshape(weight.shape[dim], -1)
+    for _ in range(max(int(power_iters), 0)):
+        v = w.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = w @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ w @ v
+    return weight / sigma
+
+
+def check_finite_and_unscale(xs, scale):
+    """ref: phi check_finite_and_unscale kernel (AMP) — unscale each grad
+    by 1/scale and report whether any was non-finite."""
+    found = jnp.zeros((), jnp.bool_)
+    outs = []
+    for x in xs:
+        found = found | ~jnp.isfinite(x).all()
+        outs.append(x / scale)
+    return tuple(outs) + (found,)
+
+
+def fake_quantize_abs_max(x, bit_length=8):
+    """ref: fluid fake_quantize_abs_max op — returns (quantized, scale)."""
+    bnt = float(2 ** (bit_length - 1) - 1)
+    scale = jnp.abs(x).max()
+    return jnp.round(x / scale * bnt), scale.reshape(1)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8):
+    bnt = float(2 ** (bit_length - 1) - 1)
+    scale = jnp.abs(x).max()
+    return jnp.round(x / scale * bnt) / bnt * scale, scale.reshape(1)
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0):
+    bnt = float(2 ** (bit_length - 1) - 1)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.abs(x).max(axis=axes, keepdims=True)
+    out = jnp.round(x / scale * bnt) / bnt * scale
+    return out, scale.reshape(-1)
+
+
+def weight_quantize(x, algo="abs_max"):
+    """ref: phi weight_quantize (weight-only int8). x [K, N] ->
+    (int8 weights, per-column scale)."""
+    scale = jnp.abs(x).max(axis=0)
+    q = jnp.clip(jnp.round(x / scale * 127.0), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def weight_dequantize(x, scale):
+    return x.astype(scale.dtype) * scale / 127.0
+
+
+def weight_only_linear(x, weight, weight_scale, bias=None):
+    """ref: phi weight_only_linear — activation fp x int8 weight matmul."""
+    w = weight.astype(x.dtype) * (weight_scale / 127.0).astype(x.dtype)
+    out = x @ w
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def view_dtype(x, dtype):
+    return jax.lax.bitcast_convert_type(x, jnp.dtype(dtype))
+
+
+def tensor_unfold(x, axis, size, step):
+    """ref: phi tensor_unfold (Tensor.unfold) — sliding windows along
+    ``axis`` appended as a trailing dim."""
+    axis = axis % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+    out = jnp.take(x, idx.reshape(-1), axis=axis)
+    shape = (x.shape[:axis] + (n, size) + x.shape[axis + 1:])
+    out = out.reshape(x.shape[:axis] + (n, size) + x.shape[axis + 1:])
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """ref: phi fill_diagonal_tensor kernel."""
+    n = min(x.shape[dim1], x.shape[dim2])
+    i = jnp.arange(n)
+    rows = i - min(offset, 0)
+    cols = i + max(offset, 0)
+    keep = (rows < x.shape[dim1]) & (cols < x.shape[dim2])
+    rows, cols = rows[keep], cols[keep]
+    xm = jnp.moveaxis(x, (dim1, dim2), (0, 1))
+    ym = jnp.broadcast_to(y, xm[rows, cols].shape)
+    xm = xm.at[rows, cols].set(ym)
+    return jnp.moveaxis(xm, (0, 1), (dim1, dim2))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """ref: phi unique_consecutive kernel (eager, concrete shapes)."""
+    flat = x.reshape(-1) if axis is None else x
+    if axis is not None:
+        raise NotImplementedError("axis form not supported")
+    keep = jnp.concatenate([jnp.ones(1, bool), flat[1:] != flat[:-1]])
+    idx = np.flatnonzero(np.asarray(keep))
+    out = flat[idx]
+    res = [out]
+    if return_inverse:
+        res.append(jnp.cumsum(keep.astype(jnp.int64)) - 1)
+    if return_counts:
+        counts = np.diff(np.append(idx, flat.shape[0]))
+        res.append(jnp.asarray(counts))
+    return tuple(res) if len(res) > 1 else out
+
+
+def partial_sum(xs, start_index=0, length=-1):
+    """ref: fluid partial_sum op."""
+    end = None if length == -1 else start_index + length
+    return sum(x[:, start_index:end] for x in xs)
+
+
+def partial_concat(xs, start_index=0, length=-1):
+    end = None if length == -1 else start_index + length
+    return jnp.concatenate([x[:, start_index:end] for x in xs], axis=1)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    """ref: phi strided_slice kernel."""
+    sl = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[ax] = slice(int(st), int(en), int(sd))
+    return x[tuple(sl)]
+
+
+def edit_distance(hyps, refs, hyps_length, refs_length, normalized=False):
+    """ref: phi edit_distance kernel (Levenshtein DP, host-side)."""
+    h_np = np.asarray(hyps)
+    r_np = np.asarray(refs)
+    hl = np.asarray(hyps_length)
+    rl = np.asarray(refs_length)
+    out = []
+    for b in range(h_np.shape[0]):
+        a = h_np[b, :hl[b]]
+        bseq = r_np[b, :rl[b]]
+        m, n = len(a), len(bseq)
+        dp = np.arange(n + 1, dtype=np.float32)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (a[i - 1] != bseq[j - 1]))
+        d = dp[n]
+        if normalized and n:
+            d = d / n
+        out.append(d)
+    return jnp.asarray(np.asarray(out, np.float32).reshape(-1, 1)), \
+        jnp.asarray(np.asarray([len(out)], np.int64))
+
+
+def nms(x, threshold=0.3):
+    """ref: phi nms kernel — boxes [N,4] sorted by score; returns kept
+    indices (eager, host-side greedy suppress)."""
+    boxes = np.asarray(x, np.float64)
+    n = boxes.shape[0]
+    alive = np.ones(n, bool)
+    keep = []
+    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    for i in range(n):
+        if not alive[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(boxes[i, 0], boxes[i + 1:, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[i + 1:, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[i + 1:, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[i + 1:, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        iou = inter / (area[i] + area[i + 1:] - inter)
+        alive[i + 1:] &= iou <= threshold
+    return jnp.asarray(np.asarray(keep, np.int64))
+
+
+# ---- MoE helper ops (ref: fluid/operators/ number_count, limit_by_capacity,
+# prune_gate_by_capacity, assign_pos, random_routing — the expert-parallel
+# dispatch utilities, incubate/distributed/models/moe) ----
+
+
+def number_count(numbers, upper_range):
+    return jnp.bincount(numbers.reshape(-1).astype(jnp.int32),
+                        length=int(upper_range)).astype(jnp.int64)
+
+
+def limit_by_capacity(expert_count, capacity, n_worker):
+    ec = expert_count.reshape(int(n_worker), -1)
+    out = jnp.minimum(ec, capacity[None, :].astype(ec.dtype))
+    return out.reshape(expert_count.shape)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert=None,
+                           n_worker=None):
+    """Tokens beyond an expert's capacity get gate index -1."""
+    g = gate_idx.reshape(-1).astype(jnp.int32)
+    ne = int(n_expert) if n_expert else int(expert_count.shape[0])
+    onehot = jax.nn.one_hot(g, ne, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based position per expert
+    mypos = pos.sum(axis=1) - 1
+    cap = expert_count.astype(jnp.int32)[g]
+    return jnp.where(mypos < cap, g, -1).reshape(gate_idx.shape)
+
+
+def assign_pos(x, cum_count):
+    """Scatter positions for MoE dispatch: out[j] lists token indices
+    grouped by expert (stable)."""
+    return jnp.argsort(x.reshape(-1), stable=True).astype(jnp.int64)
+
+
+def random_routing(topk_idx, topk_value, prob):
+    """Second-expert stochastic routing: keep expert k=1 only when
+    prob < 2 * gate_value."""
+    keep = prob < topk_value[:, 1] * 2.0
+    new1 = jnp.where(keep, topk_idx[:, 1], -1)
+    return jnp.stack([topk_idx[:, 0], new1], axis=1)
+
+
+def matrix_rank_tol(x, tol_tensor, use_default_tol=False, hermitian=False):
+    s = jnp.linalg.svd(x, compute_uv=False)
+    tol = jnp.asarray(tol_tensor)
+    return (s > tol[..., None]).sum(axis=-1).astype(jnp.int64)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """ref: phi lu_unpack kernel. x = packed LU [.., M, N], y = pivots."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    l = jnp.tril(x[..., :, :k], -1) + jnp.eye(m, k, dtype=x.dtype)
+    u = jnp.triu(x[..., :k, :])
+    piv = np.asarray(y).astype(np.int64) - 1
+    perm = np.arange(m)
+    for i in range(piv.shape[-1]):
+        j = piv[..., i]
+        perm[[i, int(j)]] = perm[[int(j), i]]
+    p = np.zeros((m, m), np.float32)
+    p[perm, np.arange(m)] = 1.0
+    return jnp.asarray(p).astype(x.dtype), l, u
+
+
+def binomial(count, prob):
+    return jax.random.binomial(_key(), count.astype(jnp.float32),
+                               prob).astype(jnp.int64)

@@ -128,3 +128,81 @@ def lamb(param, grad, moment1, moment2, beta1_pow, beta2_pow,
     wn, rn = np.linalg.norm(param), np.linalg.norm(r)
     trust = wn / rn if (wn > 0 and rn > 0) else 1.0
     return param - learning_rate * trust * r
+
+
+def segment_pool_mean(x, segment_ids, **kw):
+    num = segment_ids.max() + 1
+    out = np.zeros((num,) + x.shape[1:], x.dtype)
+    cnt = np.zeros(num)
+    for i, s in enumerate(segment_ids):
+        out[s] += x[i]
+        cnt[s] += 1
+    return out / np.maximum(cnt, 1)[(...,) + (None,) * (x.ndim - 1)]
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, **kw):
+    w = np.moveaxis(weight, dim, 0).reshape(weight.shape[dim], -1)
+    for _ in range(power_iters):
+        v = w.T @ u
+        v = v / (np.linalg.norm(v) + eps)
+        u = w @ v
+        u = u / (np.linalg.norm(u) + eps)
+    return weight / (u @ w @ v)
+
+
+def check_finite_and_unscale(xs, scale, **kw):
+    outs = [x / scale[0] for x in xs]
+    found = float(not all(np.isfinite(x).all() for x in xs))
+    return outs + [found]
+
+
+def fake_channel_wise_qdq_abs_max(x, bit_length=8, quant_axis=0, **kw):
+    bnt = 2 ** (bit_length - 1) - 1
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = np.abs(x).max(axis=axes, keepdims=True)
+    return [np.round(x / scale * bnt) / bnt * scale, scale.reshape(-1)]
+
+
+def weight_only_linear(x, weight, weight_scale, **kw):
+    w = weight.astype(x.dtype) * weight_scale / 127.0
+    return x @ w
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, **kw):
+    out = np.moveaxis(x.copy(), (dim1, dim2), (0, 1))
+    n = min(out.shape[0], out.shape[1])
+    i = np.arange(n)
+    rows = i - min(offset, 0)
+    cols = i + max(offset, 0)
+    keep = (rows < out.shape[0]) & (cols < out.shape[1])
+    out[rows[keep], cols[keep]] = y
+    return np.moveaxis(out, (0, 1), (dim1, dim2))
+
+
+def unique_consecutive(x, **kw):
+    import itertools
+
+    return np.asarray([k for k, _ in itertools.groupby(x.reshape(-1))],
+                      x.dtype)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker=1, **kw):
+    seen = np.zeros(n_expert, np.int64)
+    out = np.empty_like(gate_idx)
+    for i, g in enumerate(gate_idx):
+        out[i] = g if seen[g] < expert_count[g] else -1
+        seen[g] += 1
+    return out
+
+
+def lu_unpack(x, y, **kw):
+    m, n = x.shape[-2:]
+    k = min(m, n)
+    l = np.tril(x[:, :k], -1) + np.eye(m, k, dtype=x.dtype)
+    u = np.triu(x[:k, :])
+    perm = np.arange(m)
+    for i, p in enumerate(np.asarray(y, np.int64) - 1):
+        perm[[i, p]] = perm[[p, i]]
+    pm = np.zeros((m, m), x.dtype)
+    pm[perm, np.arange(m)] = 1.0
+    return [pm, l, u]
