@@ -1,2 +1,3 @@
 from .gate import GShardGate, NaiveGate, SwitchGate
+from .grad_clip import ClipGradForMOEByGlobalNorm
 from .moe_layer import MoELayer

@@ -67,6 +67,7 @@ class MoELayer(Layer):
                  gate: str = "gshard", top_k: int = 2,
                  capacity_factor: float = 1.2, activation: str = "gelu",
                  mesh: Optional[Mesh] = None, ep_axis: str = "ep",
+                 mp_axis: Optional[str] = None,
                  moe_group=None, recompute_interval: int = 0):
         super().__init__()
         if isinstance(gate, str):
@@ -87,11 +88,27 @@ class MoELayer(Layer):
         self.w_down = Parameter(jnp.asarray(
             rng.randn(num_expert, d_hidden, d_model) * scale, jnp.float32))
         self.b_down = Parameter(jnp.zeros((num_expert, d_model), jnp.float32))
+        # expert-parameter flag consumed by ClipGradForMOEByGlobalNorm (the
+        # reference marks these via no_sync/is_expert on each expert Layer)
+        for p_ in (self.w_up, self.b_up, self.w_down, self.b_down):
+            p_.is_expert = True
         if mesh is not None and ep_axis in mesh.axis_names \
                 and mesh.shape[ep_axis] > 1:
-            for p_ in (self.w_up, self.b_up, self.w_down, self.b_down):
+            # EP×TP composition: experts Shard(0) over ep; the expert FFN
+            # hidden dim additionally Megatron-sharded over mp (the
+            # reference composes MoELayer inside a TP group the same way)
+            mp = (mp_axis if mp_axis and mp_axis in mesh.axis_names
+                  and mesh.shape[mp_axis] > 1 else None)
+            specs = {
+                "w_up": P(ep_axis, None, mp),
+                "b_up": P(ep_axis, mp),
+                "w_down": P(ep_axis, mp, None),
+                "b_down": P(ep_axis, None),
+            }
+            for name, spec in specs.items():
+                p_ = getattr(self, name)
                 p_.set_value(jax.device_put(
-                    p_._value, NamedSharding(mesh, P(ep_axis))))
+                    p_._value, NamedSharding(mesh, spec)))
             self.gate.weight.set_value(jax.device_put(
                 self.gate.weight._value, NamedSharding(mesh, P())))
 

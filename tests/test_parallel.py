@@ -183,3 +183,95 @@ def test_moe_capacity_drops_tokens():
     x = paddle.rand([1, 32, 8])
     y = layer(x)
     assert np.isfinite(np.asarray(y._value)).all()
+
+
+def test_moe_ep_tp_hybrid_matches_serial():
+    """EP×TP composition under one hybrid mesh (VERDICT r1 item 9): experts
+    Shard(0) over ep, expert-FFN hidden dim sharded over mp; forward AND
+    parameter grads must match the unsharded layer."""
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    paddle.seed(6)
+    devs = np.asarray(jax.devices()[:8], dtype=object).reshape(2, 4)
+    mesh = Mesh(devs, axis_names=("ep", "mp"))
+    serial = MoELayer(d_model=16, d_hidden=32, num_expert=4, gate="switch",
+                      capacity_factor=4.0)
+    hybrid = MoELayer(d_model=16, d_hidden=32, num_expert=4, gate="switch",
+                      capacity_factor=4.0, mesh=mesh, ep_axis="ep",
+                      mp_axis="mp")
+    spec = hybrid.w_up._value.sharding.spec
+    assert tuple(spec)[0] == "ep" and tuple(spec)[2] == "mp"
+
+    x = paddle.rand([4, 8, 16])
+    xs = paddle.to_tensor(np.asarray(x._value)); xs.stop_gradient = False
+    xh = paddle.to_tensor(np.asarray(x._value)); xh.stop_gradient = False
+    ys = serial(xs)
+    yh = hybrid(xh)
+    np.testing.assert_allclose(np.asarray(ys._value), np.asarray(yh._value),
+                               rtol=1e-4, atol=1e-5)
+    (ys ** 2).mean().backward()
+    (yh ** 2).mean().backward()
+    np.testing.assert_allclose(np.asarray(serial.w_up.grad._value),
+                               np.asarray(hybrid.w_up.grad._value),
+                               rtol=1e-3, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(xs.grad._value),
+                               np.asarray(xh.grad._value),
+                               rtol=1e-3, atol=1e-6)
+
+
+def test_moe_grad_clip_global_norm():
+    """ClipGradForMOEByGlobalNorm: expert + dense norms combine into one
+    global norm; need_clip=False params pass through unscaled."""
+    from paddle_tpu.incubate.distributed.models.moe import (
+        ClipGradForMOEByGlobalNorm, MoELayer)
+
+    paddle.seed(7)
+    layer = MoELayer(d_model=8, d_hidden=16, num_expert=2, gate="gshard",
+                     capacity_factor=2.0)
+    assert layer.w_up.is_expert
+    dense = paddle.nn.Linear(8, 8)
+    params = list(layer.parameters()) + list(dense.parameters())
+
+    x = paddle.rand([2, 4, 8])
+    y = dense(layer(x))
+    ((y ** 2).mean() + 0.01 * layer.l_aux).backward()
+
+    grads = [p.grad for p in params]
+    clip = ClipGradForMOEByGlobalNorm(clip_norm=1e-4)  # force clipping
+    clipped = clip(params, grads)
+
+    total = sum(float((np.asarray(g._value, np.float64) ** 2).sum())
+                for g in grads if g is not None)
+    expect_norm = math.sqrt(total)
+    np.testing.assert_allclose(clip.last_global_norm, expect_norm, rtol=1e-4)
+    assert clip.last_moe_norm < clip.last_global_norm
+
+    factor = 1e-4 / expect_norm
+    for g, c in zip(grads, clipped):
+        if g is None:
+            continue
+        np.testing.assert_allclose(np.asarray(c._value),
+                                   np.asarray(g._value) * factor,
+                                   rtol=1e-4, atol=1e-8)
+
+    clipped_norm = math.sqrt(sum(
+        float((np.asarray(c._value, np.float64) ** 2).sum())
+        for c in clipped if c is not None))
+    np.testing.assert_allclose(clipped_norm, 1e-4, rtol=1e-4)
+
+
+def test_moe_grad_clip_respects_need_clip():
+    from paddle_tpu.incubate.distributed.models.moe import \
+        ClipGradForMOEByGlobalNorm
+
+    from paddle_tpu.nn.layer import Parameter
+
+    p1 = Parameter(jnp.ones(4))
+    p2 = Parameter(jnp.ones(4))
+    p2.need_clip = False
+    g1 = paddle.to_tensor(np.full(4, 10.0, np.float32))
+    g2 = paddle.to_tensor(np.full(4, 10.0, np.float32))
+    clip = ClipGradForMOEByGlobalNorm(clip_norm=1.0)
+    c1, c2 = clip([p1, p2], [g1, g2])
+    assert float(np.abs(np.asarray(c1._value)).max()) < 1.0
+    np.testing.assert_allclose(np.asarray(c2._value), 10.0)
