@@ -29,6 +29,9 @@ from .transformer import (
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
+from .rnn import (
+    GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN, SimpleRNNCell,
+)
 
 # paddle compat: nn.initializer.* style access is already available.
 ClipGradByNorm = None  # set by optimizer.clip at import

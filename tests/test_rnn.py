@@ -1,0 +1,127 @@
+"""RNN/LSTM/GRU layers vs torch goldens (weights copied weight-for-weight,
+matching the reference's cudnn gate order — python/paddle/nn/layer/rnn.py)."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _copy_to_torch(net, tnet):
+    sd = {k: torch.from_numpy(np.asarray(v._value))
+          for k, v in net.state_dict().items()}
+    tnet.load_state_dict(sd)
+
+
+def _grad_of(net, out_sum):
+    out_sum.backward()
+    return {k: np.asarray(p.grad._value) for k, p in
+            net.state_dict().items() if p.grad is not None}
+
+
+@pytest.mark.parametrize("mode,tcls", [("LSTM", torch.nn.LSTM),
+                                       ("GRU", torch.nn.GRU),
+                                       ("RNN", torch.nn.RNN)])
+@pytest.mark.parametrize("layers,direction", [(1, "forward"),
+                                              (2, "bidirect")])
+def test_rnn_matches_torch(mode, tcls, layers, direction):
+    paddle.seed(42)
+    cls = {"LSTM": nn.LSTM, "GRU": nn.GRU, "RNN": nn.SimpleRNN}[mode]
+    net = cls(input_size=6, hidden_size=5, num_layers=layers,
+              direction=direction)
+    bidir = direction == "bidirect"
+    tnet = tcls(6, 5, num_layers=layers, batch_first=True,
+                bidirectional=bidir)
+    _copy_to_torch(net, tnet)
+
+    x = np.random.RandomState(0).randn(3, 7, 6).astype("float32")
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    out, state = net(xt)
+    tx = torch.from_numpy(x).requires_grad_(True)
+    tout, tstate = tnet(tx)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               tout.detach().numpy(), rtol=1e-4, atol=1e-5)
+    if mode == "LSTM":
+        np.testing.assert_allclose(np.asarray(state[0]._value),
+                                   tstate[0].detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(state[1]._value),
+                                   tstate[1].detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(state._value),
+                                   tstate.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    # gradient parity through the scan backward
+    (out ** 2).sum().backward()
+    (tout ** 2).sum().backward()
+    np.testing.assert_allclose(np.asarray(xt.grad._value),
+                               tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+    tgrads = {k: v.grad.numpy() for k, v in tnet.named_parameters()}
+    for k, p in net.state_dict().items():
+        np.testing.assert_allclose(np.asarray(p.grad._value), tgrads[k],
+                                   rtol=1e-3, atol=1e-4, err_msg=k)
+
+
+def test_lstm_cell_single_step():
+    paddle.seed(1)
+    cell = nn.LSTMCell(4, 3)
+    tcell = torch.nn.LSTMCell(4, 3)
+    _copy_to_torch(cell, tcell)
+    x = np.random.RandomState(1).randn(2, 4).astype("float32")
+    h, (hn, cn) = cell(paddle.to_tensor(x))
+    th, tc = tcell(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(hn._value), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cn._value), tc.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_cell_single_step():
+    paddle.seed(2)
+    cell = nn.GRUCell(4, 3)
+    tcell = torch.nn.GRUCell(4, 3)
+    _copy_to_torch(cell, tcell)
+    x = np.random.RandomState(2).randn(2, 4).astype("float32")
+    h, _ = cell(paddle.to_tensor(x))
+    th = tcell(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(h._value), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_generic_rnn_wrapper_matches_fused():
+    paddle.seed(3)
+    cell = nn.LSTMCell(4, 3)
+    wrapper = nn.RNN(cell)
+    fused = nn.LSTM(4, 3)
+    # copy cell weights into the fused net's layer-0 slots
+    for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+        getattr(fused, f"{name}_l0").set_value(
+            np.asarray(getattr(cell, name)._value))
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(2, 5, 4).astype("float32"))
+    out_w, (h_w, c_w) = wrapper(x)
+    out_f, (h_f, c_f) = fused(x)
+    np.testing.assert_allclose(np.asarray(out_w._value),
+                               np.asarray(out_f._value),
+                               rtol=1e-5, atol=1e-6)
+    # wrapper final state is (h [B,H], c [B,H]); fused stacks layers [L,B,H]
+    np.testing.assert_allclose(np.asarray(h_w._value),
+                               np.asarray(h_f._value)[0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_w._value),
+                               np.asarray(c_f._value)[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_time_major_roundtrip():
+    paddle.seed(4)
+    net = nn.GRU(4, 3, time_major=True)
+    x = np.random.RandomState(4).randn(5, 2, 4).astype("float32")  # [T,B,I]
+    out, h = net(paddle.to_tensor(x))
+    assert tuple(out.shape) == (5, 2, 3)
+    assert tuple(h.shape) == (1, 2, 3)
