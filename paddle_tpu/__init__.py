@@ -65,6 +65,8 @@ from . import inference
 from . import framework
 from . import static
 from . import device
+from . import sparse
+from . import distribution
 
 
 def save(obj, path, **kwargs):

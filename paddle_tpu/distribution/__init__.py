@@ -1,0 +1,522 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Analog of python/paddle/distribution (Distribution base in
+distribution/distribution.py, Normal/Uniform/Categorical/Beta/Dirichlet/...
+and kl_divergence in distribution/kl.py). Sampling draws from the framework
+generator (paddle_tpu.ops.random) so paddle.seed governs it; densities use
+jax.scipy.stats where available.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Laplace", "LogNormal", "Gumbel",
+    "Geometric", "Multinomial", "kl_divergence", "register_kl",
+]
+
+
+def _key():
+    from ..ops.random import default_generator
+
+    return default_generator().next_key()
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    """Base (analog of paddle.distribution.Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return Tensor(jstats.norm.logpdf(_val(value), self.loc, self.scale))
+
+    def entropy(self):
+        h = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(h, self._batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(), self._extend(shape))
+        return Tensor(jnp.exp(self.loc + self.scale * eps))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(jstats.norm.logpdf(jnp.log(v), self.loc, self.scale)
+                      - jnp.log(v))
+
+    def entropy(self):
+        return Tensor(self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return Tensor(lp)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self._batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is None:
+            self.logits = _val(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        else:
+            self.probs = _val(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.bernoulli(
+            _key(), self.probs, self._extend(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            self.logits = jax.nn.log_softmax(_val(logits), axis=-1)
+        else:
+            # reference Categorical(logits=...) actually takes unnormalized
+            # *probabilities*; accept either keyword
+            p = _val(probs if probs is not None else logits)
+            self.logits = jnp.log(p / p.sum(-1, keepdims=True))
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(_key(), self.logits,
+                                     shape=tuple(shape) + self._batch_shape)
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        idx = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self.logits, idx[..., None], axis=-1)[..., 0])
+
+    def probs_of(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        return Tensor(-(self.probs * self.logits).sum(-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta,
+                                      self._extend(shape)))
+
+    def log_prob(self, value):
+        return Tensor(jstats.beta.logpdf(_val(value), self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return Tensor(c / c.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(
+            _key(), self.concentration,
+            tuple(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        return Tensor(jstats.dirichlet.logpdf(_val(value).T,
+                                              self.concentration))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        e = jax.random.exponential(_key(), self._extend(shape))
+        return Tensor(e / self.rate)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(jnp.where(v >= 0, jnp.log(self.rate) - self.rate * v,
+                                -jnp.inf))
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        g = jax.random.gamma(_key(), self.concentration, self._extend(shape))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        return Tensor(jstats.gamma.logpdf(_val(value), self.concentration,
+                                          scale=1.0 / self.rate))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        a = self.concentration
+        return Tensor(a - jnp.log(self.rate) + gammaln(a)
+                      + (1 - a) * digamma(a))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2,
+                                       self._batch_shape))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape),
+                               minval=-0.5, maxval=0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return Tensor(jstats.laplace.logpdf(_val(value), self.loc,
+                                            self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * jnp.euler_gamma)
+
+    @property
+    def variance(self):
+        return Tensor(math.pi ** 2 / 6 * self.scale ** 2
+                      * jnp.ones(self._batch_shape))
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * g)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + jnp.euler_gamma
+                      * jnp.ones(self._batch_shape))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _val(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.probs)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        k = _val(value)
+        return Tensor(k * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _val(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            _key(), jnp.log(self.probs),
+            shape=(self.total_count,) + tuple(shape) + self._batch_shape)
+        counts = jax.nn.one_hot(draws, n).sum(axis=0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _val(value)
+        logp = (gammaln(self.total_count + 1.0)
+                - gammaln(v + 1.0).sum(-1)
+                + (v * jnp.log(self.probs)).sum(-1))
+        return Tensor(logp)
+
+
+# --------------------------------------------------------------------------
+# KL divergence registry (analog of python/paddle/distribution/kl.py)
+# --------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return Tensor((p.probs * (p.logits - q.logits)).sum(-1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+
+    return Tensor(
+        (p.concentration - q.concentration) * digamma(p.concentration)
+        - gammaln(p.concentration) + gammaln(q.concentration)
+        + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
+        + p.concentration * (q.rate / p.rate - 1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+
+    pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
+    return Tensor(betaln(qa, qb) - betaln(pa, pb)
+                  + (pa - qa) * digamma(pa) + (pb - qb) * digamma(pb)
+                  + (qa - pa + qb - pb) * digamma(pa + pb))

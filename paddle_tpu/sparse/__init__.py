@@ -1,0 +1,211 @@
+"""paddle_tpu.sparse — sparse COO/CSR tensors and ops.
+
+Analog of python/paddle/sparse (SparseCooTensor/SparseCsrTensor in
+paddle/phi/core/sparse_coo_tensor.h, sparse kernels in
+paddle/phi/kernels/sparse/). TPU-native backing: jax.experimental.sparse
+BCOO/BCSR — XLA lowers sparse matmuls to gather/scatter+dot programs, which
+is the right TPU shape for moderate sparsity (the reference's cuSPARSE role).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_sparse_coo", "is_sparse_csr",
+    "add", "subtract", "multiply", "matmul", "masked_matmul", "relu",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (indices [ndim, nnz], values [nnz]).
+
+    Mirrors the reference's SparseCooTensor surface: ``indices()``,
+    ``values()``, ``to_dense()``, ``nnz()``, arithmetic via the module
+    functions."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- reference surface -------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # [ndim, nnz] reference layout
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._bcoo))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def transpose(self, perm) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.transpose(tuple(perm)))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (crows/cols/values — reference SparseCsrTensor)."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    def crows(self) -> Tensor:
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._bcsr.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcsr.data)
+
+    def nnz(self) -> int:
+        return int(self._bcsr.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True):
+    """Build a COO tensor from [ndim, nnz] indices (reference layout,
+    python/paddle/sparse/creation.py)."""
+    idx = _val(indices).T.astype(jnp.int32)         # -> [nnz, ndim]
+    val = _val(values)
+    if dtype is not None:
+        val = val.astype(jnp.dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(idx).max(axis=0))
+    return SparseCooTensor(jsparse.BCOO((val, idx), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, **kw):
+    val = _val(values)
+    if dtype is not None:
+        val = val.astype(jnp.dtype(dtype))
+    bcsr = jsparse.BCSR((val, _val(cols).astype(jnp.int32),
+                         _val(crows).astype(jnp.int32)), shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x) -> bool:
+    return isinstance(x, SparseCsrTensor)
+
+
+def _coo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def add(x, y):
+    """Sparse+sparse or sparse+dense elementwise add."""
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        out = _coo(x) + _coo(y)
+        return SparseCooTensor(out.sum_duplicates())
+    return Tensor(_coo(x).todense() + _val(y))
+
+
+def subtract(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        neg = _coo(y)
+        neg = jsparse.BCOO((-neg.data, neg.indices), shape=neg.shape)
+        return SparseCooTensor((_coo(x) + neg).sum_duplicates())
+    return Tensor(_coo(x).todense() - _val(y))
+
+
+def multiply(x, y):
+    """Elementwise multiply: sparse * dense keeps the sparse pattern."""
+    c = _coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return SparseCooTensor(
+            jsparse.bcoo_multiply_sparse(c, _coo(y)))
+    dense_vals = _val(y)[tuple(c.indices[:, i] for i in range(c.ndim))]
+    return SparseCooTensor(jsparse.BCOO((c.data * dense_vals, c.indices),
+                                        shape=c.shape))
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense (reference paddle.sparse.matmul)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        out = _coo(x) @ _val(y)
+        return Tensor(out)
+    return Tensor(_val(x) @ _coo(y).todense())
+
+
+def masked_matmul(x, y, mask: SparseCooTensor):
+    """(x @ y) sampled at mask's sparsity pattern (reference
+    paddle.sparse.masked_matmul — the SDDMM kernel)."""
+    m = _coo(mask)
+    rows = m.indices[:, 0]
+    cols = m.indices[:, 1]
+    xv, yv = _val(x), _val(y)
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+def relu(x):
+    c = _coo(x)
+    return SparseCooTensor(jsparse.BCOO((jax.nn.relu(c.data), c.indices),
+                                        shape=c.shape))
+
+
+class nn:
+    """paddle.sparse.nn subset."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

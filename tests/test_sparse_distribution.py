@@ -1,0 +1,196 @@
+"""paddle.sparse (COO/CSR over BCOO/BCSR) and paddle.distribution."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import sparse as S
+
+
+# ----------------------------------------------------------------- sparse
+
+def _coo_fixture():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    return S.sparse_coo_tensor(paddle.to_tensor(indices),
+                               paddle.to_tensor(values), shape=[3, 3])
+
+
+def test_sparse_coo_roundtrip():
+    t = _coo_fixture()
+    assert t.shape == [3, 3] and t.nnz() == 3
+    dense = np.zeros((3, 3), np.float32)
+    dense[[0, 1, 2], [1, 2, 0]] = [1, 2, 3]
+    np.testing.assert_allclose(np.asarray(t.to_dense()._value), dense)
+    np.testing.assert_allclose(np.asarray(t.indices()._value),
+                               [[0, 1, 2], [1, 2, 0]])
+    np.testing.assert_allclose(np.asarray(t.values()._value), [1, 2, 3])
+
+
+def test_sparse_csr_roundtrip():
+    t = S.sparse_csr_tensor([0, 1, 2, 3], [1, 2, 0], [1.0, 2.0, 3.0], [3, 3])
+    dense = np.zeros((3, 3), np.float32)
+    dense[[0, 1, 2], [1, 2, 0]] = [1, 2, 3]
+    np.testing.assert_allclose(np.asarray(t.to_dense()._value), dense)
+    coo = t.to_sparse_coo()
+    assert S.is_sparse_coo(coo) and coo.nnz() == 3
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(np.asarray(back.to_dense()._value), dense)
+
+
+def test_sparse_arith_and_matmul():
+    a = _coo_fixture()
+    b = _coo_fixture()
+    s = S.add(a, b)
+    np.testing.assert_allclose(np.asarray(s.to_dense()._value),
+                               2 * np.asarray(a.to_dense()._value))
+    d = S.subtract(a, b)
+    np.testing.assert_allclose(np.asarray(d.to_dense()._value), 0)
+
+    x = np.random.RandomState(0).randn(3, 4).astype("float32")
+    out = S.matmul(a, paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(a.to_dense()._value) @ x,
+                               rtol=1e-5)
+
+    # sparse * dense keeps the pattern
+    m = S.multiply(a, paddle.to_tensor(np.full((3, 3), 2.0, np.float32)))
+    np.testing.assert_allclose(np.asarray(m.to_dense()._value),
+                               2 * np.asarray(a.to_dense()._value))
+
+
+def test_sparse_masked_matmul_and_relu():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 5).astype("float32")
+    y = rng.randn(5, 3).astype("float32")
+    mask = _coo_fixture()
+    out = S.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+    full = x @ y
+    want = np.zeros((3, 3), np.float32)
+    want[[0, 1, 2], [1, 2, 0]] = full[[0, 1, 2], [1, 2, 0]]
+    np.testing.assert_allclose(np.asarray(out.to_dense()._value), want,
+                               rtol=1e-5)
+
+    neg = S.sparse_coo_tensor([[0, 1], [1, 0]], [-1.0, 2.0], [2, 2])
+    r = S.relu(neg)
+    np.testing.assert_allclose(np.asarray(r.to_dense()._value),
+                               [[0, 0], [2, 0]])
+
+
+# ----------------------------------------------------------- distribution
+
+def test_normal_moments_logprob_entropy():
+    n = D.Normal(1.0, 2.0)
+    np.testing.assert_allclose(float(n.mean._value), 1.0)
+    np.testing.assert_allclose(float(n.variance._value), 4.0)
+    np.testing.assert_allclose(float(n.log_prob(0.5)._value),
+                               scipy.stats.norm.logpdf(0.5, 1.0, 2.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(n.entropy()._value),
+                               scipy.stats.norm.entropy(1.0, 2.0), rtol=1e-5)
+    paddle.seed(0)
+    s = n.sample([20000])
+    assert abs(float(np.asarray(s._value).mean()) - 1.0) < 0.05
+
+
+def test_uniform_categorical_bernoulli():
+    u = D.Uniform(0.0, 4.0)
+    np.testing.assert_allclose(float(u.log_prob(1.0)._value), -np.log(4.0),
+                               rtol=1e-6)
+    assert np.isneginf(float(u.log_prob(5.0)._value))
+
+    c = D.Categorical(logits=paddle.to_tensor([0.0, 0.0, np.log(2.0)]))
+    np.testing.assert_allclose(np.asarray(c.probs), [0.25, 0.25, 0.5],
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(c.entropy()._value),
+                               scipy.stats.entropy([0.25, 0.25, 0.5]),
+                               rtol=1e-5)
+
+    b = D.Bernoulli(0.3)
+    np.testing.assert_allclose(float(b.log_prob(1.0)._value), np.log(0.3),
+                               rtol=1e-5)
+    paddle.seed(1)
+    assert abs(float(np.asarray(b.sample([10000])._value).mean()) - 0.3) < 0.02
+
+
+@pytest.mark.parametrize("dist,scipy_dist", [
+    (lambda: D.Beta(2.0, 3.0), scipy.stats.beta(2.0, 3.0)),
+    (lambda: D.Exponential(1.5), scipy.stats.expon(scale=1 / 1.5)),
+    (lambda: D.Gamma(2.0, 3.0), scipy.stats.gamma(2.0, scale=1 / 3.0)),
+    (lambda: D.Laplace(0.5, 2.0), scipy.stats.laplace(0.5, 2.0)),
+    (lambda: D.Gumbel(0.5, 2.0), scipy.stats.gumbel_r(0.5, 2.0)),
+    (lambda: D.LogNormal(0.2, 0.5), scipy.stats.lognorm(0.5, scale=np.exp(0.2))),
+])
+def test_logprob_matches_scipy(dist, scipy_dist):
+    d = dist()
+    for v in (0.3, 0.9, 1.7):
+        np.testing.assert_allclose(float(d.log_prob(v)._value),
+                                   scipy_dist.logpdf(v), rtol=1e-4,
+                                   atol=1e-6)
+
+
+def test_kl_divergences():
+    p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+    want = (np.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+    np.testing.assert_allclose(float(D.kl_divergence(p, q)._value), want,
+                               rtol=1e-5)
+
+    cp = D.Categorical(logits=paddle.to_tensor([0.0, 1.0]))
+    cq = D.Categorical(logits=paddle.to_tensor([1.0, 0.0]))
+    pk = np.asarray(cp.probs)
+    qk = np.asarray(cq.probs)
+    np.testing.assert_allclose(float(D.kl_divergence(cp, cq)._value),
+                               (pk * np.log(pk / qk)).sum(), rtol=1e-5)
+
+    bp, bq = D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)
+    # numeric check via quadrature
+    xs = np.linspace(1e-4, 1 - 1e-4, 20001)
+    pd = scipy.stats.beta(2, 3).pdf(xs)
+    qd = scipy.stats.beta(3, 2).pdf(xs)
+    want = np.trapezoid(pd * np.log(pd / qd), xs)
+    np.testing.assert_allclose(float(D.kl_divergence(bp, bq)._value), want,
+                               rtol=1e-3)
+
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(p, cq)
+
+
+def test_dirichlet_multinomial_geometric():
+    d = D.Dirichlet(paddle.to_tensor([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(d.mean._value),
+                               [1 / 6, 2 / 6, 3 / 6], rtol=1e-6)
+    np.testing.assert_allclose(
+        float(d.log_prob(paddle.to_tensor([0.2, 0.3, 0.5]))._value),
+        scipy.stats.dirichlet([1.0, 2.0, 3.0]).logpdf([0.2, 0.3, 0.5]),
+        rtol=1e-5)
+
+    m = D.Multinomial(10, paddle.to_tensor([0.2, 0.3, 0.5]))
+    np.testing.assert_allclose(
+        float(m.log_prob(paddle.to_tensor([2.0, 3.0, 5.0]))._value),
+        scipy.stats.multinomial(10, [0.2, 0.3, 0.5]).logpmf([2, 3, 5]),
+        rtol=1e-5)
+    paddle.seed(2)
+    s = m.sample([500])
+    assert np.asarray(s._value).sum(-1).max() == 10
+
+    g = D.Geometric(0.25)
+    np.testing.assert_allclose(float(g.log_prob(3.0)._value),
+                               scipy.stats.geom(0.25).logpmf(4), rtol=1e-5)
+
+
+def test_rsample_is_differentiable_via_jax():
+    import jax
+
+    def loss(mu):
+        import jax.numpy as jnp
+        # reparameterized: d/dmu E[(x)^2] with x = mu + eps
+        eps = 0.7
+        return (mu + eps) ** 2
+
+    g = jax.grad(loss)(1.0)
+    np.testing.assert_allclose(float(g), 2 * 1.7, rtol=1e-6)
+    # and the Tensor-level rsample path produces finite values
+    n = D.Normal(paddle.to_tensor([0.0]), paddle.to_tensor([1.0]))
+    assert np.isfinite(np.asarray(n.rsample([4])._value)).all()
