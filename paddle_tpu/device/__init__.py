@@ -25,3 +25,88 @@ def get_available_device():
     import jax
 
     return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+# ---------------------------------------------------------------------------
+# Memory stats (analog of paddle/phi/core/memory/stats.h +
+# python/paddle/device/cuda max_memory_allocated/max_memory_reserved).
+# Backed by PJRT per-device memory_stats(); CPU PJRT reports none, so the
+# functions degrade to 0 there (documented) instead of raising.
+# ---------------------------------------------------------------------------
+
+_mem_baselines = {}
+
+
+def _device_of(device=None):
+    import jax
+
+    if device is None:
+        return jax.local_devices()[0]
+    if isinstance(device, int):
+        return jax.local_devices()[device]
+    return device
+
+
+def _stats(device=None) -> dict:
+    d = _device_of(device)
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Live bytes allocated on the device (stats.h bytes_in_use)."""
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak live bytes since process start (or the last reset)."""
+    peak = int(_stats(device).get("peak_bytes_in_use", 0))
+    base = _mem_baselines.get(("alloc", _device_of(device).id), 0)
+    return max(peak - base, 0)
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved from the system by the allocator pool."""
+    s = _stats(device)
+    return int(s.get("bytes_reserved", s.get("pool_bytes", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("peak_pool_bytes", 0)))
+
+
+def reset_max_memory_allocated(device=None):
+    """PJRT cannot clear its peak counter; record the current peak as the
+    baseline so subsequent reads are relative (reference semantics)."""
+    _mem_baselines[("alloc", _device_of(device).id)] = int(
+        _stats(device).get("peak_bytes_in_use", 0))
+
+
+def empty_cache():
+    """Analog of paddle.device.cuda.empty_cache — XLA owns the HBM pool, so
+    this only hints the host-side GC."""
+    import gc
+
+    gc.collect()
+
+
+def memory_summary(device=None) -> str:
+    s = _stats(device)
+    d = _device_of(device)
+    lines = [f"device {d.platform}:{d.id} memory stats:"]
+    for k in sorted(s):
+        lines.append(f"  {k:32s} {s[k]}")
+    return "\n".join(lines)
+
+
+class cuda:
+    """Source-compat shim: paddle.device.cuda.* maps onto the PJRT stats."""
+
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)

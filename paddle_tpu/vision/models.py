@@ -194,3 +194,182 @@ def vgg16(pretrained=False, batch_norm=False, num_classes=1000):
     cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
            512, 512, 512, "M", 512, 512, 512, "M"]
     return VGG(cfg, num_classes=num_classes, batch_norm=batch_norm)
+
+
+def vgg11(pretrained=False, batch_norm=False, num_classes=1000):
+    cfg = [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
+    return VGG(cfg, num_classes=num_classes, batch_norm=batch_norm)
+
+
+def vgg13(pretrained=False, batch_norm=False, num_classes=1000):
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, "M",
+           512, 512, "M", 512, 512, "M"]
+    return VGG(cfg, num_classes=num_classes, batch_norm=batch_norm)
+
+
+def vgg19(pretrained=False, batch_norm=False, num_classes=1000):
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+           512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
+    return VGG(cfg, num_classes=num_classes, batch_norm=batch_norm)
+
+
+class AlexNet(Layer):
+    """Analog of python/paddle/vision/models/alexnet.py."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        from ..nn import Dropout
+
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(), MaxPool2D(3, 2),
+        )
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Flatten(),
+            Dropout(0.5), Linear(256 * 36, 4096), ReLU(),
+            Dropout(0.5), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(self.avgpool(self.features(x)))
+
+
+def alexnet(pretrained=False, num_classes=1000, **kw):
+    return AlexNet(num_classes=num_classes)
+
+
+class _InvertedResidual(Layer):
+    """MobileNetV2 block (analog of
+    python/paddle/vision/models/mobilenetv2.py InvertedResidual)."""
+
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        from ..nn import ReLU6
+
+        layers = []
+        if expand_ratio != 1:
+            layers += [Conv2D(inp, hidden, 1, bias_attr=False),
+                       BatchNorm2D(hidden), ReLU6()]
+        layers += [
+            Conv2D(hidden, hidden, 3, stride=stride, padding=1,
+                   groups=hidden, bias_attr=False),
+            BatchNorm2D(hidden), ReLU6(),
+            Conv2D(hidden, oup, 1, bias_attr=False), BatchNorm2D(oup),
+        ]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """Analog of python/paddle/vision/models/mobilenetv2.py."""
+
+    CFG = [
+        # t, c, n, s
+        (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+        (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+    ]
+
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+        from ..nn import Dropout, ReLU6
+
+        inp = int(32 * scale)
+        feats = [Conv2D(3, inp, 3, stride=2, padding=1, bias_attr=False),
+                 BatchNorm2D(inp), ReLU6()]
+        for t, c, n, s in self.CFG:
+            out_c = int(c * scale)
+            for i in range(n):
+                feats.append(_InvertedResidual(inp, out_c,
+                                               s if i == 0 else 1, t))
+                inp = out_c
+        last = int(1280 * max(1.0, scale))
+        feats += [Conv2D(inp, last, 1, bias_attr=False), BatchNorm2D(last),
+                  ReLU6()]
+        self.features = Sequential(*feats)
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.classifier = Sequential(Flatten(), Dropout(0.2),
+                                     Linear(last, num_classes))
+
+    def forward(self, x):
+        return self.classifier(self.pool(self.features(x)))
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, num_classes=1000, **kw):
+    return MobileNetV2(num_classes=num_classes, scale=scale)
+
+
+class _DenseLayer(Layer):
+    def __init__(self, in_c, growth_rate, bn_size):
+        super().__init__()
+        self.block = Sequential(
+            BatchNorm2D(in_c), ReLU(),
+            Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False),
+            BatchNorm2D(bn_size * growth_rate), ReLU(),
+            Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                   bias_attr=False),
+        )
+
+    def forward(self, x):
+        from ..ops import manip
+
+        return manip.concat([x, self.block(x)], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        from ..nn import AvgPool2D
+
+        self.block = Sequential(
+            BatchNorm2D(in_c), ReLU(),
+            Conv2D(in_c, out_c, 1, bias_attr=False), AvgPool2D(2, 2))
+
+    def forward(self, x):
+        return self.block(x)
+
+
+class DenseNet(Layer):
+    """Analog of python/paddle/vision/models/densenet.py."""
+
+    CFGS = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+            169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000):
+        super().__init__()
+        block_cfg = self.CFGS[layers]
+        c = 2 * growth_rate
+        feats = [Conv2D(3, c, 7, stride=2, padding=3, bias_attr=False),
+                 BatchNorm2D(c), ReLU(), MaxPool2D(3, 2, padding=1)]
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [BatchNorm2D(c), ReLU()]
+        self.features = Sequential(*feats)
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.classifier = Sequential(Flatten(), Linear(c, num_classes))
+
+    def forward(self, x):
+        return self.classifier(self.pool(self.features(x)))
+
+
+def densenet121(pretrained=False, num_classes=1000, **kw):
+    return DenseNet(121, num_classes=num_classes)
+
+
+def densenet169(pretrained=False, num_classes=1000, **kw):
+    return DenseNet(169, num_classes=num_classes)
