@@ -1,0 +1,312 @@
+// TCP key-value coordination store — C++ native runtime component.
+//
+// Analog of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121, socket.cpp): the
+// rendezvous/bootstrap KV used for comm-id exchange and barriers. The JAX
+// coordination service owns jax.distributed bootstrap; this store is the
+// framework-level equivalent surfaced as paddle.distributed.TCPStore —
+// master hosts the map, clients SET/GET/ADD/WAIT over a length-prefixed
+// binary protocol.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image — see
+// paddle_tpu/distributed/store.py for the Python wrapper).
+//
+// Protocol (all integers little-endian):
+//   request:  u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   response: i64 status | u32 plen | payload bytes
+//   ops: 1=SET 2=GET 3=ADD(value=i64 delta, payload=i64 new value)
+//        4=WAIT(value=u32 timeout_ms) 5=DEL 6=NUM_KEYS
+//   status: 0 ok, -1 not found / timeout
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> kv;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, p + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, p + sent, n - sent, 0);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_response(int fd, int64_t status, const std::string& payload) {
+  uint32_t plen = static_cast<uint32_t>(payload.size());
+  std::vector<char> out(sizeof(status) + sizeof(plen) + payload.size());
+  std::memcpy(out.data(), &status, sizeof(status));
+  std::memcpy(out.data() + sizeof(status), &plen, sizeof(plen));
+  std::memcpy(out.data() + sizeof(status) + sizeof(plen), payload.data(),
+              payload.size());
+  return write_full(fd, out.data(), out.size());
+}
+
+struct Server {
+  Store store;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::mutex conn_mu;
+  std::vector<std::thread> conn_threads;
+
+  void handle_conn(int fd) {
+    for (;;) {
+      uint8_t op;
+      uint32_t klen;
+      if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_full(fd, key.data(), klen)) break;
+      uint32_t vlen;
+      if (!read_full(fd, &vlen, 4)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+      bool ok = true;
+      switch (op) {
+        case 1: {  // SET
+          {
+            std::lock_guard<std::mutex> g(store.mu);
+            store.kv[key] = val;
+          }
+          store.cv.notify_all();
+          ok = send_response(fd, 0, "");
+          break;
+        }
+        case 2: {  // GET
+          std::lock_guard<std::mutex> g(store.mu);
+          auto it = store.kv.find(key);
+          ok = (it == store.kv.end()) ? send_response(fd, -1, "")
+                                      : send_response(fd, 0, it->second);
+          break;
+        }
+        case 3: {  // ADD
+          int64_t delta = 0;
+          if (val.size() == sizeof(delta))
+            std::memcpy(&delta, val.data(), sizeof(delta));
+          int64_t next = 0;
+          {
+            std::lock_guard<std::mutex> g(store.mu);
+            auto it = store.kv.find(key);
+            if (it != store.kv.end() && it->second.size() == sizeof(next))
+              std::memcpy(&next, it->second.data(), sizeof(next));
+            next += delta;
+            std::string stored(sizeof(next), '\0');
+            std::memcpy(stored.data(), &next, sizeof(next));
+            store.kv[key] = stored;
+          }
+          store.cv.notify_all();
+          std::string payload(sizeof(next), '\0');
+          std::memcpy(payload.data(), &next, sizeof(next));
+          ok = send_response(fd, 0, payload);
+          break;
+        }
+        case 4: {  // WAIT
+          uint32_t timeout_ms = 0;
+          if (val.size() == sizeof(timeout_ms))
+            std::memcpy(&timeout_ms, val.data(), sizeof(timeout_ms));
+          std::unique_lock<std::mutex> g(store.mu);
+          bool found = store.cv.wait_for(
+              g, std::chrono::milliseconds(timeout_ms),
+              [&] { return store.kv.count(key) > 0 || !running.load(); });
+          ok = send_response(fd, (found && store.kv.count(key)) ? 0 : -1, "");
+          break;
+        }
+        case 5: {  // DEL
+          std::lock_guard<std::mutex> g(store.mu);
+          ok = send_response(fd, store.kv.erase(key) ? 0 : -1, "");
+          break;
+        }
+        case 6: {  // NUM_KEYS
+          int64_t n;
+          {
+            std::lock_guard<std::mutex> g(store.mu);
+            n = static_cast<int64_t>(store.kv.size());
+          }
+          std::string payload(sizeof(n), '\0');
+          std::memcpy(payload.data(), &n, sizeof(n));
+          ok = send_response(fd, 0, payload);
+          break;
+        }
+        default:
+          ok = send_response(fd, -2, "");
+      }
+      if (!ok) break;
+    }
+    ::close(fd);
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return false;
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 128) != 0) return false;
+    running = true;
+    accept_thread = std::thread([this] {
+      while (running.load()) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;
+        int one2 = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+        std::lock_guard<std::mutex> g(conn_mu);
+        conn_threads.emplace_back([this, fd] { handle_conn(fd); });
+      }
+    });
+    return true;
+  }
+
+  void stop() {
+    running = false;
+    store.cv.notify_all();
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::lock_guard<std::mutex> g(conn_mu);
+    for (auto& t : conn_threads)
+      if (t.joinable()) t.detach();  // blocked in recv; sockets closed by peer
+    conn_threads.clear();
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+
+  bool request(uint8_t op, const std::string& key, const std::string& val,
+               int64_t* status, std::string* payload) {
+    std::lock_guard<std::mutex> g(mu);
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    uint32_t vlen = static_cast<uint32_t>(val.size());
+    std::vector<char> out(1 + 4 + key.size() + 4 + val.size());
+    size_t off = 0;
+    std::memcpy(out.data() + off, &op, 1); off += 1;
+    std::memcpy(out.data() + off, &klen, 4); off += 4;
+    std::memcpy(out.data() + off, key.data(), klen); off += klen;
+    std::memcpy(out.data() + off, &vlen, 4); off += 4;
+    std::memcpy(out.data() + off, val.data(), vlen);
+    if (!write_full(fd, out.data(), out.size())) return false;
+    uint32_t plen;
+    if (!read_full(fd, status, 8) || !read_full(fd, &plen, 4)) return false;
+    payload->assign(plen, '\0');
+    if (plen && !read_full(fd, payload->data(), plen)) return false;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ts_server_start(int port) {
+  auto* s = new Server();
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int ts_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void ts_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop();
+  delete s;
+}
+
+void* ts_client_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+         0) {
+    if (std::chrono::steady_clock::now() > deadline) {
+      ::close(fd);
+      return nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+// returns payload length, or -1 (not found/timeout), or -2 (io error)
+long ts_client_request(void* h, int op, const char* key, const char* val,
+                       long vlen, char* out, long outcap) {
+  auto* c = static_cast<Client*>(h);
+  int64_t status = 0;
+  std::string payload;
+  if (!c->request(static_cast<uint8_t>(op), key, std::string(val, vlen),
+                  &status, &payload))
+    return -2;
+  if (status != 0) return -1;
+  long n = static_cast<long>(payload.size());
+  if (out && n <= outcap) std::memcpy(out, payload.data(), n);
+  return n;
+}
+
+void ts_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

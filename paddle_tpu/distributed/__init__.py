@@ -13,6 +13,7 @@ Analog of python/paddle/distributed (SURVEY.md §2.6-2.7). Layering:
 
 from . import env
 from .env import ParallelEnv, get_rank, get_world_size, init_distributed
+from .store import TCPStore
 
 from .placements import Partial, Placement, Replicate, Shard
 from .process_mesh import ProcessMesh, auto_mesh, get_mesh, init_mesh, set_mesh

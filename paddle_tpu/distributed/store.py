@@ -1,0 +1,158 @@
+"""TCPStore — Python surface over the C++ coordination store.
+
+Analog of paddle.distributed.TCPStore (C++ core at
+paddle/phi/core/distributed/store/tcp_store.h:121; Python binding in
+paddle/fluid/pybind). The native library (paddle_tpu/csrc/tcp_store.cpp)
+is compiled once on first use with g++ (ctypes ABI — no pybind11 in this
+toolchain) and cached next to the source.
+
+Role in the TPU runtime: jax.distributed's coordination service owns the
+PJRT bootstrap; TCPStore is the framework-level rendezvous/KV primitive —
+comm-id exchange, barriers, elastic membership — with reference semantics
+(set/get/add/wait, master hosts the map).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Union
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+_OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_DEL, _OP_NUM_KEYS = 1, 2, 3, 4, 5, 6
+
+
+def _csrc_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(_csrc_dir(), "tcp_store.cpp")
+        so = os.path.join(_csrc_dir(), "libtcp_store.so")
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", src, "-o", so + ".tmp"]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(so + ".tmp", so)
+        lib = ctypes.CDLL(so)
+        lib.ts_server_start.restype = ctypes.c_void_p
+        lib.ts_server_start.argtypes = [ctypes.c_int]
+        lib.ts_server_port.restype = ctypes.c_int
+        lib.ts_server_port.argtypes = [ctypes.c_void_p]
+        lib.ts_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ts_client_connect.restype = ctypes.c_void_p
+        lib.ts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.ts_client_request.restype = ctypes.c_long
+        lib.ts_client_request.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
+        lib.ts_client_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+class TCPStore:
+    """Reference-parity TCPStore.
+
+    ``TCPStore(host, port, is_master=False, world_size=1, timeout=...)`` —
+    the master process hosts the native server; every process (master
+    included) connects a client to it.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0):
+        lib = _load_lib()
+        self._lib = lib
+        self._server = None
+        self.is_master = is_master
+        self.world_size = world_size
+        if is_master:
+            self._server = lib.ts_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = lib.ts_server_port(self._server)
+        self.host = host
+        self.port = port
+        self._client = lib.ts_client_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._client:
+            if self._server:
+                lib.ts_server_stop(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    # -- core ops ----------------------------------------------------------
+    def _req(self, op: int, key: str, val: bytes = b"",
+             outcap: int = 1 << 20) -> Optional[bytes]:
+        out = ctypes.create_string_buffer(outcap)
+        n = self._lib.ts_client_request(self._client, op, key.encode(),
+                                        val, len(val), out, outcap)
+        if n == -2:
+            raise RuntimeError("TCPStore: connection lost")
+        if n < 0:
+            return None
+        return out.raw[:n]
+
+    def set(self, key: str, value: Union[str, bytes]):
+        if isinstance(value, str):
+            value = value.encode()
+        self._req(_OP_SET, key, value)
+
+    def get(self, key: str) -> bytes:
+        """Blocking get with reference semantics: waits for the key."""
+        self.wait([key])
+        out = self._req(_OP_GET, key)
+        if out is None:
+            raise KeyError(key)
+        return out
+
+    def add(self, key: str, amount: int) -> int:
+        out = self._req(_OP_ADD, key,
+                        int(amount).to_bytes(8, "little", signed=True))
+        return int.from_bytes(out, "little", signed=True)
+
+    def wait(self, keys: List[str], timeout: float = 30.0):
+        for k in keys:
+            ok = self._req(_OP_WAIT, k,
+                           int(timeout * 1000).to_bytes(4, "little"))
+            if ok is None:
+                raise TimeoutError(f"TCPStore.wait timed out on {k!r}")
+
+    def delete_key(self, key: str) -> bool:
+        return self._req(_OP_DEL, key) is not None
+
+    def num_keys(self) -> int:
+        return int.from_bytes(self._req(_OP_NUM_KEYS, ""), "little",
+                              signed=True)
+
+    # -- composite ---------------------------------------------------------
+    def barrier(self, name: str = "barrier", timeout: float = 30.0):
+        """All world_size participants rendezvous (ADD + WAIT loop)."""
+        n = self.add(f"__{name}__count", 1)
+        if n >= self.world_size:
+            self.set(f"__{name}__done", b"1")
+        self.wait([f"__{name}__done"], timeout=timeout)
+
+    def close(self):
+        if getattr(self, "_client", None):
+            self._lib.ts_client_close(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            self._lib.ts_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

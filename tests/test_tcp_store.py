@@ -1,0 +1,97 @@
+"""C++ TCPStore (paddle_tpu/csrc/tcp_store.cpp via ctypes) — the native
+coordination-store analog of the reference's tcp_store.h:121."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed import TCPStore
+
+
+@pytest.fixture(scope="module")
+def master():
+    s = TCPStore(is_master=True, world_size=1)
+    yield s
+    s.close()
+
+
+def test_set_get_roundtrip(master):
+    master.set("alpha", b"hello")
+    assert master.get("alpha") == b"hello"
+    master.set("alpha", "world")  # str form
+    assert master.get("alpha") == b"world"
+
+
+def test_add_is_atomic_across_threads(master):
+    n_threads, n_iter = 8, 50
+
+    def worker():
+        c = TCPStore(port=master.port)
+        for _ in range(n_iter):
+            c.add("counter", 1)
+        c.close()
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert master.add("counter", 0) == n_threads * n_iter
+
+
+def test_wait_blocks_until_set(master):
+    t0 = time.monotonic()
+
+    def setter():
+        time.sleep(0.3)
+        c = TCPStore(port=master.port)
+        c.set("late_key", b"x")
+        c.close()
+
+    th = threading.Thread(target=setter)
+    th.start()
+    master.wait(["late_key"], timeout=5.0)
+    th.join()
+    assert time.monotonic() - t0 >= 0.25
+    assert master.get("late_key") == b"x"
+
+
+def test_wait_timeout(master):
+    with pytest.raises(TimeoutError):
+        master.wait(["never_set_key"], timeout=0.2)
+
+
+def test_delete_and_num_keys():
+    s = TCPStore(is_master=True)
+    s.set("a", b"1")
+    s.set("b", b"2")
+    assert s.num_keys() == 2
+    assert s.delete_key("a")
+    assert not s.delete_key("a")
+    assert s.num_keys() == 1
+    s.close()
+
+
+def test_barrier_across_processes(master):
+    """2 subprocess workers + this process rendezvous through the store."""
+    code = (
+        "import sys\n"
+        "sys.path.insert(0, '/root/repo')\n"
+        "from paddle_tpu.distributed import TCPStore\n"
+        f"s = TCPStore(port={master.port}, world_size=3)\n"
+        "s.barrier('b1', timeout=30)\n"
+        "print('BARRIER_OK')\n")
+    procs = [subprocess.Popen([sys.executable, "-c", code],
+                              stdout=subprocess.PIPE, text=True)
+             for _ in range(2)]
+    me = TCPStore(port=master.port, world_size=3)
+    me.barrier("b1", timeout=30)
+    for p in procs:
+        out, _ = p.communicate(timeout=60)
+        assert p.returncode == 0
+        assert "BARRIER_OK" in out
+    me.close()
