@@ -1,4 +1,8 @@
-from . import attention, fused
+from . import attention, decode_attention, fused
+from .decode_attention import (
+    block_multihead_attention, masked_multihead_attention,
+    memory_efficient_attention,
+)
 from .fused import (
     fused_layer_norm, fused_linear_activation, fused_matmul_bias,
     fused_rms_norm, fused_rotary_position_embedding, swiglu,

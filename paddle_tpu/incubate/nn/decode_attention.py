@@ -1,0 +1,186 @@
+"""Inference decode-time attention ops.
+
+Analogs of the reference's LLM-serving attention kernels
+(python/paddle/incubate/nn/functional/masked_multihead_attention.py,
+block_multihead_attention.py, memory_efficient_attention.py; CUDA kernels
+under paddle/phi/kernels/fusion/gpu/). TPU-native shapes:
+
+- ``masked_multihead_attention``: one autoregressive decode step against a
+  dense KV cache — the q·Kᵀ row is a [B,H,1,D]×[B,H,T,D] batched matmul
+  (MXU-friendly), masked by per-sequence lengths.
+- ``block_multihead_attention``: decode against a PAGED cache (blocks +
+  per-sequence block tables, the vLLM layout the reference serves with);
+  gathers are jnp.take on the block axis, which XLA lowers to dynamic
+  slices.
+- ``memory_efficient_attention``: full-sequence attention that never
+  materializes the [Sq, Sk] matrix — an online-softmax ``lax.scan`` over
+  KV chunks (differentiable; the xformers-analog fallback when the Pallas
+  flash kernel's shape constraints don't fit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register
+
+__all__ = ["masked_multihead_attention", "block_multihead_attention",
+           "memory_efficient_attention"]
+
+
+@register("masked_multihead_attention", amp="white")
+def _mmha_op(x, cache_kv, seq_lens, rotary_embs=None, *, num_heads: int,
+             head_dim: int, scale=None):
+    """One decode step. x [B, 3*H*D] fused qkv; cache_kv [2, B, H, T, D];
+    seq_lens [B] current lengths (new token is written at that offset).
+    Returns (out [B, H*D], new_cache_kv)."""
+    b = x.shape[0]
+    h, d = num_heads, head_dim
+    qkv = x.reshape(b, 3, h, d)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]           # [B, H, D]
+    if rotary_embs is not None:
+        cos, sin = rotary_embs                          # [B, D] each
+        def rot(t):
+            t1, t2 = jnp.split(t, 2, axis=-1)
+            rotated = jnp.concatenate([-t2, t1], axis=-1)
+            return t * cos[:, None, :] + rotated * sin[:, None, :]
+        q, k = rot(q), rot(k)
+    t_max = cache_kv.shape[3]
+    bidx = jnp.arange(b)
+    kc = cache_kv[0].at[bidx, :, seq_lens, :].set(k)    # [B, H, T, D]
+    vc = cache_kv[1].at[bidx, :, seq_lens, :].set(v)
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
+                        kc.astype(jnp.float32)) * scale
+    mask = jnp.arange(t_max)[None, :] <= seq_lens[:, None]  # [B, T]
+    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bht,bhtd->bhd", p, vc.astype(jnp.float32))
+    return (out.reshape(b, h * d).astype(x.dtype),
+            jnp.stack([kc, vc], axis=0))
+
+
+def masked_multihead_attention(x, cache_kv, seq_lens, rotary_embs=None,
+                               num_heads: Optional[int] = None,
+                               head_dim: Optional[int] = None, scale=None,
+                               **kw):
+    """Public wrapper (reference masked_multihead_attention_): infers
+    (num_heads, head_dim) from the cache when not given."""
+    if num_heads is None:
+        num_heads = cache_kv.shape[2]
+    if head_dim is None:
+        head_dim = cache_kv.shape[-1]
+    return _mmha_op(x, cache_kv, seq_lens, rotary_embs,
+                    num_heads=num_heads, head_dim=head_dim, scale=scale)
+
+
+@register("block_multihead_attention", amp="white")
+def _block_mha_op(qkv, key_cache, value_cache, seq_lens, block_tables, *,
+                  scale=None):
+    """Paged decode step.
+
+    qkv [B, 3, H, D]; key/value_cache [NBlocks, H, BS, D]; seq_lens [B]
+    (tokens already in cache); block_tables [B, MaxBlocksPerSeq] int32
+    (-1 = unused). Writes the new token then attends over the pages.
+    Returns (out [B, H, D], key_cache, value_cache)."""
+    b, _, h, d = qkv.shape
+    bs = key_cache.shape[2]
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    # write the new token into its page slot
+    blk_idx = seq_lens // bs
+    slot = seq_lens % bs
+    bidx = jnp.arange(b)
+    phys = block_tables[bidx, blk_idx]                  # [B]
+    key_cache = key_cache.at[phys, :, slot, :].set(k)
+    value_cache = value_cache.at[phys, :, slot, :].set(v)
+    # gather each sequence's pages: [B, MaxBlocks, H, BS, D]
+    safe_tables = jnp.maximum(block_tables, 0)
+    ks = key_cache[safe_tables]                         # [B, MB, H, BS, D]
+    vs = value_cache[safe_tables]
+    mb = block_tables.shape[1]
+    ks = jnp.moveaxis(ks, 2, 1).reshape(b, h, mb * bs, d)
+    vs = jnp.moveaxis(vs, 2, 1).reshape(b, h, mb * bs, d)
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
+                        ks.astype(jnp.float32)) * scale
+    mask = jnp.arange(mb * bs)[None, :] <= seq_lens[:, None]
+    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bht,bhtd->bhd", p, vs.astype(jnp.float32))
+    return out.astype(qkv.dtype), key_cache, value_cache
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
+                              block_tables, scale=None, **kw):
+    return _block_mha_op(qkv, key_cache, value_cache, seq_lens,
+                         block_tables, scale=scale)
+
+
+@register("memory_efficient_attention", amp="white")
+def _mea_op(query, key, value, attn_bias=None, *, p: float = 0.0,
+            scale=None, causal: bool = False, chunk: int = 512):
+    """Online-softmax attention over KV chunks; [B, S, H, D] layout.
+    Never materializes [Sq, Sk]; O(Sq * chunk) working set."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    qt = jnp.moveaxis(query, 1, 2).astype(jnp.float32) * scale  # [B,H,Sq,D]
+    kt = jnp.moveaxis(key, 1, 2).astype(jnp.float32)
+    vt = jnp.moveaxis(value, 1, 2).astype(jnp.float32)
+    nchunk = -(-sk // chunk)
+    pad = nchunk * chunk - sk
+    if pad:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        if attn_bias is not None:
+            attn_bias = jnp.pad(attn_bias, ((0, 0),) * (attn_bias.ndim - 1)
+                                + ((0, pad),), constant_values=-jnp.inf)
+    kcs = kt.reshape(b, h, nchunk, chunk, d)
+    vcs = vt.reshape(b, h, nchunk, chunk, d)
+
+    def step(carry, inputs):
+        m, l, acc = carry
+        kc, vc, j = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kc)       # [B,H,Sq,chunk]
+        kpos = j * chunk + jnp.arange(chunk)
+        valid = kpos < sk
+        if attn_bias is not None:
+            bias = jax.lax.dynamic_slice_in_dim(
+                attn_bias, j * chunk, chunk, axis=attn_bias.ndim - 1)
+            s = s + bias.astype(jnp.float32)
+        if causal:
+            qpos = jnp.arange(sq)
+            s = jnp.where(qpos[None, None, :, None] >= kpos[None, None,
+                                                           None, :],
+                          s, -jnp.inf)
+        s = jnp.where(valid[None, None, None, :], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(-1))
+        alpha = jnp.exp(m - m_new)
+        pchunk = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + pchunk.sum(-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd",
+                                                      pchunk, vc)
+        return (m_new, l_new, acc_new), None
+
+    init = (jnp.full((b, h, sq), -jnp.inf),
+            jnp.zeros((b, h, sq)),
+            jnp.zeros((b, h, sq, d)))
+    kcs_t = jnp.moveaxis(kcs, 2, 0)                     # [n, B, H, chunk, D]
+    vcs_t = jnp.moveaxis(vcs, 2, 0)
+    (m, l, acc), _ = jax.lax.scan(step, init,
+                                  (kcs_t, vcs_t, jnp.arange(nchunk)))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.moveaxis(out, 1, 2).astype(query.dtype)  # [B, Sq, H, D]
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True, causal=False,
+                               chunk=512, **kw):
+    """xformers-style memory-efficient attention (reference
+    incubate/nn/functional/memory_efficient_attention.py); dropout ``p``
+    is accepted for parity (inference path ignores it)."""
+    return _mea_op(query, key, value, attn_bias, p=p, scale=scale,
+                   causal=causal, chunk=chunk)

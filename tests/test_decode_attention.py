@@ -1,0 +1,143 @@
+"""Decode-time attention ops vs naive softmax references (analogs of the
+reference's masked/block_multihead_attention + memory_efficient_attention,
+python/paddle/incubate/nn/functional/)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import (block_multihead_attention,
+                                    masked_multihead_attention,
+                                    memory_efficient_attention)
+
+
+def _naive(q, k, v, scale=None):
+    """q [B,H,D], k/v [B,H,T,D] -> [B,H,D] (fp64 reference)."""
+    d = q.shape[-1]
+    scale = scale or 1.0 / np.sqrt(d)
+    logits = np.einsum("bhd,bhtd->bht", q.astype(np.float64),
+                       k.astype(np.float64)) * scale
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bht,bhtd->bhd", p, v.astype(np.float64))
+
+
+def test_masked_multihead_attention_decode_step():
+    rng = np.random.RandomState(0)
+    b, h, d, t_max = 2, 4, 8, 16
+    lens = np.array([5, 9], np.int32)     # prefix lengths per sequence
+    cache = np.zeros((2, b, h, t_max, d), np.float32)
+    for bi in range(b):
+        cache[:, bi, :, :lens[bi]] = rng.randn(2, h, lens[bi], d)
+    x = rng.randn(b, 3 * h * d).astype(np.float32)
+
+    out, new_cache = masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        paddle.to_tensor(lens))
+
+    qkv = x.reshape(b, 3, h, d)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    for bi in range(b):
+        t = lens[bi] + 1
+        kc = np.concatenate([cache[0, bi, :, :lens[bi]],
+                             k[bi][:, None]], axis=1)
+        vc = np.concatenate([cache[1, bi, :, :lens[bi]],
+                             v[bi][:, None]], axis=1)
+        want = _naive(q[bi:bi + 1], kc[None], vc[None])[0]
+        got = np.asarray(out._value)[bi].reshape(h, d)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # cache updated in the right slot
+    nc = np.asarray(new_cache._value)
+    np.testing.assert_allclose(nc[0, 0, :, lens[0]], k[0], rtol=1e-6)
+    np.testing.assert_allclose(nc[1, 1, :, lens[1]], v[1], rtol=1e-6)
+
+
+def test_block_multihead_attention_matches_dense():
+    """Paged cache with shuffled physical blocks == dense-cache decode."""
+    rng = np.random.RandomState(1)
+    b, h, d, bs, nblocks, mb = 2, 2, 4, 4, 8, 3
+    lens = np.array([6, 10], np.int32)
+    # physical pages deliberately out of order
+    tables = np.array([[3, 0, 5], [1, 7, 2]], np.int32)
+    kcache = np.zeros((nblocks, h, bs, d), np.float32)
+    vcache = np.zeros((nblocks, h, bs, d), np.float32)
+    dense_k = rng.randn(b, h, mb * bs, d).astype(np.float32)
+    dense_v = rng.randn(b, h, mb * bs, d).astype(np.float32)
+    for bi in range(b):
+        for t in range(lens[bi]):
+            phys = tables[bi, t // bs]
+            kcache[phys, :, t % bs] = dense_k[bi, :, t]
+            vcache[phys, :, t % bs] = dense_v[bi, :, t]
+    qkv = rng.randn(b, 3, h, d).astype(np.float32)
+
+    out, kc2, vc2 = block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kcache),
+        paddle.to_tensor(vcache), paddle.to_tensor(lens),
+        paddle.to_tensor(tables))
+
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    for bi in range(b):
+        t = lens[bi] + 1
+        kc = np.concatenate([dense_k[bi, :, :lens[bi]], k[bi][:, None]], 1)
+        vc = np.concatenate([dense_v[bi, :, :lens[bi]], v[bi][:, None]], 1)
+        want = _naive(q[bi:bi + 1], kc[None], vc[None])[0]
+        np.testing.assert_allclose(np.asarray(out._value)[bi], want,
+                                   rtol=1e-4, atol=1e-5)
+    # new token landed in its page
+    phys = tables[0, lens[0] // bs]
+    np.testing.assert_allclose(np.asarray(kc2._value)[phys, :, lens[0] % bs],
+                               k[0], rtol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_memory_efficient_attention_matches_xla(causal):
+    rng = np.random.RandomState(2)
+    b, sq, sk, h, d = 2, 33, 130, 3, 16   # sk spans multiple chunks w/ tail
+    q = rng.randn(b, sq, h, d).astype(np.float32)
+    k = rng.randn(b, sk, h, d).astype(np.float32)
+    v = rng.randn(b, sk, h, d).astype(np.float32)
+
+    out = memory_efficient_attention(paddle.to_tensor(q),
+                                     paddle.to_tensor(k),
+                                     paddle.to_tensor(v),
+                                     causal=causal, chunk=64)
+
+    def ref(qv, kv, vv):
+        s = jnp.einsum("bqhd,bkhd->bhqk", qv, kv) / np.sqrt(d)
+        if causal:
+            qpos = jnp.arange(sq)[:, None]
+            kpos = jnp.arange(sk)[None, :]
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(ref(q, k, v)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_memory_efficient_attention_grad():
+    rng = np.random.RandomState(3)
+    b, s, h, d = 1, 40, 2, 8
+    q = paddle.to_tensor(rng.randn(b, s, h, d).astype("float32"))
+    k = paddle.to_tensor(rng.randn(b, s, h, d).astype("float32"))
+    v = paddle.to_tensor(rng.randn(b, s, h, d).astype("float32"))
+    for t in (q, k, v):
+        t.stop_gradient = False
+    out = memory_efficient_attention(q, k, v, chunk=16)
+    (out ** 2).sum().backward()
+
+    def ref(qv, kv, vv):
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", qv, kv) / np.sqrt(d)
+        p = jax.nn.softmax(s_, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+        return (o ** 2).sum()
+
+    gq, gk, gv = jax.grad(ref, argnums=(0, 1, 2))(
+        q._value, k._value, v._value)
+    np.testing.assert_allclose(np.asarray(q.grad._value), np.asarray(gq),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(v.grad._value), np.asarray(gv),
+                               rtol=1e-3, atol=1e-4)
