@@ -1,0 +1,186 @@
+"""paddle_tpu.distributed.rpc — control-plane RPC between workers.
+
+Analog of python/paddle/distributed/rpc/rpc.py (init_rpc:85, rpc_sync:160,
+rpc_async, shutdown; brpc-based C++ under fluid/distributed/rpc). The
+TPU-native transport is the framework's own native TCPStore
+(paddle_tpu/csrc/tcp_store.cpp): requests/responses are cloudpickled
+payloads exchanged through store mailboxes, with the store's blocking WAIT
+providing wakeups — no second RPC runtime needed for a control plane that
+runs at job frequency.
+
+Same contract as the reference: ``fn`` executes on the callee, results
+(or raised exceptions) come back to the caller; functions and args must be
+cloudpickle-able.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ..store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _RpcAgent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 master_endpoint: str):
+        host, port = master_endpoint.rsplit(":", 1)
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = TCPStore(host=host, port=int(port),
+                              is_master=(rank == 0), world_size=world_size)
+        # the serve loop gets its OWN client connection: a store request
+        # holds the client lock for its full round trip, and Future.wait
+        # blocks in WAIT for up to its timeout — sharing one client would
+        # starve the callee side into deadlock
+        self.serve_store = TCPStore(host=host, port=self.store.port,
+                                    world_size=world_size)
+        self.info = WorkerInfo(name, rank, host, self.store.port)
+        self.store.set(f"rpc/worker/{rank}", cloudpickle.dumps(self.info))
+        self.store.barrier("rpc_init", timeout=60)
+        self._workers = {}
+        for r in range(world_size):
+            w = cloudpickle.loads(self.store.get(f"rpc/worker/{r}"))
+            self._workers[w.name] = w
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._req_id = 0
+        self._serve_thread = threading.Thread(
+            target=self._serve, name=f"rpc-serve-{name}", daemon=True)
+        self._serve_thread.start()
+
+    # -- callee side -------------------------------------------------------
+    def _serve(self):
+        seq = 0
+        while not self._stop.is_set():
+            key = f"rpc/{self.rank}/req/{seq}"
+            try:
+                self.serve_store.wait([key], timeout=0.25)
+            except TimeoutError:
+                if self.serve_store.get_nowait("rpc/shutdown") is not None:
+                    break
+                continue
+            except RuntimeError:
+                break  # store torn down
+            payload = self.serve_store.get_nowait(key)
+            if payload is None:
+                continue
+            seq += 1
+            caller, req_id, fn, args, kwargs = cloudpickle.loads(payload)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # deliver the exception to the caller
+                result = (False, e)
+            self.serve_store.set(f"rpc/{caller}/resp/{req_id}",
+                                 cloudpickle.dumps(result))
+
+    # -- caller side -------------------------------------------------------
+    def call(self, to: str, fn, args, kwargs, timeout: float):
+        w = self._workers[to]
+        with self._lock:
+            self._req_id += 1
+            req_id = f"{self.rank}.{self._req_id}"
+        seq = self.store.add(f"rpc/{w.rank}/seq", 1) - 1
+        self.store.set(f"rpc/{w.rank}/req/{seq}",
+                       cloudpickle.dumps((self.rank, req_id, fn,
+                                          tuple(args or ()), kwargs or {})))
+        return _Future(self, req_id, timeout)
+
+    def shutdown(self):
+        self.store.barrier("rpc_shutdown", timeout=60)
+        self.store.set("rpc/shutdown", b"1")
+        self._stop.set()
+        self._serve_thread.join(timeout=5)
+        self.serve_store.close()
+        self.store.close()
+
+
+class _Future:
+    """Analog of the reference's FutureWrapper: .wait() joins the result."""
+
+    def __init__(self, agent: _RpcAgent, req_id: str, timeout: float):
+        self._agent = agent
+        self._key = f"rpc/{agent.rank}/resp/{req_id}"
+        self._timeout = timeout if timeout and timeout > 0 else 120.0
+
+    def wait(self):
+        self._agent.store.wait([self._key], timeout=self._timeout)
+        ok, payload = cloudpickle.loads(self._agent.store.get(self._key))
+        self._agent.store.delete_key(self._key)
+        if not ok:
+            raise payload
+        return payload
+
+
+_agent: Optional[_RpcAgent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start the RPC agent (reference rpc.py:85). Defaults come from the
+    launcher env contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    PADDLE_MASTER)."""
+    global _agent
+    from .. import env
+
+    if _agent is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = rank if rank is not None else env.get_rank()
+    world_size = world_size if world_size is not None else env.get_world_size()
+    master_endpoint = master_endpoint or env.get_master() or "127.0.0.1:0"
+    _agent = _RpcAgent(name, rank, world_size, master_endpoint)
+    return _agent.info
+
+
+def _require_agent() -> _RpcAgent:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = -1):
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; blocks for the result
+    (reference rpc.py:160)."""
+    return _require_agent().call(to, fn, args, kwargs, timeout).wait()
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = -1):
+    return _require_agent().call(to, fn, args, kwargs, timeout)
+
+
+def shutdown():
+    global _agent
+    if _agent is not None:
+        _agent.shutdown()
+        _agent = None
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _require_agent()._workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    a = _require_agent()
+    return sorted(a._workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _require_agent().info

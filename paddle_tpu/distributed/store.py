@@ -116,6 +116,10 @@ class TCPStore:
             raise KeyError(key)
         return out
 
+    def get_nowait(self, key: str) -> Optional[bytes]:
+        """Non-blocking get: None when the key is absent."""
+        return self._req(_OP_GET, key)
+
     def add(self, key: str, amount: int) -> int:
         out = self._req(_OP_ADD, key,
                         int(amount).to_bytes(8, "little", signed=True))

@@ -1,0 +1,74 @@
+"""paddle.distributed.rpc over the native TCPStore — 3 worker processes
+launched through the repo's launcher (reference analog:
+python/paddle/distributed/rpc/rpc.py + test/rpc/)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = """
+import operator
+import os
+
+import paddle_tpu.distributed.rpc as rpc
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+info = rpc.init_rpc(f"worker{rank}")
+assert info.rank == rank
+
+if rank == 0:
+    # sync call
+    assert rpc.rpc_sync("worker1", operator.add, (2, 3)) == 5
+    # async calls to both peers
+    f1 = rpc.rpc_async("worker1", operator.mul, (6, 7))
+    f2 = rpc.rpc_async("worker2", sorted, ([3, 1, 2],))
+    assert f1.wait() == 42
+    assert f2.wait() == [1, 2, 3]
+    # lambdas work (cloudpickle, like the reference)
+    assert rpc.rpc_sync("worker2", lambda a: a * 2, (21,)) == 42
+    # exceptions propagate to the caller
+    try:
+        rpc.rpc_sync("worker1", operator.truediv, (1, 0))
+        raise AssertionError("expected ZeroDivisionError")
+    except ZeroDivisionError:
+        pass
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1", "worker2"]
+    print("RPC_OK")
+else:
+    # peers also issue a call so traffic is bidirectional
+    assert rpc.rpc_sync("worker0", operator.add, (rank, 10)) == rank + 10
+    print("RPC_OK")
+
+rpc.shutdown()
+print("SHUTDOWN_OK")
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_rpc_three_workers(tmp_path):
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(WORKER)
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3",
+         "--master", f"127.0.0.1:{_free_port()}",
+         "--log_dir", str(log_dir), str(script)],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=240)
+    logs = "\n".join((log_dir / f"workerlog.{i}").read_text()
+                     for i in range(3) if (log_dir / f"workerlog.{i}").exists())
+    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+    assert logs.count("RPC_OK") == 3, logs
+    assert logs.count("SHUTDOWN_OK") == 3, logs
