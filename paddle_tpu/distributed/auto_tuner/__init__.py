@@ -1,0 +1,252 @@
+"""paddle_tpu.distributed.auto_tuner — parallel-config search.
+
+Analog of python/paddle/distributed/auto_tuner (AutoTuner tuner.py:21,
+GridSearch search.py, prune registry prune.py, Recorder recorder.py): grid
+over {dp, mp, pp, sharding degree/stage, micro-batch, recompute}, pruned by
+feasibility rules and trial history, trials ranked by the user's metric.
+
+TPU-native differences: degrees must factor the device mesh (dp*mp*pp*
+sharding_degree == num_devices with sharding folded into dp like the
+reference); the memory model estimates per-chip HBM for a transformer
+(params/grads/optimizer states/activations under the chosen shardings)
+instead of reading nvidia-smi.
+"""
+
+from __future__ import annotations
+
+import csv
+import itertools
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["AutoTuner", "GridSearch", "Recorder", "default_candidates",
+           "register_prune", "PRUNE_FNS"]
+
+PRUNE_FNS: List[Callable] = []
+
+
+def register_prune(fn: Callable) -> Callable:
+    """Register ``fn(tuner_cfg, cur_cfg, history) -> bool`` (True = prune);
+    the reference's @register_prune (prune.py:112)."""
+    PRUNE_FNS.append(fn)
+    return fn
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg: Dict[str, Any]) -> Dict[str, List]:
+    """'auto' fields become divisor grids of num_devices (reference
+    utils.default_candidates)."""
+    n = int(tuner_cfg["num_devices"])
+    out = {}
+    for key in ("dp_degree", "mp_degree", "pp_degree", "sharding_degree"):
+        v = tuner_cfg.get(key, "auto")
+        out[key] = _divisors(n) if v == "auto" else (
+            list(v) if isinstance(v, (list, tuple)) else [int(v)])
+    v = tuner_cfg.get("sharding_stage", [1, 2, 3])
+    out["sharding_stage"] = list(v) if isinstance(v, (list, tuple)) else [int(v)]
+    v = tuner_cfg.get("micro_batch_size", "auto")
+    gbs = int(tuner_cfg.get("global_batch_size", 8))
+    out["micro_batch_size"] = (_divisors(gbs) if v == "auto"
+                               else (list(v) if isinstance(v, (list, tuple))
+                                     else [int(v)]))
+    v = tuner_cfg.get("use_recompute", [False, True])
+    out["use_recompute"] = list(v) if isinstance(v, (list, tuple)) else [bool(v)]
+    return out
+
+
+# ----------------------------------------------------------------- prunes
+
+@register_prune
+def prune_by_degree_product(tuner_cfg, cur, history):
+    n = int(tuner_cfg["num_devices"])
+    return (cur["dp_degree"] * cur["mp_degree"] * cur["pp_degree"]
+            * cur["sharding_degree"]) != n
+
+
+@register_prune
+def prune_by_mp(tuner_cfg, cur, history):
+    """mp must stay inside one host's chips (ICI, not DCN) and divide the
+    head count when given (reference prune_by_mp)."""
+    per_node = int(tuner_cfg.get("devices_per_node",
+                                 tuner_cfg["num_devices"]))
+    if cur["mp_degree"] > per_node:
+        return True
+    heads = tuner_cfg.get("num_attention_heads")
+    if heads and heads % cur["mp_degree"] != 0:
+        return True
+    return False
+
+
+@register_prune
+def prune_by_pp(tuner_cfg, cur, history):
+    layers = tuner_cfg.get("num_layers")
+    if layers and layers % cur["pp_degree"] != 0:
+        return True
+    return False
+
+
+@register_prune
+def prune_by_mbs(tuner_cfg, cur, history):
+    gbs = int(tuner_cfg.get("global_batch_size", 8))
+    local = gbs // (cur["dp_degree"] * cur["sharding_degree"])
+    if local == 0 or gbs % (cur["dp_degree"] * cur["sharding_degree"]) != 0:
+        return True
+    return local % cur["micro_batch_size"] != 0
+
+
+@register_prune
+def prune_by_memory_estimation(tuner_cfg, cur, history):
+    """Transformer per-chip HBM estimate vs capacity (the reference shells
+    out to a memory tool; here the model is analytic)."""
+    hbm = float(tuner_cfg.get("max_mem_usage_gb", 0))
+    params_b = float(tuner_cfg.get("model_size_b", 0))
+    if not (hbm and params_b):
+        return False
+    bytes_param = 2.0  # bf16 weights
+    shard = cur["mp_degree"] * cur["pp_degree"] * (
+        cur["sharding_degree"] if cur["sharding_stage"] >= 3 else 1)
+    opt_shard = cur["mp_degree"] * cur["pp_degree"] * cur["sharding_degree"]
+    weights = params_b * 1e9 * bytes_param / shard
+    grads = params_b * 1e9 * 2.0 / (
+        cur["mp_degree"] * cur["pp_degree"]
+        * (cur["sharding_degree"] if cur["sharding_stage"] >= 2 else 1))
+    optim = params_b * 1e9 * 12.0 / opt_shard  # fp32 master+m+v
+    h = float(tuner_cfg.get("hidden_size", 4096))
+    layers = float(tuner_cfg.get("num_layers", 32))
+    seq = float(tuner_cfg.get("seq_length", 4096))
+    act_factor = 4.0 if cur["use_recompute"] else 34.0
+    acts = (cur["micro_batch_size"] * seq * h * layers * act_factor
+            / (cur["mp_degree"] * cur["pp_degree"]))
+    total_gb = (weights + grads + optim + acts) / 1e9
+    return total_gb > hbm
+
+
+@register_prune
+def prune_by_history(tuner_cfg, cur, history):
+    """A config that OOM'd with MORE memory headroom prunes this one:
+    same degrees, smaller-or-equal micro batch already failed (reference
+    prune_*_history family)."""
+    for h in history:
+        if h.get("error") != "oom":
+            continue
+        if all(h["cfg"][k] == cur[k] for k in
+               ("dp_degree", "mp_degree", "pp_degree", "sharding_degree",
+                "sharding_stage")) \
+                and h["cfg"]["micro_batch_size"] <= cur["micro_batch_size"] \
+                and h["cfg"]["use_recompute"] == cur["use_recompute"]:
+            return True
+    return False
+
+
+# ----------------------------------------------------------------- search
+
+class GridSearch:
+    """Cartesian grid with prune filtering (reference search.py GridSearch)."""
+
+    def __init__(self, tuner_cfg: Dict[str, Any]):
+        self.tuner_cfg = tuner_cfg
+        cands = tuner_cfg["candidates"]
+        keys = list(cands)
+        self.all_cfgs = [dict(zip(keys, vals))
+                         for vals in itertools.product(*cands.values())]
+        self.idx = 0
+
+    def search_once(self, history: List[Dict]) -> Optional[Dict]:
+        while self.idx < len(self.all_cfgs):
+            cfg = self.all_cfgs[self.idx]
+            self.idx += 1
+            if any(fn(self.tuner_cfg, cfg, history) for fn in PRUNE_FNS):
+                continue
+            return cfg
+        return None
+
+
+class Recorder:
+    """Trial history + ranking + CSV export (reference recorder.py)."""
+
+    def __init__(self, metric: str = "throughput", higher_is_better=True):
+        self.metric = metric
+        self.higher = higher_is_better
+        self.history: List[Dict] = []
+
+    def add_cfg(self, cfg: Dict, metric: Optional[float] = None,
+                error: Optional[str] = None):
+        self.history.append({"cfg": dict(cfg), "metric": metric,
+                             "error": error})
+
+    def sorted_history(self) -> List[Dict]:
+        ok = [h for h in self.history if h["metric"] is not None]
+        return sorted(ok, key=lambda h: h["metric"], reverse=self.higher)
+
+    def get_best(self) -> Optional[Dict]:
+        s = self.sorted_history()
+        return s[0] if s else None
+
+    def store_history(self, path: str):
+        keys = sorted({k for h in self.history for k in h["cfg"]})
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(keys + [self.metric, "error"])
+            for h in self.sorted_history() + [
+                    x for x in self.history if x["metric"] is None]:
+                w.writerow([h["cfg"].get(k) for k in keys]
+                           + [h["metric"], h["error"]])
+
+
+class AutoTuner:
+    """Reference tuner.py:21 surface: ``search_once()`` yields the next
+    un-pruned config, ``add_cfg`` feeds results back, plus a convenience
+    ``tune(trial_fn)`` loop (the reference drives relaunches externally)."""
+
+    def __init__(self, tuner_cfg: Dict[str, Any]):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.tuner_cfg.setdefault("candidates",
+                                  default_candidates(self.tuner_cfg))
+        self.task_limit = int(tuner_cfg.get("task_limit", 100))
+        self.cur_task_id = 0
+        self.algo = GridSearch(self.tuner_cfg)
+        self.recorder = Recorder(tuner_cfg.get("metric", "throughput"),
+                                 tuner_cfg.get("higher_is_better", True))
+
+    @property
+    def history_cfgs(self):
+        return self.recorder.history
+
+    def search_once(self) -> Optional[Dict]:
+        if self.cur_task_id >= self.task_limit:
+            return None
+        cfg = self.algo.search_once(self.recorder.history)
+        if cfg is not None:
+            self.cur_task_id += 1
+        return cfg
+
+    def add_cfg(self, cfg: Dict, metric: Optional[float] = None,
+                error: Optional[str] = None):
+        self.recorder.add_cfg(cfg, metric, error)
+
+    def get_best_cfg(self) -> Optional[Dict]:
+        best = self.recorder.get_best()
+        return best["cfg"] if best else None
+
+    def tune(self, trial_fn: Callable[[Dict], float],
+             log_path: Optional[str] = None) -> Optional[Dict]:
+        """Run trials until the grid or task budget is exhausted.
+        ``trial_fn(cfg)`` returns the metric, or raises MemoryError /
+        RuntimeError('oom' in msg) to record an OOM."""
+        while True:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            try:
+                self.add_cfg(cfg, metric=float(trial_fn(cfg)))
+            except MemoryError:
+                self.add_cfg(cfg, error="oom")
+            except RuntimeError as e:
+                self.add_cfg(cfg, error="oom" if "oom" in str(e).lower()
+                             else str(e))
+        if log_path:
+            self.recorder.store_history(log_path)
+        return self.get_best_cfg()

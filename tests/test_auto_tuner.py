@@ -1,0 +1,120 @@
+"""auto_tuner: grid + prune + recorder (reference
+python/paddle/distributed/auto_tuner/tuner.py:21)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (AutoTuner, Recorder,
+                                               default_candidates)
+
+
+BASE = {
+    "num_devices": 8,
+    "global_batch_size": 16,
+    "num_layers": 8,
+    "num_attention_heads": 16,
+}
+
+
+def test_default_candidates_divisors():
+    c = default_candidates(dict(BASE))
+    assert c["dp_degree"] == [1, 2, 4, 8]
+    assert c["micro_batch_size"] == [1, 2, 4, 8, 16]
+    c2 = default_candidates({**BASE, "mp_degree": [2, 4],
+                             "use_recompute": [True]})
+    assert c2["mp_degree"] == [2, 4] and c2["use_recompute"] == [True]
+
+
+def test_grid_respects_feasibility():
+    t = AutoTuner({**BASE, "task_limit": 10_000})
+    seen = []
+    while True:
+        cfg = t.search_once()
+        if cfg is None:
+            break
+        seen.append(cfg)
+        t.add_cfg(cfg, metric=1.0)
+    assert seen, "grid produced nothing"
+    for cfg in seen:
+        assert (cfg["dp_degree"] * cfg["mp_degree"] * cfg["pp_degree"]
+                * cfg["sharding_degree"]) == 8
+        assert 8 % cfg["pp_degree"] == 0          # layers divisible
+        assert 16 % cfg["mp_degree"] == 0         # heads divisible
+        local = 16 // (cfg["dp_degree"] * cfg["sharding_degree"])
+        assert local % cfg["micro_batch_size"] == 0
+
+
+def test_memory_model_prunes_big_configs():
+    # 7B params on 16GB chips: unsharded optimizer state (84GB) cannot
+    # fit, so only sufficiently-sharded configs survive
+    t = AutoTuner({**BASE, "model_size_b": 7, "max_mem_usage_gb": 16,
+                   "hidden_size": 4096, "seq_length": 2048,
+                   "task_limit": 10_000})
+    survivors = []
+    while True:
+        cfg = t.search_once()
+        if cfg is None:
+            break
+        survivors.append(cfg)
+        t.add_cfg(cfg, metric=1.0)
+    assert survivors, "memory model pruned everything"
+    for cfg in survivors:
+        # no surviving config keeps the full optimizer state on one chip
+        opt_shard = (cfg["mp_degree"] * cfg["pp_degree"]
+                     * cfg["sharding_degree"])
+        assert 7e9 * 12.0 / opt_shard <= 16e9
+    # and the infeasible extreme was really pruned
+    assert not any(cfg["mp_degree"] == cfg["pp_degree"]
+                   == cfg["sharding_degree"] == 1 for cfg in survivors)
+
+
+def test_oom_history_prunes_larger_mbs():
+    t = AutoTuner({**BASE, "task_limit": 10_000})
+    first = t.search_once()
+    assert first is not None
+    t.add_cfg(first, error="oom")
+    # any later config with same degrees and >= mbs must be pruned
+    while True:
+        cfg = t.search_once()
+        if cfg is None:
+            break
+        same = all(cfg[k] == first[k] for k in
+                   ("dp_degree", "mp_degree", "pp_degree",
+                    "sharding_degree", "sharding_stage"))
+        if same and cfg["use_recompute"] == first["use_recompute"]:
+            assert cfg["micro_batch_size"] < first["micro_batch_size"]
+        t.add_cfg(cfg, metric=0.0)
+
+
+def test_tune_finds_planted_optimum(tmp_path):
+    # synthetic throughput peaked at dp=2, mp=4, mbs=4, no recompute
+    target = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+              "sharding_degree": 1, "micro_batch_size": 4,
+              "use_recompute": False}
+
+    def trial(cfg):
+        score = 100.0
+        for k, v in target.items():
+            if cfg[k] != v:
+                score -= 10.0
+        return score
+
+    t = AutoTuner({**BASE, "task_limit": 10_000})
+    best = t.tune(trial, log_path=str(tmp_path / "history.csv"))
+    for k, v in target.items():
+        assert best[k] == v, (k, best)
+    csv_text = (tmp_path / "history.csv").read_text()
+    assert "throughput" in csv_text.splitlines()[0]
+    assert len(csv_text.splitlines()) > 2
+
+
+def test_recorder_ranking():
+    r = Recorder()
+    r.add_cfg({"a": 1}, metric=5.0)
+    r.add_cfg({"a": 2}, metric=9.0)
+    r.add_cfg({"a": 3}, error="oom")
+    assert r.get_best()["cfg"] == {"a": 2}
+    lo = Recorder(metric="latency", higher_is_better=False)
+    lo.add_cfg({"a": 1}, metric=5.0)
+    lo.add_cfg({"a": 2}, metric=9.0)
+    assert lo.get_best()["cfg"] == {"a": 1}
