@@ -67,6 +67,7 @@ from . import static
 from . import device
 from . import sparse
 from . import distribution
+from . import quantization
 
 
 def save(obj, path, **kwargs):

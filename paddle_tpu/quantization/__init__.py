@@ -1,0 +1,280 @@
+"""paddle_tpu.quantization — QAT / PTQ.
+
+Analog of python/paddle/quantization (QuantConfig config.py, QAT qat.py,
+PTQ ptq.py, AbsmaxObserver observers/, FakeQuanterWithAbsMaxObserver
+quanters/). The fake-quant math rides the framework's registered ops
+(fake_quantize_dequantize_abs_max family) with a straight-through
+estimator so QAT trains; PTQ convert() lowers Linear layers onto the real
+int8 ``weight_only_linear`` op.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import Conv2D, Linear
+from ..nn.layer import Layer, Parameter
+from ..ops.registry import dispatch, register
+
+__all__ = [
+    "AbsmaxObserver", "FakeQuanterWithAbsMaxObserver", "QuanterFactory",
+    "SingleLayerConfig", "QuantConfig", "QAT", "PTQ", "QuantedLinear",
+    "QuantedConv2D", "Int8Linear", "quanter",
+]
+
+
+@register("fake_quant_ste")
+def _fake_quant_ste_op(x, scale, bit_length=8):
+    """Fake quantize-dequantize with a straight-through estimator: exact
+    rounding forward, identity gradient (the reference's
+    FakeQuantAbsMax backward)."""
+    bnt = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * bnt), -bnt, bnt) / bnt * s
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class AbsmaxObserver:
+    """Running abs-max range observer (reference observers/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._absmax: Optional[float] = None
+
+    def observe(self, x) -> float:
+        v = float(jnp.abs(x._value if isinstance(x, Tensor) else x).max())
+        if self._absmax is None:
+            self._absmax = v
+        else:
+            m = self.moving_rate
+            self._absmax = m * self._absmax + (1 - m) * v
+        return self._absmax
+
+    def scale(self) -> float:
+        return self._absmax if self._absmax is not None else 1.0
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter: observe abs-max while training, fake-quantize with STE
+    (reference quanters/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9,
+                 **kw):
+        super().__init__()
+        self.observer = AbsmaxObserver(quant_bits, moving_rate)
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        if self.training:
+            self.observer.observe(x)
+        scale = jnp.asarray(self.observer.scale(), jnp.float32)
+        return dispatch("fake_quant_ste", x, Tensor(scale),
+                        bit_length=self.quant_bits)
+
+
+class QuanterFactory:
+    """Bind a quanter class + kwargs (reference factory.py)."""
+
+    def __init__(self, cls: Type[Layer], **kwargs):
+        self.cls = cls
+        self.kwargs = kwargs
+
+    def instance(self) -> Layer:
+        return self.cls(**self.kwargs)
+
+
+def quanter(cls=FakeQuanterWithAbsMaxObserver, **kwargs) -> QuanterFactory:
+    return QuanterFactory(cls, **kwargs)
+
+
+class SingleLayerConfig:
+    def __init__(self, activation: Optional[QuanterFactory],
+                 weight: Optional[QuanterFactory]):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Reference config.py surface: a default (activation, weight) pair
+    plus per-layer and per-type overrides."""
+
+    def __init__(self, activation: Optional[QuanterFactory] = None,
+                 weight: Optional[QuanterFactory] = None):
+        self._default = SingleLayerConfig(activation, weight)
+        self._layer_configs: List = []   # (layer_obj, cfg)
+        self._type_configs: List = []    # (type, cfg)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l_ in layers:
+            self._layer_configs.append(
+                (l_, SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_configs.append((t, SingleLayerConfig(activation,
+                                                            weight)))
+
+    def config_for(self, layer) -> SingleLayerConfig:
+        for obj, cfg in self._layer_configs:
+            if obj is layer:
+                return cfg
+        for t, cfg in self._type_configs:
+            if isinstance(layer, t):
+                return cfg
+        return self._default
+
+
+class QuantedLinear(Layer):
+    """QAT-wrapped Linear: fake-quant activations and weights, fp math
+    (reference nn/quant/qat/QuantedLinear)."""
+
+    def __init__(self, inner: Linear, cfg: SingleLayerConfig):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = (cfg.activation.instance()
+                                   if cfg.activation else None)
+        self.weight_quanter = cfg.weight.instance() if cfg.weight else None
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+
+        return F.linear(x, w, self.inner._parameters.get("bias"))
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner: Conv2D, cfg: SingleLayerConfig):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = (cfg.activation.instance()
+                                   if cfg.activation else None)
+        self.weight_quanter = cfg.weight.instance() if cfg.weight else None
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+
+        return F.conv2d(x, w, self.inner._parameters.get("bias"),
+                        stride=self.inner.stride,
+                        padding=self.inner.padding,
+                        dilation=self.inner.dilation,
+                        groups=self.inner.groups)
+
+
+_QAT_MAPPING = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _replace_sublayers(model: Layer, fn):
+    for name, sub in list(model._sub_layers.items()):
+        new = fn(sub)
+        if new is not sub:
+            model._sub_layers[name] = new
+            setattr(model, name, new)
+        else:
+            _replace_sublayers(sub, fn)
+
+
+def _walk(model: Layer, prefix=""):
+    for name, sub in model._sub_layers.items():
+        path = f"{prefix}{name}"
+        yield path, sub
+        yield from _walk(sub, path + ".")
+
+
+class QAT:
+    """Quantization-aware training driver (reference qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        # per-layer configs are registered by OBJECT; resolve them to
+        # sublayer paths on the original model so the deepcopy (the
+        # reference also copies unless inplace) still honors them
+        path_cfg = {}
+        for path, sub in _walk(model):
+            for obj, cfg in self.config._layer_configs:
+                if obj is sub:
+                    path_cfg[path] = cfg
+        if not inplace:
+            model = copy.deepcopy(model)
+        paths = {id(sub): path for path, sub in _walk(model)}
+
+        def convert(layer):
+            cls = _QAT_MAPPING.get(type(layer))
+            if cls is None:
+                return layer
+            cfg = path_cfg.get(paths.get(id(layer))) \
+                or self.config.config_for(layer)
+            if cfg.activation is None and cfg.weight is None:
+                return layer
+            return cls(layer, cfg)
+
+        _replace_sublayers(model, convert)
+        return model
+
+
+class Int8Linear(Layer):
+    """Converted inference layer: int8 weights + per-channel scales via
+    the weight_only_linear op (reference's quantized inference path)."""
+
+    def __init__(self, inner: Linear):
+        super().__init__()
+        qw, scale = dispatch("weight_quantize", inner.weight)
+        self.weight = Parameter(qw._value)
+        self.weight.stop_gradient = True
+        self.weight_scale = Parameter(scale._value)
+        self.weight_scale.stop_gradient = True
+        self.bias = inner._parameters.get("bias")
+
+    def forward(self, x):
+        return dispatch("weight_only_linear", x, self.weight,
+                        self.weight_scale, self.bias)
+
+
+class PTQ:
+    """Post-training quantization: calibrate observers, then convert
+    Linear layers to int8 (reference ptq.py + quantize.py convert)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        # PTQ calibration reuses the QAT wrappers in eval mode with the
+        # observers forced on (observe() needs training=True semantics)
+        model = QAT(self.config).quantize(model, inplace=inplace)
+        model.train()
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def conv(layer):
+            if isinstance(layer, QuantedLinear):
+                return Int8Linear(layer.inner)
+            if isinstance(layer, Linear):
+                return Int8Linear(layer)
+            return layer
+
+        _replace_sublayers(model, conv)
+        model.eval()
+        return model

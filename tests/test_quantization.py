@@ -1,0 +1,115 @@
+"""paddle.quantization QAT/PTQ (reference python/paddle/quantization)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (PTQ, QAT, FakeQuanterWithAbsMaxObserver,
+                                     Int8Linear, QuantConfig, QuantedConv2D,
+                                     QuantedLinear, quanter)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def _qcfg():
+    return QuantConfig(activation=quanter(moving_rate=0.9),
+                       weight=quanter(moving_rate=0.9))
+
+
+def test_qat_replaces_layers_and_runs():
+    model = MLP()
+    q = QAT(_qcfg()).quantize(model)
+    assert isinstance(q.fc1, QuantedLinear)
+    assert isinstance(q.fc2, QuantedLinear)
+    assert isinstance(model.fc1, nn.Linear)  # original untouched
+    x = paddle.rand([4, 8])
+    out_fp = model(x)
+    out_q = q(x)
+    assert tuple(out_q.shape) == (4, 4)
+    # 8-bit fake quant stays close to fp
+    np.testing.assert_allclose(np.asarray(out_q._value),
+                               np.asarray(out_fp._value), atol=0.2)
+
+
+def test_qat_gradients_flow_through_ste():
+    q = QAT(_qcfg()).quantize(MLP())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=list(q.parameters()))
+    x = paddle.rand([4, 8])
+    before = np.asarray(q.fc1.inner.weight._value).copy()
+    loss = (q(x) ** 2).mean()
+    loss.backward()
+    g = q.fc1.inner.weight.grad
+    assert g is not None and float(np.abs(np.asarray(g._value)).max()) > 0
+    opt.step()
+    assert not np.allclose(np.asarray(q.fc1.inner.weight._value), before)
+
+
+def test_quant_config_overrides():
+    model = MLP()
+    cfg = QuantConfig(activation=None, weight=None)  # default: skip
+    cfg.add_layer_config(model.fc1, activation=quanter(), weight=quanter())
+    q = QAT(cfg).quantize(model)
+    assert isinstance(q.fc1, QuantedLinear)
+    assert isinstance(q.fc2, nn.Linear)  # default config left it alone
+
+    cfg2 = QuantConfig()
+    cfg2.add_type_config(nn.Linear, weight=quanter())
+    q2 = QAT(cfg2).quantize(MLP())
+    assert isinstance(q2.fc1, QuantedLinear)
+    assert q2.fc1.activation_quanter is None
+
+
+def test_conv_qat():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 4, 3, padding=1)
+
+        def forward(self, x):
+            return self.conv(x)
+
+    q = QAT(_qcfg()).quantize(Net())
+    assert isinstance(q.conv, QuantedConv2D)
+    out = q(paddle.rand([1, 3, 8, 8]))
+    assert tuple(out.shape) == (1, 4, 8, 8)
+
+
+def test_ptq_calibrate_convert_int8():
+    model = MLP()
+    model.eval()
+    x_cal = [paddle.rand([8, 8]) for _ in range(4)]
+    ptq = PTQ(_qcfg())
+    observed = ptq.quantize(model)
+    for xb in x_cal:
+        observed(xb)
+    int8 = ptq.convert(observed)
+    assert isinstance(int8.fc1, Int8Linear)
+    assert np.asarray(int8.fc1.weight._value).dtype == np.int8
+    x = paddle.rand([4, 8])
+    out_fp = model(x)
+    out_i8 = int8(x)
+    np.testing.assert_allclose(np.asarray(out_i8._value),
+                               np.asarray(out_fp._value), atol=0.15)
+
+
+def test_observer_moving_average():
+    q = FakeQuanterWithAbsMaxObserver(moving_rate=0.5)
+    q.train()
+    q(paddle.to_tensor(np.array([4.0], np.float32)))
+    q(paddle.to_tensor(np.array([2.0], np.float32)))
+    np.testing.assert_allclose(q.observer.scale(), 3.0)  # 0.5*4 + 0.5*2
+    q.eval()
+    out = q(paddle.to_tensor(np.array([1.5], np.float32)))
+    assert q.observer.scale() == 3.0  # eval does not observe
+    assert np.isfinite(np.asarray(out._value)).all()
