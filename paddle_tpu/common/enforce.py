@@ -1,0 +1,137 @@
+"""Error/enforce library.
+
+Analog of paddle/common/enforce.h (PADDLE_ENFORCE_* macros, EnforceNotMet)
+and the phi error-code taxonomy (paddle/phi/core/errors.h): typed
+exceptions carrying an error code, plus ``enforce``/``enforce_*`` check
+helpers used across the runtime. The types multiply-inherit the closest
+Python builtin (ValueError/KeyError/...) so idiomatic ``except ValueError``
+call sites keep working.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, NoReturn, Optional
+
+
+class ErrorCode(enum.Enum):
+    LEGACY = 0
+    INVALID_ARGUMENT = 1
+    NOT_FOUND = 2
+    OUT_OF_RANGE = 3
+    ALREADY_EXISTS = 4
+    RESOURCE_EXHAUSTED = 5
+    PRECONDITION_NOT_MET = 6
+    PERMISSION_DENIED = 7
+    EXECUTION_TIMEOUT = 8
+    UNIMPLEMENTED = 9
+    UNAVAILABLE = 10
+    FATAL = 11
+    EXTERNAL = 12
+
+
+class EnforceNotMet(Exception):
+    """Base framework error (enforce.h EnforceNotMet)."""
+
+    code = ErrorCode.LEGACY
+
+    def __init__(self, message: str = ""):
+        super().__init__(message)
+        self.message = message
+
+    def __str__(self):
+        return f"[{self.code.name}] {self.message}"
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    code = ErrorCode.INVALID_ARGUMENT
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    code = ErrorCode.NOT_FOUND
+
+    def __str__(self):  # KeyError quotes its arg; keep the enforce format
+        return f"[{self.code.name}] {self.message}"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    code = ErrorCode.OUT_OF_RANGE
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = ErrorCode.ALREADY_EXISTS
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    code = ErrorCode.RESOURCE_EXHAUSTED
+
+
+class PreconditionNotMetError(EnforceNotMet, RuntimeError):
+    code = ErrorCode.PRECONDITION_NOT_MET
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    code = ErrorCode.PERMISSION_DENIED
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    code = ErrorCode.EXECUTION_TIMEOUT
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    code = ErrorCode.UNIMPLEMENTED
+
+
+class UnavailableError(EnforceNotMet, RuntimeError):
+    code = ErrorCode.UNAVAILABLE
+
+
+def enforce(cond: Any, message: str = "",
+            exc: type = PreconditionNotMetError) -> None:
+    """PADDLE_ENFORCE: raise ``exc(message)`` when ``cond`` is falsy."""
+    if not cond:
+        raise exc(message)
+
+
+def enforce_eq(a, b, message: str = "") -> None:
+    if a != b:
+        raise InvalidArgumentError(
+            f"expected {a!r} == {b!r}. {message}".rstrip())
+
+
+def enforce_ne(a, b, message: str = "") -> None:
+    if a == b:
+        raise InvalidArgumentError(
+            f"expected {a!r} != {b!r}. {message}".rstrip())
+
+
+def enforce_gt(a, b, message: str = "") -> None:
+    if not a > b:
+        raise InvalidArgumentError(
+            f"expected {a!r} > {b!r}. {message}".rstrip())
+
+
+def enforce_ge(a, b, message: str = "") -> None:
+    if not a >= b:
+        raise InvalidArgumentError(
+            f"expected {a!r} >= {b!r}. {message}".rstrip())
+
+
+def enforce_lt(a, b, message: str = "") -> None:
+    if not a < b:
+        raise InvalidArgumentError(
+            f"expected {a!r} < {b!r}. {message}".rstrip())
+
+
+def enforce_le(a, b, message: str = "") -> None:
+    if not a <= b:
+        raise InvalidArgumentError(
+            f"expected {a!r} <= {b!r}. {message}".rstrip())
+
+
+def not_found(message: str) -> NoReturn:
+    raise NotFoundError(message)
+
+
+def unimplemented(message: str) -> NoReturn:
+    raise UnimplementedError(message)

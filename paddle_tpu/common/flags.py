@@ -104,3 +104,71 @@ define_flag("FLAGS_eager_double_grad", True,
             "ops. Disable to drop the saved-input captures and restore the "
             "minimal first-order memory profile (grad(create_graph=True) "
             "then falls back to constants).")
+
+# -- round-2 breadth: reference flags kept for source compatibility. Wired
+# flags are marked; "compat" flags are accepted + readable so ported
+# scripts' set_flags calls keep working, with the TPU-native behavior
+# documented (XLA owns what the flag tuned on CUDA).
+define_flag("FLAGS_comm_abort_on_timeout", False,
+            "Watchdog kills the process on a hung collective so the "
+            "launcher's elastic restart recovers the job (wired).")
+define_flag("FLAGS_nccl_blocking_wait", False,
+            "Reference alias of FLAGS_comm_abort_on_timeout (wired).")
+define_flag("FLAGS_benchmark_nccl", False,
+            "compat: collective timing comes from the profiler timeline.")
+define_flag("FLAGS_allreduce_record_one_event", True,
+            "compat: XLA schedules collective/compute overlap itself.")
+define_flag("FLAGS_dynamic_static_unified_comm", True,
+            "compat: one collective path (XLA) serves eager and compiled.")
+define_flag("FLAGS_use_cinn", False,
+            "compat: fusion compilation is always XLA on TPU.")
+define_flag("FLAGS_allow_cinn_ops", "",
+            "compat: XLA fusion has no per-op allowlist.")
+define_flag("FLAGS_deny_cinn_ops", "",
+            "compat: XLA fusion has no per-op denylist.")
+define_flag("FLAGS_enable_cinn_accuracy_check", False,
+            "compat: use FLAGS_check_nan_inf / tests for accuracy checks.")
+define_flag("FLAGS_enable_pir_api", True,
+            "compat: the trace->StableHLO path is always on (PIR analog).")
+define_flag("FLAGS_enable_pir_in_executor", True,
+            "compat: XLA executables are the only executor.")
+define_flag("FLAGS_new_executor_use_cuda_graph", False,
+            "compat: XLA compiles whole-step programs; no graph capture.")
+define_flag("FLAGS_new_executor_serial_run", False,
+            "compat: PJRT launches are async by design.")
+define_flag("FLAGS_fraction_of_cpu_memory_to_use", 1.0,
+            "compat: host allocations are malloc'd, not pooled.")
+define_flag("FLAGS_initial_gpu_memory_in_mb", 0,
+            "compat: XLA preallocates HBM per XLA_PYTHON_CLIENT_* env.")
+define_flag("FLAGS_reallocate_gpu_memory_in_mb", 0, "compat.")
+define_flag("FLAGS_gpu_memory_limit_mb", 0, "compat.")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0,
+            "compat: XLA/PJRT buffer lifetime is reference-counted.")
+define_flag("FLAGS_fast_eager_deletion_mode", True, "compat.")
+define_flag("FLAGS_use_pinned_memory", True,
+            "compat: H2D staging is owned by PJRT.")
+define_flag("FLAGS_init_allocated_mem", False, "compat.")
+define_flag("FLAGS_conv_workspace_size_limit", 512,
+            "compat: XLA conv algorithm picking replaces cuDNN workspace.")
+define_flag("FLAGS_cudnn_deterministic", False,
+            "compat: set FLAGS_tpu_deterministic instead.")
+define_flag("FLAGS_tpu_deterministic", False,
+            "Force deterministic XLA reductions (wired via jax config by "
+            "user scripts; surfaced here for parity).")
+define_flag("FLAGS_cudnn_exhaustive_search", False,
+            "compat: see FLAGS_use_autotune.")
+define_flag("FLAGS_embedding_deterministic", 0, "compat.")
+define_flag("FLAGS_max_inplace_grad_add", 0, "compat.")
+define_flag("FLAGS_pe_profile_fname", "", "compat profiler filename knob.")
+define_flag("FLAGS_enable_async_trace", False,
+            "Enable async dispatch tracing (wired: profiler).")
+define_flag("FLAGS_low_precision_op_list", 0,
+            "compat: AMP op lists live in paddle_tpu.amp.")
+define_flag("FLAGS_enable_auto_parallel", True,
+            "compat: DTensor/GSPMD auto-parallel is always available.")
+define_flag("FLAGS_retain_grad_for_all_tensor", False,
+            "Keep .grad on non-leaf tensors by default (wired: tape).")
+define_flag("FLAGS_print_ir", False,
+            "Dump StableHLO of compiled functions (wired: jit).")
+define_flag("FLAGS_call_stack_level", 1,
+            "Error reports include Python stack (wired: enforce).")

@@ -110,3 +110,95 @@ class cuda:
     memory_reserved = staticmethod(memory_reserved)
     max_memory_reserved = staticmethod(max_memory_reserved)
     empty_cache = staticmethod(empty_cache)
+
+
+# ---------------------------------------------------------------------------
+# Stream / Event compat (analog of python/paddle/device streams & events,
+# phi/backends stream.h / event.h). PJRT dispatch is async with program
+# order preserved per device — the "stream" — so Stream is a logical handle
+# whose synchronize() drains the device, and Event captures a completion
+# point by draining at record time (conservative but correct timing
+# semantics for the profiler-style uses these APIs serve).
+# ---------------------------------------------------------------------------
+
+
+class Stream:
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def query(self) -> bool:
+        # no queue introspection through PJRT; after a drain the answer is
+        # exactly True, otherwise unknown — mirror CUDA's semantics as
+        # closely as observable
+        synchronize(self.device)
+        return True
+
+    def wait_event(self, event: "Event"):
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream"):
+        stream.synchronize()
+
+    def record_event(self, event: "Event" = None) -> "Event":
+        event = event or Event()
+        event.record(self)
+        return event
+
+
+class Event:
+    def __init__(self, enable_timing: bool = True, blocking: bool = False,
+                 interprocess: bool = False):
+        self.enable_timing = enable_timing
+        self._time = None
+
+    def record(self, stream: Stream = None):
+        import time
+
+        synchronize(stream.device if stream else None)
+        self._time = time.perf_counter()
+
+    def query(self) -> bool:
+        return self._time is not None
+
+    def synchronize(self):
+        pass  # record() already drained
+
+    def elapsed_time(self, end: "Event") -> float:
+        """Milliseconds between two recorded events."""
+        if self._time is None or end._time is None:
+            raise RuntimeError("both events must be recorded")
+        return (end._time - self._time) * 1000.0
+
+
+_current_streams = {}
+
+
+def current_stream(device=None) -> Stream:
+    key = id(device) if device is not None else None
+    if key not in _current_streams:
+        _current_streams[key] = Stream(device)
+    return _current_streams[key]
+
+
+class stream_guard:
+    """Context manager selecting the ambient stream (compat: per-device
+    program order is XLA's; the guard tracks the handle)."""
+
+    def __init__(self, stream: Stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = _current_streams.get(None)
+        _current_streams[None] = self.stream
+        return self.stream
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            _current_streams.pop(None, None)
+        else:
+            _current_streams[None] = self._prev
+        return False

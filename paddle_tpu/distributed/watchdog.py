@@ -140,6 +140,9 @@ class CommTaskManager:
                         h(t)
                     except Exception:
                         logger.exception("comm watchdog handler failed")
+                if (_flags.get_flag("FLAGS_comm_abort_on_timeout")
+                        or _flags.get_flag("FLAGS_nccl_blocking_wait")):
+                    abort_on_timeout(t)
 
     def shutdown(self):
         self._stop.set()

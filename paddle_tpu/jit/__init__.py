@@ -77,6 +77,12 @@ class TracedLayer:
                                        is_leaf=lambda x: isinstance(x, Tensor))
         ukwargs = jax.tree_util.tree_map(_unwrap, kwargs,
                                          is_leaf=lambda x: isinstance(x, Tensor))
+        from ..common import flags as _flags
+
+        if _flags.get_flag("FLAGS_print_ir") and not getattr(
+                self, "_ir_printed", False):
+            self._ir_printed = True
+            print(self.stablehlo(*args, **kwargs))
         if self._is_layer:
             state = self._target.functional_state()
             out = self._pure(state, uargs, ukwargs)

@@ -1,0 +1,93 @@
+"""Runtime kernel autotune cache.
+
+Analog of the reference's autotune layer (paddle/phi/kernels/autotune/
+{cache.h, auto_tune_base.h, switch_autotune.h}): candidate configs are
+measured once per key (op + shape signature) when ``FLAGS_use_autotune``
+is on, and the winner is cached for every later call. Consumers: the
+Pallas flash-attention block-size selection (ops/pallas/flash_attention).
+Measurement only happens EAGERLY on concrete arrays — under a jit trace
+the cache is read-only (defaults on miss), matching how the reference
+skips autotune inside graph capture.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Hashable, Optional, Sequence, Tuple
+
+from ..common import flags as _flags
+
+
+def enabled() -> bool:
+    return bool(_flags.get_flag("FLAGS_use_autotune"))
+
+
+class AutoTuneCache:
+    """Process-wide (key -> best config) cache with hit/miss counters
+    (the reference's AutoTuneCache + AutoTuneStatus)."""
+
+    _instance: Optional["AutoTuneCache"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._cache: Dict[Hashable, Any] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @classmethod
+    def instance(cls) -> "AutoTuneCache":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def lookup(self, key: Hashable):
+        with self._lock:
+            if key in self._cache:
+                self.hits += 1
+                return self._cache[key]
+            self.misses += 1
+            return None
+
+    def put(self, key: Hashable, cfg: Any):
+        with self._lock:
+            self._cache[key] = cfg
+
+    def tune(self, key: Hashable, candidates: Sequence[Any],
+             measure: Callable[[Any], float]) -> Any:
+        """Return the cached winner for ``key``, measuring every candidate
+        on a miss. ``measure(cfg)`` returns seconds (lower wins); a
+        candidate that raises is skipped."""
+        got = self.lookup(key)
+        if got is not None:
+            return got
+        best, best_t = candidates[0], float("inf")
+        for cfg in candidates:
+            try:
+                t = measure(cfg)
+            except Exception:
+                continue
+            if t < best_t:
+                best, best_t = cfg, t
+        self.put(key, best)
+        return best
+
+    def clear(self):
+        with self._lock:
+            self._cache.clear()
+            self.hits = 0
+            self.misses = 0
+
+
+def time_fn(fn: Callable[[], Any], warmup: int = 1, reps: int = 2) -> float:
+    """Wall-time a thunk (block_until_ready is the caller's job)."""
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best

@@ -379,6 +379,38 @@ class FlashUnsupportedError(ValueError):
     conflate these expected cases with real Pallas lowering failures."""
 
 
+_BLOCK_CANDIDATES = ((256, 256), (256, 512), (512, 256), (512, 512),
+                     (512, 1024), (1024, 512))
+
+
+def _select_blocks(q, k, v, causal, scale, h, kvh, interpret):
+    """Block sizes for this shape: FLAGS_use_autotune measures the
+    candidate tilings once per (seq, d, heads, causal) signature and
+    caches the winner (the reference's switch_autotune path); otherwise
+    the measured v5e default 512x512."""
+    from .. import autotune as _at
+
+    sq, d = q.shape[1], q.shape[2]
+    sk = k.shape[1]
+    key = ("flash_fwd", sq, sk, d, h, kvh, causal, str(q.dtype))
+    cached = _at.AutoTuneCache.instance().lookup(key)
+    if cached is not None:
+        return cached
+    if (not _at.enabled() or interpret
+            or isinstance(q, jax.core.Tracer)):
+        return 512, 512
+    cands = [(bq, bk) for bq, bk in _BLOCK_CANDIDATES
+             if bq <= max(sq, 256) and bk <= max(sk, 256)]
+
+    def measure(cfg):
+        bq, bk = cfg
+        return _at.time_fn(lambda: jax.block_until_ready(
+            _flash_forward(q, k, v, causal, scale, h=h, kvh=kvh,
+                           block_q=bq, block_k=bk, interpret=interpret)))
+
+    return _at.AutoTuneCache.instance().tune(key, cands, measure)
+
+
 def _flash_fwd(q, k, v, causal, scale, interpret):
     b, sq, h, d = q.shape
     sk, kvh = k.shape[1], k.shape[2]
@@ -389,8 +421,12 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
         raise FlashUnsupportedError(
             "causal flash kernel assumes sq == sk (training "
             "self-attention); decode uses the cached path")
-    of, lse = _flash_forward(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
-                             h=h, kvh=kvh, interpret=interpret)
+    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+    block_q, block_k = _select_blocks(qb, kb, vb, causal, scale, h, kvh,
+                                      interpret)
+    of, lse = _flash_forward(qb, kb, vb, causal, scale,
+                             h=h, kvh=kvh, block_q=block_q, block_k=block_k,
+                             interpret=interpret)
     return _from_bh(of, b, h), (q, k, v, _from_bh(of, b, h), lse)
 
 

@@ -66,7 +66,9 @@ def get_op(name: str) -> OpDef:
     try:
         return _REGISTRY[name]
     except KeyError:
-        raise KeyError(f"op {name!r} is not registered") from None
+        from ..common.enforce import NotFoundError
+
+        raise NotFoundError(f"op {name!r} is not registered") from None
 
 
 def all_ops() -> Dict[str, OpDef]:
@@ -269,11 +271,14 @@ def _wrap_outputs(op: OpDef, out, recorded: bool, node=None):
         flat, _ = jax.tree_util.tree_flatten(out)
         _check_numerics(op.name, flat)
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    retain_all = _flags.get_flag("FLAGS_retain_grad_for_all_tensor")
     wrapped = []
     for slot, v in enumerate(out_leaves):
         t = Tensor(v, stop_gradient=True)
         if recorded and jnp.issubdtype(v.dtype, jnp.floating):
             t.stop_gradient = False
             t._set_grad_node(node, slot)
+            if retain_all:
+                t.retain_grads()
         wrapped.append(t)
     return jax.tree_util.tree_unflatten(out_treedef, wrapped)

@@ -137,3 +137,98 @@ def test_random_reproducible():
     paddle.seed(7)
     b = paddle.randn([4])
     np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_enforce_error_taxonomy():
+    from paddle_tpu.common import enforce as E
+
+    with pytest.raises(E.InvalidArgumentError):
+        E.enforce_eq(1, 2)
+    assert issubclass(E.InvalidArgumentError, ValueError)
+    assert issubclass(E.NotFoundError, KeyError)
+    with pytest.raises(E.PreconditionNotMetError):
+        E.enforce(False, "nope")
+    err = E.InvalidArgumentError("bad dim")
+    assert "INVALID_ARGUMENT" in str(err)
+    # registry raises the typed not-found (still a KeyError)
+    from paddle_tpu.ops.registry import get_op
+    with pytest.raises(KeyError):
+        get_op("no_such_op_xyz")
+
+
+def test_flags_breadth_and_retain_grad_flag():
+    flags = paddle.get_flags()
+    assert len(flags) >= 45
+    assert "FLAGS_nccl_blocking_wait" in flags  # reference names accepted
+    paddle.set_flags({"FLAGS_retain_grad_for_all_tensor": True})
+    try:
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        x.stop_gradient = False
+        y = x * 2.0
+        z = (y * y).sum()
+        z.backward()
+        assert y.grad is not None  # non-leaf kept its grad
+    finally:
+        paddle.set_flags({"FLAGS_retain_grad_for_all_tensor": False})
+
+
+def test_autotune_cache():
+    from paddle_tpu.ops.autotune import AutoTuneCache
+
+    cache = AutoTuneCache()
+    calls = []
+
+    def measure(cfg):
+        calls.append(cfg)
+        return {(1, 1): 3.0, (2, 2): 1.0, (4, 4): 2.0}[cfg]
+
+    best = cache.tune("k", [(1, 1), (2, 2), (4, 4)], measure)
+    assert best == (2, 2) and len(calls) == 3
+    again = cache.tune("k", [(1, 1), (2, 2), (4, 4)], measure)
+    assert again == (2, 2) and len(calls) == 3  # cached, no re-measure
+    assert cache.hits == 1
+
+    def broken(cfg):
+        if cfg == (2, 2):
+            raise RuntimeError("oom")
+        return 1.0
+
+    assert cache.tune("k2", [(2, 2), (4, 4)], broken) == (4, 4)
+
+
+def test_flash_block_autotune_uses_cache():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.autotune import AutoTuneCache
+    from paddle_tpu.ops.pallas.flash_attention import _select_blocks
+
+    q = jnp.zeros((4, 1024, 64))
+    k = jnp.zeros((4, 1024, 64))
+    key = ("flash_fwd", 1024, 1024, 64, 4, 4, True, str(q.dtype))
+    AutoTuneCache.instance().put(key, (256, 512))
+    try:
+        assert _select_blocks(q, k, k, True, 0.125, 4, 4, True) == (256, 512)
+    finally:
+        AutoTuneCache.instance().clear()
+    # cache miss + autotune off -> measured default
+    assert _select_blocks(q, k, k, True, 0.125, 4, 4, True) == (512, 512)
+
+
+def test_stream_event_compat():
+    import time
+
+    import paddle_tpu.device as device
+
+    s = device.current_stream()
+    assert s is device.current_stream()
+    e1 = device.Event()
+    e1.record(s)
+    time.sleep(0.01)
+    e2 = s.record_event()
+    assert e1.query() and e2.query()
+    assert e1.elapsed_time(e2) >= 5.0  # ms
+    with device.stream_guard(device.Stream()) as s2:
+        assert device.current_stream() is s2
+    assert device.current_stream() is s
+    s.synchronize()
+    assert s.query()
