@@ -24,6 +24,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -83,6 +84,7 @@ struct Server {
   std::thread accept_thread;
   std::mutex conn_mu;
   std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
 
   void handle_conn(int fd) {
     for (;;) {
@@ -167,6 +169,13 @@ struct Server {
       }
       if (!ok) break;
     }
+    {
+      // deregister BEFORE closing: stop() must never shutdown() an fd
+      // number the OS has already handed to someone else
+      std::lock_guard<std::mutex> g(conn_mu);
+      conn_fds.erase(std::remove(conn_fds.begin(), conn_fds.end(), fd),
+                     conn_fds.end());
+    }
     ::close(fd);
   }
 
@@ -194,6 +203,7 @@ struct Server {
         int one2 = 1;
         ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
         std::lock_guard<std::mutex> g(conn_mu);
+        conn_fds.push_back(fd);
         conn_threads.emplace_back([this, fd] { handle_conn(fd); });
       }
     });
@@ -208,10 +218,20 @@ struct Server {
       ::close(listen_fd);
     }
     if (accept_thread.joinable()) accept_thread.join();
-    std::lock_guard<std::mutex> g(conn_mu);
-    for (auto& t : conn_threads)
-      if (t.joinable()) t.detach();  // blocked in recv; sockets closed by peer
-    conn_threads.clear();
+    // unblock recv() in every connection thread, then JOIN them — a
+    // detached thread would race the Server free (use-after-free on the
+    // store mutex/map at teardown)
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    std::vector<std::thread> threads;
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      threads.swap(conn_threads);
+    }
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
   }
 };
 

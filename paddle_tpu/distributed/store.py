@@ -39,10 +39,14 @@ def _load_lib() -> ctypes.CDLL:
         so = os.path.join(_csrc_dir(), "libtcp_store.so")
         if (not os.path.exists(so)
                 or os.path.getmtime(so) < os.path.getmtime(src)):
+            # per-pid temp + atomic rename: concurrent processes (launcher
+            # workers) may all rebuild; last writer wins, none sees a
+            # half-written library
+            tmp = f"{so}.tmp.{os.getpid()}"
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", src, "-o", so + ".tmp"]
+                   "-pthread", src, "-o", tmp]
             subprocess.run(cmd, check=True, capture_output=True)
-            os.replace(so + ".tmp", so)
+            os.replace(tmp, so)
         lib = ctypes.CDLL(so)
         lib.ts_server_start.restype = ctypes.c_void_p
         lib.ts_server_start.argtypes = [ctypes.c_int]

@@ -249,6 +249,125 @@ class _PrefetchIter:
         return item
 
 
+def _np_collate(batch):
+    """Numpy-level collate used inside worker PROCESSES: workers must not
+    touch jax (forked children and the XLA runtime don't mix), so batches
+    cross the process boundary as numpy and the parent wraps Tensors."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(_np_collate([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    return np.stack([np.asarray(b) for b in batch])
+
+
+def _wrap_np(obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_wrap_np(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _wrap_np(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    return obj
+
+
+def _mp_worker(dataset, collate_fn, index_q, result_q, worker_id,
+               worker_init_fn):
+    """Worker-process loop (analog of the reference's _worker_loop,
+    io/dataloader/worker.py): pull index lists, emit collated numpy."""
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_q.get()
+        if item is None:
+            break
+        batch_idx, indices = item
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            result_q.put((batch_idx, batch, None))
+        except Exception as e:  # propagate to the parent iterator
+            result_q.put((batch_idx, None, e))
+
+
+class _MultiprocessIter:
+    """True multi-process prefetch (analog of _DataLoaderIterMultiProcess,
+    python/paddle/io/dataloader/dataloader_iter.py:370): round-robin index
+    queues, a shared result queue, in-order reassembly in the parent."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        self._loader = loader
+        self._nw = loader.num_workers
+        self._outstanding_cap = max(2, loader.prefetch_factor) * self._nw
+        self._collate = loader.worker_collate_fn or _np_collate
+        self._index_qs = [ctx.Queue() for _ in range(self._nw)]
+        self._result_q = ctx.Queue()
+        self._workers = [
+            ctx.Process(target=_mp_worker,
+                        args=(loader.dataset, self._collate,
+                              self._index_qs[w], self._result_q, w,
+                              loader.worker_init_fn),
+                        daemon=True)
+            for w in range(self._nw)]
+        for p in self._workers:
+            p.start()
+        self._batches = enumerate(iter(loader.batch_sampler))
+        self._sent = 0
+        self._next_out = 0
+        self._hold = {}
+        self._exhausted = False
+        self._fill()
+
+    def _fill(self):
+        while self._sent - self._next_out < self._outstanding_cap \
+                and not self._exhausted:
+            try:
+                bidx, indices = next(self._batches)
+            except StopIteration:
+                self._exhausted = True
+                break
+            self._index_qs[bidx % self._nw].put((bidx, list(indices)))
+            self._sent += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_out >= self._sent and self._exhausted:
+            self._shutdown()
+            raise StopIteration
+        while self._next_out not in self._hold:
+            bidx, batch, err = self._result_q.get()
+            if err is not None:
+                self._shutdown()
+                raise err
+            self._hold[bidx] = batch
+        batch = self._hold.pop(self._next_out)
+        self._next_out += 1
+        self._fill()
+        return _wrap_np(batch)
+
+    def _shutdown(self):
+        for q in self._index_qs:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self._workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -257,6 +376,10 @@ class DataLoader:
                  worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        # with worker processes, collation happens numpy-side in the
+        # child; a user collate_fn is honored there (must return numpy)
+        self.worker_collate_fn = collate_fn
+        self.worker_init_fn = worker_init_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
@@ -286,7 +409,9 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
-        if self.num_workers > 0 or self.use_buffer_reader:
+        if self.num_workers > 0 and self.batch_sampler is not None:
+            return _MultiprocessIter(self)
+        if self.use_buffer_reader:
             return _PrefetchIter(self, num_prefetch=max(2, self.prefetch_factor))
         return iter(self._batches())
 
