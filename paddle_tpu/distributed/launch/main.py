@@ -44,7 +44,7 @@ def parse_args(argv=None):
 
 
 def build_env(rank: int, local_rank: int, world: int, endpoints: List[str],
-              master: str) -> dict:
+              master: str, jax_coordinator: str = None) -> dict:
     env = dict(os.environ)
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
@@ -54,7 +54,7 @@ def build_env(rank: int, local_rank: int, world: int, endpoints: List[str],
         "PADDLE_RANK_IN_NODE": str(local_rank),
         "PADDLE_MASTER": master,
         # jax.distributed consumption (multi-host TPU)
-        "JAX_COORDINATOR_ADDRESS": master,
+        "JAX_COORDINATOR_ADDRESS": jax_coordinator or master,
         "JAX_NUM_PROCESSES": str(world),
         "JAX_PROCESS_ID": str(rank),
         # generic torch-style aliases some scripts read
@@ -78,7 +78,8 @@ def _run_gang(args, world: int, nproc: int, endpoints: List[str],
     suffix = f".restart{restart_count}" if restart_count else ""
     for local_rank in range(nproc):
         rank = args.rank * nproc + local_rank
-        env = build_env(rank, local_rank, world, endpoints, master)
+        env = build_env(rank, local_rank, world, endpoints, master,
+                        jax_coordinator=shutdown_flag.get("jax_coordinator"))
         env["PADDLE_RESTART_COUNT"] = str(restart_count)
         log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}{suffix}")
         logf = open(log_path, "w")
@@ -131,12 +132,32 @@ def launch(args=None) -> int:
     world = nnodes * nproc
     master = args.master or "127.0.0.1:49178"
     base_port = 52700
-    endpoints = [f"127.0.0.1:{base_port + i}" if nnodes == 1
-                 else f"node{i // nproc}:{base_port + i % nproc}"
-                 for i in range(world)]
     os.makedirs(args.log_dir, exist_ok=True)
 
     shutdown_flag = {"requested": False, "kill": lambda: None}
+    rdv_store = None
+    if nnodes == 1:
+        endpoints = [f"127.0.0.1:{base_port + i}" for i in range(world)]
+    else:
+        # multi-node rendezvous over the native TCPStore hosted at
+        # --master by node 0 (the HTTPMaster/ETCDMaster analog,
+        # launch/controllers/master.py): every node registers its local
+        # endpoints, barriers, then reads the agreed global list
+        from ..store import TCPStore
+
+        mhost, mport = master.rsplit(":", 1)
+        this_host = os.environ.get("PADDLE_NODE_IP", mhost)
+        node_base = base_port + args.rank * nproc  # distinct on one host
+        local_eps = [f"{this_host}:{node_base + i}" for i in range(nproc)]
+        rdv_store = TCPStore(mhost, int(mport), is_master=(args.rank == 0),
+                             world_size=nnodes, timeout=120)
+        rdv_store.set(f"launch/node/{args.rank}", ",".join(local_eps))
+        rdv_store.barrier("launch_rendezvous", timeout=120)
+        endpoints = []
+        for r in range(nnodes):
+            endpoints += rdv_store.get(f"launch/node/{r}").decode().split(",")
+        # the TCPStore owns the master port; jax.distributed gets its own
+        shutdown_flag["jax_coordinator"] = f"{mhost}:{int(mport) + 1}"
 
     def _on_sigterm(*_):
         # operator-initiated shutdown must NOT look like a worker failure

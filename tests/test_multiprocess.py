@@ -108,3 +108,51 @@ def test_two_process_collective_and_checkpoint(tmp_path):
         np.asarray(t2._value),
         np.arange(16, dtype=np.float32).reshape(4, 4))
     assert sd["step"] == 7
+
+
+def test_two_node_launcher_rendezvous(tmp_path):
+    """Two launcher processes (simulated nodes) rendezvous through the
+    master TCPStore and agree on one 4-endpoint world (reference master
+    rendezvous, launch/controllers/master.py)."""
+    script = tmp_path / "envdump.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({k: os.environ[k] for k in ("
+        "'PADDLE_TRAINER_ID','PADDLE_TRAINERS_NUM',"
+        "'PADDLE_TRAINER_ENDPOINTS','PADDLE_CURRENT_ENDPOINT',"
+        "'JAX_COORDINATOR_ADDRESS')}))\n")
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    launchers = []
+    for node in range(2):
+        log_dir = tmp_path / f"node{node}"
+        launchers.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--nproc_per_node", "2",
+             "--rank", str(node),
+             "--master", f"127.0.0.1:{port}",
+             "--log_dir", str(log_dir), str(script)],
+            cwd="/root/repo", env=env))
+    for p in launchers:
+        assert p.wait(timeout=240) == 0
+
+    import json
+    records = []
+    for node in range(2):
+        for lr in range(2):
+            records.append(json.loads(
+                (tmp_path / f"node{node}" / f"workerlog.{lr}")
+                .read_text().strip()))
+    ids = sorted(int(r["PADDLE_TRAINER_ID"]) for r in records)
+    assert ids == [0, 1, 2, 3]
+    worlds = {r["PADDLE_TRAINER_ENDPOINTS"] for r in records}
+    assert len(worlds) == 1                      # all agree on one list
+    eps = worlds.pop().split(",")
+    assert len(eps) == 4 and len(set(eps)) == 4  # distinct endpoints
+    assert all(r["PADDLE_TRAINERS_NUM"] == "4" for r in records)
+    assert all(r["JAX_COORDINATOR_ADDRESS"] == f"127.0.0.1:{port + 1}"
+               for r in records)
+    # each worker's own endpoint is at its rank position
+    for r in records:
+        assert eps[int(r["PADDLE_TRAINER_ID"])] == r["PADDLE_CURRENT_ENDPOINT"]
