@@ -68,6 +68,7 @@ from . import device
 from . import sparse
 from . import distribution
 from . import quantization
+from . import utils
 
 
 def save(obj, path, **kwargs):

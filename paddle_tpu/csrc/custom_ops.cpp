@@ -1,0 +1,61 @@
+// Demo out-of-tree custom ops over the XLA FFI C++ ABI.
+//
+// Analog of the reference's custom-op path (PD_BUILD_OP,
+// paddle/fluid/framework/custom_operator.cc + phi/capi C ABI): a user
+// compiles C++ against the framework-provided headers and the op becomes a
+// first-class kernel. TPU-native shape: the C++ implements an XLA FFI
+// handler; paddle_tpu.utils.cpp_extension compiles+registers it as an XLA
+// custom call, so it composes with jit/grad like any other op.
+//
+// Handlers here are CPU reference kernels (the host side of the ABI); a
+// TPU custom op would pair this with a Pallas kernel for the device.
+
+#include <cmath>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+// out = 0.5 * (x + bias) * (1 + tanh(sqrt(2/pi) * (v + 0.044715 v^3)))
+static ffi::Error BiasGeluImpl(ffi::Buffer<ffi::F32> x,
+                               ffi::Buffer<ffi::F32> bias,
+                               ffi::ResultBuffer<ffi::F32> out) {
+  const size_t n = x.element_count();
+  const size_t nb = bias.element_count();
+  if (nb == 0 || n % nb != 0)
+    return ffi::Error::InvalidArgument("bias must divide x");
+  const float* xp = x.typed_data();
+  const float* bp = bias.typed_data();
+  float* op = out->typed_data();
+  for (size_t i = 0; i < n; ++i) {
+    const float v = xp[i] + bp[i % nb];
+    const float c = 0.7978845608028654f * (v + 0.044715f * v * v * v);
+    op[i] = 0.5f * v * (1.0f + std::tanh(c));
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(BiasGelu, BiasGeluImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+// out = max(x, 0)^2  — second symbol to exercise multi-op libraries
+static ffi::Error ReluSquaredImpl(ffi::Buffer<ffi::F32> x,
+                                  ffi::ResultBuffer<ffi::F32> out) {
+  const size_t n = x.element_count();
+  const float* xp = x.typed_data();
+  float* op = out->typed_data();
+  for (size_t i = 0; i < n; ++i) {
+    const float r = xp[i] > 0.0f ? xp[i] : 0.0f;
+    op[i] = r * r;
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(ReluSquared, ReluSquaredImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());

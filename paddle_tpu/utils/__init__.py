@@ -1,0 +1,13 @@
+"""paddle_tpu.utils (analog of python/paddle/utils): cpp_extension custom-op
+loader plus small helpers."""
+
+from . import cpp_extension  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

@@ -1,0 +1,107 @@
+"""C++ custom-op loader over the XLA FFI ABI.
+
+Analog of paddle.utils.cpp_extension (load/setup building PD_BUILD_OP
+libraries, python/paddle/utils/cpp_extension/) and the phi C ABI
+(paddle/phi/capi): user C++ defines XLA FFI handlers (see
+paddle_tpu/csrc/custom_ops.cpp for the pattern); ``load`` compiles the
+sources against the jax-shipped ``xla/ffi/api`` headers, registers each
+handler as an XLA custom-call target, and returns a module whose functions
+dispatch through the framework op registry — so custom ops get AMP/tape
+treatment and work under jit.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import types
+from typing import Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.registry import register as _register_op
+
+_loaded: Dict[str, types.SimpleNamespace] = {}
+
+
+def _compile(name: str, sources: Sequence[str], build_dir: str,
+             extra_cflags: Sequence[str]) -> str:
+    os.makedirs(build_dir, exist_ok=True)
+    so = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(so) or os.path.getmtime(so) < newest_src:
+        tmp = f"{so}.tmp.{os.getpid()}"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               f"-I{jax.ffi.include_dir()}", *extra_cflags, *srcs,
+               "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{e.stderr}") from None
+        os.replace(tmp, so)
+    return so
+
+
+def load(name: str,
+         sources: Sequence[str],
+         functions: Dict[str, Union[str, Tuple[str, Optional[Callable]]]],
+         extra_cflags: Sequence[str] = (),
+         build_directory: Optional[str] = None,
+         platform: str = "cpu",
+         verbose: bool = False) -> types.SimpleNamespace:
+    """Compile + register custom ops; returns a namespace of callables.
+
+    ``functions`` maps python op name -> C++ handler symbol, or
+    ``(symbol, out_spec)`` where ``out_spec(*arrays) -> ShapeDtypeStruct``
+    describes the output (default: same shape/dtype as the first input —
+    the elementwise convention).
+    """
+    key = name
+    if key in _loaded:
+        return _loaded[key]
+    build_dir = build_directory or os.path.join(
+        os.path.dirname(sources[0]), "build")
+    so_path = _compile(name, sources, build_dir, extra_cflags)
+    lib = ctypes.CDLL(so_path)
+
+    ns = types.SimpleNamespace(__so_path__=so_path)
+    for py_name, spec in functions.items():
+        symbol, out_spec = spec if isinstance(spec, tuple) else (spec, None)
+        handler = getattr(lib, symbol)
+        target = f"{name}.{py_name}"
+        jax.ffi.register_ffi_target(target, jax.ffi.pycapsule(handler),
+                                    platform=platform)
+
+        def make_raw(target, out_spec):
+            def raw(*arrays):
+                if out_spec is None:
+                    a0 = arrays[0]
+                    out = jax.ShapeDtypeStruct(a0.shape, a0.dtype)
+                else:
+                    out = out_spec(*arrays)
+                return jax.ffi.ffi_call(target, out)(*arrays)
+
+            return raw
+
+        raw = make_raw(target, out_spec)
+        # first-class framework op: tape/AMP/jit via the normal dispatch
+        public = _register_op(f"custom.{target}", nondiff=True)(raw)
+        setattr(ns, py_name, public)
+        setattr(ns, py_name + "_raw", raw)
+
+    _loaded[key] = ns
+    return ns
+
+
+def builtin_custom_ops():
+    """The in-tree demo library (csrc/custom_ops.cpp)."""
+    src = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                       "csrc", "custom_ops.cpp")
+    return load("paddle_tpu_demo_ops", [src],
+                functions={"bias_gelu": "BiasGelu",
+                           "relu_squared": "ReluSquared"})

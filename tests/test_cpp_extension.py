@@ -1,0 +1,59 @@
+"""C++ custom ops over the XLA FFI ABI (analog of the reference's
+PD_BUILD_OP custom-op path + phi/capi; loader in utils/cpp_extension.py,
+demo handlers in csrc/custom_ops.cpp)."""
+
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import builtin_custom_ops
+
+
+@pytest.fixture(scope="module")
+def ops():
+    return builtin_custom_ops()
+
+
+def _gelu_ref(v):
+    return 0.5 * v * (1 + np.tanh(0.7978845608028654
+                                  * (v + 0.044715 * v ** 3)))
+
+
+def test_custom_op_numeric(ops):
+    x = np.random.RandomState(0).randn(4, 8).astype("float32")
+    b = np.random.RandomState(1).randn(8).astype("float32")
+    out = ops.bias_gelu(paddle.to_tensor(x), paddle.to_tensor(b))
+    np.testing.assert_allclose(np.asarray(out._value), _gelu_ref(x + b),
+                               rtol=1e-5)
+    r = ops.relu_squared(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(r._value),
+                               np.maximum(x, 0) ** 2, rtol=1e-6)
+
+
+def test_custom_op_under_jit(ops):
+    x = np.random.RandomState(2).randn(16).astype("float32")
+    b = np.zeros(16, "float32")
+    got = jax.jit(ops.bias_gelu_raw)(x, b)
+    np.testing.assert_allclose(np.asarray(got), _gelu_ref(x), rtol=1e-5)
+
+
+def test_custom_op_is_registered_framework_op(ops):
+    from paddle_tpu.ops.registry import all_ops, dispatch
+
+    assert "custom.paddle_tpu_demo_ops.bias_gelu" in all_ops()
+    x = paddle.to_tensor(np.ones(4, "float32"))
+    out = dispatch("custom.paddle_tpu_demo_ops.relu_squared", x)
+    np.testing.assert_allclose(np.asarray(out._value), 1.0)
+
+
+def test_custom_op_error_surface(ops):
+    # C++ handler validates: bias that does not divide x errors out
+    x = paddle.to_tensor(np.ones((4, 8), "float32"))
+    bad = paddle.to_tensor(np.ones(3, "float32"))
+    with pytest.raises(Exception, match="bias must divide x"):
+        jax.block_until_ready(ops.bias_gelu(x, bad)._value)
+
+
+def test_load_is_cached(ops):
+    assert builtin_custom_ops() is ops
