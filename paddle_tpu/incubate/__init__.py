@@ -1,1 +1,2 @@
 from . import nn
+from . import optimizer

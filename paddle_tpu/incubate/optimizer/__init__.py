@@ -1,0 +1,195 @@
+"""paddle_tpu.incubate.optimizer — LookAhead, LBFGS, GradientMerge.
+
+Analogs of python/paddle/incubate/optimizer/{lookahead.py, lbfgs.py,
+gradient_merge.py}. All three are built over the eager Optimizer base:
+LookAhead keeps slow weights and interpolates every k steps; LBFGS runs
+the classic two-loop recursion with closure re-evaluation; GradientMerge
+accumulates k micro-step gradients before delegating one real step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...optimizer import Optimizer
+
+__all__ = ["LookAhead", "LBFGS", "GradientMergeOptimizer"]
+
+
+class LookAhead(Optimizer):
+    """lookahead.py:44 — fast weights step with the inner optimizer; every
+    ``k`` steps slow weights move ``alpha`` toward them and are copied
+    back."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha: float = 0.5,
+                 k: int = 5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None
+        self._parameters = inner_optimizer._parameters
+
+    def _ensure_slow(self):
+        if self._slow is None:
+            self._slow = [np.asarray(p._value).copy()
+                          for p in self._parameters]
+
+    def step(self):
+        self._ensure_slow()
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for i, p in enumerate(self._parameters):
+                fast = np.asarray(p._value, np.float32)
+                slow = self._slow[i].astype(np.float32)
+                slow = slow + self.alpha * (fast - slow)
+                self._slow[i] = slow
+                p.set_value(jnp.asarray(slow, p.dtype))
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "slow": self._slow, "step_count": self._step_count}
+
+
+class GradientMergeOptimizer(Optimizer):
+    """gradient_merge.py — accumulate ``k_steps`` micro-batch gradients
+    (averaged when ``avg``), then run ONE inner step."""
+
+    def __init__(self, inner_optimizer: Optimizer, k_steps: int = 1,
+                 avg: bool = True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._acc = None
+        self._micro = 0
+        self._parameters = inner_optimizer._parameters
+
+    def step(self):
+        params = self._parameters
+        if self._acc is None:
+            self._acc = [None] * len(params)
+        for i, p in enumerate(params):
+            if p.grad is None:
+                continue
+            g = p.grad._value
+            self._acc[i] = g if self._acc[i] is None else self._acc[i] + g
+        self._micro += 1
+        # micro-steps only bank the gradient
+        self.inner_optimizer.clear_grad()
+        if self._micro < self.k_steps:
+            return
+        for i, p in enumerate(params):
+            if self._acc[i] is None:
+                continue
+            g = self._acc[i] / self.k_steps if self.avg else self._acc[i]
+            p._grad = Tensor(g, stop_gradient=True)
+        self.inner_optimizer.step()
+        self.inner_optimizer.clear_grad()
+        self._acc = None
+        self._micro = 0
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+
+class LBFGS(Optimizer):
+    """lbfgs.py — limited-memory BFGS with the two-loop recursion and
+    backtracking (Armijo) line search; ``step(closure)`` re-evaluates the
+    loss like the reference/torch API."""
+
+    def __init__(self, learning_rate: float = 1.0, max_iter: int = 20,
+                 tolerance_grad: float = 1e-7, tolerance_change: float = 1e-9,
+                 history_size: int = 100, line_search_fn: Optional[str] = None,
+                 parameters: Optional[List] = None, name=None):
+        super().__init__(learning_rate, parameters, None, None, name)
+        self.max_iter = max_iter
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s: List[np.ndarray] = []
+        self._y: List[np.ndarray] = []
+
+    # -- flat helpers ------------------------------------------------------
+    def _flat_params(self):
+        return np.concatenate([np.asarray(p._value, np.float64).ravel()
+                               for p in self._parameters])
+
+    def _set_flat(self, flat):
+        off = 0
+        for p in self._parameters:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p.set_value(jnp.asarray(
+                flat[off:off + n].reshape(tuple(p.shape)), p.dtype))
+            off += n
+
+    def _flat_grad(self):
+        gs = []
+        for p in self._parameters:
+            g = p.grad
+            gs.append(np.zeros(int(np.prod(p.shape) or 1))
+                      if g is None else np.asarray(g._value,
+                                                   np.float64).ravel())
+        return np.concatenate(gs)
+
+    def _eval(self, closure):
+        self.clear_grad()
+        loss = closure()
+        return float(np.asarray(loss._value
+                                if isinstance(loss, Tensor) else loss))
+
+    def step(self, closure: Callable):
+        loss = self._eval(closure)
+        g = self._flat_grad()
+        for _ in range(self.max_iter):
+            if np.abs(g).max() <= self.tol_grad:
+                break
+            # two-loop recursion
+            q = g.copy()
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / max(float(y @ s), 1e-10)
+                a = rho * (s @ q)
+                alphas.append((a, rho, s, y))
+                q -= a * y
+            if self._y:
+                y_last, s_last = self._y[-1], self._s[-1]
+                q *= float(s_last @ y_last) / max(float(y_last @ y_last),
+                                                  1e-10)
+            for a, rho, s, y in reversed(alphas):
+                b = rho * (y @ q)
+                q += (a - b) * s
+            d = -q
+            # backtracking line search on the closure
+            x0 = self._flat_params()
+            t = self.get_lr() if not self._s else 1.0
+            f0, g0d = loss, float(g @ d)
+            for _ls in range(20):
+                self._set_flat(x0 + t * d)
+                f_new = self._eval(closure)
+                if f_new <= f0 + 1e-4 * t * g0d or \
+                        self.line_search_fn is None:
+                    break
+                t *= 0.5
+            g_new = self._flat_grad()
+            s_vec = t * d
+            y_vec = g_new - g
+            if float(s_vec @ y_vec) > 1e-10:
+                self._s.append(s_vec)
+                self._y.append(y_vec)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if abs(f_new - loss) < self.tol_change:
+                loss, g = f_new, g_new
+                break
+            loss, g = f_new, g_new
+        return Tensor(jnp.asarray(loss, jnp.float32))

@@ -3,7 +3,8 @@
 from . import lr
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from .optimizer import (
-    SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer, RMSProp,
+    SGD, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
+    Optimizer, RMSProp,
 )
 
 # make nn.ClipGradBy* available (reference exposes them under paddle.nn)

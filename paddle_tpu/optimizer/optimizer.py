@@ -355,3 +355,36 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         new_value = vf - lr * trust * r
         return new_value.astype(value.dtype), {"moment1": m1, "moment2": m2}
+
+
+class LarsMomentum(Optimizer):
+    """LARS momentum (reference: fluid LarsMomentumOptimizer /
+    lars_momentum op): per-layer trust ratio
+    ``local_lr = lr * coeff * ||w|| / (||g|| + wd * ||w|| + eps)``."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-8, exclude_from_weight_decay=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+        self._exclude = exclude_from_weight_decay or []
+
+    def init_param_state(self, value):
+        return {"velocity": jnp.zeros(value.shape, dtype=jnp.float32)}
+
+    def update(self, value, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        w = value.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._coeff * w_norm
+            / (g_norm + self._lars_wd * w_norm + self._eps),
+            lr)
+        v = self._momentum * state["velocity"] \
+            + local_lr * (g + self._lars_wd * w)
+        return (w - v).astype(value.dtype), {"velocity": v}

@@ -146,3 +146,82 @@ def test_amp_backward_through_cast():
     loss.backward()
     assert w.grad is not None
     assert str(w.grad.dtype) == "float32" or str(w.grad.dtype) == "bfloat16"
+
+
+# --------------------------------------------------------------- round 2
+
+
+def test_lars_momentum_trust_ratio():
+    paddle.seed(0)
+    w = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+    w.stop_gradient = False
+    opt = paddle.optimizer.LarsMomentum(learning_rate=0.1, momentum=0.9,
+                                        lars_coeff=0.001,
+                                        lars_weight_decay=0.0005,
+                                        parameters=[w])
+    (w * w).sum().backward()
+    g = np.full(4, 4.0)                     # d/dw (w^2).sum() = 2w
+    opt.step()
+    w_norm = np.linalg.norm(np.full(4, 2.0))
+    g_norm = np.linalg.norm(g)
+    local_lr = 0.1 * 0.001 * w_norm / (g_norm + 0.0005 * w_norm + 1e-8)
+    v = local_lr * (g + 0.0005 * 2.0)
+    np.testing.assert_allclose(np.asarray(w._value), 2.0 - v, rtol=1e-5)
+
+
+def test_lookahead_interpolates_slow_weights():
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    w = paddle.to_tensor(np.zeros(2, np.float32))
+    w.stop_gradient = False
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    la = LookAhead(inner, alpha=0.5, k=2)
+    for i in range(2):
+        w._grad = paddle.to_tensor(np.ones(2, np.float32))
+        la.step()
+        la.clear_grad()
+    # fast went to -2 after 2 sgd steps; slow = 0 + 0.5*(-2 - 0) = -1
+    np.testing.assert_allclose(np.asarray(w._value), -1.0, rtol=1e-6)
+
+
+def test_gradient_merge_accumulates():
+    from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+
+    w = paddle.to_tensor(np.zeros(3, np.float32))
+    w.stop_gradient = False
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    gm = GradientMergeOptimizer(inner, k_steps=4, avg=True)
+    for i in range(4):
+        w._grad = paddle.to_tensor(np.full(3, float(i), np.float32))
+        before = np.asarray(w._value).copy()
+        gm.step()
+        if i < 3:
+            np.testing.assert_allclose(np.asarray(w._value), before)
+    # one real step with mean grad (0+1+2+3)/4 = 1.5
+    np.testing.assert_allclose(np.asarray(w._value), -1.5, rtol=1e-6)
+
+
+def test_lbfgs_converges_on_quadratic():
+    from paddle_tpu.incubate.optimizer import LBFGS
+
+    rng = np.random.RandomState(0)
+    A = rng.randn(6, 6).astype("float32")
+    A = A @ A.T + 6 * np.eye(6, dtype="float32")
+    b = rng.randn(6).astype("float32")
+    x = paddle.to_tensor(np.zeros(6, np.float32))
+    x.stop_gradient = False
+    opt = LBFGS(learning_rate=1.0, max_iter=30, history_size=10,
+                line_search_fn="strong_wolfe", parameters=[x])
+
+    At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+
+    def closure():
+        loss = 0.5 * paddle.matmul(x, paddle.matmul(At, x)) \
+            - paddle.matmul(bt, x)
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    want = np.linalg.solve(A, b)
+    np.testing.assert_allclose(np.asarray(x._value), want, rtol=1e-3,
+                               atol=1e-4)
