@@ -69,6 +69,8 @@ from . import sparse
 from . import distribution
 from . import quantization
 from . import utils
+from . import geometric
+from . import audio
 
 
 def save(obj, path, **kwargs):

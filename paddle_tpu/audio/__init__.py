@@ -1,0 +1,14 @@
+"""paddle_tpu.audio — audio feature extraction.
+
+Analog of python/paddle/audio (functional/ window+mel+dct helpers,
+features/layers.py Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC).
+The STFT is framing + rfft — a batched matmul-and-FFT program XLA maps
+well to TPU; layers precompute window/filterbank/DCT matrices as
+constants.
+"""
+
+from . import functional
+from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

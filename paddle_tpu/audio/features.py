@@ -1,0 +1,103 @@
+"""Audio feature layers (analog of python/paddle/audio/features/layers.py:
+Spectrogram:45, MelSpectrogram:130, LogMelSpectrogram:237, MFCC:344)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length)
+
+    def forward(self, x):
+        spec = F.stft_frames(x, self.n_fft, self.hop_length,
+                             self.win_length, self.window,
+                             center=self.center, pad_mode=self.pad_mode)
+        mag = jnp.abs(spec)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor(mag.astype(jnp.float32))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode)
+        self.fbank = F.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                            f_max, htk, norm)
+
+    def forward(self, x):
+        s = self.spectrogram(x)._value      # [..., n_bins, frames]
+        mel = jnp.einsum("mf,...ft->...mt", self.fbank._value, s)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db)
+        self.dct = F.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        lm = self.logmel(x)._value          # [..., n_mels, frames]
+        out = jnp.einsum("mk,...mt->...kt", self.dct._value, lm)
+        return Tensor(out)

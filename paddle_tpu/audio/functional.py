@@ -1,0 +1,140 @@
+"""Audio functional helpers (analog of python/paddle/audio/functional:
+window_function.py get_window, functional.py hz_to_mel/mel_to_hz/
+mel_frequencies/compute_fbank_matrix/create_dct/power_to_db)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/bohman/ones (reference window_function.py)."""
+    n = win_length
+    m = n if fftbins else n - 1
+    k = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / m)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / m)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / m)
+             + 0.08 * np.cos(4 * np.pi * k / m))
+    elif window == "bohman":
+        x = np.abs(2 * k / m - 1.0)
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif window in ("ones", "rectangular", "boxcar"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w.astype("float32")))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:  # slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return out if np.ndim(freq) else float(out)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return out if np.ndim(mel) else float(out)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney"):
+    """Triangular mel filterbank [n_mels, n_fft//2 + 1] (reference
+    functional.py compute_fbank_matrix, librosa formulation)."""
+    f_max = f_max or sr / 2.0
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2.0, n_bins)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    weights = np.zeros((n_mels, n_bins))
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype("float32")))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """DCT-II basis [n_mels, n_mfcc] (reference functional.py create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)
+    basis = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(n_mels)
+        basis[:, 1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return Tensor(jnp.asarray(basis.astype("float32")))
+
+
+def power_to_db(magnitude, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    x = magnitude._value if isinstance(magnitude, Tensor) \
+        else jnp.asarray(magnitude)
+    db = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    db = db - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+    if top_db is not None:
+        db = jnp.maximum(db, db.max() - top_db)
+    return Tensor(db)
+
+
+def stft_frames(x, n_fft: int, hop_length: int, win_length: int,
+                window, center: bool = True, pad_mode: str = "reflect"):
+    """Frame + window + rfft: x [..., T] -> complex [..., n_fft//2+1,
+    frames]."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    wv = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        wv = jnp.pad(wv, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = [(0, 0)] * (xv.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        xv = jnp.pad(xv, pad, mode=pad_mode)
+    t = xv.shape[-1]
+    n_frames = 1 + (t - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = xv[..., idx] * wv              # [..., frames, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1)    # [..., frames, n_bins]
+    return jnp.swapaxes(spec, -1, -2)       # [..., n_bins, frames]

@@ -1,0 +1,133 @@
+"""paddle_tpu.geometric — graph learning ops.
+
+Analog of python/paddle/geometric (segment math math.py, message passing
+message_passing/, reindex.py, sampling/). The message-passing and segment
+ops are the framework's registered YAML ops (scatter/gather programs XLA
+fuses); sampling utilities are host-side (eager, nondiff) like the
+reference's CPU kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.registry import dispatch
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "reindex_graph", "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+def _pool(x, segment_ids, pooltype):
+    return dispatch("segment_pool", x, segment_ids, pooltype=pooltype)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _pool(data, segment_ids, "SUM")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _pool(data, segment_ids, "MEAN")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _pool(data, segment_ids, "MIN")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _pool(data, segment_ids, "MAX")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    return dispatch("send_u_recv", x, src_index, dst_index,
+                    reduce_op=reduce_op.upper(), out_size=out_size)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    return dispatch("send_ue_recv", x, y, src_index, dst_index,
+                    message_op=message_op.upper(),
+                    reduce_op=reduce_op.upper(), out_size=out_size)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    return dispatch("send_uv", x, y, src_index, dst_index,
+                    message_op=message_op.upper())
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Map center nodes ``x`` + their ``neighbors`` onto contiguous ids
+    (reference reindex.py reindex_graph): centers take 0..len(x)-1, new
+    neighbor ids follow in first-seen order. Returns
+    (reindexed_src, reindexed_dst, out_nodes)."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._value if isinstance(neighbors, Tensor)
+                    else neighbors)
+    cnt = np.asarray(count._value if isinstance(count, Tensor) else count)
+    mapping = {int(n): i for i, n in enumerate(xv)}
+    out_nodes = list(xv)
+    src = np.empty(len(nb), np.int64)
+    for i, n in enumerate(nb):
+        key = int(n)
+        if key not in mapping:
+            mapping[key] = len(out_nodes)
+            out_nodes.append(key)
+        src[i] = mapping[key]
+    dst = np.repeat(np.arange(len(xv), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def _sample(row, colptr, nodes, sample_size, weights=None):
+    rng = np.random.default_rng(0)
+    out_neighbors, out_counts = [], []
+    for n in np.asarray(nodes):
+        lo, hi = int(colptr[n]), int(colptr[n + 1])
+        neigh = np.asarray(row[lo:hi])
+        if sample_size < 0 or len(neigh) <= sample_size:
+            chosen = neigh
+        elif weights is None:
+            chosen = rng.choice(neigh, size=sample_size, replace=False)
+        else:
+            w = np.asarray(weights[lo:hi], np.float64)
+            p = w / w.sum()
+            chosen = rng.choice(neigh, size=sample_size, replace=False, p=p)
+        out_neighbors.append(chosen)
+        out_counts.append(len(chosen))
+    return (np.concatenate(out_neighbors) if out_neighbors
+            else np.empty(0, np.int64),
+            np.asarray(out_counts, np.int64))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    sampling/neighbors.py). Host-side, nondiff."""
+    r = np.asarray(row._value if isinstance(row, Tensor) else row)
+    c = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    n = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor)
+                   else input_nodes)
+    neigh, cnt = _sample(r, c, n, int(sample_size))
+    return Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    r = np.asarray(row._value if isinstance(row, Tensor) else row)
+    c = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    w = np.asarray(edge_weight._value if isinstance(edge_weight, Tensor)
+                   else edge_weight)
+    n = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor)
+                   else input_nodes)
+    neigh, cnt = _sample(r, c, n, int(sample_size), weights=w)
+    return Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt))

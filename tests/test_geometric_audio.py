@@ -1,0 +1,121 @@
+"""paddle.geometric and paddle.audio packages vs scipy/manual goldens."""
+
+import numpy as np
+import pytest
+import scipy.signal
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, geometric
+
+
+# --------------------------------------------------------------- geometric
+
+def test_segment_math():
+    x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(6, 2))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1, 1, 2], np.int32))
+    np.testing.assert_allclose(
+        np.asarray(geometric.segment_sum(x, seg)._value),
+        [[2, 4], [18, 21], [10, 11]])
+    np.testing.assert_allclose(
+        np.asarray(geometric.segment_mean(x, seg)._value),
+        [[1, 2], [6, 7], [10, 11]])
+    np.testing.assert_allclose(
+        np.asarray(geometric.segment_max(x, seg)._value),
+        [[2, 3], [8, 9], [10, 11]])
+
+
+def test_message_passing():
+    x = paddle.to_tensor(np.eye(4, dtype="float32"))
+    src = paddle.to_tensor(np.array([0, 1, 2, 2], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 3, 1], np.int32))
+    out = geometric.send_u_recv(x, src, dst)
+    want = np.zeros((4, 4), np.float32)
+    for s, d in [(0, 1), (1, 2), (2, 3), (2, 1)]:
+        want[d] += np.eye(4)[s]
+    np.testing.assert_allclose(np.asarray(out._value), want)
+
+
+def test_reindex_graph():
+    x = paddle.to_tensor(np.array([10, 20], np.int64))
+    neighbors = paddle.to_tensor(np.array([30, 20, 10, 40], np.int64))
+    count = paddle.to_tensor(np.array([2, 2], np.int64))
+    src, dst, nodes = geometric.reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(np.asarray(nodes._value), [10, 20, 30, 40])
+    np.testing.assert_array_equal(np.asarray(src._value), [2, 1, 0, 3])
+    np.testing.assert_array_equal(np.asarray(dst._value), [0, 0, 1, 1])
+
+
+def test_sample_neighbors():
+    # CSC: node0 -> {1,2,3}, node1 -> {0}, node2 -> {}
+    row = np.array([1, 2, 3, 0], np.int64)
+    colptr = np.array([0, 3, 4, 4], np.int64)
+    neigh, cnt = geometric.sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([0, 1, 2], np.int64)), sample_size=2)
+    counts = np.asarray(cnt._value)
+    assert counts.tolist() == [2, 1, 0]
+    sampled = np.asarray(neigh._value)
+    assert set(sampled[:2]).issubset({1, 2, 3}) and sampled[2] == 0
+
+    w = np.array([0.0, 0.0, 1.0, 1.0], np.float32)
+    neigh2, cnt2 = geometric.weighted_sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(w),
+        paddle.to_tensor(np.array([0], np.int64)), sample_size=1)
+    assert np.asarray(neigh2._value)[0] == 3  # only nonzero-weight pick
+
+
+# ------------------------------------------------------------------- audio
+
+def test_spectrogram_matches_scipy_stft():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 2048).astype("float32")
+    n_fft, hop = 256, 64
+    layer = audio.Spectrogram(n_fft=n_fft, hop_length=hop, power=1.0,
+                              window="hann", center=True)
+    got = np.asarray(layer(paddle.to_tensor(x))._value)
+
+    _, _, z = scipy.signal.stft(
+        x, nperseg=n_fft, noverlap=n_fft - hop, window="hann",
+        boundary="even", padded=False, return_onesided=True)
+    want = np.abs(z) * (np.hanning(n_fft).sum())  # scipy normalizes by win
+    assert got.shape[1] == n_fft // 2 + 1
+    t = min(got.shape[-1], want.shape[-1])
+    np.testing.assert_allclose(got[..., 1:t - 1], want[..., 1:t - 1],
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_mel_filterbank_properties():
+    fb = np.asarray(audio.functional.compute_fbank_matrix(
+        16000, 512, n_mels=40, f_min=0.0)._value)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every filter has support, peaks ordered by frequency
+    peaks = fb.argmax(axis=1)
+    assert (np.diff(peaks) >= 0).all() and fb.sum() > 0
+    # htk vs slaney mel scales round-trip
+    for htk in (False, True):
+        f = 4000.0
+        m = audio.functional.hz_to_mel(f, htk)
+        np.testing.assert_allclose(audio.functional.mel_to_hz(m, htk), f,
+                                   rtol=1e-6)
+
+
+def test_mfcc_pipeline():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 4096).astype("float32")
+    mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+    out = np.asarray(mfcc(paddle.to_tensor(x))._value)
+    assert out.shape[0] == 1 and out.shape[1] == 13
+    assert np.isfinite(out).all()
+    # DCT basis is orthonormal (ortho norm)
+    dct = np.asarray(audio.functional.create_dct(13, 40)._value)
+    gram = dct.T @ dct
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+def test_power_to_db_top_db():
+    x = paddle.to_tensor(np.array([1.0, 1e-6], np.float32))
+    db = np.asarray(audio.functional.power_to_db(x, top_db=40.0)._value)
+    np.testing.assert_allclose(db[0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(db[1], -40.0, atol=1e-6)  # clamped
