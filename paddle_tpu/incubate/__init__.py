@@ -1,2 +1,3 @@
 from . import nn
 from . import optimizer
+from . import asp

@@ -1,0 +1,120 @@
+"""paddle_tpu.incubate.asp — automatic structured (n:m) sparsity.
+
+Analog of python/paddle/incubate/asp/asp.py (+ utils.py mask algorithms):
+``prune_model`` computes per-layer n:m masks (2:4 by default — the
+sparsity pattern TPU/SparseCore-era hardware and the reference's Ampere
+target both use) and applies them; ``decorate`` wraps an optimizer so
+masks are re-applied after every step, keeping pruned weights at zero
+through training.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn import Conv2D, Linear
+from ...nn.layer import Layer
+from ...optimizer import Optimizer
+
+__all__ = ["calculate_density", "create_mask", "check_mask_2d4",
+           "prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers", "OptimizerWithSparsityGuarantee"]
+
+_excluded: set = set()
+
+
+def set_excluded_layers(layers: List[str], model: Optional[Layer] = None):
+    """Exclude sublayers (by structured name) from pruning."""
+    for name in layers:
+        _excluded.add(name)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x) -> float:
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(v)) / max(v.size, 1)
+
+
+def create_mask(x, func_name: str = "mask_1d", n: int = 2, m: int = 4):
+    """n:m structured mask along the last dim: keep the ``n``
+    largest-|w| of every ``m`` consecutive weights (reference
+    utils.py get_mask_1d / create_mask)."""
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    orig_shape = v.shape
+    flat = v.reshape(-1, orig_shape[-1])
+    cols = orig_shape[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    groups = np.abs(flat).reshape(flat.shape[0], -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape)[:, :cols].reshape(orig_shape)
+    return Tensor(jnp.asarray(mask.astype(v.dtype)))
+
+
+def check_mask_2d4(x, n: int = 2, m: int = 4) -> bool:
+    """True when every m-group along the last dim has <= n nonzeros."""
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    flat = v.reshape(-1, v.shape[-1])
+    pad = (-v.shape[-1]) % m
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    groups = flat.reshape(flat.shape[0], -1, m)
+    return bool((np.count_nonzero(groups, axis=-1) <= n).all())
+
+
+def _prunable(model: Layer):
+    for name, sub in model.named_sublayers():
+        if name in _excluded:
+            continue
+        if isinstance(sub, (Linear, Conv2D)):
+            yield name, sub
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m masks to every Linear/Conv2D weight; masks are remembered
+    so ``decorate``d optimizers keep them enforced."""
+    pruned = {}
+    for name, sub in _prunable(model):
+        w = sub.weight
+        mask = create_mask(w, mask_algo, n, m)
+        w.set_value(w._value * mask._value)
+        if with_mask:
+            # stored ON the parameter (an id-keyed registry would collide
+            # when a collected param's id is recycled)
+            w._asp_mask = np.asarray(mask._value)
+        pruned[name] = mask
+    return pruned
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wrapped optimizer re-applying the recorded masks after each step
+    (reference asp.py:233 decorate)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self._inner = optimizer
+
+    def step(self, *args, **kwargs):
+        out = self._inner.step(*args, **kwargs)
+        for p in self._inner._parameters:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p.set_value(p._value * jnp.asarray(mask))
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def decorate(optimizer: Optimizer) -> OptimizerWithSparsityGuarantee:
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,60 @@
+"""incubate.asp 2:4 structured sparsity (reference incubate/asp/asp.py)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 8)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.fc1(x)))
+
+
+def test_create_mask_keeps_largest():
+    w = paddle.to_tensor(np.array([[1.0, -3.0, 0.5, 2.0],
+                                   [4.0, 0.1, -0.2, 5.0]], np.float32))
+    mask = np.asarray(asp.create_mask(w)._value)
+    np.testing.assert_allclose(mask, [[0, 1, 0, 1], [1, 0, 0, 1]])
+
+
+def test_prune_model_2to4_and_density():
+    net = Net()
+    assert asp.calculate_density(net.fc1.weight) == 1.0
+    asp.prune_model(net)
+    for w in (net.fc1.weight, net.fc2.weight):
+        assert asp.check_mask_2d4(w)
+        np.testing.assert_allclose(asp.calculate_density(w), 0.5, atol=0.01)
+
+
+def test_decorated_optimizer_keeps_sparsity():
+    net = Net()
+    asp.prune_model(net)
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=list(net.parameters())))
+    for _ in range(3):
+        x = paddle.rand([4, 16])
+        (net(x) ** 2).mean().backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.check_mask_2d4(net.fc1.weight)
+    assert asp.check_mask_2d4(net.fc2.weight)
+    # weights still train where unmasked
+    assert asp.calculate_density(net.fc1.weight) > 0.4
+
+
+def test_excluded_layers():
+    net = Net()
+    asp.set_excluded_layers(["fc2"], net)
+    try:
+        asp.prune_model(net)
+        assert asp.check_mask_2d4(net.fc1.weight)
+        assert asp.calculate_density(net.fc2.weight) == 1.0
+    finally:
+        asp.reset_excluded_layers()
