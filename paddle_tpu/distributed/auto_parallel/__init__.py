@@ -20,6 +20,8 @@ from .api import (
     shard_dataloader,
     unshard_dtensor,
 )
+from .spmd_rules import (infer_forward, register_spmd_rule,
+                         shard_op)
 from ..process_mesh import ProcessMesh, get_mesh, set_mesh, init_mesh, auto_mesh
 from ..placements import Partial, Placement, Replicate, Shard
 

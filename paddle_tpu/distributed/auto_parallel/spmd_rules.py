@@ -1,0 +1,158 @@
+"""Per-op SPMD (sharding propagation) rules.
+
+Analog of the reference's SPMD rule library
+(paddle/phi/infermeta/spmd_rules/, 101 files; invoked from the generated
+dist APIs, dist_api_gen.py:859). On TPU, GSPMD propagates shardings
+through whole programs — so the framework-level rules serve the narrower
+role they also serve in the reference: (a) a queryable oracle
+(``infer_forward``) for planners like shard_layer/auto_tuner, and (b)
+explicit ``shard_op`` constraint placement when GSPMD's choice must be
+overridden (the reference's per-op override path).
+
+Rules are registered per op name (populating ``OpDef.spmd_rule``) and map
+input ``PartitionSpec``s -> (input specs, output specs), possibly
+rewriting inputs (e.g. forcing a replicated contraction dim).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...ops import registry as _registry
+
+_RULES: Dict[str, Callable] = {}
+
+
+def register_spmd_rule(op_name: str):
+    """Attach a rule to a registered op (fills the OpDef.spmd_rule slot)."""
+
+    def deco(fn):
+        _RULES[op_name] = fn
+        if op_name in _registry.all_ops():
+            _registry.get_op(op_name).spmd_rule = fn
+        return fn
+
+    return deco
+
+
+def get_rule(op_name: str) -> Optional[Callable]:
+    return _RULES.get(op_name)
+
+
+def infer_forward(op_name: str, *in_specs: P, **kwargs):
+    """Propagate input PartitionSpecs through ``op_name``:
+    returns (resolved_in_specs, out_specs)."""
+    rule = _RULES.get(op_name)
+    if rule is None:
+        raise NotImplementedError(f"no spmd rule for op {op_name!r}")
+    return rule(*in_specs, **kwargs)
+
+
+# -------------------------------------------------------------------- rules
+
+def _axes(spec: Optional[P]) -> Tuple:
+    return tuple(spec) if spec is not None else ()
+
+
+@register_spmd_rule("matmul")
+def _matmul_rule(x: P, y: P, **kw):
+    """[.., m, k] @ [.., k, n]: row shard follows x, column shard follows
+    y; a sharded contraction dim k must agree on both sides (the result
+    then carries a pending partial-sum over that axis — reference
+    matmul.cc semantics)."""
+    xa, ya = _axes(x), _axes(y)
+    m_ax = xa[-2] if len(xa) >= 2 else None
+    kx = xa[-1] if xa else None
+    ky = ya[-2] if len(ya) >= 2 else None
+    n_ax = ya[-1] if ya else None
+    if kx != ky:
+        # disagreeing contraction shard: replicate k on both sides
+        kx = ky = None
+    batch = tuple(xa[:-2])
+    in_x = P(*batch, m_ax, kx)
+    in_y = P(*((None,) * max(len(ya) - 2, 0)), ky, n_ax)
+    out = P(*batch, m_ax, n_ax)
+    partial = (kx,) if kx is not None else ()
+    return (in_x, in_y), (out,), {"partial_axes": partial}
+
+
+def _elementwise_rule_factory(op_name):
+    @register_spmd_rule(op_name)
+    def rule(*specs: P, **kw):
+        # pointwise: the output inherits the first sharded input's spec;
+        # disagreeing inputs are aligned to it
+        chosen = next((s for s in specs if s is not None and any(_axes(s))),
+                      specs[0] if specs else None)
+        return tuple(chosen for _ in specs), (chosen,), {}
+
+    return rule
+
+
+for _name in ("add", "subtract", "multiply", "divide", "relu", "gelu",
+              "tanh", "cast", "scale", "dropout"):
+    _elementwise_rule_factory(_name)
+
+
+@register_spmd_rule("pallas_flash_attention")
+def _flash_rule(q: P, k: P, v: P, **kw):
+    """[b, s, h, d] attention (reference flash_attention.cc): batch and
+    head shards propagate; the sequence dim must be replicated for the
+    dense kernel (ring attention owns seq sharding); d replicated."""
+    qa = _axes(q)
+    b_ax = qa[0] if qa else None
+    h_ax = qa[2] if len(qa) > 2 else None
+    spec = P(b_ax, None, h_ax, None)
+    return (spec, spec, spec), (spec,), {}
+
+
+@register_spmd_rule("embedding")
+def _embedding_rule(ids: P, table: P, **kw):
+    """ids [.., s], table [v, h]: vocab-sharded table yields a pending
+    partial over the vocab axis (reference embedding.cc)."""
+    ta = _axes(table)
+    v_ax = ta[0] if ta else None
+    h_ax = ta[1] if len(ta) > 1 else None
+    out = P(*_axes(ids), h_ax)
+    partial = (v_ax,) if v_ax is not None else ()
+    return (ids, table), (out,), {"partial_axes": partial}
+
+
+# ---------------------------------------------------------------- shard_op
+
+def shard_op(op_name: str, mesh, *in_tensors, rule_kwargs=None, **op_kwargs):
+    """Run a registered op with its SPMD rule enforced: inputs get
+    ``with_sharding_constraint`` to the rule's resolved specs and outputs
+    are constrained to the rule's output specs — the explicit per-op
+    override the reference's dist branch performs before the local
+    kernel (dist_api_gen.py MAIN_DIST_BRANCH_TEMPLATE)."""
+    rule = _RULES.get(op_name)
+    if rule is None:
+        raise NotImplementedError(f"no spmd rule for op {op_name!r}")
+    in_specs = []
+    for t in in_tensors:
+        v = t._value if isinstance(t, Tensor) else t
+        s = getattr(v, "sharding", None)
+        in_specs.append(s.spec if isinstance(s, NamedSharding) else None)
+    resolved_in, out_specs, meta = rule(*in_specs, **(rule_kwargs or {}))
+    placed = []
+    for t, spec in zip(in_tensors, resolved_in):
+        v = t._value if isinstance(t, Tensor) else t
+        if spec is not None:
+            v = jax.device_put(v, NamedSharding(mesh, spec))
+        placed.append(Tensor(v) if isinstance(t, Tensor) else v)
+    out = _registry.dispatch(op_name, *placed, **op_kwargs)
+    outs = out if isinstance(out, tuple) else (out,)
+    constrained = []
+    for o, spec in zip(outs, out_specs):
+        if spec is not None and isinstance(o, Tensor):
+            o = Tensor(jax.device_put(o._value, NamedSharding(mesh, spec)))
+        constrained.append(o)
+    # NOTE: rule metadata may report pending-partial axes — that is the
+    # per-rank/graph-level contract the reference's kernels see. Under the
+    # single-controller eager runtime the global op already includes the
+    # contraction collective, so outputs here are complete values.
+    return constrained[0] if len(constrained) == 1 else tuple(constrained)

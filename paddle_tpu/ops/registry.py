@@ -38,8 +38,10 @@ class OpDef:
     fn: Callable
     amp: Optional[str] = None  # 'white' (bf16), 'black' (fp32), None
     nondiff: bool = False  # op has no differentiable outputs (argmax, equal, ...)
-    spmd_rule: Optional[Callable] = None  # sharding propagation rule (dist use)
-    backward_name: Optional[str] = None
+    # sharding propagation rule; populated by
+    # distributed/auto_parallel/spmd_rules.register_spmd_rule and consumed
+    # by infer_forward/shard_op (the reference's per-op SPMD override path)
+    spmd_rule: Optional[Callable] = None
 
 
 _REGISTRY: Dict[str, OpDef] = {}

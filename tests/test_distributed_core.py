@@ -249,3 +249,64 @@ def test_shard_optimizer_stage3():
     from jax.sharding import NamedSharding
     assert isinstance(s, NamedSharding)
     assert tuple(s.spec) and s.spec[0] == "dp"
+
+
+# ------------------------------------------------------------- SPMD rules
+
+
+def test_spmd_rule_matmul_propagation():
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.auto_parallel import infer_forward
+
+    # row-sharded x, column-sharded y: no conflict
+    (ix, iy), (out,), meta = infer_forward("matmul", P("dp", None),
+                                           P(None, "mp"))
+    assert tuple(out) == ("dp", "mp")
+    assert meta["partial_axes"] == ()
+    # agreeing contraction shard -> pending partial over mp
+    (ix, iy), (out,), meta = infer_forward("matmul", P(None, "mp"),
+                                           P("mp", None))
+    assert meta["partial_axes"] == ("mp",)
+    # disagreeing contraction shard -> k replicated on both sides
+    (ix, iy), (out,), meta = infer_forward("matmul", P(None, "mp"),
+                                           P("dp", None))
+    assert tuple(ix)[-1] is None and tuple(iy)[0] is None
+    assert meta["partial_axes"] == ()
+
+
+def test_spmd_rule_registered_on_opdef():
+    from paddle_tpu.ops.registry import get_op
+
+    assert get_op("matmul").spmd_rule is not None
+    assert get_op("add").spmd_rule is not None
+
+
+def test_shard_op_applies_constraints():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.auto_parallel import shard_op
+
+    devs = np.asarray(jax.devices()[:8], dtype=object).reshape(2, 4)
+    mesh = jax.sharding.Mesh(devs, ("dp", "mp"))
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(16, 12).astype("float32"))
+    out = shard_op("matmul", mesh, x, y,
+                   rule_kwargs=None)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(x._value) @ np.asarray(y._value),
+                               rtol=1e-3, atol=1e-5)
+
+    # with sharded inputs the output carries the propagated spec
+    xs = paddle.to_tensor(jax.device_put(x._value,
+                                         NamedSharding(mesh, P("dp", None))))
+    ys = paddle.to_tensor(jax.device_put(y._value,
+                                         NamedSharding(mesh, P(None, "mp"))))
+    out2 = shard_op("matmul", mesh, xs, ys)
+    spec = out2._value.sharding.spec
+    assert tuple(spec) == ("dp", "mp")
+    np.testing.assert_allclose(np.asarray(out2._value),
+                               np.asarray(x._value) @ np.asarray(y._value),
+                               rtol=1e-3, atol=1e-5)
