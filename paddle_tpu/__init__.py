@@ -71,6 +71,7 @@ from . import quantization
 from . import utils
 from . import geometric
 from . import audio
+from . import text
 
 
 def save(obj, path, **kwargs):

@@ -1,0 +1,98 @@
+"""paddle_tpu.text — text utilities and datasets.
+
+Analog of python/paddle/text: the ViterbiDecoder layer/functional wrap the
+registered viterbi_decode op; datasets mirror the reference surface with a
+synthetic backend (the reference downloads corpora — zero-egress builds
+generate deterministic token streams with the same shapes instead).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..nn.layer import Layer
+from ..ops.registry import dispatch
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "Imdb", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """(scores, best-tag paths) for a batch of CRF emissions (reference
+    python/paddle/text/viterbi_decode.py → viterbi_decode op)."""
+    return dispatch("viterbi_decode", potentials, transition_params,
+                    lengths, include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _SyntheticTextDataset(Dataset):
+    """Deterministic token-id sequences standing in for a downloaded
+    corpus (shapes/dtypes match the reference dataset)."""
+
+    def __init__(self, mode: str, size: int, seq_len: int, vocab: int,
+                 num_classes: int = 2, seed: int = 0):
+        self.mode = mode
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self._x = rng.randint(1, vocab, size=(size, seq_len)).astype("int64")
+        self._y = rng.randint(0, num_classes, size=(size,)).astype("int64")
+
+    def __getitem__(self, idx):
+        return self._x[idx], self._y[idx]
+
+    def __len__(self):
+        return len(self._x)
+
+
+class Imdb(_SyntheticTextDataset):
+    """Sentiment classification (reference text/datasets/imdb.py)."""
+
+    def __init__(self, mode="train", cutoff=150, size=256, seq_len=128,
+                 vocab=5000):
+        super().__init__(mode, size, seq_len, vocab, num_classes=2)
+
+
+class UCIHousing(Dataset):
+    """Regression (reference text/datasets/uci_housing.py shape: 13 -> 1)."""
+
+    def __init__(self, mode="train", size=256):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self._x = rng.rand(size, 13).astype("float32")
+        w = np.linspace(0.1, 1.3, 13, dtype="float32")
+        self._y = (self._x @ w)[:, None].astype("float32")
+
+    def __getitem__(self, idx):
+        return self._x[idx], self._y[idx]
+
+    def __len__(self):
+        return len(self._x)
+
+
+class WMT14(_SyntheticTextDataset):
+    """Translation pairs (reference text/datasets/wmt14.py)."""
+
+    def __init__(self, mode="train", dict_size=30000, size=256, seq_len=32):
+        super().__init__(mode, size, seq_len, min(dict_size, 30000))
+        rng = np.random.RandomState(42)
+        self._tgt = rng.randint(1, min(dict_size, 30000),
+                                size=(size, seq_len)).astype("int64")
+
+    def __getitem__(self, idx):
+        return self._x[idx], self._tgt[idx], self._tgt[idx]
+
+
+class WMT16(WMT14):
+    pass

@@ -119,3 +119,39 @@ def test_power_to_db_top_db():
     db = np.asarray(audio.functional.power_to_db(x, top_db=40.0)._value)
     np.testing.assert_allclose(db[0], 0.0, atol=1e-6)
     np.testing.assert_allclose(db[1], -40.0, atol=1e-6)  # clamped
+
+
+# -------------------------------------------------------------------- text
+
+def test_text_viterbi_decoder_layer():
+    import paddle_tpu.text as text
+
+    rng = np.random.RandomState(0)
+    pot = paddle.to_tensor(rng.rand(2, 5, 6).astype("float32"))
+    trans = paddle.to_tensor(rng.rand(6, 6).astype("float32"))
+    lens = paddle.to_tensor(np.array([5, 3], np.int64))
+    dec = text.ViterbiDecoder(trans)
+    scores, paths = dec(pot, lens)
+    assert tuple(paths.shape)[0] == 2
+    s2, p2 = text.viterbi_decode(pot, trans, lens)
+    np.testing.assert_allclose(np.asarray(scores._value),
+                               np.asarray(s2._value))
+
+
+def test_text_datasets():
+    import paddle_tpu.text as text
+
+    ds = text.Imdb(mode="train")
+    x, y = ds[0]
+    assert x.dtype == np.int64 and y in (0, 1)
+    assert len(ds) == 256
+    h = text.UCIHousing(mode="test")
+    xf, yf = h[3]
+    assert xf.shape == (13,) and yf.shape == (1,)
+    w = text.WMT14(mode="train")
+    src, tgt, lbl = w[0]
+    assert src.shape == tgt.shape
+    # usable through the DataLoader
+    dl = paddle.io.DataLoader(ds, batch_size=32)
+    xb, yb = next(iter(dl))
+    assert tuple(xb.shape) == (32, 128)
