@@ -72,6 +72,7 @@ from . import utils
 from . import geometric
 from . import audio
 from . import text
+from . import onnx
 
 
 def save(obj, path, **kwargs):

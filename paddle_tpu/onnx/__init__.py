@@ -1,0 +1,11 @@
+"""paddle.onnx — ONNX model export (reference: python/paddle/onnx/).
+
+``export(layer, path, input_spec)`` traces the layer to a jaxpr and writes
+a self-contained ``.onnx`` protobuf (opset 13, no external deps);
+``ReferenceEvaluator`` runs such a file with numpy for validation.
+"""
+
+from .export import export
+from .reference import ReferenceEvaluator
+
+__all__ = ["export", "ReferenceEvaluator"]

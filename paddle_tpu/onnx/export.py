@@ -1,0 +1,638 @@
+"""paddle.onnx.export — trace a Layer and emit an ONNX model file.
+
+Analog of the reference's ``python/paddle/onnx/export.py`` (which shells out
+to the paddle2onnx converter over a static Program). The TPU-native design
+instead traces the layer to a jaxpr — the same functional graph jit compiles —
+and lowers each jax primitive to ONNX ops (opset 13), serialized with the
+hand-rolled protobuf codec in :mod:`.proto`.
+
+Captured parameters become graph initializers (named after the layer's
+``named_parameters`` when identifiable). bfloat16 values are promoted to
+float32 at export (ONNX runtimes' bf16 coverage is poor; same policy as
+paddle2onnx's deploy-time cast).
+
+Covered primitives: matmul/einsum (any ``dot_general``), conv, pooling,
+elementwise/unary math, comparisons, reductions, argmax/min, shape ops
+(reshape/transpose/broadcast/slice/concat/pad/squeeze), select/clamp/cast,
+axis-gather (embedding lookups), cumsum, iota, and inlined sub-jaxprs
+(pjit/custom_jvp/custom_vjp/remat). Anything else raises with the primitive
+name so the gap is explicit.
+"""
+
+from __future__ import annotations
+
+import string
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jcore
+
+from . import proto
+
+__all__ = ["export"]
+
+
+def _np(x) -> np.ndarray:
+    arr = np.asarray(x)
+    if arr.dtype == jnp.bfloat16:  # promote: ONNX bf16 support is poor
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _onnx_dtype(dt) -> int:
+    if np.dtype(dt) == jnp.bfloat16:
+        return proto.FLOAT
+    return proto.np_to_onnx_dtype(dt)
+
+
+class _Builder:
+    """Accumulates ONNX graph pieces while walking a jaxpr."""
+
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self._names: Dict[Any, str] = {}   # jaxpr Var -> onnx value name
+        self._counter = 0
+        self._const_cache: Dict[Any, str] = {}
+
+    def fresh(self, hint: str = "t") -> str:
+        self._counter += 1
+        return f"{hint}_{self._counter}"
+
+    def set_name(self, var, name: str):
+        self._names[var] = name
+
+    def name_of(self, var) -> str:
+        """Value name for a jaxpr atom (Var or Literal)."""
+        if isinstance(var, jcore.Literal):
+            return self.const(np.asarray(var.val))
+        return self._names[var]
+
+    def const(self, arr: np.ndarray, hint: str = "const") -> str:
+        arr = _np(arr)
+        key = (arr.dtype.str, arr.shape, arr.tobytes())
+        if key in self._const_cache:
+            return self._const_cache[key]
+        name = self.fresh(hint)
+        self.initializers.append(proto.tensor(name, arr))
+        self._const_cache[key] = name
+        return name
+
+    def add_node(self, op_type: str, inputs: Sequence[str],
+                 outputs: Sequence[str], **attrs):
+        self.nodes.append(proto.node(op_type, inputs, outputs,
+                                     name=self.fresh(op_type.lower()), **attrs))
+
+    def emit(self, op_type: str, inputs: Sequence[str], hint: str = "",
+             **attrs) -> str:
+        out = self.fresh(hint or op_type.lower())
+        self.add_node(op_type, inputs, [out], **attrs)
+        return out
+
+
+_HANDLERS: Dict[str, Callable] = {}
+
+
+def _handler(*prims):
+    def deco(fn):
+        for p in prims:
+            _HANDLERS[p] = fn
+        return fn
+    return deco
+
+
+# ---- simple 1:1 maps ------------------------------------------------------
+
+_UNARY = {
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "abs": "Abs", "neg": "Neg", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round", "erf": "Erf",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "asin": "Asin",
+    "acos": "Acos", "atan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "asinh": "Asinh", "acosh": "Acosh", "atanh": "Atanh", "not": "Not",
+}
+
+_BINARY = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "pow": "Pow",
+    "max": "Max", "min": "Min", "and": "And", "or": "Or", "xor": "Xor",
+    "add_any": "Add",
+    "eq": "Equal", "lt": "Less", "le": "LessOrEqual", "gt": "Greater",
+    "ge": "GreaterOrEqual",
+}
+
+
+def _convert_eqn(b: _Builder, eqn) -> None:
+    prim = eqn.primitive.name
+    ins = [b.name_of(v) for v in eqn.invars]
+    outs = [b.fresh(prim) for _ in eqn.outvars]
+    for var, name in zip(eqn.outvars, outs):
+        b.set_name(var, name)
+
+    if prim in _UNARY:
+        b.add_node(_UNARY[prim], ins, outs)
+        return
+    if prim in _BINARY:
+        b.add_node(_BINARY[prim], ins, outs)
+        return
+    if prim in _HANDLERS:
+        _HANDLERS[prim](b, eqn, ins, outs)
+        return
+    raise NotImplementedError(
+        f"paddle.onnx.export: jax primitive '{prim}' has no ONNX lowering "
+        f"(eqn: {eqn})")
+
+
+@_handler("stop_gradient", "copy", "device_put", "sharding_constraint")
+def _identity(b, eqn, ins, outs):
+    b.add_node("Identity", ins[:1], outs)
+
+
+@_handler("ne")
+def _ne(b, eqn, ins, outs):
+    e = b.emit("Equal", ins)
+    b.add_node("Not", [e], outs)
+
+
+@_handler("rem")
+def _rem(b, eqn, ins, outs):
+    b.add_node("Mod", ins, outs, fmod=1)
+
+
+@_handler("rsqrt")
+def _rsqrt(b, eqn, ins, outs):
+    s = b.emit("Sqrt", ins)
+    b.add_node("Reciprocal", [s], outs)
+
+
+@_handler("log1p")
+def _log1p(b, eqn, ins, outs):
+    one = b.const(np.asarray(1, _np(np.zeros((), eqn.invars[0].aval.dtype)).dtype))
+    a = b.emit("Add", [ins[0], one])
+    b.add_node("Log", [a], outs)
+
+
+@_handler("expm1")
+def _expm1(b, eqn, ins, outs):
+    one = b.const(np.asarray(1, _np(np.zeros((), eqn.invars[0].aval.dtype)).dtype))
+    e = b.emit("Exp", ins)
+    b.add_node("Sub", [e, one], outs)
+
+
+@_handler("erfc")
+def _erfc(b, eqn, ins, outs):
+    one = b.const(np.asarray(1, _np(np.zeros((), eqn.invars[0].aval.dtype)).dtype))
+    e = b.emit("Erf", ins)
+    b.add_node("Sub", [one, e], outs)
+
+
+@_handler("square")
+def _square(b, eqn, ins, outs):
+    b.add_node("Mul", [ins[0], ins[0]], outs)
+
+
+@_handler("integer_pow")
+def _integer_pow(b, eqn, ins, outs):
+    y = b.const(np.asarray(eqn.params["y"],
+                           _np(np.zeros((), eqn.invars[0].aval.dtype)).dtype))
+    b.add_node("Pow", [ins[0], y], outs)
+
+
+@_handler("convert_element_type")
+def _cast(b, eqn, ins, outs):
+    b.add_node("Cast", ins, outs, to=_onnx_dtype(eqn.params["new_dtype"]))
+
+
+@_handler("select_n")
+def _select_n(b, eqn, ins, outs):
+    if len(ins) != 3:
+        raise NotImplementedError("select_n with >2 cases")
+    # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
+    b.add_node("Where", [ins[0], ins[2], ins[1]], outs)
+
+
+@_handler("clamp")
+def _clamp(b, eqn, ins, outs):
+    # lax.clamp(min, x, max) -> Clip(x, min, max)
+    b.add_node("Clip", [ins[1], ins[0], ins[2]], outs)
+
+
+@_handler("reshape", "squeeze", "expand_dims")
+def _reshape(b, eqn, ins, outs):
+    in_shape = eqn.invars[0].aval.shape
+    out_shape = list(eqn.outvars[0].aval.shape)
+    # keep a preserved leading (batch) dim dynamic: ONNX Reshape dim 0 means
+    # "copy from input" — exact at the trace shape, and lets models exported
+    # with a symbolic batch run at any batch size (Flatten etc.)
+    if (in_shape and out_shape and in_shape[0] == out_shape[0]
+            and len(out_shape) >= 2):
+        out_shape[0] = 0
+        out_shape[-1] = -1  # infer, so the 0-dim never over-constrains
+    shape = b.const(np.asarray(out_shape, np.int64), "shape")
+    b.add_node("Reshape", [ins[0], shape], outs)
+
+
+@_handler("transpose")
+def _transpose(b, eqn, ins, outs):
+    b.add_node("Transpose", ins, outs, perm=list(eqn.params["permutation"]))
+
+
+@_handler("broadcast_in_dim")
+def _broadcast(b, eqn, ins, outs):
+    out_shape = eqn.params["shape"]
+    bdims = eqn.params["broadcast_dimensions"]
+    in_shape = eqn.invars[0].aval.shape
+    mid = [1] * len(out_shape)
+    for src, dst in enumerate(bdims):
+        mid[dst] = in_shape[src]
+    # batch-agnostic lowering: dims the input itself provides are written as
+    # 0 in Reshape (copy input dim — valid where src index == dst index) and
+    # as 1 in Expand (ONNX Expand broadcasts bidirectionally, so 1 keeps the
+    # input's size).  Size comparisons can't tell a traced batch of 1 from a
+    # broadcast dim, so this keys on broadcast_dimensions membership —
+    # without it, a (B,1)->(B,16) LayerNorm/softmax broadcast traced at B=1
+    # would bake batch 1 into the graph.
+    prefix_identity = {d for src, d in enumerate(bdims) if src == d}
+    reshape_target = [0 if d in prefix_identity else mid[d]
+                      for d in range(len(out_shape))]
+    shape1 = b.const(np.asarray(reshape_target, np.int64), "shape")
+    r = b.emit("Reshape", [ins[0], shape1])
+    expand_target = [1 if mid[d] == out_shape[d] else out_shape[d]
+                     for d in range(len(out_shape))]
+    shape2 = b.const(np.asarray(expand_target, np.int64), "shape")
+    b.add_node("Expand", [r, shape2], outs)
+
+
+@_handler("concatenate")
+def _concat(b, eqn, ins, outs):
+    b.add_node("Concat", ins, outs, axis=int(eqn.params["dimension"]))
+
+
+@_handler("slice")
+def _slice(b, eqn, ins, outs):
+    p = eqn.params
+    starts = b.const(np.asarray(p["start_indices"], np.int64), "starts")
+    ends = b.const(np.asarray(p["limit_indices"], np.int64), "ends")
+    axes = b.const(np.arange(len(p["start_indices"]), dtype=np.int64), "axes")
+    strides = p["strides"] or (1,) * len(p["start_indices"])
+    steps = b.const(np.asarray(strides, np.int64), "steps")
+    b.add_node("Slice", [ins[0], starts, ends, axes, steps], outs)
+
+
+@_handler("rev")
+def _rev(b, eqn, ins, outs):
+    dims = eqn.params["dimensions"]
+    shape = eqn.invars[0].aval.shape
+    starts = b.const(np.asarray([shape[d] - 1 for d in dims], np.int64), "starts")
+    ends = b.const(np.asarray([-(shape[d] + 1) for d in dims], np.int64), "ends")
+    axes = b.const(np.asarray(dims, np.int64), "axes")
+    steps = b.const(np.asarray([-1] * len(dims), np.int64), "steps")
+    b.add_node("Slice", [ins[0], starts, ends, axes, steps], outs)
+
+
+@_handler("pad")
+def _pad(b, eqn, ins, outs):
+    cfg = eqn.params["padding_config"]
+    if any(i != 0 for _, _, i in cfg):
+        raise NotImplementedError("interior (dilating) pad has no ONNX op")
+    if any(l < 0 or h < 0 for l, h, _ in cfg):
+        # negative pad = crop: lower to Slice
+        shape = eqn.invars[0].aval.shape
+        starts = b.const(np.asarray([max(0, -l) for l, _, _ in cfg], np.int64), "starts")
+        ends = b.const(np.asarray(
+            [shape[i] + min(0, h) for i, (_, h, _) in enumerate(cfg)],
+            np.int64), "ends")
+        axes = b.const(np.arange(len(cfg), dtype=np.int64), "axes")
+        s = b.emit("Slice", [ins[0], starts, ends, axes])
+        pads = [max(0, l) for l, _, _ in cfg] + [max(0, h) for _, h, _ in cfg]
+        if any(pads):
+            pv = b.const(np.asarray(pads, np.int64), "pads")
+            b.add_node("Pad", [s, pv, ins[1]], outs)
+        else:
+            b.add_node("Identity", [s], outs)
+        return
+    pads = [l for l, _, _ in cfg] + [h for _, h, _ in cfg]
+    pv = b.const(np.asarray(pads, np.int64), "pads")
+    b.add_node("Pad", [ins[0], pv, ins[1]], outs)
+
+
+@_handler("iota")
+def _iota(b, eqn, ins, outs):
+    p = eqn.params
+    arr = np.reshape(
+        np.broadcast_to(
+            np.expand_dims(
+                np.arange(p["shape"][p["dimension"]],
+                          dtype=_np(np.zeros((), p["dtype"])).dtype),
+                [d for d in range(len(p["shape"])) if d != p["dimension"]]),
+            p["shape"]), p["shape"])
+    b.add_node("Identity", [b.const(arr, "iota")], outs)
+
+
+@_handler("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+          "reduce_and", "reduce_or")
+def _reduce(b, eqn, ins, outs):
+    prim = eqn.primitive.name
+    axes = list(eqn.params["axes"])
+    if not axes:
+        # jax treats axes=() as identity; ONNX empty axes means reduce-all
+        b.add_node("Identity", ins, outs)
+        return
+    if prim == "reduce_sum":
+        ax = b.const(np.asarray(axes, np.int64), "axes")
+        b.add_node("ReduceSum", [ins[0], ax], outs, keepdims=0)
+        return
+    if prim in ("reduce_and", "reduce_or"):
+        # bool reduce: cast to int32, reduce min/max, cast back
+        c = b.emit("Cast", ins, to=proto.INT32)
+        op = "ReduceMin" if prim == "reduce_and" else "ReduceMax"
+        r = b.emit(op, [c], axes=axes, keepdims=0)
+        b.add_node("Cast", [r], outs, to=proto.BOOL)
+        return
+    op = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+          "reduce_prod": "ReduceProd"}[prim]
+    b.add_node(op, ins, outs, axes=axes, keepdims=0)
+
+
+@_handler("argmax", "argmin")
+def _argminmax(b, eqn, ins, outs):
+    p = eqn.params
+    axes = p["axes"]
+    if len(axes) != 1:
+        raise NotImplementedError("argmax over multiple axes")
+    op = "ArgMax" if eqn.primitive.name == "argmax" else "ArgMin"
+    r = b.emit(op, ins, axis=int(axes[0]), keepdims=0)
+    b.add_node("Cast", [r], outs, to=_onnx_dtype(p["index_dtype"]))
+
+
+@_handler("cumsum")
+def _cumsum(b, eqn, ins, outs):
+    ax = b.const(np.asarray(eqn.params["axis"], np.int64), "axis")
+    b.add_node("CumSum", [ins[0], ax], outs,
+               reverse=int(eqn.params.get("reverse", False)))
+
+
+@_handler("dot_general")
+def _dot_general(b, eqn, ins, outs):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    # common Linear case -> MatMul
+    if (not lb and not rb and rhs.ndim == 2 and lc == (lhs.ndim - 1,)
+            and rc == (0,)):
+        b.add_node("MatMul", ins, outs)
+        return
+    # general case -> Einsum with a derived equation
+    letters = iter(string.ascii_lowercase)
+    l_sub = [None] * lhs.ndim
+    r_sub = [None] * rhs.ndim
+    for i, j in zip(lb, rb):
+        c = next(letters)
+        l_sub[i] = r_sub[j] = c
+    for i, j in zip(lc, rc):
+        c = next(letters)
+        l_sub[i] = r_sub[j] = c
+    out_sub = [l_sub[i] for i in lb]
+    for i in range(lhs.ndim):
+        if l_sub[i] is None:
+            l_sub[i] = next(letters)
+            out_sub.append(l_sub[i])
+    for j in range(rhs.ndim):
+        if r_sub[j] is None:
+            r_sub[j] = next(letters)
+            out_sub.append(r_sub[j])
+    eqn_str = f"{''.join(l_sub)},{''.join(r_sub)}->{''.join(out_sub)}"
+    b.add_node("Einsum", ins, outs, equation=eqn_str)
+
+
+@_handler("conv_general_dilated")
+def _conv(b, eqn, ins, outs):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    nd = eqn.invars[0].aval.ndim
+    nchw = tuple(range(nd))
+    oihw = tuple(range(nd))
+    if (tuple(dn.lhs_spec) != nchw or tuple(dn.rhs_spec) != oihw
+            or tuple(dn.out_spec) != nchw):
+        raise NotImplementedError(
+            f"conv with non-NCHW dimension_numbers {dn} (transpose first)")
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError("transposed conv (lhs_dilation) export")
+    pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
+    x = ins[0]
+    if p.get("batch_group_count", 1) != 1:
+        raise NotImplementedError("batch_group_count > 1")
+    b.add_node("Conv", [x, ins[1]], outs,
+               strides=list(p["window_strides"]), pads=pads,
+               dilations=list(p["rhs_dilation"]),
+               group=int(p["feature_group_count"]),
+               kernel_shape=list(eqn.invars[1].aval.shape[2:]))
+
+
+@_handler("reduce_window_max", "reduce_window_sum")
+def _pool(b, eqn, ins, outs):
+    p = eqn.params
+    wd = p["window_dimensions"]
+    ws = p["window_strides"]
+    pad = p["padding"]
+    if any(d != 1 for d in p.get("base_dilation", (1,) * len(wd))) or \
+       any(d != 1 for d in p.get("window_dilation", (1,) * len(wd))):
+        raise NotImplementedError("dilated pooling export")
+    if wd[0] != 1 or wd[1] != 1:
+        raise NotImplementedError(f"pooling window {wd} not NCHW-spatial")
+    kernel = list(wd[2:])
+    strides = list(ws[2:])
+    pads = [lo for lo, _ in pad[2:]] + [hi for _, hi in pad[2:]]
+    if eqn.primitive.name == "reduce_window_max":
+        b.add_node("MaxPool", ins, outs, kernel_shape=kernel,
+                   strides=strides, pads=pads)
+    else:
+        # reduce_window_sum == AveragePool * window_size (the divide that
+        # usually follows in the jaxpr then reproduces the mean)
+        a = b.emit("AveragePool", ins, kernel_shape=kernel, strides=strides,
+                   pads=pads, count_include_pad=1)
+        k = b.const(np.asarray(float(np.prod(kernel)),
+                               _np(np.zeros((), eqn.outvars[0].aval.dtype)).dtype))
+        b.add_node("Mul", [a, k], outs)
+
+
+@_handler("gather")
+def _gather(b, eqn, ins, outs):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand, indices = eqn.invars[0].aval, eqn.invars[1].aval
+    slice_sizes = p["slice_sizes"]
+    # recognize jnp.take(x, idx, axis=k): one collapsed dim == start_index_map
+    if (len(dn.start_index_map) == 1 and
+            tuple(dn.collapsed_slice_dims) == tuple(dn.start_index_map)):
+        axis = dn.start_index_map[0]
+        ok = all(slice_sizes[d] == operand.shape[d] for d in range(operand.ndim)
+                 if d != axis) and slice_sizes[axis] == 1
+        if ok and indices.shape[-1] == 1:
+            # drop the trailing index-vector dim; a 0-d index is valid ONNX
+            # (Gather then also drops the axis, matching jax's collapse)
+            idx_shape = indices.shape[:-1]
+            shape = b.const(np.asarray(idx_shape, np.int64), "shape")
+            idx = b.emit("Reshape", [ins[1], shape])
+            b.add_node("Gather", [ins[0], idx], outs, axis=int(axis))
+            return
+    raise NotImplementedError(
+        f"general lax.gather (dimension_numbers={dn}) has no ONNX lowering; "
+        "only axis-gather (jnp.take / embedding lookup) is supported")
+
+
+@_handler("dynamic_slice")
+def _dynamic_slice(b, eqn, ins, outs):
+    starts_atoms = eqn.invars[1:]
+    if not all(isinstance(a, jcore.Literal) for a in starts_atoms):
+        raise NotImplementedError("dynamic_slice with traced start indices")
+    sizes = eqn.params["slice_sizes"]
+    # lax.dynamic_slice clamps starts so the slice stays in bounds
+    starts = [max(0, min(int(a.val), dim - sz)) for a, dim, sz in
+              zip(starts_atoms, eqn.invars[0].aval.shape, sizes)]
+    s = b.const(np.asarray(starts, np.int64), "starts")
+    e = b.const(np.asarray([st + sz for st, sz in zip(starts, sizes)],
+                           np.int64), "ends")
+    axes = b.const(np.arange(len(starts), dtype=np.int64), "axes")
+    b.add_node("Slice", [ins[0], s, e, axes], outs)
+
+
+@_handler("is_finite")
+def _is_finite(b, eqn, ins, outs):
+    inf = b.emit("IsInf", ins)
+    nan = b.emit("IsNaN", ins)
+    bad = b.emit("Or", [inf, nan])
+    b.add_node("Not", [bad], outs)
+
+
+# ---- sub-jaxpr inlining ---------------------------------------------------
+
+def _inline(b: _Builder, closed, ins: List[str], outvars) -> None:
+    jaxpr = closed.jaxpr
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        b.set_name(cv, b.const(np.asarray(cval), "const"))
+    for v, name in zip(jaxpr.invars, ins):
+        b.set_name(v, name)
+    for sub_eqn in jaxpr.eqns:
+        _convert_eqn(b, sub_eqn)
+    for outer, inner in zip(outvars, jaxpr.outvars):
+        src = b.name_of(inner)
+        out = b.fresh("out")
+        b.add_node("Identity", [src], [out])
+        b.set_name(outer, out)
+
+
+@_handler("jit", "pjit", "closed_call", "core_call", "remat2", "checkpoint",
+          "custom_vjp_call", "custom_jvp_call", "custom_vjp_call_jaxpr")
+def _call(b, eqn, ins, outs):
+    p = eqn.params
+    closed = p.get("jaxpr") or p.get("call_jaxpr") or p.get("fun_jaxpr")
+    if closed is None:
+        raise NotImplementedError(f"call primitive {eqn.primitive.name} "
+                                  f"without an inlinable jaxpr")
+    if hasattr(closed, "jaxpr"):
+        _inline(b, closed, ins, eqn.outvars)
+    else:  # open jaxpr (no consts)
+        _inline(b, jcore.ClosedJaxpr(closed, ()), ins, eqn.outvars)
+
+
+# --------------------------------------------------------------------------
+# entry point
+# --------------------------------------------------------------------------
+
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
+           **configs) -> str:
+    """Export ``layer`` to ``{path}.onnx``.
+
+    ``input_spec`` is a list of :class:`paddle.static.InputSpec` or example
+    Tensors (as in the reference API). Symbolic (None) leading dims are
+    exported as a dynamic 'batch' dimension but traced at size 1.
+    Returns the written file path.
+    """
+    from ..core.tensor import Tensor
+    from ..static import InputSpec
+    from ..autograd import no_grad
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec (the "
+                         "layer's forward is traced, not introspected)")
+
+    avals, graph_inputs = [], []
+    for i, spec in enumerate(input_spec):
+        if isinstance(spec, InputSpec):
+            shape = tuple(1 if d is None or (isinstance(d, int) and d < 0)
+                          else int(d) for d in spec.shape)
+            decl_shape = tuple("batch" if d is None or
+                               (isinstance(d, int) and d < 0) else int(d)
+                               for d in spec.shape)
+            dtype = np.dtype(spec.dtype)
+            name = spec.name or f"input_{i}"
+        else:
+            val = spec._value if isinstance(spec, Tensor) else jnp.asarray(spec)
+            shape = decl_shape = tuple(val.shape)
+            dtype = np.dtype(val.dtype)
+            name = f"input_{i}"
+        avals.append(jax.ShapeDtypeStruct(shape, dtype))
+        graph_inputs.append((name, _onnx_dtype(dtype), decl_shape))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        def fn(*xs):
+            with no_grad():
+                out = layer(*[Tensor(x) for x in xs])
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in outs)
+
+        closed = jax.make_jaxpr(fn)(*avals)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    # pretty initializer names: match captured consts to layer parameters
+    param_names: Dict[int, str] = {}
+    if hasattr(layer, "named_parameters"):
+        for pname, pval in layer.named_parameters():
+            v = getattr(pval, "_value", pval)
+            param_names[id(v)] = pname
+    if hasattr(layer, "named_buffers"):
+        for pname, pval in layer.named_buffers():
+            v = getattr(pval, "_value", pval)
+            param_names[id(v)] = pname
+
+    b = _Builder()
+    jaxpr = closed.jaxpr
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        pretty = param_names.get(id(cval))
+        if pretty is not None:
+            arr = _np(cval)
+            b.initializers.append(proto.tensor(pretty, arr))
+            b.set_name(cv, pretty)
+        else:
+            b.set_name(cv, b.const(np.asarray(cval), "const"))
+    in_protos = []
+    for v, (name, code, decl_shape) in zip(jaxpr.invars, graph_inputs):
+        b.set_name(v, name)
+        in_protos.append(proto.value_info(name, code, decl_shape))
+
+    for eqn in jaxpr.eqns:
+        _convert_eqn(b, eqn)
+
+    output_names, out_protos = [], []
+    for i, ov in enumerate(jaxpr.outvars):
+        src = b.name_of(ov)
+        name = f"output_{i}"
+        b.add_node("Identity", [src], [name])
+        output_names.append(name)
+        out_protos.append(proto.value_info(
+            name, _onnx_dtype(ov.aval.dtype), tuple(ov.aval.shape)))
+
+    g = proto.graph(b.nodes, "paddle_tpu_graph", in_protos, out_protos,
+                    b.initializers)
+    blob = proto.model(g, opset_version=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

@@ -1,0 +1,336 @@
+"""Minimal ONNX protobuf codec (no ``onnx``/``protobuf`` dependency).
+
+The reference's ``paddle.onnx.export`` delegates serialization to the
+paddle2onnx C++ library (python/paddle/onnx/export.py); this environment has
+neither paddle2onnx nor the ``onnx`` python package, so the wire format is
+produced directly: ONNX models are protobuf messages (onnx/onnx.proto), and
+protobuf's wire encoding is simple enough to emit and parse by hand — varint
+tags, length-delimited submessages, little-endian raw tensor data.
+
+Field numbers below follow onnx/onnx.proto (IR version 8 / opset 13).
+Only the fields the exporter emits and the reference evaluator reads are
+implemented.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# TensorProto.DataType enum (onnx.proto)
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+BFLOAT16 = 16
+
+_NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.int16): INT16,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.uint32): UINT32,
+    np.dtype(np.uint64): UINT64,
+    np.dtype(np.bool_): BOOL,
+}
+_ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+
+def np_to_onnx_dtype(dt) -> int:
+    dt = np.dtype(dt)
+    if dt not in _NP_TO_ONNX:
+        raise ValueError(f"dtype {dt} has no ONNX TensorProto mapping")
+    return _NP_TO_ONNX[dt]
+
+
+def onnx_to_np_dtype(code: int):
+    if code not in _ONNX_TO_NP:
+        raise ValueError(f"ONNX dtype code {code} unsupported")
+    return _ONNX_TO_NP[code]
+
+
+# --------------------------------------------------------------------------
+# wire-level encoding
+# --------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    if n < 0:  # proto int64: two's complement, 10 bytes
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def bytes_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def str_field(field: int, s: str) -> bytes:
+    return bytes_field(field, s.encode("utf-8"))
+
+
+def packed_ints(field: int, values: Sequence[int]) -> bytes:
+    body = b"".join(_varint(int(v)) for v in values)
+    return bytes_field(field, body)
+
+
+def float_field(field: int, value: float) -> bytes:
+    return _tag(field, 5) + np.float32(value).tobytes()
+
+
+def packed_floats(field: int, values: Sequence[float]) -> bytes:
+    return bytes_field(field, np.asarray(values, np.float32).tobytes())
+
+
+# --------------------------------------------------------------------------
+# message builders (field numbers from onnx.proto)
+# --------------------------------------------------------------------------
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    out = packed_ints(1, arr.shape) if arr.ndim else b""
+    out += int_field(2, np_to_onnx_dtype(arr.dtype))
+    out += str_field(8, name)
+    le = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
+    out += bytes_field(9, le.tobytes())
+    return out
+
+
+def attribute(name: str, value: Any) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20."""
+    out = str_field(1, name)
+    if isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        out += int_field(3, int(value)) + int_field(20, 2)  # INT
+    elif isinstance(value, float):
+        out += float_field(2, value) + int_field(20, 1)  # FLOAT
+    elif isinstance(value, str):
+        out += bytes_field(4, value.encode()) + int_field(20, 3)  # STRING
+    elif isinstance(value, bytes):
+        out += bytes_field(4, value) + int_field(20, 3)
+    elif isinstance(value, np.ndarray):
+        out += bytes_field(5, tensor(name, value)) + int_field(20, 4)  # TENSOR
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, np.integer)) for v in value):
+            out += packed_ints(8, value) + int_field(20, 7)  # INTS
+        else:
+            out += packed_floats(7, value) + int_field(20, 6)  # FLOATS
+    else:
+        raise TypeError(f"attribute {name}: unsupported type {type(value)}")
+    return out
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", **attrs) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(str_field(1, i) for i in inputs)
+    out += b"".join(str_field(2, o) for o in outputs)
+    if name:
+        out += str_field(3, name)
+    out += str_field(4, op_type)
+    for k, v in attrs.items():
+        out += bytes_field(5, attribute(k, v))
+    return out
+
+
+def value_info(name: str, dtype_code: int, shape: Sequence[Any]) -> bytes:
+    """ValueInfoProto{name=1, type=2} / TypeProto{tensor_type=1} /
+    TypeProto.Tensor{elem_type=1, shape=2} / TensorShapeProto{dim=1} /
+    Dimension{dim_value=1, dim_param=2}."""
+    dims = b""
+    for d in shape:
+        if isinstance(d, (int, np.integer)) and int(d) >= 0:
+            dims += bytes_field(1, int_field(1, int(d)))
+        else:  # symbolic / unknown dim
+            dims += bytes_field(1, str_field(2, str(d)))
+    tensor_type = int_field(1, dtype_code) + bytes_field(2, dims)
+    type_proto = bytes_field(1, tensor_type)
+    return str_field(1, name) + bytes_field(2, type_proto)
+
+
+def graph(nodes: Sequence[bytes], name: str, inputs: Sequence[bytes],
+          outputs: Sequence[bytes], initializers: Sequence[bytes]) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(bytes_field(1, n) for n in nodes)
+    out += str_field(2, name)
+    out += b"".join(bytes_field(5, t) for t in initializers)
+    out += b"".join(bytes_field(11, i) for i in inputs)
+    out += b"".join(bytes_field(12, o) for o in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset_version: int = 13,
+          producer: str = "paddle_tpu", ir_version: int = 8) -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8;
+    OperatorSetIdProto: domain=1, version=2."""
+    opset = str_field(1, "") + int_field(2, opset_version)
+    return (int_field(1, ir_version) + str_field(2, producer)
+            + bytes_field(7, graph_bytes) + bytes_field(8, opset))
+
+
+# --------------------------------------------------------------------------
+# wire-level decoding (generic): message -> {field: [value, ...]} where
+# value is int (wire 0), bytes (wire 2), or 4/8-byte bytes (wire 5/1)
+# --------------------------------------------------------------------------
+
+def parse(data: bytes) -> Dict[int, List[Any]]:
+    fields: Dict[int, List[Any]] = {}
+    i, n = 0, len(data)
+    while i < n:
+        key, i = _read_varint(data, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(data, i)
+        elif wire == 2:
+            ln, i = _read_varint(data, i)
+            v = data[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = data[i:i + 4]
+            i += 4
+        elif wire == 1:
+            v = data[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(v)
+    return fields
+
+
+def _read_varint(data: bytes, i: int) -> Tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        b = data[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if result >= 1 << 63:  # int64 negative
+                result -= 1 << 64
+            return result, i
+        shift += 7
+
+
+def parse_packed_ints(raw: Any) -> List[int]:
+    """A packed repeated int field arrives as bytes; a single unpacked
+    entry arrives as an int."""
+    if isinstance(raw, (int, np.integer)):
+        return [int(raw)]
+    out, i = [], 0
+    while i < len(raw):
+        v, i = _read_varint(raw, i)
+        out.append(v)
+    return out
+
+
+def parse_tensor(data: bytes) -> Tuple[str, np.ndarray]:
+    f = parse(data)
+    dims: List[int] = []
+    for raw in f.get(1, []):
+        dims.extend(parse_packed_ints(raw))
+    code = f[2][0]
+    name = f.get(8, [b""])[0].decode()
+    np_dt = onnx_to_np_dtype(code)
+    if 9 in f:  # raw_data
+        arr = np.frombuffer(f[9][0], dtype=np_dt).reshape(dims)
+    elif 4 in f:  # float_data (packed floats)
+        arr = np.frombuffer(f[4][0], np.float32).astype(np_dt).reshape(dims)
+    elif 7 in f:  # int64_data
+        vals: List[int] = []
+        for raw in f[7]:
+            vals.extend(parse_packed_ints(raw))
+        arr = np.asarray(vals, np_dt).reshape(dims)
+    else:
+        arr = np.zeros(dims, np_dt)
+    return name, arr
+
+
+def parse_attribute(data: bytes) -> Tuple[str, Any]:
+    f = parse(data)
+    name = f[1][0].decode()
+    atype = f.get(20, [0])[0]
+    if atype == 1:  # FLOAT
+        return name, float(np.frombuffer(f[2][0], np.float32)[0])
+    if atype == 2:  # INT
+        return name, f[3][0]
+    if atype == 3:  # STRING
+        return name, f[4][0].decode()
+    if atype == 4:  # TENSOR
+        return name, parse_tensor(f[5][0])[1]
+    if atype == 6:  # FLOATS
+        return name, np.frombuffer(f[7][0], np.float32).tolist()
+    if atype == 7:  # INTS
+        vals: List[int] = []
+        for raw in f[8]:
+            vals.extend(parse_packed_ints(raw))
+        return name, vals
+    raise ValueError(f"attribute {name}: unsupported AttributeProto.type {atype}")
+
+
+def parse_node(data: bytes) -> Dict[str, Any]:
+    f = parse(data)
+    return {
+        "input": [b.decode() for b in f.get(1, [])],
+        "output": [b.decode() for b in f.get(2, [])],
+        "name": f.get(3, [b""])[0].decode(),
+        "op_type": f[4][0].decode(),
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def parse_value_info(data: bytes) -> Dict[str, Any]:
+    f = parse(data)
+    name = f[1][0].decode()
+    ttype = parse(parse(f[2][0])[1][0])  # TypeProto.tensor_type
+    elem = ttype.get(1, [0])[0]
+    shape: List[Any] = []
+    if 2 in ttype:
+        for dim_raw in parse(ttype[2][0]).get(1, []):
+            d = parse(dim_raw)
+            if 1 in d:
+                shape.append(d[1][0])
+            else:
+                shape.append(d.get(2, [b"?"])[0].decode())
+    return {"name": name, "elem_type": elem, "shape": shape}
+
+
+def parse_graph(data: bytes) -> Dict[str, Any]:
+    f = parse(data)
+    return {
+        "name": f.get(2, [b""])[0].decode(),
+        "nodes": [parse_node(n) for n in f.get(1, [])],
+        "initializers": dict(parse_tensor(t) for t in f.get(5, [])),
+        "inputs": [parse_value_info(v) for v in f.get(11, [])],
+        "outputs": [parse_value_info(v) for v in f.get(12, [])],
+    }
+
+
+def parse_model(data: bytes) -> Dict[str, Any]:
+    f = parse(data)
+    opsets = {}
+    for raw in f.get(8, []):
+        o = parse(raw)
+        opsets[o.get(1, [b""])[0].decode()] = o.get(2, [0])[0]
+    return {
+        "ir_version": f.get(1, [0])[0],
+        "producer_name": f.get(2, [b""])[0].decode(),
+        "graph": parse_graph(f[7][0]),
+        "opset_import": opsets,
+    }

@@ -1,0 +1,338 @@
+"""Pure-numpy ONNX reference evaluator.
+
+Executes a model produced by :func:`paddle_tpu.onnx.export` (opset 13)
+directly from the serialized bytes — the analog of
+``onnx.reference.ReferenceEvaluator``. Exists so exported models can be
+validated end-to-end in this environment (no ``onnxruntime``), and doubles
+as an executable spec of the exporter's op choices: every op the exporter
+emits has a kernel here.
+
+Kernels follow the ONNX operator definitions, not jax semantics — the
+round-trip test (layer ⟶ export ⟶ parse ⟶ run) only passes if the
+exporter's lowering and the ONNX op contract agree.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from . import proto
+
+__all__ = ["ReferenceEvaluator"]
+
+_erf = np.vectorize(math.erf, otypes=[np.float64])
+
+
+def _pads_split(pads: Sequence[int], nd: int):
+    return list(pads[:nd]), list(pads[nd:])
+
+
+def _conv2d(x, w, bias, strides, pads, dilations, group):
+    n, cin, ih, iw = x.shape
+    cout, cin_g, kh, kw = w.shape
+    (ph0, pw0), (ph1, pw1) = _pads_split(pads, 2)
+    x = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    dh, dw = dilations
+    sh, sw = strides
+    ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    oh = (x.shape[2] - ekh) // sh + 1
+    ow = (x.shape[3] - ekw) // sw + 1
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    cout_g = cout // group
+    for g in range(group):
+        xg = x[:, g * cin_g:(g + 1) * cin_g]
+        wg = w[g * cout_g:(g + 1) * cout_g]
+        # im2col over the dilated window
+        cols = np.empty((n, cin_g, kh, kw, oh, ow), np.float64)
+        for i in range(kh):
+            for j in range(kw):
+                patch = xg[:, :, i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                           j * dw:j * dw + (ow - 1) * sw + 1:sw]
+                cols[:, :, i, j] = patch
+        out[:, g * cout_g:(g + 1) * cout_g] = np.einsum(
+            "ncijhw,ocij->nohw", cols, wg, optimize=True)
+    if bias is not None:
+        out += bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool2d(x, kernel, strides, pads, mode, count_include_pad=False):
+    n, c, ih, iw = x.shape
+    kh, kw = kernel
+    sh, sw = strides
+    (ph0, pw0), (ph1, pw1) = _pads_split(pads, 2)
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.pad(x.astype(np.float64), ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=fill)
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    out = np.empty((n, c, oh, ow), np.float64)
+    if mode == "avg" and not count_include_pad:
+        ones = np.pad(np.ones_like(x, np.float64),
+                      ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if mode == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            else:
+                s = win.sum(axis=(2, 3))
+                if count_include_pad:
+                    out[:, :, i, j] = s / (kh * kw)
+                else:
+                    cnt = ones[:, :, i * sh:i * sh + kh,
+                               j * sw:j * sw + kw].sum(axis=(2, 3))
+                    out[:, :, i, j] = s / cnt
+    return out
+
+
+def _as_2d_spatial(x, w):
+    """Lift 1-D conv/pool inputs (N,C,L) to 2-D (N,C,L,1) so the 2-D
+    kernels below serve both; returns (x, w, unsqueezed?)."""
+    if x.ndim == 3:
+        return (x[..., None], None if w is None else w[..., None], True)
+    return x, w, False
+
+
+def _sp2(vals, fill):
+    """Per-spatial-dim attr list padded to 2 entries (1-D -> 2-D lift)."""
+    vals = list(vals) if vals is not None else [fill, fill]
+    return vals + [fill] * (2 - len(vals))
+
+
+def _sp2_pads(pads, x):
+    """ONNX pads [begin..., end...] padded to 2 spatial dims."""
+    nsp = x.ndim - 2
+    pads = list(pads) if pads is not None else [0] * (2 * nsp)
+    lo, hi = pads[:len(pads) // 2], pads[len(pads) // 2:]
+    lo += [0] * (2 - len(lo))
+    hi += [0] * (2 - len(hi))
+    return lo + hi
+
+
+class ReferenceEvaluator:
+    """Load an .onnx file (or bytes) and run it with numpy."""
+
+    def __init__(self, model):
+        if isinstance(model, (bytes, bytearray)):
+            blob = bytes(model)
+        else:
+            with open(model, "rb") as f:
+                blob = f.read()
+        self.model = proto.parse_model(blob)
+        self.graph = self.model["graph"]
+        self.input_names = [vi["name"] for vi in self.graph["inputs"]]
+        self.output_names = [vi["name"] for vi in self.graph["outputs"]]
+
+    def run(self, output_names, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        env: Dict[str, np.ndarray] = dict(self.graph["initializers"])
+        env.update({k: np.asarray(v) for k, v in feeds.items()})
+        for nd in self.graph["nodes"]:
+            self._exec(nd, env)
+        names = output_names or self.output_names
+        return [env[n] for n in names]
+
+    # ---- op kernels ------------------------------------------------------
+
+    def _exec(self, nd: Dict[str, Any], env: Dict[str, np.ndarray]):
+        op = nd["op_type"]
+        A = nd["attrs"]
+        x = [env[i] if i else None for i in nd["input"]]
+        o = nd["output"]
+
+        def put(*vals):
+            for name, v in zip(o, vals):
+                env[name] = v
+
+        if op == "Identity":
+            put(x[0])
+        elif op == "Add":
+            put(x[0] + x[1])
+        elif op == "Sub":
+            put(x[0] - x[1])
+        elif op == "Mul":
+            put(x[0] * x[1])
+        elif op == "Div":
+            if np.issubdtype(x[0].dtype, np.integer):
+                # ONNX integer Div truncates toward zero (C semantics),
+                # unlike numpy's floor division
+                q = np.trunc(x[0].astype(np.float64) / x[1])
+                put(q.astype(x[0].dtype))
+            else:
+                put(x[0] / x[1])
+        elif op == "Pow":
+            put(np.power(x[0], x[1]).astype(x[0].dtype))
+        elif op == "Max":
+            put(np.maximum(x[0], x[1]))
+        elif op == "Min":
+            put(np.minimum(x[0], x[1]))
+        elif op == "Mod":
+            put(np.fmod(x[0], x[1]) if A.get("fmod") else np.mod(x[0], x[1]))
+        elif op == "Neg":
+            put(-x[0])
+        elif op == "Abs":
+            put(np.abs(x[0]))
+        elif op == "Sign":
+            put(np.sign(x[0]))
+        elif op == "Exp":
+            put(np.exp(x[0]))
+        elif op == "Log":
+            put(np.log(x[0]))
+        elif op == "Sqrt":
+            put(np.sqrt(x[0]))
+        elif op == "Reciprocal":
+            put(1.0 / x[0])
+        elif op == "Tanh":
+            put(np.tanh(x[0]))
+        elif op == "Sigmoid":
+            put(1.0 / (1.0 + np.exp(-x[0].astype(np.float64))))
+        elif op == "Erf":
+            put(_erf(x[0]).astype(np.float32))
+        elif op in ("Sin", "Cos", "Tan", "Sinh", "Cosh"):
+            put(getattr(np, op.lower())(x[0]))
+        elif op in ("Asin", "Acos", "Atan"):
+            put(getattr(np, "arc" + op.lower()[1:])(x[0]))
+        elif op in ("Asinh", "Acosh", "Atanh"):
+            put(getattr(np, "arc" + op.lower()[1:])(x[0]))
+        elif op == "Floor":
+            put(np.floor(x[0]))
+        elif op == "Ceil":
+            put(np.ceil(x[0]))
+        elif op == "Round":
+            put(np.round(x[0]))
+        elif op == "Equal":
+            put(x[0] == x[1])
+        elif op == "Less":
+            put(x[0] < x[1])
+        elif op == "LessOrEqual":
+            put(x[0] <= x[1])
+        elif op == "Greater":
+            put(x[0] > x[1])
+        elif op == "GreaterOrEqual":
+            put(x[0] >= x[1])
+        elif op == "And":
+            put(np.logical_and(x[0], x[1]))
+        elif op == "Or":
+            put(np.logical_or(x[0], x[1]))
+        elif op == "Xor":
+            put(np.logical_xor(x[0], x[1]))
+        elif op == "Not":
+            put(np.logical_not(x[0]))
+        elif op == "IsInf":
+            put(np.isinf(x[0]))
+        elif op == "IsNaN":
+            put(np.isnan(x[0]))
+        elif op == "Where":
+            put(np.where(x[0], x[1], x[2]))
+        elif op == "Clip":
+            lo = x[1] if len(x) > 1 and x[1] is not None else -np.inf
+            hi = x[2] if len(x) > 2 and x[2] is not None else np.inf
+            put(np.clip(x[0], lo, hi))
+        elif op == "Cast":
+            put(x[0].astype(proto.onnx_to_np_dtype(A["to"])))
+        elif op == "Reshape":
+            target = [int(d) for d in x[1]]
+            # ONNX semantics: 0 copies the input dim, -1 is inferred
+            target = [x[0].shape[i] if d == 0 else d
+                      for i, d in enumerate(target)]
+            put(np.reshape(x[0], target))
+        elif op == "Transpose":
+            put(np.transpose(x[0], A.get("perm")))
+        elif op == "Expand":
+            # ONNX Expand broadcasts bidirectionally (unlike broadcast_to)
+            target = np.broadcast_shapes(x[0].shape,
+                                         tuple(int(d) for d in x[1]))
+            put(np.broadcast_to(x[0], target).copy())
+        elif op == "Concat":
+            put(np.concatenate(x, axis=A["axis"]))
+        elif op == "Slice":
+            starts, ends = x[1].astype(np.int64), x[2].astype(np.int64)
+            axes = (x[3].astype(np.int64) if len(x) > 3 and x[3] is not None
+                    else np.arange(len(starts)))
+            steps = (x[4].astype(np.int64) if len(x) > 4 and x[4] is not None
+                     else np.ones(len(starts), np.int64))
+            sl = [slice(None)] * x[0].ndim
+            for s, e, a, st in zip(starts, ends, axes, steps):
+                a = int(a)
+                # ONNX clamps: INT64_MIN/huge negatives mean "from the end"
+                e = None if (st < 0 and e < -x[0].shape[a]) else int(e)
+                sl[a] = slice(int(s), e, int(st))
+            put(x[0][tuple(sl)].copy())
+        elif op == "Pad":
+            pads = x[1].astype(np.int64)
+            cval = float(x[2]) if len(x) > 2 and x[2] is not None else 0.0
+            nd2 = len(pads) // 2
+            put(np.pad(x[0], list(zip(pads[:nd2], pads[nd2:])),
+                       constant_values=cval))
+        elif op == "Gather":
+            put(np.take(x[0], x[1].astype(np.int64), axis=A.get("axis", 0)))
+        elif op == "ReduceSum":
+            axes = tuple(int(a) for a in x[1]) if len(x) > 1 and x[1] is not None else None
+            put(np.sum(x[0], axis=axes, keepdims=bool(A.get("keepdims", 1))))
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+            fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+                  "ReduceProd": np.prod, "ReduceMean": np.mean}[op]
+            axes = tuple(A["axes"]) if "axes" in A else None
+            put(fn(x[0], axis=axes, keepdims=bool(A.get("keepdims", 1))))
+        elif op in ("ArgMax", "ArgMin"):
+            fn = np.argmax if op == "ArgMax" else np.argmin
+            r = fn(x[0], axis=A.get("axis", 0))
+            if A.get("keepdims", 1):
+                r = np.expand_dims(r, A.get("axis", 0))
+            put(r.astype(np.int64))
+        elif op == "CumSum":
+            r = x[0]
+            ax = int(x[1])
+            if A.get("reverse"):
+                r = np.flip(np.cumsum(np.flip(r, ax), axis=ax), ax)
+            else:
+                r = np.cumsum(r, axis=ax)
+            put(r.astype(x[0].dtype))
+        elif op == "MatMul":
+            put(np.matmul(x[0], x[1]))
+        elif op == "Einsum":
+            put(np.einsum(A["equation"], *x, optimize=True))
+        elif op == "Gemm":
+            a = x[0].T if A.get("transA") else x[0]
+            b_ = x[1].T if A.get("transB") else x[1]
+            r = A.get("alpha", 1.0) * (a @ b_)
+            if len(x) > 2 and x[2] is not None:
+                r = r + A.get("beta", 1.0) * x[2]
+            put(r)
+        elif op == "Conv":
+            bias = x[2] if len(x) > 2 else None
+            xx, ww, un = _as_2d_spatial(x[0], x[1])
+            nsp = xx.ndim - 2
+            if nsp != 2:
+                raise NotImplementedError(f"Conv with {nsp} spatial dims")
+            r = _conv2d(xx.astype(np.float64), ww.astype(np.float64),
+                        None if bias is None else bias.astype(np.float64),
+                        _sp2(A.get("strides"), 1),
+                        _sp2_pads(A.get("pads"), xx),
+                        _sp2(A.get("dilations"), 1),
+                        A.get("group", 1)).astype(np.float32)
+            put(r[..., 0] if un else r)
+        elif op == "MaxPool":
+            xx, _, un = _as_2d_spatial(x[0], None)
+            r = _pool2d(xx, _sp2(A["kernel_shape"], 1),
+                        _sp2(A.get("strides"), 1), _sp2_pads(A.get("pads"), xx),
+                        "max").astype(x[0].dtype)
+            put(r[..., 0] if un else r)
+        elif op == "AveragePool":
+            xx, _, un = _as_2d_spatial(x[0], None)
+            r = _pool2d(xx, _sp2(A["kernel_shape"], 1),
+                        _sp2(A.get("strides"), 1), _sp2_pads(A.get("pads"), xx),
+                        "avg",
+                        bool(A.get("count_include_pad", 0))).astype(np.float32)
+            put(r[..., 0] if un else r)
+        elif op == "Relu":
+            put(np.maximum(x[0], 0))
+        elif op == "Softmax":
+            ax = A.get("axis", -1)
+            e = np.exp(x[0] - x[0].max(axis=ax, keepdims=True))
+            put(e / e.sum(axis=ax, keepdims=True))
+        else:
+            raise NotImplementedError(f"ReferenceEvaluator: op {op}")

@@ -1,0 +1,200 @@
+"""paddle.onnx round-trip tests.
+
+Reference analog: paddle2onnx conversion tests (the reference's
+python/paddle/onnx/export.py delegates to paddle2onnx; its tests convert a
+layer and rerun it under onnxruntime). Here the exported protobuf is
+re-parsed and executed by the in-repo numpy ReferenceEvaluator — exporter
+and evaluator are written against the ONNX op spec independently, so
+agreement with the eager layer is a real round-trip check.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.onnx import ReferenceEvaluator, export
+from paddle_tpu.static import InputSpec
+
+
+def _roundtrip(layer, xs, tmp_path, atol=1e-4, specs=None):
+    layer.eval()
+    outs = layer(*[paddle.to_tensor(x) for x in xs])
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    expect = [np.asarray(o._value, np.float32) for o in outs]
+    path = export(layer, str(tmp_path / "m"),
+                  input_spec=specs or [paddle.to_tensor(x) for x in xs])
+    ev = ReferenceEvaluator(path)
+    got = ev.run(None, {n: x for n, x in zip(ev.input_names, xs)})
+    assert len(got) == len(expect)
+    for g, e in zip(got, expect):
+        np.testing.assert_allclose(np.asarray(g, np.float32), e,
+                                   rtol=1e-4, atol=atol)
+    return path
+
+
+def test_mlp_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.LayerNorm(16),
+                        nn.Linear(16, 4), nn.Softmax())
+    x = np.random.randn(3, 8).astype(np.float32)
+    _roundtrip(net, [x], tmp_path)
+
+
+def test_cnn_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, stride=2, padding=1), nn.ReLU(),
+                        nn.MaxPool2D(2, stride=2), nn.Flatten(),
+                        nn.Linear(8 * 4 * 4, 10))
+    x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+    _roundtrip(net, [x], tmp_path)
+
+
+def test_avgpool_gelu_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Conv2D(2, 4, 3, padding=1), nn.GELU(),
+                        nn.AvgPool2D(2, stride=2))
+    x = np.random.randn(1, 2, 8, 8).astype(np.float32)
+    _roundtrip(net, [x], tmp_path)
+
+
+def test_embedding_roundtrip(tmp_path):
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(20, 6)
+            self.fc = nn.Linear(6, 3)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids))
+
+    ids = np.random.randint(0, 20, (4, 5)).astype(np.int32)
+    _roundtrip(Net(), [ids], tmp_path)
+
+
+def test_input_spec_dynamic_batch(tmp_path):
+    net = nn.Sequential(nn.Linear(8, 4), nn.Sigmoid())
+    net.eval()
+    path = export(net, str(tmp_path / "dyn"),
+                  input_spec=[InputSpec([None, 8], "float32", name="x")])
+    ev = ReferenceEvaluator(path)
+    assert ev.input_names == ["x"]
+    # declared dynamic: first dim symbolic in the value_info
+    assert ev.graph["inputs"][0]["shape"][0] == "batch"
+    x = np.random.randn(5, 8).astype(np.float32)
+    got = ev.run(None, {"x": x})[0]
+    expect = np.asarray(net(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_batch_layernorm_softmax(tmp_path):
+    # batch-carrying broadcasts (LayerNorm's mean/var, Softmax's lse) must
+    # not bake the traced batch size into Reshape/Expand constants
+    net = nn.Sequential(nn.Linear(8, 16), nn.LayerNorm(16), nn.Softmax())
+    net.eval()
+    path = export(net, str(tmp_path / "ln"),
+                  input_spec=[InputSpec([None, 8], "float32", name="x")])
+    ev = ReferenceEvaluator(path)
+    for bs in (1, 5):
+        x = np.random.randn(bs, 8).astype(np.float32)
+        got = ev.run(None, {"x": x})[0]
+        want = np.asarray(net(paddle.to_tensor(x))._value)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv1d_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Conv1D(2, 4, 3, padding=1), nn.ReLU())
+    x = np.random.randn(2, 2, 10).astype(np.float32)
+    _roundtrip(net, [x], tmp_path)
+
+
+def test_dynamic_batch_through_flatten(tmp_path):
+    # Reshape targets must not bake in the traced batch size: a model with
+    # Flatten exported at symbolic batch must run at any batch
+    net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.Flatten(),
+                        nn.Linear(4 * 8 * 8, 5))
+    net.eval()
+    path = export(net, str(tmp_path / "flat"),
+                  input_spec=[InputSpec([None, 1, 8, 8], "float32", name="x")])
+    ev = ReferenceEvaluator(path)
+    for bs in (1, 7):
+        x = np.random.randn(bs, 1, 8, 8).astype(np.float32)
+        got = ev.run(None, {"x": x})[0]
+        want = np.asarray(net(paddle.to_tensor(x))._value)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_initializers_carry_param_names(tmp_path):
+    net = nn.Linear(4, 2)
+    net.eval()
+    path = export(net, str(tmp_path / "named"),
+                  input_spec=[InputSpec([1, 4], "float32")])
+    ev = ReferenceEvaluator(path)
+    names = set(ev.graph["initializers"])
+    assert any("weight" in n for n in names), names
+    assert any("bias" in n for n in names), names
+
+
+def test_multi_output(tmp_path):
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return h, paddle.nn.functional.relu(h)
+
+    x = np.random.randn(2, 4).astype(np.float32)
+    _roundtrip(Net(), [x], tmp_path)
+
+
+def test_resnet18_roundtrip(tmp_path):
+    from paddle_tpu.vision.models import resnet18
+
+    net = resnet18(num_classes=10)
+    x = np.random.randn(1, 3, 32, 32).astype(np.float32)
+    path = _roundtrip(net, [x], tmp_path, atol=5e-4)
+    ev = ReferenceEvaluator(path)
+    ops = {n["op_type"] for n in ev.graph["nodes"]}
+    assert {"Conv", "MaxPool", "MatMul"} <= ops
+
+
+def test_unsupported_primitive_raises(tmp_path):
+    class Net(nn.Layer):
+        def forward(self, x):
+            import jax
+            from paddle_tpu.core.tensor import Tensor
+            # top_k has no lowering in the exporter
+            v, _ = jax.lax.top_k(x._value, 2)
+            return Tensor(v)
+
+    with pytest.raises(NotImplementedError, match="top_k"):
+        export(Net(), str(tmp_path / "bad"),
+               input_spec=[InputSpec([2, 8], "float32")])
+
+
+def test_integer_floor_divide(tmp_path):
+    # jnp floor-divide lowers to trunc-div + sign correction; the evaluator's
+    # Div must truncate toward zero (ONNX semantics) for the correction to
+    # reproduce numpy flooring on negative operands
+    class Net(nn.Layer):
+        def forward(self, x):
+            import jax.numpy as jnp
+            from paddle_tpu.core.tensor import Tensor
+            return Tensor(x._value // 2)
+
+    x = np.asarray([[-7, 7, -3, 4]], np.int32)
+    net = Net()
+    path = export(net, str(tmp_path / "idiv"), input_spec=[paddle.to_tensor(x)])
+    ev = ReferenceEvaluator(path)
+    got = ev.run(None, {ev.input_names[0]: x})[0]
+    np.testing.assert_array_equal(got, x // 2)
+
+
+def test_opset_and_producer(tmp_path):
+    net = nn.Linear(2, 2)
+    net.eval()
+    path = export(net, str(tmp_path / "meta"),
+                  input_spec=[InputSpec([1, 2], "float32")])
+    ev = ReferenceEvaluator(path)
+    assert ev.model["producer_name"] == "paddle_tpu"
+    assert ev.model["opset_import"][""] == 13
