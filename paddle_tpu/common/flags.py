@@ -99,6 +99,12 @@ define_flag("FLAGS_comm_timeout_s", 1800, "Collective timeout (watchdog) in seco
 define_flag("FLAGS_allocator_strategy", "auto_growth", "Allocator strategy name (compat).")
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "Compat only; XLA manages HBM.")
 define_flag("FLAGS_log_memory_stats", False, "Log live/peak memory stats per step.")
+define_flag("FLAGS_eager_executable_cache", True,
+            "Cache a jitted executable per eager op call signature (op, "
+            "arg structure, static kwargs); the backward executable "
+            "rematerializes the op's forward inside the fused vjp. Turns "
+            "per-op python retracing into an XLA cache hit (the analog of "
+            "the reference's phi kernel cache).")
 define_flag("FLAGS_eager_double_grad", True,
             "Record the create_graph (double-grad) re-derivation on eager "
             "ops. Disable to drop the saved-input captures and restore the "

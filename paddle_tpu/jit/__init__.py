@@ -112,7 +112,9 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
         traced = TracedLayer(fn)
         if isinstance(fn, Layer):
             return traced
-        functools.wraps(fn)(traced.__call__)
+        # carry the function's identity onto the wrapper instance (wraps on
+        # the bound __call__ would try to setattr on a method and raise)
+        functools.update_wrapper(traced, fn, updated=())
         return traced
 
     if function is not None:

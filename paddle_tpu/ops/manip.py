@@ -8,6 +8,9 @@ which are marked host-only.
 
 from __future__ import annotations
 
+import functools
+import itertools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -108,7 +111,7 @@ def stack(xs, axis=0):
 
 
 @register("split")
-def split(x, num_or_sections, axis=0):
+def _split_op(x, num_or_sections, axis=0):
     if isinstance(num_or_sections, int):
         return tuple(jnp.split(x, num_or_sections, axis=axis))
     # sections list: allow one -1
@@ -117,8 +120,23 @@ def split(x, num_or_sections, axis=0):
     if -1 in sections:
         known = sum(s for s in sections if s != -1)
         sections[sections.index(-1)] = total - known
-    idx = jnp.cumsum(jnp.array(sections))[:-1]
-    return tuple(jnp.split(x, [int(i) for i in idx], axis=axis))
+    # static offsets in python (not jnp): the op fn must stay traceable
+    # under jit (eager executable cache / to_static)
+    idx = list(itertools.accumulate(sections))[:-1]
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@functools.wraps(_split_op.raw_fn)
+def split(x, num_or_sections, axis=0):
+    """Public entry: section sizes given as Tensors/arrays (the reference
+    accepts them) are shapes, not data — normalize to python ints BEFORE
+    dispatch so they key the cached executable as statics instead of
+    becoming traced values."""
+    if not isinstance(num_or_sections, int):
+        num_or_sections = [
+            int(s._value) if hasattr(s, "_value") else int(s)
+            for s in num_or_sections]
+    return _split_op(x, num_or_sections, axis=axis)
 
 
 @register("chunk")

@@ -38,6 +38,9 @@ class OpDef:
     fn: Callable
     amp: Optional[str] = None  # 'white' (bf16), 'black' (fp32), None
     nondiff: bool = False  # op has no differentiable outputs (argmax, equal, ...)
+    # op fn is jit-traceable (static shapes, no host-side loops over values);
+    # False exempts it from the eager executable cache (nms, unique_*, ...)
+    cacheable: bool = True
     # sharding propagation rule; populated by
     # distributed/auto_parallel/spmd_rules.register_spmd_rule and consumed
     # by infer_forward/shard_op (the reference's per-op SPMD override path)
@@ -78,13 +81,13 @@ def all_ops() -> Dict[str, OpDef]:
 
 
 def register(name: str, amp: Optional[str] = None, nondiff: bool = False,
-             spmd_rule: Optional[Callable] = None):
+             spmd_rule: Optional[Callable] = None, cacheable: bool = True):
     """Register a pure-JAX function as a framework op and return its public
     eager entry point (Tensor-in/Tensor-out)."""
 
     def deco(fn: Callable):
         _REGISTRY[name] = OpDef(name=name, fn=fn, amp=amp, nondiff=nondiff,
-                                spmd_rule=spmd_rule)
+                                spmd_rule=spmd_rule, cacheable=cacheable)
 
         @functools.wraps(fn)
         def public(*args, **kwargs):
@@ -99,6 +102,120 @@ def register(name: str, amp: Optional[str] = None, nondiff: bool = False,
 
 def _is_tensor(x):
     return isinstance(x, Tensor)
+
+
+# ---------------------------------------------------------------------------
+# eager executable cache (SURVEY §7 hard part 1: per-op dispatch speed)
+#
+# Plain eager dispatch pays a fresh jax trace per call — jnp op-by-op
+# dispatch on the no-grad path, and a full ``jax.vjp`` re-trace per call on
+# the grad path (the dominant cost: ~5x for custom_jvp ops like relu).  The
+# reference solves this with generated C++ kernels + a kernel cache
+# (phi/core/kernel_factory.h); the XLA-native analog is a jitted executable
+# per (op, arg structure, static kwargs), with shape/dtype specialization
+# handled by jit's own cache:
+#   - forward: one cached executable per key
+#   - backward: one cached executable computing vjp(fn) with the op's
+#     forward REMATERIALIZED inside (per-op remat) — no python-level vjp
+#     closure to rebuild, and XLA fuses the fwd recompute into the bwd.
+# Keyed off FLAGS_eager_executable_cache; bypassed under an outer trace
+# (tracer inputs), for unhashable kwargs, for ops marked cacheable=False
+# (host-side RNG or data-dependent shapes), and once the cache is full.
+# create_graph double-grad is served THROUGH the cached path: the cache-safe
+# ``base`` closure feeds the same _make_apply_with_graph re-derivation.
+# ---------------------------------------------------------------------------
+
+_EXEC_CACHE: Dict[Any, Any] = {}
+_EXEC_CACHE_MAX = 4096
+
+
+def clear_executable_cache():
+    _EXEC_CACHE.clear()
+
+
+def _exec_cache_key(op: OpDef, treedef, leaves, tensor_pos, diff_pos):
+    if not op.cacheable or not _flags.get_flag("FLAGS_eager_executable_cache"):
+        return None
+    if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+        # full: dispatch inline (building throwaway jits would retrace and
+        # recompile per call — far worse than the plain eager path)
+        return None
+    tset = set(tensor_pos)
+    statics = []
+    for i, leaf in enumerate(leaves):
+        if i in tset:
+            if isinstance(leaf._value, jax.core.Tracer):
+                return None  # under an outer jit/vmap trace: dispatch inline
+            continue
+        try:
+            hash(leaf)
+        except TypeError:
+            return None
+        statics.append((i, leaf))
+    return (op.name, treedef, tuple(statics), tuple(tensor_pos),
+            tuple(diff_pos))
+
+
+def _exec_cache_get(key, build):
+    entry = _EXEC_CACHE.get(key)
+    if entry is None:
+        entry = _EXEC_CACHE[key] = build()
+    return entry
+
+
+def _make_leaf_rebuild(treedef, statics, tensor_pos):
+    """Return rebuild(tvals) -> (args, kwargs) capturing only structure and
+    static (non-tensor) leaves — never tensor values."""
+    static_map = dict(statics)
+    n = treedef.num_leaves
+
+    def rebuild(tvals):
+        it = iter(tvals)
+        flat = [next(it) if i in tensor_pos else static_map[i]
+                for i in range(n)]
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    return rebuild
+
+
+def _build_fwd_exec(op: OpDef, key):
+    _, treedef, statics, tensor_pos, _ = key
+    rebuild = _make_leaf_rebuild(treedef, statics, set(tensor_pos))
+
+    @jax.jit
+    def fwd(tvals):
+        a, k = rebuild(tvals)
+        return op.fn(*a, **k)
+
+    return fwd
+
+
+def _build_grad_exec(op: OpDef, key):
+    _, treedef, statics, tensor_pos, diff_pos = key
+    rebuild = _make_leaf_rebuild(treedef, statics, set(tensor_pos))
+    diff_set = set(diff_pos)
+    # tensor slots in leaf order: interleave diff / nondiff values
+    t_order = list(tensor_pos)
+
+    def base(diff_vals, nondiff_vals):
+        di, ni = iter(diff_vals), iter(nondiff_vals)
+        tvals = [next(di) if i in diff_set else
+                 jax.lax.stop_gradient(next(ni)) for i in t_order]
+        a, k = rebuild(tvals)
+        return op.fn(*a, **k)
+
+    @jax.jit
+    def fwd(diff_vals, nondiff_vals):
+        return base(diff_vals, nondiff_vals)
+
+    @jax.jit
+    def bwd(diff_vals, nondiff_vals, flat_cots):
+        out, vjp_fn = jax.vjp(lambda *d: base(d, nondiff_vals), *diff_vals)
+        _, out_td = jax.tree_util.tree_flatten(out)
+        cots = jax.tree_util.tree_unflatten(out_td, list(flat_cots))
+        return vjp_fn(cots)
+
+    return fwd, bwd, base
 
 
 def _check_numerics(name: str, vals: Sequence[Any]):
@@ -228,6 +345,11 @@ def dispatch(name: str, *args, **kwargs):
     )
 
     if not need_grad:
+        key = _exec_cache_key(op, treedef, leaves, tensor_pos, ())
+        if key is not None:
+            fwd = _exec_cache_get(key, lambda: _build_fwd_exec(op, key))
+            out = fwd([leaves[i]._value for i in tensor_pos])
+            return _wrap_outputs(op, out, recorded=False)
         flat = [leaf._value if isinstance(leaf, Tensor) else leaf for leaf in leaves]
         a, k = jax.tree_util.tree_unflatten(treedef, flat)
         out = op.fn(*a, **k)
@@ -235,6 +357,27 @@ def dispatch(name: str, *args, **kwargs):
 
     diff_pos = [i for i in tensor_pos if leaves[i]._requires_grad()]
     diff_tensors = [leaves[i] for i in diff_pos]
+
+    key = _exec_cache_key(op, treedef, leaves, tensor_pos, diff_pos)
+    if key is not None:
+        fwd, bwd, base = _exec_cache_get(key,
+                                         lambda: _build_grad_exec(op, key))
+        diff_vals = [leaves[i]._value for i in diff_pos]
+        diff_set = set(diff_pos)
+        nondiff_vals = [leaves[i]._value for i in tensor_pos
+                        if i not in diff_set]
+        out = fwd(diff_vals, nondiff_vals)
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+
+        def node_vjp(flat_cots):
+            return bwd(diff_vals, nondiff_vals, list(flat_cots))
+
+        node = _tape.record_op(name, out_leaves, node_vjp, diff_tensors)
+        if _flags.get_flag("FLAGS_eager_double_grad"):
+            node.apply_with_graph = _make_apply_with_graph(
+                name, lambda *d: base(d, nondiff_vals), out_treedef,
+                diff_tensors)
+        return _wrap_outputs(op, out, recorded=True, node=node)
 
     def pure(*diff_vals):
         flat = []

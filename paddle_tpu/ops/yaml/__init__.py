@@ -13,6 +13,9 @@ collapses into import-time generation: ``ops.yaml`` entries carry
   - amp:      AMP list membership ('white' casts to bf16 on MXU, 'black'
               pins fp32) — the reference's amp_lists
   - nondiff:  op has no differentiable outputs
+  - cacheable: false marks fns that are not jit-traceable (host-side
+              loops / data-dependent shapes: nms, unique_consecutive...)
+              so eager dispatch skips the executable cache for them
   - ref:      forward golden — an expression over the inputs evaluated
               with {np, scipy, torch} (the OpTest numpy/torch reference)
   - tests:    generated-test cases (see tests/test_ops_generated.py):
@@ -105,7 +108,8 @@ def register_yaml_ops(target_module=None) -> Dict[str, Callable]:
             continue
         fn = _resolve_fn(entry)
         public = register(name, amp=entry.get("amp"),
-                          nondiff=bool(entry.get("nondiff", False)))(fn)
+                          nondiff=bool(entry.get("nondiff", False)),
+                          cacheable=bool(entry.get("cacheable", True)))(fn)
         out[name] = public
         if target_module is not None:
             setattr(target_module, name, public)
