@@ -47,12 +47,45 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
 
 
 def collect_operator_stats():
-    """Context manager printing per-op dtype call counts (reference :481)."""
+    """Context manager counting per-op calls bucketed by dtype and printing
+    the table on exit — the reference's
+    paddle.amp.debugging.collect_operator_stats (amp/debugging.py:481),
+    which walks the op stats the dispatcher collected. Here eager dispatch
+    (ops/registry.py) feeds a live sink while the context is active; the
+    table buckets float16/bfloat16/float32/other like the reference.
+    Contexts nest: every active context counts independently."""
     import contextlib
     from ..ops import registry as _r
 
     @contextlib.contextmanager
     def cm():
-        yield
+        _r.start_op_stats()
+        try:
+            yield
+        finally:
+            stats = _r.stop_op_stats()
+            per_op: dict = {}
+            for (op_name, dt), n in sorted(stats.items()):
+                row = per_op.setdefault(
+                    op_name, {"float16": 0, "bfloat16": 0, "float32": 0,
+                              "other": 0})
+                row[dt if dt in row else "other"] += n
+            print("<------------------------------ op list "
+                  "------------------------------->")
+            print(f"{'op name':<32} fp16  bf16  fp32  other")
+            for op_name, row in per_op.items():
+                print(f"{op_name:<32} {row['float16']:<5} {row['bfloat16']:<5}"
+                      f" {row['float32']:<5} {row['other']}")
+            print("<----------------------------------- end "
+                  "----------------------------->")
 
     return cm()
+
+
+def low_precision_op_list():
+    """Ops AMP auto-cast has routed to low precision so far; collection is
+    gated on ``FLAGS_low_precision_op_list`` (the reference prints this
+    table at exit when the flag is set — phi/core/kernel_factory.cc)."""
+    from ..ops import registry as _r
+
+    return sorted(_r._LOW_PRECISION_OPS)

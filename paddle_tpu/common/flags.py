@@ -24,6 +24,7 @@ class FlagInfo:
     doc: str
     type: type
     value: Any
+    on_set: Optional[Callable[[Any], None]] = None
 
 
 _REGISTRY: Dict[str, FlagInfo] = {}
@@ -35,8 +36,12 @@ def _coerce(raw: str, ty: type) -> Any:
     return ty(raw)
 
 
-def define_flag(name: str, default: Any, doc: str = "") -> None:
-    """Register a flag. Environment variable ``name`` overrides the default."""
+def define_flag(name: str, default: Any, doc: str = "",
+                on_set: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. Environment variable ``name`` overrides the default.
+    ``on_set`` runs on every set_flags update (and once at definition if the
+    environment overrode the default) — used to push a flag into an
+    external config (e.g. jax.config)."""
     ty = type(default)
     value = default
     env = os.environ.get(name)
@@ -45,8 +50,14 @@ def define_flag(name: str, default: Any, doc: str = "") -> None:
             value = _coerce(env, ty)
         except (TypeError, ValueError):
             value = default
+    if on_set is not None and value != default:
+        try:
+            on_set(value)
+        except Exception:
+            value = default  # bad env value must not break import
     with _LOCK:
-        _REGISTRY[name] = FlagInfo(name=name, default=default, doc=doc, type=ty, value=value)
+        _REGISTRY[name] = FlagInfo(name=name, default=default, doc=doc,
+                                   type=ty, value=value, on_set=on_set)
 
 
 def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
@@ -69,12 +80,35 @@ def get_flag(name: str) -> Any:
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
+    """Atomic batch update: every hook runs (and may reject) BEFORE any
+    value commits, so a raised hook leaves the whole registry unchanged and
+    external configs rolled back to the committed values. Runs under the
+    re-entrant lock, so hook+commit pairs cannot interleave across threads
+    (hooks may re-enter flags from the same thread)."""
     with _LOCK:
+        pending = []
         for name, value in flags.items():
             if name not in _REGISTRY:
                 raise ValueError(f"unknown flag {name!r}")
             info = _REGISTRY[name]
-            info.value = _coerce(value, info.type) if isinstance(value, str) else info.type(value)
+            coerced = _coerce(value, info.type) if isinstance(value, str) \
+                else info.type(value)
+            pending.append((info, coerced))
+        hooked = []
+        try:
+            for info, coerced in pending:
+                if info.on_set is not None:
+                    info.on_set(coerced)
+                    hooked.append(info)
+        except Exception:
+            for info in hooked:  # restore external state to committed values
+                try:
+                    info.on_set(info.value)
+                except Exception:
+                    pass
+            raise
+        for info, coerced in pending:
+            info.value = coerced
 
 
 def flag_info_map() -> Dict[str, FlagInfo]:
@@ -89,9 +123,31 @@ def flag_info_map() -> Dict[str, FlagInfo]:
 define_flag("FLAGS_check_nan_inf", False, "Check every op output for NaN/Inf (debug).")
 define_flag("FLAGS_check_nan_inf_level", 0, "0: error on nan/inf; >0 only report.")
 define_flag("FLAGS_use_autotune", False, "Enable runtime autotuning of kernel variants.")
-define_flag("FLAGS_benchmark", False, "Synchronize after every op (benchmark mode).")
-define_flag("FLAGS_tpu_eager_compile_cache", True, "Cache per-op compiled executables.")
-define_flag("FLAGS_tpu_default_matmul_precision", "default", "default|high|highest")
+define_flag("FLAGS_benchmark", False,
+            "Synchronize after every op — eager timings then measure device "
+            "time, not queue depth (wired: dispatch blocks on outputs).")
+define_flag("FLAGS_tpu_eager_compile_cache", True,
+            "Alias of FLAGS_eager_executable_cache kept from round 1; both "
+            "must be on for the cache (wired: ops/registry).")
+
+
+def _set_matmul_precision(value):
+    import jax
+
+    allowed = ("default", "float32", "bfloat16", "bfloat16_3x",
+               "tensorfloat32", "high", "highest")
+    if value not in allowed:
+        raise ValueError(
+            f"FLAGS_tpu_default_matmul_precision={value!r}; expected one "
+            f"of {allowed}")
+    jax.config.update("jax_default_matmul_precision",
+                      None if value == "default" else value)
+
+
+define_flag("FLAGS_tpu_default_matmul_precision", "default",
+            "default|float32|bfloat16_3x|highest — pushed into "
+            "jax.config.jax_default_matmul_precision on set (wired).",
+            on_set=_set_matmul_precision)
 define_flag("FLAGS_host_trace_level", 1, "Host profiler verbosity level.")
 define_flag("FLAGS_enable_async_trace", False, "Enable async dispatch tracing.")
 define_flag("FLAGS_tensor_operants_mode", "eager", "eager|static tensor operants mode.")
@@ -162,14 +218,24 @@ define_flag("FLAGS_tpu_deterministic", False,
             "Force deterministic XLA reductions (wired via jax config by "
             "user scripts; surfaced here for parity).")
 define_flag("FLAGS_cudnn_exhaustive_search", False,
-            "compat: see FLAGS_use_autotune.")
+            "Enables runtime kernel autotune, same switch as "
+            "FLAGS_use_autotune (wired: ops/autotune.enabled).")
 define_flag("FLAGS_embedding_deterministic", 0, "compat.")
 define_flag("FLAGS_max_inplace_grad_add", 0, "compat.")
 define_flag("FLAGS_pe_profile_fname", "", "compat profiler filename knob.")
 define_flag("FLAGS_enable_async_trace", False,
             "Enable async dispatch tracing (wired: profiler).")
+def _reset_low_precision_list(value):
+    if value:  # (re-)enabling starts a fresh report, like the reference's
+        from ..ops import registry  # per-run op list
+
+        registry._LOW_PRECISION_OPS.clear()
+
+
 define_flag("FLAGS_low_precision_op_list", 0,
-            "compat: AMP op lists live in paddle_tpu.amp.")
+            "Record ops AMP routes to low precision; read the set via "
+            "paddle.amp.debugging.low_precision_op_list() (wired).",
+            on_set=_reset_low_precision_list)
 define_flag("FLAGS_enable_auto_parallel", True,
             "compat: DTensor/GSPMD auto-parallel is always available.")
 define_flag("FLAGS_retain_grad_for_all_tensor", False,
@@ -178,3 +244,83 @@ define_flag("FLAGS_print_ir", False,
             "Dump StableHLO of compiled functions (wired: jit).")
 define_flag("FLAGS_call_stack_level", 1,
             "Error reports include Python stack (wired: enforce).")
+
+# -- round-2 (second pass) breadth: the next tier of reference flags users
+# actually set in training scripts. Same convention: (wired) names the
+# consumer; "compat" flags are accepted/readable with the TPU-native story
+# documented.
+define_flag("FLAGS_search_cache_max_number", 4096,
+            "Upper bound on cached eager executables, the reference's "
+            "kernel-search cache cap (wired: ops/registry executable "
+            "cache; dispatch falls back inline once full).")
+define_flag("FLAGS_sort_sum_gradient", False,
+            "compat: the tape accumulates gradients in deterministic "
+            "reverse-topological order unconditionally.")
+define_flag("FLAGS_paddle_num_threads", 1,
+            "compat: host-side parallelism belongs to XLA:CPU thread pools.")
+define_flag("FLAGS_inner_op_parallelism", 0,
+            "compat: intra-op parallelism is scheduled by XLA.")
+define_flag("FLAGS_dist_threadpool_size", 0,
+            "compat: collective execution threads are PJRT-owned.")
+define_flag("FLAGS_initial_cpu_memory_in_mb", 500,
+            "compat: host allocations are malloc'd, not pooled.")
+define_flag("FLAGS_use_mkldnn", False,
+            "compat: CPU fallback kernels compile through XLA:CPU.")
+define_flag("FLAGS_conv2d_disable_cudnn", False,
+            "compat: convs lower to XLA convolutions on TPU.")
+define_flag("FLAGS_use_fast_math", False,
+            "compat: matmul precision is per-op (bf16 MXU by default; "
+            "request fp32 accumulation via precision= on matmul ops).")
+define_flag("FLAGS_gemm_use_half_precision_compute_type", False,
+            "compat: MXU accumulates in fp32 regardless.")
+define_flag("FLAGS_communicator_max_merge_var_num", 20,
+            "compat: PS communicator knob; PS stack is stubs-by-design.")
+define_flag("FLAGS_communicator_send_queue_size", 20,
+            "compat: PS communicator knob; PS stack is stubs-by-design.")
+define_flag("FLAGS_apply_pass_to_program", False,
+            "compat: XLA passes replace Program passes.")
+define_flag("FLAGS_convert_all_blocks", True,
+            "compat: whole-function tracing has no sub-block conversion.")
+define_flag("FLAGS_jit_engine_type", "XLA",
+            "compat: the only JIT engine is XLA (reference: Executor/PE).")
+define_flag("FLAGS_use_shm_cache", False,
+            "compat: DataLoader workers ship arrays via pipes, not shm.")
+define_flag("FLAGS_dataloader_use_file_descriptor", False,
+            "compat: see FLAGS_use_shm_cache.")
+define_flag("FLAGS_enable_record_memory", False,
+            "Alias of FLAGS_log_memory_stats (wired: profiler reads "
+            "either).")
+define_flag("FLAGS_get_host_by_name_time", 120,
+            "Rendezvous DNS wait budget in seconds (wired: launch/TCPStore "
+            "connect retry window).")
+define_flag("FLAGS_start_cpu_core_id", 0,
+            "compat: no CPU core pinning on TPU hosts.")
+define_flag("FLAGS_enable_cublas_tensor_op_math", False,
+            "compat: MXU usage is implicit in dtype choice.")
+define_flag("FLAGS_cublaslt_exhaustive_search_times", 0,
+            "compat: see FLAGS_use_autotune.")
+define_flag("FLAGS_cudnn_batchnorm_spatial_persistent", False,
+            "compat: batch_norm lowers to XLA-fused normalization.")
+define_flag("FLAGS_enable_gpu_memory_usage_log", False,
+            "compat: use paddle.device.memory_stats / profiler.")
+define_flag("FLAGS_enable_gpu_memory_usage_log_mb", True, "compat.")
+define_flag("FLAGS_free_idle_chunk", False,
+            "compat: XLA's BFC allocator manages HBM chunks.")
+define_flag("FLAGS_free_when_no_cache_hit", False, "compat.")
+define_flag("FLAGS_gpu_allocator_retry_time", 2000,
+            "compat: allocation retry is PJRT-internal.")
+define_flag("FLAGS_enable_dependency_builder_debug_info", False,
+            "compat: XLA owns instruction scheduling.")
+define_flag("FLAGS_executor_log_deps_every_microseconds", 0, "compat.")
+define_flag("FLAGS_check_kernel_launch", False,
+            "compat: use FLAGS_check_nan_inf; launches are checked by PJRT.")
+define_flag("FLAGS_enable_unused_var_check", False,
+            "compat: jax tracing prunes unused values structurally.")
+define_flag("FLAGS_prim_all", False,
+            "compat: composite-op decomposition is jax-native (every op "
+            "is already expressed in primitives).")
+define_flag("FLAGS_prim_enable_dynamic", False, "compat.")
+define_flag("FLAGS_print_allocator_trace_info", False, "compat.")
+define_flag("FLAGS_npu_storage_format", False, "compat.")
+define_flag("FLAGS_set_to_1d", True,
+            "compat: 0-d vs 1-d scalar semantics follow numpy/jax (0-d).")

@@ -75,7 +75,13 @@ class TCPStore:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
-                 timeout: float = 30.0):
+                 timeout: Optional[float] = None):
+        if timeout is None:
+            # rendezvous wait budget: the reference's host-resolution /
+            # store-connect window (FLAGS_get_host_by_name_time)
+            from ..common import flags as _flags
+
+            timeout = float(_flags.get_flag("FLAGS_get_host_by_name_time"))
         lib = _load_lib()
         self._lib = lib
         self._server = None

@@ -20,7 +20,10 @@ from ..common import flags as _flags
 
 
 def enabled() -> bool:
-    return bool(_flags.get_flag("FLAGS_use_autotune"))
+    # FLAGS_cudnn_exhaustive_search is the reference's other autotune
+    # trigger (conv algo search); both route here on TPU
+    return bool(_flags.get_flag("FLAGS_use_autotune")
+                or _flags.get_flag("FLAGS_cudnn_exhaustive_search"))
 
 
 class AutoTuneCache:

@@ -125,8 +125,25 @@ def _is_tensor(x):
 # ``base`` closure feeds the same _make_apply_with_graph re-derivation.
 # ---------------------------------------------------------------------------
 
-_EXEC_CACHE: Dict[Any, Any] = {}
-_EXEC_CACHE_MAX = 4096
+_EXEC_CACHE: Dict[Any, Any] = {}  # cap: FLAGS_search_cache_max_number
+
+# live op-call statistics sinks: a stack of {(op_name, dtype_str): count}
+# dicts, one per active amp.debugging.collect_operator_stats context (every
+# active context counts, so nesting composes); empty-stack check is the only
+# per-dispatch cost when off.  The low-precision set feeds
+# FLAGS_low_precision_op_list and resets when the flag is (re-)enabled.
+_OP_STATS_STACK: List[Dict[Any, int]] = []
+_LOW_PRECISION_OPS: set = set()
+
+
+def start_op_stats() -> Dict[Any, int]:
+    d: Dict[Any, int] = {}
+    _OP_STATS_STACK.append(d)
+    return d
+
+
+def stop_op_stats() -> Dict[Any, int]:
+    return _OP_STATS_STACK.pop() if _OP_STATS_STACK else {}
 
 
 def clear_executable_cache():
@@ -134,9 +151,15 @@ def clear_executable_cache():
 
 
 def _exec_cache_key(op: OpDef, treedef, leaves, tensor_pos, diff_pos):
-    if not op.cacheable or not _flags.get_flag("FLAGS_eager_executable_cache"):
+    if not op.cacheable:
         return None
-    if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+    f = _flags.get_flags(("FLAGS_eager_executable_cache",
+                          "FLAGS_tpu_eager_compile_cache",
+                          "FLAGS_search_cache_max_number"))  # one lock trip
+    if not f["FLAGS_eager_executable_cache"] \
+            or not f["FLAGS_tpu_eager_compile_cache"]:
+        return None
+    if len(_EXEC_CACHE) >= int(f["FLAGS_search_cache_max_number"]):
         # full: dispatch inline (building throwaway jits would retrace and
         # recompile per call — far worse than the plain eager path)
         return None
@@ -245,6 +268,8 @@ def _amp_cast_leaves(op: OpDef, leaves: List[Any]) -> List[Any]:
         category = "white"
     if category == "white":
         target = st.dtype
+        if _flags.get_flag("FLAGS_low_precision_op_list"):
+            _LOW_PRECISION_OPS.add(op.name)
     elif category == "black":
         target = jnp.float32
     else:
@@ -338,6 +363,12 @@ def dispatch(name: str, *args, **kwargs):
     leaves = _amp_cast_leaves(op, leaves)
 
     tensor_pos = [i for i, leaf in enumerate(leaves) if isinstance(leaf, Tensor)]
+    sinks = tuple(_OP_STATS_STACK)  # snapshot: stop() may race from
+    if sinks:                       # another thread mid-dispatch
+        dt = next((str(leaves[i].dtype) for i in tensor_pos), "none")
+        k = (name, dt)
+        for s in sinks:
+            s[k] = s.get(k, 0) + 1
     need_grad = (
         not op.nondiff
         and _tape.is_grad_enabled()
@@ -412,6 +443,14 @@ def dispatch(name: str, *args, **kwargs):
 
 
 def _wrap_outputs(op: OpDef, out, recorded: bool, node=None):
+    if _flags.get_flag("FLAGS_benchmark"):
+        # benchmark mode: fence the async dispatch queue so per-op wall
+        # time measures device time (reference: flags.cc FLAGS_benchmark).
+        # Skip under an outer trace (tracers); device errors propagate here
+        # rather than at a later unrelated materialization.
+        flat = jax.tree_util.tree_leaves(out)
+        if not any(isinstance(v, jax.core.Tracer) for v in flat):
+            jax.block_until_ready(out)
     if _flags.get_flag("FLAGS_check_nan_inf"):
         flat, _ = jax.tree_util.tree_flatten(out)
         _check_numerics(op.name, flat)

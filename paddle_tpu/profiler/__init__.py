@@ -95,7 +95,20 @@ class Profiler:
                 pass
 
     def step(self):
-        pass
+        from ..common import flags as _flags
+
+        if not self._running:
+            return
+        if (_flags.get_flag("FLAGS_log_memory_stats")
+                or _flags.get_flag("FLAGS_enable_record_memory")):
+            from .. import device as _device
+
+            _host_events.append({
+                "name": "memory_stats", "ph": "C", "dur": 0,
+                "ts": time.perf_counter() * 1e6,
+                "args": {"allocated": _device.memory_allocated(),
+                         "max_allocated": _device.max_memory_allocated()},
+            })
 
     def __enter__(self):
         self.start()

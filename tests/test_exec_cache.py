@@ -135,17 +135,18 @@ def test_split_with_tensor_sections():
 
 
 def test_cache_full_falls_back_inline():
+    from paddle_tpu.common import flags as F
     from paddle_tpu.ops import registry as r
-    old = r._EXEC_CACHE_MAX
+    saved = F.get_flag("FLAGS_search_cache_max_number")
     try:
-        r._EXEC_CACHE_MAX = 0
+        paddle.set_flags({"FLAGS_search_cache_max_number": 0})
         x = paddle.to_tensor(np.random.randn(3, 3).astype(np.float32))
         out = paddle.nn.functional.relu(x)
         np.testing.assert_allclose(np.asarray(out._value),
                                    np.maximum(np.asarray(x._value), 0))
         assert len(r._EXEC_CACHE) == 0
     finally:
-        r._EXEC_CACHE_MAX = old
+        paddle.set_flags({"FLAGS_search_cache_max_number": saved})
 
 
 def test_to_static_still_traces_through():
