@@ -4,3 +4,5 @@ benchmark configs, BASELINE.md)."""
 from .llama import (LLAMA_SHARDING_PLAN, LlamaConfig, LlamaForCausalLM,
                     LlamaModel, apply_llama_sharding, build_train_step,
                     make_batch_shardings)
+from .gpt_moe import (GPTMoEConfig, GPTMoEForCausalLM, apply_gpt_moe_sharding,
+                      build_moe_train_step)

@@ -1,0 +1,229 @@
+"""MoE transformer language model — the ERNIE-MoE-style flagship.
+
+SURVEY §7 milestone 8's second config: a GPT-style causal LM whose FFNs are
+mixtures of experts, trained under hybrid dp×ep×mp sharding. Reference
+analogs: the MoE stack under
+python/paddle/incubate/distributed/models/moe/moe_layer.py (layer, gates,
+global scatter/gather) composed into an ERNIE/GPT decoder the way the
+reference's fleet MoE examples do; attention/embedding parity with
+python/paddle/nn/layer/transformer.py.
+
+TPU-native structure:
+- attention is the same Pallas-flash entry the Llama flagship uses
+  (incubate.nn.attention), causal, with learned position embeddings;
+- each MoE FFN is ONE registered op (moe_forward): the GShard masked-einsum
+  formulation whose dispatch/combine einsums XLA lowers to the exact
+  alltoall the reference hand-writes — experts Shard(0) over the ``ep``
+  mesh axis, expert hidden dim over ``mp`` (EP×TP);
+- the train step is a single donated jit: CE loss + capacity-weighted
+  aux load-balance loss (gshard), optimizer update inside.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn.layer import Layer
+from ..incubate.nn.attention import flash_attention
+from ..incubate.distributed.models.moe.moe_layer import MoELayer
+
+__all__ = ["GPTMoEConfig", "GPTMoEForCausalLM", "apply_gpt_moe_sharding",
+           "build_moe_train_step"]
+
+
+@dataclass
+class GPTMoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 1024
+    num_hidden_layers: int = 8
+    num_attention_heads: int = 16
+    ffn_hidden_size: int = 4096
+    num_experts: int = 8
+    moe_every: int = 2           # every k-th block gets an MoE FFN
+    top_k: int = 2
+    gate: str = "gshard"
+    capacity_factor: float = 1.2
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    aux_loss_weight: float = 0.01
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def debug(cls):
+        return cls(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=4, ffn_hidden_size=64, num_experts=4,
+                   moe_every=2, max_position_embeddings=64)
+
+
+class GPTMoEAttention(Layer):
+    """Causal MHA over the flash-attention entry. Layout [b, s, h, d]."""
+
+    def __init__(self, cfg: GPTMoEConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.qkv_proj = nn.Linear(h, 3 * h)
+        self.out_proj = nn.Linear(h, h)
+        self.cfg = cfg
+
+    def forward(self, x):
+        b, s, _ = x.shape
+        cfg = self.cfg
+        qkv = self.qkv_proj(x).reshape(
+            [b, s, 3, cfg.num_attention_heads, cfg.head_dim])
+        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        out = flash_attention(q, k, v, causal=True)
+        return self.out_proj(out.reshape([b, s, cfg.hidden_size]))
+
+
+class GPTMoEBlock(Layer):
+    def __init__(self, cfg: GPTMoEConfig, use_moe: bool):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTMoEAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.use_moe = use_moe
+        if use_moe:
+            self.mlp = MoELayer(cfg.hidden_size, cfg.ffn_hidden_size,
+                                num_expert=cfg.num_experts, gate=cfg.gate,
+                                top_k=cfg.top_k,
+                                capacity_factor=cfg.capacity_factor,
+                                activation="gelu")
+        else:
+            self.mlp = nn.Sequential(
+                nn.Linear(cfg.hidden_size, cfg.ffn_hidden_size), nn.GELU(),
+                nn.Linear(cfg.ffn_hidden_size, cfg.hidden_size))
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTMoEForCausalLM(Layer):
+    def __init__(self, cfg: GPTMoEConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.blocks = nn.LayerList([
+            GPTMoEBlock(cfg, use_moe=((i + 1) % cfg.moe_every == 0))
+            for i in range(cfg.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[-1]
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32))
+        x = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.lm_head(self.ln_f(x))
+
+    def aux_losses(self):
+        """Aux load-balance losses of the MoE blocks from the LAST forward
+        (tracers inside a trace — combine them there)."""
+        out = []
+        for blk in self.blocks:
+            if blk.use_moe and blk.mlp.l_aux is not None:
+                out.append(blk.mlp.l_aux)
+        return out
+
+
+# --------------------------------------------------------------------------
+# hybrid dp×ep×mp sharding plan
+# --------------------------------------------------------------------------
+
+def _param_specs(name: str) -> P:
+    """PartitionSpec per parameter name — the Megatron/GShard hybrid:
+    attention+dense-FFN weights mp-column/row-sharded, expert stacks
+    Shard(0) over ep with expert hidden over mp, embeddings mp-sharded on
+    vocab/hidden, norms replicated."""
+    if ".mlp.w_up" in name:
+        return P("ep", None, "mp")
+    if ".mlp.b_up" in name:
+        return P("ep", "mp")
+    if ".mlp.w_down" in name:
+        return P("ep", "mp", None)
+    if ".mlp.b_down" in name:
+        return P("ep", None)
+    if ".mlp.gate.weight" in name:
+        return P()
+    if ".qkv_proj.weight" in name or ".mlp.0.weight" in name:
+        return P(None, "mp")  # column parallel
+    if ".qkv_proj.bias" in name or ".mlp.0.bias" in name:
+        return P("mp")
+    if ".out_proj.weight" in name or ".mlp.2.weight" in name:
+        return P("mp", None)  # row parallel
+    if name.startswith("wte.") or name.startswith("lm_head."):
+        return P(None, "mp") if name.endswith("weight") else P()
+    return P()
+
+
+def apply_gpt_moe_sharding(model: GPTMoEForCausalLM, mesh: Mesh) -> None:
+    """Place every parameter per the dp×ep×mp plan (GSPMD propagates the
+    activation layouts; the moe_forward einsums then lower to ep-axis
+    alltoalls, the qkv/out matmuls to mp-axis collectives)."""
+    for name, p_ in model.named_parameters():
+        spec = _param_specs(name)
+        spec = P(*[ax if (ax is None or ax in mesh.axis_names) else None
+                   for ax in spec])
+        p_.set_value(jax.device_put(p_._value, NamedSharding(mesh, spec)))
+
+
+def build_moe_train_step(model: GPTMoEForCausalLM, optimizer,
+                         mesh: Optional[Mesh] = None,
+                         data_axes: Tuple[str, ...] = ("dp",),
+                         compute_dtype=jnp.float32):
+    """Donated jitted step: (params, opt_state, step_no, lr, ids, labels)
+    -> (loss, aux_loss, new_params, new_opt_state). CE over shifted labels
+    plus cfg.aux_loss_weight × mean expert-balance aux loss (the
+    reference's l_aux term, moe_layer.py:263)."""
+    from ..autograd import no_grad
+
+    cfg = model.cfg
+    batch_sharding = None
+    if mesh is not None:
+        axes = tuple(a for a in data_axes if a in mesh.axis_names)
+        if axes:
+            batch_sharding = NamedSharding(mesh, P(axes))
+
+    def loss_fn(params, input_ids, labels):
+        cast = {k: (v.astype(compute_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in params.items()}
+        with no_grad():
+            logits = model.functional_call(cast, Tensor(input_ids))
+        lv = logits._value.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(lv, axis=-1)
+        ll = jnp.take_along_axis(lv, labels[..., None], axis=-1)[..., 0]
+        ce = jnp.mean(lse - ll)
+        auxes = [a._value if isinstance(a, Tensor) else a
+                 for a in model.aux_losses()]
+        aux = (jnp.mean(jnp.stack(auxes)) if auxes
+               else jnp.asarray(0.0, jnp.float32))
+        return ce + cfg.aux_loss_weight * aux, (ce, aux)
+
+    def step(params, opt_state, step_no, lr, input_ids, labels):
+        if batch_sharding is not None:
+            input_ids = jax.lax.with_sharding_constraint(
+                input_ids, batch_sharding)
+            labels = jax.lax.with_sharding_constraint(labels, batch_sharding)
+        (_, (ce, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, input_ids, labels)
+        new_params, new_opt = optimizer.apply(params, grads, opt_state, lr,
+                                              step_no + 1)
+        return ce, aux, new_params, new_opt
+
+    return jax.jit(step, donate_argnums=(0, 1))
