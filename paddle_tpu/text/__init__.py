@@ -16,7 +16,7 @@ from ..nn.layer import Layer
 from ..ops.registry import dispatch
 
 __all__ = ["ViterbiDecoder", "viterbi_decode", "Imdb", "UCIHousing",
-           "WMT14", "WMT16"]
+           "WMT14", "WMT16", "Conll05st", "Imikolov", "Movielens"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,
@@ -96,3 +96,78 @@ class WMT14(_SyntheticTextDataset):
 
 class WMT16(WMT14):
     pass
+
+
+class Conll05st(Dataset):
+    """SRL dataset (reference text/datasets/conll05.py): each item is the
+    8-column tuple (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+    mark, labels) over a seq_len window."""
+
+    def __init__(self, mode="train", size=128, seq_len=32, word_vocab=5000,
+                 num_labels=67):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self._cols = [rng.randint(1, word_vocab, (size, seq_len)).astype("int64")
+                      for _ in range(6)]
+        self._cols.append(rng.randint(0, 2, (size, seq_len)).astype("int64"))
+        self._cols.append(rng.randint(0, num_labels,
+                                      (size, seq_len)).astype("int64"))
+
+    def __getitem__(self, idx):
+        return tuple(c[idx] for c in self._cols)
+
+    def __len__(self):
+        return len(self._cols[0])
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (reference text/datasets/imikolov.py):
+    items are (context n-1 grams, next word)."""
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5,
+                 size=512, vocab=2000):
+        assert data_type in ("NGRAM", "SEQ"), \
+            f"data type should be NGRAM, SEQ, but it is {data_type}"
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.data_type = data_type
+        self.window_size = window_size
+        seq = rng.randint(1, vocab, size + window_size).astype("int64")
+        self._ctx = np.stack([seq[i:i + window_size - 1]
+                              for i in range(size)])
+        self._nxt = seq[window_size - 1:window_size - 1 + size]
+        # SEQ mode: whole sentences (reference imikolov.py SEQ yields the
+        # full id sequence per line)
+        self._seqs = np.stack([seq[i:i + window_size] for i in range(size)])
+
+    def __getitem__(self, idx):
+        if self.data_type == "SEQ":
+            return self._seqs[idx]
+        return self._ctx[idx], self._nxt[idx]
+
+    def __len__(self):
+        return len(self._ctx)
+
+
+class Movielens(Dataset):
+    """Rating prediction (reference text/datasets/movielens.py): items are
+    (user_id, gender, age, job, movie_id, category, title, rating)."""
+
+    def __init__(self, mode="train", size=256, num_users=6040,
+                 num_movies=3952, title_len=8):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = size
+        self._user = rng.randint(1, num_users, n).astype("int64")
+        self._gender = rng.randint(0, 2, n).astype("int64")
+        self._age = rng.randint(0, 7, n).astype("int64")
+        self._job = rng.randint(0, 21, n).astype("int64")
+        self._movie = rng.randint(1, num_movies, n).astype("int64")
+        self._cat = rng.randint(0, 18, (n, 3)).astype("int64")
+        self._title = rng.randint(1, 5000, (n, title_len)).astype("int64")
+        self._rating = rng.randint(1, 6, n).astype("float32")
+
+    def __getitem__(self, idx):
+        return (self._user[idx], self._gender[idx], self._age[idx],
+                self._job[idx], self._movie[idx], self._cat[idx],
+                self._title[idx], self._rating[idx])
+
+    def __len__(self):
+        return len(self._user)

@@ -373,3 +373,257 @@ def densenet121(pretrained=False, num_classes=1000, **kw):
 
 def densenet169(pretrained=False, num_classes=1000, **kw):
     return DenseNet(169, num_classes=num_classes)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (analog of python/paddle/vision/models/squeezenet.py)
+# ---------------------------------------------------------------------------
+
+class _Fire(Layer):
+    """Fire module: 1x1 squeeze, then concat(1x1 expand, 3x3 expand)."""
+
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Sequential(Conv2D(cin, squeeze, 1), ReLU())
+        self.expand1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+        self.expand3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+    def forward(self, x):
+        from .. import concat
+
+        s = self.squeeze(x)
+        return concat([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(Layer):
+    """version '1.0'/'1.1' (squeezenet.py:1.0 stem 7x7/96, 1.1 stem 3x3/64).
+    ``with_pool=False`` returns the 512-channel feature map (reference
+    squeezenet.py:223); ``num_classes<=0`` skips the classifier conv."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        from ..nn import Dropout
+
+        assert version in ("1.0", "1.1"), \
+            f"supported versions are '1.0' and '1.1' but input version is {version}"
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5), Conv2D(512, num_classes, 1), ReLU())
+        self.pool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = Flatten()(self.pool(x))
+        return x
+
+
+def squeezenet1_0(pretrained=False, num_classes=1000, **kw):
+    return SqueezeNet("1.0", num_classes=num_classes)
+
+
+def squeezenet1_1(pretrained=False, num_classes=1000, **kw):
+    return SqueezeNet("1.1", num_classes=num_classes)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2 (analog of python/paddle/vision/models/shufflenetv2.py)
+# ---------------------------------------------------------------------------
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    return (x.reshape([b, groups, c // groups, h, w])
+             .transpose([0, 2, 1, 3, 4]).reshape([b, c, h, w]))
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride > 1:
+            # downsample unit: both branches see the full input
+            self.branch1 = Sequential(
+                Conv2D(cin, cin, 3, stride=stride, padding=1, groups=cin,
+                       bias_attr=False), BatchNorm2D(cin),
+                Conv2D(cin, branch, 1, bias_attr=False), BatchNorm2D(branch),
+                ReLU())
+            b2in = cin
+        else:
+            self.branch1 = None
+            b2in = cin // 2
+        self.branch2 = Sequential(
+            Conv2D(b2in, branch, 1, bias_attr=False), BatchNorm2D(branch),
+            ReLU(),
+            Conv2D(branch, branch, 3, stride=stride, padding=1, groups=branch,
+                   bias_attr=False), BatchNorm2D(branch),
+            Conv2D(branch, branch, 1, bias_attr=False), BatchNorm2D(branch),
+            ReLU())
+
+    def forward(self, x):
+        from .. import concat
+
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    SCALES = {
+        0.5: (24, 48, 96, 192, 1024),
+        1.0: (24, 116, 232, 464, 1024),
+        1.5: (24, 176, 352, 704, 1024),
+        2.0: (24, 244, 488, 976, 2048),
+    }
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True, **kw):
+        super().__init__()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        c0, c1, c2, c3, cf = self.SCALES[scale]
+        self.stem = Sequential(
+            Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(c0), ReLU(), MaxPool2D(3, 2, padding=1))
+        stages = []
+        cin = c0
+        for cout, repeat in ((c1, 4), (c2, 8), (c3, 4)):
+            stages.append(_ShuffleUnit(cin, cout, 2))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(cout, cout, 1))
+            cin = cout
+        self.stages = Sequential(*stages)
+        self.tail = Sequential(
+            Conv2D(cin, cf, 1, bias_attr=False), BatchNorm2D(cf), ReLU())
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(cf, num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = Flatten()(self.pool(x))
+            if self.num_classes > 0:
+                x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_5(pretrained=False, num_classes=1000, **kw):
+    return ShuffleNetV2(0.5, num_classes=num_classes)
+
+
+def shufflenet_v2_x1_0(pretrained=False, num_classes=1000, **kw):
+    return ShuffleNetV2(1.0, num_classes=num_classes)
+
+
+def shufflenet_v2_x1_5(pretrained=False, num_classes=1000, **kw):
+    return ShuffleNetV2(1.5, num_classes=num_classes)
+
+
+def shufflenet_v2_x2_0(pretrained=False, num_classes=1000, **kw):
+    return ShuffleNetV2(2.0, num_classes=num_classes)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet / Inception-v1 (analog of python/paddle/vision/models/googlenet.py)
+# ---------------------------------------------------------------------------
+
+class _Inception(Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, pproj):
+        super().__init__()
+        self.b1 = Sequential(Conv2D(cin, c1, 1), ReLU())
+        self.b3 = Sequential(Conv2D(cin, c3r, 1), ReLU(),
+                             Conv2D(c3r, c3, 3, padding=1), ReLU())
+        self.b5 = Sequential(Conv2D(cin, c5r, 1), ReLU(),
+                             Conv2D(c5r, c5, 5, padding=2), ReLU())
+        self.bp = Sequential(MaxPool2D(3, 1, padding=1),
+                             Conv2D(cin, pproj, 1), ReLU())
+
+    def forward(self, x):
+        from .. import concat
+
+        return concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    """Inception-v1. Reference parity (googlenet.py:256): forward returns
+    (out, aux1, aux2) unconditionally; ``with_pool=False`` leaves the main
+    path as the 1024-channel feature map."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        from ..nn import Dropout
+
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+
+        self.stem = Sequential(
+            Conv2D(3, 64, 7, stride=2, padding=3), ReLU(),
+            MaxPool2D(3, 2, padding=1),
+            Conv2D(64, 64, 1), ReLU(),
+            Conv2D(64, 192, 3, padding=1), ReLU(),
+            MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.pool5 = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.head = Sequential(Dropout(0.4), Linear(1024, num_classes))
+        self.aux1 = Sequential(AdaptiveAvgPool2D((4, 4)), Flatten(),
+                               Linear(512 * 16, 1024), ReLU(),
+                               Dropout(0.7), Linear(1024, num_classes))
+        self.aux2 = Sequential(AdaptiveAvgPool2D((4, 4)), Flatten(),
+                               Linear(528 * 16, 1024), ReLU(),
+                               Dropout(0.7), Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.pool3(self.i3b(self.i3a(self.stem(x))))
+        x = self.i4a(x)
+        a1 = x
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = x
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        out = x
+        if self.with_pool:
+            out = Flatten()(self.pool5(out))
+            if self.num_classes > 0:
+                out = self.head(out)
+        return out, self.aux1(a1), self.aux2(a2)
+
+
+def googlenet(pretrained=False, num_classes=1000, **kw):
+    return GoogLeNet(num_classes=num_classes)

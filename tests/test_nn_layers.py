@@ -167,6 +167,58 @@ def test_vision_model_zoo_forward():
         assert tuple(out.shape) == (1, 3), ctor.__name__
 
 
+def test_vision_models_squeeze_shuffle_google():
+    from paddle_tpu.vision import models as M
+
+    x = paddle.rand([1, 3, 64, 64])
+    for ctor in (M.squeezenet1_0, M.squeezenet1_1, M.shufflenet_v2_x0_5,
+                 M.shufflenet_v2_x1_0):
+        net = ctor(num_classes=7)
+        net.eval()
+        out = net(x)
+        assert tuple(out.shape) == (1, 7), ctor.__name__
+
+    net = M.googlenet(num_classes=4)
+    net.eval()
+    outs = net(x)  # reference parity: ALWAYS (out, aux1, aux2)
+    assert len(outs) == 3
+    assert tuple(outs[0].shape) == (1, 4) and tuple(outs[1].shape) == (1, 4)
+
+    # with_pool=False exposes the backbone feature map on the main path
+    feat = M.SqueezeNet("1.1", num_classes=0, with_pool=False)
+    feat.eval()
+    fmap = feat(x)
+    assert len(fmap.shape) == 4 and fmap.shape[1] == 512
+
+    import pytest as _pytest
+    with _pytest.raises(AssertionError):
+        M.SqueezeNet(version="2.0")
+
+
+def test_text_datasets_breadth():
+    from paddle_tpu import text
+
+    c = text.Conll05st(mode="train", size=8, seq_len=16)
+    item = c[0]
+    assert len(c) == 8 and len(item) == 8
+    assert item[0].shape == (16,) and item[7].dtype == np.int64
+
+    ik = text.Imikolov(mode="test", window_size=5, size=32)
+    ctx, nxt = ik[3]
+    assert ctx.shape == (4,) and np.ndim(nxt) == 0
+    # n-gram windows slide over one corpus: context shifts by one
+    np.testing.assert_array_equal(ik[4][0][:3], ik[3][0][1:])
+    seqs = text.Imikolov(data_type="SEQ", window_size=5, size=8)
+    assert seqs[0].shape == (5,)
+    with pytest.raises(AssertionError):
+        text.Imikolov(data_type="WORDS")
+
+    ml = text.Movielens(size=16)
+    row = ml[0]
+    assert len(ml) == 16 and len(row) == 8
+    assert 1.0 <= float(row[7]) <= 5.0
+
+
 def test_device_memory_stats_api():
     import paddle_tpu.device as device
 
