@@ -1106,3 +1106,729 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
 def binomial(count, prob):
     return jax.random.binomial(_key(), count.astype(jnp.float32),
                                prob).astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# round-2 second pass: remaining reference-op coverage
+# (paddle/phi/ops/yaml/ops.yaml names; CUDA-only details noted per op)
+# ---------------------------------------------------------------------------
+
+def assign_out_(x, output):
+    """Inplace assign: functional form returns the new value of ``output``."""
+    return jnp.broadcast_to(x, jnp.shape(output)).astype(output.dtype)
+
+
+def assign_value_(shape, dtype, values):
+    return jnp.asarray(values, jnp.dtype(dtype)).reshape(tuple(shape))
+
+
+def full_(x, value):
+    return jnp.full_like(x, value)
+
+
+def full_int_array(value, dtype="int64"):
+    return jnp.asarray(value, jnp.dtype(dtype))
+
+
+def full_with_tensor(shape_tensor, value, dtype="float32"):
+    shape = tuple(int(s) for s in np.asarray(shape_tensor))
+    return jnp.full(shape, value, jnp.dtype(dtype))
+
+
+def full_batch_size_like(like, shape, value, dtype="float32",
+                         input_dim_idx=0, output_dim_idx=0):
+    shape = list(shape)
+    shape[output_dim_idx] = like.shape[input_dim_idx]
+    return jnp.full(tuple(shape), value, jnp.dtype(dtype))
+
+
+def npu_identity(x, format=-1):
+    return x
+
+
+def c_identity(x, ring_id=0, use_calc_stream=True, use_model_parallel=True):
+    return x
+
+
+def depend(x, dep=None):
+    """Scheduling edge only (reference pir op); value passes through."""
+    return x
+
+
+def uniform_inplace(x, min=-1.0, max=1.0, seed=0, diag_num=0, diag_step=0,
+                    diag_val=1.0):
+    return jax.random.uniform(_key(), x.shape, x.dtype, min, max)
+
+
+def gaussian_inplace(x, mean=0.0, std=1.0, seed=0):
+    return mean + std * jax.random.normal(_key(), x.shape, x.dtype)
+
+
+def uniform_random_batch_size_like(like, shape, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   dtype="float32", seed=0):
+    shape = list(shape)
+    shape[output_dim_idx] = like.shape[input_dim_idx]
+    return jax.random.uniform(_key(), tuple(shape), jnp.dtype(dtype), min, max)
+
+
+def shuffle_batch(x, seed=0):
+    perm = jax.random.permutation(_key(), x.shape[0])
+    return jnp.take(x, perm, axis=0)
+
+
+# -- fake quantization family (phi/kernels/fake_quantize_kernel.h) ---------
+
+def _qmax(bit_length):
+    return (1 << (bit_length - 1)) - 1
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, round_type=0,
+                                       quant_axis=0, is_test=False):
+    bnt = _qmax(bit_length)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    s = scale.reshape(shape)
+    out = jnp.round(x / jnp.maximum(s, 1e-12) * bnt)
+    return jnp.clip(out, -bnt, bnt), scale
+
+
+def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=(8,),
+                                         quant_axis=0, x_num_col_dims=1):
+    bits = list(quant_bits) if hasattr(quant_bits, "__len__") else [quant_bits]
+    scs = list(scales) if isinstance(scales, (list, tuple)) else [scales]
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    out = x * jnp.asarray(scs[0]).reshape(shape) / _qmax(bits[0])
+    if len(scs) > 1:  # two-level conv path: weight scale x activation scale
+        out = out * jnp.squeeze(jnp.asarray(scs[1])) / _qmax(
+            bits[1] if len(bits) > 1 else bits[0])
+    return out
+
+
+def fake_dequantize_max_abs(x, scale, max_range):
+    return x * jnp.asarray(scale) / max_range
+
+
+def fake_quantize_moving_average_abs_max(x, in_scale, in_accum, in_state,
+                                         moving_rate=0.9, bit_length=8,
+                                         is_test=False, round_type=0):
+    bnt = _qmax(bit_length)
+    absmax = jnp.max(jnp.abs(x))
+    state = moving_rate * in_state + 1.0
+    accum = moving_rate * in_accum + absmax
+    scale = accum / state
+    out = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-12) * bnt), -bnt, bnt)
+    return out, scale.reshape(in_scale.shape), state, accum
+
+
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_scale, in_accum, in_state, moving_rate=0.9, bit_length=8,
+        is_test=False, round_type=0):
+    out, scale, state, accum = fake_quantize_moving_average_abs_max(
+        x, in_scale, in_accum, in_state, moving_rate, bit_length, is_test,
+        round_type)
+    bnt = _qmax(bit_length)
+    return out * scale / bnt, scale, state, accum
+
+
+def fake_quantize_range_abs_max(x, in_scale, iter=None, window_size=10000,
+                                bit_length=8, is_test=False, round_type=0):
+    bnt = _qmax(bit_length)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), jnp.squeeze(in_scale))
+    out = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-12) * bnt), -bnt, bnt)
+    return out, scale.reshape(jnp.shape(in_scale))
+
+
+def dequantize_abs_max(x, scale, max_range):
+    return x.astype(jnp.float32) * jnp.asarray(scale) / max_range
+
+
+def dequantize_log(x, dict):  # noqa: A002 — reference input name
+    table = jnp.asarray(dict)
+    idx = x.astype(jnp.int32)
+    # reference: high bit flags sign (uint8 codes); here signed codes
+    return jnp.where(idx < 0, -jnp.take(table, -idx - 1),
+                     jnp.take(table, idx)).astype(jnp.float32)
+
+
+def apply_per_channel_scale(x, scales):
+    return x * scales
+
+
+# -- AMP loss-scaling ops (phi/kernels/check_finite_and_unscale_kernel.h) --
+
+def check_finite_and_unscale_(xs, scale):
+    inv = 1.0 / jnp.squeeze(scale)
+    outs = []
+    found = jnp.asarray(False)
+    for x in (xs if isinstance(xs, (list, tuple)) else [xs]):
+        found = found | jnp.any(~jnp.isfinite(x))
+        outs.append(x * inv.astype(x.dtype))
+    return (*outs, found)
+
+
+def update_loss_scaling_(xs, found_infinite, prev_loss_scaling, in_good_steps,
+                         in_bad_steps, incr_every_n_steps=1000,
+                         decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                         decr_ratio=0.5, stop_update=False):
+    xs_list = list(xs) if isinstance(xs, (list, tuple)) else [xs]
+    if stop_update:  # freeze scaling + counters (gradient-accumulation steps)
+        return (*xs_list, prev_loss_scaling, in_good_steps, in_bad_steps)
+    good = jnp.squeeze(in_good_steps)
+    bad = jnp.squeeze(in_bad_steps)
+    scale = jnp.squeeze(prev_loss_scaling)
+    bad2 = jnp.where(found_infinite, bad + 1, jnp.zeros_like(bad))
+    good2 = jnp.where(found_infinite, jnp.zeros_like(good), good + 1)
+    decr = bad2 >= decr_every_n_nan_or_inf
+    incr = good2 >= incr_every_n_steps
+    new_scale = jnp.where(decr, jnp.maximum(scale * decr_ratio, 1.0),
+                          jnp.where(incr, scale * incr_ratio, scale))
+    bad3 = jnp.where(decr, jnp.zeros_like(bad2), bad2)
+    good3 = jnp.where(incr, jnp.zeros_like(good2), good2)
+    outs = [jnp.where(found_infinite, jnp.zeros_like(x), x)
+            for x in (xs if isinstance(xs, (list, tuple)) else [xs])]
+    return (*outs, new_scale.reshape(jnp.shape(prev_loss_scaling)),
+            good3.reshape(jnp.shape(in_good_steps)),
+            bad3.reshape(jnp.shape(in_bad_steps)))
+
+
+# -- detection ops (phi/kernels/{box_coder,prior_box,roi_align,...}) -------
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              variance=()):
+    pb = prior_box.astype(jnp.float32)
+    tb = target_box.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    px = pb[:, 0] + pw * 0.5
+    py = pb[:, 1] + ph * 0.5
+    if prior_box_var is not None:
+        var = prior_box_var.astype(jnp.float32)
+    elif len(variance):
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               (pb.shape[0], 4))
+    else:
+        var = jnp.ones((pb.shape[0], 4), jnp.float32)
+    def _e(v):  # broadcast priors along the non-``axis`` dim of target
+        return v[None, :] if axis == 0 else v[:, None]
+
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tx = tb[:, 0] + tw * 0.5
+        ty = tb[:, 1] + th * 0.5
+        ox = ((tx[:, None] - px[None, :]) / pw[None, :]) / var[None, :, 0]
+        oy = ((ty[:, None] - py[None, :]) / ph[None, :]) / var[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / var[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / var[None, :, 3]
+        return jnp.stack([ox, oy, ow, oh], axis=-1)
+    # decode_center_size: target [N, M, 4] deltas; axis picks which dim the
+    # priors run along (0: per column, 1: per row)
+    if tb.ndim == 2:
+        tb = tb[:, None, :] if axis == 0 else tb[None, :, :]
+    ox = _e(var[:, 0]) * tb[..., 0] * _e(pw) + _e(px)
+    oy = _e(var[:, 1]) * tb[..., 1] * _e(ph) + _e(py)
+    ow = jnp.exp(_e(var[:, 2]) * tb[..., 2]) * _e(pw)
+    oh = jnp.exp(_e(var[:, 3]) * tb[..., 3]) * _e(ph)
+    return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                      ox + ow * 0.5 - norm, oy + oh * 0.5 - norm], axis=-1)
+
+
+def box_clip(input, im_info):
+    if input.ndim == 3:  # [B, M, 4]: clip each image against its own info
+        hm = (im_info[:, 0] / im_info[:, 2] - 1.0)[:, None]
+        wm = (im_info[:, 1] / im_info[:, 2] - 1.0)[:, None]
+    else:
+        hm = im_info[0, 0] / im_info[0, 2] - 1.0
+        wm = im_info[0, 1] / im_info[0, 2] - 1.0
+    x1 = jnp.clip(input[..., 0], 0, wm)
+    y1 = jnp.clip(input[..., 1], 0, hm)
+    x2 = jnp.clip(input[..., 2], 0, wm)
+    y2 = jnp.clip(input[..., 3], 0, hm)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def prior_box(input, image, min_sizes, max_sizes=(), aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              step_w=0.0, step_h=0.0, offset=0.5, min_max_aspect_ratios_order=False):
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = step_w or iw / fw
+    sh = step_h or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            # Caffe/SSD ordering: min, max, then remaining aspect ratios
+            boxes.append((ms, ms))
+            for Ms in max_sizes:
+                boxes.append((((ms * Ms) ** 0.5), (ms * Ms) ** 0.5))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                boxes.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+        else:
+            for ar in ars:
+                boxes.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+            for Ms in max_sizes:
+                boxes.append(((ms * Ms) ** 0.5, (ms * Ms) ** 0.5))
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([(cxg - bw / 2) / iw, (cyg - bh / 2) / ih,
+                              (cxg + bw / 2) / iw, (cyg + bh / 2) / ih], -1))
+    res = jnp.stack(out, axis=2)  # [fh, fw, nboxes, 4]
+    if clip:
+        res = jnp.clip(res, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), res.shape)
+    return res, var
+
+
+def _roi_image_ids(n_images, n_rois, boxes_num):
+    """Map each ROI to its source image via per-image counts. The counts
+    come from host data (LoD in the reference), so tracers are rejected."""
+    if n_images == 1 or boxes_num is None:
+        return jnp.zeros((n_rois,), jnp.int32)
+    if isinstance(boxes_num, jax.core.Tracer):
+        raise NotImplementedError(
+            "batched roi ops need concrete boxes_num (host-side LoD)")
+    counts = np.asarray(boxes_num).reshape(-1)
+    return jnp.asarray(np.repeat(np.arange(len(counts)), counts)
+                       .astype(np.int32))
+
+
+def roi_align(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=2, aligned=False):
+    """boxes: [R, 4] in (x1, y1, x2, y2). sampling_ratio must be positive
+    (the reference's adaptive -1 needs data-dependent loop counts)."""
+    if sampling_ratio <= 0:
+        raise NotImplementedError("roi_align requires sampling_ratio > 0")
+    off = 0.5 if aligned else 0.0
+    ph, pw, sr = pooled_height, pooled_width, sampling_ratio
+    n, c, H, W = x.shape
+    img_ids = _roi_image_ids(n, boxes.shape[0], boxes_num)
+
+    def one_roi(box, img_id):
+        x1 = box[0] * spatial_scale - off
+        y1 = box[1] * spatial_scale - off
+        x2 = box[2] * spatial_scale - off
+        y2 = box[3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bw = rw / pw
+        bh = rh / ph
+        iy = (jnp.arange(ph)[:, None, None, None] * bh + y1 +
+              (jnp.arange(sr)[None, None, :, None] + 0.5) * bh / sr)
+        ix = (jnp.arange(pw)[None, :, None, None] * bw + x1 +
+              (jnp.arange(sr)[None, None, None, :] + 0.5) * bw / sr)
+        iy = jnp.broadcast_to(iy, (ph, pw, sr, sr)).reshape(-1)
+        ix = jnp.broadcast_to(ix, (ph, pw, sr, sr)).reshape(-1)
+        y0 = jnp.clip(jnp.floor(iy), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(ix), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        ly = jnp.clip(iy - y0, 0.0, 1.0)
+        lx = jnp.clip(ix - x0, 0.0, 1.0)
+        feat = jnp.take(x, img_id, axis=0)
+        # keep the two index arrays contiguous: feat[:, y, x] -> [c, S]
+        # (an integer batch index in the same subscript would push the
+        # broadcast dims to the front)
+        val = (feat[:, y0.astype(int), x0.astype(int)] * ((1 - ly) * (1 - lx))
+               + feat[:, y1i.astype(int), x0.astype(int)] * (ly * (1 - lx))
+               + feat[:, y0.astype(int), x1i.astype(int)] * ((1 - ly) * lx)
+               + feat[:, y1i.astype(int), x1i.astype(int)] * (ly * lx))
+        valid = ((iy >= -1) & (iy <= H) & (ix >= -1) & (ix <= W))
+        val = jnp.where(valid[None, :], val, 0.0)
+        return val.reshape(c, ph, pw, sr * sr).mean(-1)
+
+    return jax.vmap(one_roi)(boxes.astype(jnp.float32), img_ids)
+
+
+def roi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    """Max pooling per ROI bin via masked max over the feature map (static
+    shapes; the reference's integer bin loop is data-dependent)."""
+    n, c, H, W = x.shape
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+    ph, pw = pooled_height, pooled_width
+    img_ids = _roi_image_ids(n, boxes.shape[0], boxes_num)
+
+    def one_roi(box, img_id):
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bw = rw / pw
+        bh = rh / ph
+        out = []
+        for i in range(ph):
+            for j in range(pw):
+                ys0 = jnp.floor(y1 + i * bh)
+                ys1 = jnp.ceil(y1 + (i + 1) * bh)
+                xs0 = jnp.floor(x1 + j * bw)
+                xs1 = jnp.ceil(x1 + (j + 1) * bw)
+                mask = ((ys[:, None] >= ys0) & (ys[:, None] < ys1)
+                        & (xs[None, :] >= xs0) & (xs[None, :] < xs1)
+                        & (ys[:, None] >= 0) & (ys[:, None] < H)
+                        & (xs[None, :] >= 0) & (xs[None, :] < W))
+                m = jnp.where(mask[None], jnp.take(x, img_id, axis=0),
+                              -jnp.inf).max((-1, -2))
+                out.append(jnp.where(jnp.isfinite(m), m, 0.0))
+        return jnp.stack(out, -1).reshape(c, ph, pw)
+
+    return jax.vmap(one_roi)(boxes.astype(jnp.float32), img_ids)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x5 = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (gx + sig(x5[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2) / w
+    by = (gy + sig(x5[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2) / h
+    bw = jnp.exp(x5[:, :, 2]) * an[None, :, 0, None, None] / (
+        downsample_ratio * w)
+    bh = jnp.exp(x5[:, :, 3]) * an[None, :, 1, None, None] / (
+        downsample_ratio * h)
+    conf = sig(x5[:, :, 4])
+    probs = sig(x5[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, na * h * w, 4)
+    keep = (conf > conf_thresh).reshape(n, na * h * w)
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(n, na * h * w, class_num)
+    scores = jnp.where(keep[..., None], scores, 0.0)
+    return boxes, scores
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, nms_top_k=100,
+               keep_top_k=100, post_threshold=0.0, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Host-side (eager) op: data-dependent output size. Returns
+    (out [K, 6], index [K], rois_num [N])."""
+    bboxes = np.asarray(bboxes)
+    scores = np.asarray(scores)
+    outs, idxs, nums = [], [], []
+    for b in range(bboxes.shape[0]):
+        rows = []
+        for c in range(scores.shape[1]):
+            if c == background_label:
+                continue
+            sc = scores[b, c]
+            sel = np.where(sc > score_threshold)[0]
+            if not len(sel):
+                continue
+            order = sel[np.argsort(-sc[sel])][:nms_top_k]
+            boxes = bboxes[b, order]
+            iou = _iou_matrix(boxes)
+            iou = np.triu(iou, 1)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp((iou_cmax ** 2 - iou ** 2) / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - iou_cmax, 1e-10)
+            decay = decay.min(0)
+            dscores = sc[order] * decay
+            keep = dscores > post_threshold
+            for k in np.where(keep)[0]:
+                rows.append((c, dscores[k], *boxes[k], order[k]))
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:keep_top_k]
+        nums.append(len(rows))
+        for r in rows:
+            outs.append(r[:6])
+            idxs.append(b * bboxes.shape[1] + r[6])
+    out = np.asarray(outs, np.float32).reshape(-1, 6)
+    return (jnp.asarray(out), jnp.asarray(np.asarray(idxs, np.int64)),
+            jnp.asarray(np.asarray(nums, np.int32)))
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=100, keep_top_k=100, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=-1):
+    """Greedy per-class hard NMS (host-side eager op)."""
+    bboxes = np.asarray(bboxes)
+    scores = np.asarray(scores)
+    outs, idxs, nums = [], [], []
+    for b in range(bboxes.shape[0]):
+        rows = []
+        for c in range(scores.shape[1]):
+            if c == background_label:
+                continue
+            sc = scores[b, c]
+            sel = np.where(sc > score_threshold)[0]
+            order = sel[np.argsort(-sc[sel])][:nms_top_k]
+            iou = _iou_matrix(bboxes[b, order])
+            kept_pos = []
+            for pi in range(len(order)):
+                if all(iou[pi, pj] <= nms_threshold for pj in kept_pos):
+                    kept_pos.append(pi)
+            for pi in kept_pos:
+                i = order[pi]
+                rows.append((c, sc[i], *bboxes[b, i], i))
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:keep_top_k]
+        nums.append(len(rows))
+        for r in rows:
+            outs.append(r[:6])
+            idxs.append(b * bboxes.shape[1] + r[6])
+    out = np.asarray(outs, np.float32).reshape(-1, 6)
+    return (jnp.asarray(out), jnp.asarray(np.asarray(idxs, np.int64)),
+            jnp.asarray(np.asarray(nums, np.int32)))
+
+
+# -- attention aliases + fused optimizer + misc ----------------------------
+
+def flash_attn(q, k, v, fixed_seed_offset=None, attn_mask=None,
+               dropout=0.0, causal=False, return_softmax=False,
+               is_test=True, rng_name=""):
+    """Reference flash_attn op surface (phi flash_attn kernel): layout
+    [b, s, h, d]; routes to the same kernel entry as
+    incubate.nn.attention.flash_attention."""
+    from ...incubate.nn.attention import flash_attention
+    from ...core.tensor import Tensor as _T
+
+    out = flash_attention(_T(q), _T(k), _T(v), causal=causal,
+                          dropout=dropout, attn_mask=None if attn_mask is None
+                          else _T(attn_mask))
+    return out._value
+
+
+def flash_attn_qkvpacked(qkv, fixed_seed_offset=None, attn_mask=None,
+                         dropout=0.0, causal=False, return_softmax=False,
+                         is_test=True, rng_name=""):
+    """qkv: [b, s, 3, h, d] packed."""
+    return flash_attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                      fixed_seed_offset, attn_mask, dropout, causal,
+                      return_softmax, is_test, rng_name)
+
+
+def merged_momentum_(params, grads, velocities, lr, mu=0.9,
+                     use_nesterov=False):
+    new_p, new_v = [], []
+    lr_ = jnp.squeeze(jnp.asarray(lr))
+    for p, g, v in zip(params, grads, velocities):
+        v2 = mu * v + g
+        if use_nesterov:
+            p2 = p - (g + mu * v2) * lr_
+        else:
+            p2 = p - lr_ * v2
+        new_p.append(p2)
+        new_v.append(v2)
+    return (*new_p, *new_v)
+
+
+def merged_adam_(params, grads, lr, moments1, moments2, beta1_pows,
+                 beta2_pows, beta1=0.9, beta2=0.999, epsilon=1e-8):
+    outs_p, outs_m1, outs_m2, outs_b1, outs_b2 = [], [], [], [], []
+    lr_ = jnp.squeeze(jnp.asarray(lr))
+    for p, g, m1, m2, b1p, b2p in zip(params, grads, moments1, moments2,
+                                      beta1_pows, beta2_pows):
+        m1n = beta1 * m1 + (1 - beta1) * g
+        m2n = beta2 * m2 + (1 - beta2) * g * g
+        b1n = b1p * beta1
+        b2n = b2p * beta2
+        mhat = m1n / (1 - b1n)
+        vhat = m2n / (1 - b2n)
+        outs_p.append(p - lr_ * mhat / (jnp.sqrt(vhat) + epsilon))
+        outs_m1.append(m1n)
+        outs_m2.append(m2n)
+        outs_b1.append(b1n)
+        outs_b2.append(b2n)
+    return (*outs_p, *outs_m1, *outs_m2, *outs_b1, *outs_b2)
+
+
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3,
+                         in_num_accumulates, in_old_num_accumulates,
+                         in_num_updates, average_window=10000,
+                         max_average_window=10000, min_average_window=10000):
+    num_acc = jnp.squeeze(in_num_accumulates) + 1
+    num_upd = jnp.squeeze(in_num_updates) + 1
+    s1 = in_sum_1 + param
+    restart = num_acc >= min_average_window
+    old = jnp.squeeze(in_old_num_accumulates)
+    s2 = jnp.where(restart, s1 + in_sum_2, in_sum_2)
+    old2 = jnp.where(restart, old + num_acc, old)
+    s1o = jnp.where(restart, jnp.zeros_like(s1), s1)
+    acc2 = jnp.where(restart, jnp.zeros_like(num_acc), num_acc)
+    return (s1o, s2, in_sum_3, acc2.reshape(jnp.shape(in_num_accumulates)),
+            old2.reshape(jnp.shape(in_old_num_accumulates)),
+            num_upd.reshape(jnp.shape(in_num_updates)))
+
+
+def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95,
+                    epsilon=1e-6):
+    m2 = decay * moment + (1 - decay) * grad * grad
+    lr = jnp.squeeze(jnp.asarray(learning_rate))
+    return param - lr * grad / (jnp.sqrt(m2) + epsilon), m2
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    b, s, d = x.shape
+    pos = jnp.arange(s, dtype=jnp.float32)[:, None]
+    half = d // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return alpha * x + beta * enc[None, :, :d].astype(x.dtype)
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW"):
+    if data_layout == "NCHW":
+        return x * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1)
+    return x * scale + bias
+
+
+def shuffle_channel(x, group=1):
+    b, c, h, w = x.shape
+    return (x.reshape(b, group, c // group, h, w)
+             .transpose(0, 2, 1, 3, 4).reshape(b, c, h, w))
+
+
+def cvm(x, cvm_in, use_cvm=True):
+    """Continuous-value-model feature op (phi cvm kernel): first two
+    columns are show/click; use_cvm=False drops them."""
+    if use_cvm:
+        show = jnp.log(x[:, :1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - show
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+def unpool(x, indices, ksize=(2, 2), strides=(2, 2), padding=(0, 0),
+           output_size=None, data_format="NCHW"):
+    """Max-unpool: scatter values back to argmax flat indices."""
+    n, c, h, w = x.shape
+    if output_size is None:
+        oh = (h - 1) * strides[0] - 2 * padding[0] + ksize[0]
+        ow = (w - 1) * strides[1] - 2 * padding[1] + ksize[1]
+    else:
+        oh, ow = output_size[-2], output_size[-1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return out.reshape(n, c, oh, ow)
+
+
+def max_pool3d_with_index(x, kernel_size, strides=None, paddings=(0, 0, 0),
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    if adaptive:
+        raise NotImplementedError("adaptive max_pool3d_with_index")
+    n, c, d, h, w = x.shape
+    if global_pooling:
+        kernel_size = (d, h, w)
+        paddings = (0, 0, 0)
+    kd, kh, kw = kernel_size
+    sd, sh, sw = strides or kernel_size
+    pd, ph_, pw_ = paddings
+
+    def _odim(sz, k, s, p):
+        num = sz + 2 * p - k
+        return (-(-num // s) if ceil_mode else num // s) + 1
+
+    od, oh, ow = _odim(d, kd, sd, pd), _odim(h, kh, sh, ph_),         _odim(w, kw, sw, pw_)
+    # pad with -inf: argmax never lands in padding, and flat indices stay
+    # in the UNPADDED input's coordinates (torch/phi convention)
+    xp = jnp.pad(x, ((0, 0), (0, 0),
+                     (pd, max(0, (od - 1) * sd + kd - d - pd)),
+                     (ph_, max(0, (oh - 1) * sh + kh - h - ph_)),
+                     (pw_, max(0, (ow - 1) * sw + kw - w - pw_))),
+                 constant_values=-jnp.inf)
+    outs = jnp.full((n, c, od, oh, ow), -jnp.inf, x.dtype)
+    idxs = jnp.zeros((n, c, od, oh, ow), jnp.int32)
+    for i in range(kd):
+        for j in range(kh):
+            for k in range(kw):
+                window = xp[:, :, i:i + od * sd:sd, j:j + oh * sh:sh,
+                            k:k + ow * sw:sw]
+                di = jnp.arange(od) * sd + i - pd
+                hi = jnp.arange(oh) * sh + j - ph_
+                wi = jnp.arange(ow) * sw + k - pw_
+                flat = (di[:, None, None] * h * w + hi[None, :, None] * w
+                        + wi[None, None, :]).astype(jnp.int32)
+                better = window > outs
+                outs = jnp.where(better, window, outs)
+                idxs = jnp.where(better, flat[None, None], idxs)
+    return outs, idxs
+
+
+def margin_cross_entropy(logits, label, return_softmax=False, ring_id=0,
+                         rank=0, nranks=1, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0):
+    """ArcFace-style margin softmax CE (phi margin_cross_entropy):
+    cos(m1*theta + m2) - m3 applied to the target logit."""
+    theta = jnp.arccos(jnp.clip(logits, -1.0, 1.0))
+    margin_logit = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    mod = jnp.where(onehot > 0, margin_logit, logits) * scale
+    lse = jax.scipy.special.logsumexp(mod, axis=-1, keepdims=True)
+    logprob = mod - lse
+    loss = -(onehot * logprob).sum(-1, keepdims=True)
+    sm = jnp.exp(logprob)
+    return loss, sm
+
+
+def auc(x, label, stat_pos, stat_neg, ins_tag_weight=None, curve="ROC",
+        num_thresholds=4095, slide_steps=1):
+    """Streaming AUC (phi auc kernel): bucketed positive/negative counts."""
+    pred = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else x.reshape(-1)
+    buckets = jnp.clip((pred * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos = stat_pos.reshape(-1).at[buckets].add(lab)
+    neg = stat_neg.reshape(-1).at[buckets].add(1 - lab)
+    # integrate: for each threshold, tp/fp above it
+    tot_pos = jnp.cumsum(pos[::-1])[::-1]
+    tot_neg = jnp.cumsum(neg[::-1])[::-1]
+    tp = jnp.concatenate([tot_pos, jnp.zeros((1,), tot_pos.dtype)])
+    fp = jnp.concatenate([tot_neg, jnp.zeros((1,), tot_neg.dtype)])
+    area = jnp.sum((fp[:-1] - fp[1:]) * (tp[:-1] + tp[1:]) / 2.0)
+    denom = tot_pos[0] * tot_neg[0]
+    val = jnp.where(denom > 0, area / jnp.maximum(denom, 1), 0.0)
+    return (val.astype(jnp.float64), pos.reshape(stat_pos.shape),
+            neg.reshape(stat_neg.shape))

@@ -206,3 +206,106 @@ def lu_unpack(x, y, **kw):
     pm = np.zeros((m, m), x.dtype)
     pm[perm, np.arange(m)] = 1.0
     return [pm, l, u]
+
+
+# -- round-2 second-pass op goldens ----------------------------------------
+
+def attention_ref(q, k, v, causal=False, **kw):
+    """Plain numpy softmax attention over [b, s, h, d]."""
+    qt = np.moveaxis(q, 2, 1).astype(np.float64)  # [b, h, s, d]
+    kt = np.moveaxis(k, 2, 1).astype(np.float64)
+    vt = np.moveaxis(v, 2, 1).astype(np.float64)
+    s = qt @ np.swapaxes(kt, -1, -2) / np.sqrt(q.shape[-1])
+    if causal:
+        sq = s.shape[-2]
+        mask = np.tril(np.ones((sq, sq), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.moveaxis(p @ vt, 1, 2).astype(np.float32)
+
+
+def flash_attn(q, k, v, causal=False, **kw):
+    return attention_ref(q, k, v, causal=causal)
+
+
+def flash_attn_qkvpacked(qkv, causal=False, **kw):
+    return attention_ref(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                         causal=causal)
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0, **kw):
+    bnt = (1 << (bit_length - 1)) - 1
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = np.abs(x).max(axis=axes, keepdims=True)
+    return np.clip(np.round(x / np.maximum(scale, 1e-12) * bnt), -bnt, bnt)
+
+
+def fake_qdq_moving_avg(x, in_scale, in_accum, in_state, moving_rate=0.9,
+                        bit_length=8, **kw):
+    bnt = (1 << (bit_length - 1)) - 1
+    state = moving_rate * in_state[0] + 1.0
+    accum = moving_rate * in_accum[0] + np.abs(x).max()
+    scale = accum / state
+    q = np.clip(np.round(x / max(scale, 1e-12) * bnt), -bnt, bnt)
+    return (q * scale / bnt).astype(np.float32)
+
+
+def merged_adam_p0(params, grads, lr, moments1, moments2, beta1_pows,
+                   beta2_pows, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+    p, g, m1, m2 = params[0], grads[0], moments1[0], moments2[0]
+    b1 = beta1_pows[0] * beta1
+    b2 = beta2_pows[0] * beta2
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * g * g
+    return (p - lr[0] * (m1n / (1 - b1)) /
+            (np.sqrt(m2n / (1 - b2)) + epsilon)).astype(np.float32)
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, **kw):
+    b, s, d = x.shape
+    half = d // 2
+    pos = np.arange(s, dtype=np.float64)[:, None]
+    div = np.power(10000.0, np.arange(half, dtype=np.float64) / half)
+    enc = np.concatenate([np.sin(pos / div), np.cos(pos / div)], axis=1)
+    return (alpha * x + beta * enc[None, :, :d]).astype(np.float32)
+
+
+def roc_auc(x, label, stat_pos, stat_neg, num_thresholds=4095, **kw):
+    """Exact rank-based ROC AUC (bucketing error covered by tolerance)."""
+    pred = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else x.reshape(-1)
+    lab = label.reshape(-1)
+    order = np.argsort(pred)
+    ranks = np.empty(len(pred))
+    ranks[order] = np.arange(1, len(pred) + 1)
+    npos = lab.sum()
+    nneg = len(lab) - npos
+    return np.asarray((ranks[lab == 1].sum() - npos * (npos + 1) / 2)
+                      / (npos * nneg))
+
+
+def box_coder_decode(prior_box, prior_box_var, target_box, **kw):
+    pb = prior_box.astype(np.float64)
+    pw = pb[:, 2] - pb[:, 0]
+    ph = pb[:, 3] - pb[:, 1]
+    px = pb[:, 0] + pw / 2
+    py = pb[:, 1] + ph / 2
+    var = prior_box_var.astype(np.float64)
+    tb = target_box.astype(np.float64)
+    ox = var[None, :, 0] * tb[..., 0] * pw[None] + px[None]
+    oy = var[None, :, 1] * tb[..., 1] * ph[None] + py[None]
+    ow = np.exp(var[None, :, 2] * tb[..., 2]) * pw[None]
+    oh = np.exp(var[None, :, 3] * tb[..., 3]) * ph[None]
+    return np.stack([ox - ow / 2, oy - oh / 2, ox + ow / 2, oy + oh / 2],
+                    -1).astype(np.float32)
+
+
+def margin_ce_loss(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                   scale=64.0, **kw):
+    theta = np.arccos(np.clip(logits.astype(np.float64), -1, 1))
+    m = np.cos(margin1 * theta + margin2) - margin3
+    onehot = np.eye(logits.shape[-1])[label]
+    mod = np.where(onehot > 0, m, logits.astype(np.float64)) * scale
+    lse = np.log(np.exp(mod - mod.max(-1, keepdims=True)).sum(-1,
+                 keepdims=True)) + mod.max(-1, keepdims=True)
+    return (-(onehot * (mod - lse)).sum(-1, keepdims=True)).astype(np.float32)
