@@ -6,3 +6,4 @@ from .llama import (LLAMA_SHARDING_PLAN, LlamaConfig, LlamaForCausalLM,
                     make_batch_shardings)
 from .gpt_moe import (GPTMoEConfig, GPTMoEForCausalLM, apply_gpt_moe_sharding,
                       build_moe_train_step)
+from .generation import generate

@@ -1,0 +1,248 @@
+"""Autoregressive generation with a KV cache for the Llama flagship.
+
+Reference analogs: the fused decode path
+(python/paddle/incubate/nn/functional/masked_multihead_attention.py and
+fused_multi_transformer.py — one-token-per-step attention against a
+preallocated cache) plus the generation loops PaddleNLP layers over it.
+
+TPU-native design: the whole decode is TWO compiled programs —
+- prefill: one forward over the prompt that also returns the per-layer
+  K/V tensors (written into a [L, B, max_len, kvh, d] cache), and
+- a ``lax.scan`` over decode steps: each step embeds one token, runs every
+  layer against the cache (GQA grouped einsums, fp32 softmax with a
+  position mask), appends its K/V via ``dynamic_update_slice``, samples
+  (greedy / temperature / top-k / top-p) and carries the PRNG key chain.
+No per-token python dispatch, no cache reallocation, static shapes
+throughout — the XLA-friendly formulation of the reference's CUDA decode
+kernels.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["generate"]
+
+
+def _rms_norm(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rotate_half(x):
+    a, b = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-b, a], axis=-1)
+
+
+def _apply_rope(q, k, cos, sin):
+    """q: [..., h, d]; cos/sin broadcastable [..., 1, d] (neox style, the
+    layout _rope_tables builds)."""
+    return (q * cos + _rotate_half(q) * sin,
+            k * cos + _rotate_half(k) * sin)
+
+
+class _Weights:
+    """Name-indexed view over functional_state (paddle Linear weights are
+    [in, out]: y = x @ W)."""
+
+    def __init__(self, cfg, params):
+        self.cfg = cfg
+        self.p = params
+
+    def layer(self, i, name):
+        return self.p[f"model.layers.{i}.{name}"]
+
+    def head(self, x):
+        if "lm_head.weight" in self.p:
+            return x @ self.p["lm_head.weight"]
+        # tied embeddings: reuse the embedding matrix transposed
+        return x @ self.p["model.embed_tokens.weight"].T
+
+    def __getitem__(self, k):
+        return self.p[k]
+
+
+def _block(w: _Weights, i, x, cos, sin, mask, k_all=None, v_all=None,
+           cache_pos=None):
+    """One decoder layer. x [b, s, hdim]; without a cache (prefill) it
+    attends x's own K/V causally; with k_all/v_all ([b, M, kvh, d] layer
+    cache) and ``cache_pos``, x's K/V are first written at that position,
+    then attention runs over the whole cache. Returns
+    (y, k_attended, v_attended) — the prompt's K/V in prefill, the updated
+    layer cache in decode."""
+    cfg = w.cfg
+    b, s, _ = x.shape
+    h, kvh, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    eps = cfg.rms_norm_eps
+    xin = _rms_norm(x, w.layer(i, "input_layernorm.weight"), eps)
+    q = (xin @ w.layer(i, "self_attn.q_proj.weight")).reshape(b, s, h, d)
+    k = (xin @ w.layer(i, "self_attn.k_proj.weight")).reshape(b, s, kvh, d)
+    v = (xin @ w.layer(i, "self_attn.v_proj.weight")).reshape(b, s, kvh, d)
+    q, k = _apply_rope(q, k, cos, sin)
+    if k_all is None:
+        k_all, v_all = k, v
+    else:
+        k_all = lax.dynamic_update_slice(k_all, k.astype(k_all.dtype),
+                                         (0, cache_pos, 0, 0))
+        v_all = lax.dynamic_update_slice(v_all, v.astype(v_all.dtype),
+                                         (0, cache_pos, 0, 0))
+    # GQA: group q heads over kv heads, attend in fp32
+    g = h // kvh
+    qg = q.reshape(b, s, kvh, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bskgd,bSkd->bskgS", qg,
+                        k_all.astype(jnp.float32)) * (d ** -0.5)
+    if mask is not None:
+        scores = scores + mask[None, :, None, None, :]
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bskgS,bSkd->bskgd", probs, v_all.astype(jnp.float32))
+    ctx = ctx.reshape(b, s, h * d).astype(x.dtype)
+    x = x + ctx @ w.layer(i, "self_attn.o_proj.weight")
+    xm = _rms_norm(x, w.layer(i, "post_attention_layernorm.weight"), eps)
+    gate = xm @ w.layer(i, "mlp.gate_proj.weight")
+    up = xm @ w.layer(i, "mlp.up_proj.weight")
+    x = x + (jax.nn.silu(gate) * up) @ w.layer(i, "mlp.down_proj.weight")
+    return x, k_all, v_all
+
+
+def _decode_step(w: _Weights, cos_tab, sin_tab, token, pos, k_cache, v_cache):
+    """One-token step. token [b], pos scalar; caches [L, b, M, kvh, d].
+    Each layer goes through the same _block as prefill, writing its K/V at
+    ``pos`` before attending. Returns (logits [b, V], k_cache, v_cache)."""
+    cfg = w.cfg
+    M = k_cache.shape[2]
+    x = jnp.take(w["model.embed_tokens.weight"], token[:, None], axis=0)
+    cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1)[None, :, None, :]
+    sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1)[None, :, None, :]
+    cos = cos.astype(x.dtype)
+    sin = sin.astype(x.dtype)
+    valid = (jnp.arange(M) <= pos)[None, :]  # [1 (q pos), M]
+    mask = jnp.where(valid, 0.0, -jnp.inf).astype(jnp.float32)
+    for i in range(cfg.num_hidden_layers):
+        x, kl, vl = _block(w, i, x, cos, sin, mask, k_cache[i], v_cache[i],
+                           pos)
+        k_cache = k_cache.at[i].set(kl)
+        v_cache = v_cache.at[i].set(vl)
+    x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
+    return w.head(x[:, 0]), k_cache, v_cache
+
+
+def _sample(logits, key, do_sample, temperature, top_k, top_p):
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with mass >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg_id", "max_new_tokens", "do_sample",
+                                   "temperature", "top_k", "top_p", "eos_id"))
+def _generate_jit(params, ids, key, cfg_id, max_new_tokens,
+                  do_sample, temperature, top_k, top_p, eos_id):
+    cfg, cos_tab, sin_tab = _CFGS[cfg_id]
+    w = _Weights(cfg, params)
+    b, S = ids.shape
+    M = S + max_new_tokens
+    h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    L = cfg.num_hidden_layers
+
+    # ---- prefill: full causal forward, capture per-layer K/V ----
+    positions = jnp.broadcast_to(jnp.arange(S), (b, S))
+    x = jnp.take(w["model.embed_tokens.weight"], ids, axis=0)
+    cos = jnp.take(cos_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
+    sin = jnp.take(sin_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
+    causal = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0, -jnp.inf)
+    k_cache = jnp.zeros((L, b, M, kvh, d), x.dtype)
+    v_cache = jnp.zeros((L, b, M, kvh, d), x.dtype)
+    for i in range(L):
+        x, k, v = _block(w, i, x, cos, sin, causal)
+        k_cache = k_cache.at[i, :, :S].set(k)
+        v_cache = v_cache.at[i, :, :S].set(v)
+    x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
+    last_logits = w.head(x[:, -1])
+
+    key, sub = jax.random.split(key)
+    tok = _sample(last_logits, sub, do_sample, temperature, top_k, top_p)
+    done = jnp.zeros((b,), bool) | (tok == eos_id)
+
+    # ---- decode scan ----
+    def step(carry, _):
+        tok, pos, k_cache, v_cache, key, done = carry
+        logits, k_cache, v_cache = _decode_step(w, cos_tab, sin_tab, tok,
+                                                pos, k_cache, v_cache)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sub, do_sample, temperature, top_k, top_p)
+        nxt = jnp.where(done, eos_id, nxt)
+        done = done | (nxt == eos_id)
+        return (nxt, pos + 1, k_cache, v_cache, key, done), tok
+
+    carry = (tok, jnp.asarray(S, jnp.int32), k_cache, v_cache, key, done)
+    (last, _, _, _, _, _), toks = lax.scan(step, carry, None,
+                                           length=max_new_tokens - 1)
+    out = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    return out
+
+
+_CFGS = {}
+
+
+def generate(model, input_ids, max_new_tokens: int = 32,
+             do_sample: bool = False, temperature: float = 1.0,
+             top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+             eos_token_id: Optional[int] = None):
+    """Generate continuations for ``input_ids`` ([b, S] int) with a KV
+    cache; returns [b, S + max_new_tokens] including the prompt. Greedy by
+    default; ``do_sample`` enables temperature / top-k / top-p. After an
+    EOS is produced, a sequence keeps emitting ``eos_token_id``."""
+    from ..core.tensor import Tensor
+
+    import dataclasses
+
+    ids = input_ids._value if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    cfg = model.cfg if hasattr(model, "cfg") else model.model.cfg
+    max_new_tokens = int(max_new_tokens)
+    if max_new_tokens <= 0:
+        return Tensor(ids)
+    total = ids.shape[1] + max_new_tokens
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"generate: prompt ({ids.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds max_position_embeddings "
+            f"({cfg.max_position_embeddings}); rope phases past the table "
+            f"would silently repeat")
+    params = {k: v for k, v in model.functional_state().items()}
+    # key the compiled program + rope tables on the config VALUES, so equal
+    # configs across model instances share one compilation
+    cfg_key = tuple(sorted(dataclasses.asdict(cfg).items()))
+    if cfg_key not in _CFGS:
+        from .llama import _rope_tables
+
+        cos_tab, sin_tab = _rope_tables(cfg.head_dim,
+                                        cfg.max_position_embeddings,
+                                        cfg.rope_theta)
+        _CFGS[cfg_key] = (cfg, cos_tab, sin_tab)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    key = jax.random.PRNGKey(seed)
+    new = _generate_jit(params, ids, key, cfg_key, max_new_tokens,
+                        bool(do_sample), float(temperature), int(top_k),
+                        float(top_p), eos)
+    return Tensor(jnp.concatenate([ids, new], axis=1))

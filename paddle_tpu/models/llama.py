@@ -250,6 +250,12 @@ class LlamaForCausalLM(Layer):
             return matmul(h, self.model.embed_tokens.weight, transpose_y=True)
         return self.lm_head(h)
 
+    def generate(self, input_ids, **kwargs):
+        """KV-cached autoregressive decoding (models/generation.py)."""
+        from .generation import generate
+
+        return generate(self, input_ids, **kwargs)
+
 
 # --------------------------------------------------------------------------
 # GSPMD sharding plan (the analog of the reference's per-layer TP wrappers +
