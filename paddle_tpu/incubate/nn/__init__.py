@@ -8,6 +8,7 @@ from .fused import (
     fused_rms_norm, fused_rotary_position_embedding, swiglu,
 )
 from .attention import flash_attention
+from .fused_transformer import FusedMultiTransformer
 
 # paddle-compat namespace: paddle.incubate.nn.functional.*
 from . import fused as functional

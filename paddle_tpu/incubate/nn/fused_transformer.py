@@ -1,0 +1,205 @@
+"""FusedMultiTransformer — the reference's fused inference stack.
+
+Analog of python/paddle/incubate/nn/layer/fused_transformer.py:1071 (layer)
+over incubate.nn.functional.fused_multi_transformer (CUDA fused kernels).
+The TPU formulation runs the whole stack as one traced program per mode:
+prefill executes all layers over the full sequence (optionally writing the
+K/V caches), decode executes one token per call against the caches at
+``time_step`` — the same split the reference's masked-MHA kernel makes,
+with reference cache layout [2, B, num_head, max_seq_len, head_dim].
+
+Inference-only (like the reference kernel): outputs are detached.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer, Parameter
+
+__all__ = ["FusedMultiTransformer"]
+
+
+def _ln(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = jnp.square(x32 - mu).mean(-1, keepdims=True)
+    return (((x32 - mu) * lax.rsqrt(var + eps)) * scale + bias).astype(x.dtype)
+
+
+class FusedMultiTransformer(Layer):
+    def __init__(self, embed_dim: int, num_heads: int, dim_feedforward: int,
+                 dropout_rate: float = 0.0, activation: str = "gelu",
+                 normalize_before: bool = True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon: float = 1e-5,
+                 num_layers: int = -1, nranks: int = 1, trans_qkvw: bool = True,
+                 ring_id: int = -1, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer: only pre-LayerNorm is implemented")
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if isinstance(
+                qkv_weight_attrs, (list, tuple)) else 1
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dim_feedforward = dim_feedforward
+        self.activation = activation
+        self.num_layers = num_layers
+        self._epsilon = epsilon
+        h, nh, hd, dff = embed_dim, num_heads, self.head_dim, dim_feedforward
+        rng = np.random.RandomState(0)
+
+        def mk(shape, scale=0.02, zeros=False):
+            if zeros:
+                return Parameter(jnp.zeros(shape, jnp.float32))
+            return Parameter(jnp.asarray(rng.randn(*shape) * scale,
+                                         jnp.float32))
+
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            def reg(name_, p):
+                self.add_parameter(f"{name_}_{i}", p)
+                return p
+
+            self.ln_scales.append(reg("ln_scale", Parameter(
+                jnp.ones((h,), jnp.float32))))
+            self.ln_biases.append(reg("ln_bias", mk((h,), zeros=True)))
+            # reference layout (trans_qkvw=True): [3, num_heads, head_dim, h]
+            self.qkv_weights.append(reg("qkv_weight", mk((3, nh, hd, h))))
+            self.qkv_biases.append(reg("qkv_bias", mk((3, nh, hd), zeros=True)))
+            self.linear_weights.append(reg("linear_weight", mk((h, h))))
+            self.linear_biases.append(reg("linear_bias", mk((h,), zeros=True)))
+            self.ffn_ln_scales.append(reg("ffn_ln_scale", Parameter(
+                jnp.ones((h,), jnp.float32))))
+            self.ffn_ln_biases.append(reg("ffn_ln_bias", mk((h,), zeros=True)))
+            self.ffn1_weights.append(reg("ffn1_weight", mk((h, dff))))
+            self.ffn1_biases.append(reg("ffn1_bias", mk((dff,), zeros=True)))
+            self.ffn2_weights.append(reg("ffn2_weight", mk((dff, h))))
+            self.ffn2_biases.append(reg("ffn2_bias", mk((h,), zeros=True)))
+
+    def _act(self, x):
+        return jax.nn.gelu(x, approximate=False) if self.activation == "gelu" \
+            else jax.nn.relu(x)
+
+    def _layer(self, i, x, mask, cache=None, ts=None):
+        """One shared layer body. x [b, s, h]. Without ``cache``: self
+        (prefill) attention over x's own K/V. With ``cache`` ([2, b, nh, M,
+        hd]) and ``ts``: write this token's K/V at ts, attend the whole
+        cache. Returns (y, k, v, cache) — k/v are x's own (for prefill
+        cache writes), cache is the updated one (or None)."""
+        nh, hd = self.num_heads, self.head_dim
+        b, s, h = x.shape
+        eps = self._epsilon
+        xin = _ln(x, self.ln_scales[i]._value, self.ln_biases[i]._value, eps)
+        w = self.qkv_weights[i]._value.reshape(3 * nh * hd, h)
+        qkv = (xin @ w.T + self.qkv_biases[i]._value.reshape(-1)
+               ).reshape(b, s, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k = jnp.moveaxis(k, 1, 2)  # [b, nh, s, hd]
+        v = jnp.moveaxis(v, 1, 2)
+        if cache is None:
+            k_all, v_all = k, v
+        else:
+            cache = lax.dynamic_update_slice(
+                cache, k[None].astype(cache.dtype), (0, 0, 0, ts, 0))
+            cache = lax.dynamic_update_slice(
+                cache, v[None].astype(cache.dtype), (1, 0, 0, ts, 0))
+            k_all, v_all = cache[0], cache[1]
+        qh = jnp.moveaxis(q, 1, 2).astype(jnp.float32)
+        scores = jnp.einsum("bnsd,bnSd->bnsS", qh,
+                            k_all.astype(jnp.float32)) * (hd ** -0.5)
+        if mask is not None:
+            scores = scores + mask
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bnsS,bnSd->bnsd", probs,
+                         v_all.astype(jnp.float32)).astype(x.dtype)
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(b, s, nh * hd)
+        x = x + ctx @ self.linear_weights[i]._value \
+            + self.linear_biases[i]._value
+        xm = _ln(x, self.ffn_ln_scales[i]._value,
+                 self.ffn_ln_biases[i]._value, eps)
+        f = self._act(xm @ self.ffn1_weights[i]._value
+                      + self.ffn1_biases[i]._value)
+        x = x + f @ self.ffn2_weights[i]._value + self.ffn2_biases[i]._value
+        return x, k, v, cache
+
+    def forward(self, src, attn_mask=None, caches: Optional[List] = None,
+                pre_caches=None, rotary_embs=None, rotary_emb_dims=0,
+                beam_offset=None, seq_lens=None, time_step=None):
+        # unsupported reference knobs must fail loudly, not change results
+        if rotary_embs is not None or rotary_emb_dims:
+            raise NotImplementedError(
+                "FusedMultiTransformer: rotary_embs not implemented (use the "
+                "Llama flagship path for rope models)")
+        if pre_caches is not None or beam_offset is not None \
+                or seq_lens is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer: pre_caches/beam_offset/seq_lens "
+                "not implemented")
+        x = src._value if isinstance(src, Tensor) else jnp.asarray(src)
+        mask = None
+        if attn_mask is not None:
+            mask = attn_mask._value if isinstance(attn_mask, Tensor) \
+                else jnp.asarray(attn_mask)
+            mask = mask.astype(jnp.float32)
+        cache_vals = None
+        if caches is not None:
+            cache_vals = [c._value if isinstance(c, Tensor) else jnp.asarray(c)
+                          for c in caches]
+
+        if time_step is None:
+            out, new_caches = self._prefill(x, mask, cache_vals)
+        else:
+            ts = int(time_step._value if isinstance(time_step, Tensor)
+                     else time_step)
+            out, new_caches = self._decode(x, cache_vals, ts, mask)
+
+        out_t = Tensor(out, stop_gradient=True)
+        if caches is None:
+            return out_t
+        return out_t, [Tensor(c, stop_gradient=True) for c in new_caches]
+
+    def _prefill(self, x, mask, cache_vals):
+        b, s, _ = x.shape
+        new_caches = []
+        for i in range(self.num_layers):
+            x, k, v, _ = self._layer(i, x, mask)
+            if cache_vals is not None:
+                c = cache_vals[i]
+                c = c.at[0, :, :, :s].set(k.astype(c.dtype))
+                c = c.at[1, :, :, :s].set(v.astype(c.dtype))
+                new_caches.append(c)
+        return x, new_caches
+
+    def _decode(self, x, cache_vals, ts, attn_mask=None):
+        if cache_vals is None:
+            raise ValueError("decode (time_step given) requires caches")
+        M = cache_vals[0].shape[3]
+        valid = (jnp.arange(M) <= ts)
+        mask = jnp.where(valid, 0.0, -jnp.inf).astype(jnp.float32)[None, None,
+                                                                   None, :]
+        if attn_mask is not None:  # e.g. padding mask over cache positions
+            mask = mask + attn_mask
+        new_caches = []
+        for i in range(self.num_layers):
+            x, _, _, c = self._layer(i, x, mask, cache_vals[i], ts)
+            new_caches.append(c)
+        return x, new_caches

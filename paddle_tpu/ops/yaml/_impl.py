@@ -1832,3 +1832,128 @@ def auc(x, label, stat_pos, stat_neg, ins_tag_weight=None, curve="ROC",
     val = jnp.where(denom > 0, area / jnp.maximum(denom, 1), 0.0)
     return (val.astype(jnp.float64), pos.reshape(stat_pos.shape),
             neg.reshape(stat_neg.shape))
+
+
+# -- static-graph collective ops (c_* family) ------------------------------
+# The reference's phi comm kernels (paddle/phi/kernels/gpu/all_reduce_kernel
+# .cu etc, dispatched by ring_id through CommContext). These OP-level
+# entries see raw arrays (dispatch unwraps Tensors), so they cover the
+# replicated single-controller contract: with no group initialized they are
+# identities (world size 1), with a group they route through the eager
+# collective layer. Pending-PARTIAL DTensors carry their partial axes on
+# the Tensor wrapper — reduce those through paddle.distributed.all_reduce
+# (the Tensor API), not these ops.
+
+def _collective_entry(x, fn, *args, **kw):
+    from ...core.tensor import Tensor as _T
+    from ...distributed import collective as C
+
+    if not C.is_initialized():
+        return x  # world size 1: identity (reference: ring of one)
+    t = _T(x)
+    fn(t, *args, **kw)
+    return t._value
+
+
+def c_allreduce_sum(x, ring_id=0, use_calc_stream=True, use_model_parallel=False):
+    from ...distributed import collective as C
+
+    return _collective_entry(x, C.all_reduce, op="sum")
+
+
+def c_allreduce_max(x, ring_id=0, use_calc_stream=True, use_model_parallel=False):
+    from ...distributed import collective as C
+
+    return _collective_entry(x, C.all_reduce, op="max")
+
+
+def c_allreduce_min(x, ring_id=0, use_calc_stream=True, use_model_parallel=False):
+    from ...distributed import collective as C
+
+    return _collective_entry(x, C.all_reduce, op="min")
+
+
+def c_allreduce_prod(x, ring_id=0, use_calc_stream=True, use_model_parallel=False):
+    from ...distributed import collective as C
+
+    return _collective_entry(x, C.all_reduce, op="prod")
+
+
+def c_reduce_sum(x, ring_id=0, root_id=0, use_calc_stream=True):
+    from ...distributed import collective as C
+
+    return _collective_entry(x, C.all_reduce, op="sum")
+
+
+def c_broadcast(x, ring_id=0, root=0, use_calc_stream=True):
+    from ...core.tensor import Tensor as _T
+    from ...distributed import collective as C
+
+    if not C.is_initialized():
+        return x
+    t = _T(x)
+    C.broadcast(t, src=root)
+    return t._value
+
+
+def c_allgather(x, ring_id=0, nranks=1, use_calc_stream=True):
+    from ...core.tensor import Tensor as _T
+    from ...distributed import collective as C
+
+    if not C.is_initialized() or nranks <= 1:
+        return x
+    t = _T(x)
+    out: list = []
+    C.all_gather(out, t)
+    return jnp.concatenate([o._value for o in out], axis=0)
+
+
+def c_concat(x, ring_id=0, rank=0, nranks=1, use_calc_stream=True,
+             use_model_parallel=True):
+    """Gather along the LAST axis (the inverse of c_split for
+    column-parallel activations; reference c_concat_op)."""
+    from ...core.tensor import Tensor as _T
+    from ...distributed import collective as C
+
+    if not C.is_initialized() or nranks <= 1:
+        return x
+    out: list = []
+    C.all_gather(out, _T(x))
+    return jnp.concatenate([o._value for o in out], axis=-1)
+
+
+def c_scatter(x, ring_id=0, root=0, nranks=1, use_calc_stream=True):
+    from ...core.tensor import Tensor as _T
+    from ...distributed import collective as C
+
+    if not C.is_initialized() or nranks <= 1:
+        return x
+    parts = [_T(p) for p in jnp.split(x, nranks, axis=0)]
+    dst = _T(jnp.zeros_like(parts[0]._value))
+    C.scatter(dst, parts, src=root)  # per-rank result rides Shard(0)
+    return dst._value
+
+
+def c_sync_calc_stream(x):
+    return x  # PJRT orders device work per stream; nothing to sync
+
+
+def c_sync_comm_stream(x, ring_id=0):
+    return x
+
+
+def all_gather_op(x, ring_id=0, nranks=1):
+    return c_allgather(x, ring_id, nranks)
+
+
+def reduce_scatter_op(x, ring_id=0, nranks=1):
+    from ...core.tensor import Tensor as _T
+    from ...distributed import collective as C
+
+    if not C.is_initialized() or nranks <= 1:
+        return x
+    t = _T(x)
+    parts = [_T(p) for p in jnp.split(x, nranks, axis=0)]
+    out = _T(jnp.zeros_like(parts[0]._value))
+    C.reduce_scatter(out, parts)
+    return out._value

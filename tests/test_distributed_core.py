@@ -310,3 +310,25 @@ def test_shard_op_applies_constraints():
     np.testing.assert_allclose(np.asarray(out2._value),
                                np.asarray(x._value) @ np.asarray(y._value),
                                rtol=1e-3, atol=1e-5)
+
+
+def test_c_collective_ops_with_group():
+    """The c_* static-graph op family (ops/yaml/_impl.py) routes through
+    the eager collective layer when a group exists: c_concat gathers along
+    the LAST axis (column-parallel inverse of c_split), c_scatter's
+    per-rank result rides Shard(0)."""
+    from paddle_tpu.ops import generated as G
+
+    dist.init_parallel_env()
+    n = dist.get_world_size()
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+
+    r = G.c_allreduce_sum(x)  # replicated: identity
+    np.testing.assert_allclose(np.asarray(r._value), np.asarray(x._value))
+
+    cat = G.c_concat(x, nranks=n)
+    assert tuple(cat.shape) == (2, 4 * n)  # last-axis gather
+
+    big = paddle.to_tensor(np.arange(n * 3, dtype=np.float32).reshape(n, 3))
+    sc = G.c_scatter(big, nranks=n)
+    assert tuple(sc.shape) == (1, 3)
