@@ -308,10 +308,14 @@ class ShardingStage1(_ShardingStage):
 
 
 class ShardingStage2(ShardingStage1):
-    """ZeRO-2: + gradients are reduce-scattered.  Under jit, XLA derives the
-    reduce-scatter automatically from the sharded optimizer-state layout, so
-    stage 2 == stage 1 from the placement point of view (kept for API
-    parity)."""
+    """ZeRO-2: + gradients are reduce-scattered. Under jit, XLA derives the
+    reduce-scatter automatically from the sharded optimizer-state layout; in
+    EAGER mode stage 2 additionally installs a gradient re-placement hook
+    (optimizer._grad_transform) that puts each grad in the Shard(0) layout
+    before the update — the DTensor analog of the reference's grad
+    reduce-scatter (group_sharded_stage2.py)."""
+
+    shard_grad = True
 
 
 class ShardingStage3(_ShardingStage):
@@ -322,6 +326,7 @@ class ShardingStage3(_ShardingStage):
 
     shard_param = True
     shard_state = True
+    shard_grad = True
 
 
 def shard_optimizer(optimizer, shard_fn: Optional[_ShardingStage] = None):
@@ -345,6 +350,22 @@ def shard_optimizer(optimizer, shard_fn: Optional[_ShardingStage] = None):
             if p is None or p.ndim == 0:
                 continue
             shard_parameter(p, shard_fn.mesh, shard_fn._shard_dim0_spec(p))
+
+    if getattr(shard_fn, "shard_grad", False):
+        # fail at install time on a bad axis, not silently per-grad
+        _dim_names(shard_fn.mesh).index(shard_fn.axis)
+
+        def _reshard_grad(p, g):
+            placements = shard_fn._shard_dim0_spec(p)
+            if not any(pl.is_shard() for pl in placements if pl is not None):
+                return g  # indivisible dim 0: grad stays as placed
+            # through reshard(): resolves pending-Partial grads with the
+            # psum before the layout change (the one case device_put alone
+            # would silently skip)
+            return reshard(g if isinstance(g, Tensor) else Tensor(g),
+                           shard_fn.mesh, placements)
+
+        optimizer._grad_transform = _reshard_grad
 
     if getattr(shard_fn, "shard_state", False):
         inner_init = optimizer.init_param_state

@@ -33,6 +33,9 @@ class Optimizer:
         # per-parameter state: dict name -> dict of arrays, keyed by id(param)
         self._state: Dict[int, Dict[str, Any]] = {}
         self._global_step = 0
+        # optional (param, grad) -> grad hook installed by shard_optimizer
+        # stage >= 2: re-places gradients (reduce-scatter layout) pre-update
+        self._grad_transform = None
 
     # ------------------------- lr ------------------------------------------
     def get_lr(self) -> float:
@@ -89,6 +92,18 @@ class Optimizer:
         grads = [p._grad for p in params]
         if self._grad_clip is not None:
             grads = self._grad_clip(params, grads)
+        if self._grad_transform is not None:
+            grads = list(grads)
+            for i, (p, g) in enumerate(zip(params, grads)):
+                if g is None:
+                    continue
+                ng = self._grad_transform(p, g)
+                if ng is not g:
+                    grads[i] = ng
+                    # write back: releases the replicated grad buffer, so
+                    # the sharded layout is what survives the step (the
+                    # ZeRO-2 memory effect, not just a transient copy)
+                    p._grad = ng
         lr = self.get_lr()
         for p, g in zip(params, grads):
             if g is None or p.stop_gradient:
