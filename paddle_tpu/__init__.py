@@ -73,6 +73,9 @@ from . import geometric
 from . import audio
 from . import text
 from . import onnx
+from . import fft
+from . import signal
+from . import regularizer
 
 
 def save(obj, path, **kwargs):

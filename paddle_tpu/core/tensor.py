@@ -18,7 +18,7 @@ import numpy as np
 
 from ..autograd import tape as _tape
 from . import device as _device
-from .dtype import convert_dtype, is_floating
+from .dtype import convert_dtype, is_complex, is_floating
 
 
 class Tensor:
@@ -140,7 +140,8 @@ class Tensor:
         self._grad_slot = slot
 
     def _requires_grad(self) -> bool:
-        return (not self.stop_gradient) and is_floating(self.dtype)
+        return (not self.stop_gradient) and (is_floating(self.dtype)
+                                             or is_complex(self.dtype))
 
     def _accumulate_grad(self, g):
         if isinstance(g, Tensor):

@@ -1,0 +1,256 @@
+"""Discrete Fourier transforms (``paddle.fft`` analog).
+
+API surface of the reference's ``python/paddle/fft.py`` (fft/ifft, rfft/
+irfft, hfft/ihfft, their 2-D/N-D variants, fftfreq/rfftfreq and the shift
+helpers), routed through the three kernel-level ops the reference also
+uses — ``fft_c2c`` / ``fft_r2c`` / ``fft_c2r`` (paddle/phi/ops/yaml/
+ops.yaml) — which here lower onto XLA's native FFT HLO via ``jnp.fft``.
+All transforms are differentiable through the tape (complex tensors carry
+grad state since the VJP of an FFT is an FFT).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, to_tensor
+from .ops.registry import dispatch
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _check_norm(norm):
+    norm = norm or "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be 'forward', "
+            "'backward' or 'ortho'")
+    return norm
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _norm_axes(x, axes):
+    """Resolve possibly-negative axes against ``x`` and validate range."""
+    nd = len(x.shape)
+    out = []
+    for a in axes:
+        a = int(a)
+        if not -nd <= a < nd:
+            raise ValueError(f"axis {a} out of range for rank-{nd} input")
+        out.append(a % nd)
+    if len(set(out)) != len(out):
+        raise ValueError(f"duplicate fft axes {tuple(axes)}")
+    return tuple(out)
+
+
+def _1d_args(x, n, axis):
+    axes = _norm_axes(x, (axis,))
+    if n is not None and n < 1:
+        raise ValueError(f"invalid fft length n={n}")
+    s = (int(n),) if n is not None else None
+    return s, axes
+
+
+def _nd_args(x, s, axes, default_ndim=None):
+    """Resolve (s, axes) the way the reference's fftn/fft2 do."""
+    if axes is None:
+        if s is not None:
+            nd = len(x.shape)
+            axes = tuple(range(nd - len(s), nd))
+        elif default_ndim is not None:
+            axes = tuple(range(-default_ndim, 0))
+        else:
+            axes = tuple(range(len(x.shape)))
+    elif not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    axes = _norm_axes(x, axes)
+    if s is not None:
+        s = tuple(int(v) for v in s)
+        if len(s) != len(axes):
+            raise ValueError(
+                f"fft s {s} must match the number of axes {axes}")
+        if any(v < 1 for v in s):
+            raise ValueError(f"invalid fft shape s={s}")
+    return s, axes
+
+
+# ------------------------------------------------------------------ c2c
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _1d_args(x, n, axis)
+    return dispatch("fft_c2c", x, s=s, axes=axes,
+                    normalization=_check_norm(norm), forward=True)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _1d_args(x, n, axis)
+    return dispatch("fft_c2c", x, s=s, axes=axes,
+                    normalization=_check_norm(norm), forward=False)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes, default_ndim=2)
+    return dispatch("fft_c2c", x, s=s, axes=axes,
+                    normalization=_check_norm(norm), forward=True)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes, default_ndim=2)
+    return dispatch("fft_c2c", x, s=s, axes=axes,
+                    normalization=_check_norm(norm), forward=False)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes)
+    return dispatch("fft_c2c", x, s=s, axes=axes,
+                    normalization=_check_norm(norm), forward=True)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes)
+    return dispatch("fft_c2c", x, s=s, axes=axes,
+                    normalization=_check_norm(norm), forward=False)
+
+
+# ------------------------------------------------------------------ r2c
+
+def _r2c(x, s, axes, norm, forward):
+    if jnp.issubdtype(jnp.dtype(x.dtype), jnp.complexfloating):
+        raise TypeError("rfft/ihfft expect a real input; use fft/hfft for "
+                        f"complex inputs (got dtype {x.dtype})")
+    return dispatch("fft_r2c", x, s=s, axes=axes,
+                    normalization=_check_norm(norm), forward=forward,
+                    onesided=True)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _1d_args(x, n, axis)
+    return _r2c(x, s, axes, norm, forward=True)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _1d_args(x, n, axis)
+    return _r2c(x, s, axes, norm, forward=False)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes, default_ndim=2)
+    return _r2c(x, s, axes, norm, forward=True)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes, default_ndim=2)
+    return _r2c(x, s, axes, norm, forward=False)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes)
+    return _r2c(x, s, axes, norm, forward=True)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes)
+    return _r2c(x, s, axes, norm, forward=False)
+
+
+# ------------------------------------------------------------------ c2r
+
+def _c2r(x, s, axes, norm, forward, n):
+    last = n if n is not None else (s[-1] if s is not None else 0)
+    return dispatch("fft_c2r", x, s=s, axes=axes,
+                    normalization=_check_norm(norm), forward=forward,
+                    last_dim_size=int(last) if last else 0)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _1d_args(x, n, axis)
+    return _c2r(x, None, axes, norm, forward=False, n=n)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _1d_args(x, n, axis)
+    return _c2r(x, None, axes, norm, forward=True, n=n)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes, default_ndim=2)
+    return _c2r(x, s, axes, norm, forward=False, n=None)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes, default_ndim=2)
+    return _c2r(x, s, axes, norm, forward=True, n=None)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes)
+    return _c2r(x, s, axes, norm, forward=False, n=None)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _as_tensor(x)
+    s, axes = _nd_args(x, s, axes)
+    return _c2r(x, s, axes, norm, forward=True, n=None)
+
+
+# ------------------------------------------------------------- helpers
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    if n < 1:
+        raise ValueError(f"n must be positive, got {n}")
+    out = jnp.fft.fftfreq(int(n), float(d))
+    return Tensor(out.astype(jnp.dtype(dtype)) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    if n < 1:
+        raise ValueError(f"n must be positive, got {n}")
+    out = jnp.fft.rfftfreq(int(n), float(d))
+    return Tensor(out.astype(jnp.dtype(dtype)) if dtype else out)
+
+
+def _shift(x, axes, inverse):
+    x = _as_tensor(x)
+    if axes is None:
+        axes = tuple(range(len(x.shape)))
+    elif not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    axes = _norm_axes(x, axes)
+    shifts = tuple((-(x.shape[a] // 2) if inverse else x.shape[a] // 2)
+                   for a in axes)
+    return dispatch("roll", x, shifts=shifts, axis=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    return _shift(x, axes, inverse=False)
+
+
+def ifftshift(x, axes=None, name=None):
+    return _shift(x, axes, inverse=True)

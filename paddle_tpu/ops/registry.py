@@ -348,7 +348,8 @@ def record_call(name: str, fn: Callable, tensors: Sequence[Tensor]):
     wrapped = []
     for slot, v in enumerate(out_leaves):
         t = Tensor(v, stop_gradient=True)
-        if jnp.issubdtype(v.dtype, jnp.floating):
+        if (jnp.issubdtype(v.dtype, jnp.floating)
+                or jnp.issubdtype(v.dtype, jnp.complexfloating)):
             t.stop_gradient = False
             t._set_grad_node(node, slot)
         wrapped.append(t)
@@ -459,7 +460,8 @@ def _wrap_outputs(op: OpDef, out, recorded: bool, node=None):
     wrapped = []
     for slot, v in enumerate(out_leaves):
         t = Tensor(v, stop_gradient=True)
-        if recorded and jnp.issubdtype(v.dtype, jnp.floating):
+        if recorded and (jnp.issubdtype(v.dtype, jnp.floating)
+                         or jnp.issubdtype(v.dtype, jnp.complexfloating)):
             t.stop_gradient = False
             t._set_grad_node(node, slot)
             if retain_all:

@@ -521,27 +521,45 @@ def lamb_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
 # --------------------------------------------------------------------------
 
 def frame(x, frame_length, hop_length, axis=-1):
+    # axis=-1: [..., seq] -> [..., frame_length, num]
+    # axis=0:  [seq, ...] -> [num, frame_length, ...] (reference frame_kernel
+    # supports exactly these two ends)
+    if axis not in (-1, x.ndim - 1, 0):
+        raise ValueError(f"frame axis must be 0 or -1, got {axis}")
+    first = axis == 0 and x.ndim > 1
+    if first:
+        x = jnp.moveaxis(x, 0, -1)         # [..., seq]
     n = x.shape[-1]
     num = 1 + (n - frame_length) // hop_length
     idx = (jnp.arange(frame_length)[None, :]
            + hop_length * jnp.arange(num)[:, None])
     out = x[..., idx]                      # [..., num, frame_length]
-    if axis == -1 or axis == x.ndim:
-        out = jnp.swapaxes(out, -1, -2)    # paddle: [..., frame_length, num]
-    return out
+    if first:
+        return jnp.moveaxis(out, (-2, -1), (0, 1))  # [num, frame_length, ...]
+    return jnp.swapaxes(out, -1, -2)       # [..., frame_length, num]
 
 
 def overlap_add(x, hop_length, axis=-1):
+    # inverse of frame(): axis=-1 takes [..., frame_length, num]; axis=0
+    # takes [num, frame_length, ...] (the two reference layouts)
+    first = False
     if axis in (-1, x.ndim - 1):
-        xs = jnp.swapaxes(x, -1, -2)       # [..., num, frame_length]
+        xs = jnp.swapaxes(x, -1, -2)            # [..., num, frame_length]
+    elif axis == 0 and x.ndim == 2:
+        xs = x                                  # already [num, frame_length]
+    elif axis == 0:
+        first = True
+        xs = jnp.moveaxis(x, (0, 1), (-2, -1))  # [..., num, frame_length]
     else:
-        xs = x
+        raise ValueError(f"overlap_add axis must be 0 or -1, got {axis}")
     num, fl = xs.shape[-2], xs.shape[-1]
     n = fl + hop_length * (num - 1)
     ref = jnp.zeros(xs.shape[:-2] + (n,), x.dtype)
     _, vjp = jax.vjp(lambda sig: jnp.swapaxes(
         frame(sig, fl, hop_length, axis=-1), -1, -2), ref)
     (out,) = vjp(xs)
+    if first:
+        out = jnp.moveaxis(out, -1, 0)          # [seq, ...]
     return out
 
 
@@ -557,6 +575,67 @@ def stft(x, n_fft, hop_length=None, window=None, center=True,
         fr = fr * window
     spec = jnp.fft.rfft(fr, axis=-1) if onesided else jnp.fft.fft(fr, axis=-1)
     return jnp.swapaxes(spec, -1, -2)      # [..., freq, num]
+
+
+# --------------------------------------------------------------------------
+# fft family (ref: paddle/phi/kernels/funcs/fft.h FFTC2CFunctor/R2C/C2R and
+# the op triple in paddle/phi/ops/yaml/ops.yaml fft_c2c/fft_r2c/fft_c2r;
+# public API python/paddle/fft.py).  Unlike the round-1 lambdas these carry
+# the full schema: s-resize, per-axis norm, forward/inverse flag, onesided
+# spectra and the hermitian (hfft) forward-c2r path.
+# --------------------------------------------------------------------------
+
+def _swap_norm(norm):
+    # hermitian transforms reuse the opposite-direction kernel; "backward"
+    # and "forward" scaling swap while "ortho" is self-dual
+    return {"backward": "forward", "forward": "backward"}.get(norm, norm)
+
+
+def _as_complex(x):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return x
+    # float64 promotes to complex128 when x64 is on (reference parity)
+    return x.astype(jnp.result_type(x.dtype, jnp.complex64))
+
+
+def fft_c2c(x, s=None, axes=None, normalization="backward", forward=True):
+    s = tuple(s) if s is not None else None
+    axes = tuple(axes) if axes is not None else None
+    f = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return f(_as_complex(x), s=s, axes=axes, norm=normalization)
+
+
+def fft_r2c(x, s=None, axes=None, normalization="backward", forward=True,
+            onesided=True):
+    if not onesided:
+        # full-spectrum transform of a real signal == c2c on the cast input
+        return fft_c2c(x, s, axes, normalization, forward)
+    s = tuple(s) if s is not None else None
+    axes = tuple(axes) if axes is not None else None
+    # inverse-direction r2c (ihfft family): conj(rfft) with swapped scaling,
+    # the numpy identity ihfft(a, n) == conj(rfft(a, n)) / n
+    norm = normalization if forward else _swap_norm(normalization)
+    out = jnp.fft.rfftn(x, s=s, axes=axes, norm=norm)
+    return out if forward else jnp.conj(out)
+
+
+def fft_c2r(x, s=None, axes=None, normalization="backward", forward=False,
+            last_dim_size=0):
+    axes = tuple(axes) if axes is not None else tuple(range(x.ndim))
+    n_out = int(last_dim_size) or 2 * (x.shape[axes[-1]] - 1)
+    if s is None:
+        s = tuple(x.shape[a] for a in axes[:-1]) + (n_out,)
+    else:
+        s = tuple(s[:-1]) + (int(s[-1]) or n_out,)
+    x = _as_complex(x)
+    if forward:
+        # hfft family: hfftn(x, norm) == irfftn(conj(x), swap(norm)) — the
+        # conj turns each leading-axis inverse c2c into a forward c2c and
+        # the last-axis inverse c2r into the hermitian forward transform,
+        # with scaling balanced by the norm swap
+        return jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes,
+                              norm=_swap_norm(normalization))
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=normalization)
 
 
 # --------------------------------------------------------------------------

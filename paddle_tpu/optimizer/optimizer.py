@@ -28,6 +28,17 @@ class Optimizer:
                  grad_clip=None, name=None):
         self._lr = learning_rate
         self._parameters = list(parameters) if parameters is not None else []
+        # weight_decay accepts a float (decoupled/L2 per optimizer) or a
+        # paddle.regularizer instance (reference regularizer.py precedence:
+        # a per-parameter ``param.regularizer`` overrides this one, so the
+        # instance must stay a regularizer — folding L2Decay into the float
+        # path would keep applying it under a per-param override)
+        from ..regularizer import WeightDecayRegularizer
+
+        self._regularizer = None
+        if isinstance(weight_decay, WeightDecayRegularizer):
+            self._regularizer = weight_decay
+            weight_decay = None
         self._weight_decay = 0.0 if weight_decay is None else weight_decay
         self._grad_clip = grad_clip
         # per-parameter state: dict name -> dict of arrays, keyed by id(param)
@@ -64,8 +75,14 @@ class Optimizer:
 
     def apply(self, params: Dict[str, Any], grads: Dict[str, Any],
               state: Dict[str, Dict[str, Any]], lr, step: int = 0,
-              decay_mask: Optional[Dict[str, bool]] = None):
-        """Pure pytree update used under jit. Returns (new_params, new_state)."""
+              decay_mask: Optional[Dict[str, bool]] = None,
+              regularizers: Optional[Dict[str, Any]] = None):
+        """Pure pytree update used under jit. Returns (new_params, new_state).
+
+        ``regularizers`` carries per-parameter regularizer overrides (the
+        functional analog of ``param.regularizer`` on the eager path, same
+        precedence: per-param beats the optimizer-level one).
+        """
         new_params, new_state = {}, {}
         for k, v in params.items():
             g = grads.get(k)
@@ -73,7 +90,14 @@ class Optimizer:
                 new_params[k] = v
                 new_state[k] = state.get(k, {})
                 continue
-            if decay_mask is not None and not decay_mask.get(k, True):
+            masked = decay_mask is not None and not decay_mask.get(k, True)
+            has_override = regularizers is not None and k in regularizers
+            reg = regularizers[k] if has_override else self._regularizer
+            if reg is not None and not masked:
+                g = g + reg._apply(v).astype(g.dtype)
+            if masked or has_override:
+                # per-param override also replaces the float weight_decay
+                # (same precedence as the eager path)
                 saved, self._weight_decay = self._weight_decay, 0.0
                 try:
                     nv, ns = self.update(v, g, state.get(k, self.init_param_state(v)), lr, step)
@@ -89,7 +113,8 @@ class Optimizer:
     def step(self):
         self._global_step += 1
         params = self._parameters
-        grads = [p._grad for p in params]
+        accum = [p._grad for p in params]
+        grads = accum
         if self._grad_clip is not None:
             grads = self._grad_clip(params, grads)
         if self._grad_transform is not None:
@@ -102,8 +127,12 @@ class Optimizer:
                     grads[i] = ng
                     # write back: releases the replicated grad buffer, so
                     # the sharded layout is what survives the step (the
-                    # ZeRO-2 memory effect, not just a transient copy)
-                    p._grad = ng
+                    # ZeRO-2 memory effect, not just a transient copy).
+                    # p.grad must keep the ACCUMULATED gradient, so when a
+                    # clip ran, reshard the pre-clip value instead of
+                    # leaking clipped values into p.grad.
+                    og = accum[i]
+                    p._grad = ng if og is g else self._grad_transform(p, og)
         lr = self.get_lr()
         for p, g in zip(params, grads):
             if g is None or p.stop_gradient:
@@ -112,7 +141,12 @@ class Optimizer:
             if pid not in self._state:
                 self._state[pid] = self.init_param_state(p._value)
             no_decay = getattr(p, "no_weight_decay", False)
-            if no_decay:
+            param_reg = getattr(p, "regularizer", None)
+            # a per-parameter regularizer REPLACES every optimizer-level
+            # decay (regularizer instance and float weight_decay alike) —
+            # the reference's ParamAttr precedence rule
+            suppress_wd = no_decay or param_reg is not None
+            if suppress_wd:
                 saved, self._weight_decay = self._weight_decay, 0.0
             p_lr = lr
             ratio_fn = getattr(self, "_lr_ratio_fn", None)
@@ -120,10 +154,13 @@ class Optimizer:
                 p_lr = lr * float(ratio_fn(p))
             try:
                 gv = g._value if isinstance(g, Tensor) else g
+                reg = param_reg if param_reg is not None else self._regularizer
+                if reg is not None and not no_decay:
+                    gv = gv + reg._apply(p._value).astype(gv.dtype)
                 new_v, new_s = self.update(p._value, gv.astype(p._value.dtype),
                                            self._state[pid], p_lr, self._global_step)
             finally:
-                if no_decay:
+                if suppress_wd:
                     self._weight_decay = saved
             p.set_value(new_v)
             self._state[pid] = new_s

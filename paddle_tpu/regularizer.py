@@ -1,0 +1,46 @@
+"""Weight-decay regularizers (``paddle.regularizer`` analog).
+
+Reference: ``python/paddle/regularizer.py`` — ``L1Decay``/``L2Decay``
+append a decay term to each parameter's gradient before the optimizer
+update.  Here the term is added inside the (jit-compiled) update, either
+globally via ``Optimizer(weight_decay=L1Decay(...))`` or per-parameter by
+setting ``param.regularizer`` (the ``ParamAttr(regularizer=...)`` analog);
+a per-parameter setting overrides the optimizer-level one, matching the
+reference's precedence rule.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def _apply(self, value):
+        """Return the gradient contribution d(penalty)/d(value)."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """Lasso: penalty = coeff * sum|w|, gradient term coeff * sign(w)."""
+
+    def _apply(self, value):
+        return (self._coeff * jnp.sign(value)).astype(value.dtype)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """Ridge: penalty = 0.5 * coeff * sum w^2, gradient term coeff * w."""
+
+    def _apply(self, value):
+        return (self._coeff * value).astype(value.dtype)

@@ -1,0 +1,161 @@
+"""Short-time Fourier transforms (``paddle.signal`` analog).
+
+Reference: ``python/paddle/signal.py`` — ``frame``/``overlap_add`` (over
+the phi kernels ``frame_kernel.cc``/``overlap_add_kernel.cc``) plus
+``stft``/``istft``.  The TPU build composes the already-registered
+``frame``/``overlap_add``/``fft_*`` ops, so everything here is
+differentiable and jit-traceable; XLA fuses the windowing into the FFT's
+pre-pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, to_tensor
+from .ops.registry import dispatch
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames: [..., frame_length, num]."""
+    x = _as_tensor(x)
+    if frame_length < 1 or hop_length < 1:
+        raise ValueError("frame_length and hop_length must be positive, got "
+                         f"{frame_length}, {hop_length}")
+    seq = x.shape[0] if axis == 0 else x.shape[-1]
+    if frame_length > seq:
+        raise ValueError(f"frame_length {frame_length} exceeds input size "
+                         f"{seq} along axis {axis}")
+    return dispatch("frame", x, frame_length=int(frame_length),
+                    hop_length=int(hop_length), axis=axis)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of :func:`frame` (summing overlaps)."""
+    x = _as_tensor(x)
+    if hop_length < 1:
+        raise ValueError(f"hop_length must be positive, got {hop_length}")
+    return dispatch("overlap_add", x, hop_length=int(hop_length), axis=axis)
+
+
+def _prep_window(window, win_length, n_fft, dtype):
+    if window is None:
+        w = jnp.ones((win_length,), jnp.dtype(dtype))
+    else:
+        w = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+        if w.shape != (win_length,):
+            raise ValueError(f"window must have shape ({win_length},), got "
+                             f"{tuple(w.shape)}")
+        w = w.astype(jnp.dtype(dtype))
+    if win_length < n_fft:                    # center the window in n_fft
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    return Tensor(w)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """[batch?, n] -> complex [batch?, n_fft//2+1 (or n_fft), frames]."""
+    x = _as_tensor(x)
+    if len(x.shape) not in (1, 2):
+        raise ValueError(f"stft expects a 1-D or 2-D input, got rank "
+                         f"{len(x.shape)}")
+    hop = int(hop_length) if hop_length else n_fft // 4
+    wl = int(win_length) if win_length else int(n_fft)
+    if not 0 < wl <= n_fft:
+        raise ValueError(f"win_length {wl} must be in (0, n_fft={n_fft}]")
+    if jnp.issubdtype(jnp.dtype(x.dtype), jnp.complexfloating):
+        if onesided:
+            raise ValueError("onesided stft requires a real input")
+        rdtype = "float64" if jnp.dtype(x.dtype) == jnp.complex128 \
+            else "float32"
+    else:
+        rdtype = x.dtype
+    w = _prep_window(window, wl, int(n_fft), rdtype)
+    if center:
+        x = dispatch("pad", x, pad=[n_fft // 2, n_fft // 2], mode=pad_mode)
+    fr = frame(x, int(n_fft), hop)                 # [..., n_fft, num]
+    fr = dispatch("transpose", fr, perm=_swap_last2(len(fr.shape)))
+    fr = fr * w                                    # [..., num, n_fft]
+    if onesided:
+        spec = dispatch("fft_r2c", fr, axes=(-1,), forward=True,
+                        onesided=True)
+    else:
+        spec = dispatch("fft_c2c", fr, axes=(-1,), forward=True)
+    if normalized:
+        spec = spec * float(n_fft) ** -0.5
+    return dispatch("transpose", spec, perm=_swap_last2(len(spec.shape)))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse stft via windowed overlap-add with envelope normalization."""
+    x = _as_tensor(x)
+    if len(x.shape) not in (2, 3):
+        raise ValueError(f"istft expects [batch?, freq, frames], got rank "
+                         f"{len(x.shape)}")
+    if return_complex and onesided:
+        raise ValueError("return_complex=True requires a two-sided spectrum "
+                         "(onesided=False); a onesided istft is real by "
+                         "construction")
+    hop = int(hop_length) if hop_length else n_fft // 4
+    wl = int(win_length) if win_length else int(n_fft)
+    n_freq = x.shape[-2]
+    if onesided and n_freq != n_fft // 2 + 1:
+        raise ValueError(f"onesided istft expects {n_fft // 2 + 1} freq "
+                         f"bins, got {n_freq}")
+    if not onesided and n_freq != n_fft:
+        raise ValueError(f"two-sided istft expects {n_fft} freq bins, got "
+                         f"{n_freq}")
+    spec = dispatch("transpose", x, perm=_swap_last2(len(x.shape)))
+    if normalized:
+        spec = spec * float(n_fft) ** 0.5
+    if onesided:
+        fr = dispatch("fft_c2r", spec, axes=(-1,), forward=False,
+                      last_dim_size=int(n_fft))     # real [..., num, n_fft]
+    else:
+        fr = dispatch("fft_c2c", spec, axes=(-1,), forward=False)
+        if not return_complex:
+            fr = dispatch("real", fr)
+    w = _prep_window(window, wl, int(n_fft),
+                     "float32" if "complex" in str(fr.dtype) else fr.dtype)
+    fr = fr * w
+    fr = dispatch("transpose", fr, perm=_swap_last2(len(fr.shape)))
+    sig = overlap_add(fr, hop)                      # [..., n]
+    # window-square envelope for COLA normalization
+    num = x.shape[-1]
+    env_frames = jnp.tile((w._value.astype(jnp.float32) ** 2)[:, None],
+                          (1, num))
+    env = dispatch("overlap_add", Tensor(env_frames), hop_length=hop)
+    env_v = jnp.where(jnp.abs(env._value) > 1e-11, env._value, 1.0)
+    sig = sig / Tensor(env_v.astype(jnp.float32))
+    start = n_fft // 2 if center else 0
+    total = sig.shape[-1]
+    # the true signal ends before the right center-pad: samples past it are
+    # reconstructed padding, not data (the reference errors here too)
+    avail = (total - n_fft // 2 if center else total) - start
+    if length is not None:
+        if int(length) > avail:
+            raise ValueError(f"requested length {length} exceeds "
+                             f"reconstructed signal length {avail}")
+        stop = start + int(length)
+    else:
+        stop = start + avail
+    idx = (slice(None),) * (len(sig.shape) - 1) + (slice(start, stop),)
+    return dispatch("slice", sig, idx)
+
+
+def _swap_last2(rank):
+    perm = list(range(rank))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    return tuple(perm)

@@ -1,0 +1,279 @@
+"""paddle.fft / paddle.signal / paddle.regularizer tests.
+
+Mirrors the reference's test/fft/test_fft.py (numpy-golden parity across
+norm modes and transform kinds) and test/legacy_test/test_stft_op.py /
+test_istft_op.py (torch cross-check + round-trip).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.default_rng(7)
+
+
+def _rand(shape, complex_=False):
+    if complex_:
+        return (RNG.standard_normal(shape) +
+                1j * RNG.standard_normal(shape)).astype("complex64")
+    return RNG.standard_normal(shape).astype("float32")
+
+
+NORMS = ["backward", "ortho", "forward"]
+
+
+class TestFFT:
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_fft_ifft(self, norm):
+        x = _rand((4, 8), complex_=True)
+        np.testing.assert_allclose(
+            paddle.fft.fft(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.fft(x, norm=norm), atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.fft.ifft(paddle.to_tensor(x), axis=0, norm=norm).numpy(),
+            np.fft.ifft(x, axis=0, norm=norm), atol=1e-4)
+
+    def test_fft_n_resize(self):
+        x = _rand((8,))
+        for n in (5, 12):
+            np.testing.assert_allclose(
+                paddle.fft.fft(paddle.to_tensor(x), n=n).numpy(),
+                np.fft.fft(x, n=n), atol=1e-4)
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_fft2_fftn(self, norm):
+        x = _rand((3, 4, 6), complex_=True)
+        np.testing.assert_allclose(
+            paddle.fft.fft2(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.fft2(x, norm=norm), atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.fft.ifftn(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.ifftn(x, norm=norm), atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.fft.fftn(paddle.to_tensor(x), s=(2, 5),
+                            axes=(0, 2), norm=norm).numpy(),
+            np.fft.fftn(x, s=(2, 5), axes=(0, 2), norm=norm), atol=1e-3)
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_rfft_family(self, norm):
+        x = _rand((4, 10))
+        np.testing.assert_allclose(
+            paddle.fft.rfft(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.rfft(x, norm=norm), atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.fft.rfft2(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.rfft2(x, norm=norm), atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.fft.rfftn(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.rfftn(x, norm=norm), atol=1e-3)
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_ihfft(self, norm):
+        x = _rand((10,))
+        np.testing.assert_allclose(
+            paddle.fft.ihfft(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.ihfft(x, norm=norm), atol=1e-4)
+
+    @pytest.mark.parametrize("norm", NORMS)
+    def test_irfft_hfft(self, norm):
+        x = _rand((6,), complex_=True)
+        np.testing.assert_allclose(
+            paddle.fft.irfft(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.irfft(x, norm=norm), atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.fft.hfft(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.hfft(x, norm=norm), atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.fft.hfft(paddle.to_tensor(x), n=16, norm=norm).numpy(),
+            np.fft.hfft(x, n=16, norm=norm), atol=1e-3)
+
+    def test_hfft2_matches_composition(self):
+        # numpy has no hfft2; golden = c2c over leading axis then hfft last
+        x = _rand((4, 5), complex_=True)
+        want = np.fft.hfft(np.fft.fft(x, axis=0), axis=-1)
+        np.testing.assert_allclose(
+            paddle.fft.hfft2(paddle.to_tensor(x)).numpy(), want, atol=1e-2)
+
+    def test_rfft_irfft_roundtrip(self):
+        x = _rand((3, 16))
+        t = paddle.fft.irfft(paddle.fft.rfft(paddle.to_tensor(x)), n=16)
+        np.testing.assert_allclose(t.numpy(), x, atol=1e-4)
+
+    def test_helpers(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(9, 0.5).numpy(),
+                                   np.fft.fftfreq(9, 0.5))
+        np.testing.assert_allclose(paddle.fft.rfftfreq(9, 0.5).numpy(),
+                                   np.fft.rfftfreq(9, 0.5))
+        x = _rand((4, 6))
+        np.testing.assert_allclose(
+            paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+            np.fft.fftshift(x))
+        np.testing.assert_allclose(
+            paddle.fft.ifftshift(paddle.to_tensor(x), axes=1).numpy(),
+            np.fft.ifftshift(x, axes=1))
+
+    def test_validation(self):
+        x = paddle.to_tensor(_rand((4, 4)))
+        with pytest.raises(ValueError):
+            paddle.fft.fft(x, norm="bogus")
+        with pytest.raises(ValueError):
+            paddle.fft.fftn(x, axes=(0, 0))
+        with pytest.raises(ValueError):
+            paddle.fft.fft(x, axis=5)
+        with pytest.raises(TypeError):
+            paddle.fft.rfft(paddle.to_tensor(_rand((4,), complex_=True)))
+
+    def test_grad_through_fft(self):
+        # Parseval: d/dx sum|fft(x)|^2 = 2 N x
+        x = _rand((8,))
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        y = paddle.fft.fft(t)
+        loss = (paddle.abs(y) ** 2).sum()
+        loss.backward()
+        np.testing.assert_allclose(t.grad.numpy(), 2 * 8 * x, atol=1e-2)
+
+    def test_grad_through_rfft_irfft(self):
+        x = _rand((12,))
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        rec = paddle.fft.irfft(paddle.fft.rfft(t), n=12)
+        (rec ** 2).sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), 2 * x, atol=1e-3)
+
+
+class TestSignal:
+    def test_frame_overlap_add_inverse(self):
+        x = _rand((2, 32))
+        fr = paddle.signal.frame(paddle.to_tensor(x), 8, 8)  # non-overlapping
+        rec = paddle.signal.overlap_add(fr, 8)
+        np.testing.assert_allclose(rec.numpy(), x, atol=1e-6)
+
+    def test_frame_axis0(self):
+        # axis=0 frames the leading axis: [seq, ...] -> [num, frame_len, ...]
+        x = _rand((16, 2))
+        fr = paddle.signal.frame(paddle.to_tensor(x), 4, 2, axis=0)
+        assert tuple(fr.shape) == (7, 4, 2)
+        np.testing.assert_allclose(fr.numpy()[3], x[6:10], atol=1e-6)
+        # overlapping (hop < frame_length) axis-0 overlap-add vs manual sum
+        rec = paddle.signal.overlap_add(fr, 2, axis=0).numpy()
+        want = np.zeros((16, 2), "float32")
+        for i in range(fr.shape[0]):
+            want[2 * i:2 * i + 4] += fr.numpy()[i]
+        np.testing.assert_allclose(rec, want, atol=1e-6)
+        # non-overlapping round-trip
+        fr2 = paddle.signal.frame(paddle.to_tensor(x), 4, 4, axis=0)
+        rec2 = paddle.signal.overlap_add(fr2, 4, axis=0)
+        np.testing.assert_allclose(rec2.numpy(), x, atol=1e-6)
+
+    def test_istft_excess_length_rejected(self):
+        sig = _rand((32,))
+        S = paddle.signal.stft(paddle.to_tensor(sig), n_fft=8, hop_length=2)
+        with pytest.raises(ValueError):
+            paddle.signal.istft(S, n_fft=8, hop_length=2, length=34)
+
+    def test_stft_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        sig = _rand((2, 64))
+        w = paddle.audio.functional.get_window("hann", 16)
+        S = paddle.signal.stft(paddle.to_tensor(sig), n_fft=16, hop_length=4,
+                               window=w)
+        St = torch.stft(torch.from_numpy(sig), n_fft=16, hop_length=4,
+                        window=torch.hann_window(16), center=True,
+                        pad_mode="reflect", return_complex=True)
+        np.testing.assert_allclose(S.numpy(), St.numpy(), atol=1e-3)
+
+    @pytest.mark.parametrize("onesided", [True, False])
+    def test_stft_istft_roundtrip(self, onesided):
+        sig = _rand((64,))
+        w = paddle.audio.functional.get_window("hann", 16)
+        S = paddle.signal.stft(paddle.to_tensor(sig), n_fft=16, hop_length=4,
+                               window=w, onesided=onesided)
+        rec = paddle.signal.istft(S, n_fft=16, hop_length=4, window=w,
+                                  onesided=onesided, length=64)
+        np.testing.assert_allclose(rec.numpy(), sig, atol=1e-3)
+
+    def test_stft_normalized_win_length(self):
+        sig = _rand((48,))
+        w = paddle.audio.functional.get_window("hann", 8)
+        S = paddle.signal.stft(paddle.to_tensor(sig), n_fft=16, hop_length=4,
+                               win_length=8, window=w, normalized=True)
+        rec = paddle.signal.istft(S, n_fft=16, hop_length=4, win_length=8,
+                                  window=w, normalized=True, length=48)
+        np.testing.assert_allclose(rec.numpy(), sig, atol=1e-3)
+
+    def test_istft_grad(self):
+        sig = _rand((40,))
+        t = paddle.to_tensor(sig)
+        t.stop_gradient = False
+        S = paddle.signal.stft(t, n_fft=8, hop_length=2)
+        rec = paddle.signal.istft(S, n_fft=8, hop_length=2, length=40)
+        (rec ** 2).sum().backward()
+        # perfect-reconstruction stft: gradient of sum(x_rec^2) is 2x
+        np.testing.assert_allclose(t.grad.numpy(), 2 * sig, atol=1e-2)
+
+
+class TestRegularizer:
+    def _train(self, weight_decay):
+        paddle.seed(0)
+        w = paddle.nn.Parameter(np.ones((3,), "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                                   weight_decay=weight_decay)
+        loss = (w * 0.0).sum()  # zero data gradient: isolates the decay term
+        loss.backward()
+        opt.step()
+        return w.numpy()
+
+    def test_l2_decay(self):
+        got = self._train(paddle.regularizer.L2Decay(0.5))
+        # grad = 0 + 0.5 * w -> w = 1 - 0.1 * 0.5
+        np.testing.assert_allclose(got, np.full((3,), 0.95, "float32"),
+                                   atol=1e-6)
+
+    def test_l1_decay(self):
+        got = self._train(paddle.regularizer.L1Decay(0.5))
+        # grad = 0.5 * sign(w) -> w = 1 - 0.05
+        np.testing.assert_allclose(got, np.full((3,), 0.95, "float32"),
+                                   atol=1e-6)
+
+    def test_per_param_overrides_global(self):
+        # the per-param L1 must REPLACE the optimizer-level L2, not stack
+        w = paddle.nn.Parameter(np.ones((2,), "float32"))
+        w.regularizer = paddle.regularizer.L1Decay(0.5)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                                   weight_decay=paddle.regularizer.L2Decay(0.5))
+        (w * 0.0).sum().backward()
+        opt.step()
+        # only the L1 term: w = 1 - 0.1 * 0.5 * sign(1) = 0.95
+        np.testing.assert_allclose(w.numpy(), np.full((2,), 0.95, "float32"),
+                                   atol=1e-6)
+
+    def test_per_param_overrides_float_weight_decay(self):
+        # float weight_decay must also be suppressed by param.regularizer
+        w = paddle.nn.Parameter(np.ones((2,), "float32"))
+        w.regularizer = paddle.regularizer.L1Decay(0.5)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                                   weight_decay=0.3)
+        (w * 0.0).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), np.full((2,), 0.95, "float32"),
+                                   atol=1e-6)
+
+    def test_istft_return_complex_onesided_rejected(self):
+        S = paddle.signal.stft(paddle.to_tensor(_rand((32,))), n_fft=8,
+                               hop_length=2)
+        with pytest.raises(ValueError):
+            paddle.signal.istft(S, n_fft=8, hop_length=2,
+                                return_complex=True)
+
+    def test_functional_apply_path(self):
+        import jax.numpy as jnp
+
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   weight_decay=paddle.regularizer.L2Decay(0.5))
+        params = {"w": jnp.ones((3,))}
+        grads = {"w": jnp.zeros((3,))}
+        new_p, _ = opt.apply(params, grads, {"w": {}}, 0.1)
+        np.testing.assert_allclose(np.asarray(new_p["w"]),
+                                   np.full((3,), 0.95), atol=1e-6)
