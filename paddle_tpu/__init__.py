@@ -76,6 +76,10 @@ from . import onnx
 from . import fft
 from . import signal
 from . import regularizer
+from . import hub
+from . import reader
+from . import cost_model
+from .batch import batch
 
 
 def save(obj, path, **kwargs):

@@ -1,0 +1,148 @@
+"""Op cost model (``paddle.cost_model`` analog).
+
+Reference: ``python/paddle/cost_model/cost_model.py`` — a ``CostModel``
+that serves per-op latencies to the auto-parallel planner from a
+benchmark table (``static_op_benchmark.json``).  The TPU build measures
+ops live against the current backend (each op is one cached XLA
+executable, so a timed run is cheap and exact for the deployed chip) and
+falls back to an MXU/HBM roofline estimate when asked not to execute.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["CostModel"]
+
+# v5e-class defaults; overridable per CostModel instance
+_PEAK_BF16_FLOPS = 197e12
+_HBM_BYTES_PER_S = 819e9
+
+
+class CostModel:
+    def __init__(self, peak_flops: float = _PEAK_BF16_FLOPS,
+                 hbm_bandwidth: float = _HBM_BYTES_PER_S,
+                 cache_path: Optional[str] = None):
+        self.peak_flops = peak_flops
+        self.hbm_bandwidth = hbm_bandwidth
+        self._cache: Dict[str, float] = {}
+        self._cache_path = cache_path
+        if cache_path and os.path.isfile(cache_path):
+            with open(cache_path) as f:
+                self._cache = json.load(f)
+
+    # ------------------------------------------------------------- measure
+    def measure_op(self, op_name: str,
+                   input_shapes: Sequence[Tuple[int, ...]],
+                   dtype: str = "float32", warmup: int = 3, iters: int = 10,
+                   **op_kwargs: Any) -> float:
+        """Median wall time (seconds) of one jitted execution of the
+        registered op on the current default backend."""
+        key = json.dumps([op_name, [list(s) for s in input_shapes], dtype,
+                          sorted(op_kwargs.items())], default=str)
+        if key in self._cache:
+            return self._cache[key]
+        import jax
+
+        from ..ops.registry import get_op
+
+        fn = get_op(op_name).fn
+        rng = np.random.default_rng(0)
+        args = [jax.numpy.asarray(rng.standard_normal(s).astype(dtype))
+                for s in input_shapes]
+        jitted = jax.jit(lambda *a: fn(*a, **op_kwargs))
+        jax.block_until_ready(jitted(*args))  # compile
+        for _ in range(warmup):
+            jax.block_until_ready(jitted(*args))
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jitted(*args))
+            times.append(time.perf_counter() - t0)
+        t = float(np.median(times))
+        self._cache[key] = t
+        self._flush()
+        return t
+
+    def get_static_op_time(self, op_name: str, forward: bool = True,
+                           dtype: str = "float32",
+                           input_shapes: Optional[Sequence] = None) -> Dict:
+        """Reference-shaped accessor: {"op_time": ms} (cost_model.py
+        get_static_op_time).  Backward ops are timed as fwd+vjp."""
+        shapes = input_shapes or [(1024, 1024), (1024, 1024)]
+        from ..ops.registry import get_op
+
+        get_op(op_name)  # unknown op names must raise, not fabricate a time
+        try:
+            if forward:
+                t = self.measure_op(op_name, shapes, dtype)
+            else:
+                t = self._measure_grad(op_name, shapes, dtype)
+        except Exception:  # op not measurable with generic float inputs
+            # (int-id ops, list-input ops...): serve the roofline estimate
+            t = self.estimate_elementwise_time(
+                int(np.prod(shapes[0])), np.dtype(dtype).itemsize)
+        return {"op_time": t * 1e3, "op_name": op_name, "forward": forward}
+
+    def _measure_grad(self, op_name, input_shapes, dtype):
+        import jax
+
+        from ..ops.registry import get_op
+
+        fn = get_op(op_name).fn
+        rng = np.random.default_rng(0)
+        args = [jax.numpy.asarray(rng.standard_normal(s).astype(dtype))
+                for s in input_shapes]
+
+        def loss(*a):
+            out = fn(*a)
+            leaves = jax.tree_util.tree_leaves(out)
+            return sum(jax.numpy.sum(jax.numpy.real(l)) for l in leaves)
+
+        g = jax.jit(jax.grad(loss, argnums=tuple(range(len(args)))))
+        jax.block_until_ready(g(*args))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            jax.block_until_ready(g(*args))
+        return (time.perf_counter() - t0) / 5
+
+    # ------------------------------------------------------------ estimate
+    def estimate_matmul_time(self, m: int, n: int, k: int,
+                             bytes_per_el: int = 2) -> float:
+        """MXU/HBM roofline: max(compute, memory) seconds."""
+        flops = 2.0 * m * n * k
+        bytes_moved = bytes_per_el * (m * k + k * n + m * n)
+        return max(flops / self.peak_flops,
+                   bytes_moved / self.hbm_bandwidth)
+
+    def estimate_elementwise_time(self, numel: int,
+                                  bytes_per_el: int = 4) -> float:
+        """HBM-bound: read + write each element once."""
+        return 2.0 * numel * bytes_per_el / self.hbm_bandwidth
+
+    def estimate_collective_time(self, bytes_total: int, n_devices: int,
+                                 ici_bytes_per_s: float = 45e9,
+                                 kind: str = "all_reduce") -> float:
+        """Ring-model ICI estimate (scaling-book recipe): all_reduce moves
+        2(n-1)/n of the data, all_gather/reduce_scatter (n-1)/n."""
+        if n_devices <= 1:
+            return 0.0
+        frac = {"all_reduce": 2.0, "all_gather": 1.0,
+                "reduce_scatter": 1.0, "all_to_all": 1.0}[kind]
+        return frac * (n_devices - 1) / n_devices * bytes_total / ici_bytes_per_s
+
+    # ------------------------------------------------------------- persist
+    def _flush(self):
+        if self._cache_path:
+            with open(self._cache_path, "w") as f:
+                json.dump(self._cache, f)
+
+    def static_cost_data(self) -> Dict[str, float]:
+        """The measured table (reference: static_op_benchmark.json)."""
+        return dict(self._cache)

@@ -29,6 +29,7 @@ from .collective import (Group, all_gather, all_reduce, alltoall, barrier,
 from . import auto_parallel
 from . import fleet
 from . import checkpoint
+from . import ps
 from .checkpoint import load_state_dict, save_state_dict
 from .spawn import spawn
 from .auto_parallel import (ShardingStage1, ShardingStage2, ShardingStage3,

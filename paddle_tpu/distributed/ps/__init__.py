@@ -1,0 +1,325 @@
+"""Parameter-server stack (``paddle.distributed.ps`` analog).
+
+Reference: ``paddle/fluid/distributed/ps`` (~40 kLoC brpc C++) driven by
+``python/paddle/distributed/ps/the_one_ps.py`` — dense/sparse tables for
+trillion-parameter recommendation models.
+
+Scope decision (SURVEY §2.10 #19): the GPU/heter PS serving stack is out
+of the TPU north star, but the *capability* — sparse embedding tables
+living on server hosts, workers pulling rows and pushing gradients — is
+kept as a small, working implementation over the framework's own control
+plane: the native TCPStore rendezvous + ``paddle.distributed.rpc``
+(cloudpickle calls).  Dense model math stays on TPU; the sparse tables
+are host-side numpy, exactly the split the reference uses (PS tables are
+CPU-resident there too).
+
+Topology: ``world = trainers ++ pservers`` in one rpc gang; trainer i is
+``trainer{i}``, server j is ``pserver{j}``.  Tables shard rows over
+servers by ``id % num_servers`` (the reference's default hash shard).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import rpc
+
+__all__ = [
+    "Role", "PaddleCloudRoleMaker", "SparseTable", "TheOnePS",
+    "init", "is_server", "is_worker", "run_server", "stop_server",
+    "create_sparse_table", "pull_sparse", "push_sparse", "barrier_worker",
+    "shutdown",
+]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    """Role/rank resolution from the PADDLE_* env contract
+    (reference: python/paddle/distributed/fleet/base/role_maker.py).
+
+    Env: ``TRAINING_ROLE`` (TRAINER|PSERVER), ``PADDLE_TRAINERS_NUM``,
+    ``PADDLE_PSERVER_NUM``, ``PADDLE_TRAINER_ID`` / ``PADDLE_PSERVER_ID``.
+    """
+
+    def __init__(self, is_collective: bool = False, role: Optional[int] = None,
+                 worker_num: Optional[int] = None,
+                 server_num: Optional[int] = None,
+                 worker_index: Optional[int] = None,
+                 server_index: Optional[int] = None):
+        self._is_collective = is_collective
+        env = os.environ
+        if role is None:
+            role = (Role.SERVER
+                    if env.get("TRAINING_ROLE", "TRAINER") == "PSERVER"
+                    else Role.WORKER)
+        self._role = role
+        self._worker_num = int(worker_num
+                               if worker_num is not None
+                               else env.get("PADDLE_TRAINERS_NUM", 1))
+        self._server_num = int(server_num
+                               if server_num is not None
+                               else env.get("PADDLE_PSERVER_NUM", 0))
+        self._worker_index = int(worker_index
+                                 if worker_index is not None
+                                 else env.get("PADDLE_TRAINER_ID", 0))
+        self._server_index = int(server_index
+                                 if server_index is not None
+                                 else env.get("PADDLE_PSERVER_ID", 0))
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def server_num(self) -> int:
+        return self._server_num
+
+    def worker_index(self) -> int:
+        return self._worker_index
+
+    def server_index(self) -> int:
+        return self._server_index
+
+    # rpc-gang coordinates: trainers first, then servers
+    def rpc_rank(self) -> int:
+        return (self._worker_index if self.is_worker()
+                else self._worker_num + self._server_index)
+
+    def rpc_world(self) -> int:
+        return self._worker_num + self._server_num
+
+    def rpc_name(self) -> str:
+        return (f"trainer{self._worker_index}" if self.is_worker()
+                else f"pserver{self._server_index}")
+
+
+# --------------------------------------------------------------------------
+# server side
+# --------------------------------------------------------------------------
+
+@dataclass
+class SparseTable:
+    """One sparse embedding table shard (reference: ps/table/
+    memory_sparse_table) — rows materialize on first pull, SGD or adagrad
+    updates on push."""
+
+    name: str
+    dim: int
+    initializer: str = "uniform"     # uniform | zeros
+    init_range: float = 0.01
+    optimizer: str = "sgd"           # sgd | adagrad
+    learning_rate: float = 0.01
+    seed: int = 0
+    rows: Dict[int, np.ndarray] = field(default_factory=dict)
+    accum: Dict[int, np.ndarray] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _init_row(self, i: int) -> np.ndarray:
+        if self.initializer == "zeros":
+            return np.zeros((self.dim,), np.float32)
+        rng = np.random.default_rng((self.seed, i))
+        return rng.uniform(-self.init_range, self.init_range,
+                           (self.dim,)).astype(np.float32)
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self._lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for n, i in enumerate(ids):
+                i = int(i)
+                row = self.rows.get(i)
+                if row is None:
+                    row = self.rows[i] = self._init_row(i)
+                out[n] = row
+            return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        with self._lock:
+            for n, i in enumerate(ids):
+                i = int(i)
+                row = self.rows.get(i)
+                if row is None:
+                    row = self.rows[i] = self._init_row(i)
+                g = grads[n]
+                if self.optimizer == "adagrad":
+                    acc = self.accum.setdefault(
+                        i, np.zeros((self.dim,), np.float32))
+                    acc += g * g
+                    row -= self.learning_rate * g / (np.sqrt(acc) + 1e-8)
+                else:
+                    row -= self.learning_rate * g
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self.rows)
+
+
+# module-level table registry; rpc-called functions resolve through it
+_TABLES: Dict[str, SparseTable] = {}
+_STOP = threading.Event()
+
+
+def _srv_create(name, **kwargs):
+    if name not in _TABLES:
+        _TABLES[name] = SparseTable(name=name, **kwargs)
+    return True
+
+
+def _srv_pull(name, ids):
+    return _TABLES[name].pull(np.asarray(ids))
+
+
+def _srv_push(name, ids, grads):
+    _TABLES[name].push(np.asarray(ids), np.asarray(grads))
+    return True
+
+
+def _srv_size(name):
+    return _TABLES[name].size()
+
+
+def _srv_stop():
+    _STOP.set()
+    return True
+
+
+class TheOnePS:
+    """Server runtime (the_one_ps.py analog): joins the rpc gang and
+    serves table calls until stopped."""
+
+    def __init__(self, role: PaddleCloudRoleMaker):
+        self._role = role
+
+    def run_server(self):
+        _STOP.clear()
+        while not _STOP.wait(timeout=0.1):
+            pass
+
+
+# --------------------------------------------------------------------------
+# facade (fleet-PS-mode style entry points)
+# --------------------------------------------------------------------------
+
+_ROLE: Optional[PaddleCloudRoleMaker] = None
+
+
+def init(role: Optional[PaddleCloudRoleMaker] = None) -> PaddleCloudRoleMaker:
+    """Join the PS gang (every trainer and pserver process calls this)."""
+    global _ROLE
+    _ROLE = role or PaddleCloudRoleMaker()
+    rpc.init_rpc(_ROLE.rpc_name(), rank=_ROLE.rpc_rank(),
+                 world_size=_ROLE.rpc_world())
+    return _ROLE
+
+
+def _role() -> PaddleCloudRoleMaker:
+    if _ROLE is None:
+        raise RuntimeError("call paddle.distributed.ps.init() first")
+    return _ROLE
+
+
+def is_server() -> bool:
+    return _role().is_server()
+
+
+def is_worker() -> bool:
+    return _role().is_worker()
+
+
+def run_server():
+    """Blocks serving tables until a worker calls stop_server()."""
+    TheOnePS(_role()).run_server()
+
+
+def stop_server():
+    """Worker-side: stop every pserver."""
+    r = _role()
+    for j in range(r.server_num()):
+        rpc.rpc_sync(f"pserver{j}", _srv_stop, ())
+
+
+def _shard(r: PaddleCloudRoleMaker, ids: np.ndarray):
+    """id -> owning server by modulo hash (reference default)."""
+    owners = ids % r.server_num()
+    return owners
+
+
+def create_sparse_table(name: str, dim: int, **kwargs):
+    """Create (idempotently) the table on every server shard."""
+    r = _role()
+    for j in range(r.server_num()):
+        rpc.rpc_sync(f"pserver{j}", _srv_create, (name,),
+                     dict(dim=dim, **kwargs))
+
+
+def pull_sparse(name: str, ids) -> np.ndarray:
+    """Gather rows for ``ids`` ([n] int) across server shards."""
+    r = _role()
+    ids = np.asarray(ids, np.int64)
+    owners = _shard(r, ids)
+    out = np.empty((len(ids), 0), np.float32) if len(ids) == 0 else None
+    futs, slots = [], []
+    for j in range(r.server_num()):
+        sel = np.nonzero(owners == j)[0]
+        if sel.size == 0:
+            continue
+        futs.append(rpc.rpc_async(f"pserver{j}", _srv_pull,
+                                  (name, ids[sel])))
+        slots.append(sel)
+    for f, sel in zip(futs, slots):
+        rows = f.wait()
+        if out is None:
+            out = np.empty((len(ids), rows.shape[1]), np.float32)
+        out[sel] = rows
+    return out
+
+
+def push_sparse(name: str, ids, grads):
+    """Scatter-add gradient updates for ``ids`` to their server shards."""
+    r = _role()
+    ids = np.asarray(ids, np.int64)
+    grads = np.asarray(grads, np.float32)
+    owners = _shard(r, ids)
+    futs = []
+    for j in range(r.server_num()):
+        sel = np.nonzero(owners == j)[0]
+        if sel.size == 0:
+            continue
+        futs.append(rpc.rpc_async(f"pserver{j}", _srv_push,
+                                  (name, ids[sel], grads[sel])))
+    for f in futs:
+        f.wait()
+
+
+_BARRIER_GEN = 0
+
+
+def barrier_worker():
+    """Barrier across trainers only (reference fleet.barrier_worker) —
+    servers are blocked in run_server and must not be counted."""
+    global _BARRIER_GEN
+    r = _role()
+    store = rpc._require_agent().store
+    _BARRIER_GEN += 1
+    name = f"__ps_wbar_{_BARRIER_GEN}"
+    n = store.add(f"{name}_count", 1)
+    if n >= r.worker_num():
+        store.set(f"{name}_done", b"1")
+    store.wait([f"{name}_done"], timeout=60)
+
+
+def shutdown():
+    rpc.shutdown()

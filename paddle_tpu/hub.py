@@ -1,0 +1,77 @@
+"""Model hub (``paddle.hub`` analog).
+
+Reference: ``python/paddle/hub.py`` — ``list``/``help``/``load`` over a
+repo that exposes entrypoints in a ``hubconf.py``.  The TPU build runs in
+zero-egress environments, so the ``local`` source is first-class (a
+directory containing ``hubconf.py``); ``github``/``gitee`` sources raise
+with a clear message instead of attempting a download.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import Any, Callable, List
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} found in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve_repo(repo_dir: str, source: str) -> str:
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected 'local', 'github' or "
+            "'gitee'")
+    if source != "local":
+        raise RuntimeError(
+            f"source={source!r} requires network access, which this "
+            "environment does not provide; clone the repo and use "
+            "source='local' with its path")
+    if not os.path.isdir(repo_dir):
+        raise FileNotFoundError(f"local hub repo {repo_dir!r} does not exist")
+    return repo_dir
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Names of all callable entrypoints defined by the repo's hubconf."""
+    mod = _load_hubconf(_resolve_repo(repo_dir, source))
+    return [name for name, obj in vars(mod).items()
+            if callable(obj) and not name.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False):
+    """The entrypoint's docstring."""
+    mod = _load_hubconf(_resolve_repo(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no callable entrypoint {model!r} in "
+                           f"{repo_dir}/{MODULE_HUBCONF}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs: Any):
+    """Instantiate entrypoint ``model`` with ``kwargs``."""
+    mod = _load_hubconf(_resolve_repo(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no callable entrypoint {model!r} in "
+                           f"{repo_dir}/{MODULE_HUBCONF}")
+    return fn(**kwargs)

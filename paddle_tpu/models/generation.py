@@ -200,17 +200,112 @@ def _generate_jit(params, ids, key, cfg_id, max_new_tokens,
     return out
 
 
+@partial(jax.jit, static_argnames=("cfg_id", "max_new_tokens", "num_beams",
+                                   "length_penalty", "eos_id"))
+def _beam_search_jit(params, ids, cfg_id, max_new_tokens, num_beams,
+                     length_penalty, eos_id):
+    """Compiled beam search: prefill once per prompt, then a ``lax.scan``
+    over decode steps carrying B beams per sequence.  Finished (EOS) beams
+    are frozen — their candidate row collapses to a single "emit EOS again
+    at +0 logp" entry, so they keep competing on their final score.  The
+    analog of the reference's beam-search decode (the legacy
+    paddle beam_search op + PaddleNLP's loop), formulated as two XLA
+    programs with static shapes."""
+    cfg, cos_tab, sin_tab = _CFGS[cfg_id]
+    w = _Weights(cfg, params)
+    b, S = ids.shape
+    B = num_beams
+    M = S + max_new_tokens
+    h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    L = cfg.num_hidden_layers
+
+    # ---- prefill (per prompt, beams share it) ----
+    positions = jnp.broadcast_to(jnp.arange(S), (b, S))
+    x = jnp.take(w["model.embed_tokens.weight"], ids, axis=0)
+    cos = jnp.take(cos_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
+    sin = jnp.take(sin_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
+    causal = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0, -jnp.inf)
+    k_cache = jnp.zeros((L, b, M, kvh, d), x.dtype)
+    v_cache = jnp.zeros((L, b, M, kvh, d), x.dtype)
+    for i in range(L):
+        x, k, v = _block(w, i, x, cos, sin, causal)
+        k_cache = k_cache.at[i, :, :S].set(k)
+        v_cache = v_cache.at[i, :, :S].set(v)
+    x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
+    logp0 = jax.nn.log_softmax(w.head(x[:, -1]).astype(jnp.float32), axis=-1)
+    V = logp0.shape[-1]
+
+    alive_logp, tok = lax.top_k(logp0, B)            # [b, B]
+    tok = tok.astype(jnp.int32)
+    done = tok == eos_id
+    gen_len = jnp.ones((b, B), jnp.int32)
+    toks_buf = jnp.zeros((b, B, max_new_tokens), jnp.int32)
+    toks_buf = toks_buf.at[:, :, 0].set(tok)
+    # beams share the prompt cache: tile to [L, b*B, M, ...]
+    k_cache = jnp.repeat(k_cache, B, axis=1)
+    v_cache = jnp.repeat(v_cache, B, axis=1)
+
+    def gather_cache(c, parent):
+        # c: [L, b*B, M, kvh, d] -> reorder the beam sub-axis by parent
+        cv = c.reshape(L, b, B, M, kvh, d)
+        idx = parent[None, :, :, None, None, None]
+        cv = jnp.take_along_axis(cv, idx, axis=2)
+        return cv.reshape(L, b * B, M, kvh, d)
+
+    def step(carry, t):
+        alive_logp, tok, toks_buf, gen_len, done, k_cache, v_cache = carry
+        pos = S + t
+        logits, k_cache, v_cache = _decode_step(
+            w, cos_tab, sin_tab, tok.reshape(b * B), pos, k_cache, v_cache)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                axis=-1).reshape(b, B, V)
+        # frozen EOS beams: single continuation (EOS again) at +0 logp
+        eos_row = jnp.full((V,), -jnp.inf).at[eos_id if eos_id >= 0 else 0
+                                              ].set(0.0)
+        lp = jnp.where(done[:, :, None], eos_row[None, None, :], lp)
+        cand = alive_logp[:, :, None] + lp           # [b, B, V]
+        top, idx = lax.top_k(cand.reshape(b, B * V), B)
+        parent = (idx // V).astype(jnp.int32)
+        ntok = (idx % V).astype(jnp.int32)
+        # reorder all beam state by parent
+        toks_buf = jnp.take_along_axis(toks_buf, parent[:, :, None], axis=1)
+        gen_len = jnp.take_along_axis(gen_len, parent, axis=1)
+        done = jnp.take_along_axis(done, parent, axis=1)
+        k_cache = gather_cache(k_cache, parent)
+        v_cache = gather_cache(v_cache, parent)
+        gen_len = gen_len + jnp.where(done, 0, 1)
+        toks_buf = lax.dynamic_update_slice_in_dim(
+            toks_buf, ntok[:, :, None], t + 1, axis=2)
+        done = done | (ntok == eos_id)
+        return (top, ntok, toks_buf, gen_len, done, k_cache, v_cache), None
+
+    carry = (alive_logp, tok, toks_buf, gen_len, done, k_cache, v_cache)
+    carry, _ = lax.scan(step, carry, jnp.arange(max_new_tokens - 1))
+    alive_logp, _, toks_buf, gen_len, done, _, _ = carry
+    # GNMT-free simple normalization: score = logp / len^alpha
+    scores = alive_logp / jnp.power(gen_len.astype(jnp.float32),
+                                    length_penalty)
+    best = jnp.argmax(scores, axis=1)                # [b]
+    out = jnp.take_along_axis(toks_buf, best[:, None, None], axis=1)[:, 0]
+    best_score = jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0]
+    return out, best_score
+
+
 _CFGS = {}
 
 
 def generate(model, input_ids, max_new_tokens: int = 32,
              do_sample: bool = False, temperature: float = 1.0,
              top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-             eos_token_id: Optional[int] = None):
+             eos_token_id: Optional[int] = None, num_beams: int = 1,
+             length_penalty: float = 1.0):
     """Generate continuations for ``input_ids`` ([b, S] int) with a KV
     cache; returns [b, S + max_new_tokens] including the prompt. Greedy by
-    default; ``do_sample`` enables temperature / top-k / top-p. After an
-    EOS is produced, a sequence keeps emitting ``eos_token_id``."""
+    default; ``do_sample`` enables temperature / top-k / top-p;
+    ``num_beams > 1`` selects compiled beam search (returns each prompt's
+    best beam, scored as logp / len**length_penalty). After an EOS is
+    produced, a sequence keeps emitting ``eos_token_id``."""
     from ..core.tensor import Tensor
 
     import dataclasses
@@ -241,8 +336,15 @@ def generate(model, input_ids, max_new_tokens: int = 32,
                                         cfg.rope_theta)
         _CFGS[cfg_key] = (cfg, cos_tab, sin_tab)
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    key = jax.random.PRNGKey(seed)
-    new = _generate_jit(params, ids, key, cfg_key, max_new_tokens,
-                        bool(do_sample), float(temperature), int(top_k),
-                        float(top_p), eos)
+    if num_beams > 1:
+        if do_sample:
+            raise ValueError("beam search is deterministic: num_beams > 1 "
+                             "is incompatible with do_sample=True")
+        new, _ = _beam_search_jit(params, ids, cfg_key, max_new_tokens,
+                                  int(num_beams), float(length_penalty), eos)
+    else:
+        key = jax.random.PRNGKey(seed)
+        new = _generate_jit(params, ids, key, cfg_key, max_new_tokens,
+                            bool(do_sample), float(temperature), int(top_k),
+                            float(top_p), eos)
     return Tensor(jnp.concatenate([ids, new], axis=1))

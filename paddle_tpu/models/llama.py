@@ -332,7 +332,7 @@ def apply_llama_sharding(model: Layer, mesh: Mesh,
 def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = None,
                      data_axes: Tuple[str, ...] = ("dp", "sharding"),
                      remat: bool = False, remat_policy=None,
-                     compute_dtype=jnp.bfloat16):
+                     compute_dtype=jnp.bfloat16, accum_steps: int = 1):
     """Build a single donated, jitted train step:
 
         step_fn(params, opt_state, step_no, lr, input_ids, labels)
@@ -409,7 +409,37 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
             decay_mask={n: n not in no_decay for n in names})
         return loss, new_params, new_opt_state
 
-    return jax.jit(step_fn, donate_argnums=(0, 1))
+    def accum_step_fn(params, opt_state, step_no, lr, input_ids, labels):
+        """Gradient accumulation (reference: strategy gradient-merge /
+        GradientMergeOptimizer): ids/labels carry a leading [accum_steps]
+        micro-batch axis; one fp32 grad buffer is accumulated by a
+        lax.scan of fwd+bwd micro-steps, then AdamW runs ONCE — the
+        HBM-bound optimizer read-modify-write (4 fp32 tensors the size of
+        the model) is amortized over accum_steps of compute."""
+        if batch_sharding is not None:
+            mspec = tuple(batch_sharding.spec)
+            micro = NamedSharding(mesh, P(None, *mspec))
+            input_ids = jax.lax.with_sharding_constraint(input_ids, micro)
+            labels = jax.lax.with_sharding_constraint(labels, micro)
+
+        def micro_step(acc, xs):
+            mids, mlabels = xs
+            loss, g = grad_fn(params, mids, mlabels)
+            acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), acc, g)
+            return acc, loss
+
+        zero = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        acc, losses = jax.lax.scan(micro_step, zero, (input_ids, labels))
+        grads = jax.tree_util.tree_map(lambda a: a / accum_steps, acc)
+        new_params, new_opt_state = optimizer.apply(
+            params, grads, opt_state, lr, step_no + 1,
+            decay_mask={n: n not in no_decay for n in names})
+        return losses.mean(), new_params, new_opt_state
+
+    fn = step_fn if accum_steps <= 1 else accum_step_fn
+    return jax.jit(fn, donate_argnums=(0, 1))
 
 
 def make_batch_shardings(mesh: Mesh, data_axes: Tuple[str, ...] = ("dp", "sharding")):

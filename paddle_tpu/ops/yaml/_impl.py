@@ -536,6 +536,8 @@ def frame(x, frame_length, hop_length, axis=-1):
     out = x[..., idx]                      # [..., num, frame_length]
     if first:
         return jnp.moveaxis(out, (-2, -1), (0, 1))  # [num, frame_length, ...]
+    if axis == 0 and x.ndim == 1:
+        return out                         # 1-D axis-0: [num, frame_length]
     return jnp.swapaxes(out, -1, -2)       # [..., frame_length, num]
 
 

@@ -113,10 +113,10 @@ class Optimizer:
     def step(self):
         self._global_step += 1
         params = self._parameters
-        accum = [p._grad for p in params]
-        grads = accum
-        if self._grad_clip is not None:
-            grads = self._grad_clip(params, grads)
+        grads = [p._grad for p in params]
+        # reshard BEFORE clipping: the reshard is a linear layout change, so
+        # global-norm clip over sharded grads is equivalent — one transform
+        # serves both the update and the p.grad write-back (pre-clip)
         if self._grad_transform is not None:
             grads = list(grads)
             for i, (p, g) in enumerate(zip(params, grads)):
@@ -127,12 +127,12 @@ class Optimizer:
                     grads[i] = ng
                     # write back: releases the replicated grad buffer, so
                     # the sharded layout is what survives the step (the
-                    # ZeRO-2 memory effect, not just a transient copy).
-                    # p.grad must keep the ACCUMULATED gradient, so when a
-                    # clip ran, reshard the pre-clip value instead of
-                    # leaking clipped values into p.grad.
-                    og = accum[i]
-                    p._grad = ng if og is g else self._grad_transform(p, og)
+                    # ZeRO-2 memory effect); holds the ACCUMULATED (un-
+                    # clipped) gradient — the clip below only affects the
+                    # values fed to the update
+                    p._grad = ng
+        if self._grad_clip is not None:
+            grads = self._grad_clip(params, grads)
         lr = self.get_lr()
         for p, g in zip(params, grads):
             if g is None or p.stop_gradient:

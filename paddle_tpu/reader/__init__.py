@@ -1,0 +1,236 @@
+"""Reader decorators (``paddle.reader`` analog).
+
+Reference: ``python/paddle/reader/decorator.py`` — composable generators
+feeding training loops: map_readers, shuffle, chain, compose, buffered,
+firstn, cache, xmap_readers.  These are host-side and backend-agnostic;
+the threaded ones mirror the reference's queue-based implementations.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Any, Callable, Iterable
+
+__all__ = [
+    "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
+    "cache", "xmap_readers", "multiprocess_reader",
+]
+
+
+def map_readers(func, *readers):
+    """Apply ``func`` element-wise over samples zipped from ``readers``."""
+
+    def reader():
+        its = [r() for r in readers]
+        for args in zip(*its):
+            yield func(*args)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill a window of ``buf_size`` samples, emit in
+    random order (reference decorator.py shuffle)."""
+
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers back-to-back."""
+
+    def chained():
+        for r in readers:
+            yield from r()
+
+    return chained
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuples per sample; check_alignment asserts equal
+    lengths (reference ComposeNotAligned)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"unexpected kwargs {sorted(kwargs)}")
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        its = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*its, fillvalue=_SENTINEL):
+                if any(i is _SENTINEL for i in items):
+                    raise ComposeNotAligned(
+                        "readers have different lengths")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in zip(*its):
+                yield sum((make_tuple(i) for i in items), ())
+
+    return composed
+
+
+_SENTINEL = object()
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def buffered(reader, size):
+    """Decouple producer/consumer with a background thread + queue of
+    ``size`` (reference decorator.py buffered)."""
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_SENTINEL)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _SENTINEL:
+                break
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """Limit to the first ``n`` samples."""
+
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def cache(reader):
+    """Materialize the full reader once; replays from memory."""
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        yield from all_data
+
+    return cached
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader using ``process_num`` worker threads
+    (reference decorator.py xmap_readers; threads instead of processes —
+    mappers in TPU input pipelines are numpy-bound and release the GIL)."""
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        errors: list = []
+
+        def feed():
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:  # noqa: BLE001 — must not deadlock
+                errors.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_SENTINEL)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _SENTINEL:
+                        return
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                # always post the sentinel so the consumer can't hang on a
+                # dead worker; its recorded error re-raises below
+                out_q.put(_SENTINEL)
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        done = 0
+        if order:
+            pending = {}
+            want = 0
+            while done < process_num:
+                item = out_q.get()
+                if item is _SENTINEL:
+                    done += 1
+                    continue
+                i, d = item
+                pending[i] = d
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while done < process_num:
+                item = out_q.get()
+                if item is _SENTINEL:
+                    done += 1
+                    continue
+                yield item[1]
+        if errors:
+            raise errors[0]
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers concurrently (thread-backed; the
+    reference uses fork+pipe, which is unsafe with a live TPU client)."""
+
+    def mreader():
+        q: queue.Queue = queue.Queue(queue_size)
+
+        def run(r):
+            try:
+                for d in r():
+                    q.put(d)
+            finally:
+                q.put(_SENTINEL)
+
+        for r in readers:
+            threading.Thread(target=run, args=(r,), daemon=True).start()
+        done = 0
+        while done < len(readers):
+            e = q.get()
+            if e is _SENTINEL:
+                done += 1
+                continue
+            yield e
+
+    return mreader

@@ -12,8 +12,11 @@ from ..nn import (
 class BasicBlock(Layer):
     expansion = 1
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None, norm_layer=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None, norm_layer=None,
+                 groups=1, base_width=64):
         super().__init__()
+        if groups != 1 or base_width != 64:
+            raise ValueError("BasicBlock only supports groups=1, base_width=64")
         norm_layer = norm_layer or BatchNorm2D
         self.conv1 = Conv2D(inplanes, planes, 3, stride=stride, padding=1, bias_attr=False)
         self.bn1 = norm_layer(planes)
@@ -37,14 +40,17 @@ class BasicBlock(Layer):
 class BottleneckBlock(Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None, norm_layer=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None, norm_layer=None,
+                 groups=1, base_width=64):
         super().__init__()
         norm_layer = norm_layer or BatchNorm2D
-        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = norm_layer(planes)
-        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
-        self.conv3 = Conv2D(planes, planes * self.expansion, 1, bias_attr=False)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = norm_layer(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = norm_layer(width)
+        self.conv3 = Conv2D(width, planes * self.expansion, 1, bias_attr=False)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = ReLU()
         self.downsample = downsample
@@ -63,7 +69,7 @@ class ResNet(Layer):
     """Analog of python/paddle/vision/models/resnet.py ResNet."""
 
     def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True,
-                 small_input=False):
+                 small_input=False, groups=1):
         super().__init__()
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
@@ -72,6 +78,8 @@ class ResNet(Layer):
         layers = layer_cfg[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
+        self.groups = groups          # ResNeXt cardinality
+        self.base_width = width       # 64 = plain; 128 = wide; 4 w/ groups = next
         self.inplanes = 64
         if small_input:
             # CIFAR-style stem (3x3, no maxpool)
@@ -99,10 +107,12 @@ class ResNet(Layer):
                        bias_attr=False),
                 BatchNorm2D(planes * block.expansion),
             )
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        layers = [block(self.inplanes, planes, stride, downsample,
+                        groups=self.groups, base_width=self.base_width)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, groups=self.groups,
+                                base_width=self.base_width))
         return Sequential(*layers)
 
     def forward(self, x):
@@ -627,3 +637,382 @@ class GoogLeNet(Layer):
 
 def googlenet(pretrained=False, num_classes=1000, **kw):
     return GoogLeNet(num_classes=num_classes)
+
+
+# --------------------------------------------------------------------------
+# ResNeXt / Wide ResNet (reference: python/paddle/vision/models/resnet.py
+# resnext50_32x4d:*, wide_resnet50_2:* — same ResNet skeleton, different
+# cardinality/base width)
+# --------------------------------------------------------------------------
+
+def resnext50_32x4d(pretrained=False, num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, 50, width=4, groups=32,
+                  num_classes=num_classes, **kw)
+
+
+def resnext50_64x4d(pretrained=False, num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, 50, width=4, groups=64,
+                  num_classes=num_classes, **kw)
+
+
+def resnext101_32x4d(pretrained=False, num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, 101, width=4, groups=32,
+                  num_classes=num_classes, **kw)
+
+
+def resnext101_64x4d(pretrained=False, num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, 101, width=4, groups=64,
+                  num_classes=num_classes, **kw)
+
+
+def resnext152_32x4d(pretrained=False, num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, 152, width=4, groups=32,
+                  num_classes=num_classes, **kw)
+
+
+def resnext152_64x4d(pretrained=False, num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, 152, width=4, groups=64,
+                  num_classes=num_classes, **kw)
+
+
+def wide_resnet50_2(pretrained=False, num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, 50, width=128, num_classes=num_classes, **kw)
+
+
+def wide_resnet101_2(pretrained=False, num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, 101, width=128, num_classes=num_classes, **kw)
+
+
+# --------------------------------------------------------------------------
+# MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py —
+# depthwise-separable conv stacks)
+# --------------------------------------------------------------------------
+
+class _ConvBNRelu(Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, kernel, stride=stride, padding=padding,
+                           groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _DepthwiseSeparable(Layer):
+    def __init__(self, cin, cout, stride, scale):
+        super().__init__()
+        cin, cout = int(cin * scale), int(cout * scale)
+        self.dw = _ConvBNRelu(cin, cin, 3, stride=stride, padding=1, groups=cin)
+        self.pw = _ConvBNRelu(cin, cout, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    """13 depthwise-separable stages after a 3x3 stem (mobilenetv1.py)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # cin, cout, stride (all pre-scale)
+            (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2), (512, 512, 1), (512, 512, 1),
+            (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 1024, 2),
+            (1024, 1024, 1),
+        ]
+        self.stem = _ConvBNRelu(3, int(32 * scale), 3, stride=2, padding=1)
+        self.blocks = Sequential(*[_DepthwiseSeparable(cin, cout, s, scale)
+                                   for cin, cout, s in cfg])
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, num_classes=1000, **kw):
+    return MobileNetV1(scale=scale, num_classes=num_classes, **kw)
+
+
+# --------------------------------------------------------------------------
+# MobileNetV3 (reference: python/paddle/vision/models/mobilenetv3.py —
+# inverted residuals + squeeze-excite + hardswish)
+# --------------------------------------------------------------------------
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SqueezeExcite(Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        from ..nn import Hardsigmoid
+
+        squeeze = _make_divisible(channels // reduction)
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.fc1 = Conv2D(channels, squeeze, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(squeeze, channels, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(Layer):
+    def __init__(self, cin, exp, cout, kernel, stride, use_se, act):
+        super().__init__()
+        from ..nn import Hardswish
+
+        self.use_res = stride == 1 and cin == cout
+        act_layer = Hardswish if act == "hardswish" else ReLU
+        layers = []
+        if exp != cin:
+            layers += [Conv2D(cin, exp, 1, bias_attr=False), BatchNorm2D(exp),
+                       act_layer()]
+        layers += [Conv2D(exp, exp, kernel, stride=stride,
+                          padding=kernel // 2, groups=exp, bias_attr=False),
+                   BatchNorm2D(exp), act_layer()]
+        if use_se:
+            layers.append(_SqueezeExcite(exp))
+        layers += [Conv2D(exp, cout, 1, bias_attr=False), BatchNorm2D(cout)]
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_MBV3_LARGE = [
+    # kernel, exp, cout, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        from ..nn import Dropout, Hardswish
+
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cin = _make_divisible(16 * scale)
+        self.stem = Sequential(
+            Conv2D(3, cin, 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(cin), Hardswish())
+        blocks = []
+        for kernel, exp, cout, se, act, stride in config:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(cout * scale)
+            blocks.append(_MBV3Block(cin, exp_c, out_c, kernel, stride, se, act))
+            cin = out_c
+        self.blocks = Sequential(*blocks)
+        last_conv = _make_divisible(6 * cin)
+        self.head_conv = Sequential(
+            Conv2D(cin, last_conv, 1, bias_attr=False),
+            BatchNorm2D(last_conv), Hardswish())
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_conv, last_channel), Hardswish(),
+                Dropout(0.2), Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, num_classes=1000, **kw):
+    return MobileNetV3(_MBV3_LARGE, 1280, scale=scale,
+                       num_classes=num_classes, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, num_classes=1000, **kw):
+    return MobileNetV3(_MBV3_SMALL, 1024, scale=scale,
+                       num_classes=num_classes, **kw)
+
+
+# --------------------------------------------------------------------------
+# InceptionV3 (reference: python/paddle/vision/models/inceptionv3.py —
+# factorized 7x7/3x3 inception stacks; aux head omitted like the reference's
+# eval path)
+# --------------------------------------------------------------------------
+
+class _IncA(Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = _ConvBNRelu(cin, 64, 1)
+        self.b5 = Sequential(_ConvBNRelu(cin, 48, 1),
+                             _ConvBNRelu(48, 64, 5, padding=2))
+        self.b3 = Sequential(_ConvBNRelu(cin, 64, 1),
+                             _ConvBNRelu(64, 96, 3, padding=1),
+                             _ConvBNRelu(96, 96, 3, padding=1))
+        self.pool_proj = _ConvBNRelu(cin, pool_features, 1)
+
+    def forward(self, x):
+        from ..nn import AvgPool2D
+
+        import paddle_tpu as paddle
+
+        pooled = AvgPool2D(3, stride=1, padding=1)(x)
+        return paddle.concat([self.b1(x), self.b5(x), self.b3(x),
+                              self.pool_proj(pooled)], axis=1)
+
+
+class _IncB(Layer):  # grid reduction
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _ConvBNRelu(cin, 384, 3, stride=2)
+        self.b3dbl = Sequential(_ConvBNRelu(cin, 64, 1),
+                                _ConvBNRelu(64, 96, 3, padding=1),
+                                _ConvBNRelu(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        return paddle.concat([self.b3(x), self.b3dbl(x), self.pool(x)], axis=1)
+
+
+class _IncC(Layer):  # factorized 7x7
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _ConvBNRelu(cin, 192, 1)
+        self.b7 = Sequential(
+            _ConvBNRelu(cin, c7, 1),
+            _ConvBNRelu(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNRelu(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7dbl = Sequential(
+            _ConvBNRelu(cin, c7, 1),
+            _ConvBNRelu(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNRelu(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNRelu(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNRelu(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool_proj = _ConvBNRelu(cin, 192, 1)
+
+    def forward(self, x):
+        from ..nn import AvgPool2D
+
+        import paddle_tpu as paddle
+
+        pooled = AvgPool2D(3, stride=1, padding=1)(x)
+        return paddle.concat([self.b1(x), self.b7(x), self.b7dbl(x),
+                              self.pool_proj(pooled)], axis=1)
+
+
+class _IncD(Layer):  # grid reduction 2
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = Sequential(_ConvBNRelu(cin, 192, 1),
+                             _ConvBNRelu(192, 320, 3, stride=2))
+        self.b7x3 = Sequential(
+            _ConvBNRelu(cin, 192, 1),
+            _ConvBNRelu(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBNRelu(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBNRelu(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        return paddle.concat([self.b3(x), self.b7x3(x), self.pool(x)], axis=1)
+
+
+class _IncE(Layer):  # expanded filter bank
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _ConvBNRelu(cin, 320, 1)
+        self.b3_stem = _ConvBNRelu(cin, 384, 1)
+        self.b3_a = _ConvBNRelu(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBNRelu(384, 384, (3, 1), padding=(1, 0))
+        self.b3dbl_stem = Sequential(_ConvBNRelu(cin, 448, 1),
+                                     _ConvBNRelu(448, 384, 3, padding=1))
+        self.b3dbl_a = _ConvBNRelu(384, 384, (1, 3), padding=(0, 1))
+        self.b3dbl_b = _ConvBNRelu(384, 384, (3, 1), padding=(1, 0))
+        self.pool_proj = _ConvBNRelu(cin, 192, 1)
+
+    def forward(self, x):
+        from ..nn import AvgPool2D
+
+        import paddle_tpu as paddle
+
+        s = self.b3_stem(x)
+        d = self.b3dbl_stem(x)
+        pooled = AvgPool2D(3, stride=1, padding=1)(x)
+        return paddle.concat(
+            [self.b1(x), self.b3_a(s), self.b3_b(s), self.b3dbl_a(d),
+             self.b3dbl_b(d), self.pool_proj(pooled)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        from ..nn import Dropout
+
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _ConvBNRelu(3, 32, 3, stride=2), _ConvBNRelu(32, 32, 3),
+            _ConvBNRelu(32, 64, 3, padding=1), MaxPool2D(3, stride=2),
+            _ConvBNRelu(64, 80, 1), _ConvBNRelu(80, 192, 3),
+            MaxPool2D(3, stride=2))
+        self.mixed = Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160), _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.mixed(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, num_classes=1000, **kw):
+    return InceptionV3(num_classes=num_classes, **kw)

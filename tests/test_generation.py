@@ -94,3 +94,63 @@ def test_eos_padding():
     out = np.asarray(generate(model, ids, max_new_tokens=5,
                               eos_token_id=eos)._value)
     assert (out[0, 4:] == eos).all()
+
+
+def _seq_logp(model, ids, gen):
+    """Sum of log-probs the model assigns to `gen` continuing `ids`."""
+    import jax
+
+    full = np.concatenate([ids, gen], axis=1)
+    logits = model(paddle.to_tensor(full))._value.astype(jnp.float32)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    total = 0.0
+    S = ids.shape[1]
+    for t in range(gen.shape[1]):
+        total += float(lp[0, S - 1 + t, gen[0, t]])
+    return total
+
+
+def test_beam1_equals_greedy():
+    model, cfg = _model()
+    ids = np.random.RandomState(3).randint(0, cfg.vocab_size,
+                                           (2, 4)).astype(np.int32)
+    greedy = np.asarray(generate(model, ids, max_new_tokens=5)._value)
+    beam1 = np.asarray(generate(model, ids, max_new_tokens=5,
+                                num_beams=1)._value)
+    np.testing.assert_array_equal(greedy, beam1)
+
+
+def test_beam_search_beats_or_ties_greedy_logp():
+    model, cfg = _model()
+    ids = np.random.RandomState(4).randint(0, cfg.vocab_size,
+                                           (1, 4)).astype(np.int32)
+    n = 6
+    greedy = np.asarray(generate(model, ids, max_new_tokens=n)._value)
+    beam = np.asarray(generate(model, ids, max_new_tokens=n, num_beams=4,
+                               length_penalty=0.0)._value)
+    assert beam.shape == greedy.shape
+    lp_greedy = _seq_logp(model, ids, greedy[:, 4:])
+    lp_beam = _seq_logp(model, ids, beam[:, 4:])
+    assert lp_beam >= lp_greedy - 1e-4, (lp_beam, lp_greedy)
+
+
+def test_beam_search_eos_freezes():
+    model, cfg = _model()
+    ids = np.random.RandomState(5).randint(0, cfg.vocab_size,
+                                           (1, 3)).astype(np.int32)
+    out = np.asarray(generate(model, ids, max_new_tokens=8, num_beams=3,
+                              eos_token_id=11)._value)
+    gen = out[0, 3:]
+    hits = np.where(gen == 11)[0]
+    if hits.size:  # everything after the first EOS must stay EOS
+        assert np.all(gen[hits[0]:] == 11)
+
+
+def test_beam_rejects_sampling():
+    model, cfg = _model()
+    ids = np.zeros((1, 3), np.int32)
+    try:
+        generate(model, ids, max_new_tokens=2, num_beams=2, do_sample=True)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

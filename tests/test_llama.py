@@ -163,3 +163,36 @@ def test_llama_eager_vs_compiled_loss_parity():
     opt_state = opt.init_state(params)
     loss, _, _ = step(params, opt_state, 0, 0.0, ids_np, lab_np)
     np.testing.assert_allclose(float(loss), float(eager), rtol=1e-5)
+
+
+def test_grad_accum_matches_full_batch():
+    """accum=2 over [2, b, s] must match one step over the concatenated
+    [2b, s] batch: per-micro mean losses average to the global mean and
+    accumulated grads are averaged, so params after AdamW agree."""
+    cfg = LlamaConfig.debug(layers=1, hidden=32, heads=2, kv_heads=1, inter=64)
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.randint(0, cfg.vocab_size, (4, 8), dtype=np.int32)
+    lab = np.random.randint(0, cfg.vocab_size, (4, 8), dtype=np.int32)
+
+    opt = paddle.optimizer.AdamW(parameters=model.parameters())
+    params = model.functional_state()
+    opt_state = opt.init_state(params)
+
+    import jax
+
+    def deep(t):  # the jitted steps donate their buffers
+        return jax.tree_util.tree_map(jnp.copy, t)
+
+    full = build_train_step(model, opt, compute_dtype=jnp.float32)
+    l_full, p_full, _ = full(deep(params), deep(opt_state), 0, 1e-3, ids, lab)
+
+    acc = build_train_step(model, opt, compute_dtype=jnp.float32,
+                           accum_steps=2)
+    l_acc, p_acc, _ = acc(deep(params), deep(opt_state), 0, 1e-3,
+                          ids.reshape(2, 2, 8), lab.reshape(2, 2, 8))
+
+    np.testing.assert_allclose(float(l_acc), float(l_full), rtol=1e-5)
+    for k in p_full:
+        np.testing.assert_allclose(np.asarray(p_acc[k]),
+                                   np.asarray(p_full[k]), atol=1e-5,
+                                   err_msg=k)

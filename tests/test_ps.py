@@ -1,0 +1,103 @@
+"""Parameter-server stack: unit tests for SparseTable + a real
+2-trainer/1-pserver gang through the repo's launcher (reference analog:
+test/legacy_test/test_dist_base.py pserver+trainer subprocess harness)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+from paddle_tpu.distributed.ps import SparseTable
+
+
+class TestSparseTable:
+    def test_lazy_init_and_sgd(self):
+        t = SparseTable("e", dim=3, initializer="zeros", learning_rate=0.1)
+        rows = t.pull(np.array([5, 9]))
+        assert rows.shape == (2, 3) and np.all(rows == 0)
+        t.push(np.array([5]), np.ones((1, 3), np.float32))
+        np.testing.assert_allclose(t.pull(np.array([5])), -0.1, atol=1e-7)
+        assert t.size() == 2
+
+    def test_uniform_init_deterministic(self):
+        a = SparseTable("a", dim=4, seed=3)
+        b = SparseTable("b", dim=4, seed=3)
+        np.testing.assert_array_equal(a.pull(np.array([7])),
+                                      b.pull(np.array([7])))
+        assert np.any(a.pull(np.array([7])) != 0)
+
+    def test_adagrad(self):
+        t = SparseTable("e", dim=2, initializer="zeros",
+                        optimizer="adagrad", learning_rate=1.0)
+        g = np.full((1, 2), 2.0, np.float32)
+        t.push(np.array([1]), g)
+        # acc = 4, update = 1 * 2/sqrt(4) = 1
+        np.testing.assert_allclose(t.pull(np.array([1])), -1.0, atol=1e-6)
+
+
+WORKER = """
+import os
+import numpy as np
+import paddle_tpu.distributed.ps as ps
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+if rank < 2:
+    role = ps.PaddleCloudRoleMaker(role=ps.Role.WORKER, worker_num=2,
+                                   server_num=1, worker_index=rank)
+else:
+    role = ps.PaddleCloudRoleMaker(role=ps.Role.SERVER, worker_num=2,
+                                   server_num=1, server_index=0)
+ps.init(role)
+if ps.is_server():
+    ps.run_server()
+    print("SERVER_DONE")
+else:
+    ps.create_sparse_table("emb", dim=4, initializer="zeros",
+                           learning_rate=0.5)
+    ids = np.array([1, 2, 3]) if rank == 0 else np.array([3, 4])
+    rows = ps.pull_sparse("emb", ids)
+    assert rows.shape == (len(ids), 4) and np.all(rows == 0), rows
+    ps.barrier_worker()
+    if rank == 0:
+        ps.push_sparse("emb", np.array([3]), np.ones((1, 4), "float32"))
+    ps.barrier_worker()
+    got = ps.pull_sparse("emb", np.array([3]))
+    assert np.allclose(got, -0.5), got  # lr 0.5 * grad 1
+    ps.barrier_worker()
+    if rank == 0:
+        ps.stop_server()
+    print("WORKER_DONE")
+ps.shutdown()
+print("PS_SHUTDOWN_OK")
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_ps_gang(tmp_path):
+    script = tmp_path / "ps_node.py"
+    script.write_text(WORKER)
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3",
+         "--master", f"127.0.0.1:{_free_port()}",
+         "--log_dir", str(log_dir), str(script)],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=240)
+    logs = "\n".join((log_dir / f"workerlog.{i}").read_text()
+                     for i in range(3) if (log_dir / f"workerlog.{i}").exists())
+    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+    assert logs.count("WORKER_DONE") == 2, logs
+    assert logs.count("SERVER_DONE") == 1, logs
+    assert logs.count("PS_SHUTDOWN_OK") == 3, logs

@@ -1,0 +1,193 @@
+"""New vision families + paddle.hub/reader/batch/cost_model tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+M = paddle.vision.models
+
+
+def _fwd(net, hw=64, cin=3):
+    net.eval()
+    x = paddle.to_tensor(np.random.randn(1, cin, hw, hw).astype("float32"))
+    return net(x)
+
+
+class TestVisionBreadth:
+    def test_resnext_shapes_and_params(self):
+        net = M.resnext50_32x4d(num_classes=10)
+        assert tuple(_fwd(net).shape) == (1, 10)
+        # cardinality changes conv2 weight shape: groups=32 -> cin/32
+        w = net.layer1[0].conv2.weight
+        assert w.shape[1] * 32 == w.shape[0]
+
+    def test_wide_resnet(self):
+        net = M.wide_resnet50_2(num_classes=7)
+        assert tuple(_fwd(net).shape) == (1, 7)
+        # doubled bottleneck width vs plain resnet50
+        assert net.layer1[0].conv1.weight.shape[0] == 128
+
+    def test_basic_block_rejects_groups(self):
+        with pytest.raises(ValueError):
+            M.ResNet(M.BasicBlock, 18, width=4, groups=32)
+
+    def test_mobilenet_v1(self):
+        net = M.mobilenet_v1(scale=0.5, num_classes=5)
+        assert tuple(_fwd(net).shape) == (1, 5)
+
+    @pytest.mark.parametrize("factory", [M.mobilenet_v3_small,
+                                         M.mobilenet_v3_large])
+    def test_mobilenet_v3(self, factory):
+        net = factory(num_classes=4)
+        assert tuple(_fwd(net).shape) == (1, 4)
+
+    def test_inception_v3(self):
+        net = M.inception_v3(num_classes=6)
+        assert tuple(_fwd(net, hw=299).shape) == (1, 6)
+
+    def test_mobilenet_trains(self):
+        net = M.mobilenet_v1(scale=0.25, num_classes=3)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+        label = paddle.to_tensor(np.array([0, 2], "int64"))
+        loss = paddle.nn.CrossEntropyLoss()(net(x), label)
+        loss.backward()
+        opt.step()
+        assert np.isfinite(float(loss.numpy()))
+
+
+class TestHub:
+    def test_list_help_load(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def lenet(num_classes=10):\n"
+            "    'A LeNet entrypoint.'\n"
+            "    import paddle_tpu as paddle\n"
+            "    return paddle.vision.models.LeNet(num_classes=num_classes)\n")
+        names = paddle.hub.list(str(tmp_path), source="local")
+        assert "lenet" in names
+        assert "LeNet" in paddle.hub.help(str(tmp_path), "lenet")
+        net = paddle.hub.load(str(tmp_path), "lenet", num_classes=3)
+        x = paddle.to_tensor(np.random.randn(1, 1, 28, 28).astype("float32"))
+        assert tuple(net(x).shape) == (1, 3)
+
+    def test_remote_source_rejected(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            paddle.hub.list("someorg/somerepo", source="github")
+
+    def test_missing_entrypoint(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text("x = 1\n")
+        with pytest.raises(RuntimeError):
+            paddle.hub.load(str(tmp_path), "nope")
+
+
+class TestReaderBatch:
+    def test_batch(self):
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3)
+        assert [len(b) for b in r()] == [3, 3, 1]
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3, drop_last=True)
+        assert [len(b) for b in r()] == [3, 3]
+
+    def test_map_chain_compose_firstn(self):
+        a = lambda: iter([1, 2, 3])
+        b = lambda: iter([10, 20, 30])
+        assert list(paddle.reader.map_readers(lambda x, y: x + y, a, b)()) \
+            == [11, 22, 33]
+        assert list(paddle.reader.chain(a, b)()) == [1, 2, 3, 10, 20, 30]
+        assert list(paddle.reader.compose(a, b)()) == [(1, 10), (2, 20),
+                                                       (3, 30)]
+        assert list(paddle.reader.firstn(a, 2)()) == [1, 2]
+
+    def test_compose_misaligned(self):
+        a = lambda: iter([1, 2, 3])
+        c = lambda: iter([1])
+        with pytest.raises(paddle.reader.ComposeNotAligned):
+            list(paddle.reader.compose(a, c)())
+
+    def test_shuffle_preserves_multiset(self):
+        r = paddle.reader.shuffle(lambda: iter(range(20)), buf_size=8)
+        assert sorted(r()) == sorted(range(20))
+
+    def test_buffered_and_cache(self):
+        calls = []
+
+        def src():
+            calls.append(1)
+            yield from range(5)
+
+        assert list(paddle.reader.buffered(src, 2)()) == list(range(5))
+        cached = paddle.reader.cache(src)
+        n0 = len(calls)
+        assert list(cached()) == list(range(5))
+        assert list(cached()) == list(range(5))
+        assert len(calls) == n0 + 1  # generator consumed exactly once more
+
+    def test_xmap_ordered(self):
+        r = paddle.reader.xmap_readers(lambda x: x * x,
+                                       lambda: iter(range(10)),
+                                       process_num=3, buffer_size=4,
+                                       order=True)
+        assert list(r()) == [i * i for i in range(10)]
+
+    def test_xmap_unordered(self):
+        r = paddle.reader.xmap_readers(lambda x: x + 1,
+                                       lambda: iter(range(10)),
+                                       process_num=2, buffer_size=4)
+        assert sorted(r()) == list(range(1, 11))
+
+
+class TestCostModel:
+    def test_measure_and_table(self):
+        cm = paddle.cost_model.CostModel()
+        t = cm.measure_op("matmul", [(64, 64), (64, 64)], iters=3, warmup=1)
+        assert t > 0
+        assert cm.static_cost_data()  # cached
+        # cached second call returns identical value
+        assert cm.measure_op("matmul", [(64, 64), (64, 64)]) == t
+
+    def test_static_op_time_shape(self):
+        cm = paddle.cost_model.CostModel()
+        out = cm.get_static_op_time("relu", input_shapes=[(128, 128)])
+        assert out["op_time"] > 0 and out["op_name"] == "relu"
+
+    def test_estimates_monotone(self):
+        cm = paddle.cost_model.CostModel()
+        assert cm.estimate_matmul_time(8192, 8192, 8192) > \
+            cm.estimate_matmul_time(512, 512, 512)
+        assert cm.estimate_collective_time(1 << 30, 8) > \
+            cm.estimate_collective_time(1 << 20, 8)
+        assert cm.estimate_collective_time(1 << 20, 1) == 0.0
+
+
+class TestReviewRegressions:
+    def test_frame_1d_axis0_layout(self):
+        import paddle_tpu as paddle
+
+        x = np.arange(12, dtype="float32")
+        fr = paddle.signal.frame(paddle.to_tensor(x), 4, 2, axis=0)
+        assert tuple(fr.shape) == (5, 4)  # [num, frame_length]
+        np.testing.assert_array_equal(fr.numpy()[2], x[4:8])
+        # non-overlapping round trip through axis-0 overlap_add
+        fr2 = paddle.signal.frame(paddle.to_tensor(x), 4, 4, axis=0)
+        rec = paddle.signal.overlap_add(fr2, 4, axis=0)
+        np.testing.assert_array_equal(rec.numpy(), x)
+
+    def test_xmap_mapper_error_propagates(self):
+        import paddle_tpu as paddle
+
+        def bad(x):
+            raise RuntimeError("boom")
+
+        r = paddle.reader.xmap_readers(bad, lambda: iter(range(5)),
+                                       process_num=2, buffer_size=2)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(r())
+
+    def test_cost_model_unknown_op_raises(self):
+        import paddle_tpu as paddle
+
+        cm = paddle.cost_model.CostModel()
+        with pytest.raises(Exception) as ei:
+            cm.get_static_op_time("matmull")  # typo must not be estimated
+        assert "matmull" in str(ei.value)
