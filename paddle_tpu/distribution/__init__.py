@@ -520,3 +520,46 @@ def _kl_beta(p, q):
     return Tensor(betaln(qa, qb) - betaln(pa, pb)
                   + (pa - qa) * digamma(pa) + (pb - qb) * digamma(pb)
                   + (qa - pa + qb - pb) * digamma(pa + pb))
+
+
+# additional families + transforms (distribution/extra.py)
+from .extra import (  # noqa: E402
+    AffineTransform, Binomial, Cauchy, ChainTransform, Chi2, ExpTransform,
+    Independent, MultivariateNormal, Poisson, PowerTransform,
+    SigmoidTransform, StudentT, TanhTransform, Transform,
+    TransformedDistribution,
+)
+
+__all__ += [
+    "Poisson", "Binomial", "Cauchy", "Chi2", "StudentT",
+    "MultivariateNormal", "Independent", "TransformedDistribution",
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "TanhTransform", "PowerTransform", "ChainTransform",
+]
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return Tensor(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+                  - p.rate + q.rate)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    d = p.event_shape[0]
+    diff = q.loc - p.loc
+    # lax triangular_solve does not broadcast batch dims: align loc-induced
+    # and scale-induced batches explicitly (same workaround as
+    # MultivariateNormal.log_prob)
+    batch = jnp.broadcast_shapes(diff.shape[:-1], p._tril.shape[:-2],
+                                 q._tril.shape[:-2])
+    lq = jnp.broadcast_to(q._tril, batch + q._tril.shape[-2:])
+    lp = jnp.broadcast_to(p._tril, batch + p._tril.shape[-2:])
+    diff = jnp.broadcast_to(diff, batch + diff.shape[-1:])
+    m = jax.scipy.linalg.solve_triangular(lq, lp, lower=True)
+    tr = (m ** 2).sum((-2, -1))
+    z = jax.scipy.linalg.solve_triangular(lq, diff[..., None],
+                                          lower=True)[..., 0]
+    logdet = (jnp.log(jnp.diagonal(lq, axis1=-2, axis2=-1)).sum(-1)
+              - jnp.log(jnp.diagonal(lp, axis1=-2, axis2=-1)).sum(-1))
+    return Tensor(0.5 * (tr + (z ** 2).sum(-1) - d) + logdet)

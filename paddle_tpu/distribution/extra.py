@@ -1,0 +1,414 @@
+"""Additional distributions + the transform system.
+
+Analog of the rest of python/paddle/distribution: poisson.py, binomial.py,
+cauchy.py, chi2.py, student_t.py, multivariate_normal.py, independent.py,
+transformed_distribution.py and transform.py (Transform/Affine/Exp/
+Sigmoid/Tanh/Power/Chain).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+from jax.scipy.special import gammaln, xlogy
+
+from ..core.tensor import Tensor
+from . import Distribution, _key, _val
+
+__all__ = [
+    "Poisson", "Binomial", "Cauchy", "Chi2", "StudentT",
+    "MultivariateNormal", "Independent", "TransformedDistribution",
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "TanhTransform", "PowerTransform", "ChainTransform",
+]
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        out = jax.random.poisson(_key(), self.rate, self._extend(shape))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(xlogy(v, self.rate) - self.rate - gammaln(v + 1.0))
+
+    def entropy(self):
+        # series approximation (matches the reference's formula for large
+        # rate; exact summation is unbounded)
+        r = self.rate
+        h = (0.5 * jnp.log(2 * math.pi * math.e * r)
+             - 1 / (12 * r) - 1 / (24 * r ** 2))
+        small = jnp.where(r < 10,
+                          self._small_rate_entropy(), h)
+        return Tensor(small)
+
+    def _small_rate_entropy(self, terms: int = 64):
+        k = jnp.arange(terms, dtype=jnp.float32)
+        r = self.rate[..., None]
+        logp = xlogy(k, r) - r - gammaln(k + 1.0)
+        return -(jnp.exp(logp) * logp).sum(-1)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(total_count)
+        self.probs = _val(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        # O(1) memory per element (vs the naive (..., n) Bernoulli table)
+        out = jax.random.binomial(_key(),
+                                  jnp.asarray(self.total_count, jnp.float32),
+                                  self.probs, shape=self._extend(shape))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        n = self.total_count
+        return Tensor(gammaln(n + 1.0) - gammaln(v + 1.0)
+                      - gammaln(n - v + 1.0) + xlogy(v, self.probs)
+                      + xlogy(n - v, 1.0 - self.probs))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape), minval=1e-6,
+                               maxval=1 - 1e-6)
+        return Tensor(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return Tensor(jstats.cauchy.logpdf(_val(value), self.loc,
+                                           self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                       self._batch_shape))
+
+    def cdf(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Chi2(Distribution):
+    def __init__(self, df, name=None):
+        self.df = _val(df)
+        super().__init__(self.df.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.df)
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.df)
+
+    def rsample(self, shape=()):
+        g = jax.random.gamma(_key(), self.df / 2.0, self._extend(shape))
+        return Tensor(2.0 * g)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _val(value)
+        k2 = self.df / 2.0
+        return Tensor((k2 - 1) * jnp.log(v) - v / 2.0 - k2 * math.log(2.0)
+                      - gammaln(k2))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _val(df)
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2,
+                      self.scale ** 2 * self.df / (self.df - 2), jnp.inf)
+        return Tensor(jnp.where(self.df > 1, v, jnp.nan))
+
+    def rsample(self, shape=()):
+        sh = self._extend(shape)
+        z = jax.random.normal(_key(), sh)
+        g = jax.random.gamma(_key(), self.df / 2.0, sh)
+        chi2 = 2.0 * g
+        return Tensor(self.loc + self.scale * z
+                      * jnp.sqrt(self.df / chi2))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return Tensor(jstats.t.logpdf(z, self.df) - jnp.log(self.scale))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _val(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError("provide exactly one of covariance_matrix or "
+                             "scale_tril")
+        if covariance_matrix is not None:
+            cov = _val(covariance_matrix)
+            self._tril = jnp.linalg.cholesky(cov)
+        else:
+            self._tril = _val(scale_tril)
+        d = self.loc.shape[-1]
+        if self._tril.shape[-2:] != (d, d):
+            raise ValueError(f"scale shape {self._tril.shape[-2:]} does not "
+                             f"match event dim {d}")
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self._tril.shape[:-2])
+        super().__init__(batch, (d,))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc,
+                                       self._batch_shape + self._event_shape))
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    def rsample(self, shape=()):
+        sh = tuple(shape) + self._batch_shape + self._event_shape
+        eps = jax.random.normal(_key(), sh)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i", self._tril,
+                                            eps))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        d = self._event_shape[0]
+        diff = _val(value) - self.loc
+        # solve L z = diff (triangular); lax triangular_solve does not
+        # broadcast batch dims, so align them explicitly
+        L = jnp.broadcast_to(self._tril,
+                             diff.shape[:-1] + self._tril.shape[-2:])
+        z = jax.scipy.linalg.solve_triangular(
+            L, diff[..., None], lower=True)[..., 0]
+        half_logdet = jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                           axis2=-1)).sum(-1)
+        return Tensor(-0.5 * (z ** 2).sum(-1) - half_logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self._event_shape[0]
+        half_logdet = jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                           axis2=-1)).sum(-1)
+        h = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return Tensor(jnp.broadcast_to(h, self._batch_shape))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_ndims`` batch dims
+    as event dims (independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self._n = int(reinterpreted_batch_ndims)
+        if self._n > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_ndims exceeds batch rank")
+        cut = len(base.batch_shape) - self._n
+        super().__init__(base.batch_shape[:cut],
+                         base.batch_shape[cut:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._value
+        axes = tuple(range(-self._n, 0)) if self._n else ()
+        return Tensor(lp.sum(axes) if axes else lp)
+
+    def entropy(self):
+        h = self.base.entropy()._value
+        axes = tuple(range(-self._n, 0)) if self._n else ()
+        return Tensor(h.sum(axes) if axes else h)
+
+
+# --------------------------------------------------------------------------
+# transforms (transform.py)
+# --------------------------------------------------------------------------
+
+class Transform:
+    def forward(self, x):
+        return Tensor(self._forward(_val(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_val(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_val(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _val(y)
+        return Tensor(-self._fldj(self._inverse(yv)))
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-6, 1 - 1e-6))
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _val(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through transforms
+    (transformed_distribution.py); univariate events."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)._value
+        for t in self.transforms:
+            x = t._forward(x)
+        return Tensor(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)._value
+        for t in self.transforms:
+            x = t._forward(x)
+        return Tensor(x)
+
+    def log_prob(self, value):
+        y = _val(value)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            lp = lp - t._fldj(x)
+            y = x
+        return Tensor(lp + self.base.log_prob(Tensor(y))._value)

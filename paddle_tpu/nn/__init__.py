@@ -10,6 +10,8 @@ from .common import (
     Hardswish, Hardsigmoid, Hardtanh, Hardshrink, Softshrink, Tanhshrink,
     ThresholdedReLU, Softplus, Softsign, Sigmoid, Tanh, LogSigmoid, Softmax,
     LogSoftmax, Maxout, GLU,
+    PixelUnshuffle, ChannelShuffle, Unfold, Fold, MaxUnPool2D, Dropout3D,
+    AlphaDropout, RReLU, UpsamplingNearest2D, UpsamplingBilinear2D,
 )
 from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose
 from .norm import (
@@ -24,6 +26,9 @@ from .container import LayerDict, LayerList, ParameterList, Sequential
 from .loss import (
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss, MSELoss,
     NLLLoss, SmoothL1Loss,
+    MarginRankingLoss, SoftMarginLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, MultiLabelSoftMarginLoss,
+    GaussianNLLLoss, PoissonNLLLoss, CTCLoss,
 )
 from .transformer import (
     MultiHeadAttention, Transformer, TransformerDecoder,

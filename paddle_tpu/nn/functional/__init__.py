@@ -27,9 +27,15 @@ from ...ops.nn_ops import (  # noqa: F401
     softmax_with_cross_entropy, binary_cross_entropy,
     binary_cross_entropy_with_logits, mse_loss, l1_loss, smooth_l1_loss,
     kl_div, nll_loss, cosine_similarity, pixel_shuffle, unfold,
+    local_response_norm, max_unpool2d, npair_loss,
 )
 from ...ops.math import sigmoid, tanh  # noqa: F401
 from ...ops.manip import pad, one_hot  # noqa: F401
+# yaml-schema ops with torch-golden generated tests (ops/yaml/ops.yaml)
+from ...ops.generated import (  # noqa: F401
+    affine_grid, channel_shuffle, fold, grid_sample, pixel_unshuffle,
+    temporal_shift,
+)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
@@ -150,3 +156,106 @@ def scaled_dot_product_attention_(q, k, v, attn_mask=None, dropout_p=0.0,
         mask_t = Tensor(jax.random.bernoulli(key_, 1.0 - dropout_p, (b, h, sq, sk)))
     return dispatch("scaled_dot_product_attention", q, k, v, attn_mask=attn_mask,
                     dropout_mask=mask_t, dropout_p=dropout_p, is_causal=is_causal)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    """SELU-preserving dropout (reference functional/common.py
+    alpha_dropout): dropped units take alpha' and an affine (a, b)
+    restores zero mean / unit variance."""
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766  # -scale * alpha of SELU
+    a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    key = _random.default_generator().next_key()
+    keep = Tensor(jax.random.bernoulli(key, 1.0 - p, tuple(x.shape)))
+    kept = dispatch("cast", keep, dtype=jnp.float32)
+    return (x * kept + (1.0 - kept) * alpha_p) * a + b
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    """Differentiable categorical relaxation (reference functional/
+    activation.py gumbel_softmax); ``hard`` uses the straight-through
+    one-hot."""
+    key = _random.default_generator().next_key()
+    u = jax.random.uniform(key, tuple(x.shape), minval=1e-10, maxval=1.0)
+    g = Tensor(-jnp.log(-jnp.log(u)))
+    y = softmax((x + g) / float(temperature), axis=axis)
+    if not hard:
+        return y
+    idx = dispatch("argmax", y, axis=axis)
+    y_hard = dispatch("one_hot", idx, num_classes=x.shape[axis])
+    y_hard = dispatch("cast", y_hard, dtype=jnp.float32)
+    if axis != -1 and axis != len(x.shape) - 1:
+        perm = list(range(len(x.shape)))
+        perm.insert(axis, perm.pop(-1))
+        y_hard = dispatch("transpose", y_hard, perm=tuple(perm))
+    return y_hard - y.detach() + y
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    """Randomized leaky ReLU (reference functional/activation.py rrelu):
+    negative slope ~ U[lower, upper] per element in training, the mean
+    slope at inference."""
+    if not training:
+        return leaky_relu(x, negative_slope=(lower + upper) / 2.0)
+    key = _random.default_generator().next_key()
+    slope = Tensor(jax.random.uniform(key, tuple(x.shape),
+                                      minval=lower, maxval=upper))
+    neg = x * slope
+    pos_mask = dispatch("cast", x > 0.0, dtype=jnp.float32)
+    return x * pos_mask + neg * (1.0 - pos_mask)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC-style class-center sampling (reference
+    functional/common.py class_center_sample): keep all positive classes,
+    fill to ``num_samples`` with uniformly sampled negatives; returns
+    (remapped_label, sampled_class_center). Host-side sampling (eager)."""
+    import numpy as np
+
+    lbl = np.asarray(label._value if isinstance(label, Tensor) else label)
+    pos = np.unique(lbl)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos,
+                                assume_unique=True)
+        seed = int(jax.random.randint(
+            _random.default_generator().next_key(), (), 0, 2 ** 31 - 1))
+        rng = np.random.default_rng(seed)
+        extra = rng.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    remapped = np.vectorize(lambda c: remap[c])(lbl).astype(lbl.dtype)
+    return Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss over the warpctc op (reference functional/loss.py ctc_loss:
+    'mean' divides each example's loss by its label length)."""
+    loss = dispatch("warpctc", log_probs, labels, input_lengths,
+                    label_lengths, blank=blank, norm_by_times=norm_by_times)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return loss.sum()
+    ll = label_lengths._value if isinstance(label_lengths, Tensor) \
+        else jnp.asarray(label_lengths)
+    return (loss / Tensor(jnp.maximum(ll, 1).astype(jnp.float32))).mean()
+
+
+from ...ops.generated import max_pool2d_with_index  # noqa: F401,E402
+from ...ops.nn_ops import (  # noqa: F401,E402
+    margin_ranking_loss, soft_margin_loss, hinge_embedding_loss,
+    cosine_embedding_loss, triplet_margin_loss,
+    multi_label_soft_margin_loss, gaussian_nll_loss, poisson_nll_loss,
+    square_error_cost, dice_loss, sigmoid_focal_loss,
+)

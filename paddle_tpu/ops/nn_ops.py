@@ -737,3 +737,183 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
     n1 = jnp.linalg.norm(x1, axis=axis)
     n2 = jnp.linalg.norm(x2, axis=axis)
     return dot_ / jnp.maximum(n1 * n2, eps)
+
+
+@register("local_response_norm", amp="black")
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    """ref: python/paddle/nn/functional/norm.py local_response_norm — the
+    window statistic is the MEAN of squares (avg_pool over the channel
+    window), with (size//2, (size-1)//2) channel padding."""
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[ch_axis] = (size // 2, (size - 1) // 2)
+    window = [1] * x.ndim
+    window[ch_axis] = size
+    acc = lax.reduce_window(jnp.pad(sq, pad_cfg), 0.0, lax.add,
+                            tuple(window), (1,) * x.ndim, "valid") / size
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+@register("max_unpool2d")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Scatter pooled values back to ``indices`` (flattened input-plane
+    positions from max_pool2d_with_index; ref: phi unpool kernel)."""
+    n, c, h, w = x.shape
+    kh, kw = _norm_tuple(kernel_size, 2)
+    sh, sw = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    ph, pw = _norm_tuple(padding, 2)
+    if output_size is None:
+        oh = (h - 1) * sh - 2 * ph + kh
+        ow = (w - 1) * sw - 2 * pw + kw
+    else:
+        oh, ow = _norm_tuple(output_size, 2)
+    flat = jnp.reshape(x, (n, c, h * w))
+    fidx = jnp.reshape(indices, (n, c, h * w)).astype(jnp.int32)
+    bidx = jnp.arange(n)[:, None, None]
+    cidx = jnp.arange(c)[None, :, None]
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = out.at[bidx, cidx, fidx].set(flat)
+    return jnp.reshape(out, (n, c, oh, ow))
+
+
+@register("npair_loss", amp="black")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """ref: python/paddle/nn/functional/loss.py npair_loss — cross-entropy
+    over anchor·positiveᵀ with same-label soft targets + L2 pull."""
+    lab = labels.reshape(-1).astype(jnp.float32)
+    same = (lab[:, None] == lab[None, :]).astype(jnp.float32)
+    targets = same / jnp.maximum(same.sum(axis=1, keepdims=True), 1.0)
+    sim = anchor @ positive.T
+    logp = jax.nn.log_softmax(sim, axis=-1)
+    ce = -(targets * logp).sum(-1).mean()
+    l2 = ((anchor ** 2).sum(-1) + (positive ** 2).sum(-1)).mean() \
+        * (l2_reg * 0.25)
+    return ce + l2
+
+
+# --------------------------------------------------------------------------
+# loss breadth (ref: python/paddle/nn/functional/loss.py — the remaining
+# margin/embedding/nll family)
+# --------------------------------------------------------------------------
+
+def _reduce(out, reduction):
+    if reduction == "none":
+        return out
+    if reduction == "sum":
+        return jnp.sum(out)
+    return jnp.mean(out)
+
+
+@register("margin_ranking_loss", amp="black")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    out = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(out, reduction)
+
+
+@register("soft_margin_loss", amp="black")
+def soft_margin_loss(input, label, reduction="mean"):  # noqa: A002
+    # softplus form: log(1 + exp(z)) without overflow for large z
+    out = jax.nn.softplus(-label * input)
+    return _reduce(out, reduction)
+
+
+@register("hinge_embedding_loss", amp="black")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):  # noqa: A002
+    out = jnp.where(label == 1.0, input,
+                    jnp.maximum(0.0, margin - input))
+    return _reduce(out, reduction)
+
+
+@register("cosine_embedding_loss", amp="black")
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    cos = jnp.sum(input1 * input2, -1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    out = jnp.where(label == 1, 1.0 - cos,
+                    jnp.maximum(0.0, cos - margin))
+    return _reduce(out, reduction)
+
+
+@register("triplet_margin_loss", amp="black")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), -1),
+                         1.0 / p)
+
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+@register("multi_label_soft_margin_loss", amp="black")
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean"):
+    term = (label * jax.nn.log_sigmoid(input)
+            + (1.0 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        term = term * weight
+    out = -term.mean(-1)
+    return _reduce(out, reduction)
+
+
+@register("gaussian_nll_loss", amp="black")
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,  # noqa: A002
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    out = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        out = out + 0.5 * jnp.log(2.0 * jnp.pi)
+    return _reduce(out, reduction)
+
+
+@register("poisson_nll_loss", amp="black")
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        out = jnp.exp(input) - label * input
+    else:
+        out = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for label! (reference loss.py)
+        stirling = (label * jnp.log(label) - label
+                    + 0.5 * jnp.log(2.0 * jnp.pi * label))
+        out = out + jnp.where(label > 1.0, stirling, 0.0)
+    return _reduce(out, reduction)
+
+
+@register("square_error_cost", amp="black")
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(input - label)
+
+
+@register("dice_loss", amp="black")
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    # input [N, ..., C] probabilities, label [N, ..., 1] int
+    lab = jnp.squeeze(label, -1)
+    oh = jax.nn.one_hot(lab, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = 2.0 * jnp.sum(input * oh, reduce_dims)
+    denom = jnp.sum(input, reduce_dims) + jnp.sum(oh, reduce_dims)
+    return jnp.mean(1.0 - (inter + epsilon) / (denom + epsilon))
+
+
+@register("sigmoid_focal_loss", amp="black")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = (jnp.maximum(logit, 0.0) - logit * label
+          + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    out = ce * jnp.power(1.0 - p_t, gamma)
+    if alpha >= 0:
+        out = out * (alpha * label + (1.0 - alpha) * (1.0 - label))
+    if normalizer is not None:
+        out = out / normalizer
+    return _reduce(out, reduction)

@@ -738,7 +738,12 @@ def warpctc(logits, label, logits_length, labels_length, blank=0,
     ln = label.shape[1]
     ypad = (jnp.arange(ln)[None, :] >= labels_length[:, None]).astype(
         jnp.float32)
-    return optax.ctc_loss(logprobs, lpad, label, ypad, blank_id=blank)
+    loss = optax.ctc_loss(logprobs, lpad, label, ypad, blank_id=blank)
+    if norm_by_times:
+        # reference warpctc norm_by_times: per-example loss (and hence its
+        # gradient) scaled by the number of valid timesteps
+        loss = loss / jnp.maximum(logits_length, 1).astype(jnp.float32)
+    return loss
 
 
 def fused_softmax_mask(x, mask):

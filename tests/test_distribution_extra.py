@@ -1,0 +1,203 @@
+"""Extra distributions + transforms (distribution/extra.py) vs
+scipy/torch goldens."""
+
+import numpy as np
+import pytest
+import scipy.stats as ss
+
+import paddle_tpu as paddle
+
+D = paddle.distribution
+
+
+class TestFamilies:
+    def test_poisson(self):
+        p = D.Poisson(np.array([2.0, 7.5], "float32"))
+        v = np.array([1.0, 6.0], "float32")
+        np.testing.assert_allclose(p.log_prob(v).numpy(),
+                                   ss.poisson.logpmf(v, [2.0, 7.5]),
+                                   rtol=1e-5)
+        s = p.sample((500,))
+        assert np.all(s.numpy() >= 0)
+        np.testing.assert_allclose(s.numpy().mean(0), [2.0, 7.5], atol=0.5)
+        np.testing.assert_allclose(p.entropy().numpy(),
+                                   [ss.poisson.entropy(2.0),
+                                    ss.poisson.entropy(7.5)], atol=2e-2)
+
+    def test_binomial(self):
+        b = D.Binomial(10, np.array(0.3, "float32"))
+        v = np.arange(0, 11, dtype="float32")
+        np.testing.assert_allclose(b.log_prob(v).numpy(),
+                                   ss.binom.logpmf(v, 10, 0.3), rtol=1e-4,
+                                   atol=1e-5)
+        s = b.sample((800,)).numpy()
+        assert s.min() >= 0 and s.max() <= 10
+        np.testing.assert_allclose(s.mean(), 3.0, atol=0.3)
+
+    def test_cauchy(self):
+        c = D.Cauchy(1.0, 2.0)
+        v = np.array([-3.0, 0.0, 4.0], "float32")
+        np.testing.assert_allclose(c.log_prob(v).numpy(),
+                                   ss.cauchy.logpdf(v, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(c.cdf(v).numpy(),
+                                   ss.cauchy.cdf(v, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(float(c.entropy().numpy()),
+                                   ss.cauchy.entropy(1.0, 2.0), rtol=1e-5)
+
+    def test_chi2(self):
+        c = D.Chi2(np.array(3.0, "float32"))
+        v = np.array([0.5, 2.0, 9.0], "float32")
+        np.testing.assert_allclose(c.log_prob(v).numpy(),
+                                   ss.chi2.logpdf(v, 3.0), rtol=1e-4)
+        s = c.sample((1000,)).numpy()
+        np.testing.assert_allclose(s.mean(), 3.0, atol=0.4)
+
+    def test_student_t(self):
+        t = D.StudentT(5.0, loc=1.0, scale=2.0)
+        v = np.array([-1.0, 1.0, 3.0], "float32")
+        np.testing.assert_allclose(t.log_prob(v).numpy(),
+                                   ss.t.logpdf(v, 5.0, 1.0, 2.0), rtol=1e-4)
+        s = t.sample((4000,)).numpy()
+        np.testing.assert_allclose(s.mean(), 1.0, atol=0.3)
+
+    def test_multivariate_normal(self):
+        mu = np.array([1.0, -1.0], "float32")
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        m = D.MultivariateNormal(mu, covariance_matrix=cov)
+        v = np.array([[0.0, 0.0], [1.0, -1.0]], "float32")
+        np.testing.assert_allclose(m.log_prob(v).numpy(),
+                                   ss.multivariate_normal.logpdf(v, mu, cov),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(float(m.entropy().numpy()),
+                                   ss.multivariate_normal.entropy(mu, cov),
+                                   rtol=1e-5)
+        s = m.rsample((3000,)).numpy()
+        np.testing.assert_allclose(s.mean(0), mu, atol=0.15)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.25)
+        np.testing.assert_allclose(m.covariance_matrix.numpy(), cov,
+                                   rtol=1e-5)
+
+    def test_mvn_validates(self):
+        with pytest.raises(ValueError):
+            D.MultivariateNormal(np.zeros(2, "float32"))
+        with pytest.raises(ValueError):
+            D.MultivariateNormal(np.zeros(2, "float32"),
+                                 covariance_matrix=np.eye(3, dtype="float32"))
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((3, 4), "float32"),
+                        np.ones((3, 4), "float32"))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        v = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(ind.log_prob(v).numpy(),
+                                   base.log_prob(v).numpy().sum(-1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(ind.entropy().numpy(),
+                                   base.entropy().numpy().sum(-1), rtol=1e-5)
+
+
+class TestTransforms:
+    def test_affine_roundtrip_ldj(self):
+        t = D.AffineTransform(2.0, 3.0)
+        x = np.array([-1.0, 0.5], "float32")
+        y = t.forward(x).numpy()
+        np.testing.assert_allclose(y, 2.0 + 3.0 * x, rtol=1e-6)
+        np.testing.assert_allclose(t.inverse(y).numpy(), x, rtol=1e-6)
+        np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                                   np.log(3.0), rtol=1e-6)
+
+    @pytest.mark.parametrize("tf,xs", [
+        (D.ExpTransform(), [-1.0, 0.0, 2.0]),
+        (D.SigmoidTransform(), [-2.0, 0.0, 3.0]),
+        (D.TanhTransform(), [-1.5, 0.0, 1.0]),
+        (D.PowerTransform(2.0), [0.5, 1.0, 2.0]),
+    ])
+    def test_roundtrip_and_numeric_ldj(self, tf, xs):
+        import jax
+
+        x = np.asarray(xs, "float32")
+        y = tf.forward(x).numpy()
+        np.testing.assert_allclose(tf.inverse(y).numpy(), x, atol=1e-4)
+        # numeric jacobian check
+        num = jax.vmap(jax.grad(lambda v: tf._forward(v)))(
+            np.asarray(xs, "float32"))
+        np.testing.assert_allclose(tf.forward_log_det_jacobian(x).numpy(),
+                                   np.log(np.abs(np.asarray(num))),
+                                   atol=1e-4)
+
+    def test_chain(self):
+        t = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.ExpTransform()])
+        x = np.array([0.5], "float32")
+        np.testing.assert_allclose(t.forward(x).numpy(), np.exp(2 * x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(), x,
+                                   rtol=1e-5)
+        # ldj accumulates through the chain
+        np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                                   np.log(2.0) + 2 * x, rtol=1e-5)
+
+
+class TestTransformedDistribution:
+    def test_lognormal_via_transform(self):
+        base = D.Normal(np.float32(0.3), np.float32(0.7))
+        td = D.TransformedDistribution(base, D.ExpTransform())
+        ln = D.LogNormal(np.float32(0.3), np.float32(0.7))
+        v = np.array([0.5, 1.0, 2.5], "float32")
+        np.testing.assert_allclose(td.log_prob(v).numpy(),
+                                   ln.log_prob(v).numpy(), rtol=1e-4)
+
+    def test_sampling_range(self):
+        base = D.Normal(np.float32(0.0), np.float32(1.0))
+        td = D.TransformedDistribution(base, D.SigmoidTransform())
+        s = td.sample((200,)).numpy()
+        assert np.all((s > 0) & (s < 1))
+
+
+class TestNewKLs:
+    def test_kl_poisson(self):
+        p, q = D.Poisson(np.float32(3.0)), D.Poisson(np.float32(5.0))
+        want = 3.0 * (np.log(3.0) - np.log(5.0)) - 3.0 + 5.0
+        np.testing.assert_allclose(float(D.kl_divergence(p, q).numpy()),
+                                   want, rtol=1e-5)
+
+    def test_kl_mvn_zero_for_identical(self):
+        mu = np.array([1.0, 2.0], "float32")
+        cov = np.array([[1.5, 0.2], [0.2, 0.8]], "float32")
+        p = D.MultivariateNormal(mu, covariance_matrix=cov)
+        q = D.MultivariateNormal(mu, covariance_matrix=cov)
+        np.testing.assert_allclose(float(D.kl_divergence(p, q).numpy()),
+                                   0.0, atol=1e-5)
+
+    def test_kl_mvn_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        mu_p = np.array([0.0, 1.0], "float32")
+        mu_q = np.array([1.0, -1.0], "float32")
+        cov_p = np.array([[2.0, 0.3], [0.3, 1.0]], "float32")
+        cov_q = np.array([[1.0, 0.0], [0.0, 3.0]], "float32")
+        p = D.MultivariateNormal(mu_p, covariance_matrix=cov_p)
+        q = D.MultivariateNormal(mu_q, covariance_matrix=cov_q)
+        tp = torch.distributions.MultivariateNormal(
+            torch.tensor(mu_p), torch.tensor(cov_p))
+        tq = torch.distributions.MultivariateNormal(
+            torch.tensor(mu_q), torch.tensor(cov_q))
+        want = float(torch.distributions.kl_divergence(tp, tq))
+        np.testing.assert_allclose(float(D.kl_divergence(p, q).numpy()),
+                                   want, rtol=1e-4)
+
+
+class TestReviewRegressions:
+    def test_kl_mvn_batched_loc_shared_cov(self):
+        mu = np.random.randn(3, 2).astype("float32")
+        cov = np.array([[1.5, 0.2], [0.2, 0.8]], "float32")
+        p = D.MultivariateNormal(mu, covariance_matrix=cov)
+        q = D.MultivariateNormal(np.zeros(2, "float32"),
+                                 covariance_matrix=cov)
+        kl = D.kl_divergence(p, q).numpy()
+        assert kl.shape == (3,) and np.all(kl >= -1e-6)
+
+    def test_binomial_large_n_sample(self):
+        b = D.Binomial(1_000_000, np.float32(0.25))
+        s = b.sample((16,)).numpy()
+        np.testing.assert_allclose(s.mean(), 250_000, rtol=0.01)

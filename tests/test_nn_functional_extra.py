@@ -1,0 +1,307 @@
+"""Round-2 functional breadth: lrn/unpool/npair + RNG-based activations,
+cross-checked against torch where it has the op."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+F = paddle.nn.functional
+
+
+class TestDeterministicOps:
+    def test_local_response_norm_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(2, 8, 5, 5).astype("float32")
+        got = F.local_response_norm(paddle.to_tensor(x), size=3, alpha=1e-3,
+                                    beta=0.75, k=1.5).numpy()
+        want = torch.nn.functional.local_response_norm(
+            torch.from_numpy(x), 3, alpha=1e-3, beta=0.75, k=1.5).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+    def test_max_pool_unpool_roundtrip_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(2, 3, 8, 8).astype("float32")
+        out, idx = F.max_pool2d_with_index(paddle.to_tensor(x), 2, stride=2) \
+            if hasattr(F, "max_pool2d_with_index") else (None, None)
+        if out is None:
+            from paddle_tpu.ops.generated import max_pool2d_with_index
+            out, idx = max_pool2d_with_index(paddle.to_tensor(x), 2, stride=2)
+        rec = F.max_unpool2d(out, idx, 2, stride=2)
+        tout, tidx = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 2, stride=2, return_indices=True)
+        trec = torch.nn.functional.max_unpool2d(tout, tidx, 2, stride=2)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(rec.numpy(), trec.numpy(), rtol=1e-6)
+
+    def test_npair_loss_matches_manual(self):
+        a = np.random.randn(4, 6).astype("float32")
+        p = np.random.randn(4, 6).astype("float32")
+        lab = np.array([0, 1, 0, 2], "int64")
+        got = float(F.npair_loss(paddle.to_tensor(a), paddle.to_tensor(p),
+                                 paddle.to_tensor(lab),
+                                 l2_reg=0.01).numpy())
+        sim = a @ p.T
+        same = (lab[:, None] == lab[None, :]).astype("float64")
+        tgt = same / same.sum(1, keepdims=True)
+        logp = sim - np.log(np.exp(sim).sum(1, keepdims=True))
+        ce = float((-(tgt * logp).sum(1)).mean())
+        l2 = float(((a ** 2).sum(1) + (p ** 2).sum(1)).mean() * 0.01 * 0.25)
+        np.testing.assert_allclose(got, ce + l2, rtol=1e-4)
+
+    def test_grid_sample_affine_grid_exports(self):
+        # identity theta reproduces the input through the full pipeline
+        x = np.random.randn(1, 2, 6, 6).astype("float32")
+        theta = np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], "float32")
+        grid = F.affine_grid(paddle.to_tensor(theta), (1, 2, 6, 6))
+        out = F.grid_sample(paddle.to_tensor(x), grid)
+        np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+
+    def test_fold_unfold_adjoint(self):
+        x = np.random.randn(1, 3, 6, 6).astype("float32")
+        cols = F.unfold(paddle.to_tensor(x), 2, strides=2)
+        rec = F.fold(cols, (6, 6), 2, strides=2)
+        np.testing.assert_allclose(rec.numpy(), x, atol=1e-6)
+
+    def test_pixel_unshuffle_inverts_shuffle(self):
+        x = np.random.randn(1, 4, 4, 4).astype("float32")
+        up = F.pixel_shuffle(paddle.to_tensor(x), 2)
+        back = F.pixel_unshuffle(up, 2)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-6)
+
+    def test_channel_shuffle_permutes(self):
+        x = np.arange(8, dtype="float32").reshape(1, 8, 1, 1)
+        got = F.channel_shuffle(paddle.to_tensor(x), 2).numpy().ravel()
+        np.testing.assert_array_equal(got, [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+class TestRandomOps:
+    def test_gumbel_softmax_soft_and_hard(self):
+        paddle.seed(3)
+        x = paddle.to_tensor(np.random.randn(16, 5).astype("float32"))
+        y = F.gumbel_softmax(x, temperature=0.5)
+        np.testing.assert_allclose(y.numpy().sum(-1), 1.0, atol=1e-5)
+        h = F.gumbel_softmax(x, temperature=0.5, hard=True)
+        hv = h.numpy()
+        assert set(np.unique(hv)).issubset({0.0, 1.0})
+        np.testing.assert_allclose(hv.sum(-1), 1.0, atol=1e-6)
+
+    def test_gumbel_softmax_hard_grad_flows(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(np.random.randn(4, 3).astype("float32"))
+        x.stop_gradient = False
+        (F.gumbel_softmax(x, hard=True) * 2.0).sum().backward()
+        assert x.grad is not None and np.any(x.grad.numpy() != 0)
+
+    def test_rrelu(self):
+        paddle.seed(1)
+        x = paddle.to_tensor(np.array([-4.0, -2.0, 3.0], "float32"))
+        infer = F.rrelu(x, training=False).numpy()
+        mid = (1 / 8 + 1 / 3) / 2
+        np.testing.assert_allclose(infer, [-4 * mid, -2 * mid, 3.0],
+                                   rtol=1e-6)
+        tr = F.rrelu(x, training=True).numpy()
+        assert tr[2] == 3.0
+        for i in (0, 1):  # slope within [lower, upper]
+            slope = tr[i] / float(x.numpy()[i])
+            assert 1 / 8 - 1e-6 <= slope <= 1 / 3 + 1e-6
+
+    def test_alpha_dropout_stats(self):
+        paddle.seed(2)
+        x = paddle.to_tensor(np.random.randn(200_0).astype("float32"))
+        y = F.alpha_dropout(x, p=0.3).numpy()
+        assert abs(y.mean()) < 0.15 and abs(y.std() - 1.0) < 0.2
+        y2 = F.alpha_dropout(x, p=0.3, training=False)
+        np.testing.assert_array_equal(y2.numpy(), x.numpy())
+
+    def test_dropout3d_drops_whole_channels(self):
+        paddle.seed(4)
+        x = paddle.to_tensor(np.ones((2, 8, 3, 4, 4), "float32"))
+        y = F.dropout3d(x, p=0.5).numpy()
+        flat = y.reshape(2, 8, -1)
+        for b in range(2):
+            for c in range(8):
+                vals = np.unique(flat[b, c])
+                assert len(vals) == 1  # entire channel kept or dropped
+
+    def test_class_center_sample(self):
+        paddle.seed(5)
+        labels = np.array([3, 7, 3, 42], "int64")
+        remapped, sampled = F.class_center_sample(
+            paddle.to_tensor(labels), num_classes=100, num_samples=10)
+        s = sampled.numpy()
+        assert len(s) == 10 and len(np.unique(s)) == 10
+        for orig in (3, 7, 42):
+            assert orig in s
+        r = remapped.numpy()
+        np.testing.assert_array_equal(s[r], labels)
+
+
+class TestLossFamily:
+    """New loss ops vs torch goldens (reference loss.py parity)."""
+
+    def _t(self, a):
+        return paddle.to_tensor(np.asarray(a, "float32"))
+
+    def test_margin_ranking_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x1 = np.random.randn(6).astype("float32")
+        x2 = np.random.randn(6).astype("float32")
+        y = np.sign(np.random.randn(6)).astype("float32")
+        got = float(F.margin_ranking_loss(self._t(x1), self._t(x2),
+                                          self._t(y), margin=0.3).numpy())
+        want = float(torch.nn.functional.margin_ranking_loss(
+            torch.tensor(x1), torch.tensor(x2), torch.tensor(y),
+            margin=0.3))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_soft_margin_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(8).astype("float32")
+        y = np.sign(np.random.randn(8)).astype("float32")
+        got = float(F.soft_margin_loss(self._t(x), self._t(y)).numpy())
+        want = float(torch.nn.functional.soft_margin_loss(
+            torch.tensor(x), torch.tensor(y)))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_hinge_embedding_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(8).astype("float32")
+        y = np.sign(np.random.randn(8)).astype("float32")
+        got = float(F.hinge_embedding_loss(self._t(x), self._t(y),
+                                           margin=0.8).numpy())
+        want = float(torch.nn.functional.hinge_embedding_loss(
+            torch.tensor(x), torch.tensor(y), margin=0.8))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cosine_embedding_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        a = np.random.randn(4, 8).astype("float32")
+        b = np.random.randn(4, 8).astype("float32")
+        y = np.sign(np.random.randn(4)).astype("float32")
+        got = float(F.cosine_embedding_loss(self._t(a), self._t(b),
+                                            self._t(y), margin=0.2).numpy())
+        want = float(torch.nn.functional.cosine_embedding_loss(
+            torch.tensor(a), torch.tensor(b), torch.tensor(y), margin=0.2))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_triplet_margin_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        a = np.random.randn(5, 7).astype("float32")
+        p = np.random.randn(5, 7).astype("float32")
+        n = np.random.randn(5, 7).astype("float32")
+        got = float(F.triplet_margin_loss(self._t(a), self._t(p), self._t(n),
+                                          margin=0.9, swap=True).numpy())
+        want = float(torch.nn.functional.triplet_margin_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n), margin=0.9,
+            swap=True))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_multilabel_soft_margin_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(3, 6).astype("float32")
+        y = (np.random.rand(3, 6) > 0.5).astype("float32")
+        got = float(F.multi_label_soft_margin_loss(self._t(x),
+                                                   self._t(y)).numpy())
+        want = float(torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(x), torch.tensor(y)))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_gaussian_nll_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(10).astype("float32")
+        y = np.random.randn(10).astype("float32")
+        v = np.random.rand(10).astype("float32") + 0.1
+        got = float(F.gaussian_nll_loss(self._t(x), self._t(y),
+                                        self._t(v), full=True).numpy())
+        want = float(torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(x), torch.tensor(y), torch.tensor(v), full=True))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_poisson_nll_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(10).astype("float32")
+        y = np.random.poisson(3.0, 10).astype("float32")
+        got = float(F.poisson_nll_loss(self._t(x), self._t(y),
+                                       full=True).numpy())
+        want = float(torch.nn.functional.poisson_nll_loss(
+            torch.tensor(x), torch.tensor(y), log_input=True, full=True))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_sigmoid_focal_loss_basics(self):
+        logit = np.random.randn(8).astype("float32")
+        lab = (np.random.rand(8) > 0.7).astype("float32")
+        out = float(F.sigmoid_focal_loss(self._t(logit),
+                                         self._t(lab)).numpy())
+        assert out > 0
+        # gamma=0, alpha=-1 degenerates to plain BCE-with-logits sum
+        got = float(F.sigmoid_focal_loss(self._t(logit), self._t(lab),
+                                         alpha=-1, gamma=0.0).numpy())
+        want = float(F.binary_cross_entropy_with_logits(
+            self._t(logit), self._t(lab), reduction="sum").numpy())
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_ctc_loss_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        T, B, V = 12, 2, 6
+        logits = np.random.randn(T, B, V).astype("float32")
+        labels = np.random.randint(1, V, (B, 4)).astype("int32")
+        in_len = np.array([12, 10], "int32")
+        lab_len = np.array([4, 3], "int32")
+        got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                         reduction="none").numpy()
+        want = torch.nn.functional.ctc_loss(
+            torch.tensor(logits).log_softmax(-1), torch.tensor(labels),
+            torch.tensor(in_len), torch.tensor(lab_len), blank=0,
+            reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_dice_square_error(self):
+        probs = np.random.rand(2, 3, 4).astype("float32")
+        probs /= probs.sum(-1, keepdims=True)
+        lab = np.random.randint(0, 4, (2, 3, 1)).astype("int64")
+        d = float(F.dice_loss(paddle.to_tensor(probs),
+                              paddle.to_tensor(lab)).numpy())
+        assert 0 <= d <= 1
+        a = np.random.randn(5).astype("float32")
+        b = np.random.randn(5).astype("float32")
+        np.testing.assert_allclose(
+            F.square_error_cost(self._t(a), self._t(b)).numpy(),
+            (a - b) ** 2, rtol=1e-6)
+
+    def test_loss_layers_exist_and_run(self):
+        a = self._t(np.random.randn(4, 5))
+        b = self._t(np.random.randn(4, 5))
+        y = self._t(np.sign(np.random.randn(4)))
+        assert np.isfinite(float(paddle.nn.TripletMarginLoss()(
+            a, b, self._t(np.random.randn(4, 5))).numpy()))
+        assert np.isfinite(float(paddle.nn.CosineEmbeddingLoss()(
+            a, b, y).numpy()))
+        assert np.isfinite(float(paddle.nn.MarginRankingLoss()(
+            self._t(np.random.randn(4)), self._t(np.random.randn(4)),
+            y).numpy()))
+
+
+class TestLossRegressions:
+    def test_soft_margin_loss_stable(self):
+        x = paddle.to_tensor(np.array([100.0, -100.0], "float32"))
+        y = paddle.to_tensor(np.array([-1.0, 1.0], "float32"))
+        out = F.soft_margin_loss(x, y, reduction="none").numpy()
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(out, [100.0, 100.0], rtol=1e-4)
+
+    def test_ctc_norm_by_times(self):
+        logits = paddle.to_tensor(np.random.randn(10, 2, 5).astype("float32"))
+        labels = paddle.to_tensor(np.random.randint(1, 5, (2, 3)).astype("int32"))
+        il = paddle.to_tensor(np.array([10, 8], "int32"))
+        ll = paddle.to_tensor(np.array([3, 2], "int32"))
+        plain = F.ctc_loss(logits, labels, il, ll, reduction="none").numpy()
+        normed = F.ctc_loss(logits, labels, il, ll, reduction="none",
+                            norm_by_times=True).numpy()
+        np.testing.assert_allclose(normed, plain / np.array([10.0, 8.0]),
+                                   rtol=1e-5)
+
+    def test_max_unpool_rejects_nhwc(self):
+        with pytest.raises(ValueError):
+            paddle.nn.MaxUnPool2D(2, data_format="NHWC")
