@@ -221,9 +221,11 @@ class MultivariateNormal(Distribution):
         d = self._event_shape[0]
         diff = _val(value) - self.loc
         # solve L z = diff (triangular); lax triangular_solve does not
-        # broadcast batch dims, so align them explicitly
-        L = jnp.broadcast_to(self._tril,
-                             diff.shape[:-1] + self._tril.shape[-2:])
+        # broadcast batch dims, so align value- and scale-induced batches
+        batch = jnp.broadcast_shapes(diff.shape[:-1],
+                                     self._tril.shape[:-2])
+        L = jnp.broadcast_to(self._tril, batch + self._tril.shape[-2:])
+        diff = jnp.broadcast_to(diff, batch + diff.shape[-1:])
         z = jax.scipy.linalg.solve_triangular(
             L, diff[..., None], lower=True)[..., 0]
         half_logdet = jnp.log(jnp.diagonal(self._tril, axis1=-2,

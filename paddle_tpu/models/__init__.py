@@ -7,3 +7,8 @@ from .llama import (LLAMA_SHARDING_PLAN, LlamaConfig, LlamaForCausalLM,
 from .gpt_moe import (GPTMoEConfig, GPTMoEForCausalLM, apply_gpt_moe_sharding,
                       build_moe_train_step)
 from .generation import generate
+from .bert import (BertConfig, BertForMaskedLM,
+                   BertForSequenceClassification, BertModel,
+                   build_bert_train_step)
+from .ppyoloe import (PPYOLOE, PPYOLOEConfig, decode_predictions,
+                      ppyoloe_loss)

@@ -1,0 +1,175 @@
+"""BERT/ERNIE encoder family — the north-star config-2 model
+(BASELINE.md #2: "ERNIE-3.0 / BERT-base fine-tune, data-parallel").
+
+Reference analogs: the transformer encoder stack the reference builds its
+ERNIE/BERT models from (python/paddle/nn/layer/transformer.py
+TransformerEncoder; model zoo lives in PaddleNLP, the capability here is
+the framework-side encoder + heads + a compiled DP fine-tune step).
+
+TPU-first: the eager Layer graph is also runnable as one jitted train
+step (``build_bert_train_step``) with the batch sharded over the mesh's
+data axes — DP via GSPMD, no hand-written allreduce.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import (Dropout, Embedding, GELU, Layer, LayerList, LayerNorm,
+                  Linear, Tanh, TransformerEncoder, TransformerEncoderLayer)
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForMaskedLM", "build_bert_train_step"]
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def debug(vocab=97, hidden=32, layers=2, heads=2, inter=64, max_pos=64):
+        return BertConfig(vocab_size=vocab, hidden_size=hidden,
+                          num_hidden_layers=layers, num_attention_heads=heads,
+                          intermediate_size=inter,
+                          max_position_embeddings=max_pos)
+
+
+class _BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = Tensor(jnp.broadcast_to(jnp.arange(s), (b, s)))
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros((b, s), jnp.int32))
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(Layer):
+    """Embeddings -> TransformerEncoder -> (sequence_output, pooled)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = _BertEmbeddings(cfg)
+        layer = TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu",
+            normalize_before=False)
+        self.encoder = TransformerEncoder(layer, cfg.num_hidden_layers)
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.pooler_act = Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None:
+            # [b, s] 1/0 -> additive [b, 1, 1, s]
+            mv = attention_mask._value if isinstance(attention_mask, Tensor) \
+                else jnp.asarray(attention_mask)
+            add = jnp.where(mv[:, None, None, :].astype(bool), 0.0,
+                            jnp.float32(-1e9))
+            attention_mask = Tensor(add)
+        seq = self.encoder(x, src_mask=attention_mask)
+        pooled = self.pooler_act(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.act = GELU()
+        self.norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        # decoder tied to word embeddings (BERT convention)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        h = self.norm(self.act(self.transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight
+        return h.matmul(w.t())
+
+
+def build_bert_train_step(model: BertForSequenceClassification, optimizer,
+                          mesh=None, data_axes: Tuple[str, ...] = ("dp",)):
+    """One donated jitted fine-tune step (config-2 path): batch sharded
+    over the mesh's data axes, params replicated (plain DP — GSPMD emits
+    the gradient all-reduce the reference's EagerReducer does by hand)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..autograd import no_grad
+
+    batch_sharding = None
+    if mesh is not None:
+        axes = tuple(a for a in data_axes
+                     if a in mesh.axis_names and mesh.shape[a] > 1)
+        batch_sharding = NamedSharding(
+            mesh, P(axes if len(axes) > 1 else (axes[0] if axes else None)))
+
+    def loss_fn(params, input_ids, labels):
+        with no_grad():
+            logits = model.functional_call(params, Tensor(input_ids))
+        lv = logits._value.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(lv, axis=-1)
+        gold = jnp.take_along_axis(lv, labels[:, None], axis=-1)[:, 0]
+        return (lse - gold).mean()
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def step_fn(params, opt_state, step_no, lr, input_ids, labels):
+        if batch_sharding is not None:
+            input_ids = jax.lax.with_sharding_constraint(input_ids,
+                                                         batch_sharding)
+            labels = jax.lax.with_sharding_constraint(labels, batch_sharding)
+        loss, grads = grad_fn(params, input_ids, labels)
+        new_params, new_state = optimizer.apply(params, grads, opt_state, lr,
+                                                step_no + 1)
+        return loss, new_params, new_state
+
+    return jax.jit(step_fn, donate_argnums=(0, 1))

@@ -1,0 +1,335 @@
+"""PP-YOLOE-style anchor-free detector — the north-star config-3 model
+(BASELINE.md #3: "PP-YOLOE detection (conv+attn, dynamic shapes via
+fusion→HLO)").
+
+Reference analogs: the detector the reference ecosystem trains with the
+ops this framework already registers (yolo_box / multiclass_nms3 /
+prior_box live in paddle/phi; the PP-YOLOE model zoo is PaddleDetection).
+Framework-side capability: a CSPResNet-lite backbone, PAN-lite neck,
+decoupled anchor-free head with DFL regression, varifocal + GIoU + DFL
+losses, center-sampling assignment — all static-shape jnp so the whole
+train step jits (the "dynamic shapes" of detection are handled the
+TPU-first way: fixed-size padded GT tensors with validity masks, and NMS
+at the end of the compiled graph via the registered multiclass_nms3 op).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import BatchNorm2D, Conv2D, Layer, LayerList, Silu
+
+__all__ = ["PPYOLOEConfig", "PPYOLOE", "ppyoloe_loss", "decode_predictions"]
+
+
+@dataclass(frozen=True)
+class PPYOLOEConfig:
+    num_classes: int = 80
+    widths: Tuple[int, ...] = (32, 64, 128, 256)   # stem + 3 stages
+    depths: Tuple[int, ...] = (1, 2, 2)
+    strides: Tuple[int, ...] = (8, 16, 32)
+    reg_max: int = 8                               # DFL bins
+    head_width: int = 64
+
+    @staticmethod
+    def debug(num_classes=4):
+        return PPYOLOEConfig(num_classes=num_classes,
+                             widths=(8, 16, 32, 64), depths=(1, 1, 1),
+                             reg_max=4, head_width=16)
+
+
+class _ConvBNAct(Layer):
+    def __init__(self, cin, cout, k=3, stride=1):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = Silu()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _CSPBlock(Layer):
+    """CSP stage: split, residual bottlenecks on one branch, concat."""
+
+    def __init__(self, cin, cout, n):
+        super().__init__()
+        mid = cout // 2
+        self.a = _ConvBNAct(cin, mid, 1)
+        self.b = _ConvBNAct(cin, mid, 1)
+        self.m = LayerList([_ConvBNAct(mid, mid, 3) for _ in range(n)])
+        self.out = _ConvBNAct(2 * mid, cout, 1)
+
+    def forward(self, x):
+        a = self.a(x)
+        for blk in self.m:
+            a = a + blk(a)
+        b = self.b(x)
+        from ..ops.registry import dispatch
+
+        return self.out(dispatch("concat", [a, b], axis=1))
+
+
+class _Backbone(Layer):
+    def __init__(self, cfg: PPYOLOEConfig):
+        super().__init__()
+        w = cfg.widths
+        # stride-4 stem, then 3 stride-2 stages -> pyramid strides 8/16/32,
+        # matching cfg.strides (anchor geometry depends on this)
+        self.stem1 = _ConvBNAct(3, w[0], 3, stride=2)
+        self.stem2 = _ConvBNAct(w[0], w[0], 3, stride=2)
+        self.downs = LayerList()
+        self.stages = LayerList()
+        for i, n in enumerate(cfg.depths):
+            self.downs.append(_ConvBNAct(w[i], w[i + 1], 3, stride=2))
+            self.stages.append(_CSPBlock(w[i + 1], w[i + 1], n))
+
+    def forward(self, x):
+        x = self.stem2(self.stem1(x))
+        feats = []
+        for down, stage in zip(self.downs, self.stages):
+            x = stage(down(x))
+            feats.append(x)
+        return feats
+
+
+class _PANNeck(Layer):
+    """Top-down fusion then bottom-up re-aggregation (PAN-lite)."""
+
+    def __init__(self, cfg: PPYOLOEConfig):
+        super().__init__()
+        w = cfg.widths[1:]
+        self.lat = LayerList([_ConvBNAct(c, cfg.head_width, 1) for c in w])
+        self.td = LayerList([_ConvBNAct(cfg.head_width, cfg.head_width, 3)
+                             for _ in w[:-1]])
+        self.bu = LayerList([_ConvBNAct(cfg.head_width, cfg.head_width, 3)
+                             for _ in w[:-1]])
+
+    def forward(self, feats):
+        from ..nn import functional as F
+        from ..ops.registry import dispatch
+
+        p = [lat(f) for lat, f in zip(self.lat, feats)]
+        # top-down
+        for i in range(len(p) - 2, -1, -1):
+            up = F.interpolate(p[i + 1], size=tuple(p[i].shape[2:]),
+                               mode="nearest")
+            p[i] = self.td[i](p[i] + up)
+        # bottom-up: resize to the exact coarser shape so odd feature maps
+        # (inputs not divisible by 32) still align with the conv pyramid
+        for i in range(1, len(p)):
+            down = p[i - 1]
+            if tuple(down.shape[2:]) != tuple(p[i].shape[2:]):
+                down = F.interpolate(down, size=tuple(p[i].shape[2:]),
+                                     mode="nearest")
+            p[i] = self.bu[i - 1](p[i] + down)
+        return p
+
+
+class _Head(Layer):
+    """Decoupled anchor-free head: cls logits + DFL ltrb distributions."""
+
+    def __init__(self, cfg: PPYOLOEConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.cls_conv = LayerList()
+        self.reg_conv = LayerList()
+        self.cls_pred = LayerList()
+        self.reg_pred = LayerList()
+        for _ in cfg.strides:
+            self.cls_conv.append(_ConvBNAct(cfg.head_width, cfg.head_width))
+            self.reg_conv.append(_ConvBNAct(cfg.head_width, cfg.head_width))
+            self.cls_pred.append(Conv2D(cfg.head_width, cfg.num_classes, 1))
+            self.reg_pred.append(Conv2D(cfg.head_width,
+                                        4 * (cfg.reg_max + 1), 1))
+
+    def forward(self, feats):
+        cls_out, reg_out = [], []
+        for i, f in enumerate(feats):
+            c = self.cls_pred[i](self.cls_conv[i](f))
+            r = self.reg_pred[i](self.reg_conv[i](f))
+            b = c.shape[0]
+            cls_out.append(c.reshape([b, self.cfg.num_classes, -1])
+                           .transpose([0, 2, 1]))
+            reg_out.append(r.reshape([b, 4 * (self.cfg.reg_max + 1), -1])
+                           .transpose([0, 2, 1]))
+        from ..ops.registry import dispatch
+
+        return (dispatch("concat", cls_out, axis=1),
+                dispatch("concat", reg_out, axis=1))
+
+
+class PPYOLOE(Layer):
+    def __init__(self, cfg: PPYOLOEConfig = None, num_classes: int = None):
+        super().__init__()
+        cfg = cfg or PPYOLOEConfig()
+        if num_classes is not None:
+            cfg = PPYOLOEConfig(num_classes=num_classes, widths=cfg.widths,
+                                depths=cfg.depths, strides=cfg.strides,
+                                reg_max=cfg.reg_max,
+                                head_width=cfg.head_width)
+        self.cfg = cfg
+        self.backbone = _Backbone(cfg)
+        self.neck = _PANNeck(cfg)
+        self.head = _Head(cfg)
+
+    def forward(self, images):
+        """images [b, 3, H, W] -> (cls_logits [b, A, C],
+        reg_logits [b, A, 4*(reg_max+1)], anchor_points [A, 2],
+        stride_per_anchor [A])."""
+        feats = self.neck(self.backbone(images))
+        cls_logits, reg_logits = self.head(feats)
+        pts, strides = _anchor_points(
+            [tuple(f.shape[2:]) for f in feats], self.cfg)
+        return cls_logits, reg_logits, Tensor(pts), Tensor(strides)
+
+
+def _anchor_points(level_shapes: Sequence[Tuple[int, int]],
+                   cfg: PPYOLOEConfig):
+    pts, strides = [], []
+    for (h, w), s in zip(level_shapes, cfg.strides):
+        ys, xs = jnp.meshgrid(jnp.arange(h) + 0.5, jnp.arange(w) + 0.5,
+                              indexing="ij")
+        pts.append(jnp.stack([xs.ravel(), ys.ravel()], -1) * s)
+        strides.append(jnp.full((h * w,), float(s)))
+    return jnp.concatenate(pts), jnp.concatenate(strides)
+
+
+def _dfl_expect(reg_logits, reg_max):
+    """[..., 4*(reg_max+1)] logits -> expected ltrb distances [..., 4]."""
+    shp = reg_logits.shape[:-1]
+    p = jax.nn.softmax(
+        reg_logits.reshape(shp + (4, reg_max + 1)).astype(jnp.float32), -1)
+    return (p * jnp.arange(reg_max + 1, dtype=jnp.float32)).sum(-1)
+
+
+def _decode_boxes(reg_logits, pts, strides, reg_max):
+    d = _dfl_expect(reg_logits, reg_max) * strides[None, :, None]
+    x, y = pts[None, :, 0], pts[None, :, 1]
+    return jnp.stack([x - d[..., 0], y - d[..., 1],
+                      x + d[..., 2], y + d[..., 3]], -1)  # xyxy
+
+
+def _giou(a, b):
+    """a, b [..., 4] xyxy -> GIoU [...]."""
+    ix1 = jnp.maximum(a[..., 0], b[..., 0])
+    iy1 = jnp.maximum(a[..., 1], b[..., 1])
+    ix2 = jnp.minimum(a[..., 2], b[..., 2])
+    iy2 = jnp.minimum(a[..., 3], b[..., 3])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0) * jnp.clip(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0) * jnp.clip(b[..., 3] - b[..., 1], 0)
+    union = area_a + area_b - inter
+    iou = inter / jnp.maximum(union, 1e-9)
+    cx1 = jnp.minimum(a[..., 0], b[..., 0])
+    cy1 = jnp.minimum(a[..., 1], b[..., 1])
+    cx2 = jnp.maximum(a[..., 2], b[..., 2])
+    cy2 = jnp.maximum(a[..., 3], b[..., 3])
+    hull = jnp.clip(cx2 - cx1, 0) * jnp.clip(cy2 - cy1, 0)
+    return iou - (hull - union) / jnp.maximum(hull, 1e-9)
+
+
+def _assign(pts, strides, gt_boxes, gt_labels, gt_mask, num_classes):
+    """Center-sampling assignment (TAL-lite, fully static shapes):
+    an anchor is positive for the closest valid GT whose box contains it
+    AND whose center is within 2.5 strides. Returns (cls_target [A, C],
+    box_target [A, 4], pos_mask [A]) per image."""
+    x, y = pts[:, 0], pts[:, 1]
+    inside = ((x[:, None] >= gt_boxes[None, :, 0])
+              & (x[:, None] <= gt_boxes[None, :, 2])
+              & (y[:, None] >= gt_boxes[None, :, 1])
+              & (y[:, None] <= gt_boxes[None, :, 3]))
+    cx = (gt_boxes[:, 0] + gt_boxes[:, 2]) / 2
+    cy = (gt_boxes[:, 1] + gt_boxes[:, 3]) / 2
+    dist = jnp.hypot(x[:, None] - cx[None, :], y[:, None] - cy[None, :])
+    near = dist <= 2.5 * strides[:, None]
+    cand = inside & near & gt_mask[None, :]
+    dist = jnp.where(cand, dist, jnp.inf)
+    best = jnp.argmin(dist, axis=1)                  # [A]
+    pos = jnp.isfinite(jnp.min(dist, axis=1))
+    box_t = gt_boxes[best]
+    cls_t = jax.nn.one_hot(gt_labels[best], num_classes) \
+        * pos[:, None].astype(jnp.float32)
+    return cls_t, box_t, pos
+
+
+def _varifocal(cls_logits, cls_target, alpha=0.75, gamma=2.0):
+    p = jax.nn.sigmoid(cls_logits)
+    # IoU-aware targets: weight positives by target score, negatives by
+    # alpha * p^gamma (reference ppyoloe varifocal loss)
+    weight = jnp.where(cls_target > 0, cls_target,
+                       alpha * jnp.power(p, gamma))
+    ce = (jnp.maximum(cls_logits, 0) - cls_logits * cls_target
+          + jnp.log1p(jnp.exp(-jnp.abs(cls_logits))))
+    return (ce * weight).sum()
+
+
+def ppyoloe_loss(outputs, gt_boxes, gt_labels, gt_mask):
+    """Compiled detection loss. gt_* are fixed-size padded tensors:
+    gt_boxes [b, M, 4] xyxy, gt_labels [b, M] int, gt_mask [b, M] bool."""
+    cls_logits, reg_logits, pts, strides = outputs
+    cl = cls_logits._value if isinstance(cls_logits, Tensor) else cls_logits
+    rl = reg_logits._value if isinstance(reg_logits, Tensor) else reg_logits
+    pv = pts._value if isinstance(pts, Tensor) else pts
+    sv = strides._value if isinstance(strides, Tensor) else strides
+    num_classes = cl.shape[-1]
+    reg_max = rl.shape[-1] // 4 - 1
+
+    assign = jax.vmap(lambda b_, l_, m_: _assign(pv, sv, b_, l_, m_,
+                                                 num_classes))
+    cls_t, box_t, pos = assign(gt_boxes, gt_labels, gt_mask)
+
+    boxes = _decode_boxes(rl, pv, sv, reg_max)
+    giou = _giou(boxes, box_t)
+    n_pos = jnp.maximum(pos.sum(), 1.0)
+    loss_box = (jnp.where(pos, 1.0 - giou, 0.0)).sum() / n_pos
+
+    # IoU-aware cls target (varifocal): positive weight = detached IoU
+    iou_w = jax.lax.stop_gradient(jnp.clip((giou + 1) / 2, 0, 1))
+    loss_cls = _varifocal(cl.astype(jnp.float32),
+                          cls_t * iou_w[..., None]) / n_pos
+
+    # DFL: distances to the assigned box, per-side cross-entropy on the
+    # two neighboring bins
+    d_t = jnp.stack([pv[None, :, 0] - box_t[..., 0],
+                     pv[None, :, 1] - box_t[..., 1],
+                     box_t[..., 2] - pv[None, :, 0],
+                     box_t[..., 3] - pv[None, :, 1]], -1) / sv[None, :, None]
+    d_t = jnp.clip(d_t, 0, reg_max - 0.01)
+    lo = jnp.floor(d_t)
+    hi = lo + 1
+    w_hi = d_t - lo
+    logits = rl.reshape(rl.shape[:-1] + (4, reg_max + 1)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    pick = lambda idx: jnp.take_along_axis(
+        logp, idx[..., None].astype(jnp.int32), -1)[..., 0]
+    dfl = -(pick(lo) * (1 - w_hi) + pick(hi) * w_hi)
+    loss_dfl = (jnp.where(pos[..., None], dfl, 0.0)).sum() / (4 * n_pos)
+
+    total = loss_cls + 2.5 * loss_box + 0.5 * loss_dfl
+    return total, {"cls": loss_cls, "box": loss_box, "dfl": loss_dfl}
+
+
+def decode_predictions(outputs, score_threshold=0.05, nms_threshold=0.6,
+                       keep_top_k=100):
+    """Inference post-process through the registered multiclass_nms3 op
+    (the reference's deploy path: yolo_box + multiclass_nms kernels)."""
+    from ..ops.registry import dispatch
+
+    cls_logits, reg_logits, pts, strides = outputs
+    cl = cls_logits._value
+    rl = reg_logits._value
+    reg_max = rl.shape[-1] // 4 - 1
+    boxes = _decode_boxes(rl, pts._value, strides._value, reg_max)
+    scores = jax.nn.sigmoid(cl.astype(jnp.float32))
+    return dispatch("multiclass_nms3", Tensor(boxes),
+                    Tensor(jnp.swapaxes(scores, 1, 2)),  # [b, C, A]
+                    score_threshold=score_threshold,
+                    nms_threshold=nms_threshold, keep_top_k=keep_top_k)

@@ -28,13 +28,17 @@ from ...ops.nn_ops import (  # noqa: F401
     binary_cross_entropy_with_logits, mse_loss, l1_loss, smooth_l1_loss,
     kl_div, nll_loss, cosine_similarity, pixel_shuffle, unfold,
     local_response_norm, max_unpool2d, npair_loss,
+    margin_ranking_loss, soft_margin_loss, hinge_embedding_loss,
+    cosine_embedding_loss, triplet_margin_loss,
+    multi_label_soft_margin_loss, gaussian_nll_loss, poisson_nll_loss,
+    square_error_cost, dice_loss, sigmoid_focal_loss,
 )
 from ...ops.math import sigmoid, tanh  # noqa: F401
 from ...ops.manip import pad, one_hot  # noqa: F401
 # yaml-schema ops with torch-golden generated tests (ops/yaml/ops.yaml)
 from ...ops.generated import (  # noqa: F401
-    affine_grid, channel_shuffle, fold, grid_sample, pixel_unshuffle,
-    temporal_shift,
+    affine_grid, channel_shuffle, fold, grid_sample, max_pool2d_with_index,
+    pixel_unshuffle, temporal_shift,
 )
 
 
@@ -191,9 +195,12 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
     idx = dispatch("argmax", y, axis=axis)
     y_hard = dispatch("one_hot", idx, num_classes=x.shape[axis])
     y_hard = dispatch("cast", y_hard, dtype=jnp.float32)
-    if axis != -1 and axis != len(x.shape) - 1:
-        perm = list(range(len(x.shape)))
-        perm.insert(axis, perm.pop(-1))
+    nd = len(x.shape)
+    axis = axis % nd
+    if axis != nd - 1:
+        # one_hot put the class dim last: move it back to ``axis``
+        perm = list(range(nd - 1))
+        perm.insert(axis, nd - 1)
         y_hard = dispatch("transpose", y_hard, perm=tuple(perm))
     return y_hard - y.detach() + y
 
@@ -252,10 +259,3 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return (loss / Tensor(jnp.maximum(ll, 1).astype(jnp.float32))).mean()
 
 
-from ...ops.generated import max_pool2d_with_index  # noqa: F401,E402
-from ...ops.nn_ops import (  # noqa: F401,E402
-    margin_ranking_loss, soft_margin_loss, hinge_embedding_loss,
-    cosine_embedding_loss, triplet_margin_loss,
-    multi_label_soft_margin_loss, gaussian_nll_loss, poisson_nll_loss,
-    square_error_cost, dice_loss, sigmoid_focal_loss,
-)

@@ -288,6 +288,18 @@ class Layer:
         """Raw-array pytree of all params+buffers keyed by structured name."""
         return {k: v._value for k, v in self.state_dict().items()}
 
+    def load_functional_state(self, state: Dict[str, Any]):
+        """Write a functional-state pytree back into the layer's own
+        storage.  The compiled train steps DONATE their params/opt-state
+        buffers (jit donate_argnums), which deletes the layer's original
+        arrays — after a compiled run, call this with the returned params
+        before using the layer eagerly (state_dict/save/inference)."""
+        sd = self.state_dict()
+        for k, t in sd.items():
+            if k in state:
+                t.set_value(state[k])
+        return self
+
     def functional_call(self, state: Dict[str, Any], *args, **kwargs):
         """Run forward with parameter values substituted from ``state``
         (pure w.r.t. the layer's own storage; the jit bridge)."""

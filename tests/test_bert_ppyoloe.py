@@ -1,0 +1,187 @@
+"""BERT/ERNIE (north-star config 2) + PP-YOLOE-style detector (config 3)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (BertConfig, BertForMaskedLM,
+                               BertForSequenceClassification, BertModel,
+                               PPYOLOE, PPYOLOEConfig, build_bert_train_step,
+                               decode_predictions, ppyoloe_loss)
+
+
+class TestBert:
+    def _cfg(self):
+        return BertConfig.debug()
+
+    def test_forward_shapes(self):
+        m = BertModel(self._cfg())
+        m.eval()
+        ids = paddle.to_tensor(np.random.randint(0, 97, (2, 12)).astype("int32"))
+        seq, pooled = m(ids)
+        assert tuple(seq.shape) == (2, 12, 32)
+        assert tuple(pooled.shape) == (2, 32)
+
+    def test_attention_mask_blocks_padding(self):
+        m = BertModel(self._cfg())
+        m.eval()
+        ids = np.random.randint(0, 97, (1, 8)).astype("int32")
+        ids2 = ids.copy()
+        ids2[0, 5:] = 3  # change padded-out positions
+        mask = np.array([[1, 1, 1, 1, 1, 0, 0, 0]], "int32")
+        s1, _ = m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(mask))
+        s2, _ = m(paddle.to_tensor(ids2), attention_mask=paddle.to_tensor(mask))
+        # visible positions must be unaffected by masked-out token changes
+        np.testing.assert_allclose(s1.numpy()[:, :5], s2.numpy()[:, :5],
+                                   atol=1e-5)
+
+    def test_mlm_tied_embeddings(self):
+        cfg = self._cfg()
+        m = BertForMaskedLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(np.random.randint(0, 97, (2, 6)).astype("int32"))
+        out = m(ids)
+        assert tuple(out.shape) == (2, 6, cfg.vocab_size)
+        # no independent decoder matrix: logits come from embedding.T
+        names = [n for n, _ in m.named_parameters()]
+        assert not any("decoder" in n for n in names)
+
+    def test_dp_train_step_loss_decreases(self, cpu_mesh8):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(cpu_mesh8).reshape(8), ("dp",))
+        m = BertForSequenceClassification(self._cfg(), num_classes=3)
+        opt = paddle.optimizer.AdamW(parameters=m.parameters())
+        step = build_bert_train_step(m, opt, mesh=mesh)
+        params = m.functional_state()
+        st = opt.init_state(params)
+        ids = np.random.randint(0, 97, (16, 10)).astype("int32")
+        labs = np.random.randint(0, 3, (16,)).astype("int32")
+        l0, params, st = step(params, st, 0, 1e-3, ids, labs)
+        ln = l0
+        for i in range(9):
+            ln, params, st = step(params, st, i + 1, 1e-3, ids, labs)
+        assert float(ln) < float(l0)
+
+    def test_finetune_eager(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(self._cfg(), hidden_dropout_prob=0.0)
+        m = BertForSequenceClassification(cfg, num_classes=2)
+        opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                     learning_rate=1e-3)
+        ids = paddle.to_tensor(np.random.randint(0, 97, (4, 8)).astype("int32"))
+        y = paddle.to_tensor(np.array([0, 1, 1, 0], "int64"))
+        losses = []
+        for _ in range(4):
+            loss = paddle.nn.CrossEntropyLoss()(m(ids), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestPPYOLOE:
+    def _setup(self):
+        cfg = PPYOLOEConfig.debug()
+        net = PPYOLOE(cfg)
+        net.eval()
+        return cfg, net
+
+    def test_anchor_geometry(self):
+        cfg, net = self._setup()
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+        cls_l, reg_l, pts, strides = net(x)
+        # strides 8/16/32 on a 64px image -> 8x8 + 4x4 + 2x2 = 84 anchors
+        assert tuple(cls_l.shape) == (1, 84, cfg.num_classes)
+        assert tuple(reg_l.shape) == (1, 84, 4 * (cfg.reg_max + 1))
+        pv = pts.numpy()
+        # anchors live inside the image
+        assert pv.min() >= 0 and pv.max() <= 64
+        sv = strides.numpy()
+        assert set(np.unique(sv)) == {8.0, 16.0, 32.0}
+
+    def test_loss_finite_and_jits(self):
+        cfg, net = self._setup()
+        x = paddle.to_tensor(np.random.randn(2, 3, 64, 64).astype("float32"))
+        out = net(x)
+        gt_boxes = np.array([[[4, 4, 30, 30], [32, 32, 60, 60]],
+                             [[10, 10, 50, 50], [0, 0, 0, 0]]], "float32")
+        gt_labels = np.array([[1, 2], [0, 0]], "int32")
+        gt_mask = np.array([[True, True], [True, False]])
+        loss, parts = ppyoloe_loss(out, gt_boxes, gt_labels, gt_mask)
+        assert np.isfinite(float(loss))
+        assert set(parts) == {"cls", "box", "dfl"}
+
+    def test_training_decreases_loss(self):
+        cfg, net = self._setup()
+        net.train()
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=1e-3)
+        x_np = np.random.randn(1, 3, 64, 64).astype("float32")
+        gt_boxes = np.array([[[8, 8, 40, 40]]], "float32")
+        gt_labels = np.array([[2]], "int32")
+        gt_mask = np.array([[True]])
+        import paddle_tpu.autograd as AG
+
+        losses = []
+        for _ in range(6):
+            out = net(paddle.to_tensor(x_np))
+            # bridge the jnp loss into the tape via a functional grad step
+            cls_l, reg_l, pts, strides = out
+
+            def jloss(cv, rv):
+                l, _ = ppyoloe_loss((cv, rv, pts, strides), gt_boxes,
+                                    gt_labels, gt_mask)
+                return l
+
+            lv, grads = jax.value_and_grad(jloss, argnums=(0, 1))(
+                cls_l._value, reg_l._value)
+            cls_l.backward(paddle.Tensor(grads[0]), retain_graph=True)
+            reg_l.backward(paddle.Tensor(grads[1]))
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(lv))
+        assert losses[-1] < losses[0], losses
+
+    def test_decode_nms(self):
+        cfg, net = self._setup()
+        x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+        out = net(x)
+        res = decode_predictions(out, score_threshold=0.0, keep_top_k=5)
+        assert res is not None
+
+
+class TestReviewRegressions:
+    def test_ppyoloe_non_divisible_input(self):
+        net = PPYOLOE(PPYOLOEConfig.debug())
+        net.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 104, 104).astype("float32"))
+        cls_l, reg_l, pts, strides = net(x)
+        # 13x13 + 7x7 + 4x4 anchors for 104px at strides 8/16/32
+        assert cls_l.shape[1] == 13 * 13 + 7 * 7 + 4 * 4
+
+    def test_gumbel_softmax_negative_axis(self):
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(0)
+        x = paddle.to_tensor(np.random.randn(2, 3, 4).astype("float32"))
+        h = F.gumbel_softmax(x, hard=True, axis=-2)
+        assert tuple(h.shape) == (2, 3, 4)
+        np.testing.assert_allclose(h.numpy().sum(-2), 1.0, atol=1e-6)
+
+    def test_mvn_logprob_batched_cov_unbatched_loc(self):
+        D = paddle.distribution
+        covs = np.stack([np.eye(2, dtype="float32") * (i + 1)
+                         for i in range(3)])
+        m = D.MultivariateNormal(np.zeros(2, "float32"),
+                                 covariance_matrix=covs)
+        lp = m.log_prob(np.zeros(2, "float32")).numpy()
+        assert lp.shape == (3,)
+        import scipy.stats as ss
+        want = [ss.multivariate_normal.logpdf(np.zeros(2), np.zeros(2), c)
+                for c in covs]
+        np.testing.assert_allclose(lp, want, rtol=1e-4)
