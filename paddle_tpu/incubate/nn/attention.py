@@ -25,16 +25,49 @@ def _pallas_available() -> bool:
     return _PALLAS_OK
 
 
+def _as_padding_segments(attn_mask, query, key):
+    """A BOOLEAN [b, sk] (or [b, 1, 1, sk]) keep-mask maps onto the
+    kernel's segment ids (valid=1, pad=0); anything else returns None and
+    takes the XLA path.  Bool-only on purpose: integer/float masks are
+    ADDITIVE in the XLA path (sdpa semantics), so routing them as keep
+    masks would change numerics between backends."""
+    m = attn_mask._value if hasattr(attn_mask, "_value") else attn_mask
+    import jax.numpy as jnp
+
+    if m.ndim == 4 and m.shape[1] == 1 and m.shape[2] == 1:
+        m = m[:, 0, 0]
+    if m.ndim != 2 or m.shape != (key.shape[0], key.shape[1]):
+        return None
+    if not jnp.issubdtype(m.dtype, jnp.bool_):
+        return None
+    if query.shape[1] != key.shape[1]:
+        return None
+    return m.astype(jnp.int32)
+
+
 def flash_attention(query, key, value, causal=False, dropout=0.0,
                     attn_mask=None, scale=None):
     """(batch, seq, heads, head_dim) attention, flash-style.  GQA (fewer
     kv heads) is accepted: the Pallas kernel routes q heads to kv groups
-    natively; the XLA fallback repeats kv heads."""
-    if _pallas_available() and attn_mask is None and dropout == 0.0:
+    natively; the XLA fallback repeats kv heads.  A [b, sk] boolean
+    padding mask rides the Pallas path as segment ids (splash-attention
+    style); arbitrary additive masks and dropout use the XLA path."""
+    seg = None
+    if _pallas_available() and attn_mask is not None and dropout == 0.0:
+        seg = _as_padding_segments(attn_mask, query, key)
+    if _pallas_available() and dropout == 0.0 \
+            and (attn_mask is None or seg is not None):
         try:
             from ...ops.pallas.flash_attention import (FlashUnsupportedError,
                                                        flash_attention_op)
 
+            if seg is not None:
+                from ...core.tensor import Tensor as _T
+
+                return dispatch("pallas_flash_attention", query, key, value,
+                                q_segment_ids=_T(seg),
+                                kv_segment_ids=_T(seg),
+                                causal=causal, scale=scale)
             return dispatch("pallas_flash_attention", query, key, value,
                             causal=causal, scale=scale)
         except (ImportError, FlashUnsupportedError):
@@ -60,6 +93,18 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
 
         key = repeat_interleave(key, rep, axis=2)
         value = repeat_interleave(value, rep, axis=2)
+    if attn_mask is not None:
+        # a [b, sk] (or [b,1,1,sk]) bool keep-mask must mean the same
+        # thing on this path as on the Pallas one: normalize it to the
+        # broadcastable [b, 1, 1, sk] bool shape sdpa's where() expects
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+
+        mv = attn_mask._value if isinstance(attn_mask, Tensor) else \
+            jnp.asarray(attn_mask)
+        if jnp.issubdtype(mv.dtype, jnp.bool_) and mv.ndim == 2 \
+                and mv.shape == (key.shape[0], key.shape[1]):
+            attn_mask = Tensor(mv[:, None, None, :])
     dropout_mask = None
     if dropout > 0.0:
         from ...core.tensor import Tensor

@@ -18,7 +18,6 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn import (Dropout, Embedding, GELU, Layer, LayerList, LayerNorm,
@@ -137,13 +136,23 @@ class BertForMaskedLM(Layer):
 
 
 def build_bert_train_step(model: BertForSequenceClassification, optimizer,
-                          mesh=None, data_axes: Tuple[str, ...] = ("dp",)):
+                          mesh=None, data_axes: Tuple[str, ...] = ("dp",),
+                          dropout_seed: int = 0):
     """One donated jitted fine-tune step (config-2 path): batch sharded
     over the mesh's data axes, params replicated (plain DP — GSPMD emits
-    the gradient all-reduce the reference's EagerReducer does by hand)."""
+    the gradient all-reduce the reference's EagerReducer does by hand).
+
+        step_fn(params, opt_state, step_no, lr, input_ids, labels,
+                attention_mask=None) -> (loss, new_params, new_opt_state)
+
+    Dropout is live and step-dependent: the framework generator's root
+    key is swapped for a TRACED key derived from ``step_no`` during the
+    trace, so every compiled step draws fresh masks (a trace-time host
+    key would bake ONE mask into the executable)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..autograd import no_grad
+    from ..ops import random as _random
 
     batch_sharding = None
     if mesh is not None:
@@ -152,9 +161,18 @@ def build_bert_train_step(model: BertForSequenceClassification, optimizer,
         batch_sharding = NamedSharding(
             mesh, P(axes if len(axes) > 1 else (axes[0] if axes else None)))
 
-    def loss_fn(params, input_ids, labels):
-        with no_grad():
-            logits = model.functional_call(params, Tensor(input_ids))
+    def loss_fn(params, input_ids, labels, attention_mask, rng_key):
+        gen = _random.default_generator()
+        saved = gen._root, gen._counter
+        gen._root, gen._counter = rng_key, 0
+        try:
+            with no_grad():
+                mask_t = None if attention_mask is None \
+                    else Tensor(attention_mask)
+                logits = model.functional_call(params, Tensor(input_ids),
+                                               attention_mask=mask_t)
+        finally:
+            gen._root, gen._counter = saved
         lv = logits._value.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(lv, axis=-1)
         gold = jnp.take_along_axis(lv, labels[:, None], axis=-1)[:, 0]
@@ -162,12 +180,14 @@ def build_bert_train_step(model: BertForSequenceClassification, optimizer,
 
     grad_fn = jax.value_and_grad(loss_fn)
 
-    def step_fn(params, opt_state, step_no, lr, input_ids, labels):
+    def step_fn(params, opt_state, step_no, lr, input_ids, labels,
+                attention_mask=None):
         if batch_sharding is not None:
             input_ids = jax.lax.with_sharding_constraint(input_ids,
                                                          batch_sharding)
             labels = jax.lax.with_sharding_constraint(labels, batch_sharding)
-        loss, grads = grad_fn(params, input_ids, labels)
+        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed), step_no)
+        loss, grads = grad_fn(params, input_ids, labels, attention_mask, rng)
         new_params, new_state = optimizer.apply(params, grads, opt_state, lr,
                                                 step_no + 1)
         return loss, new_params, new_state

@@ -20,7 +20,6 @@ from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn import BatchNorm2D, Conv2D, Layer, LayerList, Silu

@@ -60,9 +60,13 @@ def _attn_reference(q, k, v, causal, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                  *, scale: float, causal: bool, block_q: int, block_k: int,
-                  seq_k: int):
+def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
+                  block_k: int, seq_k: int, has_segments: bool = False):
+    if has_segments:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     qi = pl.program_id(1)
@@ -87,6 +91,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_segments:
+            # splash-attention-style segment mask: a q position attends
+            # only keys of its own segment (padding = its own segment id)
+            s = jnp.where(qs_ref[0, 0][:, None] == ks_ref[0, 0][None, :],
+                          s, NEG_INF)
         if seq_k % block_k != 0:
             # mask the grid-padding columns of the last k tile
             s = jnp.where(k_pos < seq_k, s, NEG_INF)
@@ -128,6 +137,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = jnp.broadcast_to(lse_row, lse_ref.shape[1:])
 
 
+def _seg3(seg):
+    b, s = seg.shape
+    return jnp.broadcast_to(seg.astype(jnp.int32)[:, None, :], (b, 8, s))
+
+
 def _kv_index(bh, h: int, kvh: int):
     """Map a flat q-head grid index to its GQA kv-head flat index:
     q head hi of batch b reads kv head hi // (h // kvh)."""
@@ -137,31 +151,45 @@ def _kv_index(bh, h: int, kvh: int):
 
 def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
                    block_q: int = 512, block_k: int = 512,
-                   interpret: bool = False):
+                   interpret: bool = False, q_seg=None, k_seg=None):
     # defaults measured on v5e (seq 2048, d 64): 128x128 tiles drown in
     # grid overhead (163ms); 512x512 runs 23ms vs 24-88ms for XLA's path
     """q: [b*h, s, d]; k,v: [b*kvh, s, d].  GQA is native: the k/v
     BlockSpec index maps route each q head to its kv group — no
     materialised head repeat (4x HBM for llama3-8b otherwise).
-    Returns (o, lse) with lse = logsumexp of each row's logits (the
-    backward residual, as in flash-v2)."""
+    ``q_seg``/``k_seg`` ([b, s] int32) enable the segment mask (padding /
+    packed sequences).  Returns (o, lse) with lse = logsumexp of each
+    row's logits (the backward residual, as in flash-v2)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    has_segments = q_seg is not None
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (_kv_index(b, h, kvh), j, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (_kv_index(b, h, kvh), j, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_segments:
+        in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b // h, 0, i)),
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h, 0, j)),
+        ]
+        # sublane-replicated (b, 8, s): a flat (1, BQ) int block violates
+        # Mosaic's (8, 128) min tile, same workaround as the lse rows
+        inputs += [_seg3(q_seg), _seg3(k_seg)]
 
     return pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_k=sk),
+                          block_q=block_q, block_k=block_k, seq_k=sk,
+                          has_segments=has_segments),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (_kv_index(b, h, kvh), j, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (_kv_index(b, h, kvh), j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
@@ -178,7 +206,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
 
 
 # --------------------------------------------------------------------------
@@ -193,7 +221,7 @@ def _mask_rows(x, start, limit, size):
 
 
 def _bwd_tile_common(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
-                     block_q, block_k, seq_q, seq_k):
+                     block_q, block_k, seq_q, seq_k, qs=None, ks=None):
     """Shared per-tile math: returns (p, ds) both [BQ, BK] f32, padded
     rows/cols zeroed."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -204,6 +232,8 @@ def _bwd_tile_common(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
         jnp.int32, (block_q, block_k), 1)
     if causal:
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if qs is not None:
+        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
     if seq_k % block_k != 0:
         s = jnp.where(k_pos < seq_k, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])                  # [BQ, BK]
@@ -220,9 +250,15 @@ def _bwd_tile_common(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
     return p, ds
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, acc_scr, *, scale, causal, block_q, block_k,
-                         seq_q, seq_k):
+def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
+                         seq_q, seq_k, has_segments=False):
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dq_ref, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, acc_scr) = refs
+        qs_ref = ks_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -239,7 +275,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _, ds = _bwd_tile_common(
             q_ref[0], k, v, do_ref[0], lse_ref[0, 0], delta_ref[0, 0], qi, ki,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            seq_q=seq_q, seq_k=seq_k)
+            seq_q=seq_q, seq_k=seq_k,
+            qs=None if qs_ref is None else qs_ref[0, 0],
+            ks=None if ks_ref is None else ks_ref[0, 0])
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BQ, d]
@@ -254,12 +292,18 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                          block_q, block_k, seq_q, seq_k, nq):
+def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
+                          seq_k, nq, has_segments=False):
     """Grid (b*kvh, ki, t) with t = q_head_in_group * nq + q_tile — the
     whole kv group's q heads iterate innermost so dk/dv out-block revisits
     stay consecutive (a Pallas requirement)."""
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        qs_ref = ks_ref = None
     ki, t = pl.program_id(1), pl.program_id(2)
     nt = pl.num_programs(2)
     qi = t % nq
@@ -278,7 +322,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p, ds = _bwd_tile_common(
             q, k_ref[0], v_ref[0], do, lse_ref[0, 0], delta_ref[0, 0], qi, ki,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            seq_q=seq_q, seq_k=seq_k)
+            seq_q=seq_q, seq_k=seq_k,
+            qs=None if qs_ref is None else qs_ref[0, 0],
+            ks=None if ks_ref is None else ks_ref[0, 0])
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BK, d]
@@ -299,7 +345,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
                     h: int, kvh: int, block_q: int = 512, block_k: int = 512,
-                    interpret: bool = False):
+                    interpret: bool = False, q_seg=None, k_seg=None):
     """q/o/do: [b*h, s, d]; k/v: [b*kvh, s, d].  Returns (dq [b*h,..],
     dk, dv [b*kvh,..]) — kv grads summed over each GQA group in-kernel."""
     bh, sq, d = q.shape
@@ -308,28 +354,41 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     nq = pl.cdiv(sq, block_q)
+    has_segments = q_seg is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                        # [bh, sq]
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, sq))
 
     common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, seq_q=sq, seq_k=sk)
+                  block_k=block_k, seq_q=sq, seq_k=sk,
+                  has_segments=has_segments)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d),
                          lambda b, i, j: (_kv_index(b, h, kvh), j, 0))
     rowspec = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
 
+    dq_in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if has_segments:
+        q_seg = _seg3(q_seg)
+        k_seg = _seg3(k_seg)
+        dq_in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b // h, 0, i)),
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h, 0, j)),
+        ]
+        dq_inputs += [q_seg, k_seg]
+
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
         grid=(bh, nq, pl.cdiv(sk, block_k)),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=dq_in_specs,
         out_specs=qspec,
         out_shape=_sds((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
     # dkv grid: (b*kvh, ki, t) with t covering the group's q heads x tiles
     def _qflat(b2, t):
@@ -340,10 +399,19 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
     kspec2 = pl.BlockSpec((1, block_k, d), lambda b2, j, t: (b2, j, 0))
     rowspec2 = pl.BlockSpec((1, 8, block_q),
                             lambda b2, j, t: (_qflat(b2, t), 0, t % nq))
+    kv_in_specs = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
+    kv_inputs = [q, k, v, do, lse, delta]
+    if has_segments:
+        kv_in_specs += [
+            pl.BlockSpec((1, 8, block_q),
+                         lambda b2, j, t: (b2 // kvh, 0, t % nq)),
+            pl.BlockSpec((1, 8, block_k), lambda b2, j, t: (b2 // kvh, 0, j)),
+        ]
+        kv_inputs += [q_seg, k_seg]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common, nq=nq),
         grid=(bkv, pl.cdiv(sk, block_k), rep * nq),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        in_specs=kv_in_specs,
         out_specs=(kspec2, kspec2),
         out_shape=(_sds((bkv, sk, d), k.dtype),
                    _sds((bkv, sk, d), v.dtype)),
@@ -352,7 +420,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*kv_inputs)
     return dq, dk, dv
 
 
@@ -366,10 +434,11 @@ def _from_bh(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, scale, interpret):
-    """q: [b, s, h, d]; k,v: [b, s, kvh, d] (kvh divides h — native GQA)."""
-    out, _ = _flash_fwd(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, q_seg, k_seg, causal, scale, interpret):
+    """q: [b, s, h, d]; k,v: [b, s, kvh, d] (kvh divides h — native GQA);
+    q_seg/k_seg: [b, s] int32 segment ids or None."""
+    out, _ = _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret)
     return out
 
 
@@ -411,7 +480,7 @@ def _select_blocks(q, k, v, causal, scale, h, kvh, interpret):
     return _at.AutoTuneCache.instance().tune(key, cands, measure)
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret):
+def _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret):
     b, sq, h, d = q.shape
     sk, kvh = k.shape[1], k.shape[2]
     if h % kvh != 0:
@@ -426,31 +495,41 @@ def _flash_fwd(q, k, v, causal, scale, interpret):
                                       interpret)
     of, lse = _flash_forward(qb, kb, vb, causal, scale,
                              h=h, kvh=kvh, block_q=block_q, block_k=block_k,
-                             interpret=interpret)
-    return _from_bh(of, b, h), (q, k, v, _from_bh(of, b, h), lse)
+                             interpret=interpret, q_seg=q_seg, k_seg=k_seg)
+    return _from_bh(of, b, h), (q, k, v, q_seg, k_seg, _from_bh(of, b, h),
+                                lse)
 
 
 def _flash_bwd(causal, scale, interpret, res, g):
-    q, k, v, o, lse = res
+    q, k, v, q_seg, k_seg, o, lse = res
     b, sq, h, d = q.shape
     kvh = k.shape[2]
     dq, dk, dv = _flash_backward(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o), lse, _to_bh(g),
-        causal, scale, h=h, kvh=kvh, interpret=interpret)
-    return _from_bh(dq, b, h), _from_bh(dk, b, kvh), _from_bh(dv, b, kvh)
+        causal, scale, h=h, kvh=kvh, interpret=interpret,
+        q_seg=q_seg, k_seg=k_seg)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, kvh), _from_bh(dv, b, kvh),
+            None, None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention_raw(q, k, v, causal: bool = True, scale=None,
-                        interpret=None):
-    """Pure-jax-array entry: q,k,v [b, s, h, d] with equal head counts."""
+                        interpret=None, q_segment_ids=None,
+                        kv_segment_ids=None):
+    """Pure-jax-array entry: q,k,v [b, s, h, d]; optional [b, s] int32
+    segment ids (padding / sequence-packing masks, splash-attention
+    style: q attends k iff their ids match)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    return _flash(q, k, v, bool(causal), float(scale), bool(interpret))
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids must be given "
+                         "together")
+    return _flash(q, k, v, q_segment_ids, kv_segment_ids, bool(causal),
+                  float(scale), bool(interpret))
 
 
 # framework op registration (tape + AMP aware)
@@ -458,5 +537,8 @@ from ..registry import register  # noqa: E402
 
 
 @register("pallas_flash_attention", amp="white")
-def flash_attention_op(q, k, v, causal=True, scale=None):
-    return flash_attention_raw(q, k, v, causal=causal, scale=scale)
+def flash_attention_op(q, k, v, q_segment_ids=None, kv_segment_ids=None,
+                       causal=True, scale=None):
+    return flash_attention_raw(q, k, v, causal=causal, scale=scale,
+                               q_segment_ids=q_segment_ids,
+                               kv_segment_ids=kv_segment_ids)

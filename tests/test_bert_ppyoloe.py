@@ -185,3 +185,56 @@ class TestReviewRegressions:
         want = [ss.multivariate_normal.logpdf(np.zeros(2), np.zeros(2), c)
                 for c in covs]
         np.testing.assert_allclose(lp, want, rtol=1e-4)
+
+
+class TestBertTrainStepRegressions:
+    def test_dropout_varies_per_step(self):
+        """The compiled step must draw FRESH dropout masks per step: same
+        params/data at two different step_no values give different losses
+        (a trace-time host key would bake one mask in)."""
+        import dataclasses
+        import jax as j
+
+        cfg = BertConfig.debug()
+        assert cfg.hidden_dropout_prob > 0
+        m = BertForSequenceClassification(cfg, num_classes=3)
+        m.train()
+        opt = paddle.optimizer.SGD(learning_rate=0.0,  # lr 0: params frozen
+                                   parameters=m.parameters())
+        step = build_bert_train_step(m, opt)
+        params = m.functional_state()
+        st = opt.init_state(params)
+        ids = np.random.randint(0, 97, (8, 10)).astype("int32")
+        labs = np.random.randint(0, 3, (8,)).astype("int32")
+
+        def deep(t):
+            return j.tree_util.tree_map(jnp.copy, t)
+
+        l0, _, _ = step(deep(params), deep(st), 0, 0.0, ids, labs)
+        l0b, _, _ = step(deep(params), deep(st), 0, 0.0, ids, labs)
+        l1, _, _ = step(deep(params), deep(st), 1, 0.0, ids, labs)
+        assert float(l0) == float(l0b)      # deterministic per step_no
+        assert float(l0) != float(l1)       # fresh mask per step
+
+    def test_step_honors_attention_mask(self):
+        cfg = BertConfig.debug()
+        m = BertForSequenceClassification(cfg, num_classes=3)
+        m.eval()  # no dropout: isolate the mask effect
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=m.parameters())
+        step = build_bert_train_step(m, opt)
+        params = m.functional_state()
+        st = opt.init_state(params)
+        import jax as j
+
+        def deep(t):
+            return j.tree_util.tree_map(jnp.copy, t)
+
+        ids = np.random.randint(0, 97, (2, 8)).astype("int32")
+        ids2 = ids.copy()
+        ids2[:, 6:] = 5  # mutate padded-out tokens
+        labs = np.zeros((2,), "int32")
+        am = np.array([[1] * 6 + [0] * 2] * 2, "int32")
+        la, _, _ = step(deep(params), deep(st), 0, 0.0, ids, labs, am)
+        lb, _, _ = step(deep(params), deep(st), 0, 0.0, ids2, labs, am)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)

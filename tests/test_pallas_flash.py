@@ -104,3 +104,135 @@ def test_flash_op_through_tape():
     ref = _attn_reference(q, k, v, True, 1.0 / math.sqrt(16))
     np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def _seg_reference(q, k, v, seg, causal, scale):
+    import jax.numpy as jnp
+
+    rep = q.shape[2] // k.shape[2]
+    kk = jnp.repeat(k, rep, axis=2)
+    vv = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+    mask = (seg[:, :, None] == seg[:, None, :])[:, None]
+    if causal:
+        s = q.shape[1]
+        mask = mask & jnp.tril(jnp.ones((s, s), bool))[None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), vv)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_padding_mask(causal):
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+
+    q, k, v = _rand_qkv(b=2, s=160, h=4, d=32, kv_heads=2)
+    lens = np.array([130, 96])
+    seg = jnp.asarray((np.arange(160)[None, :] < lens[:, None])
+                      .astype(np.int32))
+    scale = 1.0 / math.sqrt(32)
+    out = flash_attention_raw(q, k, v, causal=causal,
+                              q_segment_ids=seg, kv_segment_ids=seg,
+                              interpret=True)
+    want = _seg_reference(q, k, v, seg, causal, scale)
+    m = np.asarray(seg, bool)[:, :, None, None]
+    np.testing.assert_allclose(np.asarray(out) * m, np.asarray(want) * m,
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_segment_grads_match_reference():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+
+    q, k, v = _rand_qkv(b=2, s=128, h=2, d=32)
+    lens = np.array([100, 64])
+    seg = jnp.asarray((np.arange(128)[None, :] < lens[:, None])
+                      .astype(np.int32))
+    m = jnp.asarray(np.asarray(seg, bool)[:, :, None, None])
+    scale = 1.0 / math.sqrt(32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention_raw(q, k, v, causal=False, q_segment_ids=seg,
+                                kv_segment_ids=seg, interpret=True)
+        return ((o * m) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return ((_seg_reference(q, k, v, seg, False, scale) * m) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_packed_sequences():
+    """Two sequences packed in one row: ids [1]*64 + [2]*64 — tokens of
+    one packed sequence must not attend the other."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+
+    q, k, v = _rand_qkv(b=1, s=128, h=2, d=32)
+    seg = jnp.asarray(np.r_[np.full(64, 1), np.full(64, 2)][None, :]
+                      .astype(np.int32))
+    out = flash_attention_raw(q, k, v, causal=False, q_segment_ids=seg,
+                              kv_segment_ids=seg, interpret=True)
+    # first-half output must equal attention computed over first half only
+    half = flash_attention_raw(q[:, :64], k[:, :64], v[:, :64], causal=False,
+                               interpret=True)
+    np.testing.assert_allclose(np.asarray(out[:, :64]), np.asarray(half),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_incubate_routing_padding_mask_uses_pallas(monkeypatch):
+    """A [b, sk] boolean mask must ride the Pallas path (not silently fall
+    back to the XLA softmax path) when Pallas is available."""
+    import paddle_tpu.incubate.nn.attention as attn_mod
+
+    monkeypatch.setattr(attn_mod, "_PALLAS_OK", True)
+    calls = {}
+    from paddle_tpu.ops.registry import dispatch as real_dispatch
+
+    def spy(name, *a, **kw):
+        calls[name] = calls.get(name, 0) + 1
+        return real_dispatch(name, *a, **kw)
+
+    monkeypatch.setattr(attn_mod, "dispatch", spy)
+    q, k, v = _rand_qkv(b=2, s=96, h=2, d=32)
+    mask = paddle.to_tensor(np.arange(96)[None, :]
+                            < np.array([80, 60])[:, None])  # BOOL keep-mask
+    out = attn_mod.flash_attention(paddle.to_tensor(np.asarray(q)),
+                                   paddle.to_tensor(np.asarray(k)),
+                                   paddle.to_tensor(np.asarray(v)),
+                                   causal=False, attn_mask=mask)
+    assert calls.get("pallas_flash_attention", 0) == 1, calls
+    assert "scaled_dot_product_attention" not in calls
+    # an INT mask is additive (sdpa semantics) and must NOT be rerouted
+    imask = paddle.to_tensor(np.zeros((2, 1, 1, 96), np.float32))
+    attn_mod.flash_attention(paddle.to_tensor(np.asarray(q)),
+                             paddle.to_tensor(np.asarray(k)),
+                             paddle.to_tensor(np.asarray(v)),
+                             causal=False, attn_mask=imask)
+    assert calls.get("scaled_dot_product_attention", 0) == 1, calls
+
+
+def test_incubate_bool_mask_same_numerics_on_fallback(monkeypatch):
+    """Pallas path and XLA fallback must agree on a bool keep-mask."""
+    import paddle_tpu.incubate.nn.attention as attn_mod
+
+    q, k, v = _rand_qkv(b=2, s=64, h=2, d=32)
+    mask_np = np.arange(64)[None, :] < np.array([50, 30])[:, None]
+    args = [paddle.to_tensor(np.asarray(t)) for t in (q, k, v)]
+    monkeypatch.setattr(attn_mod, "_PALLAS_OK", True)
+    a = attn_mod.flash_attention(*args, causal=False,
+                                 attn_mask=paddle.to_tensor(mask_np))
+    monkeypatch.setattr(attn_mod, "_PALLAS_OK", False)
+    b = attn_mod.flash_attention(*args, causal=False,
+                                 attn_mask=paddle.to_tensor(mask_np))
+    m = mask_np[:, :, None, None]
+    np.testing.assert_allclose(np.asarray(a._value) * m,
+                               np.asarray(b._value) * m, rtol=2e-5,
+                               atol=2e-5)
