@@ -5,7 +5,7 @@ from .decode_attention import (
 )
 from .fused import (
     fused_layer_norm, fused_linear_activation, fused_matmul_bias,
-    fused_rms_norm, fused_rotary_position_embedding, swiglu,
+    fused_moe, fused_rms_norm, fused_rotary_position_embedding, swiglu,
 )
 from .attention import flash_attention
 from .fused_transformer import FusedMultiTransformer

@@ -95,16 +95,23 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
         value = repeat_interleave(value, rep, axis=2)
     if attn_mask is not None:
         # a [b, sk] (or [b,1,1,sk]) bool keep-mask must mean the same
-        # thing on this path as on the Pallas one: normalize it to the
-        # broadcastable [b, 1, 1, sk] bool shape sdpa's where() expects
+        # thing here as on the Pallas path, where it becomes SEGMENT ids
+        # (q attends k iff same segment — padded queries see only padded
+        # keys).  Expand to the equivalent [b, 1, sq, sk] equality mask so
+        # both backends produce identical outputs at every position.
         from ...core.tensor import Tensor
         import jax.numpy as jnp
 
         mv = attn_mask._value if isinstance(attn_mask, Tensor) else \
             jnp.asarray(attn_mask)
+        if mv.ndim == 4 and mv.shape[1] == 1 and mv.shape[2] == 1 \
+                and jnp.issubdtype(mv.dtype, jnp.bool_):
+            mv = mv[:, 0, 0]
         if jnp.issubdtype(mv.dtype, jnp.bool_) and mv.ndim == 2 \
-                and mv.shape == (key.shape[0], key.shape[1]):
-            attn_mask = Tensor(mv[:, None, None, :])
+                and mv.shape == (key.shape[0], key.shape[1]) \
+                and query.shape[1] == key.shape[1]:
+            attn_mask = Tensor(
+                (mv[:, :, None] == mv[:, None, :])[:, None, :, :])
     dropout_mask = None
     if dropout > 0.0:
         from ...core.tensor import Tensor

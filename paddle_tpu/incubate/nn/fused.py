@@ -131,3 +131,54 @@ def _fused_linear_activation_op(x, y, bias=None, activation="gelu"):
 
 def fused_linear_activation(x, y, bias=None, activation="gelu"):
     return dispatch("fused_linear_activation", x, y, bias, activation=activation)
+
+
+@register("fused_moe", amp="white")
+def _fused_moe_op(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+                  ffn2_bias=None, moe_topk=2, norm_topk_prob=True,
+                  activation="gelu"):
+    """Reference: python/paddle/incubate/nn/functional/fused_moe.py — the
+    fused inference-path MoE FFN (gate -> top-k -> expert FFNs ->
+    weighted combine) with NO token dropping.  TPU formulation: dense
+    per-expert evaluation (every expert runs every token on the MXU,
+    cost E x FFN) + a scatter of normalized top-k weights; exact and
+    fusion-friendly at decode/inference scales.  Capacity-based
+    EP-sharded training should use MoELayer (moe_forward op) instead.
+
+    x [..., m]; gate_weight [m, E]; ffn1_weight [E, m, h] (2h for
+    swiglu); ffn2_weight [E, h, m]."""
+    orig = x.shape
+    m = orig[-1]
+    x2 = x.reshape(-1, m)
+    g = x2.shape[0]
+    e = gate_weight.shape[-1]
+    logits = x2.astype(jnp.float32) @ gate_weight.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, moe_topk)          # [G, K]
+    if norm_topk_prob:
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-12)
+    h = jnp.einsum("gm,emh->egh", x2, ffn1_weight)
+    if ffn1_bias is not None:
+        h = h + ffn1_bias[:, None, :]
+    if activation == "gelu":
+        h = jax.nn.gelu(h)
+    elif activation == "relu":
+        h = jax.nn.relu(h)
+    elif activation == "swiglu":
+        a, b = jnp.split(h, 2, axis=-1)
+        h = jax.nn.silu(a) * b
+    eo = jnp.einsum("egh,ehm->egm", h, ffn2_weight)
+    if ffn2_bias is not None:
+        eo = eo + ffn2_bias[:, None, :]
+    w_full = jnp.zeros((g, e), jnp.float32).at[
+        jnp.arange(g)[:, None], topi].add(topv)
+    y = jnp.einsum("ge,egm->gm", w_full.astype(x.dtype), eo)
+    return y.reshape(orig)
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, moe_topk=2, norm_topk_prob=True,
+              activation="gelu"):
+    return dispatch("fused_moe", x, gate_weight, ffn1_weight, ffn2_weight,
+                    ffn1_bias, ffn2_bias, moe_topk=moe_topk,
+                    norm_topk_prob=norm_topk_prob, activation=activation)

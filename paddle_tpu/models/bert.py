@@ -186,6 +186,9 @@ def build_bert_train_step(model: BertForSequenceClassification, optimizer,
             input_ids = jax.lax.with_sharding_constraint(input_ids,
                                                          batch_sharding)
             labels = jax.lax.with_sharding_constraint(labels, batch_sharding)
+            if attention_mask is not None:
+                attention_mask = jax.lax.with_sharding_constraint(
+                    attention_mask, batch_sharding)
         rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed), step_no)
         loss, grads = grad_fn(params, input_ids, labels, attention_mask, rng)
         new_params, new_state = optimizer.apply(params, grads, opt_state, lr,

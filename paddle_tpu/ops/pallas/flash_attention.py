@@ -129,11 +129,18 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
     @pl.when(ki == nk - 1)
     def _():
         l = l_scr[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        l = jnp.where(l == 0.0, 1.0, l)
+        # a row that never saw an unmasked key keeps m == NEG_INF: inside
+        # its tiles every s == m so p == 1 everywhere, poisoning acc/l
+        # with a uniform attend-everything.  Zero those rows and pin their
+        # lse to +1e30 so the backward's p = exp(s - lse) underflows to 0.
+        valid = m_scr[:, :1] > NEG_INF * 0.5          # [BQ, 1]
+        o = jnp.where(valid, acc_scr[:] / l, 0.0)
+        o_ref[0] = o.astype(o_ref.dtype)
         # lse stored sublane-replicated (8, BQ): TPU block dims must be
         # (8k, 128k)-aligned, a flat (1, BQ) block is rejected by Mosaic
-        lse_row = (m_scr[:, :1] + jnp.log(l)).reshape(1, -1)
+        lse_col = jnp.where(valid, m_scr[:, :1] + jnp.log(l), -NEG_INF)
+        lse_row = lse_col.reshape(1, -1)
         lse_ref[0] = jnp.broadcast_to(lse_row, lse_ref.shape[1:])
 
 

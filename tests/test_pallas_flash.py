@@ -236,3 +236,66 @@ def test_incubate_bool_mask_same_numerics_on_fallback(monkeypatch):
     np.testing.assert_allclose(np.asarray(a._value) * m,
                                np.asarray(b._value) * m, rtol=2e-5,
                                atol=2e-5)
+
+
+def test_flash_fully_masked_row_outputs_zero():
+    """A q row whose segment id appears in NO key must output exactly 0
+    with zero gradients — not a uniform attend-everything (the p=exp(0)
+    poisoning when every s == m == NEG_INF)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+
+    q, k, v = _rand_qkv(b=1, s=64, h=2, d=32)
+    qs = np.full((1, 64), 1, np.int32)
+    qs[0, 10] = 7                      # no key carries id 7
+    ks = np.full((1, 64), 1, np.int32)
+    out = flash_attention_raw(q, k, v, causal=False,
+                              q_segment_ids=jnp.asarray(qs),
+                              kv_segment_ids=jnp.asarray(ks),
+                              interpret=True)
+    np.testing.assert_array_equal(np.asarray(out[0, 10]), 0.0)
+
+    def loss(q, k, v):
+        o = flash_attention_raw(q, k, v, causal=False,
+                                q_segment_ids=jnp.asarray(qs),
+                                kv_segment_ids=jnp.asarray(ks),
+                                interpret=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) \
+            / math.sqrt(32)
+        mask = (jnp.asarray(qs)[:, :, None]
+                == jnp.asarray(ks)[:, None, :])[:, None]
+        logits = jnp.where(mask, logits, -1e30)
+        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), v)
+        # reference softmax of an all -1e30 row is uniform: zero it to
+        # match the kernel's (correct) empty-row convention
+        o = o.at[0, 10].set(0.0)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert np.all(np.isfinite(np.asarray(a)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_incubate_padded_rows_agree_between_paths(monkeypatch):
+    """Pallas route and XLA fallback must now agree at EVERY position,
+    including padded query rows (both use segment semantics)."""
+    import paddle_tpu.incubate.nn.attention as attn_mod
+
+    q, k, v = _rand_qkv(b=2, s=64, h=2, d=32)
+    mask_np = np.arange(64)[None, :] < np.array([50, 30])[:, None]
+    args = [paddle.to_tensor(np.asarray(t)) for t in (q, k, v)]
+    monkeypatch.setattr(attn_mod, "_PALLAS_OK", True)
+    a = attn_mod.flash_attention(*args, causal=False,
+                                 attn_mask=paddle.to_tensor(mask_np))
+    monkeypatch.setattr(attn_mod, "_PALLAS_OK", False)
+    b = attn_mod.flash_attention(*args, causal=False,
+                                 attn_mask=paddle.to_tensor(mask_np))
+    np.testing.assert_allclose(np.asarray(a._value), np.asarray(b._value),
+                               rtol=2e-5, atol=2e-5)
