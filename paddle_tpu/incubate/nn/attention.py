@@ -108,10 +108,14 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
                 and jnp.issubdtype(mv.dtype, jnp.bool_):
             mv = mv[:, 0, 0]
         if jnp.issubdtype(mv.dtype, jnp.bool_) and mv.ndim == 2 \
-                and mv.shape == (key.shape[0], key.shape[1]) \
-                and query.shape[1] == key.shape[1]:
-            attn_mask = Tensor(
-                (mv[:, :, None] == mv[:, None, :])[:, None, :, :])
+                and mv.shape == (key.shape[0], key.shape[1]):
+            if query.shape[1] == key.shape[1]:
+                attn_mask = Tensor(
+                    (mv[:, :, None] == mv[:, None, :])[:, None, :, :])
+            else:
+                # decode shapes (sq != sk): every query is a live token,
+                # only keys carry padding — plain broadcastable keep-mask
+                attn_mask = Tensor(mv[:, None, None, :])
     dropout_mask = None
     if dropout > 0.0:
         from ...core.tensor import Tensor

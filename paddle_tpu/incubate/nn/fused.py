@@ -167,6 +167,9 @@ def _fused_moe_op(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     elif activation == "swiglu":
         a, b = jnp.split(h, 2, axis=-1)
         h = jax.nn.silu(a) * b
+    else:
+        raise ValueError(f"fused_moe: unsupported activation "
+                         f"{activation!r} (gelu | relu | swiglu)")
     eo = jnp.einsum("egh,ehm->egm", h, ffn2_weight)
     if ffn2_bias is not None:
         eo = eo + ffn2_bias[:, None, :]

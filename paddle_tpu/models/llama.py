@@ -104,7 +104,7 @@ class LlamaAttention(Layer):
         self.v_proj = nn.Linear(h, cfg.num_key_value_heads * d, bias_attr=False)
         self.o_proj = nn.Linear(cfg.num_attention_heads * d, h, bias_attr=False)
 
-    def forward(self, x, cos, sin):
+    def forward(self, x, cos, sin, attn_mask=None):
         cfg = self.cfg
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, cfg.num_attention_heads, cfg.head_dim])
@@ -118,8 +118,9 @@ class LlamaAttention(Layer):
         q, k = fused_rotary_position_embedding(q, k, sin=sin_b, cos=cos_b)
         # GQA goes to the attention entry unexpanded: the Pallas kernel
         # routes q heads to kv groups via index maps (no HBM repeat); the
-        # XLA fallback repeats internally
-        out = flash_attention(q, k, v, causal=True)
+        # XLA fallback repeats internally.  A bool [b, s] keep-mask rides
+        # the Pallas path as segment ids (padded batches / packing).
+        out = flash_attention(q, k, v, causal=True, attn_mask=attn_mask)
         return self.o_proj(out.reshape([b, s, -1]))
 
 
@@ -142,8 +143,9 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cos, sin):
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+    def forward(self, x, cos, sin, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin,
+                               attn_mask=attn_mask)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -170,7 +172,7 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, attention_mask=None):
         from ..autograd import is_grad_enabled
 
         s = input_ids.shape[-1]
@@ -194,18 +196,33 @@ class LlamaModel(Layer):
             return Tensor(jax.lax.with_sharding_constraint(
                 t._value, self.act_sharding))
 
+        if attention_mask is not None and not isinstance(attention_mask,
+                                                         Tensor):
+            attention_mask = Tensor(jnp.asarray(attention_mask))
+        if attention_mask is not None:
+            mv = attention_mask._value
+            if not (jnp.issubdtype(mv.dtype, jnp.bool_)
+                    or jnp.issubdtype(mv.dtype, jnp.integer)):
+                # a blind bool cast would INVERT the additive convention
+                # (0 = keep, -1e9 = masked); demand an explicit keep-mask
+                raise TypeError(
+                    "LlamaModel.attention_mask expects a bool/0-1 integer "
+                    f"keep-mask [b, s], got dtype {mv.dtype}; convert an "
+                    "additive float mask with (mask == 0) first")
+            attention_mask = Tensor(mv.astype(bool))
         x = _pin(x)
         for layer in self.layers:
             if use_remat:
-                x = _remat_layer_call(layer, x, cos, sin, self.remat_policy)
+                x = _remat_layer_call(layer, x, cos, sin, self.remat_policy,
+                                      attention_mask)
             else:
-                x = layer(x, cos, sin)
+                x = layer(x, cos, sin, attn_mask=attention_mask)
             x = _pin(x)
         return self.norm(x)
 
 
 def _remat_layer_call(layer: "LlamaDecoderLayer", x: Tensor, cos: Tensor,
-                      sin: Tensor, policy=None) -> Tensor:
+                      sin: Tensor, policy=None, attn_mask=None) -> Tensor:
     """Run one decoder layer under jax.checkpoint: activations inside the
     layer are recomputed in backward (the analog of the reference's
     recompute pass, strategy.recompute / fleet recompute_configs).
@@ -220,14 +237,17 @@ def _remat_layer_call(layer: "LlamaDecoderLayer", x: Tensor, cos: Tensor,
     state = {k: (t._value if isinstance(t, Tensor) else t)
              for k, t in layer.state_dict().items()}
 
-    @functools.partial(jax.checkpoint, policy=policy)
-    def body(state, xv, cosv, sinv):
+    @functools.partial(jax.checkpoint, policy=policy, static_argnums=(4,))
+    def body(state, xv, cosv, sinv, has_mask, maskv):
         with no_grad():
-            out = layer.functional_call(state, Tensor(xv), Tensor(cosv),
-                                        Tensor(sinv))
+            out = layer.functional_call(
+                state, Tensor(xv), Tensor(cosv), Tensor(sinv),
+                attn_mask=Tensor(maskv) if has_mask else None)
         return out._value
 
-    return Tensor(body(state, x._value, cos._value, sin._value))
+    mv = attn_mask._value if attn_mask is not None else jnp.zeros((), bool)
+    return Tensor(body(state, x._value, cos._value, sin._value,
+                       attn_mask is not None, mv))
 
 
 class LlamaForCausalLM(Layer):
@@ -240,10 +260,10 @@ class LlamaForCausalLM(Layer):
         else:
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, attention_mask=None):
         from ..ops.linalg import matmul
 
-        h = self.model(input_ids, position_ids)
+        h = self.model(input_ids, position_ids, attention_mask)
         if self.cfg.tie_word_embeddings:
             # tape-recorded matmul against the embedding Parameter itself so
             # the head contributes gradients to embed_tokens in eager mode

@@ -196,3 +196,59 @@ def test_grad_accum_matches_full_batch():
         np.testing.assert_allclose(np.asarray(p_acc[k]),
                                    np.asarray(p_full[k]), atol=1e-5,
                                    err_msg=k)
+
+
+def test_attention_mask_isolates_padding():
+    """A bool [b, s] keep-mask must make valid-position logits invariant
+    to pad-token content (rides the segment-masked flash path on TPU)."""
+    cfg = LlamaConfig.debug()
+    m = LlamaForCausalLM(cfg)
+    ids = np.random.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    am = np.arange(12)[None, :] < np.array([9, 6])[:, None]
+    o1 = m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(am))
+    ids2 = ids.copy()
+    ids2[0, 10] = 7
+    ids2[1, 8] = 3
+    o2 = m(paddle.to_tensor(ids2), attention_mask=paddle.to_tensor(am))
+    np.testing.assert_allclose(o1.numpy()[0, :9], o2.numpy()[0, :9],
+                               atol=1e-5)
+    np.testing.assert_allclose(o1.numpy()[1, :6], o2.numpy()[1, :6],
+                               atol=1e-5)
+
+
+def test_attention_mask_under_remat_matches_eager():
+    cfg = LlamaConfig.debug()
+    m = LlamaForCausalLM(cfg)
+    ids = np.random.randint(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    am = np.arange(8)[None, :] < np.array([6, 8])[:, None]
+
+    plain = m(paddle.to_tensor(ids),
+              attention_mask=paddle.to_tensor(am)).numpy()
+
+    import jax as j
+
+    params = m.functional_state()
+
+    def fwd(params, ids_v, am_v):
+        from paddle_tpu.autograd import no_grad
+
+        m.model.remat = True
+        try:
+            with no_grad():
+                return m.functional_call(params, paddle.Tensor(ids_v),
+                                         attention_mask=paddle.Tensor(am_v)
+                                         )._value
+        finally:
+            m.model.remat = False
+
+    got = np.asarray(j.jit(fwd)(params, ids, am))
+    np.testing.assert_allclose(got, plain, rtol=1e-4, atol=1e-4)
+
+
+def test_attention_mask_rejects_additive_float():
+    cfg = LlamaConfig.debug(layers=1)
+    m = LlamaForCausalLM(cfg)
+    ids = np.random.randint(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+    bad = np.array([[0.0, 0.0, -1e9, -1e9]], "float32")  # additive style
+    with pytest.raises(TypeError):
+        m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(bad))

@@ -299,3 +299,28 @@ def test_incubate_padded_rows_agree_between_paths(monkeypatch):
                                  attn_mask=paddle.to_tensor(mask_np))
     np.testing.assert_allclose(np.asarray(a._value), np.asarray(b._value),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_incubate_decode_shape_bool_mask():
+    """sq != sk (decode): a [b, sk] bool mask must broadcast correctly on
+    the fallback (regression: the equality expand was gated on sq == sk
+    and left the raw 2-D mask to misbroadcast)."""
+    import paddle_tpu.incubate.nn.attention as attn_mod
+
+    rng = np.random.RandomState(0)
+    q = paddle.to_tensor(rng.randn(2, 1, 2, 16).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype(np.float32))
+    mask = np.arange(8)[None, :] < np.array([6, 4])[:, None]
+    out = attn_mod.flash_attention(q, k, v, causal=False,
+                                   attn_mask=paddle.to_tensor(mask))
+    assert tuple(out.shape) == (2, 1, 2, 16)
+    # golden: masked softmax attention over valid keys only
+    qj, kj, vj = (np.asarray(t._value) for t in (q, k, v))
+    logits = np.einsum("bqhd,bkhd->bhqk", qj, kj) / np.sqrt(16)
+    logits = np.where(mask[:, None, None, :], logits, -1e30)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bkhd->bqhd", p, vj)
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=2e-5,
+                               atol=2e-5)
