@@ -46,27 +46,48 @@ def _as_padding_segments(attn_mask, query, key):
 
 
 def flash_attention(query, key, value, causal=False, dropout=0.0,
-                    attn_mask=None, scale=None):
+                    attn_mask=None, scale=None, q_segment_ids=None,
+                    kv_segment_ids=None):
     """(batch, seq, heads, head_dim) attention, flash-style.  GQA (fewer
     kv heads) is accepted: the Pallas kernel routes q heads to kv groups
     natively; the XLA fallback repeats kv heads.  A [b, sk] boolean
-    padding mask rides the Pallas path as segment ids (splash-attention
-    style); arbitrary additive masks and dropout use the XLA path."""
-    seg = None
-    if _pallas_available() and attn_mask is not None and dropout == 0.0:
+    padding mask — or explicit int [b, s] segment ids (sequence packing)
+    — rides the Pallas path splash-attention style; arbitrary additive
+    masks and dropout use the XLA path."""
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids must be given "
+                         "together")
+    if q_segment_ids is not None:
+        if attn_mask is not None:
+            raise ValueError("pass either attn_mask or segment ids, "
+                             "not both")
+        import jax.numpy as jnp
+
+        qsv = q_segment_ids._value if hasattr(q_segment_ids, "_value") \
+            else jnp.asarray(q_segment_ids)
+        ksv = kv_segment_ids._value if hasattr(kv_segment_ids, "_value") \
+            else jnp.asarray(kv_segment_ids)
+        seg_pair = (qsv.astype(jnp.int32), ksv.astype(jnp.int32))
+    else:
+        seg_pair = None
+    if attn_mask is not None and dropout == 0.0:
         seg = _as_padding_segments(attn_mask, query, key)
-    if _pallas_available() and dropout == 0.0 \
-            and (attn_mask is None or seg is not None):
+        if seg is not None:
+            # the bool keep-mask is fully expressed as segment ids from
+            # here on (both backends use the same equality semantics)
+            seg_pair = (seg, seg)
+            attn_mask = None
+    if _pallas_available() and dropout == 0.0 and attn_mask is None:
         try:
             from ...ops.pallas.flash_attention import (FlashUnsupportedError,
                                                        flash_attention_op)
 
-            if seg is not None:
+            if seg_pair is not None:
                 from ...core.tensor import Tensor as _T
 
                 return dispatch("pallas_flash_attention", query, key, value,
-                                q_segment_ids=_T(seg),
-                                kv_segment_ids=_T(seg),
+                                q_segment_ids=_T(seg_pair[0]),
+                                kv_segment_ids=_T(seg_pair[1]),
                                 causal=causal, scale=scale)
             return dispatch("pallas_flash_attention", query, key, value,
                             causal=causal, scale=scale)
@@ -93,12 +114,18 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
 
         key = repeat_interleave(key, rep, axis=2)
         value = repeat_interleave(value, rep, axis=2)
-    if attn_mask is not None:
-        # a [b, sk] (or [b,1,1,sk]) bool keep-mask must mean the same
-        # thing here as on the Pallas path, where it becomes SEGMENT ids
-        # (q attends k iff same segment — padded queries see only padded
-        # keys).  Expand to the equivalent [b, 1, sq, sk] equality mask so
-        # both backends produce identical outputs at every position.
+    if attn_mask is None and seg_pair is not None:
+        # segment ids on the XLA path: the same equality semantics the
+        # Pallas kernel applies (bool keep-masks were folded into
+        # seg_pair above, so this is the single masked-fallback branch)
+        from ...core.tensor import Tensor
+
+        attn_mask = Tensor(
+            (seg_pair[0][:, :, None] == seg_pair[1][:, None, :])[:, None])
+    elif attn_mask is not None:
+        # masks _as_padding_segments rejected: decode shapes (sq != sk)
+        # with a [b, sk] bool keep-mask normalize to the broadcastable
+        # form; anything else (additive float/4-D) passes through as-is
         from ...core.tensor import Tensor
         import jax.numpy as jnp
 
@@ -109,13 +136,8 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
             mv = mv[:, 0, 0]
         if jnp.issubdtype(mv.dtype, jnp.bool_) and mv.ndim == 2 \
                 and mv.shape == (key.shape[0], key.shape[1]):
-            if query.shape[1] == key.shape[1]:
-                attn_mask = Tensor(
-                    (mv[:, :, None] == mv[:, None, :])[:, None, :, :])
-            else:
-                # decode shapes (sq != sk): every query is a live token,
-                # only keys carry padding — plain broadcastable keep-mask
-                attn_mask = Tensor(mv[:, None, None, :])
+            # every decode query is a live token; only keys carry padding
+            attn_mask = Tensor(mv[:, None, None, :])
     dropout_mask = None
     if dropout > 0.0:
         from ...core.tensor import Tensor

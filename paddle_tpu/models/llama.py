@@ -118,9 +118,16 @@ class LlamaAttention(Layer):
         q, k = fused_rotary_position_embedding(q, k, sin=sin_b, cos=cos_b)
         # GQA goes to the attention entry unexpanded: the Pallas kernel
         # routes q heads to kv groups via index maps (no HBM repeat); the
-        # XLA fallback repeats internally.  A bool [b, s] keep-mask rides
-        # the Pallas path as segment ids (padded batches / packing).
-        out = flash_attention(q, k, v, causal=True, attn_mask=attn_mask)
+        # XLA fallback repeats internally.  ``attn_mask`` arrives as int32
+        # SEGMENT ids ([b, s], normalized by LlamaModel): 1/0 for padded
+        # batches, arbitrary ids for packed sequences — splash-attention
+        # semantics on both backends.
+        if attn_mask is not None:
+            out = flash_attention(q, k, v, causal=True,
+                                  q_segment_ids=attn_mask,
+                                  kv_segment_ids=attn_mask)
+        else:
+            out = flash_attention(q, k, v, causal=True)
         return self.o_proj(out.reshape([b, s, -1]))
 
 
@@ -203,13 +210,22 @@ class LlamaModel(Layer):
             mv = attention_mask._value
             if not (jnp.issubdtype(mv.dtype, jnp.bool_)
                     or jnp.issubdtype(mv.dtype, jnp.integer)):
-                # a blind bool cast would INVERT the additive convention
-                # (0 = keep, -1e9 = masked); demand an explicit keep-mask
+                # a blind cast would INVERT the additive convention
+                # (0 = keep, -1e9 = masked); demand keep-mask/segment ids
                 raise TypeError(
-                    "LlamaModel.attention_mask expects a bool/0-1 integer "
-                    f"keep-mask [b, s], got dtype {mv.dtype}; convert an "
-                    "additive float mask with (mask == 0) first")
-            attention_mask = Tensor(mv.astype(bool))
+                    "LlamaModel.attention_mask expects a bool keep-mask or "
+                    f"int segment ids [b, s], got dtype {mv.dtype}; convert "
+                    "an additive float mask with (mask == 0) first")
+            if (jnp.issubdtype(mv.dtype, jnp.integer)
+                    and not isinstance(mv, jax.core.Tracer)
+                    and bool(jnp.any(mv < 0))):
+                # negative values are the additive-int convention in
+                # disguise — reject rather than treat them as segment ids
+                raise TypeError(
+                    "integer attention_mask values must be >= 0 (segment "
+                    "ids; 0 marks padding) — additive masks are not "
+                    "accepted")
+            attention_mask = Tensor(mv.astype(jnp.int32))
         x = _pin(x)
         for layer in self.layers:
             if use_remat:
@@ -379,7 +395,7 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
     batch_sharding = make_batch_shardings(mesh, data_axes) if mesh is not None \
         else None
 
-    def loss_fn(params: Dict[str, Any], input_ids, labels):
+    def loss_fn(params: Dict[str, Any], input_ids, labels, attn_mask=None):
         cast = {k: (v.astype(compute_dtype)
                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
                 for k, v in params.items()}
@@ -399,7 +415,10 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
                 mesh, P(batch_sharding.spec[0], None, None))
         try:
             with no_grad():  # tape off: jax.grad provides the gradients
-                logits = model.functional_call(cast, Tensor(input_ids))
+                logits = model.functional_call(
+                    cast, Tensor(input_ids),
+                    attention_mask=None if attn_mask is None
+                    else Tensor(attn_mask))
         finally:
             model.model.remat = saved_remat
             model.model.remat_policy = saved_policy
@@ -415,21 +434,30 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
         lse = jax.scipy.special.logsumexp(lv.astype(jnp.float32), axis=-1)
         gold = jnp.take_along_axis(lv, labels[..., None],
                                    axis=-1)[..., 0].astype(jnp.float32)
-        return (lse - gold).mean()
+        nll = lse - gold
+        if attn_mask is None:
+            return nll.mean()
+        w = (attn_mask > 0).astype(jnp.float32)
+        return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
 
     grad_fn = jax.value_and_grad(loss_fn)
 
-    def step_fn(params, opt_state, step_no, lr, input_ids, labels):
+    def step_fn(params, opt_state, step_no, lr, input_ids, labels,
+                attention_mask=None):
         if batch_sharding is not None:
             input_ids = jax.lax.with_sharding_constraint(input_ids, batch_sharding)
             labels = jax.lax.with_sharding_constraint(labels, batch_sharding)
-        loss, grads = grad_fn(params, input_ids, labels)
+            if attention_mask is not None:
+                attention_mask = jax.lax.with_sharding_constraint(
+                    attention_mask, batch_sharding)
+        loss, grads = grad_fn(params, input_ids, labels, attention_mask)
         new_params, new_opt_state = optimizer.apply(
             params, grads, opt_state, lr, step_no + 1,
             decay_mask={n: n not in no_decay for n in names})
         return loss, new_params, new_opt_state
 
-    def accum_step_fn(params, opt_state, step_no, lr, input_ids, labels):
+    def accum_step_fn(params, opt_state, step_no, lr, input_ids, labels,
+                      attention_mask=None):
         """Gradient accumulation (reference: strategy gradient-merge /
         GradientMergeOptimizer): ids/labels carry a leading [accum_steps]
         micro-batch axis; one fp32 grad buffer is accumulated by a
@@ -441,17 +469,36 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
             micro = NamedSharding(mesh, P(None, *mspec))
             input_ids = jax.lax.with_sharding_constraint(input_ids, micro)
             labels = jax.lax.with_sharding_constraint(labels, micro)
+            if attention_mask is not None:
+                attention_mask = jax.lax.with_sharding_constraint(
+                    attention_mask, micro)
 
+        # two scan bodies, NOT a fabricated all-ones mask: the mask-free
+        # path must keep the unmasked attention kernel and plain-mean CE
+        # (the headline bench runs here — a dummy mask would drag the
+        # segment-masked kernel variant into every layer)
         def micro_step(acc, xs):
             mids, mlabels = xs
-            loss, g = grad_fn(params, mids, mlabels)
+            loss, g = grad_fn(params, mids, mlabels, None)
+            acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), acc, g)
+            return acc, loss
+
+        def micro_step_masked(acc, xs):
+            mids, mlabels, mmask = xs
+            loss, g = grad_fn(params, mids, mlabels, mmask)
             acc = jax.tree_util.tree_map(
                 lambda a, b: a + b.astype(jnp.float32), acc, g)
             return acc, loss
 
         zero = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        acc, losses = jax.lax.scan(micro_step, zero, (input_ids, labels))
+        if attention_mask is None:
+            acc, losses = jax.lax.scan(micro_step, zero,
+                                       (input_ids, labels))
+        else:
+            acc, losses = jax.lax.scan(micro_step_masked, zero,
+                                       (input_ids, labels, attention_mask))
         grads = jax.tree_util.tree_map(lambda a: a / accum_steps, acc)
         new_params, new_opt_state = optimizer.apply(
             params, grads, opt_state, lr, step_no + 1,

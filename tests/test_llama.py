@@ -252,3 +252,52 @@ def test_attention_mask_rejects_additive_float():
     bad = np.array([[0.0, 0.0, -1e9, -1e9]], "float32")  # additive style
     with pytest.raises(TypeError):
         m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(bad))
+
+
+def test_train_step_attention_mask_isolates_pads():
+    """Compiled train step with a keep-mask: loss must be invariant to
+    pad-token content (attention AND the CE both masked)."""
+    cfg = LlamaConfig.debug(layers=1, hidden=32, heads=2, kv_heads=1,
+                            inter=64)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(parameters=model.parameters())
+    step = build_train_step(model, opt, compute_dtype=jnp.float32)
+    params = model.functional_state()
+    st = opt.init_state(params)
+
+    def deep(t):
+        return jax.tree_util.tree_map(jnp.copy, t)
+
+    ids = np.random.randint(0, cfg.vocab_size, (2, 8), dtype=np.int32)
+    am = (np.arange(8)[None, :] < np.array([6, 8])[:, None]).astype(np.int32)
+    ids2 = ids.copy()
+    ids2[0, 7] = (ids2[0, 7] + 3) % cfg.vocab_size
+    la, _, _ = step(deep(params), deep(st), 0, 0.0, ids, ids, am)
+    lb, _, _ = step(deep(params), deep(st), 0, 0.0, ids2, ids2, am)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+
+
+def test_packed_sequences_via_int_segment_ids():
+    """Int segment ids pack two sequences per row: the first packed
+    sequence's logits must equal running it alone."""
+    cfg = LlamaConfig.debug(layers=2)
+    m = LlamaForCausalLM(cfg)
+    a = np.random.randint(0, cfg.vocab_size, (1, 6)).astype(np.int32)
+    b = np.random.randint(0, cfg.vocab_size, (1, 6)).astype(np.int32)
+    packed = np.concatenate([a, b], axis=1)
+    seg = np.array([[1] * 6 + [2] * 6], np.int32)
+    pos = np.array([list(range(6)) + list(range(6))], np.int32)
+    out = m(paddle.to_tensor(packed), position_ids=paddle.to_tensor(pos),
+            attention_mask=paddle.to_tensor(seg))
+    alone = m(paddle.to_tensor(a))
+    np.testing.assert_allclose(out.numpy()[0, :6], alone.numpy()[0],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_additive_int_mask_rejected():
+    cfg = LlamaConfig.debug(layers=1)
+    m = LlamaForCausalLM(cfg)
+    ids = np.random.randint(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+    bad = np.array([[0, 0, -10000, -10000]], np.int64)
+    with pytest.raises(TypeError):
+        m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(bad))

@@ -324,3 +324,20 @@ def test_incubate_decode_shape_bool_mask():
     want = np.einsum("bhqk,bkhd->bqhd", p, vj)
     np.testing.assert_allclose(np.asarray(out._value), want, rtol=2e-5,
                                atol=2e-5)
+
+
+def test_incubate_segment_pair_required_together():
+    import paddle_tpu.incubate.nn.attention as attn_mod
+
+    q, k, v = _rand_qkv(b=1, s=32, h=2, d=16)
+    args = [paddle.to_tensor(np.asarray(t)) for t in (q, k, v)]
+    seg = paddle.to_tensor(np.ones((1, 32), np.int32))
+    with pytest.raises(ValueError):
+        attn_mod.flash_attention(*args, kv_segment_ids=seg)
+    with pytest.raises(ValueError):
+        attn_mod.flash_attention(*args, q_segment_ids=seg)
+    with pytest.raises(ValueError):
+        attn_mod.flash_attention(*args, q_segment_ids=seg,
+                                 kv_segment_ids=seg,
+                                 attn_mask=paddle.to_tensor(
+                                     np.ones((1, 32), bool)))
