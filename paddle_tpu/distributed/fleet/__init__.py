@@ -31,6 +31,7 @@ class _FleetState:
         self.initialized = False
         self.strategy: Optional[DistributedStrategy] = None
         self.hcg: Optional[HybridCommunicateGroup] = None
+        self.ps_role = None  # set by init(is_collective=False)
 
 
 _fleet = _FleetState()
@@ -39,7 +40,22 @@ _fleet = _FleetState()
 def init(role_maker=None, is_collective: bool = True,
          strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
     """Analog of fleet.init (fleet/fleet.py:218 → _init_hybrid_parallel_env
-    :674). Builds the hybrid topology mesh from strategy.hybrid_configs."""
+    :674). Builds the hybrid topology mesh from strategy.hybrid_configs.
+
+    ``is_collective=False`` (or an explicit PS role maker) selects the
+    parameter-server mode: the process joins the trainer/pserver rpc gang
+    (reference fleet PS mode → paddle_tpu.distributed.ps)."""
+    ps_mode = (not is_collective
+               or (role_maker is not None
+                   and not getattr(role_maker, "_is_collective", False)))
+    if ps_mode:
+        from .. import ps
+
+        role = ps.init(role_maker)
+        _fleet.initialized = True
+        _fleet.strategy = strategy or DistributedStrategy()
+        _fleet.ps_role = role
+        return None
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
     hcg = HybridCommunicateGroup(
@@ -64,6 +80,11 @@ def get_hybrid_communicate_group_():
 def distributed_model(model):
     """Pick the wrapper by parallel mode (reference: fleet/model.py:143-160)."""
     assert _fleet.initialized, "call fleet.init first"
+    if _fleet.ps_role is not None:
+        raise RuntimeError(
+            "fleet PS mode has no distributed_model wrapper: dense layers "
+            "train locally on each trainer; sparse tables live on the "
+            "pservers (use ps.pull_sparse/push_sparse)")
     hcg = _fleet.hcg
     strategy = _fleet.strategy
 
@@ -119,17 +140,25 @@ class HybridParallelOptimizer:
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     assert _fleet.initialized, "call fleet.init first"
+    if _fleet.ps_role is not None:
+        # PS mode: the dense optimizer runs as-is on each trainer; sparse
+        # updates happen server-side (SparseTable sgd/adagrad rows)
+        return optimizer
     return HybridParallelOptimizer(optimizer, _fleet.hcg,
                                    strategy or _fleet.strategy)
 
 
 # worker info parity (reference fleet.py worker_num/worker_index etc.)
 def worker_num() -> int:
+    if _fleet.ps_role is not None:
+        return _fleet.ps_role.worker_num()  # trainers only, not pservers
     from ..env import get_world_size
     return get_world_size()
 
 
 def worker_index() -> int:
+    if _fleet.ps_role is not None:
+        return _fleet.ps_role.worker_index()
     from ..env import get_rank
     return get_rank()
 
@@ -139,4 +168,47 @@ def is_first_worker() -> bool:
 
 
 def barrier_worker():
+    if _fleet.ps_role is not None:
+        return _ps().barrier_worker()
     return None
+
+
+# ---------------------------------------------------------------- PS mode
+# (reference fleet PS-mode surface: fleet.is_server/is_worker/run_server/
+# init_server/stop_worker delegate to the parameter-server gang)
+
+def _ps():
+    from .. import ps
+
+    if _fleet.ps_role is None:
+        raise RuntimeError("fleet PS mode not initialized: call "
+                           "fleet.init(is_collective=False) (or pass a "
+                           "PaddleCloudRoleMaker) first")
+    return ps
+
+
+def is_server() -> bool:
+    return _ps().is_server()
+
+
+def is_worker() -> bool:
+    return _ps().is_worker()
+
+
+def init_server(*args, **kwargs):
+    return None  # tables are created lazily by create_sparse_table
+
+
+def run_server():
+    return _ps().run_server()
+
+
+def init_worker():
+    return None  # the rpc gang is already joined by fleet.init
+
+
+def stop_worker():
+    ps = _ps()
+    if ps.is_worker() and _fleet.ps_role.worker_index() == 0:
+        ps.stop_server()
+    ps.shutdown()

@@ -82,22 +82,68 @@ def _free_port():
     return port
 
 
-def test_ps_gang(tmp_path):
-    script = tmp_path / "ps_node.py"
-    script.write_text(WORKER)
+def _run_gang(tmp_path, script_body, nproc=3):
+    """Launch `nproc` processes of `script_body` through the repo's own
+    launcher; returns (returncode, joined workerlogs, result)."""
+    script = tmp_path / "gang_node.py"
+    script.write_text(script_body)
     log_dir = tmp_path / "logs"
     env = dict(os.environ)
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node", "3",
+         "--nproc_per_node", str(nproc),
          "--master", f"127.0.0.1:{_free_port()}",
          "--log_dir", str(log_dir), str(script)],
         cwd="/root/repo", env=env, capture_output=True, text=True,
         timeout=240)
     logs = "\n".join((log_dir / f"workerlog.{i}").read_text()
-                     for i in range(3) if (log_dir / f"workerlog.{i}").exists())
+                     for i in range(nproc)
+                     if (log_dir / f"workerlog.{i}").exists())
+    return r, logs
+
+
+def test_ps_gang(tmp_path):
+    r, logs = _run_gang(tmp_path, WORKER)
     assert r.returncode == 0, (r.stdout, r.stderr, logs)
     assert logs.count("WORKER_DONE") == 2, logs
     assert logs.count("SERVER_DONE") == 1, logs
     assert logs.count("PS_SHUTDOWN_OK") == 3, logs
+
+
+FLEET_WORKER = """
+import os
+import numpy as np
+import paddle_tpu.distributed.fleet as fleet
+import paddle_tpu.distributed.ps as ps
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+if rank < 2:
+    role = ps.PaddleCloudRoleMaker(role=ps.Role.WORKER, worker_num=2,
+                                   server_num=1, worker_index=rank)
+else:
+    role = ps.PaddleCloudRoleMaker(role=ps.Role.SERVER, worker_num=2,
+                                   server_num=1, server_index=0)
+fleet.init(role_maker=role, is_collective=False)
+if fleet.is_server():
+    fleet.init_server()
+    fleet.run_server()
+    ps.shutdown()
+    print("FLEET_SERVER_DONE")
+else:
+    fleet.init_worker()
+    ps.create_sparse_table("emb", dim=2, initializer="zeros",
+                           learning_rate=1.0)
+    rows = ps.pull_sparse("emb", np.array([rank]))
+    assert np.all(rows == 0)
+    fleet.barrier_worker()
+    fleet.stop_worker()
+    print("FLEET_WORKER_DONE")
+"""
+
+
+def test_fleet_ps_mode(tmp_path):
+    r, logs = _run_gang(tmp_path, FLEET_WORKER)
+    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+    assert logs.count("FLEET_WORKER_DONE") == 2, logs
+    assert logs.count("FLEET_SERVER_DONE") == 1, logs
