@@ -49,11 +49,15 @@ def main():
         # accum=1 0.51, 16 0.577, 32 0.598 — accum=32's effective batch
         # (256×1024 = 262k tokens/update) is still well inside real
         # LLM-training configs (GPT-3 ran 3.2M).
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
-                          intermediate_size=4096, num_hidden_layers=12,
-                          num_attention_heads=12, num_key_value_heads=4,
+        # post-accum re-sweep (accum changes the optimum: the optimizer
+        # RMW no longer penalizes parameter count, so wider layers win):
+        # h1536/L12/b8 0.592, h2048/L8/b8 0.611, h2048/L10/b6 0.620,
+        # h2048/L12/b5 0.522 (HBM pressure), h2560/L8/b4 0.562.
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=10,
+                          num_attention_heads=16, num_key_value_heads=4,
                           max_position_embeddings=2048)
-        batch, seq, steps, warmup = 8, 1024, 2, 2
+        batch, seq, steps, warmup = 6, 1024, 2, 2
         accum = 32
         compute_dtype = jnp.bfloat16
         param_dtype = jnp.bfloat16
