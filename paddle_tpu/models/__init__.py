@@ -4,6 +4,9 @@ benchmark configs, BASELINE.md)."""
 from .llama import (LLAMA_SHARDING_PLAN, LlamaConfig, LlamaForCausalLM,
                     LlamaModel, apply_llama_sharding, build_train_step,
                     make_batch_shardings)
+from .llama_hybrid import (build_hybrid_train_step, hybrid_mesh,
+                           init_hybrid_state, shard_hybrid_state,
+                           stack_llama_state, unstack_llama_state)
 from .gpt_moe import (GPTMoEConfig, GPTMoEForCausalLM, apply_gpt_moe_sharding,
                       build_moe_train_step)
 from .generation import generate

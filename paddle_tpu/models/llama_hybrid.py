@@ -1,0 +1,343 @@
+"""The composed hybrid-parallel Llama train step: pp x dp x sharding x sep
+x mp in ONE jitted program.
+
+Capability analog of the reference's full Fleet hybrid runtime — one model
+trained simultaneously under pipeline parallelism
+(fleet/meta_parallel/pipeline_parallel.py:547), data parallelism + sharded
+optimizer states, segment/sequence parallelism (segment_parallel.py,
+topology.py:503 get_sep_*) and Megatron tensor parallelism (mp_layers.py)
+over the 5-axis HybridCommunicateGroup (topology.py:189).
+
+TPU-first composition (no actor runtime, no per-rank branching code):
+
+- ``pp`` and ``sep`` are MANUAL mesh axes inside one
+  ``jax.shard_map(..., axis_names={"pp","sep"})`` region: pipeline-stage
+  advance is one ``lax.ppermute`` per tick (GPipe dataflow; XLA reverses
+  the statically-bounded loop for backward), and sequence parallelism is
+  the Ulysses alltoall pair (seq<->heads) or an exact ring schedule around
+  flash attention.
+- ``dp``/``sharding``/``mp`` stay AUTO (GSPMD): per-layer weights are
+  stacked layer-major ([L, ...] leaves, dim 0 sharded over pp) with their
+  remaining dims carrying the same FSDP('sharding') x TP('mp') placements
+  as the single-program plan (LLAMA_SHARDING_PLAN); XLA inserts the
+  Megatron collectives inside each pipeline tick.
+- Embedding, final norm, LM head and the streaming fp32 cross-entropy run
+  OUTSIDE the manual region in plain GSPMD land; their gradients flow
+  through the shard_map boundary (ppermute/alltoall transpose rules), so
+  tied/untied embeddings train correctly — no special-cased first/last
+  pipeline stage.
+
+The decoder-layer math here is the functional twin of
+``models/llama.py`` (LlamaAttention/LlamaMLP/LlamaRMSNorm, which follow
+incubate/nn/fused.py) — kept expression-for-expression identical so the
+pp=1 GSPMD step and this pipelined step agree to float tolerance
+(tests/test_llama_hybrid.py parity).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .llama import (LlamaConfig, LLAMA_SHARDING_PLAN, plan_spec_for,
+                    _filter_spec_to_mesh, _rope_tables)
+from ..parallel.pipelining import pipeline_apply
+from ..parallel.sep import ulysses_attention
+from ..parallel.ring_attention import ring_flash_attention
+
+HYBRID_AXES = ("pp", "dp", "sharding", "sep", "mp")
+
+_LAYER_PREFIX = "model.layers."
+
+
+def hybrid_mesh(devices, pp=1, dp=1, sharding=1, sep=1, mp=1) -> Mesh:
+    """Build the 5-axis hybrid mesh (reference: topology.py:189 order
+    pp->dp->sharding->sep->mp, outermost..innermost so mp rides the
+    fastest-varying / closest ICI neighbours)."""
+    n = pp * dp * sharding * sep * mp
+    grid = np.asarray(devices[:n], dtype=object).reshape(pp, dp, sharding,
+                                                         sep, mp)
+    return Mesh(grid, axis_names=HYBRID_AXES)
+
+
+# --------------------------------------------------------------------------
+# state layout: layer-major stacking
+# --------------------------------------------------------------------------
+
+def stack_llama_state(state: Dict[str, Any], num_layers: int
+                      ) -> Dict[str, Any]:
+    """Collapse per-layer params ``model.layers.{i}.X`` into layer-major
+    stacks ``model.layers.X`` with leading dim [L].  Sharding dim 0 over
+    ``pp`` then gives pipeline stage s the contiguous layer block
+    [s*L/P, (s+1)*L/P) — the reference's segment_parallel layer split
+    (fleet/meta_parallel/parallel_layers/pp_layers.py segment methods)."""
+    out: Dict[str, Any] = {}
+    per_layer: Dict[str, list] = {}
+    for k, v in state.items():
+        if k.startswith(_LAYER_PREFIX):
+            rest = k[len(_LAYER_PREFIX):]
+            idx, suffix = rest.split(".", 1)
+            per_layer.setdefault(suffix, [None] * num_layers)[int(idx)] = v
+        else:
+            out[k] = v
+    for suffix, vals in per_layer.items():
+        assert all(v is not None for v in vals), f"missing layers for {suffix}"
+        out[_LAYER_PREFIX + suffix] = jnp.stack(
+            [jnp.asarray(v) for v in vals], axis=0)
+    return out
+
+
+def unstack_llama_state(hstate: Dict[str, Any], num_layers: int
+                        ) -> Dict[str, Any]:
+    """Inverse of stack_llama_state (checkpoint interop / parity tests)."""
+    out: Dict[str, Any] = {}
+    for k, v in hstate.items():
+        if k.startswith(_LAYER_PREFIX) and "." in k[len(_LAYER_PREFIX):] \
+                and not k[len(_LAYER_PREFIX):].split(".", 1)[0].isdigit():
+            suffix = k[len(_LAYER_PREFIX):]
+            for i in range(num_layers):
+                out[f"{_LAYER_PREFIX}{i}.{suffix}"] = v[i]
+        else:
+            out[k] = v
+    return out
+
+
+def shard_hybrid_state(hstate: Dict[str, Any], mesh: Mesh,
+                       plan: Optional[Dict[str, P]] = None) -> Dict[str, Any]:
+    """Place the stacked state on the hybrid mesh: stacked leaves get
+    P('pp', *plan-dims); non-layer leaves get their plan spec directly
+    (replicated over pp/sep).  Non-divisible dims fall back to
+    replication, mirroring apply_llama_sharding."""
+    out = {}
+    for name, v in hstate.items():
+        stacked = name.startswith(_LAYER_PREFIX)
+        spec = _filter_spec_to_mesh(plan_spec_for(name, plan), mesh)
+        entries = list(tuple(spec))
+        shape = v.shape[1:] if stacked else v.shape
+        for i, e in enumerate(entries):
+            if e is None:
+                continue
+            axes = e if isinstance(e, tuple) else (e,)
+            size = int(np.prod([mesh.shape[a] for a in axes]))
+            if i >= len(shape) or shape[i] % size != 0:
+                entries[i] = None
+        if stacked:
+            full = P("pp", *entries) if mesh.shape["pp"] > 1 else P(None, *entries)
+            if v.shape[0] % mesh.shape["pp"]:
+                raise ValueError(
+                    f"{name}: {v.shape[0]} layers not divisible by pp degree "
+                    f"{mesh.shape['pp']}")
+        else:
+            full = P(*entries)
+        out[name] = jax.device_put(v, NamedSharding(mesh, full))
+    return out
+
+
+def init_hybrid_state(model, mesh: Mesh) -> Dict[str, Any]:
+    """model (LlamaForCausalLM) -> stacked+sharded hybrid param dict."""
+    return shard_hybrid_state(
+        stack_llama_state(model.functional_state(),
+                          model.cfg.num_hidden_layers),
+        mesh)
+
+
+# --------------------------------------------------------------------------
+# functional decoder layer (expression-identical to models/llama.py)
+# --------------------------------------------------------------------------
+
+def _rms_norm(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _decoder_layer(lp: Dict[str, Any], x, cos, sin, cfg: LlamaConfig,
+                   sep_axis: Optional[str], sep_attn: str):
+    """One decoder layer on raw arrays inside the manual region.
+
+    x: [mb, s_local, h]; cos/sin: [s_local, head_dim] (this sep-rank's
+    position slice); lp: this layer's params keyed by intra-layer suffix.
+    """
+    nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    b, sl, _ = x.shape
+    h = _rms_norm(x, lp["input_layernorm.weight"], cfg.rms_norm_eps)
+    q = (h @ lp["self_attn.q_proj.weight"]).reshape(b, sl, nh, hd)
+    k = (h @ lp["self_attn.k_proj.weight"]).reshape(b, sl, nkv, hd)
+    v = (h @ lp["self_attn.v_proj.weight"]).reshape(b, sl, nkv, hd)
+    cos_b = cos[None, :, None, :]
+    sin_b = sin[None, :, None, :]
+    q = q * cos_b + _rotate_half(q) * sin_b
+    k = k * cos_b + _rotate_half(k) * sin_b
+    if sep_axis is None:
+        from ..ops.pallas.flash_attention import flash_attention_raw
+
+        attn = flash_attention_raw(q, k, v, causal=True)
+    elif sep_attn == "ring":
+        attn = ring_flash_attention(q, k, v, axis=sep_axis, causal=True)
+    else:
+        attn = ulysses_attention(q, k, v, axis=sep_axis, causal=True)
+    attn = attn.astype(x.dtype).reshape(b, sl, nh * hd)
+    x = x + attn @ lp["self_attn.o_proj.weight"]
+    h2 = _rms_norm(x, lp["post_attention_layernorm.weight"],
+                   cfg.rms_norm_eps)
+    gate = h2 @ lp["mlp.gate_proj.weight"]
+    up = h2 @ lp["mlp.up_proj.weight"]
+    return x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+
+
+# --------------------------------------------------------------------------
+# the composed train step
+# --------------------------------------------------------------------------
+
+def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
+                            num_microbatches: int = 1,
+                            compute_dtype=jnp.bfloat16,
+                            remat: bool = False,
+                            sep_attn: str = "ulysses",
+                            data_axes: Tuple[str, ...] = ("dp", "sharding")):
+    """Build the fully-composed hybrid train step:
+
+        step(params, opt_state, step_no, lr, input_ids, labels)
+            -> (loss, new_params, new_opt_state)
+
+    ``params`` is the stacked+sharded dict from ``init_hybrid_state``.
+    input_ids/labels: [B, S] with B divisible by num_microbatches (and by
+    the data-axes degrees), S by the sep degree.  The mesh must carry all
+    of HYBRID_AXES (degree 1 axes are fine — ppermute/alltoall over a
+    size-1 axis are no-ops, so the same program serves every composition).
+
+    GPipe semantics: per-tick stage advance via ppermute; bubbles are
+    (P-1) ticks per direction.  The 1F1B/VPP/ZBH1 static tables
+    (parallel/schedules.py + pipeline_train_step) remain the
+    schedule-explicit runtime for uniform-stage workloads; the composed
+    flagship rides the differentiable dataflow form, where XLA overlaps
+    each tick's ppermute with the next tick's compute.
+    """
+    pp_axis, sep_axis = "pp", "sep"
+    for ax in HYBRID_AXES:
+        if ax not in mesh.axis_names:
+            raise ValueError(f"hybrid mesh must carry axis {ax!r}")
+    L = cfg.num_hidden_layers
+    pp = mesh.shape[pp_axis]
+    sep = mesh.shape[sep_axis]
+    if L % pp:
+        raise ValueError(f"{L} layers not divisible by pp={pp}")
+    m = num_microbatches
+
+    batch_axes = tuple(a for a in data_axes
+                       if a in mesh.axis_names and mesh.shape[a] > 1)
+    batch_entry = (batch_axes if len(batch_axes) > 1
+                   else (batch_axes[0] if batch_axes else None))
+    sep_entry = sep_axis if sep > 1 else None
+
+    names_cache: list = []
+
+    def _split(params):
+        stacked = {k[len(_LAYER_PREFIX):]: v for k, v in params.items()
+                   if k.startswith(_LAYER_PREFIX)}
+        outer = {k: v for k, v in params.items()
+                 if not k.startswith(_LAYER_PREFIX)}
+        return outer, stacked
+
+    cos_full, sin_full = _rope_tables(cfg.head_dim,
+                                      cfg.max_position_embeddings,
+                                      cfg.rope_theta)
+
+    def pipeline_body(stacked, x, cos, sin):
+        """Manual region over {pp, sep}.  stacked leaves: [L/pp, ...]
+        (auto-sharded over sharding/mp on trailing dims); x: [m, mb,
+        s_local, hidden]; cos/sin: [s_local, head_dim]."""
+
+        def layer_step(h, lp):
+            return _decoder_layer(lp, h, cos, sin, cfg,
+                                  sep_axis if sep > 1 else None,
+                                  sep_attn), None
+
+        if remat:
+            layer_step = jax.checkpoint(layer_step)
+
+        def stage_fn(stage_params, act):
+            act, _ = lax.scan(layer_step, act, stage_params)
+            return act
+
+        outs = pipeline_apply(stage_fn, stacked, x, axis=pp_axis,
+                              squeeze_stage_dim=False)
+        # only the last stage holds real outputs; broadcast across pp so
+        # the replicated-out-spec read is valid on every rank
+        is_last = (lax.axis_index(pp_axis)
+                   == lax.axis_size(pp_axis) - 1).astype(outs.dtype)
+        return lax.psum(outs * is_last, pp_axis)
+
+    shmap = jax.shard_map(
+        pipeline_body, mesh=mesh, axis_names={pp_axis, sep_axis},
+        in_specs=(P("pp"), P(None, None, sep_entry, None),
+                  P(sep_entry, None), P(sep_entry, None)),
+        out_specs=P(None, None, sep_entry, None), check_vma=False)
+
+    def loss_fn(params, input_ids, labels):
+        cast = {k: (v.astype(compute_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in params.items()}
+        outer, stacked = _split(cast)
+        B, S = input_ids.shape
+        mb = B // m
+        ids = input_ids.reshape(m, mb, S)
+        x = jnp.take(outer["model.embed_tokens.weight"], ids, axis=0)
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, batch_entry, sep_entry, None)))
+        cos = cos_full[:S].astype(compute_dtype)
+        sin = sin_full[:S].astype(compute_dtype)
+        h = shmap(stacked, x, cos, sin)
+        h = _rms_norm(h, outer["model.norm.weight"], cfg.rms_norm_eps)
+        if cfg.tie_word_embeddings:
+            logits = h @ outer["model.embed_tokens.weight"].T
+        else:
+            logits = h @ outer["lm_head.weight"]
+        logits = lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, P(None, batch_entry)))
+        lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32),
+                                          axis=-1)
+        ylb = labels.reshape(m, mb, S)
+        gold = jnp.take_along_axis(logits, ylb[..., None],
+                                   axis=-1)[..., 0].astype(jnp.float32)
+        return (lse - gold).mean()
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def step_fn(params, opt_state, step_no, lr, input_ids, labels):
+        if batch_entry is not None or sep_entry is not None:
+            bs = NamedSharding(mesh, P(batch_entry, sep_entry))
+            input_ids = lax.with_sharding_constraint(input_ids, bs)
+            labels = lax.with_sharding_constraint(labels, bs)
+        loss, grads = grad_fn(params, input_ids, labels)
+        if not names_cache:
+            names_cache.extend(params.keys())
+        no_decay = {n for n in names_cache
+                    if "layernorm" in n or n.endswith("norm.weight")
+                    or n.endswith(".bias")}
+        new_params, new_opt_state = optimizer.apply(
+            params, grads, opt_state, lr, step_no + 1,
+            decay_mask={n: n not in no_decay for n in names_cache})
+        return loss, new_params, new_opt_state
+
+    jstep = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def step(params, opt_state, step_no, lr, input_ids, labels):
+        with jax.sharding.set_mesh(mesh):
+            return jstep(params, opt_state, step_no, lr, input_ids, labels)
+
+    return step

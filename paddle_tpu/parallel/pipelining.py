@@ -26,12 +26,16 @@ from jax import lax
 
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
-                   axis: str = "pp", num_microbatches: int | None = None):
+                   axis: str = "pp", num_microbatches: int | None = None,
+                   squeeze_stage_dim: bool = True):
     """Run a P-stage pipeline inside a shard_map body.
 
     stage_fn(params_slice, activation) -> activation  — one stage's compute
     stage_params: pytree whose leaves have leading dim 1 (this device's
-        stage slice of the stacked [P, ...] parameters)
+        stage slice of the stacked [P, ...] parameters); pass
+        ``squeeze_stage_dim=False`` when the leading dim is itself
+        meaningful to stage_fn (e.g. layer-major [L/P, ...] stacks that
+        the stage scans over)
     x: [M, mb, ...] this call's micro-batched input — every device receives
         the same x (replicated); only stage 0 consumes it.
     Returns [M, mb, ...] outputs (valid on the LAST stage; other devices
@@ -49,7 +53,8 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
         except AttributeError:
             return v
 
-    params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    params = jax.tree_util.tree_map(lambda a: a[0], stage_params) \
+        if squeeze_stage_dim else stage_params
     state = _varying(jnp.zeros_like(x[0]))            # current activation
     outs = _varying(jnp.zeros((m,) + tuple(x.shape[1:]), x.dtype))
 
