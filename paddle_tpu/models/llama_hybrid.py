@@ -36,8 +36,6 @@ pp=1 GSPMD step and this pipelined step agree to float tolerance
 
 from __future__ import annotations
 
-import functools
-import math
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -152,16 +150,18 @@ def init_hybrid_state(model, mesh: Mesh) -> Dict[str, Any]:
 # functional decoder layer (expression-identical to models/llama.py)
 # --------------------------------------------------------------------------
 
+# raw-array twins of the fused ops (same functions models/llama.py runs
+# through dispatch) — shared so the math cannot drift from the pp=1 path
+from ..incubate.nn.fused import _fused_rms_norm_op, _rope_rotate_half
+
+_rms_norm_raw = _fused_rms_norm_op.raw_fn
+
+
 def _rms_norm(x, w, eps):
-    xf = x.astype(jnp.float32)
-    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-    out = xf * lax.rsqrt(var + eps)
-    return (out * w.astype(jnp.float32)).astype(x.dtype)
+    return _rms_norm_raw(x, w, epsilon=eps)
 
 
-def _rotate_half(x):
-    x1, x2 = jnp.split(x, 2, axis=-1)
-    return jnp.concatenate([-x2, x1], axis=-1)
+_rotate_half = _rope_rotate_half
 
 
 def _decoder_layer(lp: Dict[str, Any], x, cos, sin, cfg: LlamaConfig,
@@ -231,6 +231,12 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
     for ax in HYBRID_AXES:
         if ax not in mesh.axis_names:
             raise ValueError(f"hybrid mesh must carry axis {ax!r}")
+    if compute_dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
+        # XLA:CPU's AllReducePromotion pass aborts ("Invalid binary
+        # instruction opcode copy") on the bf16 collectives this program
+        # emits (psum/ppermute transposes inside the manual region); TPU
+        # handles bf16 collectives natively.  Promote on CPU only.
+        compute_dtype = jnp.float32
     L = cfg.num_hidden_layers
     pp = mesh.shape[pp_axis]
     sep = mesh.shape[sep_axis]
@@ -243,8 +249,6 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
     batch_entry = (batch_axes if len(batch_axes) > 1
                    else (batch_axes[0] if batch_axes else None))
     sep_entry = sep_axis if sep > 1 else None
-
-    names_cache: list = []
 
     def _split(params):
         stacked = {k[len(_LAYER_PREFIX):]: v for k, v in params.items()
@@ -324,14 +328,13 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             input_ids = lax.with_sharding_constraint(input_ids, bs)
             labels = lax.with_sharding_constraint(labels, bs)
         loss, grads = grad_fn(params, input_ids, labels)
-        if not names_cache:
-            names_cache.extend(params.keys())
-        no_decay = {n for n in names_cache
+        names = list(params.keys())  # trace-time only: retrace-safe
+        no_decay = {n for n in names
                     if "layernorm" in n or n.endswith("norm.weight")
                     or n.endswith(".bias")}
         new_params, new_opt_state = optimizer.apply(
             params, grads, opt_state, lr, step_no + 1,
-            decay_mask={n: n not in no_decay for n in names_cache})
+            decay_mask={n: n not in no_decay for n in names})
         return loss, new_params, new_opt_state
 
     jstep = jax.jit(step_fn, donate_argnums=(0, 1))
