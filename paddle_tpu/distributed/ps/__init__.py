@@ -251,15 +251,25 @@ def stop_server():
         rpc.rpc_sync(f"pserver{j}", _srv_stop, ())
 
 
+def _require_servers(r: PaddleCloudRoleMaker) -> int:
+    n = r.server_num()
+    if n < 1:
+        raise RuntimeError(
+            "PS mode requires PADDLE_PSERVER_NUM >= 1 (no parameter "
+            "servers in this gang — check PADDLE_PSERVERS_IP_PORT_LIST)")
+    return n
+
+
 def _shard(r: PaddleCloudRoleMaker, ids: np.ndarray):
     """id -> owning server by modulo hash (reference default)."""
-    owners = ids % r.server_num()
+    owners = ids % _require_servers(r)
     return owners
 
 
 def create_sparse_table(name: str, dim: int, **kwargs):
     """Create (idempotently) the table on every server shard."""
     r = _role()
+    _require_servers(r)
     for j in range(r.server_num()):
         rpc.rpc_sync(f"pserver{j}", _srv_create, (name,),
                      dict(dim=dim, **kwargs))

@@ -25,11 +25,15 @@ def _load_hubconf(repo_dir: str):
         raise FileNotFoundError(f"no {MODULE_HUBCONF} found in {repo_dir!r}")
     spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
     mod = importlib.util.module_from_spec(spec)
+    n_before = sys.path.count(repo_dir)
     sys.path.insert(0, repo_dir)
     try:
         spec.loader.exec_module(mod)
     finally:
-        sys.path.remove(repo_dir)
+        # restore the user's original count of this entry — remove only
+        # our insertion, never a pre-existing identical path
+        while sys.path.count(repo_dir) > n_before:
+            sys.path.remove(repo_dir)
     return mod
 
 

@@ -484,26 +484,42 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
                 lambda a, b: a + b.astype(jnp.float32), acc, g)
             return acc, loss
 
-        def micro_step_masked(acc, xs):
+        def micro_step_masked(carry, xs):
+            # token-weighted accumulation: micro-batches with unequal
+            # valid-token counts must contribute in proportion to their
+            # tokens, or the merged gradient deviates from the true
+            # global token-mean (per-micro grad_fn returns the gradient
+            # of a per-micro token MEAN, so scale by that micro's count)
+            acc, wsum = carry
             mids, mlabels, mmask = xs
             loss, g = grad_fn(params, mids, mlabels, mmask)
+            # true token count, no clamp: an all-padding micro contributes
+            # zero weight (its loss/grads are already zero via loss_fn's
+            # own divide guard); clamping HERE would add a phantom token
+            # and shrink every real micro's contribution by n/(n+1)
+            w = (mmask > 0).sum().astype(jnp.float32)
             acc = jax.tree_util.tree_map(
-                lambda a, b: a + b.astype(jnp.float32), acc, g)
-            return acc, loss
+                lambda a, b: a + w * b.astype(jnp.float32), acc, g)
+            return (acc, wsum + w), loss * w
 
         zero = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
         if attention_mask is None:
             acc, losses = jax.lax.scan(micro_step, zero,
                                        (input_ids, labels))
+            grads = jax.tree_util.tree_map(lambda a: a / accum_steps, acc)
+            mean_loss = losses.mean()
         else:
-            acc, losses = jax.lax.scan(micro_step_masked, zero,
-                                       (input_ids, labels, attention_mask))
-        grads = jax.tree_util.tree_map(lambda a: a / accum_steps, acc)
+            (acc, wsum), wlosses = jax.lax.scan(
+                micro_step_masked, (zero, jnp.zeros((), jnp.float32)),
+                (input_ids, labels, attention_mask))
+            wsum = jnp.maximum(wsum, 1.0)  # guard only the TOTAL
+            grads = jax.tree_util.tree_map(lambda a: a / wsum, acc)
+            mean_loss = wlosses.sum() / wsum
         new_params, new_opt_state = optimizer.apply(
             params, grads, opt_state, lr, step_no + 1,
             decay_mask={n: n not in no_decay for n in names})
-        return losses.mean(), new_params, new_opt_state
+        return mean_loss, new_params, new_opt_state
 
     fn = step_fn if accum_steps <= 1 else accum_step_fn
     return jax.jit(fn, donate_argnums=(0, 1))

@@ -459,16 +459,21 @@ _BLOCK_CANDIDATES = ((256, 256), (256, 512), (512, 256), (512, 512),
                      (512, 1024), (1024, 512))
 
 
-def _select_blocks(q, k, v, causal, scale, h, kvh, interpret):
+def _select_blocks(q, k, v, causal, scale, h, kvh, interpret,
+                   q_seg=None, k_seg=None):
     """Block sizes for this shape: FLAGS_use_autotune measures the
-    candidate tilings once per (seq, d, heads, causal) signature and
-    caches the winner (the reference's switch_autotune path); otherwise
-    the measured v5e default 512x512."""
+    candidate tilings once per (seq, d, heads, causal, segmented)
+    signature and caches the winner (the reference's switch_autotune
+    path); otherwise the measured v5e default 512x512.  The segmented
+    kernel variant is tuned (and cached) separately — its mask loads
+    shift the profitable tiling."""
     from .. import autotune as _at
 
     sq, d = q.shape[1], q.shape[2]
     sk = k.shape[1]
-    key = ("flash_fwd", sq, sk, d, h, kvh, causal, str(q.dtype))
+    has_segments = q_seg is not None
+    key = ("flash_fwd", sq, sk, d, h, kvh, causal, str(q.dtype),
+           has_segments)
     cached = _at.AutoTuneCache.instance().lookup(key)
     if cached is not None:
         return cached
@@ -482,7 +487,8 @@ def _select_blocks(q, k, v, causal, scale, h, kvh, interpret):
         bq, bk = cfg
         return _at.time_fn(lambda: jax.block_until_ready(
             _flash_forward(q, k, v, causal, scale, h=h, kvh=kvh,
-                           block_q=bq, block_k=bk, interpret=interpret)))
+                           block_q=bq, block_k=bk, interpret=interpret,
+                           q_seg=q_seg, k_seg=k_seg)))
 
     return _at.AutoTuneCache.instance().tune(key, cands, measure)
 
@@ -499,7 +505,7 @@ def _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret):
             "self-attention); decode uses the cached path")
     qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
     block_q, block_k = _select_blocks(qb, kb, vb, causal, scale, h, kvh,
-                                      interpret)
+                                      interpret, q_seg=q_seg, k_seg=k_seg)
     of, lse = _flash_forward(qb, kb, vb, causal, scale,
                              h=h, kvh=kvh, block_q=block_q, block_k=block_k,
                              interpret=interpret, q_seg=q_seg, k_seg=k_seg)

@@ -148,27 +148,46 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         in_q: queue.Queue = queue.Queue(buffer_size)
         out_q: queue.Queue = queue.Queue(buffer_size)
         errors: list = []
+        # set on any worker failure: unblocks the feed thread (which could
+        # otherwise sit forever in put() on a full in_q with all its
+        # consumers dead) and tells surviving workers to wind down
+        failed = threading.Event()
+
+        def _put(q_, item) -> bool:
+            while not failed.is_set():
+                try:
+                    q_.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def feed():
             try:
                 for i, d in enumerate(reader()):
-                    in_q.put((i, d))
+                    if not _put(in_q, (i, d)):
+                        break
             except BaseException as e:  # noqa: BLE001 — must not deadlock
                 errors.append(e)
             finally:
                 for _ in range(process_num):
-                    in_q.put(_SENTINEL)
+                    if not _put(in_q, _SENTINEL):
+                        break
 
         def work():
             try:
-                while True:
-                    item = in_q.get()
+                while not failed.is_set():
+                    try:
+                        item = in_q.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
                     if item is _SENTINEL:
                         return
                     i, d = item
                     out_q.put((i, mapper(d)))
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
+                failed.set()
             finally:
                 # always post the sentinel so the consumer can't hang on a
                 # dead worker; its recorded error re-raises below

@@ -198,6 +198,44 @@ def test_grad_accum_matches_full_batch():
                                    err_msg=k)
 
 
+def test_masked_grad_accum_token_weighted():
+    """Masked accumulation with UNEQUAL per-micro token counts must match
+    the full-batch masked step: micro contributions are token-weighted
+    (weighted-grad-sum / total tokens), not equal-weighted."""
+    cfg = LlamaConfig.debug(layers=1, hidden=32, heads=2, kv_heads=1, inter=64)
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.randint(0, cfg.vocab_size, (4, 8), dtype=np.int32)
+    lab = np.random.randint(0, cfg.vocab_size, (4, 8), dtype=np.int32)
+    # rows have 8/3/5/2 valid tokens -> micro 0 carries 11, micro 1 carries 7
+    mask = (np.arange(8)[None, :] < np.array([8, 3, 5, 2])[:, None]) \
+        .astype(np.int32)
+
+    opt = paddle.optimizer.AdamW(parameters=model.parameters())
+    params = model.functional_state()
+    opt_state = opt.init_state(params)
+
+    import jax
+
+    def deep(t):
+        return jax.tree_util.tree_map(jnp.copy, t)
+
+    full = build_train_step(model, opt, compute_dtype=jnp.float32)
+    l_full, p_full, _ = full(deep(params), deep(opt_state), 0, 1e-3, ids,
+                             lab, mask)
+
+    acc = build_train_step(model, opt, compute_dtype=jnp.float32,
+                           accum_steps=2)
+    l_acc, p_acc, _ = acc(deep(params), deep(opt_state), 0, 1e-3,
+                          ids.reshape(2, 2, 8), lab.reshape(2, 2, 8),
+                          mask.reshape(2, 2, 8))
+
+    np.testing.assert_allclose(float(l_acc), float(l_full), rtol=1e-5)
+    for k in p_full:
+        np.testing.assert_allclose(np.asarray(p_acc[k]),
+                                   np.asarray(p_full[k]), atol=1e-5,
+                                   err_msg=k)
+
+
 def test_attention_mask_isolates_padding():
     """A bool [b, s] keep-mask must make valid-position logits invariant
     to pad-token content (rides the segment-masked flash path on TPU)."""

@@ -204,10 +204,15 @@ def test_flash_block_autotune_uses_cache():
 
     q = jnp.zeros((4, 1024, 64))
     k = jnp.zeros((4, 1024, 64))
-    key = ("flash_fwd", 1024, 1024, 64, 4, 4, True, str(q.dtype))
+    key = ("flash_fwd", 1024, 1024, 64, 4, 4, True, str(q.dtype), False)
     AutoTuneCache.instance().put(key, (256, 512))
     try:
         assert _select_blocks(q, k, k, True, 0.125, 4, 4, True) == (256, 512)
+        # the segmented variant tunes separately: same shapes but with
+        # segment ids must NOT hit the unsegmented entry
+        seg = jnp.zeros((4, 1024), jnp.int32)
+        assert _select_blocks(q, k, k, True, 0.125, 4, 4, True,
+                              q_seg=seg, k_seg=seg) == (512, 512)
     finally:
         AutoTuneCache.instance().clear()
     # cache miss + autotune off -> measured default
