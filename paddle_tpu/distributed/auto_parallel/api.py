@@ -64,6 +64,8 @@ from ...ops.registry import register as _register_op
 
 @_register_op("sharding_constraint")
 def _sharding_constraint_op(x, sharding=None):
+    if sharding is None:
+        return x  # no-constraint: identity (no mesh context required)
     return jax.lax.with_sharding_constraint(x, sharding)
 
 

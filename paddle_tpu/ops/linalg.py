@@ -150,7 +150,7 @@ def svd(x, full_matrices=False):
     return jnp.linalg.svd(x, full_matrices=full_matrices)
 
 
-@register("eig", amp="black", nondiff=True)
+@register("eig", amp="black", nondiff=True, cacheable=False)
 def eig(x):
     import numpy as np
 
@@ -163,7 +163,7 @@ def eigh(x, UPLO="L"):
     return jnp.linalg.eigh(x, symmetrize_input=(UPLO == "L"))
 
 
-@register("eigvals", amp="black", nondiff=True)
+@register("eigvals", amp="black", nondiff=True, cacheable=False)
 def eigvals(x):
     import numpy as np
 

@@ -161,7 +161,9 @@ def flip(x, axis):
 
 @register("rot90")
 def rot90(x, k=1, axes=(0, 1)):
-    return jnp.rot90(x, k=k, axes=axes)
+    # jnp.rot90 is internally jitted with static axes: a user-passed LIST
+    # (paddle API style) must become hashable
+    return jnp.rot90(x, k=k, axes=tuple(axes))
 
 
 @register("roll")
@@ -264,14 +266,17 @@ def index_add(x, index, axis, value):
     return jnp.moveaxis(out, 0, axis)
 
 
-@register("masked_select", nondiff=True)
+@register("masked_select", nondiff=True, cacheable=False)
 def masked_select(x, mask):
     # data-dependent shape: host-only op (documented limitation; the
     # reference has the same dynamic-output problem in static graphs)
     import numpy as np
 
     xv = np.asarray(x)
-    mv = np.asarray(mask)
+    # mask is SEMANTICALLY boolean (paddle masked_select): an int 0/1 mask
+    # must select, not gather — fancy-indexing with ints would silently
+    # reinterpret it as row indices
+    mv = np.asarray(mask).astype(bool)
     return jnp.asarray(xv[mv])
 
 
@@ -294,7 +299,7 @@ def select_scatter(x, values, axis, index):
     return jnp.moveaxis(out, 0, axis)
 
 
-@register("nonzero", nondiff=True)
+@register("nonzero", nondiff=True, cacheable=False)
 def nonzero(x, as_tuple=False):
     import numpy as np
 
@@ -304,7 +309,7 @@ def nonzero(x, as_tuple=False):
     return jnp.asarray(np.stack(nz, axis=-1))
 
 
-@register("where_index", nondiff=True)
+@register("where_index", nondiff=True, cacheable=False)
 def where_index(condition):
     import numpy as np
 
@@ -367,7 +372,7 @@ def bucketize(x, sorted_sequence, right=False):
     return jnp.searchsorted(sorted_sequence, x, side=side).astype("int64")
 
 
-@register("unique", nondiff=True)
+@register("unique", nondiff=True, cacheable=False)
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
     import numpy as np
 
@@ -385,7 +390,7 @@ def one_hot(x, num_classes):
     return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
 
 
-@register("bincount", nondiff=True)
+@register("bincount", nondiff=True, cacheable=False)
 def bincount(x, weights=None, minlength=0):
     return jnp.bincount(x, weights=weights, minlength=minlength)
 

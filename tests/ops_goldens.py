@@ -309,3 +309,122 @@ def margin_ce_loss(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
     lse = np.log(np.exp(mod - mod.max(-1, keepdims=True)).sum(-1,
                  keepdims=True)) + mod.max(-1, keepdims=True)
     return (-(onehot * (mod - lse)).sum(-1, keepdims=True)).astype(np.float32)
+
+
+# ---------------------------------------------------------------- manip
+def index_add(x, index, axis, value):
+    out = np.copy(x)
+    np.add.at(out, tuple([index if i == axis else slice(None)
+                          for i in range(x.ndim)][:axis + 1]), value)
+    return out
+
+
+def index_fill(x, index, axis, value):
+    out = np.copy(x)
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    out[tuple(sl)] = value
+    return out
+
+
+def index_put(x, idx, value):
+    out = np.copy(x)
+    out[idx] = value
+    return out
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    out = np.copy(x)
+    np.put_along_axis(out, indices, values, axis)
+    return out
+
+
+def scatter_overwrite(x, index, updates, overwrite=True):
+    out = np.copy(x)
+    out[index] = updates
+    return out
+
+
+def scatter_nd_add(x, index, updates):
+    out = np.copy(x)
+    np.add.at(out, tuple(index.T), updates)
+    return out
+
+
+def select_scatter(x, values, axis, index):
+    out = np.copy(x)
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    out[tuple(sl)] = values
+    return out
+
+
+# ---------------------------------------------------------------- linalg
+def cholesky_solve(x, y, upper=False):
+    import scipy.linalg
+
+    return scipy.linalg.cho_solve((y, not upper), x)
+
+
+def svd_vals(x, full_matrices=False):
+    return np.linalg.svd(x, compute_uv=False)
+
+
+def eigvals_sorted(x):
+    return np.sort(np.linalg.eigvals(x).real)
+
+
+def eigh_vals(x, UPLO="L"):
+    return np.linalg.eigvalsh(x)
+
+
+# ---------------------------------------------------------------- nn
+def softmax_ce(logits, label, soft_label=False, ignore_index=-100, axis=-1):
+    import scipy.special
+
+    logp = logits - scipy.special.logsumexp(
+        np.asarray(logits, np.float64), axis=-1, keepdims=True)
+    return -np.take_along_axis(logp, label[:, None].astype(int), -1)
+
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    lab = np.squeeze(label, -1).astype(int)
+    oh = np.eye(input.shape[-1])[lab]
+    rd = tuple(range(1, input.ndim))
+    inter = 2.0 * (input * oh).sum(rd)
+    denom = input.sum(rd) + oh.sum(rd)
+    return np.mean(1.0 - (inter + epsilon) / (denom + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    import scipy.special
+
+    lab = labels.reshape(-1)
+    same = (lab[:, None] == lab[None, :]).astype(np.float64)
+    targets = same / np.maximum(same.sum(1, keepdims=True), 1.0)
+    sim = anchor.astype(np.float64) @ positive.T.astype(np.float64)
+    logp = sim - scipy.special.logsumexp(sim, -1, keepdims=True)
+    ce = -(targets * logp).sum(-1).mean()
+    l2 = ((anchor ** 2).sum(-1) + (positive ** 2).sum(-1)).mean() \
+        * (l2_reg * 0.25)
+    return ce + l2
+
+
+def sigmoid_focal(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                  reduction="sum"):
+    p = 1.0 / (1.0 + np.exp(-logit))
+    ce = (np.maximum(logit, 0.0) - logit * label
+          + np.log1p(np.exp(-np.abs(logit))))
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    out = ce * np.power(1.0 - p_t, gamma)
+    out = out * (alpha * label + (1.0 - alpha) * (1.0 - label))
+    return out.sum()
+
+
+def rope_neox(q, k=None, v=None, sin=None, cos=None, position_ids=None,
+              use_neox_rotary_style=True):
+    def rot(x):
+        x1, x2 = np.split(x, 2, -1)
+        return np.concatenate([-x2, x1], -1)
+
+    return q * cos + rot(q) * sin
