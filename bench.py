@@ -169,6 +169,31 @@ def main():
     mfu = achieved_flops / peak
     vs_baseline = mfu / 0.40  # >= 1.0 beats the A100-cluster MFU north star
 
+    # ---- supplementary diagnostics (stderr + BENCH_EXTRA.json; the
+    # headline JSON line below stays the single stdout contract) ----
+    extras = {}
+    try:
+        from paddle_tpu.ops import microbench
+
+        extras["eager_dispatch"] = microbench.run(
+            n=300 if on_tpu else 150)
+        print(f"# eager dispatch: {extras['eager_dispatch']}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not kill bench
+        print(f"# eager microbench failed: {e}", file=sys.stderr)
+    if on_tpu:
+        try:
+            extras["varlen_vs_dense"] = _varlen_vs_dense_bench()
+            print(f"# varlen flash: {extras['varlen_vs_dense']}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"# varlen bench failed: {e}", file=sys.stderr)
+    try:
+        with open("BENCH_EXTRA.json", "w") as f:
+            json.dump(extras, f, indent=1)
+    except OSError:
+        pass
+
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -178,6 +203,62 @@ def main():
     print(f"# backend={backend} params={n_params/1e6:.1f}M batch={batch} "
           f"seq={seq} accum={accum} steps={steps} dt={dt:.2f}s "
           f"loss={final_loss:.3f} mfu={mfu:.3f}", file=sys.stderr)
+
+
+def _varlen_vs_dense_bench():
+    """Packed-varlen (ragged kernel, per-segment block skip) vs the
+    dense-padded-with-masks path on identical workloads: 4 sequences
+    (~32% padding when padded to max).  VERDICT r2 missing#3's win
+    criterion: packed-varlen beats dense-masked at >=30% padding."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import (
+        flash_attention_raw, flash_attn_unpadded_raw,
+        varlen_block_skip_fraction)
+
+    seqlens = [1300, 2048, 700, 1500]   # max 2048 -> 32% padding dense
+    h, d = 16, 64
+    total = sum(seqlens)
+    rng = np.random.default_rng(0)
+    maxlen = max(seqlens)
+    b = len(seqlens)
+
+    qp = jnp.asarray(rng.standard_normal((total, h, d)), jnp.bfloat16)
+    cu = jnp.asarray(np.cumsum([0] + seqlens), jnp.int32)
+
+    qd = jnp.asarray(rng.standard_normal((b, maxlen, h, d)), jnp.bfloat16)
+    seg = np.zeros((b, maxlen), np.int32)
+    for i, n in enumerate(seqlens):
+        seg[i, :n] = i + 1
+    seg = jnp.asarray(seg)
+
+    packed = jax.jit(lambda q: flash_attn_unpadded_raw(
+        q, q, q, cu, cu, causal=True, interpret=False))
+    dense = jax.jit(lambda q: flash_attention_raw(
+        q, q, q, causal=True, interpret=False,
+        q_segment_ids=seg, kv_segment_ids=seg))
+
+    def _time(fn, x, steps=20):
+        fn(x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(x)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / steps
+
+    tp = _time(packed, qp)
+    td = _time(dense, qd)
+    return {
+        "packed_ms": round(tp * 1e3, 3),
+        "dense_masked_ms": round(td * 1e3, 3),
+        "speedup_x": round(td / tp, 3),
+        "padding_frac": round(1 - total / (b * maxlen), 3),
+        "est_block_skip_frac": round(
+            varlen_block_skip_fraction(seqlens, 512), 3),
+    }
 
 
 if __name__ == "__main__":

