@@ -32,10 +32,11 @@ from . import checkpoint
 from . import ps
 from .checkpoint import load_state_dict, save_state_dict
 from .spawn import spawn
-from .auto_parallel import (ShardingStage1, ShardingStage2, ShardingStage3,
-                            dtensor_from_local, dtensor_to_local,
-                            get_placements, is_dist, reshard, shard_dataloader,
-                            shard_layer, shard_optimizer, shard_tensor,
+from .auto_parallel import (DistModel, ShardingStage1, ShardingStage2,
+                            ShardingStage3, Strategy, dtensor_from_local,
+                            dtensor_to_local, get_placements, is_dist,
+                            reshard, shard_dataloader, shard_layer,
+                            shard_optimizer, shard_tensor, to_static,
                             unshard_dtensor)
 
 

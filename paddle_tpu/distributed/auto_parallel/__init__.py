@@ -22,6 +22,7 @@ from .api import (
 )
 from .spmd_rules import (infer_forward, register_spmd_rule,
                          shard_op)
+from .dist_model import DistModel, Strategy, to_static
 from ..process_mesh import ProcessMesh, get_mesh, set_mesh, init_mesh, auto_mesh
 from ..placements import Partial, Placement, Replicate, Shard
 

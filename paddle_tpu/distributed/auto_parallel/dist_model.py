@@ -1,0 +1,297 @@
+"""DistModel / dist.to_static — the static auto-parallel training surface.
+
+Analog of the reference's ``paddle.distributed.to_static``
+(python/paddle/distributed/auto_parallel/api.py:2510 -> DistModel :2030,
+engine python/paddle/distributed/auto_parallel/static/engine.py): wrap a
+(sharded) layer + loss + optimizer into compiled train/eval/predict steps
+driven by a ``Strategy``.
+
+TPU-first: "static" here is one jitted, donated XLA program per mode —
+GSPMD completes/partitions from the parameters' NamedShardings (the
+reference's completion + partitioner passes collapse into the compiler,
+SURVEY §2.10), so DistModel's job is the mode state machine, the
+Strategy knobs (amp / recompute / gradient merge) and the functional
+param/optimizer threading.  Reference training scripts port verbatim
+modulo imports:
+
+    layer = dist.shard_layer(MyNet(), mesh, shard_fn)
+    opt = paddle.optimizer.AdamW(parameters=layer.parameters())
+    loader = dist.shard_dataloader(raw_loader, meshes=[mesh])
+    model = dist.to_static(layer, loader, loss_fn, opt, strategy)
+    model.train()
+    for img, lbl in loader:
+        loss = model(img, lbl)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+class _Section(dict):
+    """Attribute-style config section (reference Strategy's .amp.enable)."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class Strategy:
+    """dist.Strategy (reference auto_parallel/strategy.py): knob sections
+    consumed by DistModel — amp, recompute (sequence/full), gradient
+    merge.  Pipeline/sharding degrees live on the mesh itself here (GSPMD
+    + the hybrid train step own those axes)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.amp = _Section(enable=False, dtype="bfloat16", level="O2",
+                            custom_white_list=[], custom_black_list=[])
+        self.recompute = _Section(enable=False, checkpoints=[])
+        self.gradient_merge = _Section(enable=False, k_steps=1, avg=True)
+        self.pipeline = _Section(enable=False, schedule_mode="1F1B",
+                                 accumulate_steps=1, micro_batch_size=1)
+        self.sharding = _Section(enable=False, stage=1, degree=1)
+        for sec, kv in (config or {}).items():
+            section = getattr(self, sec)
+            for k, v in kv.items():
+                section[k] = v
+
+
+class DistModel:
+    """Compiled train/eval/predict steps over a functionalized layer.
+
+    Reference DistModel semantics (auto_parallel/api.py:2030): mode
+    switching via .train()/.eval()/.predict(); __call__ runs ONE step of
+    the current mode and returns the loss (train/eval) or outputs
+    (predict).  Parameters and optimizer state live as functional pytrees
+    inside this wrapper between steps (donated through the jit), and are
+    written back to the layer by state_dict()/finalize()."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy: Optional[Strategy] = None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._metrics = metrics or []
+        self._mode = ("train" if loss is not None and optimizer is not None
+                      else "predict")
+        # trainable parameters vs buffers: only params are differentiated
+        # and optimized — an int buffer would crash value_and_grad and a
+        # float buffer (rope tables, running stats) must never receive
+        # AdamW updates
+        pnames = {n for n, _ in layer.named_parameters()}
+        state = layer.functional_state()
+        self._params = {k: v for k, v in state.items() if k in pnames}
+        self._buffers = {k: v for k, v in state.items() if k not in pnames}
+        self._opt_state = (optimizer.init_state(self._params)
+                           if optimizer is not None else None)
+        self._step_no = 0
+        self._steps: Dict[str, Callable] = {}
+        # gradient merge accumulator (reference GradientMergePass: k-step
+        # local accumulation, optimizer applied on the k-th)
+        gm = self._strategy.gradient_merge
+        self._gm_k = int(gm.k_steps) if gm.enable else 1
+        self._gm_acc = None
+        self._gm_count = 0
+
+    # ------------------------------------------------------------- modes
+    def train(self):
+        if self._loss is None or self._optimizer is None:
+            raise ValueError("to_static needs loss and optimizer for "
+                             "train mode (reference DistModel raises too)")
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise ValueError("eval mode needs a loss")
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        return self
+
+    # --------------------------------------------------------- internals
+    def _compute_dtype(self):
+        amp = self._strategy.amp
+        if amp.enable:
+            return jnp.bfloat16 if "bf" in str(amp.dtype) else jnp.float16
+        return None
+
+    def _forward(self, params, args):
+        """Pure forward: Strategy.amp casts params; Strategy.recompute
+        flips the layer's remat switch when it exposes one (the
+        build_train_step convention, models/llama.py)."""
+        from ...autograd import no_grad
+
+        cdt = self._compute_dtype()
+        if cdt is not None:
+            params = {k: (v.astype(cdt)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for k, v in params.items()}
+        remat_host = None
+        for holder in (self.network,
+                       getattr(self.network, "model", None)):
+            if holder is not None and hasattr(holder, "remat"):
+                remat_host = holder
+                break
+        saved = None
+        if remat_host is not None and self._strategy.recompute.enable:
+            saved = remat_host.remat
+            remat_host.remat = True
+        try:
+            with no_grad():
+                out = self.network.functional_call(
+                    params, *[Tensor(a) for a in args])
+        finally:
+            if saved is not None:
+                remat_host.remat = saved
+        return out
+
+    def _loss_val(self, params, buffers, *data):
+        *inputs, label = data
+        out = self._forward({**buffers, **params}, inputs)
+        lv = self._loss(out, Tensor(label))
+        return lv._value if isinstance(lv, Tensor) else lv
+
+    def _apply(self, params, grads, opt_state, step_no, lr):
+        names = list(params.keys())
+        no_decay = {n for n in names if "norm" in n.lower()
+                    or n.endswith(".bias")}
+        return self._optimizer.apply(
+            params, grads, opt_state, lr, step_no + 1,
+            decay_mask={n: n not in no_decay for n in names})
+
+    def _build(self, mode: str):
+        if mode == "train":
+            grad_fn = jax.value_and_grad(self._loss_val)
+
+            if self._gm_k <= 1:
+                # no gradient merge: single fused grad+apply step, params
+                # and optimizer state donated (build_train_step shape)
+                def train_step(params, opt_state, buffers, step_no, lr,
+                               *data):
+                    loss, g = grad_fn(params, buffers, *data)
+                    new_p, new_s = self._apply(params, g, opt_state,
+                                               step_no, lr)
+                    return loss, new_p, new_s
+
+                return jax.jit(train_step, donate_argnums=(0, 1))
+
+            def train_accum(params, acc, buffers, *data):
+                loss, g = grad_fn(params, buffers, *data)
+                acc = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), acc, g)
+                return loss, acc
+
+            def train_apply(params, opt_state, acc, step_no, lr):
+                gm = self._strategy.gradient_merge
+                scale = (1.0 / self._gm_k
+                         if (gm.enable and gm.avg) else 1.0)
+                grads = jax.tree_util.tree_map(lambda a: a * scale, acc)
+                return self._apply(params, grads, opt_state, step_no, lr)
+
+            return (jax.jit(train_accum, donate_argnums=(1,)),
+                    jax.jit(train_apply, donate_argnums=(0, 1, 2)))
+        if mode == "eval":
+            return jax.jit(self._loss_val)
+
+        def fwd(params, buffers, *inputs):
+            out = self._forward({**buffers, **params}, inputs)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out)
+
+        return jax.jit(fwd)
+
+    # ------------------------------------------------------------- step
+    def __call__(self, *data):
+        data = [d._value if isinstance(d, Tensor) else jnp.asarray(d)
+                for d in data]
+        if self._mode == "train":
+            if "train" not in self._steps:
+                self._steps["train"] = self._build("train")
+            lr = (self._optimizer.get_lr()
+                  if hasattr(self._optimizer, "get_lr") else 1e-3)
+            if self._gm_k <= 1:
+                loss, self._params, self._opt_state = self._steps["train"](
+                    self._params, self._opt_state, self._buffers,
+                    self._step_no, lr, *data)
+                self._step_no += 1
+                self._lr_tick()
+                return Tensor(loss)
+            accum_fn, apply_fn = self._steps["train"]
+            if self._gm_acc is None:
+                self._gm_acc = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), self._params)
+            loss, self._gm_acc = accum_fn(self._params, self._gm_acc,
+                                          self._buffers, *data)
+            self._gm_count += 1
+            if self._gm_count >= self._gm_k:
+                self._params, self._opt_state = apply_fn(
+                    self._params, self._opt_state, self._gm_acc,
+                    self._step_no, lr)
+                self._gm_acc = None
+                self._gm_count = 0
+                self._step_no += 1
+                self._lr_tick()
+            return Tensor(loss)
+        if self._mode == "eval":
+            if "eval" not in self._steps:
+                self._steps["eval"] = self._build("eval")
+            return Tensor(self._steps["eval"](self._params, self._buffers,
+                                              *data))
+        if "predict" not in self._steps:
+            self._steps["predict"] = self._build("predict")
+        out = self._steps["predict"](self._params, self._buffers, *data)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def _lr_tick(self):
+        sched = getattr(self._optimizer, "_lr", None)
+        if hasattr(sched, "step"):
+            sched.step()
+
+    # ------------------------------------------------------- state access
+    def state_dict(self, mode: str = "all") -> Dict[str, Any]:
+        """Write live params+buffers back into the layer and return its
+        state_dict (reference DistModel.state_dict)."""
+        self.network.load_functional_state(
+            {**self._buffers, **self._params})
+        return self.network.state_dict()
+
+    def set_state_dict(self, state_dict):
+        self.network.set_state_dict(state_dict)
+        pnames = {n for n, _ in self.network.named_parameters()}
+        state = self.network.functional_state()
+        self._params = {k: v for k, v in state.items() if k in pnames}
+        self._buffers = {k: v for k, v in state.items() if k not in pnames}
+        if self._optimizer is not None:
+            self._opt_state = self._optimizer.init_state(self._params)
+
+    def dist_main_program(self, mode=None):  # parity shim
+        return None
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy: Optional[Strategy] = None, metrics=None
+              ) -> DistModel:
+    """Reference: paddle.distributed.to_static
+    (auto_parallel/api.py:2510)."""
+    return DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy, metrics=metrics)

@@ -51,12 +51,13 @@ def run(n: int = 300, size: int = 256) -> Dict[str, float]:
     ):
         out[f"{opname}_dispatch_ops_s"] = _time_loop(
             lambda: dfn()._value, n, sync)
+        saved = paddle.get_flags("FLAGS_tpu_eager_compile_cache")
         try:
             paddle.set_flags({"FLAGS_tpu_eager_compile_cache": False})
             out[f"{opname}_dispatch_nocache_ops_s"] = _time_loop(
                 lambda: dfn()._value, max(n // 10, 20), sync)
         finally:
-            paddle.set_flags({"FLAGS_tpu_eager_compile_cache": True})
+            paddle.set_flags(saved)
         out[f"{opname}_raw_jnp_ops_s"] = _time_loop(rfn, n, sync)
         out[f"{opname}_overhead_x"] = round(
             out[f"{opname}_raw_jnp_ops_s"]

@@ -80,14 +80,21 @@ def all_ops() -> Dict[str, OpDef]:
     return dict(_REGISTRY)
 
 
+# SPMD rules declared before their op exists (import order: the rule
+# library loads with distributed, some ops register later from incubate/
+# rnn/quantization) — register() picks them up here
+_PENDING_SPMD_RULES: Dict[str, Callable] = {}
+
+
 def register(name: str, amp: Optional[str] = None, nondiff: bool = False,
              spmd_rule: Optional[Callable] = None, cacheable: bool = True):
     """Register a pure-JAX function as a framework op and return its public
     eager entry point (Tensor-in/Tensor-out)."""
 
     def deco(fn: Callable):
+        rule = spmd_rule or _PENDING_SPMD_RULES.get(name)
         _REGISTRY[name] = OpDef(name=name, fn=fn, amp=amp, nondiff=nondiff,
-                                spmd_rule=spmd_rule, cacheable=cacheable)
+                                spmd_rule=rule, cacheable=cacheable)
 
         @functools.wraps(fn)
         def public(*args, **kwargs):

@@ -1,0 +1,155 @@
+"""dist.to_static / DistModel: a reference-style auto-parallel training
+script must run verbatim-modulo-imports on the 8-device mesh.
+
+Reference: python/paddle/distributed/auto_parallel/api.py:2510 to_static,
+:2030 DistModel, static/engine.py.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed import ProcessMesh
+
+
+class MLP(nn.Layer):
+    def __init__(self, d=32, h=64, classes=8):
+        super().__init__()
+        self.fc1 = nn.Linear(d, h)
+        self.fc2 = nn.Linear(h, classes)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _mesh():
+    return ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+
+
+def _shard_fn(name, layer, mesh):
+    from paddle_tpu.distributed import Replicate, Shard
+
+    # column-parallel fc1, row-parallel fc2 over "mp"
+    for pname, p in layer.named_parameters(include_sublayers=False):
+        if name.endswith("fc1") and pname == "weight":
+            dist.auto_parallel.api.shard_parameter(
+                p, mesh, [Replicate(), Shard(1)])
+        elif name.endswith("fc2") and pname == "weight":
+            dist.auto_parallel.api.shard_parameter(
+                p, mesh, [Shard(0), Replicate()])
+
+
+def _data(n=64, d=32, classes=8, batch=16):
+    rng = np.random.RandomState(0)
+    xs = rng.randn(n, d).astype(np.float32)
+    ys = rng.randint(0, classes, (n,)).astype(np.int64)
+    for i in range(0, n, batch):
+        yield xs[i:i + batch], ys[i:i + batch]
+
+
+def _loss_fn(logits, label):
+    return paddle.nn.functional.cross_entropy(logits, label)
+
+
+def test_to_static_reference_script():
+    """The reference's canonical to_static training loop."""
+    mesh = _mesh()
+    layer = dist.shard_layer(MLP(), mesh, _shard_fn)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=layer.parameters())
+    model = dist.to_static(layer, None, _loss_fn, opt)
+    model.train()
+    losses = []
+    for _ in range(3):
+        for img, lbl in _data():
+            losses.append(float(model(img, lbl)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # eval mode reuses the same params
+    model.eval()
+    ev = float(model(*next(iter(_data()))))
+    assert np.isfinite(ev)
+
+    # predict returns logits
+    model.predict()
+    out = model(next(iter(_data()))[0])
+    assert tuple(out.shape) == (16, 8)
+
+    # state_dict round-trips through the layer
+    sd = model.state_dict()
+    assert "fc1.weight" in sd
+
+
+def test_to_static_strategy_knobs():
+    """Strategy.amp (bf16 compute) + gradient_merge (k-step accumulation:
+    params move only every k calls) are consumed."""
+    mesh = _mesh()
+    layer = dist.shard_layer(MLP(), mesh, _shard_fn)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=layer.parameters())
+    strategy = dist.Strategy()
+    strategy.amp.enable = True
+    strategy.amp.dtype = "bfloat16"
+    strategy.gradient_merge.enable = True
+    strategy.gradient_merge.k_steps = 2
+    model = dist.to_static(layer, None, _loss_fn, opt, strategy)
+    model.train()
+
+    it = _data()
+    p0 = np.asarray(model._params["fc1.weight"])
+    model(*next(it))
+    p1 = np.asarray(model._params["fc1.weight"])
+    np.testing.assert_array_equal(p0, p1)  # first of k=2: no update yet
+    model(*next(it))
+    p2 = np.asarray(model._params["fc1.weight"])
+    assert np.abs(p2 - p0).max() > 0  # k-th call applies the merged grads
+
+
+class BufferedNet(nn.Layer):
+    """int step-counter buffer + float scale buffer: neither may be
+    differentiated or optimized by DistModel train mode."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+        import paddle_tpu as _p
+
+        self.register_buffer("steps", _p.to_tensor(
+            np.zeros((1,), np.int32)))
+        self.register_buffer("scale", _p.to_tensor(
+            np.ones((1,), np.float32)))
+
+    def forward(self, x):
+        return self.fc(x) * self.scale
+
+
+def test_buffers_not_trained():
+    layer = BufferedNet()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=layer.parameters())
+    model = dist.to_static(layer, None,
+                           lambda out, lbl: ((out - lbl) ** 2).mean(), opt)
+    model.train()
+    x = np.random.randn(4, 8).astype(np.float32)
+    y = np.random.randn(4, 4).astype(np.float32)
+    for _ in range(2):
+        loss = model(x, y)
+    assert np.isfinite(float(loss))
+    # buffers unchanged; param changed
+    assert np.asarray(model._buffers["scale"]).item() == 1.0
+    assert np.asarray(model._buffers["steps"]).item() == 0
+    sd = model.state_dict()
+    assert "steps" in sd and "fc.weight" in sd
+
+
+def test_to_static_requires_loss_for_train():
+    layer = MLP()
+    model = dist.to_static(layer)
+    assert model.mode == "predict"
+    with pytest.raises(ValueError):
+        model.train()
