@@ -234,8 +234,19 @@ class DistModel:
                 return Tensor(loss)
             accum_fn, apply_fn = self._steps["train"]
             if self._gm_acc is None:
-                self._gm_acc = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), self._params)
+                # allocate WITH each param's sharding: an unsharded fp32
+                # copy of a mesh-sharded model would OOM device 0
+                def _zeros(p):
+                    z = jnp.zeros(p.shape, jnp.float32)
+                    sh = getattr(p, "sharding", None)
+                    # commit only mesh-sharded accumulators; committing
+                    # single-device leaves would conflict with
+                    # mesh-committed siblings in one jit call
+                    if isinstance(sh, jax.sharding.NamedSharding):
+                        return jax.device_put(z, sh)
+                    return z
+
+                self._gm_acc = jax.tree_util.tree_map(_zeros, self._params)
             loss, self._gm_acc = accum_fn(self._params, self._gm_acc,
                                           self._buffers, *data)
             self._gm_count += 1
@@ -266,19 +277,43 @@ class DistModel:
     # ------------------------------------------------------- state access
     def state_dict(self, mode: str = "all") -> Dict[str, Any]:
         """Write live params+buffers back into the layer and return its
-        state_dict (reference DistModel.state_dict)."""
+        state_dict; ``mode='all'/'opt'`` additionally exports optimizer
+        slots as ``opt_state.<param>.<slot>`` entries (the reference
+        DistModel contract: mode='all' covers the full training state, so
+        save/resume does not silently reset Adam moments)."""
         self.network.load_functional_state(
             {**self._buffers, **self._params})
-        return self.network.state_dict()
+        out = dict(self.network.state_dict()) if mode != "opt" else {}
+        if mode in ("all", "opt") and self._opt_state is not None:
+            for pname, slots in self._opt_state.items():
+                for sname, v in slots.items():
+                    out[f"opt_state.{pname}.{sname}"] = v
+        return out
 
     def set_state_dict(self, state_dict):
-        self.network.set_state_dict(state_dict)
+        opt_entries = {k: v for k, v in state_dict.items()
+                       if k.startswith("opt_state.")}
+        rest = {k: v for k, v in state_dict.items()
+                if not k.startswith("opt_state.")}
+        self.network.set_state_dict(rest)
         pnames = {n for n, _ in self.network.named_parameters()}
         state = self.network.functional_state()
         self._params = {k: v for k, v in state.items() if k in pnames}
         self._buffers = {k: v for k, v in state.items() if k not in pnames}
         if self._optimizer is not None:
-            self._opt_state = self._optimizer.init_state(self._params)
+            if opt_entries:
+                restored = self._optimizer.init_state(self._params)
+                for k, v in opt_entries.items():
+                    pname, sname = k[len("opt_state."):].rsplit(".", 1)
+                    if pname in restored:
+                        restored[pname][sname] = (
+                            v._value if isinstance(v, Tensor)
+                            else jnp.asarray(v))
+                self._opt_state = restored
+            elif self._opt_state is None:
+                self._opt_state = self._optimizer.init_state(self._params)
+            # else: keep the live moments — resetting them silently would
+            # change the training trajectory
 
     def dist_main_program(self, mode=None):  # parity shim
         return None

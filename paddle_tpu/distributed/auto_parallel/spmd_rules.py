@@ -214,30 +214,38 @@ def _transpose_rule(x: P, perm=None, **kw):
 @register_spmd_rule("reshape")
 def _reshape_rule(x: P, in_shape=None, out_shape=None, **kw):
     """Dims unchanged from the FRONT keep their shard; the first changed
-    dim and everything after is replicated (the conservative core of
-    reference reshape.cc's factorization mapping)."""
+    dim and everything after is replicated ON BOTH SIDES (the
+    conservative core of reference reshape.cc's factorization mapping —
+    the input rewrite is what makes the prediction consistent with
+    GSPMD, which would otherwise keep a sharded merged/split dim)."""
     xa = _axes(x)
     if in_shape is None or out_shape is None:
-        return (x,), (P(),), {}
-    out_entries = []
-    for i, (a, b) in enumerate(zip(in_shape, out_shape)):
+        return (P(),), (P(),), {}
+    keep = 0
+    for a, b in zip(in_shape, out_shape):
         if a != b:
             break
-        out_entries.append(xa[i] if i < len(xa) else None)
-    out_entries += [None] * (len(out_shape) - len(out_entries))
-    return (x,), (P(*out_entries),), {}
+        keep += 1
+    xa = xa + (None,) * (len(in_shape) - len(xa))
+    in_entries = [xa[i] if i < keep else None for i in range(len(in_shape))]
+    out_entries = [xa[i] if i < keep else None for i in range(len(out_shape))]
+    return (P(*in_entries),), (P(*out_entries),), {}
 
 
 @register_spmd_rule("flatten")
 def _flatten_rule(x: P, start_axis: int = 0, stop_axis: int = -1,
                   ndim: Optional[int] = None, **kw):
+    """Flattened range replicated on input AND output (same consistency
+    argument as reshape); dims outside the range keep their shard."""
     xa = _axes(x)
     nd = ndim if ndim is not None else len(xa)
     xa = xa + (None,) * (nd - len(xa))
     start = start_axis % nd
     stop = stop_axis % nd
+    in_x = P(*(None if start <= i <= stop else a
+               for i, a in enumerate(xa)))
     out = tuple(xa[:start]) + (None,) + tuple(xa[stop + 1:])
-    return (x,), (P(*out),), {}
+    return (in_x,), (P(*out),), {}
 
 
 @register_spmd_rule("squeeze")
@@ -328,7 +336,7 @@ def _linear_rule(x: P, w: P, b: P = None, **kw):
 def _swiglu_rule(x: P, y: P = None, **kw):
     if y is None:
         return (x,), (x,), {}
-    chosen = x if any(_axes(x)) else (y if y is not None else x)
+    chosen = x if any(_axes(x)) else y
     return (chosen, chosen), (chosen,), {}
 
 

@@ -52,6 +52,47 @@ def _moe_forward_op(x2d, gate_w, w_up, b_up, w_down, b_down, *,
     return y, aux
 
 
+@register("moe_dropless_forward", amp="white")
+def _moe_dropless_op(x2d, gate_w, w_up, b_up, w_down, b_down, *,
+                     topk: int, aux_fn=None, activation="gelu"):
+    """Dropless (capacity = infinity) MoE without dense all-expert
+    compute — the MegaBlocks formulation on TPU: routed tokens are
+    SORTED by expert id and pushed through grouped GEMMs
+    (``lax.ragged_dot``: one MXU pass per expert group, group sizes
+    dynamic), then unsorted and combined.  Exactly G*topk token-FFN
+    products regardless of routing skew, vs the capacity path's dense
+    [G, E, C] dispatch (reference fused_moe's eval path computes all E
+    experts per token).
+
+    x2d: [G, m]; returns (y [G, m], aux)."""
+    g, m = x2d.shape
+    e = gate_w.shape[1]
+    logits = x2d.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    aux = aux_fn(probs) if aux_fn is not None else jnp.asarray(0.0)
+    top_p, top_ids = jax.lax.top_k(probs, topk)         # [G, k]
+    flat_ids = top_ids.reshape(-1)                      # [G*k]
+    order = jnp.argsort(flat_ids, stable=True)
+    sorted_ids = flat_ids[order]
+    token_of = order // topk                            # source token
+    xs = x2d[token_of]                                  # [G*k, m] sorted
+    group_sizes = jnp.bincount(flat_ids, length=e).astype(jnp.int32)
+    h = jax.lax.ragged_dot(xs, w_up.astype(xs.dtype), group_sizes) \
+        + b_up.astype(xs.dtype)[sorted_ids]
+    if activation == "gelu":
+        h = jax.nn.gelu(h)
+    elif activation == "relu":
+        h = jax.nn.relu(h)
+    elif activation == "swiglu":
+        a, b = jnp.split(h, 2, axis=-1)
+        h = jax.nn.silu(a) * b
+    eo = jax.lax.ragged_dot(h, w_down.astype(h.dtype), group_sizes) \
+        + b_down.astype(h.dtype)[sorted_ids]
+    wgt = top_p.reshape(-1)[order].astype(x2d.dtype)
+    y = jnp.zeros_like(x2d).at[token_of].add(eo * wgt[:, None])
+    return y, aux
+
+
 class MoELayer(Layer):
     """Drop-in MoE FFN.
 
@@ -68,7 +109,8 @@ class MoELayer(Layer):
                  capacity_factor: float = 1.2, activation: str = "gelu",
                  mesh: Optional[Mesh] = None, ep_axis: str = "ep",
                  mp_axis: Optional[str] = None,
-                 moe_group=None, recompute_interval: int = 0):
+                 moe_group=None, recompute_interval: int = 0,
+                 dropless: bool = False):
         super().__init__()
         if isinstance(gate, str):
             topk = 1 if gate == "switch" else top_k
@@ -78,6 +120,7 @@ class MoELayer(Layer):
         self.num_expert = num_expert
         self.capacity_factor = capacity_factor
         self.activation = activation
+        self.dropless = dropless
         self.l_aux = None
         scale = 1.0 / (d_model ** 0.5)
         import numpy as np
@@ -116,11 +159,19 @@ class MoELayer(Layer):
         shape = x.shape
         d = shape[-1]
         x2d = x.reshape([-1, d])
-        g = x2d.shape[0]
-        capacity = self.gate.capacity(g, self.capacity_factor)
-        y, aux = _moe_forward_op(
-            x2d, self.gate.weight, self.w_up, self.b_up, self.w_down,
-            self.b_down, topk=self.gate.topk, capacity=capacity,
-            aux_fn=type(self.gate).aux_loss_fn, activation=self.activation)
+        if self.dropless:
+            y, aux = _moe_dropless_op(
+                x2d, self.gate.weight, self.w_up, self.b_up, self.w_down,
+                self.b_down, topk=self.gate.topk,
+                aux_fn=type(self.gate).aux_loss_fn,
+                activation=self.activation)
+        else:
+            g = x2d.shape[0]
+            capacity = self.gate.capacity(g, self.capacity_factor)
+            y, aux = _moe_forward_op(
+                x2d, self.gate.weight, self.w_up, self.b_up, self.w_down,
+                self.b_down, topk=self.gate.topk, capacity=capacity,
+                aux_fn=type(self.gate).aux_loss_fn,
+                activation=self.activation)
         self.l_aux = aux
         return y.reshape(shape)

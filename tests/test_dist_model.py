@@ -153,3 +153,28 @@ def test_to_static_requires_loss_for_train():
     assert model.mode == "predict"
     with pytest.raises(ValueError):
         model.train()
+
+
+def test_state_dict_roundtrips_optimizer_moments():
+    """mode='all' exports Adam moments; set_state_dict restores them —
+    resume must not silently reset the trajectory."""
+    layer = MLP()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=layer.parameters())
+    model = dist.to_static(layer, None, _loss_fn, opt)
+    model.train()
+    x, y = next(iter(_data()))
+    for _ in range(3):
+        model(x, y)
+    sd = model.state_dict()
+    opt_keys = [k for k in sd if k.startswith("opt_state.")]
+    assert opt_keys, "no optimizer slots exported"
+    m_before = np.asarray(model._opt_state["fc1.weight"]["moment1"])
+
+    layer2 = MLP()
+    opt2 = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                  parameters=layer2.parameters())
+    model2 = dist.to_static(layer2, None, _loss_fn, opt2)
+    model2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(model2._opt_state["fc1.weight"]["moment1"]), m_before)

@@ -145,3 +145,189 @@ def test_fused_moe_grads_flow():
     w1.stop_gradient = False
     (fused_moe(x, gw, w1, w2, moe_topk=2) ** 2).sum().backward()
     assert x.grad is not None and w1.grad is not None
+
+
+# --------------------------------------------------------------------------
+# dropless dispatch (round 3): sort + ragged_dot grouped GEMM
+# --------------------------------------------------------------------------
+
+def _moe_loop_reference(x2d, gate_w, w_up, b_up, w_down, b_down, topk):
+    """Per-token python loop: every routed token processed (capacity inf)."""
+    import jax
+
+    logits = np.asarray(x2d, np.float64) @ np.asarray(gate_w, np.float64)
+    ex = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = ex / ex.sum(-1, keepdims=True)
+    y = np.zeros_like(np.asarray(x2d, np.float64))
+    for t in range(x2d.shape[0]):
+        top = np.argsort(-probs[t])[:topk]
+        for e in top:
+            h = np.asarray(x2d[t], np.float64) @ np.asarray(w_up[e], np.float64) \
+                + np.asarray(b_up[e], np.float64)
+            h = np.asarray(jax.nn.gelu(jnp.asarray(h, jnp.float64)))
+            o = h @ np.asarray(w_down[e], np.float64) + np.asarray(b_down[e], np.float64)
+            y[t] += probs[t, e] * o
+    return y
+
+
+def test_dropless_matches_loop_reference():
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
+        _moe_dropless_op
+
+    rng = np.random.RandomState(0)
+    g, m, h, e = 12, 8, 16, 4
+    x2d = jnp.asarray(rng.randn(g, m).astype(np.float32))
+    gate_w = jnp.asarray(rng.randn(m, e).astype(np.float32))
+    w_up = jnp.asarray(rng.randn(e, m, h).astype(np.float32) * 0.3)
+    b_up = jnp.asarray(rng.randn(e, h).astype(np.float32) * 0.1)
+    w_down = jnp.asarray(rng.randn(e, h, m).astype(np.float32) * 0.3)
+    b_down = jnp.asarray(rng.randn(e, m).astype(np.float32) * 0.1)
+
+    y, _ = _moe_dropless_op.raw_fn(x2d, gate_w, w_up, b_up, w_down, b_down,
+                                   topk=2)
+    ref = _moe_loop_reference(x2d, gate_w, w_up, b_up, w_down, b_down, 2)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_dropless_matches_capacity_path_when_no_drops():
+    """With capacity >= G the dense GShard path drops nothing -> must
+    agree with dropless exactly."""
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
+        _moe_dropless_op, _moe_forward_op
+
+    rng = np.random.RandomState(1)
+    g, m, h, e = 16, 8, 12, 4
+    args = (jnp.asarray(rng.randn(g, m).astype(np.float32)),
+            jnp.asarray(rng.randn(m, e).astype(np.float32)),
+            jnp.asarray(rng.randn(e, m, h).astype(np.float32) * 0.3),
+            jnp.asarray(rng.randn(e, h).astype(np.float32) * 0.1),
+            jnp.asarray(rng.randn(e, h, m).astype(np.float32) * 0.3),
+            jnp.asarray(rng.randn(e, m).astype(np.float32) * 0.1))
+    yd, _ = _moe_dropless_op.raw_fn(*args, topk=2)
+    yc, _ = _moe_forward_op.raw_fn(*args, topk=2, capacity=g)
+    np.testing.assert_allclose(np.asarray(yd), np.asarray(yc),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_dropless_processes_skewed_routing():
+    """All tokens to ONE expert: the capacity path (factor 1.2) drops
+    most of them; dropless must process every token."""
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
+        _moe_dropless_op
+
+    g, m, h, e = 16, 8, 12, 4
+    rng = np.random.RandomState(2)
+    gate_w = np.zeros((m, e), np.float32)
+    gate_w[:, 1] = 1.0  # every token -> expert 1 (then runner-up expert)
+    x2d = jnp.asarray(np.abs(rng.randn(g, m)).astype(np.float32))
+    w_up = jnp.asarray(rng.randn(e, m, h).astype(np.float32) * 0.3)
+    b_up = jnp.zeros((e, h), jnp.float32)
+    w_down = jnp.asarray(rng.randn(e, h, m).astype(np.float32) * 0.3)
+    b_down = jnp.zeros((e, m), jnp.float32)
+    y, _ = _moe_dropless_op.raw_fn(x2d, jnp.asarray(gate_w), w_up, b_up,
+                                   w_down, b_down, topk=1)
+    ref = _moe_loop_reference(x2d, jnp.asarray(gate_w), w_up, b_up, w_down,
+                              b_down, 1)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-5)
+    assert np.abs(np.asarray(y)).sum() > 0
+
+
+def test_dropless_grads():
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
+        _moe_dropless_op
+
+    rng = np.random.RandomState(3)
+    g, m, h, e = 8, 4, 8, 3
+    x2d = jnp.asarray(rng.randn(g, m).astype(np.float32))
+    gate_w = jnp.asarray(rng.randn(m, e).astype(np.float32))
+    w_up = jnp.asarray(rng.randn(e, m, h).astype(np.float32) * 0.3)
+    b_up = jnp.zeros((e, h), jnp.float32)
+    w_down = jnp.asarray(rng.randn(e, h, m).astype(np.float32) * 0.3)
+    b_down = jnp.zeros((e, m), jnp.float32)
+
+    def loss(x2d, w_up, w_down):
+        y, _ = _moe_dropless_op.raw_fn(x2d, gate_w, w_up, b_up, w_down,
+                                       b_down, topk=2)
+        return (y ** 2).sum()
+
+    gx, gu, gd = jax.grad(loss, argnums=(0, 1, 2))(x2d, w_up, w_down)
+    for name, gv in (("x", gx), ("w_up", gu), ("w_down", gd)):
+        assert np.isfinite(np.asarray(gv)).all(), name
+        assert np.abs(np.asarray(gv)).sum() > 0, name
+
+
+def test_moe_layer_dropless_flag():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    moe = MoELayer(d_model=8, d_hidden=16, num_expert=4, gate="gshard",
+                   dropless=True)
+    out = moe(paddle.rand([2, 6, 8]))
+    assert tuple(out.shape) == (2, 6, 8)
+    assert np.isfinite(np.asarray(out._value)).all()
+    assert moe.l_aux is not None
+
+
+def test_moe_pipeline_ep_mp_composition(cpu_mesh8):
+    """MoE blocks pipelined over pp with experts sharded over ep AND
+    expert hidden dims Megatron-sharded over mp — ep x mp x pp all > 1 in
+    ONE compiled program (round-2 verdict item 7's composition leg)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
+        _moe_forward_op
+    from paddle_tpu.parallel.pipelining import pipeline_apply
+
+    devs = np.asarray(jax.devices("cpu")[:8], dtype=object).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("pp", "ep", "mp"))
+    L, E, dm, dh = 2, 4, 8, 16
+    m_micro, mb = 2, 8
+    rng = np.random.RandomState(0)
+    params = {
+        "gate_w": jnp.asarray(rng.randn(L, dm, E).astype(np.float32)),
+        "w_up": jnp.asarray(rng.randn(L, E, dm, dh).astype(np.float32) * .3),
+        "b_up": jnp.zeros((L, E, dh), jnp.float32),
+        "w_down": jnp.asarray(rng.randn(L, E, dh, dm).astype(np.float32) * .3),
+        "b_down": jnp.zeros((L, E, dm), jnp.float32),
+    }
+    specs = {
+        "gate_w": P("pp", None, None),
+        "w_up": P("pp", "ep", None, "mp"),
+        "b_up": P("pp", "ep", "mp"),
+        "w_down": P("pp", "ep", "mp", None),
+        "b_down": P("pp", "ep", None),
+    }
+    placed = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+              for k, v in params.items()}
+    x = jnp.asarray(rng.randn(m_micro, mb, dm).astype(np.float32))
+
+    def moe_block(lp, act):
+        y, _ = _moe_forward_op.raw_fn(
+            act, lp["gate_w"], lp["w_up"], lp["b_up"], lp["w_down"],
+            lp["b_down"], topk=2, capacity=act.shape[0], aux_fn=None)
+        return act + y
+
+    def stage_fn(sp, act):
+        act, _ = jax.lax.scan(lambda h, lp: (moe_block(lp, h), None),
+                              act, sp)
+        return act
+
+    def body(sp, x):
+        outs = pipeline_apply(stage_fn, sp, x, axis="pp",
+                              squeeze_stage_dim=False)
+        is_last = (jax.lax.axis_index("pp")
+                   == jax.lax.axis_size("pp") - 1).astype(outs.dtype)
+        return jax.lax.psum(outs * is_last, "pp")
+
+    with jax.sharding.set_mesh(mesh):
+        out = jax.jit(jax.shard_map(
+            body, mesh=mesh, axis_names={"pp"},
+            in_specs=(P("pp"), P(None)), out_specs=P(None),
+            check_vma=False))(placed, x)
+
+    # sequential reference, unsharded
+    ref = x
+    for i in range(L):
+        lp = {k: v[i] for k, v in params.items()}
+        ref = jnp.stack([moe_block(lp, ref[j])
+                         for j in range(m_micro)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-4, atol=5e-5)

@@ -216,3 +216,22 @@ def test_rule_count_and_opdef_plumbing():
     for n in names:
         if n in __import__("paddle_tpu").ops.registry.all_ops():
             assert get_op(n).spmd_rule is not None, n
+
+
+def test_reshape_sharded_changed_dim_consistent():
+    """A shard on a CHANGED dim must be dropped on the INPUT spec too —
+    the rule's prediction then agrees with GSPMD (review finding r3)."""
+    ins, outs, _ = SR.infer_forward("reshape", P(None, "y"),
+                                    in_shape=(8, 16), out_shape=(8, 4, 4))
+    assert _norm(ins[0]) == ()          # changed dim replicated on input
+    assert _norm(outs[0]) == ()
+    got = _run("reshape", [_arr(8, 16)], ins, shape=(8, 4, 4))
+    assert got == _norm(outs[0])
+
+
+def test_flatten_sharded_range_consistent():
+    ins, outs, _ = SR.infer_forward("flatten", P("x", None, None),
+                                    start_axis=0, stop_axis=1, ndim=3)
+    assert _norm(ins[0]) == ()
+    got = _run("flatten", [_arr(4, 4, 8)], ins, start_axis=0, stop_axis=1)
+    assert got == _norm(outs[0])
