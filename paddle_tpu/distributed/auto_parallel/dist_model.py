@@ -306,9 +306,17 @@ class DistModel:
                 for k, v in opt_entries.items():
                     pname, sname = k[len("opt_state."):].rsplit(".", 1)
                     if pname in restored:
-                        restored[pname][sname] = (
-                            v._value if isinstance(v, Tensor)
-                            else jnp.asarray(v))
+                        arr = (v._value if isinstance(v, Tensor)
+                               else jnp.asarray(v))
+                        # keep moments on the param's mesh sharding — an
+                        # unsharded restore would OOM device 0 for models
+                        # that only fit sharded (same rationale as _zeros)
+                        sh = getattr(self._params.get(pname), "sharding",
+                                     None)
+                        if isinstance(sh, jax.sharding.NamedSharding) \
+                                and arr.shape == self._params[pname].shape:
+                            arr = jax.device_put(arr, sh)
+                        restored[pname][sname] = arr
                 self._opt_state = restored
             elif self._opt_state is None:
                 self._opt_state = self._optimizer.init_state(self._params)

@@ -123,13 +123,35 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
 
 
 def _spec_avals(input_spec):
-    """InputSpec list → ShapeDtypeStructs (example Tensors pass through)."""
+    """InputSpec list → ShapeDtypeStructs (example Tensors pass through).
+
+    ``None``/-1 dims become jax.export SYMBOLIC dims: dim 0 is the shared
+    batch symbol ``b`` across all inputs (the reference's -1 batch in
+    save_inference_model), other dynamic dims get unique symbols — the
+    exported artifact then serves any batch size (Predictor.run_batch)."""
     from ..static import InputSpec
 
+    scope = None
     avals = []
-    for spec in input_spec:
+    for i, spec in enumerate(input_spec):
         if isinstance(spec, InputSpec):
-            avals.append(jax.ShapeDtypeStruct(spec.shape, jnp.dtype(spec.dtype)))
+            shape = tuple(spec.shape)
+            dtype = jnp.dtype(spec.dtype)
+            if any(d is None or (isinstance(d, int) and d < 0)
+                   for d in shape):
+                from jax import export as jax_export
+
+                if scope is None:
+                    scope = jax_export.SymbolicScope()
+                parts = []
+                for j, d in enumerate(shape):
+                    if d is None or (isinstance(d, int) and d < 0):
+                        parts.append("b" if j == 0 else f"d{i}_{j}")
+                    else:
+                        parts.append(str(d))
+                shape = jax_export.symbolic_shape(",".join(parts),
+                                                  scope=scope)
+            avals.append(jax.ShapeDtypeStruct(shape, dtype))
         elif isinstance(spec, Tensor):
             avals.append(jax.ShapeDtypeStruct(tuple(spec.shape), spec.dtype))
         else:
@@ -188,8 +210,19 @@ def save(layer, path, input_spec=None, **configs):
                                                             *in_avals)
             payload["exported"] = exported.serialize()
             payload["stablehlo"] = exported.mlir_module()
-            payload["input_spec"] = [(tuple(a.shape), str(a.dtype))
-                                     for a in in_avals]
+            payload["input_spec"] = [(tuple(str(d) if not isinstance(d, int)
+                                           else d for d in a.shape),
+                                      str(a.dtype)) for a in in_avals]
+            # named IO: InputSpec.name when given (AnalysisPredictor's
+            # named-handle contract); outputs counted from the exported
+            # signature
+            from ..static import InputSpec as _IS
+
+            payload["input_names"] = [
+                (s.name if isinstance(s, _IS) and s.name else f"input_{i}")
+                for i, s in enumerate(input_spec)]
+            n_out = len(exported.out_avals)
+            payload["output_names"] = [f"output_{i}" for i in range(n_out)]
         finally:
             if was_training and hasattr(layer, "train"):
                 layer.train()
@@ -208,6 +241,8 @@ class LoadedFunction:
         self._state = payload["state"]
         self._exported = jax_export.deserialize(payload["exported"])
         self.input_spec = payload.get("input_spec")
+        self.input_names = payload.get("input_names")
+        self.output_names = payload.get("output_names")
         self.class_name = payload.get("class")
 
     def state_dict(self):

@@ -993,6 +993,7 @@ def weight_quantize(x, algo="abs_max"):
     """ref: phi weight_quantize (weight-only int8). x [K, N] ->
     (int8 weights, per-column scale)."""
     scale = jnp.abs(x).max(axis=0)
+    scale = jnp.where(scale == 0, jnp.ones_like(scale), scale)
     q = jnp.clip(jnp.round(x / scale * 127.0), -127, 127).astype(jnp.int8)
     return q, scale
 

@@ -1,0 +1,166 @@
+"""Inference predictor depth (VERDICT r2 missing#7): named IO from the
+saved signature, convert-on-load (bf16 / weight-only int8), clone-per-
+thread serving, multi-request batching over a symbolic batch dim.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:105 (named
+ZeroCopyTensor handles, Clone), paddle_pass_builder.h:38 (precision
+convert passes).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, jit
+from paddle_tpu.models import BertConfig, BertForSequenceClassification
+from paddle_tpu.static import InputSpec
+
+
+@pytest.fixture(scope="module")
+def saved_bert(tmp_path_factory):
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=64)
+    model = BertForSequenceClassification(cfg, num_classes=4)
+    model.eval()
+    path = str(tmp_path_factory.mktemp("pred") / "bert")
+    jit.save(model, path, input_spec=[
+        InputSpec([None, 16], "int32", name="input_ids"),
+        InputSpec([None, 16], "int32", name="token_type_ids"),
+    ])
+    ids = np.random.RandomState(0).randint(0, 128, (3, 16)).astype(np.int32)
+    tt = np.zeros((3, 16), np.int32)
+    ref = np.asarray(model(paddle.to_tensor(ids),
+                           paddle.to_tensor(tt))._value)
+    return path, ids, tt, ref
+
+
+def test_named_io_from_signature(saved_bert):
+    path, ids, tt, ref = saved_bert
+    pred = inference.create_predictor(inference.Config(path))
+    assert pred.get_input_names() == ["input_ids", "token_type_ids"]
+    assert pred.get_output_names() == ["output_0"]
+    pred.get_input_handle("input_ids").copy_from_cpu(ids)
+    pred.get_input_handle("token_type_ids").copy_from_cpu(tt)
+    pred.run()
+    out = pred.get_output_handle("output_0").copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_batch_and_run_batch(saved_bert):
+    path, ids, tt, ref = saved_bert
+    pred = inference.create_predictor(inference.Config(path))
+    # the symbolic batch dim serves any size
+    out5 = pred.run([np.tile(ids, (2, 1))[:5], np.zeros((5, 16), np.int32)])
+    assert out5[0].shape[0] == 5
+    # multi-request batching: one executable call, per-request splits
+    reqs = [[ids[:1], tt[:1]], [ids[1:], tt[1:]]]
+    outs = pred.run_batch(reqs)
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0][0], ref[:1], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[1][0], ref[1:], rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_convert_on_load(saved_bert):
+    path, ids, tt, ref = saved_bert
+    cfg = inference.Config(path)
+    cfg.enable_bf16()
+    pred = inference.create_predictor(cfg)
+    out = pred.run([ids, tt])[0]
+    # bf16 weights: close but not identical
+    np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.1)
+    assert np.abs(out - ref).max() > 0
+
+
+def test_int8_convert_on_load(saved_bert):
+    path, ids, tt, ref = saved_bert
+    cfg = inference.Config(path)
+    cfg.enable_int8()
+    pred = inference.create_predictor(cfg)
+    out = pred.run([ids, tt])[0]
+    # weight-only per-channel int8: logits within coarse tolerance, and
+    # the top class agrees on every row
+    assert np.argmax(out, -1).tolist() == np.argmax(ref, -1).tolist()
+    np.testing.assert_allclose(out, ref, rtol=0.35, atol=0.35)
+
+
+def test_clone_shares_weights(saved_bert):
+    path, ids, tt, ref = saved_bert
+    pred = inference.create_predictor(inference.Config(path))
+    clone = pred.clone()
+    # independent handles
+    pred.get_input_handle("input_ids").copy_from_cpu(ids)
+    assert clone.get_input_handle("input_ids")._value is None
+    out = clone.run([ids, tt])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_clone_threaded_serving(saved_bert):
+    import threading
+
+    path, ids, tt, ref = saved_bert
+    base = inference.create_predictor(inference.Config(path))
+    results = {}
+
+    def serve(i):
+        p = base.clone()
+        results[i] = p.run([ids, tt])[0]
+
+    threads = [threading.Thread(target=serve, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(4):
+        np.testing.assert_allclose(results[i], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_layer_backed_int8_convert():
+    """Precision convert must work for live-Layer predictors too (review
+    finding): int8 weight-only via the registered weight_quantize math."""
+    cfg = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=1,
+                     num_attention_heads=2, intermediate_size=32,
+                     max_position_embeddings=32)
+    model = BertForSequenceClassification(cfg, num_classes=3)
+    model.eval()
+    ids = np.random.RandomState(1).randint(0, 64, (2, 8)).astype(np.int32)
+    ref = np.asarray(model(paddle.to_tensor(ids))._value)
+    c = inference.Config()
+    c.enable_int8()
+    pred = inference.Predictor(c, layer=model)
+    out = pred.run([ids])[0]
+    assert np.argmax(out, -1).tolist() == np.argmax(ref, -1).tolist()
+    assert np.abs(out - ref).max() > 0  # actually quantized
+
+
+def test_multi_output_layer_handles():
+    """Every output of a multi-output layer gets a reachable handle."""
+    from paddle_tpu import nn
+
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 2)
+            self.b = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    pred = inference.create_predictor(TwoHead())
+    x = np.random.randn(2, 4).astype(np.float32)
+    outs = pred.run([x])
+    assert len(outs) == 2
+    assert pred.get_output_names() == ["output_0", "output_1"]
+    assert pred.get_output_handle("output_1").copy_to_cpu().shape == (2, 3)
+
+
+def test_set_input_handle_coherent(saved_bert):
+    """set_input and handles share one feed path — no stale shadowing."""
+    path, ids, tt, ref = saved_bert
+    pred = inference.create_predictor(inference.Config(path))
+    pred.get_input_handle("input_ids").copy_from_cpu(np.zeros_like(ids))
+    pred.get_input_handle("token_type_ids").copy_from_cpu(tt)
+    pred.set_input("input_ids", ids)  # must override the handle feed
+    pred.run()
+    out = pred.get_output_handle("output_0").copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
