@@ -127,8 +127,19 @@ def launch(args=None) -> int:
 
     args = args if args is not None else parse_args()
     mgr = ElasticManager(nnodes=args.nnodes, max_restart=args.max_restart)
-    nnodes = mgr.min_nodes
     nproc = args.nproc_per_node
+    # single-host mode: one node, OR an elastic range driven entirely by
+    # this (rank-0, masterless) launcher — each "node" is then a local
+    # proc, which is the scale-down testbed.  Multi-launcher setups
+    # (explicit --master or --rank > 0) keep the min_nodes rendezvous
+    # semantics: scaling them requires a coordinated re-rendezvous.
+    single_host = (mgr.max_nodes == 1
+                   or (args.master is None and args.rank == 0
+                       and mgr.max_nodes > mgr.min_nodes))
+    # single-host elastic starts at FULL size and scales DOWN one node
+    # per failed generation until min_nodes (the reference manager's
+    # re-rendezvous-at-smaller-world path, fleet/elastic/manager.py:125)
+    nnodes = mgr.max_nodes if single_host else mgr.min_nodes
     world = nnodes * nproc
     master = args.master or "127.0.0.1:49178"
     base_port = 52700
@@ -136,7 +147,7 @@ def launch(args=None) -> int:
 
     shutdown_flag = {"requested": False, "kill": lambda: None}
     rdv_store = None
-    if nnodes == 1:
+    if single_host:
         endpoints = [f"127.0.0.1:{base_port + i}" for i in range(world)]
     else:
         # multi-node rendezvous over the native TCPStore hosted at
@@ -171,8 +182,9 @@ def launch(args=None) -> int:
             sys.stderr.write("launch: shutdown requested (SIGTERM); not "
                              "starting a new gang\n")
             return 0
-        codes = _run_gang(args, world, nproc, endpoints, master,
-                          mgr.restart_count, shutdown_flag)
+        codes = _run_gang(args, world, world if single_host else nproc,
+                          endpoints, master, mgr.restart_count,
+                          shutdown_flag)
         if shutdown_flag["requested"]:
             # intentional stop is a clean exit, not a failure
             sys.stderr.write("launch: shutdown requested (SIGTERM); not "
@@ -182,9 +194,18 @@ def launch(args=None) -> int:
         if status is ElasticStatus.COMPLETED:
             return 0
         if status is ElasticStatus.RESTART:
-            sys.stderr.write(
-                f"launch: worker failed (codes={codes}); elastic gang "
-                f"restart {mgr.restart_count}/{mgr.max_restart}\n")
+            if single_host and nnodes > mgr.min_nodes:
+                nnodes -= 1
+                world = nnodes * nproc
+                endpoints = endpoints[:world]
+                sys.stderr.write(
+                    f"launch: worker failed (codes={codes}); elastic "
+                    f"SCALE-DOWN re-rendezvous at world={world} "
+                    f"(restart {mgr.restart_count}/{mgr.max_restart})\n")
+            else:
+                sys.stderr.write(
+                    f"launch: worker failed (codes={codes}); elastic gang "
+                    f"restart {mgr.restart_count}/{mgr.max_restart}\n")
             continue
         code = next(c for c in codes if c)  # first failure wins
         sys.stderr.write(

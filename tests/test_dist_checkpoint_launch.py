@@ -75,3 +75,79 @@ def test_launcher_propagates_failure(tmp_path):
          "--log_dir", str(tmp_path / "logs"), str(script)],
         cwd="/root/repo", capture_output=True, text=True, timeout=120)
     assert r.returncode == 3
+
+
+def test_elastic_scale_down_resume(tmp_path):
+    """Elastic e2e with CHANGED world size (round-3, VERDICT r2 item 9):
+    3 workers; worker 1 dies after rank 0 writes a sharded checkpoint;
+    the manager re-rendezvous at world=2 (scale-down) and training
+    resumes from the checkpoint WITH resharding onto the smaller mesh."""
+    script = tmp_path / "elastic_worker.py"
+    ckpt = tmp_path / "ckpt"
+    flag = tmp_path / "saved.flag"
+    script.write_text(f"""
+import os, sys, time
+import numpy as np
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+ckpt = {str(ckpt)!r}
+flag = {str(flag)!r}
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=6"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import Shard
+
+data = np.arange(48, dtype=np.float32).reshape(12, 4)
+
+if world == 3:
+    if rank == 0:
+        mesh = dist.ProcessMesh(np.arange(3), ["x"])
+        t = dist.shard_tensor(paddle.to_tensor(data), mesh, [Shard(0)])
+        dist.save_state_dict({{"w": t, "step": 7}}, ckpt)
+        open(flag, "w").close()
+        time.sleep(60)  # hold the gang until worker 1 fails it
+    elif rank == 1:
+        for _ in range(1200):  # generous deadline for cold imports
+            if os.path.exists(flag):
+                sys.exit(21)  # the "killed" worker, AFTER the save landed
+            time.sleep(0.1)
+        sys.exit(0)  # checkpoint never appeared: finish clean so the
+        # outer assert fails on "no scale-down" instead of a bogus resume
+    else:
+        time.sleep(60)
+elif world == 2:
+    if rank == 0:
+        mesh = dist.ProcessMesh(np.arange(2), ["x"])
+        t = dist.shard_tensor(paddle.zeros([12, 4]), mesh, [Shard(0)])
+        sd = {{"w": t, "step": 0}}
+        dist.load_state_dict(sd, ckpt)
+        np.testing.assert_allclose(np.asarray(t._value), data)
+        assert sd["step"] == 7
+        # placement is the NEW 2-way mesh (resharded on load)
+        assert t._value.sharding.spec[0] == "x"
+        assert len(t._value.sharding.mesh.devices.flatten()) == 2
+        # one resumed training step
+        t.set_value(t._value * 0.5)
+        print(f"RESUMED_OK world={{world}} step=8")
+    sys.exit(0)
+else:
+    sys.exit(99)
+""")
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "2:3", "--nproc_per_node", "1", "--max_restart", "2",
+         "--log_dir", str(log_dir), str(script)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=300,
+        env={**os.environ,
+             "PYTHONPATH": "/root/repo" + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "SCALE-DOWN re-rendezvous at world=2" in r.stderr
+    gen1 = (log_dir / "workerlog.0.restart1").read_text()
+    assert "RESUMED_OK world=2" in gen1, gen1
