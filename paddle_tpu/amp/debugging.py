@@ -89,3 +89,29 @@ def low_precision_op_list():
     from ..ops import registry as _r
 
     return sorted(_r._LOW_PRECISION_OPS)
+
+
+def check_accuracy(actual, expected, dtype=None, err_msg=""):
+    """Tolerance-driven comparison using the FLAGS_accuracy_check_* knobs
+    (reference flags.cc accuracy_check_{rtol,atol}_{fp32,fp16,bf16}) — the
+    standard gate for low-precision vs fp32 parity runs."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..common import flags as _flags
+    from ..core.tensor import Tensor
+
+    a = np.asarray(actual._value if isinstance(actual, Tensor) else actual,
+                   np.float64)
+    e = np.asarray(expected._value if isinstance(expected, Tensor)
+                   else expected, np.float64)
+    if dtype is None:
+        src = actual._value if isinstance(actual, Tensor) else actual
+        dtype = getattr(src, "dtype", np.float32)
+    key = {"float16": "fp16", "bfloat16": "bf16"}.get(str(jnp.dtype(dtype)),
+                                                      "fp32")
+    tol = _flags.get_flags((f"FLAGS_accuracy_check_rtol_{key}",
+                            f"FLAGS_accuracy_check_atol_{key}"))
+    np.testing.assert_allclose(
+        a, e, rtol=tol[f"FLAGS_accuracy_check_rtol_{key}"],
+        atol=tol[f"FLAGS_accuracy_check_atol_{key}"], err_msg=err_msg)

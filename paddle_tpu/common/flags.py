@@ -336,3 +336,91 @@ define_flag("FLAGS_print_allocator_trace_info", False, "compat.")
 define_flag("FLAGS_npu_storage_format", False, "compat.")
 define_flag("FLAGS_set_to_1d", True,
             "compat: 0-d vs 1-d scalar semantics follow numpy/jax (0-d).")
+
+# ---- round 3: remaining behavior-critical flags from the reference's
+# paddle/common/flags.cc (the GPU/oneDNN/graph-store-only tail is ported
+# as documented compat no-ops; wired flags say what consumes them) ----
+
+def deterministic_enabled() -> bool:
+    """True when bit-stable math is requested — by the determinism flag
+    itself OR by auto-parallel align mode (consumer-side OR instead of a
+    hook: a nested set_flags inside a hook would break the atomic-
+    rollback guarantee above)."""
+    f = get_flags(("FLAGS_tpu_deterministic",
+                   "FLAGS_enable_auto_parallel_align_mode"))
+    return bool(f["FLAGS_tpu_deterministic"]
+                or f["FLAGS_enable_auto_parallel_align_mode"])
+
+
+define_flag("FLAGS_enable_auto_parallel_align_mode", False,
+            "Alignment-debug mode for auto-parallel runs (wired: "
+            "deterministic_enabled() ORs it with FLAGS_tpu_deterministic "
+            "so dp/mp/pp recompositions are bit-comparable; reference "
+            "uses it to align dygraph vs static).")
+define_flag("FLAGS_alloc_fill_value", -1,
+            "When >= 0, paddle.empty/empty_like fill new buffers with this "
+            "value instead of zeros (wired: ops/yaml empty impls) — the "
+            "uninitialized-memory bug shaker (reference init_allocated_mem "
+            "cousin).")
+define_flag("FLAGS_logging_pir_py_code_dir", "",
+            "When set, jit.to_static dumps each traced function's "
+            "StableHLO text into this directory (wired: jit/__init__.py) — "
+            "the analog of dumping PIR python code.")
+define_flag("FLAGS_logging_trunc_pir_py_code", False,
+            "Truncate prior IR dumps instead of appending (wired with "
+            "FLAGS_logging_pir_py_code_dir).")
+define_flag("FLAGS_accuracy_check_rtol_fp32", 1e-5,
+            "Tolerances for amp.debugging.check_accuracy comparisons "
+            "(wired: amp/debugging.py).")
+define_flag("FLAGS_accuracy_check_atol_fp32", 1e-6, "See rtol_fp32 (wired).")
+define_flag("FLAGS_accuracy_check_rtol_fp16", 1e-3, "See rtol_fp32 (wired).")
+define_flag("FLAGS_accuracy_check_atol_fp16", 1e-3, "See rtol_fp32 (wired).")
+define_flag("FLAGS_accuracy_check_rtol_bf16", 1e-2, "See rtol_fp32 (wired).")
+define_flag("FLAGS_accuracy_check_atol_bf16", 1e-2, "See rtol_fp32 (wired).")
+define_flag("FLAGS_pir_debug", False,
+            "Print jaxpr of each to_static trace to stderr (wired: "
+            "jit/__init__.py).")
+define_flag("FLAGS_async_trace_count", 0,
+            "compat: host->device dispatch is PJRT-async by default.")
+define_flag("FLAGS_prim_check_ops", False,
+            "compat: jax primitives are closed under tracing; no "
+            "decomposition completeness check needed.")
+define_flag("FLAGS_disable_dyshape_in_train", False,
+            "compat: jit shapes are static per specialization already.")
+define_flag("FLAGS_enable_cse_in_dy2st", True,
+            "compat: XLA always runs CSE.")
+define_flag("FLAGS_enable_fuse_parallel_matmul_pass", True,
+            "compat: XLA fusion subsumes the pass.")
+define_flag("FLAGS_enable_fusion_fallback", False,
+            "compat: Pallas kernels fall back per-op (incubate.nn).")
+define_flag("FLAGS_pir_apply_inplace_pass", True,
+            "compat: XLA buffer donation/aliasing replaces inplace passes.")
+define_flag("FLAGS_pir_apply_shape_optimization_pass", True, "compat.")
+define_flag("FLAGS_enable_pir_with_pt_in_dy2st", False, "compat.")
+define_flag("FLAGS_enable_pir_in_executor_trace_run", False, "compat.")
+define_flag("FLAGS_logging_pir_py_code_dump_symbolic_dims", False, "compat.")
+define_flag("FLAGS_enable_collect_shape", False,
+            "compat: shape collection is trace-time in jax.")
+define_flag("FLAGS_cudnn_exhaustive_search_times", 0,
+            "compat: see FLAGS_use_autotune.")
+define_flag("FLAGS_cudnn_cache_saturation_count", 1, "compat.")
+define_flag("FLAGS_enable_cudnn_frontend", False, "compat: no cuDNN.")
+define_flag("FLAGS_batch_norm_use_miopen", False, "compat: no MIOpen.")
+define_flag("FLAGS_run_kp_kernel", False, "compat: no Kunlun XPU here.")
+define_flag("FLAGS_trt_ibuilder_cache", False, "compat: no TensorRT.")
+define_flag("FLAGS_use_cuda_malloc_async_allocator", False,
+            "compat: PJRT owns the allocator.")
+define_flag("FLAGS_custom_device_mem_record", False, "compat.")
+define_flag("FLAGS_enable_blaslt_global_search", False,
+            "compat: see FLAGS_use_autotune.")
+define_flag("FLAGS_cublaslt_device_best_config", "", "compat.")
+define_flag("FLAGS_tracer_onednn_ops_on", "", "compat: no oneDNN tracer.")
+define_flag("FLAGS_tracer_onednn_ops_off", "", "compat.")
+define_flag("FLAGS_static_runtime_data_save_path", "", "compat.")
+define_flag("FLAGS_use_fast_math", False,
+            "compat: use FLAGS_tpu_default_matmul_precision for the "
+            "speed/accuracy trade.")
+define_flag("FLAGS_gemm_use_half_precision_compute_type", False,
+            "compat: MXU accumulates fp32 regardless.")
+define_flag("FLAGS_enable_async_trace", False, "compat.")
+define_flag("FLAGS_use_mkldnn", False, "compat: no oneDNN.")

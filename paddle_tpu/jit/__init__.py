@@ -33,6 +33,9 @@ def _wrap(x):
     return Tensor(x) if isinstance(x, (jax.Array, jax.core.Tracer)) else x
 
 
+_IR_DUMP_COUNTER = 0
+
+
 class TracedLayer:
     """A compiled wrapper over a Layer or function.
 
@@ -83,6 +86,30 @@ class TracedLayer:
                 self, "_ir_printed", False):
             self._ir_printed = True
             print(self.stablehlo(*args, **kwargs))
+        if _flags.get_flag("FLAGS_pir_debug") and not getattr(
+                self, "_jaxpr_printed", False):
+            self._jaxpr_printed = True
+            import sys as _sys
+
+            print(self.jaxpr(*args, **kwargs), file=_sys.stderr)
+        dump_dir = _flags.get_flag("FLAGS_logging_pir_py_code_dir")
+        if dump_dir and not getattr(self, "_ir_dumped", False):
+            # the PIR-python-code dump analog: one StableHLO file per
+            # traced callable (truncated or appended per
+            # FLAGS_logging_trunc_pir_py_code)
+            self._ir_dumped = True
+            os.makedirs(dump_dir, exist_ok=True)
+            tgt = getattr(self._target, "__name__",
+                          type(self._target).__name__)
+            # unique file per traced callable: same-named layers must not
+            # clobber each other's dumps
+            global _IR_DUMP_COUNTER
+            _IR_DUMP_COUNTER += 1
+            fname = f"{tgt}.{_IR_DUMP_COUNTER}.stablehlo.mlir"
+            mode = "w" if _flags.get_flag(
+                "FLAGS_logging_trunc_pir_py_code") else "a"
+            with open(os.path.join(dump_dir, fname), mode) as f:
+                f.write(self.stablehlo(*args, **kwargs) + "\n")
         if self._is_layer:
             state = self._target.functional_state()
             out = self._pure(state, uargs, ukwargs)
@@ -101,6 +128,17 @@ class TracedLayer:
     def stablehlo(self, *args, **kwargs) -> str:
         """The compiled module's StableHLO text (the PIR-program analog)."""
         return str(self.lower(*args, **kwargs).compiler_ir(dialect="stablehlo"))
+
+    def jaxpr(self, *args, **kwargs) -> str:
+        """The traced jaxpr text (FLAGS_pir_debug's dump — the closest
+        analog of printing the PIR program pre-lowering)."""
+        leaf = lambda x: isinstance(x, Tensor)  # noqa: E731
+        uargs = jax.tree_util.tree_map(_unwrap, args, is_leaf=leaf)
+        ukwargs = jax.tree_util.tree_map(_unwrap, kwargs, is_leaf=leaf)
+        if self._is_layer:
+            return str(jax.make_jaxpr(self._pure.__wrapped__)(
+                self._target.functional_state(), uargs, ukwargs))
+        return str(jax.make_jaxpr(self._pure.__wrapped__)(uargs, ukwargs))
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,

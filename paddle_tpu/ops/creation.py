@@ -48,11 +48,17 @@ def full_like(x, fill_value, dtype=None):
 
 
 def empty(shape, dtype="float32"):
-    return Tensor(jnp.zeros(shape, dtype=_d(dtype)))
+    from .yaml._impl import empty_impl
+
+    # honors FLAGS_alloc_fill_value (debug fill; see flags.py)
+    return Tensor(empty_impl(shape, str(_d(dtype))))
 
 
 def empty_like(x, dtype=None):
-    return zeros_like(x, dtype)
+    from .yaml._impl import empty_like_impl
+
+    v = x._value if hasattr(x, "_value") else x
+    return Tensor(empty_like_impl(v, dtype and str(_d(dtype))))
 
 
 def arange(start=0, end=None, step=1, dtype=None):

@@ -431,11 +431,33 @@ def _fast_dispatch(op: OpDef, args):
 def dispatch(name: str, *args, **kwargs):
     """Execute op ``name`` eagerly with tape recording."""
     op = get_op(name)
-    if (not kwargs and op.cacheable and not _OP_STATS_STACK
-            and _fast_flags_ok()):
+    recording = _profiler_recording()
+    if (not recording and not kwargs and op.cacheable
+            and not _OP_STATS_STACK and _fast_flags_ok()):
         out = _fast_dispatch(op, args)
         if out is not None:
             return out
+    if recording:
+        from .. import profiler as _prof
+
+        with _prof.RecordEvent(name, "Operator"):
+            return _dispatch_slow(op, name, args, kwargs)
+    return _dispatch_slow(op, name, args, kwargs)
+
+
+_PROF_RECORDING = None
+
+
+def _profiler_recording() -> bool:
+    global _PROF_RECORDING
+    if _PROF_RECORDING is None:
+        from .. import profiler as _prof
+
+        _PROF_RECORDING = _prof._recording
+    return _PROF_RECORDING[0]
+
+
+def _dispatch_slow(op, name: str, args, kwargs):
 
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     leaves = _amp_cast_leaves(op, leaves)

@@ -2044,3 +2044,18 @@ def reduce_scatter_op(x, ring_id=0, nranks=1):
     out = _T(jnp.zeros_like(parts[0]._value))
     C.reduce_scatter(out, parts)
     return out._value
+
+
+def empty_impl(shape, dtype="float32"):
+    """Uninitialized-memory contract; FLAGS_alloc_fill_value >= 0 fills
+    new buffers with the value (the init_allocated_mem debug shaker)."""
+    from ...common import flags as _flags
+
+    fv = _flags.get_flag("FLAGS_alloc_fill_value")
+    if fv >= 0:
+        return jnp.full(tuple(shape), fv, jnp.dtype(dtype))
+    return jnp.zeros(tuple(shape), jnp.dtype(dtype))
+
+
+def empty_like_impl(x, dtype=None):
+    return empty_impl(x.shape, dtype or x.dtype)

@@ -126,14 +126,69 @@ class Profiler:
     export = export_chrome_tracing
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
-        agg: Dict[str, float] = {}
-        for e in _host_events:
-            agg[e["name"]] = agg.get(e["name"], 0.0) + e["dur"]
-        lines = ["name\ttotal_us"]
-        for name, dur in sorted(agg.items(), key=lambda kv: -kv[1]):
-            lines.append(f"{name}\t{dur:.1f}")
-        return "\n".join(lines)
+                time_unit="ms", top_n: int = 30):
+        """Aggregated statistics table (the profiler_statistic.py analog:
+        python/paddle/profiler/profiler_statistic.py) — per-event-name
+        calls / total / avg / max / min and share of the profiled span,
+        sorted by total self time."""
+        return summarize_events(_host_events, time_unit=time_unit,
+                                top_n=top_n)
+
+
+def summarize_events(events, time_unit="ms", top_n: int = 30) -> str:
+    """Build the top-N-by-SELF-time table from chrome-trace-style event
+    dicts (ph == 'X'): nested span durations are subtracted from their
+    parent (a RecordEvent wrapping ten op spans reports only its own
+    overhead), so per-name ratios sum to <= 100% of the profiled wall
+    span.  Also works on an EXPORTED trace: ``summarize_chrome_trace``."""
+    div = {"s": 1e6, "ms": 1e3, "us": 1.0}[time_unit]
+    spans = sorted((e for e in events if e.get("ph") == "X"),
+                   key=lambda e: (e["ts"], -e["dur"]))
+    # interval sweep: a span starting inside the currently-open span is
+    # its child — subtract the child's (inclusive) duration from the
+    # parent's self time (direct children only; grandchildren already
+    # reduced the child)
+    self_time = [e["dur"] for e in spans]
+    open_stack: list = []
+    lo, hi = float("inf"), 0.0
+    for i, e in enumerate(spans):
+        ts, dur = e["ts"], e["dur"]
+        while open_stack and ts >= spans[open_stack[-1]]["ts"] \
+                + spans[open_stack[-1]]["dur"] - 1e-9:
+            open_stack.pop()
+        if open_stack:
+            self_time[open_stack[-1]] -= dur
+        open_stack.append(i)
+        lo = min(lo, ts)
+        hi = max(hi, ts + dur)
+    stats: Dict[str, list] = {}
+    for i, e in enumerate(spans):
+        st = max(self_time[i], 0.0)
+        s = stats.setdefault(e["name"], [0, 0.0, 0.0, float("inf")])
+        s[0] += 1
+        s[1] += st
+        s[2] = max(s[2], st)
+        s[3] = min(s[3], st)
+    wall = max(hi - lo, 1e-9)
+    header = (f"{'Name':<36}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+              f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"
+              f"{'Min(' + time_unit + ')':>12}{'Ratio(%)':>10}")
+    lines = ["-" * len(header), header, "-" * len(header)]
+    rows = sorted(stats.items(), key=lambda kv: -kv[1][1])[:top_n]
+    for name, (calls, total, mx, mn) in rows:
+        lines.append(f"{name[:35]:<36}{calls:>8}{total / div:>14.3f}"
+                     f"{total / calls / div:>12.3f}{mx / div:>12.3f}"
+                     f"{mn / div:>12.3f}{100.0 * total / wall:>10.2f}")
+    lines.append("-" * len(header))
+    return "\n".join(lines)
+
+
+def summarize_chrome_trace(path: str, time_unit="ms", top_n: int = 30) -> str:
+    """Summary table from an exported chrome trace file."""
+    with open(path) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    return summarize_events(events, time_unit=time_unit, top_n=top_n)
 
 
 class Timer:

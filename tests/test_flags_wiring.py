@@ -183,3 +183,105 @@ def test_tcp_store_timeout_flag_default():
 
     sig = inspect.signature(TCPStore.__init__)
     assert sig.parameters["timeout"].default is None  # resolved from flag
+
+
+def test_alloc_fill_value_wiring():
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"FLAGS_alloc_fill_value": 7})
+    try:
+        t = paddle.empty([2, 3])
+        np.testing.assert_array_equal(np.asarray(t._value),
+                                      np.full((2, 3), 7.0, np.float32))
+    finally:
+        paddle.set_flags({"FLAGS_alloc_fill_value": -1})
+    t0 = paddle.empty([2, 3])
+    np.testing.assert_array_equal(np.asarray(t0._value), np.zeros((2, 3)))
+
+
+def test_align_mode_forces_determinism():
+    import paddle_tpu as paddle
+    from paddle_tpu.common.flags import deterministic_enabled
+
+    assert not deterministic_enabled()
+    try:
+        paddle.set_flags({"FLAGS_enable_auto_parallel_align_mode": True})
+        assert deterministic_enabled()
+    finally:
+        paddle.set_flags({"FLAGS_enable_auto_parallel_align_mode": False})
+    assert not deterministic_enabled()
+
+
+def test_pir_code_dump_dir(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    d = str(tmp_path / "irdump")
+    paddle.set_flags({"FLAGS_logging_pir_py_code_dir": d,
+                      "FLAGS_logging_trunc_pir_py_code": True})
+    try:
+        net = nn.Linear(4, 2)
+        traced = paddle.jit.to_static(net)
+        traced(paddle.rand([3, 4]))
+        import os as _os
+
+        files = _os.listdir(d)
+        assert files, "no IR dump written"
+        text = open(_os.path.join(d, files[0])).read()
+        assert "stablehlo" in text or "module" in text
+    finally:
+        paddle.set_flags({"FLAGS_logging_pir_py_code_dir": ""})
+
+
+def test_accuracy_check_flags():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from paddle_tpu.amp.debugging import check_accuracy
+
+    a = np.ones((4,), np.float32)
+    # bf16 tolerance accepts a 1% wobble; fp32 must reject it
+    check_accuracy(a * 1.005, a, dtype=jnp.bfloat16)
+    with _pytest.raises(AssertionError):
+        check_accuracy(a * 1.005, a, dtype=jnp.float32)
+
+
+def test_profiler_summary_table():
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+
+    a = paddle.rand([16, 16])
+    with profiler.Profiler(timer_only=True) as p:
+        for _ in range(3):
+            b = a + a
+        with profiler.RecordEvent("outer_step"):
+            c = a @ a
+    table = p.summary(top_n=10)
+    assert "Calls" in table and "Ratio(%)" in table
+    assert "add" in table and "outer_step" in table
+    # chrome-trace summarization round-trips
+    import tempfile, os as _os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = _os.path.join(d, "t.json")
+        p.export_chrome_tracing(path)
+        t2 = profiler.summarize_chrome_trace(path, top_n=5)
+        assert "add" in t2
+
+
+def test_profiler_summary_self_time():
+    """Nested spans report SELF time: a wrapper around op spans must not
+    double-count its children (ratios sum <= ~100%)."""
+    from paddle_tpu.profiler import summarize_events
+
+    events = [
+        {"name": "step", "ph": "X", "ts": 0.0, "dur": 100.0},
+        {"name": "op_a", "ph": "X", "ts": 10.0, "dur": 40.0},
+        {"name": "op_b", "ph": "X", "ts": 60.0, "dur": 30.0},
+    ]
+    table = summarize_events(events, time_unit="us")
+    lines = {l.split()[0]: l.split() for l in table.splitlines()
+             if l and not l.startswith("-") and "Name" not in l}
+    assert float(lines["step"][2]) == 30.0   # 100 - 40 - 30 self
+    assert float(lines["op_a"][2]) == 40.0
+    assert float(lines["op_b"][2]) == 30.0
