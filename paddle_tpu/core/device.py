@@ -49,6 +49,16 @@ def _platform_matches(platform: str, device_type: str) -> bool:
     if device_type == "tpu":
         # 'axon' is a tunneled TPU platform; treat any accelerator as tpu
         return platform in ("tpu", "axon")
+    # registered custom device types resolve through the plugin registry
+    # (device/custom.py — the phi custom-device ABI analog)
+    try:
+        from ..device.custom import resolve as _custom_resolve
+
+        hit = _custom_resolve(device_type)
+        if hit is not None:
+            return platform == hit[0]
+    except ImportError:
+        pass
     return platform == device_type
 
 

@@ -5,6 +5,9 @@ from ..core.device import (
     CPUPlace, Place, TPUPlace, current_place, device_count, get_device,
     is_compiled_with_tpu, set_device,
 )
+from .custom import (custom_devices, get_all_custom_device_type,
+                     is_compiled_with_custom_device, register_custom_device,
+                     unregister_custom_device)
 
 
 def synchronize(device=None):

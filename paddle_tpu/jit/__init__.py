@@ -44,12 +44,17 @@ class TracedLayer:
     """
 
     def __init__(self, fn_or_layer, donate_params: bool = False,
-                 static_argnames: Optional[Sequence[str]] = None):
+                 static_argnames: Optional[Sequence[str]] = None,
+                 full_graph: bool = False):
         self._target = fn_or_layer
         self._is_layer = isinstance(fn_or_layer, Layer)
         self._static_argnames = tuple(static_argnames or ())
         self._cache: Dict[Any, Any] = {}
         self._compiled = None
+        # graph-break policy (reference SOT default: fall back to eager;
+        # full_graph=True makes a break an error, jit.to_static kwarg)
+        self._allow_fallback = not full_graph
+        self._fell_back = False
         if self._is_layer:
             layer = fn_or_layer
 
@@ -82,39 +87,77 @@ class TracedLayer:
                                          is_leaf=lambda x: isinstance(x, Tensor))
         from ..common import flags as _flags
 
-        if _flags.get_flag("FLAGS_print_ir") and not getattr(
-                self, "_ir_printed", False):
-            self._ir_printed = True
-            print(self.stablehlo(*args, **kwargs))
-        if _flags.get_flag("FLAGS_pir_debug") and not getattr(
-                self, "_jaxpr_printed", False):
-            self._jaxpr_printed = True
-            import sys as _sys
+        if self._fell_back:
+            return self._target(*args, **kwargs)
+        # debug IR dumps trace the callable too — a graph-breaking target
+        # must reach the fallback below, not crash inside a dump, so the
+        # dumps themselves swallow tracer errors
+        try:
+            if _flags.get_flag("FLAGS_print_ir") and not getattr(
+                    self, "_ir_printed", False):
+                self._ir_printed = True
+                print(self.stablehlo(*args, **kwargs))
+            if _flags.get_flag("FLAGS_pir_debug") and not getattr(
+                    self, "_jaxpr_printed", False):
+                self._jaxpr_printed = True
+                import sys as _sys
 
-            print(self.jaxpr(*args, **kwargs), file=_sys.stderr)
-        dump_dir = _flags.get_flag("FLAGS_logging_pir_py_code_dir")
-        if dump_dir and not getattr(self, "_ir_dumped", False):
-            # the PIR-python-code dump analog: one StableHLO file per
-            # traced callable (truncated or appended per
-            # FLAGS_logging_trunc_pir_py_code)
-            self._ir_dumped = True
-            os.makedirs(dump_dir, exist_ok=True)
+                print(self.jaxpr(*args, **kwargs), file=_sys.stderr)
+            dump_dir = _flags.get_flag("FLAGS_logging_pir_py_code_dir")
+            if dump_dir and not getattr(self, "_ir_dumped", False):
+                # the PIR-python-code dump analog: one StableHLO file per
+                # traced callable (truncated or appended per
+                # FLAGS_logging_trunc_pir_py_code)
+                self._ir_dumped = True
+                os.makedirs(dump_dir, exist_ok=True)
+                tgt = getattr(self._target, "__name__",
+                              type(self._target).__name__)
+                # unique file per traced callable: same-named layers must
+                # not clobber each other's dumps
+                global _IR_DUMP_COUNTER
+                _IR_DUMP_COUNTER += 1
+                fname = f"{tgt}.{_IR_DUMP_COUNTER}.stablehlo.mlir"
+                mode = "w" if _flags.get_flag(
+                    "FLAGS_logging_trunc_pir_py_code") else "a"
+                with open(os.path.join(dump_dir, fname), mode) as f:
+                    f.write(self.stablehlo(*args, **kwargs) + "\n")
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError):
+            pass  # the compiled-call path below decides fallback vs raise
+        try:
+            if self._is_layer:
+                state = self._target.functional_state()
+                out = self._pure(state, uargs, ukwargs)
+            else:
+                out = self._pure(uargs, ukwargs)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # GRAPH BREAK: data-dependent host control flow the tracer
+            # cannot capture.  The reference's SOT handles this with
+            # bytecode-level graph breaks (python/paddle/jit/sot/
+            # translate.py:31, pybind/sot/eval_frame.c); the function-
+            # level translation is: warn once, run this callable eagerly
+            # from now on (dygraph fallback) instead of erroring out.
+            if not self._allow_fallback:
+                raise
+            self._fell_back = True
+            import warnings
+
             tgt = getattr(self._target, "__name__",
                           type(self._target).__name__)
-            # unique file per traced callable: same-named layers must not
-            # clobber each other's dumps
-            global _IR_DUMP_COUNTER
-            _IR_DUMP_COUNTER += 1
-            fname = f"{tgt}.{_IR_DUMP_COUNTER}.stablehlo.mlir"
-            mode = "w" if _flags.get_flag(
-                "FLAGS_logging_trunc_pir_py_code") else "a"
-            with open(os.path.join(dump_dir, fname), mode) as f:
-                f.write(self.stablehlo(*args, **kwargs) + "\n")
-        if self._is_layer:
-            state = self._target.functional_state()
-            out = self._pure(state, uargs, ukwargs)
-        else:
-            out = self._pure(uargs, ukwargs)
+            warnings.warn(
+                f"to_static({tgt}): tracing hit data-dependent Python "
+                f"control flow ({type(e).__name__}); falling back to "
+                "eager execution for this callable. NOTE: host side "
+                "effects before the break ran during tracing AND run "
+                "again eagerly on this call. Rewrite the branch with "
+                "lax.cond/where, or pass full_graph=True to make this "
+                "an error.", stacklevel=2)
+            return self._target(*args, **kwargs)
         return jax.tree_util.tree_map(_wrap, out)
 
     # introspection ---------------------------------------------------------
@@ -142,12 +185,18 @@ class TracedLayer:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
-              full_graph=True, **kwargs):
+              full_graph=False, **kwargs):
     """Analog of @paddle.jit.to_static (python/paddle/jit/api.py:195).
-    backend is accepted for compatibility; XLA is always the compiler."""
+    backend is accepted for compatibility; XLA is always the compiler.
+
+    ``full_graph=False`` (the reference's SOT default): data-dependent
+    Python control flow that breaks the trace falls back to eager for
+    that callable with a warning — the function-level translation of
+    SOT's bytecode graph breaks.  ``full_graph=True`` makes a break an
+    error."""
 
     def decorate(fn):
-        traced = TracedLayer(fn)
+        traced = TracedLayer(fn, full_graph=full_graph)
         if isinstance(fn, Layer):
             return traced
         # carry the function's identity onto the wrapper instance (wraps on

@@ -285,3 +285,61 @@ def test_profiler_summary_self_time():
     assert float(lines["step"][2]) == 30.0   # 100 - 40 - 30 self
     assert float(lines["op_a"][2]) == 40.0
     assert float(lines["op_b"][2]) == 30.0
+
+
+def test_custom_device_plugin_abi():
+    """Framework-level custom-device registration (phi/capi analog over
+    PJRT): a registered type resolves through set_device and the
+    introspection API; a plugin path lands in PJRT discovery env."""
+    import paddle_tpu as paddle
+    from paddle_tpu import device as D
+
+    assert not D.is_compiled_with_custom_device("mydev")
+    D.register_custom_device("mydev", platform="cpu")  # alias binding
+    try:
+        assert D.is_compiled_with_custom_device("mydev")
+        assert "mydev" in D.get_all_custom_device_type()
+        place = paddle.set_device("mydev:0")
+        assert place.device_type == "mydev"
+        # the Place resolves to a real jax device of the bound platform
+        assert place.jax_device.platform == "cpu"
+        assert len(D.custom_devices("mydev")) >= 1
+        t = paddle.to_tensor(np.ones((2,), np.float32))
+        assert np.asarray((t + t)._value).sum() == 4.0
+    finally:
+        D.unregister_custom_device("mydev")
+        paddle.set_device("cpu")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        D.register_custom_device("bad:name", platform="cpu")
+    with _pytest.raises(ValueError):
+        D.register_custom_device("x")  # neither path nor platform
+
+
+def test_custom_device_plugin_path_env(tmp_path):
+    from paddle_tpu import device as D
+
+    fake = tmp_path / "libfake_pjrt.so"
+    fake.write_bytes(b"\x7fELF")
+    import os as _os
+
+    saved = _os.environ.get("PJRT_NAMES_AND_LIBRARY_PATHS")
+    try:
+        D.register_custom_device("fakedev", library_path=str(fake))
+        assert f"fakedev:{fake}" in _os.environ[
+            "PJRT_NAMES_AND_LIBRARY_PATHS"]
+        # unregister cleans the discovery env (no stale plugin binding)
+        D.unregister_custom_device("fakedev")
+        assert "fakedev" not in _os.environ.get(
+            "PJRT_NAMES_AND_LIBRARY_PATHS", "")
+    finally:
+        D.unregister_custom_device("fakedev")
+        if saved is None:
+            _os.environ.pop("PJRT_NAMES_AND_LIBRARY_PATHS", None)
+        else:
+            _os.environ["PJRT_NAMES_AND_LIBRARY_PATHS"] = saved
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        D.register_custom_device("cpu", platform="tpu")  # builtin guard

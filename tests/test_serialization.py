@@ -139,3 +139,48 @@ def test_predictor_multi_input(tmp_path):
     (got,) = pred.run()
     want = np.asarray(net(paddle.to_tensor(x), paddle.to_tensor(y))._value)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_to_static_graph_break_fallback():
+    """Data-dependent Python control flow (untraceable) falls back to
+    eager with a warning — the function-level SOT graph-break story
+    (reference python/paddle/jit/sot/translate.py:31)."""
+    import warnings
+
+    import paddle_tpu as paddle
+
+    def branchy(x):
+        # host-side bool() on a traced value: a guaranteed graph break
+        if float((x.sum())._value if hasattr(x.sum(), "_value")
+                 else x.sum()) > 0:
+            return x * 2
+        return x - 1
+
+    traced = paddle.jit.to_static(branchy)
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = traced(x)
+        assert any("falling back to eager" in str(m.message) for m in w), \
+            [str(m.message) for m in w]
+    np.testing.assert_allclose(np.asarray(out._value), 2 * np.ones((2, 2)))
+    # subsequent calls stay eager, no repeat warning storm
+    out2 = traced(paddle.to_tensor(-np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(np.asarray(out2._value),
+                               -np.ones((2, 2)) - 1)
+
+
+def test_to_static_full_graph_raises():
+    import jax
+    import pytest as _pytest
+
+    import paddle_tpu as paddle
+
+    def branchy(x):
+        if float(np.asarray(x.sum()._value)) > 0:
+            return x * 2
+        return x
+
+    traced = paddle.jit.to_static(branchy, full_graph=True)
+    with _pytest.raises(jax.errors.JAXTypeError):
+        traced(paddle.to_tensor(np.ones((2, 2), np.float32)))
