@@ -56,9 +56,12 @@ def register_custom_device(name: str, library_path: Optional[str] = None,
             raise FileNotFoundError(library_path)
         entry = f"{name}:{library_path}"
         cur = os.environ.get("PJRT_NAMES_AND_LIBRARY_PATHS", "")
-        if entry not in cur.split(","):
-            os.environ["PJRT_NAMES_AND_LIBRARY_PATHS"] = \
-                f"{cur},{entry}" if cur else entry
+        # replace any existing binding for this name: a stale .so first
+        # in discovery order would win over the new one
+        kept = [e for e in cur.split(",")
+                if e and not e.startswith(f"{name}:")]
+        os.environ["PJRT_NAMES_AND_LIBRARY_PATHS"] = \
+            ",".join(kept + [entry])
         platform = name
     _CUSTOM_DEVICES[name] = {"platform": platform,
                              "library_path": library_path}

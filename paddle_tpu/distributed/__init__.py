@@ -33,6 +33,7 @@ from . import ps
 from .checkpoint import load_state_dict, save_state_dict
 from .spawn import spawn
 from .auto_parallel import (DistModel, ShardingStage1, ShardingStage2,
+                            moe_global_mesh_tensor, moe_sub_mesh_tensors,
                             ShardingStage3, Strategy, dtensor_from_local,
                             dtensor_to_local, get_placements, is_dist,
                             reshard, shard_dataloader, shard_layer,

@@ -5,6 +5,8 @@ api.py for the mapping table.
 """
 
 from .api import (
+    moe_global_mesh_tensor,
+    moe_sub_mesh_tensors,
     sharding_constraint,
     ShardingStage1,
     ShardingStage2,

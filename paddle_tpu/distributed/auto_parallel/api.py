@@ -453,3 +453,104 @@ def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=Fa
                      input_keys=None) -> ShardDataloader:
     return ShardDataloader(dataloader, meshes, shard_dims, input_keys,
                            is_dataset_splitted)
+
+
+# --------------------------------------------------------------------------
+# MoE sub-mesh APIs (reference: auto_parallel/api.py:439 moe_global_mesh_
+# tensor, :580 moe_sub_mesh_tensors — dygraph MoE across sub-meshes, where
+# experts live on slices of the global mesh along the expert mesh dim)
+# --------------------------------------------------------------------------
+
+def _sub_meshes_and_local_placements(mesh, placements, local_mesh_dim):
+    """Slice the global mesh along ``local_mesh_dim``: one sub-mesh per
+    index, with that mesh dim's placement removed from the local list."""
+    jm = _as_jax_mesh(mesh)
+    names = list(jm.axis_names)
+    local_mesh_dim = local_mesh_dim % len(names)
+    sub_names = tuple(n for j, n in enumerate(names) if j != local_mesh_dim)
+    subs = []
+    for i in range(jm.devices.shape[local_mesh_dim]):
+        grid = np.take(jm.devices, i, axis=local_mesh_dim)
+        subs.append(Mesh(grid.reshape([s for j, s in
+                                       enumerate(jm.devices.shape)
+                                       if j != local_mesh_dim] or [1]),
+                         sub_names or ("_",)))
+    placements = list(placements or [])
+    while len(placements) < len(names):
+        placements.append(Replicate())
+    split_p = placements[local_mesh_dim]
+    if isinstance(split_p, Partial):
+        raise NotImplementedError(
+            "moe_sub_mesh_tensors over a Partial mesh dim: resolve the "
+            "pending sum first (reshard)")
+    local_placements = [p for j, p in enumerate(placements)
+                        if j != local_mesh_dim]
+    return subs, local_placements, split_p, local_mesh_dim
+
+
+def moe_sub_mesh_tensors(dist_tensor, global_mesh=None, local_mesh_dim=-1,
+                         global_placements=None):
+    """Split ``dist_tensor`` into its per-sub-mesh local parts along
+    ``local_mesh_dim`` (reference auto_parallel/api.py:580): Shard over
+    that mesh dim -> tensor-axis slices; Replicate -> full copies.  Each
+    part is placed on its sub-mesh with the remaining placements.
+    ``global_mesh``/``global_placements`` default to the dist tensor's
+    own mesh/placements (reference behavior)."""
+    if global_mesh is None:
+        global_mesh = get_process_mesh(dist_tensor)
+        if global_mesh is None:
+            raise ValueError("moe_sub_mesh_tensors: dist_tensor carries no "
+                             "mesh; pass global_mesh explicitly")
+    if global_placements is None:
+        global_placements = get_placements(dist_tensor)
+    subs, local_placements, split_p, local_mesh_dim = \
+        _sub_meshes_and_local_placements(global_mesh, global_placements,
+                                         local_mesh_dim)
+    v = dist_tensor._value if isinstance(dist_tensor, Tensor) \
+        else jnp.asarray(dist_tensor)
+    n = len(subs)
+    outs = []
+    for i, sub in enumerate(subs):
+        if isinstance(split_p, Shard):
+            d = split_p.get_dim()
+            if v.shape[d] % n:
+                raise ValueError(
+                    f"moe_sub_mesh_tensors: dim {d} (size {v.shape[d]}) "
+                    f"not divisible by {n} sub-meshes — slicing would "
+                    "silently drop trailing entries")
+            size = v.shape[d] // n
+            piece = jax.lax.slice_in_dim(v, i * size, (i + 1) * size, axis=d)
+        else:
+            piece = v
+        sharding, _ = _sharding_for(sub, local_placements, piece.ndim)
+        outs.append(Tensor(jax.device_put(piece, sharding)))
+    return outs
+
+
+def moe_global_mesh_tensor(local_tensor_list, mesh, placements,
+                           local_mesh_dim=-1):
+    """Inverse of :func:`moe_sub_mesh_tensors` (reference
+    auto_parallel/api.py:439): reassemble per-sub-mesh locals into one
+    tensor on the global mesh — concat along the sharded tensor axis, or
+    verify-and-take-first for a replicated split dim."""
+    subs, _local_placements, split_p, local_mesh_dim = \
+        _sub_meshes_and_local_placements(mesh, placements, local_mesh_dim)
+    if len(local_tensor_list) != len(subs):
+        raise ValueError(
+            f"got {len(local_tensor_list)} local tensors for "
+            f"{len(subs)} sub-meshes along mesh dim {local_mesh_dim}")
+    # locals live on DISJOINT device sets (their sub-meshes): pull to
+    # host before reassembly — this is a mesh-boundary reshard, the same
+    # DCN-hop the reference's cross-mesh reshard performs
+    vals = [np.asarray(t._value if isinstance(t, Tensor) else t)
+            for t in local_tensor_list]
+    if isinstance(split_p, Shard):
+        full = jnp.asarray(np.concatenate(vals, axis=split_p.get_dim()))
+    else:
+        for i, vv in enumerate(vals[1:], 1):
+            if not np.array_equal(vv, vals[0]):
+                raise ValueError(
+                    f"moe_global_mesh_tensor: replicated locals diverge "
+                    f"(sub-mesh 0 vs {i}) — refusing to pick one silently")
+        full = jnp.asarray(vals[0])
+    return shard_tensor(Tensor(full), mesh, placements)

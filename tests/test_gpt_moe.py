@@ -331,3 +331,38 @@ def test_moe_pipeline_ep_mp_composition(cpu_mesh8):
                          for j in range(m_micro)])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=5e-4, atol=5e-5)
+
+
+def test_moe_sub_mesh_tensors_roundtrip():
+    """moe_sub_mesh_tensors / moe_global_mesh_tensor (reference
+    auto_parallel/api.py:580/:439): split an expert-stacked tensor over
+    the ep mesh dim into per-sub-mesh locals and reassemble."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import ProcessMesh, Replicate, Shard
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["ep", "mp"])
+    data = np.arange(8 * 8, dtype=np.float32).reshape(8, 8)
+    t = dist.shard_tensor(paddle.to_tensor(data), mesh,
+                          [Shard(0), Shard(1)])
+
+    locals_ = dist.moe_sub_mesh_tensors(t, mesh, 0, [Shard(0), Shard(1)])
+    assert len(locals_) == 2
+    np.testing.assert_array_equal(np.asarray(locals_[0]._value), data[:4])
+    np.testing.assert_array_equal(np.asarray(locals_[1]._value), data[4:])
+    # each local lives on its own sub-mesh, mp-sharded
+    sub_mesh = locals_[0]._value.sharding.mesh
+    assert tuple(sub_mesh.axis_names) == ("mp",)
+    assert len(sub_mesh.devices.flatten()) == 4
+    assert locals_[0]._value.sharding.spec[1] == "mp"
+
+    back = dist.moe_global_mesh_tensor(locals_, mesh, [Shard(0), Shard(1)],
+                                       local_mesh_dim=0)
+    np.testing.assert_array_equal(np.asarray(back._value), data)
+    assert back._value.sharding.mesh.shape["ep"] == 2
+
+    # replicated split dim: locals are full copies
+    t2 = dist.shard_tensor(paddle.to_tensor(data), mesh,
+                           [Replicate(), Shard(1)])
+    reps = dist.moe_sub_mesh_tensors(t2, mesh, 0, [Replicate(), Shard(1)])
+    np.testing.assert_array_equal(np.asarray(reps[0]._value), data)
+    np.testing.assert_array_equal(np.asarray(reps[1]._value), data)
