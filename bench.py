@@ -60,7 +60,9 @@ def main():
         batch, seq, steps, warmup = 6, 1024, 3, 2  # r3: wider measurement
         # window (r2 verdict weak#6: 2-step windows can hide variance; now
         # 3 timed windows x 3 steps each, warmup unchanged at 2)
-        accum = 32
+        accum = 64  # r3 re-sweep: accum=32 0.612, 48 0.619, 64 0.622,
+        # 96 0.626 (diminishing; 64 keeps the effective batch at 393k
+        # tokens/update, well inside real LLM configs)
         compute_dtype = jnp.bfloat16
         param_dtype = jnp.bfloat16
     else:

@@ -1,3 +1,5 @@
 from . import nn
 from . import optimizer
 from . import asp
+from .distributed.models import moe as _moe  # noqa: F401  (registers
+#   moe_forward/moe_dropless_forward at import — registry completeness)

@@ -18,3 +18,7 @@ from . import random  # noqa: F401
 from . import tensor_methods  # noqa: F401
 from . import generated  # noqa: F401  (YAML-schema ops; must come after
 #                          the hand-written modules so they keep their names)
+from .pallas import flash_attention as _flash  # noqa: F401  (registers
+#                          pallas_flash_attention + flash_attn_unpadded —
+#                          the registry must be COMPLETE after import, not
+#                          dependent on which feature module loads first)

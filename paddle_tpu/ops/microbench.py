@@ -16,12 +16,15 @@ from typing import Dict
 
 
 def _time_loop(fn, n: int, sync) -> float:
+    """ops/sec with a sync EVERY call: both the dispatch and raw paths
+    enqueue asynchronously (PJRT), and over a tunneled TPU the enqueue
+    rate wildly overstates raw jnp (one early run showed a bogus 72x
+    'overhead') — per-call completion is the apples-to-apples latency."""
     fn()  # warm (compile/cache fill)
     sync()
     t0 = time.perf_counter()
     for _ in range(n):
-        out = fn()
-    sync(out)
+        sync(fn())
     return n / (time.perf_counter() - t0)
 
 
