@@ -208,6 +208,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                             compute_dtype=jnp.bfloat16,
                             remat: bool = False,
                             sep_attn: str = "ulysses",
+                            schedule: str = "gpipe",
                             data_axes: Tuple[str, ...] = ("dp", "sharding")):
     """Build the fully-composed hybrid train step:
 
@@ -220,12 +221,18 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
     of HYBRID_AXES (degree 1 axes are fine — ppermute/alltoall over a
     size-1 axis are no-ops, so the same program serves every composition).
 
-    GPipe semantics: per-tick stage advance via ppermute; bubbles are
-    (P-1) ticks per direction.  The 1F1B/VPP/ZBH1 static tables
-    (parallel/schedules.py + pipeline_train_step) remain the
-    schedule-explicit runtime for uniform-stage workloads; the composed
-    flagship rides the differentiable dataflow form, where XLA overlaps
-    each tick's ppermute with the next tick's compute.
+    ``schedule`` selects the pipeline runtime:
+
+    - ``"gpipe"`` (default): differentiable dataflow — jax.grad reverses
+      the statically-bounded tick loop; memory holds all m micro
+      activations.
+    - ``"1F1B"`` / ``"ZBH1"`` / ``"FThenB"``: the schedule-explicit
+      executor (parallel/pipelining.pipeline_train_step) with the static
+      tables from parallel/schedules.py — backward interleaves with
+      forward per the table (1F1B's min(p, m) activation bound; ZBH1's
+      dx/dw split filling bubbles), grads computed in-schedule, and the
+      embedding/LM-head outside the pipeline get their gradients through
+      the executor's x-grad / loss-params channels.
     """
     pp_axis, sep_axis = "pp", "sep"
     for ax in HYBRID_AXES:
@@ -261,18 +268,19 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                                       cfg.max_position_embeddings,
                                       cfg.rope_theta)
 
-    def pipeline_body(stacked, x, cos, sin):
-        """Manual region over {pp, sep}.  stacked leaves: [L/pp, ...]
-        (auto-sharded over sharding/mp on trailing dims); x: [m, mb,
-        s_local, hidden]; cos/sin: [s_local, head_dim]."""
-
+    def _make_layer_step(cos, sin):
         def layer_step(h, lp):
             return _decoder_layer(lp, h, cos, sin, cfg,
                                   sep_axis if sep > 1 else None,
                                   sep_attn), None
 
-        if remat:
-            layer_step = jax.checkpoint(layer_step)
+        return jax.checkpoint(layer_step) if remat else layer_step
+
+    def pipeline_body(stacked, x, cos, sin):
+        """Manual region over {pp, sep}.  stacked leaves: [L/pp, ...]
+        (auto-sharded over sharding/mp on trailing dims); x: [m, mb,
+        s_local, hidden]; cos/sin: [s_local, head_dim]."""
+        layer_step = _make_layer_step(cos, sin)
 
         def stage_fn(stage_params, act):
             act, _ = lax.scan(layer_step, act, stage_params)
@@ -292,10 +300,74 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                   P(sep_entry, None), P(sep_entry, None)),
         out_specs=P(None, None, sep_entry, None), check_vma=False)
 
+    # ---- schedule-explicit runtime (1F1B / ZBH1 / FThenB) ----
+    sched = None
+    if schedule.lower() != "gpipe":
+        if cfg.tie_word_embeddings:
+            raise NotImplementedError(
+                "schedule-explicit hybrid needs an untied lm_head (the "
+                "embedding lives outside the pipeline)")
+        if mesh.shape["dp"] > 1:
+            # batch dims must stay unsharded over AUTO axes inside the
+            # executor: its per-rank lax.switch branches diverge across
+            # pp rows, and GSPMD-inserted batch collectives inside those
+            # branches deadlock the collective rendezvous (XLA:CPU
+            # reproduces it deterministically).  FSDP ('sharding') on
+            # WEIGHTS is fine — proven by tests; dp would silently
+            # replicate compute, so reject it loudly.  Use
+            # schedule='gpipe' for dp/sharding batch composition.
+            raise NotImplementedError(
+                "schedule-explicit hybrid (1F1B/ZBH1) composes "
+                "pp x sep x mp with FSDP-at-rest weights; dp>1 requires "
+                "schedule='gpipe'")
+        from ..parallel.pipelining import pipeline_train_step
+        from ..parallel.schedules import build_schedule
+
+        sched = build_schedule(schedule, p=pp, m=m, v=1)
+
+    def pipeline_body_sched(chunked, x, y, cos, sin, head_params):
+        """stacked chunk layout [1, L/pp, ...] per rank; x [m, mb,
+        s_local, h]; y [m, mb, s_local]; head_params = final norm + LM
+        head (grads via the executor's loss-params channel)."""
+        layer_step = _make_layer_step(cos, sin)
+
+        def stage_fn(chunk, act):
+            act, _ = lax.scan(layer_step, act, chunk)
+            return act
+
+        def loss_fn(lp, act, y_mb):
+            h = _rms_norm(act, lp["norm"], cfg.rms_norm_eps)
+            logits = h @ lp["head"]
+            lse = jax.scipy.special.logsumexp(
+                logits.astype(jnp.float32), axis=-1)
+            gold = jnp.take_along_axis(
+                logits, y_mb[..., None], axis=-1)[..., 0].astype(jnp.float32)
+            # local-token mean / sep degree: summed over sep below, this
+            # is the GLOBAL token mean (equal shard sizes)
+            return (lse - gold).mean() / sep
+
+        loss, sgrads, hgrads, dxs = pipeline_train_step(
+            stage_fn, loss_fn, sched, chunked, x, y, axis=pp_axis,
+            loss_params=head_params, want_x_grad=True)
+        if sep > 1:
+            loss = lax.psum(loss, sep_axis)
+            sgrads = jax.tree_util.tree_map(
+                lambda a: lax.psum(a, sep_axis), sgrads)
+            hgrads = jax.tree_util.tree_map(
+                lambda a: lax.psum(a, sep_axis), hgrads)
+        return loss, sgrads, hgrads, dxs
+
+    shmap_sched = jax.shard_map(
+        pipeline_body_sched, mesh=mesh, axis_names={pp_axis, sep_axis},
+        in_specs=(P("pp"), P(None, None, sep_entry, None),
+                  P(None, None, sep_entry),
+                  P(sep_entry, None), P(sep_entry, None), P()),
+        out_specs=(P(), P("pp"), P(),
+                   P(None, None, sep_entry, None)),
+        check_vma=False) if sched is not None else None
+
     def loss_fn(params, input_ids, labels):
-        cast = {k: (v.astype(compute_dtype)
-                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
-                for k, v in params.items()}
+        cast = _cast(params)
         outer, stacked = _split(cast)
         B, S = input_ids.shape
         mb = B // m
@@ -322,22 +394,77 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
 
     grad_fn = jax.value_and_grad(loss_fn)
 
+    def _cast(params):
+        return {k: (v.astype(compute_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in params.items()}
+
+    def _apply_optimizer(params, grads, opt_state, lr, step_no):
+        """Single copy of the decay-mask rule + apply: the gpipe and
+        schedule-explicit paths must not drift."""
+        names = list(params.keys())  # trace-time only: retrace-safe
+        no_decay = {n for n in names
+                    if "layernorm" in n or n.endswith("norm.weight")
+                    or n.endswith(".bias")}
+        return optimizer.apply(
+            params, grads, opt_state, lr, step_no + 1,
+            decay_mask={n: n not in no_decay for n in names})
+
     def step_fn(params, opt_state, step_no, lr, input_ids, labels):
         if batch_entry is not None or sep_entry is not None:
             bs = NamedSharding(mesh, P(batch_entry, sep_entry))
             input_ids = lax.with_sharding_constraint(input_ids, bs)
             labels = lax.with_sharding_constraint(labels, bs)
         loss, grads = grad_fn(params, input_ids, labels)
-        names = list(params.keys())  # trace-time only: retrace-safe
-        no_decay = {n for n in names
-                    if "layernorm" in n or n.endswith("norm.weight")
-                    or n.endswith(".bias")}
-        new_params, new_opt_state = optimizer.apply(
-            params, grads, opt_state, lr, step_no + 1,
-            decay_mask={n: n not in no_decay for n in names})
+        new_params, new_opt_state = _apply_optimizer(params, grads,
+                                                     opt_state, lr, step_no)
         return loss, new_params, new_opt_state
 
-    jstep = jax.jit(step_fn, donate_argnums=(0, 1))
+    def sched_step_fn(params, opt_state, step_no, lr, input_ids, labels):
+        """Schedule-explicit train step: grads come from the executor's
+        in-schedule vjps (stages), loss-params channel (norm + head) and
+        x-grad channel (embedding), not from an outer jax.grad."""
+        if sep_entry is not None:
+            # batch stays REPLICATED over dp/sharding here (see the
+            # build-time guard); only the sep split applies
+            bs = NamedSharding(mesh, P(None, sep_entry))
+            input_ids = lax.with_sharding_constraint(input_ids, bs)
+            labels = lax.with_sharding_constraint(labels, bs)
+        cast = _cast(params)
+        outer, stacked = _split(cast)
+        B, S = input_ids.shape
+        mb = B // m
+        ids = input_ids.reshape(m, mb, S)
+        y = labels.reshape(m, mb, S)
+
+        def embed_fn(w):
+            return jnp.take(w, ids, axis=0)
+
+        x, embed_vjp = jax.vjp(embed_fn, outer["model.embed_tokens.weight"])
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, None, sep_entry, None)))
+        cos = cos_full[:S].astype(compute_dtype)
+        sin = sin_full[:S].astype(compute_dtype)
+        chunked = jax.tree_util.tree_map(
+            lambda a: a.reshape((pp, a.shape[0] // pp) + a.shape[1:]),
+            stacked)
+        head_params = {"norm": cast["model.norm.weight"],
+                       "head": cast["lm_head.weight"]}
+        loss, sgrads, hgrads, dxs = shmap_sched(chunked, x, y, cos, sin,
+                                                head_params)
+        (d_embed,) = embed_vjp(dxs.astype(x.dtype))
+        grads = {}
+        for suffix, g in sgrads.items():
+            grads[_LAYER_PREFIX + suffix] = g.reshape((L,) + g.shape[2:])
+        grads["model.norm.weight"] = hgrads["norm"]
+        grads["lm_head.weight"] = hgrads["head"]
+        grads["model.embed_tokens.weight"] = d_embed.astype(jnp.float32)
+        new_params, new_opt_state = _apply_optimizer(params, grads,
+                                                     opt_state, lr, step_no)
+        return loss, new_params, new_opt_state
+
+    jstep = jax.jit(step_fn if sched is None else sched_step_fn,
+                    donate_argnums=(0, 1))
 
     def step(params, opt_state, step_no, lr, input_ids, labels):
         with jax.sharding.set_mesh(mesh):

@@ -110,10 +110,19 @@ def stack_stage_params_interleaved(per_stage_params: list, p: int) -> Any:
 
 def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
                         stage_params: Any, x: jnp.ndarray, y: jnp.ndarray,
-                        axis: str = "pp"):
+                        axis: str = "pp", loss_params: Any = None,
+                        want_x_grad: bool = False):
     """Run one forward+backward over micro-batches under an explicit
     pipeline schedule, inside a shard_map body.  Returns (mean_loss,
     param_grads) where grads match ``stage_params``' layout.
+
+    With ``loss_params`` (a pytree closed into the loss head — final
+    norm + LM head weights), loss_fn is called as ``loss_fn(loss_params,
+    act, y_mb)`` and the step ALSO returns their accumulated grads; with
+    ``want_x_grad=True`` it returns the per-microbatch gradient w.r.t.
+    the stage-0 INPUT (``[m, ...]``, valid on rank 0) — what an
+    embedding outside the pipeline needs for its backward.  Full return
+    shape: (loss, param_grads[, loss_param_grads][, x_grads]).
 
     The TPU translation of the reference's schedule runtimes
     (fleet/meta_parallel/pipeline_parallel.py:547 1F1B, :1143 interleave,
@@ -166,6 +175,14 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
     bcarry0 = _varying(jnp.zeros(act_shape, act_dtype))
     gacc0 = jax.tree_util.tree_map(
         lambda a: _varying(jnp.zeros(a.shape, jnp.float32)), stage_params)
+    # loss-head grads (final norm/LM head outside the stages) and the
+    # stage-0 input grads (for an embedding outside the pipeline)
+    lacc0 = jax.tree_util.tree_map(
+        lambda a: _varying(jnp.zeros(jnp.shape(a), jnp.float32)),
+        loss_params) if loss_params is not None else _varying(
+        jnp.zeros((), jnp.float32))
+    dxs0 = _varying(jnp.zeros((m,) + act_shape, act_dtype)) \
+        if want_x_grad else _varying(jnp.zeros((), jnp.float32))
     loss0 = _varying(jnp.zeros((), jnp.float32))
 
     is_last = (me == p - 1)      # last GLOBAL stage = chunk v-1 on rank p-1
@@ -181,7 +198,7 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
                                                idx, 0)
 
     def tick(t, carry):
-        stash, gin, fcarry, bcarry, gacc, loss_acc = carry
+        stash, gin, fcarry, bcarry, gacc, lacc, dxs, loss_acc = carry
 
         # 1) store this tick's arrivals (what last tick's ppermute brought)
         frs, frm = frs_t[me, t], frm_t[me, t]
@@ -202,28 +219,54 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
         g_up = lax.dynamic_index_in_dim(gin, sl, 0, keepdims=False)
 
         zero_act = jnp.zeros(act_shape, act_dtype)
+        first_here = is_first & (ch == 0)
 
-        def _loss_grad(out):
+        def _loss_grad(out, lacc):
             """Upstream grad at this op's stage: the loss gradient if this
             is the last global stage, else the stashed arrival.  Computed
             unconditionally on every rank — uniform SPMD program; the
             unused value is dead weight XLA overlaps, not a branch."""
-            l, lvjp = jax.vjp(lambda o: loss_fn(o, yin), out)
-            (gl,) = lvjp(jnp.ones((), l.dtype) / (m))
-            gl = gl.astype(act_dtype)
             last_here = is_last & (ch == v - 1)
+            if loss_params is not None:
+                # COST NOTE: the head vjp runs on EVERY rank (uniform
+                # SPMD — it cannot be lax.cond'ed away, because an
+                # mp-sharded head emits collectives inside the vjp and
+                # per-rank branch divergence around collectives
+                # deadlocks); (p-1)/p of the head FLOPs + the fp32 lacc
+                # buffer are the price.  For very large vocabs, fold the
+                # head into the LAST stage's chunk params instead of
+                # loss_params.
+                l, lvjp = jax.vjp(
+                    lambda lp, o: loss_fn(lp, o, yin), loss_params, out)
+                dlp, gl = lvjp(jnp.ones((), l.dtype) / (m))
+                lacc = jax.tree_util.tree_map(
+                    lambda acc, d: acc + jnp.where(
+                        last_here, d.astype(jnp.float32), 0.0),
+                    lacc, dlp)
+            else:
+                l, lvjp = jax.vjp(lambda o: loss_fn(o, yin), out)
+                (gl,) = lvjp(jnp.ones((), l.dtype) / (m))
+            gl = gl.astype(act_dtype)
             return (jnp.where(last_here, gl, g_up),
-                    jnp.where(last_here, l / m, 0.0).astype(jnp.float32))
+                    jnp.where(last_here, l / m, 0.0).astype(jnp.float32),
+                    lacc)
 
-        def do_noop(stash, gin, gacc, loss_acc):
-            return stash, gin, gacc, loss_acc, zero_act, zero_act
+        def _stash_dx(dxs, dx):
+            """Record stage-0's input grad for micro-batch ``mb``."""
+            if not want_x_grad:
+                return dxs
+            cur = lax.dynamic_index_in_dim(dxs, mb, 0, keepdims=False)
+            return _upd(dxs, jnp.where(first_here, dx, cur), mb)
 
-        def do_fwd(stash, gin, gacc, loss_acc):
-            first_here = is_first & (ch == 0)
+        def do_noop(stash, gin, gacc, lacc, dxs, loss_acc):
+            return stash, gin, gacc, lacc, dxs, loss_acc, zero_act, zero_act
+
+        def do_fwd(stash, gin, gacc, lacc, dxs, loss_acc):
             inp = jnp.where(first_here, xin.astype(act_dtype), stashed)
             stash = _upd(stash, inp, sl)      # stage-0 path stores x[mb]
             out = stage_fn(pc, inp)
-            return stash, gin, gacc, loss_acc, out.astype(act_dtype), zero_act
+            return (stash, gin, gacc, lacc, dxs, loss_acc,
+                    out.astype(act_dtype), zero_act)
 
         def _accum(gacc, ch, dp):
             return jax.tree_util.tree_map(
@@ -233,41 +276,63 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
                     + d.astype(jnp.float32), ch),
                 gacc, dp)
 
-        def do_bwd(stash, gin, gacc, loss_acc):
+        def do_bwd(stash, gin, gacc, lacc, dxs, loss_acc):
             out, vjp = jax.vjp(stage_fn, pc, stashed)
-            g, l = _loss_grad(out)
+            g, l, lacc = _loss_grad(out, lacc)
             dp, dx = vjp(g)
             gacc = _accum(gacc, ch, dp)
-            return (stash, gin, gacc, loss_acc + l, zero_act,
+            dxs = _stash_dx(dxs, dx)
+            return (stash, gin, gacc, lacc, dxs, loss_acc + l, zero_act,
                     dx.astype(act_dtype))
 
-        def do_bwdx(stash, gin, gacc, loss_acc):
+        def do_bwdx(stash, gin, gacc, lacc, dxs, loss_acc):
             out, vjpx = jax.vjp(lambda xx: stage_fn(pc, xx), stashed)
-            g, l = _loss_grad(out)
+            g, l, lacc = _loss_grad(out, lacc)
             (dx,) = vjpx(g)
             # the loss-grad case (last stage) must persist g for BWDW
             gin = _upd(gin, g, sl)
-            return (stash, gin, gacc, loss_acc + l, zero_act,
+            dxs = _stash_dx(dxs, dx)
+            return (stash, gin, gacc, lacc, dxs, loss_acc + l, zero_act,
                     dx.astype(act_dtype))
 
-        def do_bwdw(stash, gin, gacc, loss_acc):
+        def do_bwdw(stash, gin, gacc, lacc, dxs, loss_acc):
             _, vjpw = jax.vjp(lambda pp: stage_fn(pp, stashed), pc)
             (dp,) = vjpw(g_up)
             gacc = _accum(gacc, ch, dp)
-            return stash, gin, gacc, loss_acc, zero_act, zero_act
+            return (stash, gin, gacc, lacc, dxs, loss_acc, zero_act,
+                    zero_act)
 
         branches = [do_noop] * 5
         branches[FWD], branches[BWD] = do_fwd, do_bwd
         branches[BWDX], branches[BWDW] = do_bwdx, do_bwdw
-        stash, gin, gacc, loss_acc, fsend, bsend = lax.switch(
-            k, branches, stash, gin, gacc, loss_acc)
+        stash, gin, gacc, lacc, dxs, loss_acc, fsend, bsend = lax.switch(
+            k, branches, stash, gin, gacc, lacc, dxs, loss_acc)
 
+        # the two directional permutes are data-INDEPENDENT (and so are
+        # the fwd chains of CONSECUTIVE ticks); without explicit ordering
+        # edges, per-device thunk schedulers can enter collectives in
+        # different orders and deadlock the rendezvous (observed on
+        # XLA:CPU with auto batch axes alongside manual pp).  Two
+        # barriers pin the global order fwd(t) -> bwd(t) -> fwd(t+1): the
+        # first sequences the pair inside the tick, the second makes
+        # EVERY carry output (hence all of tick t+1) depend on bwd(t).
         fcarry = lax.ppermute(fsend, axis, perm_r)
+        fcarry, bsend = lax.optimization_barrier((fcarry, bsend))
         bcarry = lax.ppermute(bsend, axis, perm_l)
-        return stash, gin, fcarry, bcarry, gacc, loss_acc
+        return lax.optimization_barrier(
+            (stash, gin, fcarry, bcarry, gacc, lacc, dxs, loss_acc))
 
-    init = (stash0, gin0, fcarry0, bcarry0, gacc0, loss0)
-    _, _, _, _, gacc, loss_acc = lax.fori_loop(0, sched.ticks, tick, init)
+    init = (stash0, gin0, fcarry0, bcarry0, gacc0, lacc0, dxs0, loss0)
+    _, _, _, _, gacc, lacc, dxs, loss_acc = lax.fori_loop(
+        0, sched.ticks, tick, init)
     # only the last rank accumulated real losses; share it
     loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), axis)
-    return loss, gacc
+    out = [loss, gacc]
+    if loss_params is not None:
+        # real only on the last rank (masked zeros elsewhere): share
+        out.append(jax.tree_util.tree_map(
+            lambda a: lax.psum(a, axis), lacc))
+    if want_x_grad:
+        # real only on rank 0 (first global stage)
+        out.append(lax.psum(jnp.where(is_first, dxs, 0.0), axis))
+    return tuple(out)
