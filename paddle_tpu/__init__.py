@@ -79,6 +79,8 @@ from . import regularizer
 from . import hub
 from . import reader
 from . import cost_model
+from . import strings
+from .core.selected_rows import SelectedRows
 from .batch import batch
 
 

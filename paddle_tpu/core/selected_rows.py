@@ -1,0 +1,86 @@
+"""SelectedRows — row-sparse tensor (reference phi/core/selected_rows.h).
+
+The reference uses SelectedRows for sparse embedding/lookup-table
+gradients: (rows, value) where ``rows`` are int64 row ids into a dense
+[height, ...] tensor and ``value`` holds only those rows.  On TPU, dense
+XLA gradients are the default (scatter-add fuses into the backward;
+SURVEY §2.10) — SelectedRows here serves the paths where row sparsity is
+the INTERFACE: parameter-server push/pull (distributed/ps sparse tables)
+and row-wise optimizer updates on huge embeddings.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """rows: [n] int64 ids; value: [n, ...] the selected rows' data;
+    height: dim 0 of the dense equivalent."""
+
+    def __init__(self, rows, value, height: Optional[int] = None):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.value = jnp.asarray(value)
+        if self.rows.shape[0] != self.value.shape[0]:
+            raise ValueError(
+                f"rows ({self.rows.shape[0]}) and value "
+                f"({self.value.shape[0]}) leading dims differ")
+        self.height = int(height) if height is not None else (
+            int(self.rows.max()) + 1 if self.rows.size else 0)
+
+    # ------------------------------------------------ reference interface
+    def has_key(self, key: int) -> bool:
+        return bool(jnp.any(self.rows == key))
+
+    def get(self, keys):
+        """Gather the value rows for ``keys`` (missing keys -> zeros,
+        the reference's AutoGrownIndex read path simplified)."""
+        keys = jnp.asarray(keys, jnp.int32)
+        eq = self.rows[None, :] == keys[:, None]          # [k, n]
+        hit = eq.any(axis=1)
+        idx = jnp.argmax(eq, axis=1)
+        vals = self.value[idx]
+        return jnp.where(hit.reshape((-1,) + (1,) * (vals.ndim - 1)),
+                         vals, jnp.zeros_like(vals))
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate rows (reference
+        phi/kernels/funcs/selected_rows_functor MergeAdd)."""
+        uniq, inv = np.unique(np.asarray(self.rows), return_inverse=True)
+        merged = jnp.zeros((len(uniq),) + self.value.shape[1:],
+                           self.value.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(self.value)
+        return SelectedRows(uniq, merged, self.height)
+
+    def to_dense(self):
+        """Scatter-add into the dense [height, ...] tensor."""
+        dense = jnp.zeros((self.height,) + self.value.shape[1:],
+                          self.value.dtype)
+        return dense.at[self.rows].add(self.value)
+
+    @staticmethod
+    def from_dense(dense, rows):
+        rows = jnp.asarray(rows, jnp.int32)
+        return SelectedRows(rows, jnp.asarray(dense)[rows],
+                            height=dense.shape[0])
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"n_rows={int(self.rows.shape[0])}, "
+                f"row_shape={tuple(self.value.shape[1:])})")
+
+
+def apply_rowwise_update(param, grad: SelectedRows, lr: float):
+    """Row-sparse SGD: touch ONLY the selected rows (reference
+    phi/kernels/cpu/sgd_kernel.cc SelectedRows overload) — the update
+    cost scales with touched rows, not the embedding height."""
+    g = grad.merge()
+    pv = param._value if hasattr(param, "_value") else jnp.asarray(param)
+    new = pv.at[g.rows].add(-lr * g.value.astype(pv.dtype))
+    if hasattr(param, "set_value"):
+        param.set_value(new)
+        return param
+    return new

@@ -24,6 +24,8 @@ from .layers.mpu import (ColumnParallelLinear, ParallelCrossEntropy,
                          RowParallelLinear, VocabParallelEmbedding,
                          get_rng_state_tracker, model_parallel_random_seed)
 from .utils import sequence_parallel_utils
+from .dataset import (DataGenerator, InMemoryDataset,
+                      MultiSlotDataGenerator, QueueDataset)
 
 
 class _FleetState:

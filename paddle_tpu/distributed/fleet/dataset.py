@@ -1,0 +1,242 @@
+"""Fleet datasets — the PS-mode streaming data pipeline.
+
+Analog of the reference's data_generator + dataset stack
+(python/paddle/distributed/fleet/data_generator/data_generator.py
+MultiSlot text protocol; python/paddle/distributed/fleet/dataset/
+dataset.py InMemoryDataset/QueueDataset over the C++ MultiSlotDataFeed).
+
+TPU-native translation: the wire format is kept byte-compatible (a
+sample line is ``count v1 v2 ...`` per slot, space-joined — files
+produced for the reference feed load here and vice versa), but the feed
+is Python/numpy: samples land in host memory and batches come out as
+numpy per-slot arrays ready for device_put.  Under the single-controller
+runtime "global shuffle" is a deterministic hash partition of the global
+filelist across trainers + a local shuffle — each trainer ends with a
+random, disjoint share (the property the reference's shuffle RPC
+establishes)."""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DataGenerator:
+    """User subclasses override ``generate_sample(line)`` returning a
+    callable iterator of ``[(slot_name, [values...]), ...]`` samples
+    (reference data_generator.py:154)."""
+
+    def __init__(self):
+        self.batch_size_ = 1
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line: Optional[str]):
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample")
+
+    def generate_batch(self, samples: List):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _gen_str(self, line) -> str:
+        """MultiSlot text protocol: per slot ``count v1 v2 ...``."""
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of generate_sample() must be list or tuple, "
+                "e.g. [('words', [1926, 8, 17]), ('label', [1])]")
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            it = self.generate_sample(line)
+            for sample in it():
+                sys.stdout.write(self._gen_str(sample))
+
+    def run_from_files(self, filelist: Sequence[str], output_path: str):
+        """Offline conversion: raw text files -> one MultiSlot file
+        (the reference pipes this through ``pipe_command``)."""
+        with open(output_path, "w") as out:
+            for path in filelist:
+                with open(path) as f:
+                    for line in f:
+                        it = self.generate_sample(line)
+                        for sample in it():
+                            out.write(self._gen_str(sample))
+        return output_path
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Name kept for reference parity (same protocol)."""
+
+
+def _parse_multislot_line(line: str, slots: Sequence[str],
+                          dtypes: Dict[str, str]):
+    toks = line.split()
+    out = {}
+    i = 0
+    for slot in slots:
+        if i >= len(toks):
+            raise ValueError(f"truncated MultiSlot line at slot {slot!r}")
+        n = int(toks[i])
+        vals = toks[i + 1:i + 1 + n]
+        i += 1 + n
+        dt = dtypes.get(slot, "int64")
+        out[slot] = np.asarray(
+            [float(v) for v in vals] if "float" in dt
+            else [int(v) for v in vals],
+            dtype=np.float32 if "float" in dt else np.int64)
+    return out
+
+
+class InMemoryDataset:
+    """Load a MultiSlot filelist into host memory; shuffle; iterate
+    batches (reference fleet/dataset/dataset.py InMemoryDataset:
+    load_into_memory / local_shuffle / global_shuffle /
+    get_memory_data_size / release_memory)."""
+
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._slots: List[str] = []
+        self._dtypes: Dict[str, str] = {}
+        self._batch_size = 1
+        self._samples: List[Dict[str, np.ndarray]] = []
+        self._loaded = False
+
+    def init(self, batch_size: int = 1, use_var: Optional[Sequence] = None,
+             pipe_command: str = "", thread_num: int = 1, **kwargs):
+        """``use_var`` takes slot names (strings) or objects with
+        .name/.dtype (the reference passes Variables)."""
+        self._batch_size = batch_size
+        self._slots = []
+        for v in use_var or []:
+            if isinstance(v, str):
+                self._slots.append(v)
+            else:
+                self._slots.append(v.name)
+                self._dtypes[v.name] = str(getattr(v, "dtype", "int64"))
+        return self
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = batch_size
+
+    def update_settings(self, **kwargs):
+        if "batch_size" in kwargs:
+            self._batch_size = kwargs["batch_size"]
+
+    def load_into_memory(self):
+        self._samples = []
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        self._samples.append(_parse_multislot_line(
+                            line, self._slots, self._dtypes))
+        self._loaded = True
+
+    def preload_into_memory(self, thread_num: int = 1):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        rng = random.Random(seed)
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 1,
+                       seed: Optional[int] = None):
+        """Single-controller translation: deterministic hash partition of
+        the loaded samples across trainers (each trainer keeps a random
+        DISJOINT share — the invariant the reference's shuffle RPC
+        provides) followed by a local shuffle."""
+        from ..env import get_rank, get_world_size
+
+        world = get_world_size()
+        rank = get_rank()
+        if world > 1:
+            self._samples = [s for i, s in enumerate(self._samples)
+                             if (i * 2654435761 + 12345) % world == rank]
+        self.local_shuffle(seed)
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+        self._loaded = False
+
+    # ------------------------------------------------------- iteration
+    def _batch(self, samples: List[Dict[str, np.ndarray]]):
+        """Per-slot ragged concat: (flat values, lod offsets) — the
+        MultiSlotDataFeed's LoD layout; fixed-length slots also get a
+        dense [b, n] view for convenience."""
+        out = {}
+        for slot in self._slots:
+            vals = [s[slot] for s in samples]
+            lens = [len(v) for v in vals]
+            flat = np.concatenate(vals) if vals else np.empty((0,))
+            lod = np.cumsum([0] + lens)
+            entry = {"data": flat, "lod": lod}
+            if len(set(lens)) == 1 and lens:
+                entry["dense"] = flat.reshape(len(vals), lens[0])
+            out[slot] = entry
+        return out
+
+    def __iter__(self) -> Iterator[Dict[str, dict]]:
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() before iterating")
+        for i in range(0, len(self._samples), self._batch_size):
+            yield self._batch(self._samples[i:i + self._batch_size])
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant: iterates the filelist without materializing
+    (reference QueueDataset — single pass, no shuffle)."""
+
+    def load_into_memory(self):
+        raise RuntimeError("QueueDataset streams from files; use the "
+                           "iterator directly (reference raises too)")
+
+    def local_shuffle(self, seed=None):
+        raise RuntimeError("QueueDataset cannot shuffle (single pass)")
+
+    def global_shuffle(self, fleet=None, thread_num=1, seed=None):
+        raise RuntimeError("QueueDataset cannot shuffle (single pass)")
+
+    def __iter__(self):
+        batch: List[Dict[str, np.ndarray]] = []
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    batch.append(_parse_multislot_line(
+                        line, self._slots, self._dtypes))
+                    if len(batch) == self._batch_size:
+                        yield self._batch(batch)
+                        batch = []
+        if batch:
+            yield self._batch(batch)

@@ -134,7 +134,9 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
     remat; the schedule's memory bound is its ``num_slots``).
 
     stage_fn(chunk_params, act) -> act             (uniform act shapes)
-    loss_fn(act, y_mb) -> scalar                   (applied at last stage)
+    loss_fn(act, y_mb) -> scalar                   (applied at last stage;
+        with ``loss_params`` the signature becomes
+        loss_fn(loss_params, act, y_mb))
     sched: a ``schedules.Schedule`` for (p, m, v)
     stage_params: pytree with leading dim v (this device's chunk slice —
         shard a [p*v, ...] stack over ``axis``; use

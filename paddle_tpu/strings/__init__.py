@@ -1,0 +1,113 @@
+"""paddle_tpu.strings — string tensors and string ops.
+
+Analog of the reference's strings subsystem (phi/kernels/strings/:
+strings_lower_upper_kernel.h over pstring arrays with the utf8/unicode
+case tables in unicode.h; python surface paddle/incubate's string
+tensors).  Strings are HOST data: a StringTensor wraps a numpy object
+array (the reference's pstring tensor is likewise CPU-resident; its GPU
+kernels just move bytes), and the ops run vectorized numpy — there is
+nothing for an MXU to do with codepoints.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Union
+
+import numpy as np
+
+
+class StringTensor:
+    """A tensor of variable-length strings (reference: phi
+    StringTensor/pstring)."""
+
+    def __init__(self, data, name: str = ""):
+        if isinstance(data, StringTensor):
+            arr = data._data.copy()
+        else:
+            arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == o)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def to_string_tensor(strings: Union[Iterable[str], np.ndarray],
+                     name: str = "") -> StringTensor:
+    """Reference: paddle.to_tensor on pstring data
+    (strings_empty_kernel.cc + fill)."""
+    return StringTensor(np.asarray(list(strings) if not
+                                   isinstance(strings, np.ndarray)
+                                   else strings, dtype=object), name)
+
+
+def _map(fn, x: StringTensor) -> StringTensor:
+    return StringTensor(np.vectorize(fn, otypes=[object])(x._data))
+
+
+def lower(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
+    """Reference strings_lower_upper_kernel.h: ascii fast path vs the
+    utf8/unicode case-conversion tables — python's str.lower IS the
+    unicode table; the ascii flag restricts to A-Z."""
+    if use_utf8_encoding:
+        return _map(str.lower, x)
+    return _map(lambda s: "".join(
+        chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s), x)
+
+
+def upper(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
+    if use_utf8_encoding:
+        return _map(str.upper, x)
+    return _map(lambda s: "".join(
+        chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s), x)
+
+
+def length(x: StringTensor) -> np.ndarray:
+    """Codepoint lengths (int64)."""
+    return np.vectorize(len, otypes=[np.int64])(x._data)
+
+
+def byte_length(x: StringTensor, encoding: str = "utf-8") -> np.ndarray:
+    return np.vectorize(lambda s: len(s.encode(encoding)),
+                        otypes=[np.int64])(x._data)
+
+
+def concat(xs: List[StringTensor], axis: int = 0) -> StringTensor:
+    return StringTensor(np.concatenate([x._data for x in xs], axis=axis))
+
+
+def strip(x: StringTensor) -> StringTensor:
+    return _map(str.strip, x)
+
+
+def join(x: StringTensor, sep: str = "") -> str:
+    return sep.join(x._data.reshape(-1).tolist())
+
+
+__all__ = ["StringTensor", "to_string_tensor", "lower", "upper", "length",
+           "byte_length", "concat", "strip", "join"]

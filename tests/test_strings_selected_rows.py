@@ -1,0 +1,68 @@
+"""Strings subsystem + SelectedRows (round-3 completeness for inventory
+item 21: reference phi/kernels/strings/ and phi/core/selected_rows.h)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+from paddle_tpu.core.selected_rows import SelectedRows, apply_rowwise_update
+
+
+def test_string_tensor_case_ops():
+    st = strings.to_string_tensor(["Hello", "WORLD", "Grüße", "mixed Case"])
+    assert st.shape == (4,)
+    low = strings.lower(st)
+    assert low.tolist() == ["hello", "world", "grüße", "mixed case"]
+    up = strings.upper(st)
+    assert up.tolist() == ["HELLO", "WORLD", "GRÜSSE", "MIXED CASE"]
+    # ascii-only mode leaves non-ascii letters alone (the reference's
+    # use_utf8_encoding=False path)
+    low_ascii = strings.lower(st, use_utf8_encoding=False)
+    assert low_ascii.tolist()[2] == "grüße"  # ü untouched either way
+    up_ascii = strings.upper(st, use_utf8_encoding=False)
+    assert up_ascii.tolist()[2] == "GRüßE"   # ascii-only: ü and ß kept
+
+
+def test_string_tensor_lengths_concat():
+    st = strings.to_string_tensor(["ab", "grüße"])
+    np.testing.assert_array_equal(strings.length(st), [2, 5])
+    assert strings.byte_length(st)[1] > 5  # utf-8 multibyte
+    cat = strings.concat([st, strings.to_string_tensor(["x"])])
+    assert cat.tolist() == ["ab", "grüße", "x"]
+    assert strings.join(strings.to_string_tensor(["a", "b"]), "-") == "a-b"
+    assert (st == strings.to_string_tensor(["ab", "nope"])).tolist() == \
+        [True, False]
+
+
+def test_selected_rows_roundtrip_and_merge():
+    sr = SelectedRows(rows=[3, 1, 3], value=np.ones((3, 4), np.float32),
+                      height=6)
+    assert sr.has_key(3) and not sr.has_key(0)
+    m = sr.merge()
+    assert m.rows.shape[0] == 2
+    dense = np.asarray(m.to_dense())
+    assert dense.shape == (6, 4)
+    np.testing.assert_array_equal(dense[3], 2 * np.ones(4))
+    np.testing.assert_array_equal(dense[1], np.ones(4))
+    np.testing.assert_array_equal(dense[0], np.zeros(4))
+    # get: present rows return values, absent rows zeros
+    got = np.asarray(m.get([1, 5]))
+    np.testing.assert_array_equal(got[0], np.ones(4))
+    np.testing.assert_array_equal(got[1], np.zeros(4))
+    # from_dense picks the rows back out
+    back = SelectedRows.from_dense(dense, [3])
+    np.testing.assert_array_equal(np.asarray(back.value[0]), dense[3])
+
+
+def test_selected_rows_rowwise_sgd():
+    """Row-sparse SGD touches only selected rows (reference
+    sgd_kernel.cc SelectedRows overload)."""
+    emb = paddle.to_tensor(np.ones((8, 4), np.float32))
+    grad = SelectedRows(rows=[2, 5, 2], value=np.ones((3, 4), np.float32),
+                        height=8)
+    apply_rowwise_update(emb, grad, lr=0.1)
+    out = np.asarray(emb._value)
+    np.testing.assert_allclose(out[2], 1.0 - 0.2 * np.ones(4))  # merged x2
+    np.testing.assert_allclose(out[5], 1.0 - 0.1 * np.ones(4))
+    np.testing.assert_allclose(out[0], np.ones(4))  # untouched
