@@ -124,3 +124,10 @@ _default_dtype = ["float32"]
 
 def set_default_dtype(d):
     _default_dtype[0] = str(d)
+
+
+# snapshot the framework-shipped op set (custom ops registered by user
+# code/tests later are exempt from the YAML schema-completeness check)
+from .ops.registry import freeze_builtin_ops as _freeze_builtin_ops
+
+_freeze_builtin_ops()

@@ -76,6 +76,23 @@ def get_op(name: str) -> OpDef:
         raise NotFoundError(f"op {name!r} is not registered") from None
 
 
+# frozen at the END of paddle_tpu's import (freeze_builtin_ops): the
+# framework-shipped op set, excluding user custom ops registered later —
+# schema-completeness checks apply to THIS set only
+_BUILTIN_OPS: frozenset = frozenset()
+
+
+def freeze_builtin_ops():
+    global _BUILTIN_OPS
+    if not _BUILTIN_OPS:
+        _BUILTIN_OPS = frozenset(_REGISTRY)
+    return _BUILTIN_OPS
+
+
+def builtin_ops() -> frozenset:
+    return _BUILTIN_OPS or frozenset(_REGISTRY)
+
+
 def all_ops() -> Dict[str, OpDef]:
     return dict(_REGISTRY)
 

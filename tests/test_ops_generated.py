@@ -278,13 +278,17 @@ def test_yaml_schema_consistency():
     """Every YAML op is registered AND every registered op has a schema
     entry — the single-source invariant (reference: ops.yaml drives the
     whole surface, §2.11)."""
+    from paddle_tpu.ops.registry import builtin_ops
+
     schema_names = {e["op"] for e in load_schema()}
     registered = set(all_ops())
     missing = schema_names - registered
     assert not missing, f"YAML ops not registered: {sorted(missing)}"
-    unschema = registered - schema_names
+    # completeness applies to the FRAMEWORK-shipped set: user custom ops
+    # (cpp_extension tests etc.) registered at runtime are exempt
+    unschema = set(builtin_ops()) - schema_names
     assert not unschema, \
-        f"registered ops missing a YAML schema entry: {sorted(unschema)}"
+        f"built-in ops missing a YAML schema entry: {sorted(unschema)}"
 
 
 def test_yaml_golden_or_exemption_everywhere():
