@@ -209,6 +209,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                             remat: bool = False,
                             sep_attn: str = "ulysses",
                             schedule: str = "gpipe",
+                            virtual_chunks: int = 1,
                             data_axes: Tuple[str, ...] = ("dp", "sharding")):
     """Build the fully-composed hybrid train step:
 
@@ -302,7 +303,13 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
 
     # ---- schedule-explicit runtime (1F1B / ZBH1 / FThenB) ----
     sched = None
-    if schedule.lower() != "gpipe":
+    if schedule.lower() == "gpipe":
+        if int(virtual_chunks) > 1:
+            raise ValueError(
+                "virtual_chunks > 1 needs a schedule-explicit runtime "
+                "(schedule='VPP'); the gpipe dataflow has no interleaved "
+                "placement")
+    else:
         if cfg.tie_word_embeddings:
             raise NotImplementedError(
                 "schedule-explicit hybrid needs an untied lm_head (the "
@@ -323,10 +330,22 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         from ..parallel.pipelining import pipeline_train_step
         from ..parallel.schedules import build_schedule
 
-        sched = build_schedule(schedule, p=pp, m=m, v=1)
+        vch = max(int(virtual_chunks), 1)
+        if L % (pp * vch):
+            raise ValueError(
+                f"{L} layers not divisible by pp*virtual_chunks = "
+                f"{pp}*{vch}")
+        sched = build_schedule(schedule, p=pp, m=m, v=vch)
+        # Megatron VPP placement (single source of truth:
+        # parallel.pipelining.vpp_device_major_order), applied here to
+        # layer-BLOCKS instead of per-stage param lists
+        from ..parallel.pipelining import vpp_device_major_order
+
+        _vpp_order, _vpp_inv = vpp_device_major_order(pp, vch)
 
     def pipeline_body_sched(chunked, x, y, cos, sin, head_params):
-        """stacked chunk layout [1, L/pp, ...] per rank; x [m, mb,
+        """chunked leaves arrive [v, L/(pp*v), ...] per rank (v=1 for
+        1F1B/ZBH1; VPP device-major chunks otherwise); x [m, mb,
         s_local, h]; y [m, mb, s_local]; head_params = final norm + LM
         head (grads via the executor's loss-params channel)."""
         layer_step = _make_layer_step(cos, sin)
@@ -445,9 +464,16 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             x, NamedSharding(mesh, P(None, None, sep_entry, None)))
         cos = cos_full[:S].astype(compute_dtype)
         sin = sin_full[:S].astype(compute_dtype)
-        chunked = jax.tree_util.tree_map(
-            lambda a: a.reshape((pp, a.shape[0] // pp) + a.shape[1:]),
-            stacked)
+        nstage = pp * sched.v
+
+        def _to_chunks(a):
+            # [L, ...] -> [nstage, L/nstage, ...] in VPP device-major
+            # order, so sharding dim 0 over pp yields [v, blk, ...] per
+            # rank with chunk j = global stage j*pp + rank
+            blk = a.reshape((nstage, a.shape[0] // nstage) + a.shape[1:])
+            return blk[jnp.asarray(_vpp_order)] if sched.v > 1 else blk
+
+        chunked = jax.tree_util.tree_map(_to_chunks, stacked)
         head_params = {"norm": cast["model.norm.weight"],
                        "head": cast["lm_head.weight"]}
         loss, sgrads, hgrads, dxs = shmap_sched(chunked, x, y, cos, sin,
@@ -455,6 +481,9 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         (d_embed,) = embed_vjp(dxs.astype(x.dtype))
         grads = {}
         for suffix, g in sgrads.items():
+            # [nstage(dev-major), blk, ...] -> stage order -> [L, ...]
+            if sched.v > 1:
+                g = g[jnp.asarray(_vpp_inv)]
             grads[_LAYER_PREFIX + suffix] = g.reshape((L,) + g.shape[2:])
         grads["model.norm.weight"] = hgrads["norm"]
         grads["lm_head.weight"] = hgrads["head"]

@@ -88,6 +88,19 @@ def stack_stage_params(per_stage_params: list) -> Any:
                                   *per_stage_params)
 
 
+def vpp_device_major_order(p: int, v: int):
+    """Megatron VPP placement as a position list: stacked position
+    r*v + j holds global stage j*p + r (device-major), so sharding dim 0
+    over ``pp`` hands rank r exactly its chunks in chunk order.  Returns
+    (order, inverse): ``stacked[i] = stages[order[i]]`` and
+    ``stages[s] = stacked[inverse[s]]``."""
+    order = [j * p + r for r in range(p) for j in range(v)]
+    inv = [0] * (p * v)
+    for pos, st in enumerate(order):
+        inv[st] = pos
+    return order, inv
+
+
 def stack_stage_params_interleaved(per_stage_params: list, p: int) -> Any:
     """Stack per-GLOBAL-stage params for a VPP run: with v chunks per rank,
     device r holds global stages {r, r+p, ..., r+(v-1)p} (Megatron VPP
@@ -97,8 +110,7 @@ def stack_stage_params_interleaved(per_stage_params: list, p: int) -> Any:
     """
     n = len(per_stage_params)
     assert n % p == 0, f"{n} stages not divisible by {p} ranks"
-    v = n // p
-    order = [j * p + r for r in range(p) for j in range(v)]
+    order, _ = vpp_device_major_order(p, n // p)
     return jax.tree_util.tree_map(
         lambda *xs: jnp.stack([xs[i] for i in order], axis=0),
         *per_stage_params)
