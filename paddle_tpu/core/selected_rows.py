@@ -39,6 +39,10 @@ class SelectedRows:
         """Gather the value rows for ``keys`` (missing keys -> zeros,
         the reference's AutoGrownIndex read path simplified)."""
         keys = jnp.asarray(keys, jnp.int32)
+        if self.rows.size == 0:
+            # a shard that received no rows answers zeros for every key
+            return jnp.zeros((keys.shape[0],) + self.value.shape[1:],
+                             self.value.dtype)
         eq = self.rows[None, :] == keys[:, None]          # [k, n]
         hit = eq.any(axis=1)
         idx = jnp.argmax(eq, axis=1)

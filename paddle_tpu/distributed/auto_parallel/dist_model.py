@@ -169,7 +169,11 @@ class DistModel:
 
     def _apply(self, params, grads, opt_state, step_no, lr):
         names = list(params.keys())
-        no_decay = {n for n in names if "norm" in n.lower()
+        # match llama_hybrid's rule exactly: a bare "norm" substring
+        # would silently un-decay unrelated params ("normal_proj"...)
+        no_decay = {n for n in names
+                    if "layernorm" in n.lower()
+                    or n.lower().endswith("norm.weight")
                     or n.endswith(".bias")}
         return self._optimizer.apply(
             params, grads, opt_state, lr, step_no + 1,

@@ -94,6 +94,10 @@ def _parse_multislot_line(line: str, slots: Sequence[str],
             raise ValueError(f"truncated MultiSlot line at slot {slot!r}")
         n = int(toks[i])
         vals = toks[i + 1:i + 1 + n]
+        if len(vals) != n:
+            raise ValueError(
+                f"truncated MultiSlot line: slot {slot!r} declares {n} "
+                f"values but only {len(vals)} remain")
         i += 1 + n
         dt = dtypes.get(slot, "int64")
         out[slot] = np.asarray(

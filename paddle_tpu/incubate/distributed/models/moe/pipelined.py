@@ -1,0 +1,96 @@
+"""Pipelined MoE blocks: pp x ep x mp composition in one program.
+
+The shared harness behind the 8-device dryrun leg and
+tests/test_gpt_moe.py::test_moe_pipeline_ep_mp_composition: a stack of
+MoE-FFN residual blocks pipelined over ``pp`` (layer-major chunks,
+pipeline_apply dataflow) with experts Shard(ep) and expert hidden dims
+Shard(mp) left to GSPMD.  Reference analog: MoE transformer blocks as
+PipelineLayer segments under expert parallelism
+(incubate/distributed/models/moe/moe_layer.py:263 + pp_layers.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .moe_layer import _moe_forward_op
+from .....parallel.pipelining import pipeline_apply
+
+MOE_BLOCK_SPECS = {
+    "gate_w": P("pp", None, None),
+    "w_up": P("pp", "ep", None, "mp"),
+    "b_up": P("pp", "ep", "mp"),
+    "w_down": P("pp", "ep", "mp", None),
+    "b_down": P("pp", "ep", None),
+}
+
+
+def init_pipelined_moe_params(mesh: Mesh, num_layers: int, num_expert: int,
+                              d_model: int, d_hidden: int,
+                              seed: int = 0) -> Dict[str, Any]:
+    """Layer-major [L, E, ...] expert stacks placed per MOE_BLOCK_SPECS."""
+    rng = np.random.RandomState(seed)
+    params = {
+        "gate_w": jnp.asarray(
+            rng.randn(num_layers, d_model, num_expert).astype(np.float32)),
+        "w_up": jnp.asarray(rng.randn(
+            num_layers, num_expert, d_model, d_hidden).astype(np.float32)
+            * 0.3),
+        "b_up": jnp.zeros((num_layers, num_expert, d_hidden), jnp.float32),
+        "w_down": jnp.asarray(rng.randn(
+            num_layers, num_expert, d_hidden, d_model).astype(np.float32)
+            * 0.3),
+        "b_down": jnp.zeros((num_layers, num_expert, d_model), jnp.float32),
+    }
+    return {k: jax.device_put(v, NamedSharding(mesh, MOE_BLOCK_SPECS[k]))
+            for k, v in params.items()}
+
+
+def moe_block(lp: Dict[str, Any], act, topk: int = 2):
+    """One residual MoE-FFN block on raw arrays (capacity = full batch,
+    i.e. no dropping — the parity-friendly setting)."""
+    y, _ = _moe_forward_op.raw_fn(
+        act, lp["gate_w"], lp["w_up"], lp["b_up"], lp["w_down"],
+        lp["b_down"], topk=topk, capacity=act.shape[0], aux_fn=None)
+    return act + y
+
+
+def pipelined_moe_forward(params: Dict[str, Any], x, mesh: Mesh,
+                          topk: int = 2):
+    """Run [m, mb, d_model] micro-batches through the pipelined MoE
+    stack; returns [m, mb, d_model] (valid everywhere — last-stage psum
+    broadcast)."""
+
+    def stage_fn(sp, act):
+        act, _ = jax.lax.scan(
+            lambda h, lp: (moe_block(lp, h, topk=topk), None), act, sp)
+        return act
+
+    def body(sp, x):
+        outs = pipeline_apply(stage_fn, sp, x, axis="pp",
+                              squeeze_stage_dim=False)
+        last = (jax.lax.axis_index("pp")
+                == jax.lax.axis_size("pp") - 1).astype(outs.dtype)
+        return jax.lax.psum(outs * last, "pp")
+
+    with jax.sharding.set_mesh(mesh):
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, axis_names={"pp"},
+            in_specs=(P("pp"), P(None)), out_specs=P(None),
+            check_vma=False))(params, x)
+
+
+def sequential_moe_forward(params: Dict[str, Any], x, topk: int = 2):
+    """Unsharded sequential reference for parity checks."""
+    num_layers = params["gate_w"].shape[0]
+    ref = x
+    for i in range(num_layers):
+        lp = {k: v[i] for k, v in params.items()}
+        ref = jnp.stack([moe_block(lp, ref[j], topk=topk)
+                         for j in range(x.shape[0])])
+    return ref

@@ -108,10 +108,12 @@ def _quantize_int8(w: np.ndarray):
 
 
 def _dequantize_int8(q, scale, dtype):
+    """Device-resident dequant (no host round-trip: run() calls this in
+    the serving hot path)."""
     from ..ops.yaml import _impl as _yimpl
 
-    return np.asarray(_yimpl.weight_dequantize(
-        jnp.asarray(q), jnp.asarray(scale, jnp.float32))).astype(dtype)
+    return _yimpl.weight_dequantize(
+        jnp.asarray(q), jnp.asarray(scale, jnp.float32)).astype(dtype)
 
 
 class Predictor:
@@ -204,8 +206,17 @@ class Predictor:
             self._traced._state = None  # release the fp32 copy
 
     def _materialize_state(self):
+        """Signature-dtype weights from the low-precision store.  With
+        Config.memory_optim (default) this runs per call — the dequant is
+        cheap elementwise device work and the low-precision copy stays
+        the only resident one (the point of convert-on-load); with
+        memory_optim=False the materialized set is cached for
+        lowest-latency serving (memory back to full precision)."""
         if self._qstate is None:
             return None
+        cached = self._qstate.get("cache")
+        if cached is not None:
+            return cached
         out = {}
         for k, v in self._qstate["store"].items():
             od = self._qstate["orig_dtype"].get(k)
@@ -215,7 +226,9 @@ class Predictor:
                 out[k] = jnp.asarray(v).astype(od)
             else:
                 q, s = v
-                out[k] = jnp.asarray(_dequantize_int8(q, s, od))
+                out[k] = _dequantize_int8(jnp.asarray(q), s, od)
+        if self._config is not None and not self._config.memory_optim:
+            self._qstate["cache"] = out
         return out
 
     # ------------------------------------------------------- IO surface

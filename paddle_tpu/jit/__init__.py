@@ -52,9 +52,11 @@ class TracedLayer:
         self._cache: Dict[Any, Any] = {}
         self._compiled = None
         # graph-break policy (reference SOT default: fall back to eager;
-        # full_graph=True makes a break an error, jit.to_static kwarg)
+        # full_graph=True makes a break an error, jit.to_static kwarg).
+        # Breaks are tracked PER INPUT SIGNATURE: a shape that traced
+        # fine keeps its compiled path even after another shape broke.
         self._allow_fallback = not full_graph
-        self._fell_back = False
+        self._broken_sigs: set = set()
         if self._is_layer:
             layer = fn_or_layer
 
@@ -87,7 +89,16 @@ class TracedLayer:
                                          is_leaf=lambda x: isinstance(x, Tensor))
         from ..common import flags as _flags
 
-        if self._fell_back:
+        def _sig():
+            leaves, td = jax.tree_util.tree_flatten(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+            return (td, tuple(
+                (tuple(v.shape), str(getattr(v, "dtype", type(v).__name__)))
+                if hasattr(v, "shape") else ("scalar", repr(v)[:32])
+                for v in leaves))
+
+        sig = _sig() if self._broken_sigs else None
+        if sig is not None and sig in self._broken_sigs:
             return self._target(*args, **kwargs)
         # debug IR dumps trace the callable too — a graph-breaking target
         # must reach the fallback below, not crash inside a dump, so the
@@ -144,7 +155,7 @@ class TracedLayer:
             # from now on (dygraph fallback) instead of erroring out.
             if not self._allow_fallback:
                 raise
-            self._fell_back = True
+            self._broken_sigs.add(_sig())
             import warnings
 
             tgt = getattr(self._target, "__name__",

@@ -629,11 +629,15 @@ def flash_attn_unpadded_raw(q, k, v, cu_seqlens_q, cu_seqlens_k,
     # flat layout has one long sequence axis (b=1), so grid-trip overhead
     # per skipped tile dominates at 512 tiles (measured v5e: 1024x1024
     # turns a 0.95x parity into a 1.3x win over dense-masked at ~30%
-    # padding); small totals fall back to one tile
+    # padding).  The block size is FIXED at 1024, not min(1024, total):
+    # a block clipped to an unaligned total (e.g. 1001) violates
+    # Mosaic's (8, 128) tile alignment; Pallas instead pads a smaller
+    # array into the full block and the kernel's seq_q/seq_k masks keep
+    # the padding out of real rows (tests/test_pallas_flash varlen
+    # shapes like 24 rely on this)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    blocks = (min(1024, total_q), min(1024, total_k)) if not interpret \
-        else None
+    blocks = (1024, 1024) if not interpret else None
     out = flash_attention_raw(q[None], k[None], v[None], causal=causal,
                               scale=scale, interpret=interpret,
                               q_segment_ids=qs[None],

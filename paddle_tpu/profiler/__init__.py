@@ -50,11 +50,13 @@ class RecordEvent:
     def end(self):
         if self._begin is None or not _recording[0]:
             return
+        import threading
+
         _host_events.append({
             "name": self.name, "cat": self.event_type, "ph": "X",
             "ts": self._begin / 1000.0,
             "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
-            "pid": os.getpid(), "tid": 0,
+            "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFF,
         })
 
     def __enter__(self):
@@ -142,33 +144,41 @@ def summarize_events(events, time_unit="ms", top_n: int = 30) -> str:
     overhead), so per-name ratios sum to <= 100% of the profiled wall
     span.  Also works on an EXPORTED trace: ``summarize_chrome_trace``."""
     div = {"s": 1e6, "ms": 1e3, "us": 1.0}[time_unit]
-    spans = sorted((e for e in events if e.get("ph") == "X"),
-                   key=lambda e: (e["ts"], -e["dur"]))
-    # interval sweep: a span starting inside the currently-open span is
-    # its child — subtract the child's (inclusive) duration from the
-    # parent's self time (direct children only; grandchildren already
-    # reduced the child)
-    self_time = [e["dur"] for e in spans]
-    open_stack: list = []
-    lo, hi = float("inf"), 0.0
-    for i, e in enumerate(spans):
-        ts, dur = e["ts"], e["dur"]
-        while open_stack and ts >= spans[open_stack[-1]]["ts"] \
-                + spans[open_stack[-1]]["dur"] - 1e-9:
-            open_stack.pop()
-        if open_stack:
-            self_time[open_stack[-1]] -= dur
-        open_stack.append(i)
-        lo = min(lo, ts)
-        hi = max(hi, ts + dur)
+    # interval sweep PER (pid, tid): nesting only holds within one
+    # thread — mixing threads would subtract unrelated concurrent spans
+    # from each other's self time
+    by_thread: Dict[tuple, list] = {}
+    for e in events:
+        if e.get("ph") == "X":
+            by_thread.setdefault((e.get("pid", 0), e.get("tid", 0)),
+                                 []).append(e)
     stats: Dict[str, list] = {}
-    for i, e in enumerate(spans):
-        st = max(self_time[i], 0.0)
-        s = stats.setdefault(e["name"], [0, 0.0, 0.0, float("inf")])
-        s[0] += 1
-        s[1] += st
-        s[2] = max(s[2], st)
-        s[3] = min(s[3], st)
+    lo, hi = float("inf"), 0.0
+    for spans in by_thread.values():
+        spans.sort(key=lambda e: (e["ts"], -e["dur"]))
+        # a span starting inside the currently-open span is its child —
+        # subtract the child's (inclusive) duration from the parent's
+        # self time (direct children only; grandchildren already
+        # reduced the child)
+        self_time = [e["dur"] for e in spans]
+        open_stack: list = []
+        for i, e in enumerate(spans):
+            ts, dur = e["ts"], e["dur"]
+            while open_stack and ts >= spans[open_stack[-1]]["ts"] \
+                    + spans[open_stack[-1]]["dur"] - 1e-9:
+                open_stack.pop()
+            if open_stack:
+                self_time[open_stack[-1]] -= dur
+            open_stack.append(i)
+            lo = min(lo, ts)
+            hi = max(hi, ts + dur)
+        for i, e in enumerate(spans):
+            st = max(self_time[i], 0.0)
+            s = stats.setdefault(e["name"], [0, 0.0, 0.0, float("inf")])
+            s[0] += 1
+            s[1] += st
+            s[2] = max(s[2], st)
+            s[3] = min(s[3], st)
     wall = max(hi - lo, 1e-9)
     header = (f"{'Name':<36}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
               f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"

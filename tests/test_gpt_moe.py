@@ -270,65 +270,23 @@ def test_moe_layer_dropless_flag():
 def test_moe_pipeline_ep_mp_composition(cpu_mesh8):
     """MoE blocks pipelined over pp with experts sharded over ep AND
     expert hidden dims Megatron-sharded over mp — ep x mp x pp all > 1 in
-    ONE compiled program (round-2 verdict item 7's composition leg)."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
-        _moe_forward_op
-    from paddle_tpu.parallel.pipelining import pipeline_apply
+    ONE compiled program (round-2 verdict item 7's composition leg).
+    Uses the SAME harness the driver dryrun runs (moe.pipelined), plus a
+    sequential parity check."""
+    from jax.sharding import Mesh
+    from paddle_tpu.incubate.distributed.models.moe.pipelined import (
+        init_pipelined_moe_params, pipelined_moe_forward,
+        sequential_moe_forward)
 
     devs = np.asarray(jax.devices("cpu")[:8], dtype=object).reshape(2, 2, 2)
     mesh = Mesh(devs, ("pp", "ep", "mp"))
-    L, E, dm, dh = 2, 4, 8, 16
-    m_micro, mb = 2, 8
+    params = init_pipelined_moe_params(mesh, num_layers=2, num_expert=4,
+                                       d_model=8, d_hidden=16)
     rng = np.random.RandomState(0)
-    params = {
-        "gate_w": jnp.asarray(rng.randn(L, dm, E).astype(np.float32)),
-        "w_up": jnp.asarray(rng.randn(L, E, dm, dh).astype(np.float32) * .3),
-        "b_up": jnp.zeros((L, E, dh), jnp.float32),
-        "w_down": jnp.asarray(rng.randn(L, E, dh, dm).astype(np.float32) * .3),
-        "b_down": jnp.zeros((L, E, dm), jnp.float32),
-    }
-    specs = {
-        "gate_w": P("pp", None, None),
-        "w_up": P("pp", "ep", None, "mp"),
-        "b_up": P("pp", "ep", "mp"),
-        "w_down": P("pp", "ep", "mp", None),
-        "b_down": P("pp", "ep", None),
-    }
-    placed = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
-              for k, v in params.items()}
-    x = jnp.asarray(rng.randn(m_micro, mb, dm).astype(np.float32))
-
-    def moe_block(lp, act):
-        y, _ = _moe_forward_op.raw_fn(
-            act, lp["gate_w"], lp["w_up"], lp["b_up"], lp["w_down"],
-            lp["b_down"], topk=2, capacity=act.shape[0], aux_fn=None)
-        return act + y
-
-    def stage_fn(sp, act):
-        act, _ = jax.lax.scan(lambda h, lp: (moe_block(lp, h), None),
-                              act, sp)
-        return act
-
-    def body(sp, x):
-        outs = pipeline_apply(stage_fn, sp, x, axis="pp",
-                              squeeze_stage_dim=False)
-        is_last = (jax.lax.axis_index("pp")
-                   == jax.lax.axis_size("pp") - 1).astype(outs.dtype)
-        return jax.lax.psum(outs * is_last, "pp")
-
-    with jax.sharding.set_mesh(mesh):
-        out = jax.jit(jax.shard_map(
-            body, mesh=mesh, axis_names={"pp"},
-            in_specs=(P("pp"), P(None)), out_specs=P(None),
-            check_vma=False))(placed, x)
-
-    # sequential reference, unsharded
-    ref = x
-    for i in range(L):
-        lp = {k: v[i] for k, v in params.items()}
-        ref = jnp.stack([moe_block(lp, ref[j])
-                         for j in range(m_micro)])
+    x = jnp.asarray(rng.randn(2, 8, 8).astype(np.float32))
+    out = pipelined_moe_forward(params, x, mesh)
+    host_params = {k: jnp.asarray(np.asarray(v)) for k, v in params.items()}
+    ref = sequential_moe_forward(host_params, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=5e-4, atol=5e-5)
 
