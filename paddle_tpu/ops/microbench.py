@@ -19,9 +19,16 @@ def _time_loop(fn, n: int, sync) -> float:
     """ops/sec with a sync EVERY call: both the dispatch and raw paths
     enqueue asynchronously (PJRT), and over a tunneled TPU the enqueue
     rate wildly overstates raw jnp (one early run showed a bogus 72x
-    'overhead') — per-call completion is the apples-to-apples latency."""
+    'overhead') — per-call completion is the apples-to-apples latency.
+    ``n`` shrinks adaptively when a single call is slow (degraded tunnel
+    RTTs of ~100ms would otherwise blow the bench's time budget)."""
     fn()  # warm (compile/cache fill)
     sync()
+    t0 = time.perf_counter()
+    sync(fn())
+    probe = time.perf_counter() - t0
+    if probe > 5e-3:
+        n = max(10, min(n, int(2.0 / probe)))  # cap ~2s per measurement
     t0 = time.perf_counter()
     for _ in range(n):
         sync(fn())
