@@ -245,13 +245,18 @@ def _varlen_vs_dense_bench():
         q, q, q, causal=True, interpret=False,
         q_segment_ids=seg, kv_segment_ids=seg))
 
-    def _time(fn, x, steps=20):
+    def _time(fn, x, steps=20, windows=3):
+        # best-of-N windows: the tunnel adds high-variance queueing noise
+        # (same methodology as the headline measurement)
         fn(x).block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(x)
-        out.block_until_ready()
-        return (time.perf_counter() - t0) / steps
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(x)
+            out.block_until_ready()
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best
 
     tp = _time(packed, qp)
     td = _time(dense, qd)
