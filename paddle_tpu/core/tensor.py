@@ -40,7 +40,10 @@ class Tensor:
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
             value = value._value
-        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+        if not isinstance(value, (jax.Array, jax.core.Tracer)) \
+                and not getattr(value, "_lazy_tensor_value_", False):
+            # jit.sot.LazyArray passes through un-asarray'd: coercing it
+            # here would force-flush the pending SOT segment
             value = jnp.asarray(value)
         self._value = value
         self.stop_gradient = bool(stop_gradient)

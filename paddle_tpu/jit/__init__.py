@@ -57,6 +57,8 @@ class TracedLayer:
         # fine keeps its compiled path even after another shape broke.
         self._allow_fallback = not full_graph
         self._broken_sigs: set = set()
+        self._sot = None          # SegmentRunner, created on first break
+        self._sot_disabled = False
         if self._is_layer:
             layer = fn_or_layer
 
@@ -99,7 +101,7 @@ class TracedLayer:
 
         sig = _sig() if self._broken_sigs else None
         if sig is not None and sig in self._broken_sigs:
-            return self._target(*args, **kwargs)
+            return self._run_broken(args, kwargs)
         # debug IR dumps trace the callable too — a graph-breaking target
         # must reach the fallback below, not crash inside a dump, so the
         # dumps themselves swallow tracer errors
@@ -150,12 +152,17 @@ class TracedLayer:
             # GRAPH BREAK: data-dependent host control flow the tracer
             # cannot capture.  The reference's SOT handles this with
             # bytecode-level graph breaks (python/paddle/jit/sot/
-            # translate.py:31, pybind/sot/eval_frame.c); the function-
-            # level translation is: warn once, run this callable eagerly
-            # from now on (dygraph fallback) instead of erroring out.
+            # translate.py:31, pybind/sot/eval_frame.c); the op-level
+            # translation (jit/sot.py): warn once, then run this
+            # callable as compiled SUBGRAPHS split at each host
+            # materialisation point, with the host glue eager between
+            # them — not whole-callable eager.
             if not self._allow_fallback:
                 raise
             self._broken_sigs.add(_sig())
+            from . import sot as _sot
+
+            _sot._STATS["breaks"] += 1
             import warnings
 
             tgt = getattr(self._target, "__name__",
@@ -163,13 +170,52 @@ class TracedLayer:
             warnings.warn(
                 f"to_static({tgt}): tracing hit data-dependent Python "
                 f"control flow ({type(e).__name__}); falling back to "
-                "eager execution for this callable. NOTE: host side "
-                "effects before the break ran during tracing AND run "
-                "again eagerly on this call. Rewrite the branch with "
-                "lax.cond/where, or pass full_graph=True to make this "
-                "an error.", stacklevel=2)
-            return self._target(*args, **kwargs)
+                "subgraph (SOT) execution for this callable: the op "
+                "sequences between host materialisation points compile "
+                "as separate XLA executables, host control flow runs "
+                "eagerly between them. NOTE: host side effects before "
+                "the break ran during tracing AND run again on this "
+                "call. Rewrite the branch with lax.cond/where for one "
+                "fused graph, or pass full_graph=True to make this an "
+                "error.", stacklevel=2)
+            return self._run_broken(args, kwargs)
         return jax.tree_util.tree_map(_wrap, out)
+
+    def _run_broken(self, args, kwargs):
+        """Execute a graph-breaking callable: segmented (subgraph-
+        compiled) when gradients aren't required, plain eager when the
+        tape must record (segments are pure-fn replays, invisible to the
+        tape) or when segmentation itself failed before."""
+        from . import sot as _sot_probe
+
+        def _any_requires_grad():
+            leaves = jax.tree_util.tree_leaves(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+            if self._is_layer:
+                # training a graph-broken Layer must keep the tape: the
+                # trainable leaves are its PARAMETERS, not the inputs
+                leaves = list(leaves) + list(self._target.parameters())
+            return any(isinstance(t, Tensor) and t._requires_grad()
+                       for t in leaves)
+
+        needs_tape = _tape.is_grad_enabled() and _any_requires_grad()
+        if (self._sot_disabled or needs_tape
+                or _sot_probe.active_runner() is not None):
+            return self._target(*args, **kwargs)
+        from . import sot as _sot
+
+        if self._sot is None:
+            self._sot = _sot.SegmentRunner()
+        try:
+            with _tape.no_grad():
+                with _sot.segmented(self._sot):
+                    out = self._target(*args, **kwargs)
+                return self._sot.finalize(out)
+        except Exception:
+            # segmentation is an optimisation — never a correctness
+            # cliff.  Disable it for this callable and run plain eager.
+            self._sot_disabled = True
+            return self._target(*args, **kwargs)
 
     # introspection ---------------------------------------------------------
     def lower(self, *args, **kwargs):

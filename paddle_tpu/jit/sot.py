@@ -1,0 +1,381 @@
+"""Function-level SOT: subgraph compilation around graph breaks.
+
+The reference compiles the bytecode BETWEEN graph breaks
+(python/paddle/jit/sot/translate.py:31, paddle/fluid/pybind/sot/
+eval_frame.c): a model with one host-side branch still runs mostly
+compiled.  The TPU-native translation works at the op-dispatch layer
+instead of the bytecode layer:
+
+- In *segmented* mode every ``dispatch()`` call records (op, wiring)
+  into a pending segment and returns a lazy tensor (aval known via
+  ``jax.eval_shape`` — the InferMeta analog) without executing anything.
+- The moment host Python needs a concrete value (``bool()``/``int()``/
+  ``float()``/``.numpy()``/``.item()`` on a lazy tensor — exactly the
+  operations that raise TracerBoolConversionError under ``jax.jit``) the
+  pending segment is FLUSHED: compiled as ONE jitted function and
+  executed.  The host branch then runs on concrete values, and
+  subsequent ops open a new segment.
+- Segment executables are cached by (op sequence, wiring, input avals):
+  repeat calls with the same shapes and the same host path re-use the
+  compiled segments (assertable via :func:`sot_stats`).
+
+So a callable with a data-dependent host branch executes as N compiled
+subgraphs + host glue instead of falling back to per-op eager — the
+function-level equivalent of SOT's bytecode splitting.  Recording costs
+Python per op (same order as eager dispatch); the win is XLA fusing each
+segment across ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LazyArray", "SegmentRunner", "segmented", "sot_stats",
+           "reset_sot_stats"]
+
+# the active-runner cell (thread-local) and fallthrough sentinel live on
+# the registry so dispatch() checks them without importing this module
+from ..ops.registry import _SOT_FALLTHROUGH as FALLTHROUGH  # noqa: E402
+from ..ops.registry import _SOT_TLS  # noqa: E402
+
+
+def active_runner():
+    return getattr(_SOT_TLS, "rec", None)
+
+_STATS = {"segments_compiled": 0, "segments_hit": 0, "flushes": 0,
+          "breaks": 0}
+
+
+def sot_stats() -> Dict[str, int]:
+    return dict(_STATS)
+
+
+def reset_sot_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+class LazyArray:
+    """Placeholder for a not-yet-executed op output.  Duck-types the
+    jax.Array surface Tensor uses for metadata (shape/ndim/dtype) and
+    flushes the owning segment on any host materialisation."""
+
+    __slots__ = ("aval", "_runner", "_concrete", "_env_idx", "_epoch")
+    _lazy_tensor_value_ = True  # Tensor.__init__ pass-through marker
+
+    def __init__(self, aval, runner, env_idx, epoch):
+        self.aval = aval
+        self._runner = runner
+        self._concrete = None
+        self._env_idx = env_idx    # position in the segment env (O(1)
+        self._epoch = epoch        # wiring lookup); valid while epoch
+        #                            matches the runner's current one
+
+    # -- metadata (no flush) ------------------------------------------------
+    @property
+    def shape(self):
+        return self.aval.shape
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval.shape)) if self.aval.shape else 1
+
+    # -- materialisation (graph break points) -------------------------------
+    def force(self):
+        if self._concrete is None:
+            if self._runner is None:
+                raise RuntimeError(
+                    "lazy tensor escaped an aborted SOT segment (the "
+                    "segmented call raised before this value was "
+                    "computed); it has no value")
+            self._runner.flush()
+            if self._concrete is None:
+                raise RuntimeError(
+                    "lazy tensor was not materialised by its segment "
+                    "flush (escaped a cleared segment)")
+        return self._concrete
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __bool__(self):
+        return bool(self.force())
+
+    def __int__(self):
+        return int(self.force())
+
+    def __float__(self):
+        return float(self.force())
+
+    def __index__(self):
+        return int(self.force())
+
+    def item(self):
+        return self.force().item()
+
+    def __len__(self):
+        if self.aval.shape:
+            return self.aval.shape[0]
+        raise TypeError("len() of a 0-d lazy tensor")
+
+    def __repr__(self):
+        state = "pending" if self._concrete is None else "materialized"
+        return (f"LazyArray(shape={tuple(self.aval.shape)}, "
+                f"dtype={self.aval.dtype}, {state})")
+
+
+class _Node:
+    __slots__ = ("op_name", "fn", "treedef", "slots", "statics",
+                 "out_treedef", "outs")
+
+    def __init__(self, op_name, fn, treedef, slots, statics):
+        self.op_name = op_name
+        self.fn = fn
+        self.treedef = treedef
+        # slots: per-leaf descriptor ('lazy', seg_out_index) |
+        #        ('ext', ext_index) | ('static', static_index)
+        self.slots = slots
+        self.statics = statics
+
+
+class SegmentRunner:
+    """Records op dispatches into segments and compiles each segment as
+    one XLA executable on flush.  One instance per TracedLayer; the
+    compiled-segment cache lives on the instance (cleared with it)."""
+
+    # compiled-segment cache cap: a per-call-varying STATIC python
+    # scalar in the op stream (step counter passed positionally...)
+    # makes every call a new segment key; FIFO eviction bounds the
+    # memory instead of leaking a compiled executable per step
+    CACHE_CAP = 128
+
+    def __init__(self):
+        self.nodes: List[_Node] = []
+        # flat environment of this segment's produced LazyArrays, in
+        # creation order (node outputs are contiguous runs)
+        self.env: List[LazyArray] = []
+        self.epoch = 0            # bumped per flush; validates _env_idx
+        self.ext_vals: List[Any] = []
+        self.ext_ids: Dict[int, int] = {}
+        self.cache: Dict[Any, Any] = {}
+        self.segments_run = 0
+
+    # -- recording ----------------------------------------------------------
+    def _fallthrough(self, args, kwargs):
+        """Flush, make arg tensors concrete, and signal the normal eager
+        dispatch path."""
+        from ..core.tensor import Tensor
+
+        self.flush()
+        for leaf in jax.tree_util.tree_leaves(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)):
+            if isinstance(leaf, Tensor) and isinstance(leaf._value,
+                                                       LazyArray):
+                leaf._value = leaf._value.force()
+        return FALLTHROUGH
+
+    def record(self, op, args, kwargs):
+        """Record one dispatch; returns wrapped outputs, or FALLTHROUGH
+        when the op must run eagerly (after flushing)."""
+        from ..ops import registry as _reg
+
+        if not op.cacheable or _reg.amp_state() is not None:
+            return self._fallthrough(args, kwargs)
+
+        from ..core.tensor import Tensor
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        slots, statics, avals = [], [], []
+        for leaf in leaves:
+            v = leaf._value if isinstance(leaf, Tensor) else leaf
+            if isinstance(v, LazyArray):
+                if v._concrete is not None:
+                    v = v._concrete
+                    if isinstance(leaf, Tensor):
+                        leaf._value = v  # write back, stop re-checking
+                elif v._runner is not self:
+                    v = v.force()
+            if isinstance(v, LazyArray):
+                if v._epoch == self.epoch and v._runner is self:
+                    slots.append(("lazy", v._env_idx))
+                    avals.append(v.aval)
+                    continue
+                # produced by an already-flushed segment (or another
+                # runner) — force to a concrete value
+                v = v.force()
+            if isinstance(v, (jax.Array, np.ndarray)) or np.isscalar(v) \
+                    and isinstance(v, (np.floating, np.integer)):
+                eid = self.ext_ids.get(id(v))
+                if eid is None:
+                    eid = len(self.ext_vals)
+                    self.ext_vals.append(v)
+                    self.ext_ids[id(v)] = eid
+                slots.append(("ext", eid))
+                avals.append(jax.ShapeDtypeStruct(np.shape(v),
+                                                  np.asarray(v).dtype
+                                                  if not isinstance(v, jax.Array)
+                                                  else v.dtype))
+                continue
+            # static python value (int/float/bool/str/None/tuple...)
+            slots.append(("static", len(statics)))
+            statics.append(v)
+            avals.append(None)
+
+        # shape inference = segment-free eval_shape of this op alone
+        def apply(flat_dyn):
+            full = []
+            it = iter(flat_dyn)
+            for s, a in zip(slots, avals):
+                full.append(next(it) if a is not None else statics[s[1]])
+            a_, k_ = jax.tree_util.tree_unflatten(treedef, full)
+            return op.fn(*a_, **k_)
+
+        dyn_avals = [a for a in avals if a is not None]
+        try:
+            out_shape = jax.eval_shape(apply, dyn_avals)
+        except Exception:
+            # data-dependent inside the op — flush and run it eagerly
+            return self._fallthrough(args, kwargs)
+
+        node = _Node(op.name, op.fn, treedef, slots, statics)
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(out_shape)
+        node.out_treedef = out_treedef
+        outs = []
+        for o in out_leaves:
+            la = LazyArray(jax.ShapeDtypeStruct(o.shape, o.dtype), self,
+                           len(self.env), self.epoch)
+            self.env.append(la)
+            outs.append(la)
+        node.outs = outs
+        self.nodes.append(node)
+        out_tree = jax.tree_util.tree_unflatten(out_treedef, outs)
+        return _wrap_like(op, out_tree)
+
+    # -- flushing -----------------------------------------------------------
+    def _segment_key(self):
+        parts = []
+        for n in self.nodes:
+            parts.append((n.op_name, str(n.treedef), tuple(n.slots),
+                          tuple(repr(s) for s in n.statics)))
+        ext_sig = tuple((tuple(np.shape(v)),
+                         str(v.dtype if isinstance(v, jax.Array)
+                             else np.asarray(v).dtype))
+                        for v in self.ext_vals)
+        return (tuple(parts), ext_sig)
+
+    def flush(self):
+        if not self.nodes:
+            self.ext_vals, self.ext_ids = [], {}
+            self.epoch += 1
+            return
+        _STATS["flushes"] += 1
+        nodes, env = self.nodes, self.env
+        ext_vals = self.ext_vals
+        key = self._segment_key()
+        compiled = self.cache.get(key)
+        if compiled is None:
+            _STATS["segments_compiled"] += 1
+            # node/env lists are captured by value (the wiring in `key`
+            # guarantees any later call with this key replays identically)
+            snap_nodes = list(nodes)
+
+            def replay(ext):
+                environ: List[Any] = []
+                for n in snap_nodes:
+                    full = []
+                    for s in n.slots:
+                        kind, idx = s
+                        if kind == "lazy":
+                            full.append(environ[idx])
+                        elif kind == "ext":
+                            full.append(ext[idx])
+                        else:
+                            full.append(n.statics[idx])
+                    a_, k_ = jax.tree_util.tree_unflatten(n.treedef, full)
+                    out = n.fn(*a_, **k_)
+                    environ.extend(jax.tree_util.tree_leaves(out))
+                return environ
+
+            if len(self.cache) >= self.CACHE_CAP:
+                self.cache.pop(next(iter(self.cache)))  # FIFO evict
+            compiled = self.cache[key] = jax.jit(replay)
+        else:
+            _STATS["segments_hit"] += 1
+        results = compiled([jnp.asarray(v) for v in ext_vals])
+        for la, val in zip(env, results):
+            la._concrete = val
+        self.segments_run += 1
+        self.epoch += 1
+        self.nodes, self.env = [], []
+        self.ext_vals, self.ext_ids = [], {}
+
+    def finalize(self, out_tree):
+        """Flush the trailing segment and replace lazy leaves of the
+        callable's outputs with concrete arrays."""
+        from ..core.tensor import Tensor
+
+        def mat(x):
+            if isinstance(x, Tensor) and isinstance(x._value, LazyArray):
+                x._value = x._value.force()
+            elif isinstance(x, LazyArray):
+                return x.force()
+            return x
+
+        out = jax.tree_util.tree_map(
+            mat, out_tree, is_leaf=lambda x: isinstance(x, (Tensor,
+                                                            LazyArray)))
+        self.flush()
+        return out
+
+
+def _wrap_like(op, out_tree):
+    """Wrap LazyArray outputs the way _wrap_outputs wraps arrays."""
+    from ..core.tensor import Tensor
+
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x, stop_gradient=True)
+        if isinstance(x, LazyArray) else x, out_tree,
+        is_leaf=lambda x: isinstance(x, LazyArray))
+
+
+class segmented:
+    """Context manager activating segmented (subgraph-compiled) execution
+    for the current thread's eager dispatches."""
+
+    def __init__(self, runner: SegmentRunner):
+        self.runner = runner
+
+    def __enter__(self):
+        if getattr(_SOT_TLS, "rec", None) is not None:
+            raise RuntimeError("nested segmented execution")
+        _SOT_TLS.rec = self.runner
+        return self.runner
+
+    def __exit__(self, exc_type, exc, tb):
+        _SOT_TLS.rec = None
+        if exc_type is None:
+            self.runner.flush()
+        else:
+            # abort pending work: orphan the escaped lazies so touching
+            # one raises (force() checks _runner) instead of yielding
+            # a silent None
+            for la in self.runner.env:
+                la._runner = None
+            self.runner.nodes, self.runner.env = [], []
+            self.runner.ext_vals, self.runner.ext_ids = [], {}
+            self.runner.epoch += 1
+        return False

@@ -172,6 +172,56 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     return out
 
 
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True):
+    """FlashMask column-sparse-mask attention — analog of
+    paddle.nn.functional.flashmask_attention (python/paddle/nn/functional/
+    flash_attention.py:1098; op paddle/phi/ops/yaml/ops.yaml:1913).
+
+    ``startend_row_indices`` [b, mh, sk, {1,2,4}] int32 encodes per-column
+    masked row bands (causal document mask, share-question mask, sliding
+    window, global+window...).  Runs the Pallas flash kernel with
+    mask-driven block skipping; the 4-bound non-causal class the
+    reference leaves NotImplementedError is supported here."""
+    from ...ops.registry import dispatch
+
+    out = dispatch("flashmask_attention", query, key, value,
+                   startend_row_indices,
+                   dropout=dropout if training else 0.0, causal=causal,
+                   window_size=window_size)
+    extras = []
+    if return_softmax_lse:
+        extras.append(None)   # lse is a kernel residual, not re-exposed
+    if return_seed_offset:
+        extras.append(None)
+    if extras:
+        return (out, *extras)
+    return out
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name="", varlen_padded=True,
+                                training=True):
+    """Varlen attention on a GQA-packed qkv tensor [total, h/kvh + 2,
+    kvh, d] — analog of paddle.nn.functional.flash_attn_varlen_qkvpacked
+    (python/paddle/nn/functional/flash_attention.py:848)."""
+    from ...ops.registry import dispatch
+
+    out = dispatch("flash_attn_varlen_qkvpacked", qkv,
+                   cu_seqlens_q, cu_seqlens_k,
+                   max_seqlen_q=max_seqlen_q, max_seqlen_k=max_seqlen_k,
+                   scale=scale, dropout=dropout if training else 0.0,
+                   causal=causal, varlen_padded=varlen_padded)
+    if return_softmax:
+        return out, None
+    return out
+
+
 def scaled_dot_product_attention_(q, k, v, attn_mask=None, dropout_p=0.0,
                                   is_causal=False, training=True):
     mask_t = None

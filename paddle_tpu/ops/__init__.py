@@ -22,3 +22,5 @@ from .pallas import flash_attention as _flash  # noqa: F401  (registers
 #                          pallas_flash_attention + flash_attn_unpadded —
 #                          the registry must be COMPLETE after import, not
 #                          dependent on which feature module loads first)
+from .pallas import flashmask as _flashmask  # noqa: F401  (registers
+#                          flashmask_attention + flash_attn_varlen_qkvpacked)

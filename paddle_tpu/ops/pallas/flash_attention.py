@@ -79,11 +79,34 @@ def _seg_block_overlap(qs, ks, qi, ki, block_q, block_k, seq_q, seq_k):
     return (qmin <= kmax) & (qmax >= kmin)
 
 
+def _band_block_covered(bands, qi, ki, block_q, block_k, seq_q, seq_k):
+    """Scalar bool: is this (q, k) tile FULLY masked by the per-column
+    FlashMask bands?  A column j masks rows [lts_j, lte_j) (lower band)
+    union [uts_j, ute_j) (upper band); the tile is skippable iff for
+    every valid column the union covers the tile's whole row range
+    [q_lo, q_hi).  This is the FlashMask block-skip: with a causal
+    document mask, every cross-document tile has lts <= q_lo and drops
+    out of the MXU work entirely (reference intent:
+    paddle/phi/kernels/gpu/flash_attn_kernel.cu flashmask path)."""
+    lts, lte, uts, ute = (b.reshape(1, -1).astype(jnp.int32) for b in bands)
+    q_lo = qi * block_q
+    q_hi = jnp.minimum((qi + 1) * block_q, seq_q)
+    kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, lts.shape, 1)
+    pad = kpos >= seq_k  # grid-padding columns are masked anyway
+    lt_cov = (lts <= q_lo) & (lte >= q_hi)
+    ut_cov = (uts <= q_lo) & (ute >= q_hi)
+    # the two bands jointly covering [q_lo, q_hi) without either alone
+    join1 = (lts <= q_lo) & (uts <= lte) & (ute >= q_hi)
+    join2 = (uts <= q_lo) & (lts <= ute) & (lte >= q_hi)
+    return jnp.all(lt_cov | ut_cov | join1 | join2 | pad)
+
+
 def _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
-               seq_q, seq_k, qs, ks):
+               seq_q, seq_k, qs, ks, bands=None):
     """Run ``compute`` only if the (qi, ki) tile can contain unmasked
     entries: causal triangle test AND (for segmented/ragged inputs) the
-    segment-interval overlap test."""
+    segment-interval overlap test AND (for FlashMask) the band cover
+    test."""
     cond = None
     if causal:
         cond = (qi + 1) * block_q - 1 >= ki * block_k
@@ -91,20 +114,44 @@ def _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
         ov = _seg_block_overlap(qs, ks, qi, ki, block_q, block_k,
                                 seq_q, seq_k)
         cond = ov if cond is None else jnp.logical_and(cond, ov)
+    if bands is not None:
+        live = jnp.logical_not(_band_block_covered(
+            bands, qi, ki, block_q, block_k, seq_q, seq_k))
+        cond = live if cond is None else jnp.logical_and(cond, live)
     if cond is None:
         compute()
     else:
         pl.when(cond)(compute)
 
 
+def _band_mask(s, bands, qi, ki, block_q, block_k):
+    """Apply the FlashMask per-column row bands to a [BQ, BK] score tile:
+    mask (i, j) iff lts_j <= i < lte_j or uts_j <= i < ute_j (the exact
+    semantics of the reference's startend_row_indices dense expansion,
+    test/legacy_test/test_flashmask.py flashmask_to_densemask)."""
+    lts, lte, uts, ute = (b.reshape(1, -1).astype(jnp.int32) for b in bands)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    masked = (((q_pos >= lts) & (q_pos < lte))
+              | ((q_pos >= uts) & (q_pos < ute)))
+    return jnp.where(masked, NEG_INF, s)
+
+
 def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
                   block_k: int, seq_q: int, seq_k: int,
-                  has_segments: bool = False):
+                  has_segments: bool = False, has_bands: bool = False):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    qs_ref = ks_ref = None
     if has_segments:
-        (q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
-         m_scr, l_scr, acc_scr) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        qs_ref, ks_ref = refs[pos:pos + 2]
+        pos += 2
+    band_refs = None
+    if has_bands:
+        band_refs = refs[pos:pos + 4]
+        pos += 4
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[pos:]
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     qi = pl.program_id(1)
@@ -134,6 +181,9 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
             # only keys of its own segment (padding = its own segment id)
             s = jnp.where(qs_ref[0, 0][:, None] == ks_ref[0, 0][None, :],
                           s, NEG_INF)
+        if has_bands:
+            s = _band_mask(s, [b[0, 0] for b in band_refs], qi, ki,
+                           block_q, block_k)
         if seq_k % block_k != 0:
             # mask the grid-padding columns of the last k tile
             s = jnp.where(k_pos < seq_k, s, NEG_INF)
@@ -158,12 +208,13 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    # fully-masked tiles (causal triangle / disjoint segments) skip the
-    # MXU work entirely
+    # fully-masked tiles (causal triangle / disjoint segments / FlashMask
+    # band-covered) skip the MXU work entirely
     _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
                seq_q, seq_k,
                qs_ref[0, 0] if has_segments else None,
-               ks_ref[0, 0] if has_segments else None)
+               ks_ref[0, 0] if has_segments else None,
+               bands=[b[0, 0] for b in band_refs] if has_bands else None)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -188,6 +239,29 @@ def _seg3(seg):
     return jnp.broadcast_to(seg.astype(jnp.int32)[:, None, :], (b, 8, s))
 
 
+def _bands3(bands):
+    """FlashMask bands [b, mh, sk] -> sublane-replicated [b*mh, 8, sk]
+    (same Mosaic (8, 128) min-tile workaround as the segment ids)."""
+    out = []
+    for x in bands:
+        b, mh, sk = x.shape
+        x = x.astype(jnp.int32).reshape(b * mh, 1, sk)
+        out.append(jnp.broadcast_to(x, (b * mh, 8, sk)))
+    return tuple(out)
+
+
+def _clamp_block(block: int, seq: int) -> int:
+    """Clamp a block size to the sequence WITHOUT producing an unaligned
+    block shape: a block clipped to e.g. min(1024, 1001) violates
+    Mosaic's (8, 128) tile rule (block_q/block_k sit in the lane position
+    of the lse/segment/band blocks).  Round the clamp up to a multiple of
+    128 — Pallas pads the array into the full block and the kernel's
+    seq_q/seq_k masks keep padding out of real rows."""
+    if seq >= block:
+        return block
+    return -(-seq // 128) * 128
+
+
 def _kv_index(bh, h: int, kvh: int):
     """Map a flat q-head grid index to its GQA kv-head flat index:
     q head hi of batch b reads kv head hi // (h // kvh)."""
@@ -197,7 +271,8 @@ def _kv_index(bh, h: int, kvh: int):
 
 def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
                    block_q: int = 512, block_k: int = 512,
-                   interpret: bool = False, q_seg=None, k_seg=None):
+                   interpret: bool = False, q_seg=None, k_seg=None,
+                   bands=None, mask_h: int = 1):
     # defaults measured on v5e (seq 2048, d 64): 128x128 tiles drown in
     # grid overhead (163ms); 512x512 runs 23ms vs 24-88ms for XLA's path
     """q: [b*h, s, d]; k,v: [b*kvh, s, d].  GQA is native: the k/v
@@ -208,10 +283,11 @@ def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
     row's logits (the backward residual, as in flash-v2)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _clamp_block(block_q, sq)
+    block_k = _clamp_block(block_k, sk)
     grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
     has_segments = q_seg is not None
+    has_bands = bands is not None
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -229,11 +305,17 @@ def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
         # sublane-replicated (b, 8, s): a flat (1, BQ) int block violates
         # Mosaic's (8, 128) min tile, same workaround as the lse rows
         inputs += [_seg3(q_seg), _seg3(k_seg)]
+    if has_bands:
+        bspec = pl.BlockSpec((1, 8, block_k),
+                             lambda b, i, j: (_kv_index(b, h, mask_h), 0, j))
+        in_specs += [bspec] * 4
+        inputs += list(_bands3(bands))
 
     return pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=sq,
-                          seq_k=sk, has_segments=has_segments),
+                          seq_k=sk, has_segments=has_segments,
+                          has_bands=has_bands),
         grid=grid,
         in_specs=in_specs,
         out_specs=(
@@ -267,7 +349,8 @@ def _mask_rows(x, start, limit, size):
 
 
 def _bwd_tile_common(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
-                     block_q, block_k, seq_q, seq_k, qs=None, ks=None):
+                     block_q, block_k, seq_q, seq_k, qs=None, ks=None,
+                     bands=None):
     """Shared per-tile math: returns (p, ds) both [BQ, BK] f32, padded
     rows/cols zeroed."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -280,6 +363,8 @@ def _bwd_tile_common(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     if qs is not None:
         s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
+    if bands is not None:
+        s = _band_mask(s, bands, qi, ki, block_q, block_k)
     if seq_k % block_k != 0:
         s = jnp.where(k_pos < seq_k, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])                  # [BQ, BK]
@@ -297,14 +382,19 @@ def _bwd_tile_common(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
 
 
 def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
-                         seq_q, seq_k, has_segments=False):
+                         seq_q, seq_k, has_segments=False, has_bands=False):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    pos = 6
+    qs_ref = ks_ref = None
     if has_segments:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
-         dq_ref, acc_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, acc_scr) = refs
-        qs_ref = ks_ref = None
+        qs_ref, ks_ref = refs[pos:pos + 2]
+        pos += 2
+    band_refs = None
+    if has_bands:
+        band_refs = refs[pos:pos + 4]
+        pos += 4
+    dq_ref, acc_scr = refs[pos:]
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -323,7 +413,8 @@ def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             seq_q=seq_q, seq_k=seq_k,
             qs=None if qs_ref is None else qs_ref[0, 0],
-            ks=None if ks_ref is None else ks_ref[0, 0])
+            ks=None if ks_ref is None else ks_ref[0, 0],
+            bands=[b[0, 0] for b in band_refs] if has_bands else None)
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BQ, d]
@@ -331,7 +422,8 @@ def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
     _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
                seq_q, seq_k,
                qs_ref[0, 0] if has_segments else None,
-               ks_ref[0, 0] if has_segments else None)
+               ks_ref[0, 0] if has_segments else None,
+               bands=[b[0, 0] for b in band_refs] if has_bands else None)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -339,17 +431,22 @@ def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
 
 
 def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
-                          seq_k, nq, has_segments=False):
+                          seq_k, nq, has_segments=False, has_bands=False):
     """Grid (b*kvh, ki, t) with t = q_head_in_group * nq + q_tile — the
     whole kv group's q heads iterate innermost so dk/dv out-block revisits
     stay consecutive (a Pallas requirement)."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    pos = 6
+    qs_ref = ks_ref = None
     if has_segments:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-        qs_ref = ks_ref = None
+        qs_ref, ks_ref = refs[pos:pos + 2]
+        pos += 2
+    band_refs = None
+    if has_bands:
+        band_refs = refs[pos:pos + 4]
+        pos += 4
+    dk_ref, dv_ref, dk_scr, dv_scr = refs[pos:]
     ki, t = pl.program_id(1), pl.program_id(2)
     nt = pl.num_programs(2)
     qi = t % nq
@@ -370,7 +467,8 @@ def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             seq_q=seq_q, seq_k=seq_k,
             qs=None if qs_ref is None else qs_ref[0, 0],
-            ks=None if ks_ref is None else ks_ref[0, 0])
+            ks=None if ks_ref is None else ks_ref[0, 0],
+            bands=[b[0, 0] for b in band_refs] if has_bands else None)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BK, d]
@@ -381,7 +479,8 @@ def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
     _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
                seq_q, seq_k,
                qs_ref[0, 0] if has_segments else None,
-               ks_ref[0, 0] if has_segments else None)
+               ks_ref[0, 0] if has_segments else None,
+               bands=[b[0, 0] for b in band_refs] if has_bands else None)
 
     @pl.when(t == nt - 1)
     def _():
@@ -391,23 +490,25 @@ def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
 
 def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
                     h: int, kvh: int, block_q: int = 512, block_k: int = 512,
-                    interpret: bool = False, q_seg=None, k_seg=None):
+                    interpret: bool = False, q_seg=None, k_seg=None,
+                    bands=None, mask_h: int = 1):
     """q/o/do: [b*h, s, d]; k/v: [b*kvh, s, d].  Returns (dq [b*h,..],
     dk, dv [b*kvh,..]) — kv grads summed over each GQA group in-kernel."""
     bh, sq, d = q.shape
     bkv, sk, _ = k.shape
     rep = h // kvh
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _clamp_block(block_q, sq)
+    block_k = _clamp_block(block_k, sk)
     nq = pl.cdiv(sq, block_q)
     has_segments = q_seg is not None
+    has_bands = bands is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                        # [bh, sq]
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, sq))
 
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, seq_q=sq, seq_k=sk,
-                  has_segments=has_segments)
+                  has_segments=has_segments, has_bands=has_bands)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d),
                          lambda b, i, j: (_kv_index(b, h, kvh), j, 0))
@@ -423,6 +524,12 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h, 0, j)),
         ]
         dq_inputs += [q_seg, k_seg]
+    if has_bands:
+        bands = _bands3(bands)
+        bspec = pl.BlockSpec((1, 8, block_k),
+                             lambda b, i, j: (_kv_index(b, h, mask_h), 0, j))
+        dq_in_specs += [bspec] * 4
+        dq_inputs += list(bands)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
@@ -454,6 +561,14 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, 8, block_k), lambda b2, j, t: (b2 // kvh, 0, j)),
         ]
         kv_inputs += [q_seg, k_seg]
+    if has_bands:
+        # map the kv-flat grid index to its mask row (mask_h is 1 or kvh)
+        bspec2 = pl.BlockSpec(
+            (1, 8, block_k),
+            lambda b2, j, t: ((b2 // kvh) * mask_h
+                              + ((b2 % kvh) * mask_h) // kvh, 0, j))
+        kv_in_specs += [bspec2] * 4
+        kv_inputs += list(bands)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common, nq=nq),
         grid=(bkv, pl.cdiv(sk, block_k), rep * nq),
@@ -480,14 +595,16 @@ def _from_bh(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, q_seg, k_seg, causal, scale, interpret, blocks):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash(q, k, v, q_seg, k_seg, bands, causal, scale, interpret, blocks):
     """q: [b, s, h, d]; k,v: [b, s, kvh, d] (kvh divides h — native GQA);
-    q_seg/k_seg: [b, s] int32 segment ids or None; blocks: optional
-    (block_q, block_k) override (packed/ragged layouts profit from larger
-    tiles than the dense default — fewer grid trips per skipped tile)."""
-    out, _ = _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret,
-                        blocks)
+    q_seg/k_seg: [b, s] int32 segment ids or None; bands: None or a tuple
+    of 4 FlashMask row-bound arrays (lts, lte, uts, ute) each [b, mh, sk]
+    int32 (mh = 1 broadcast or kvh); blocks: optional (block_q, block_k)
+    override (packed/ragged layouts profit from larger tiles than the
+    dense default — fewer grid trips per skipped tile)."""
+    out, _ = _flash_fwd(q, k, v, q_seg, k_seg, bands, causal, scale,
+                        interpret, blocks)
     return out
 
 
@@ -502,7 +619,7 @@ _BLOCK_CANDIDATES = ((256, 256), (256, 512), (512, 256), (512, 512),
 
 
 def _select_blocks(q, k, v, causal, scale, h, kvh, interpret,
-                   q_seg=None, k_seg=None):
+                   q_seg=None, k_seg=None, bands=None, mask_h=1):
     """Block sizes for this shape: FLAGS_use_autotune measures the
     candidate tilings once per (seq, d, heads, causal, segmented)
     signature and caches the winner (the reference's switch_autotune
@@ -514,8 +631,9 @@ def _select_blocks(q, k, v, causal, scale, h, kvh, interpret,
     sq, d = q.shape[1], q.shape[2]
     sk = k.shape[1]
     has_segments = q_seg is not None
+    has_bands = bands is not None
     key = ("flash_fwd", sq, sk, d, h, kvh, causal, str(q.dtype),
-           has_segments)
+           has_segments, has_bands)
     cached = _at.AutoTuneCache.instance().lookup(key)
     if cached is not None:
         return cached
@@ -530,12 +648,13 @@ def _select_blocks(q, k, v, causal, scale, h, kvh, interpret,
         return _at.time_fn(lambda: jax.block_until_ready(
             _flash_forward(q, k, v, causal, scale, h=h, kvh=kvh,
                            block_q=bq, block_k=bk, interpret=interpret,
-                           q_seg=q_seg, k_seg=k_seg)))
+                           q_seg=q_seg, k_seg=k_seg, bands=bands,
+                           mask_h=mask_h)))
 
     return _at.AutoTuneCache.instance().tune(key, cands, measure)
 
 
-def _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret,
+def _flash_fwd(q, k, v, q_seg, k_seg, bands, causal, scale, interpret,
                blocks=None):
     b, sq, h, d = q.shape
     sk, kvh = k.shape[1], k.shape[2]
@@ -546,32 +665,36 @@ def _flash_fwd(q, k, v, q_seg, k_seg, causal, scale, interpret,
         raise FlashUnsupportedError(
             "causal flash kernel assumes sq == sk (training "
             "self-attention); decode uses the cached path")
+    mask_h = bands[0].shape[1] if bands is not None else 1
     qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
     if blocks is not None:
         block_q, block_k = blocks
     else:
         block_q, block_k = _select_blocks(qb, kb, vb, causal, scale, h, kvh,
                                           interpret, q_seg=q_seg,
-                                          k_seg=k_seg)
+                                          k_seg=k_seg, bands=bands,
+                                          mask_h=mask_h)
     of, lse = _flash_forward(qb, kb, vb, causal, scale,
                              h=h, kvh=kvh, block_q=block_q, block_k=block_k,
-                             interpret=interpret, q_seg=q_seg, k_seg=k_seg)
-    return _from_bh(of, b, h), (q, k, v, q_seg, k_seg, _from_bh(of, b, h),
-                                lse)
+                             interpret=interpret, q_seg=q_seg, k_seg=k_seg,
+                             bands=bands, mask_h=mask_h)
+    return _from_bh(of, b, h), (q, k, v, q_seg, k_seg, bands,
+                                _from_bh(of, b, h), lse)
 
 
 def _flash_bwd(causal, scale, interpret, blocks, res, g):
-    q, k, v, q_seg, k_seg, o, lse = res
+    q, k, v, q_seg, k_seg, bands, o, lse = res
     b, sq, h, d = q.shape
     kvh = k.shape[2]
+    mask_h = bands[0].shape[1] if bands is not None else 1
     bkw = {} if blocks is None else dict(block_q=blocks[0],
                                          block_k=blocks[1])
     dq, dk, dv = _flash_backward(
         _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(o), lse, _to_bh(g),
         causal, scale, h=h, kvh=kvh, interpret=interpret,
-        q_seg=q_seg, k_seg=k_seg, **bkw)
+        q_seg=q_seg, k_seg=k_seg, bands=bands, mask_h=mask_h, **bkw)
     return (_from_bh(dq, b, h), _from_bh(dk, b, kvh), _from_bh(dv, b, kvh),
-            None, None)
+            None, None, None if bands is None else (None,) * 4)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -579,11 +702,13 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention_raw(q, k, v, causal: bool = True, scale=None,
                         interpret=None, q_segment_ids=None,
-                        kv_segment_ids=None, blocks=None):
+                        kv_segment_ids=None, blocks=None, mask_bands=None):
     """Pure-jax-array entry: q,k,v [b, s, h, d]; optional [b, s] int32
     segment ids (padding / sequence-packing masks, splash-attention
     style: q attends k iff their ids match); optional (block_q, block_k)
-    tiling override."""
+    tiling override; optional ``mask_bands`` — a tuple of 4 FlashMask
+    row-bound arrays (lts, lte, uts, ute) each [b, mh, sk] int32 (see
+    flashmask.py for the startend_row_indices normalisation)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
@@ -591,8 +716,9 @@ def flash_attention_raw(q, k, v, causal: bool = True, scale=None,
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError("q_segment_ids and kv_segment_ids must be given "
                          "together")
-    return _flash(q, k, v, q_segment_ids, kv_segment_ids, bool(causal),
-                  float(scale), bool(interpret),
+    return _flash(q, k, v, q_segment_ids, kv_segment_ids,
+                  None if mask_bands is None else tuple(mask_bands),
+                  bool(causal), float(scale), bool(interpret),
                   None if blocks is None else tuple(blocks))
 
 
@@ -629,12 +755,10 @@ def flash_attn_unpadded_raw(q, k, v, cu_seqlens_q, cu_seqlens_k,
     # flat layout has one long sequence axis (b=1), so grid-trip overhead
     # per skipped tile dominates at 512 tiles (measured v5e: 1024x1024
     # turns a 0.95x parity into a 1.3x win over dense-masked at ~30%
-    # padding).  The block size is FIXED at 1024, not min(1024, total):
-    # a block clipped to an unaligned total (e.g. 1001) violates
-    # Mosaic's (8, 128) tile alignment; Pallas instead pads a smaller
-    # array into the full block and the kernel's seq_q/seq_k masks keep
-    # the padding out of real rows (tests/test_pallas_flash varlen
-    # shapes like 24 rely on this)
+    # padding).  Block clamping for short/unaligned totals is handled by
+    # _clamp_block (128-aligned round-up; Pallas pads the array into the
+    # full block and the kernel's seq_q/seq_k masks cover padded rows —
+    # tests/test_pallas_flash varlen shapes like 24 rely on this)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     blocks = (1024, 1024) if not interpret else None

@@ -445,9 +445,23 @@ def _fast_dispatch(op: OpDef, args):
     return jax.tree_util.tree_unflatten(out_treedef, wrapped)
 
 
+# SOT segmented execution (jit/sot.py): when a runner is active on THIS
+# thread, every dispatch records into a pending compiled segment instead
+# of executing (thread-local: a data-loader thread dispatching ops mid-
+# segment must not record into another thread's runner).  The cell +
+# sentinel live HERE so dispatch never imports jit (no cycle).
+_SOT_TLS = threading.local()
+_SOT_FALLTHROUGH = object()
+
+
 def dispatch(name: str, *args, **kwargs):
     """Execute op ``name`` eagerly with tape recording."""
     op = get_op(name)
+    rec = getattr(_SOT_TLS, "rec", None)
+    if rec is not None:
+        out = rec.record(op, args, kwargs)
+        if out is not _SOT_FALLTHROUGH:
+            return out
     recording = _profiler_recording()
     if (not recording and not kwargs and op.cacheable
             and not _OP_STATS_STACK and _fast_flags_ok()):

@@ -161,10 +161,10 @@ def test_to_static_graph_break_fallback():
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         out = traced(x)
-        assert any("falling back to eager" in str(m.message) for m in w), \
-            [str(m.message) for m in w]
+        assert any("falling back to subgraph" in str(m.message)
+                   for m in w), [str(m.message) for m in w]
     np.testing.assert_allclose(np.asarray(out._value), 2 * np.ones((2, 2)))
-    # subsequent calls stay eager, no repeat warning storm
+    # subsequent calls stay on the subgraph path, no repeat warning storm
     out2 = traced(paddle.to_tensor(-np.ones((2, 2), np.float32)))
     np.testing.assert_allclose(np.asarray(out2._value),
                                -np.ones((2, 2)) - 1)

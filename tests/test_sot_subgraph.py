@@ -1,0 +1,200 @@
+"""SOT-style subgraph compilation tests (jit/sot.py).
+
+A graph-breaking callable must run as COMPILED subgraphs split at host
+materialisation points — not whole-callable eager — matching the
+reference's bytecode-level SOT (python/paddle/jit/sot/translate.py:31).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import sot as _sot
+
+
+@pytest.fixture(autouse=True)
+def _reset_stats():
+    _sot.reset_sot_stats()
+    yield
+
+
+def _branchy(x):
+    # segment 1: two fusable ops, then a host bool (graph break)
+    y = x * 2.0
+    s = y.sum()
+    if float(s) > 0:          # host materialisation -> segment flush
+        # segment 2
+        z = y + 1.0
+        return z * 3.0
+    z = y - 1.0
+    return z * 0.5
+
+
+def _eager_reference(xv):
+    y = xv * 2.0
+    if float(y.sum()) > 0:
+        return (y + 1.0) * 3.0
+    return (y - 1.0) * 0.5
+
+
+class TestSubgraphCompilation:
+    def test_two_segments_compiled_and_parity(self):
+        traced = paddle.jit.to_static(_branchy)
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = traced(x)
+        assert any("subgraph" in str(m.message) for m in w)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   _eager_reference(np.ones((4, 4))),
+                                   rtol=1e-6)
+        stats = _sot.sot_stats()
+        # two host-split segments, each compiled exactly once
+        assert stats["breaks"] == 1
+        assert stats["segments_compiled"] == 2, stats
+        assert stats["flushes"] == 2, stats
+
+    def test_segment_cache_hits_on_repeat_calls(self):
+        traced = paddle.jit.to_static(_branchy)
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            traced(x)
+        base = _sot.sot_stats()
+        for i in range(3):
+            out = traced(paddle.to_tensor(
+                np.full((4, 4), i + 1.0, np.float32)))
+            np.testing.assert_allclose(
+                np.asarray(out.numpy()),
+                _eager_reference(np.full((4, 4), i + 1.0)), rtol=1e-6)
+        stats = _sot.sot_stats()
+        # repeat calls re-use the compiled segments: no new compiles
+        assert stats["segments_compiled"] == base["segments_compiled"]
+        assert stats["segments_hit"] - base["segments_hit"] == 6, stats
+
+    def test_other_branch_compiles_its_own_segment(self):
+        traced = paddle.jit.to_static(_branchy)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pos = traced(paddle.to_tensor(np.ones((4, 4), np.float32)))
+            n0 = _sot.sot_stats()["segments_compiled"]
+            neg = traced(paddle.to_tensor(-np.ones((4, 4), np.float32)))
+        np.testing.assert_allclose(np.asarray(neg.numpy()),
+                                   _eager_reference(-np.ones((4, 4))),
+                                   rtol=1e-6)
+        # the negative path's suffix segment is new; the prefix is shared
+        stats = _sot.sot_stats()
+        assert stats["segments_compiled"] == n0 + 1, stats
+        np.testing.assert_allclose(np.asarray(pos.numpy()),
+                                   _eager_reference(np.ones((4, 4))),
+                                   rtol=1e-6)
+
+    def test_multiple_breaks(self):
+        def two_breaks(x):
+            a = x * 2.0
+            if float(a.sum()) > 0:
+                a = a + 1.0
+            b = a * 3.0
+            if float(b.mean()) > 100.0:
+                return b - 5.0
+            return b + 5.0
+
+        traced = paddle.jit.to_static(two_breaks)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = traced(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        want = (1.0 * 2 + 1) * 3 + 5
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.full((2, 2), want), rtol=1e-6)
+        assert _sot.sot_stats()["flushes"] == 3  # 2 breaks + final
+
+    def test_layer_with_break(self):
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = paddle.nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if float(h.sum()) > 1e6:
+                    return h * 0.0
+                return paddle.nn.functional.relu(h) + 1.0
+
+        net = Net()
+        net.eval()
+        traced = paddle.jit.to_static(net)
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((2, 4)).astype(np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = traced(x)
+        want = net(x)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(want.numpy()), rtol=1e-5)
+
+    def test_grads_fall_back_to_tape_eager(self):
+        """When inputs require grad, the broken callable runs plain
+        eager so the tape records (segments are invisible to it)."""
+        traced = paddle.jit.to_static(_branchy)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32),
+                             stop_gradient=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = traced(x)
+        out.sum().backward()
+        # d/dx of (x*2 + 1) * 3 = 6
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   np.full((2, 2), 6.0), rtol=1e-6)
+
+    def test_layer_param_grads_keep_tape(self):
+        """A graph-broken LAYER in training keeps parameter gradients:
+        the trainable leaves are its parameters, not the inputs."""
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = paddle.nn.Linear(3, 3)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if float(h.sum()) > 1e9:
+                    return h * 0.0
+                return h * 2.0
+
+        net = Net()
+        traced = paddle.jit.to_static(net)
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = traced(x)
+        out.sum().backward()
+        w = net.fc.weight
+        assert w.grad is not None
+        assert float(np.abs(np.asarray(w.grad.numpy())).sum()) > 0
+
+    def test_full_graph_still_raises(self):
+        import jax
+
+        traced = paddle.jit.to_static(_branchy, full_graph=True)
+        with pytest.raises(jax.errors.JAXTypeError):
+            traced(paddle.to_tensor(np.ones((2, 2), np.float32)))
+
+    def test_data_dependent_op_falls_through(self):
+        """A non-cacheable op (data-dependent output shape) inside a
+        broken callable splits the segment instead of crashing."""
+        def uses_unique(x):
+            y = x * 2.0
+            if float(y.sum()) > 0:
+                u = paddle.unique(y)
+                return u.sum() + y.sum()
+            return y.sum()
+
+        traced = paddle.jit.to_static(uses_unique)
+        x = paddle.to_tensor(np.asarray([[1.0, 2.0], [1.0, 3.0]],
+                                        np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = traced(x)
+        want = float(np.unique([[2, 4], [2, 6]]).sum() + 14.0)
+        np.testing.assert_allclose(float(out.numpy()), want, rtol=1e-6)

@@ -204,7 +204,8 @@ def test_flash_block_autotune_uses_cache():
 
     q = jnp.zeros((4, 1024, 64))
     k = jnp.zeros((4, 1024, 64))
-    key = ("flash_fwd", 1024, 1024, 64, 4, 4, True, str(q.dtype), False)
+    key = ("flash_fwd", 1024, 1024, 64, 4, 4, True, str(q.dtype), False,
+           False)
     AutoTuneCache.instance().put(key, (256, 512))
     try:
         assert _select_blocks(q, k, k, True, 0.125, 4, 4, True) == (256, 512)
