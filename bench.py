@@ -192,6 +192,11 @@ def main():
                   file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"# varlen bench failed: {e}", file=sys.stderr)
+        try:
+            extras["flashmask"] = _flashmask_bench()
+            print(f"# flashmask: {extras['flashmask']}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"# flashmask bench failed: {e}", file=sys.stderr)
     try:
         with open("BENCH_EXTRA.json", "w") as f:
             json.dump(extras, f, indent=1)
@@ -209,13 +214,48 @@ def main():
           f"loss={final_loss:.3f} mfu={mfu:.3f}", file=sys.stderr)
 
 
+def _chained_device_time(fn, x, n_lo=9, n_hi=73, reps=5):
+    """On-device per-iteration time of ``fn`` with the tunnel's per-call
+    overhead (~60-70ms RTT, swamping ms-scale kernels) subtracted out:
+    chain n_lo and n_hi dependent applications inside ONE jitted call
+    each and take the slope ((t_hi - t_lo) / (n_hi - n_lo)) — both
+    measurements carry one RTT, so it cancels.  Root-caused in round 4:
+    the old per-call wall-clock methodology measured the link, not the
+    kernel, which is why BENCH_r03's varlen leg read 1.05x.  The chain
+    lengths are far apart so the device-time delta (tens of ms) clears
+    the RTT jitter; min-of-reps rides the RTT floor."""
+    import time
+
+    import jax
+
+    def chain(m):
+        return jax.jit(
+            lambda q: jax.lax.fori_loop(0, m, lambda i, y: fn(y), q))
+
+    lo, hi = chain(n_lo), chain(n_hi)
+    lo(x).block_until_ready()
+    hi(x).block_until_ready()
+    deltas = []
+    for _ in range(reps):
+        # paired back-to-back samples see the same tunnel congestion;
+        # the median of per-pair slopes rejects RTT drift between reps
+        t0 = time.perf_counter()
+        lo(x).block_until_ready()
+        tl = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        hi(x).block_until_ready()
+        th = time.perf_counter() - t0
+        deltas.append((th - tl) / (n_hi - n_lo))
+    deltas.sort()
+    return deltas[len(deltas) // 2]
+
+
 def _varlen_vs_dense_bench():
     """Packed-varlen (ragged kernel, per-segment block skip) vs the
     dense-padded-with-masks path on identical workloads: 4 sequences
     (~32% padding when padded to max).  VERDICT r2 missing#3's win
-    criterion: packed-varlen beats dense-masked at >=30% padding."""
-    import time
-
+    criterion: packed-varlen beats dense-masked at >=30% padding.
+    Device time via _chained_device_time (tunnel-free)."""
     import jax
     import jax.numpy as jnp
 
@@ -239,34 +279,70 @@ def _varlen_vs_dense_bench():
         seg[i, :n] = i + 1
     seg = jnp.asarray(seg)
 
-    packed = jax.jit(lambda q: flash_attn_unpadded_raw(
-        q, q, q, cu, cu, causal=True, interpret=False))
-    dense = jax.jit(lambda q: flash_attention_raw(
-        q, q, q, causal=True, interpret=False,
-        q_segment_ids=seg, kv_segment_ids=seg))
+    def packed(q):
+        return flash_attn_unpadded_raw(q, q, q, cu, cu, causal=True,
+                                       interpret=False)
 
-    def _time(fn, x, steps=20, windows=3):
-        # best-of-N windows: the tunnel adds high-variance queueing noise
-        # (same methodology as the headline measurement)
-        fn(x).block_until_ready()
-        best = float("inf")
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                out = fn(x)
-            out.block_until_ready()
-            best = min(best, (time.perf_counter() - t0) / steps)
-        return best
+    def dense(q):
+        return flash_attention_raw(q, q, q, causal=True, interpret=False,
+                                   q_segment_ids=seg, kv_segment_ids=seg)
 
-    tp = _time(packed, qp)
-    td = _time(dense, qd)
+    def grad_step(fn):
+        g = jax.grad(lambda q: jnp.sum(fn(q).astype(jnp.float32)))
+        return lambda q: g(q).astype(q.dtype)
+
+    tp = _chained_device_time(packed, qp)
+    td = _chained_device_time(dense, qd)
+    tpg = _chained_device_time(grad_step(packed), qp, n_lo=3, n_hi=27)
+    tdg = _chained_device_time(grad_step(dense), qd, n_lo=3, n_hi=27)
     return {
         "packed_ms": round(tp * 1e3, 3),
         "dense_masked_ms": round(td * 1e3, 3),
         "speedup_x": round(td / tp, 3),
+        "packed_fwdbwd_ms": round(tpg * 1e3, 3),
+        "dense_fwdbwd_ms": round(tdg * 1e3, 3),
+        "fwdbwd_speedup_x": round(tdg / tpg, 3),
         "padding_frac": round(1 - total / (b * maxlen), 3),
         "est_block_skip_frac": round(
             varlen_block_skip_fraction(seqlens, 512), 3),
+        "method": "chained-iteration device time (tunnel-free)",
+    }
+
+
+def _flashmask_bench():
+    """FlashMask causal document mask vs plain causal flash on the same
+    packed stream: mask-structure-driven block skipping should win by
+    roughly the live-tile ratio (VERDICT r3 next#1's bench leg)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+    from paddle_tpu.ops.pallas.flashmask import (
+        causal_document_row_indices, flashmask_attention_raw,
+        flashmask_block_skip_fraction)
+
+    seqlens = [700, 400, 620, 500, 356, 640, 480, 400]   # 8 docs, 4096
+    s = sum(seqlens)
+    h, d = 16, 64
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, s, h, d)), jnp.bfloat16)
+    idx = causal_document_row_indices(seqlens)
+
+    def fm(x):
+        return flashmask_attention_raw(x, x, x, idx, causal=True,
+                                       interpret=False)
+
+    def causal(x):
+        return flash_attention_raw(x, x, x, causal=True, interpret=False)
+
+    tm = _chained_device_time(fm, q)
+    tc = _chained_device_time(causal, q)
+    return {
+        "flashmask_ms": round(tm * 1e3, 3),
+        "causal_dense_ms": round(tc * 1e3, 3),
+        "speedup_x": round(tc / tm, 3),
+        "skip_frac": round(flashmask_block_skip_fraction(idx, True, s,
+                                                         512), 3),
+        "method": "chained-iteration device time (tunnel-free)",
     }
 
 

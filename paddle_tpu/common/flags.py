@@ -424,3 +424,110 @@ define_flag("FLAGS_gemm_use_half_precision_compute_type", False,
             "compat: MXU accumulates fp32 regardless.")
 define_flag("FLAGS_enable_async_trace", False, "compat.")
 define_flag("FLAGS_use_mkldnn", False, "compat: no oneDNN.")
+
+# ---- round-4 wired additions (reference paddle/common/flags.cc) ----
+define_flag("FLAGS_multi_block_attention_min_partition_size", 512,
+            "KV-chunk size for chunked decode attention "
+            "(incubate.nn.memory_efficient_attention) — the TPU analog "
+            "of the GPU multi-block decode partition size.")
+define_flag("FLAGS_einsum_opt", False,
+            "einsum contraction-order search: True = exhaustive "
+            "('optimal'), False = greedy. The reference flag gates its "
+            "einsum intermediate cache; contraction planning is the XLA-"
+            "native equivalent knob.")
+define_flag("FLAGS_selected_gpus", "",
+            "comma-separated accelerator indices visible to this process "
+            "(reference: device selection for the trainer); filters "
+            "paddle.device accelerator enumeration.")
+define_flag("FLAGS_enable_api_kernel_fallback", True,
+            "allow a failing Pallas kernel to fall back to the XLA "
+            "path (the phi fallback-to-CPU-kernel analog). False makes "
+            "kernel errors raise.")
+define_flag("FLAGS_sync_nccl_allreduce", True,
+            "eager collectives block until the result is ready "
+            "(XLA dispatch is async; the wait is block_until_ready, "
+            "the NCCL-stream-sync analog).")
+
+
+# ---- exemption record: reference flags with NO TPU/XLA analog --------
+# Every name in paddle/common/flags.cc is either WIRED above (same
+# FLAGS_ name, real effect) or EXEMPT here with the reason.  The
+# completeness test (tests/test_flags_wiring.py) asserts
+# wired + exempt covers the reference list exactly.
+_CUDA_LIB_DIRS = ("cublas_dir cudnn_dir cupti_dir curand_dir cusolver_dir "
+                  "cusparse_dir cusparselt_dir lapack_dir mkl_dir "
+                  "mklml_dir nccl_dir nvidia_package_dir op_dir "
+                  "win_cuda_bin_dir").split()
+_GPUGRAPH = ("gpugraph_debug_gpu_memory gpugraph_dedup_pull_push_mode "
+             "gpugraph_enable_gpu_direct_access "
+             "gpugraph_enable_hbm_table_collision_stat "
+             "gpugraph_enable_segment_merge_grads "
+             "gpugraph_hbm_table_load_factor "
+             "gpugraph_load_node_list_into_hbm "
+             "gpugraph_merge_grads_segment_size "
+             "gpugraph_slot_feasign_max_num "
+             "gpugraph_sparse_table_storage_mode gpugraph_storage_mode "
+             "graph_embedding_split_infer_mode graph_get_neighbor_id "
+             "graph_load_in_parallel graph_metapath_split_opt "
+             "graph_neighbor_size_percent "
+             "enable_graph_multi_node_sampling "
+             "enable_neighbor_list_use_uva multi_node_sample_use_gpu_table "
+             "query_dest_rank_by_multi_node enable_auto_detect_gpu_topo "
+             "enable_auto_rdma_trans enable_all2all_use_fp16 "
+             "enable_tracker_all2all enable_sparse_inner_gather "
+             "enable_opt_get_features enable_ins_parser_file "
+             "enable_slotpool_wait_release enable_slotrecord_reset_shrink "
+             "record_pool_max_size slotpool_thread_num").split()
+_CINN = ("cinn_compile_thread_num cinn_input_dynamic_dim_spec_file "
+         "cinn_specify_input_dynamic_dim cinn_subgraph_graphviz_dir "
+         "enable_cinn_auto_tune enable_cinn_compile_cache "
+         "enable_interpretercore_launch_cinn check_infer_symbolic").split()
+_CUDA_ALLOC = ("auto_free_cudagraph_allocations_on_launch "
+               "auto_growth_chunk_size_in_mb "
+               "cuda_malloc_async_pool_memory_throttle_ratio "
+               "fraction_of_cuda_pinned_memory_to_use "
+               "use_auto_growth_pinned_allocator pinned_memory_as_cpu_backend "
+               "sync_after_alloc").split()
+_LEGACY_EXEC = ("cache_inference_while_scope eager_delete_scope "
+                "local_exe_sub_scope_limit memory_fraction_of_eager_deletion "
+                "reader_queue_speed_test_mode save_static_runtime_data "
+                "multiple_of_cupti_buffer_size "
+                "communicator_is_sgd_optimizer "
+                "enable_exit_when_partial_worker "
+                "enable_adjust_op_order").split()
+_PIR_PRIM = ("cse_max_count ir_inplace_kernel_blacklist "
+             "logging_pir_py_code_int_tensor_element_limit "
+             "pir_broadcast_tree_limit pir_subgraph_saving_dir "
+             "prim_forward_blacklist prim_skip_dynamic "
+             "manually_trans_conv_filter").split()
+
+FLAG_EXEMPTIONS: Dict[str, str] = {}
+for _n in _CUDA_LIB_DIRS:
+    FLAG_EXEMPTIONS[_n] = ("CUDA/BLAS library dlopen search path — no "
+                           "dynamic GPU library loading under PJRT/XLA")
+for _n in _GPUGRAPH:
+    FLAG_EXEMPTIONS[_n] = ("GPU-graph-engine / BoxPS / slot-pool data "
+                           "feed — documented scope cut (SURVEY §2.10.2: "
+                           "heter PS pipeline)")
+for _n in _CINN:
+    FLAG_EXEMPTIONS[_n] = ("CINN compiler stack — XLA replaces CINN "
+                           "wholesale (SURVEY §2.10.1 L6 decision)")
+for _n in _CUDA_ALLOC:
+    FLAG_EXEMPTIONS[_n] = ("CUDA allocator / pinned-host pool tuning — "
+                           "PJRT owns allocation on TPU; stats surfaced "
+                           "via device.memory_stats")
+for _n in _LEGACY_EXEC:
+    FLAG_EXEMPTIONS[_n] = ("legacy fluid executor scope/communicator "
+                           "machinery — no scope tree in the jit "
+                           "execution model")
+for _n in _PIR_PRIM:
+    FLAG_EXEMPTIONS[_n] = ("PIR pass / prim-decomposition internals — "
+                           "jaxpr->StableHLO has no analogous pass knob; "
+                           "IR dumps are FLAGS_logging_pir_py_code_dir")
+FLAG_EXEMPTIONS["fused_multi_transformer_op_use_mbfmha"] = (
+    "CUDA mbFMHA kernel selector — Pallas flash is the one attention "
+    "kernel family on TPU")
+FLAG_EXEMPTIONS["use_xqa_optim"] = (
+    "CUDA XQA decode kernel selector — decode attention is "
+    "incubate.nn.decode_attention on TPU")
+FLAG_EXEMPTIONS["trt_min_group_size"] = "TensorRT subgraph engine — no TRT"

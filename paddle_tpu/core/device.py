@@ -38,11 +38,31 @@ class Place:
 
     @property
     def jax_device(self):
-        devs = [d for d in jax.devices() if _platform_matches(d.platform, self.device_type)]
+        devs = _accel_devices(self.device_type)
         if not devs:
             # fall back to cpu host platform
             devs = jax.devices("cpu")
         return devs[min(self.device_id, len(devs) - 1)]
+
+
+def _accel_devices(device_type: str):
+    """Platform-matching devices, filtered by FLAGS_selected_gpus when set
+    (the reference's trainer device-selection contract: a comma-separated
+    index list restricting which accelerators this process uses)."""
+    devs = [d for d in jax.devices()
+            if _platform_matches(d.platform, device_type)]
+    from ..common import flags as _flags
+
+    sel = _flags.get_flag("FLAGS_selected_gpus")
+    if sel and device_type != "cpu":
+        try:
+            idx = {int(i) for i in str(sel).split(",") if i.strip() != ""}
+        except ValueError:
+            return devs
+        picked = [d for i, d in enumerate(devs) if i in idx]
+        if picked:
+            return picked
+    return devs
 
 
 def _platform_matches(platform: str, device_type: str) -> bool:
@@ -106,7 +126,7 @@ def current_place() -> Place:
 
 def device_count(device_type: Optional[str] = None) -> int:
     dt = device_type or current_place().device_type
-    return len([d for d in jax.devices() if _platform_matches(d.platform, dt)]) or 1
+    return len(_accel_devices(dt)) or 1
 
 
 def is_compiled_with_tpu() -> bool:

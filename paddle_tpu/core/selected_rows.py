@@ -22,7 +22,25 @@ class SelectedRows:
     height: dim 0 of the dense equivalent."""
 
     def __init__(self, rows, value, height: Optional[int] = None):
-        self.rows = jnp.asarray(rows, jnp.int32)
+        import numpy as _np
+
+        import jax as _jax
+
+        rows_arr = _np.asarray(rows)
+        big = rows_arr.size and int(rows_arr.max()) >= 2 ** 31
+        if big and _jax.config.jax_enable_x64:
+            # int64 storage path (the reference contract) when x64 is on
+            self.rows = jnp.asarray(rows, jnp.int64)
+        elif big:
+            # without x64 the storage is int32: ids that would silently
+            # wrap must raise loudly (PS-scale tables, height > 2^31)
+            raise ValueError(
+                "SelectedRows ids exceed int32 range and jax x64 is "
+                "disabled; enable it BEFORE creating arrays "
+                "(jax.config.update('jax_enable_x64', True)) to store "
+                "int64 row ids")
+        else:
+            self.rows = jnp.asarray(rows, jnp.int32)
         self.value = jnp.asarray(value)
         if self.rows.shape[0] != self.value.shape[0]:
             raise ValueError(

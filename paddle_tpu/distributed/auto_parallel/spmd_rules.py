@@ -360,6 +360,229 @@ def _gather_rule(x: P, index: P, axis: int = 0,
     return (in_x, index), (out,), {}
 
 
+@register_spmd_rule("scatter")
+@register_spmd_rule("put_along_axis")
+def _scatter_rule(x: P, index: P = None, updates: P = None, axis: int = 0,
+                  ndim: Optional[int] = None, **kw):
+    """Scatter writes along ``axis``: that dim must be replicated on every
+    operand (arbitrary destinations), other dims follow x (reference
+    scatter.cc / put_along_axis semantics)."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    ax = axis % max(nd, 1)
+    out = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    # index is a (possibly lower-rank) id tensor — replicated; updates
+    # share the destination placement (their scatter dim is already None)
+    return (out, P(), out), (out,), {}
+
+
+@register_spmd_rule("scatter_nd_add")
+def _scatter_nd_rule(x: P, index: P = None, updates: P = None,
+                     ndim: Optional[int] = None, **kw):
+    """scatter_nd touches arbitrary x positions: x replicated on indexed
+    leading dims is the safe curated choice — everything replicated
+    except trailing slice dims that updates carry through."""
+    xa = _axes(x)
+    out = P(*xa)
+    return (out, P(), P()), (out,), {}
+
+
+@register_spmd_rule("gather_nd")
+def _gather_nd_rule(x: P, index: P = None, index_ndim: int = 2, **kw):
+    """out = index batch dims (minus the coord dim) + x trailing dims
+    past the indexed prefix; x's indexed prefix must be replicated."""
+    ia = _axes(index)
+    batch = tuple(ia[:max(index_ndim - 1, 0)])
+    return (P(), index), (P(*batch),), {}
+
+
+@register_spmd_rule("where")
+def _where_rule(cond: P, x: P = None, y: P = None, **kw):
+    """Ternary elementwise: first sharded operand wins (broadcast
+    operands follow)."""
+    for spec in (cond, x, y):
+        if _axes(spec):
+            out = P(*_axes(spec))
+            return (out, out, out), (out,), {}
+    return (P(), P(), P()), (P(),), {}
+
+
+@register_spmd_rule("cumsum")
+@register_spmd_rule("cumprod")
+@register_spmd_rule("logcumsumexp")
+def _cumsum_rule(x: P, axis: int = 0, ndim: Optional[int] = None, **kw):
+    """Scan axis replicated (a sharded scan needs a carry exchange);
+    other dims pass through — reference cumsum spmd rule."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    ax = axis % max(nd, 1)
+    out = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    return (out,), (out,), {}
+
+
+@register_spmd_rule("topk")
+def _topk_rule(x: P, k: int = 1, axis: int = -1,
+               ndim: Optional[int] = None, **kw):
+    """topk axis replicated (global order needs the whole axis); values
+    and indices share the spec."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    ax = axis % max(nd, 1)
+    out = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    return (out,), (out, out), {}
+
+
+@register_spmd_rule("argmax")
+@register_spmd_rule("argmin")
+def _arg_reduce_rule(x: P, axis: int = 0, keepdim: bool = False,
+                     ndim: Optional[int] = None, **kw):
+    """Arg-reduction: reduced axis replicated (the winner is global),
+    output drops (or keeps) that dim."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    ax = axis % max(nd, 1)
+    in_x = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    if keepdim:
+        out = in_x
+    else:
+        out = P(*(a for i, a in enumerate(tuple(in_x)) if i != ax))
+    return (in_x,), (out,), {}
+
+
+@register_spmd_rule("tile")
+def _tile_rule(x: P, repeat_times=(), ndim: Optional[int] = None, **kw):
+    """Tiled dims replicated (shard boundaries break the repeat
+    pattern); repeat==1 dims keep their placement."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    reps = tuple(repeat_times)
+    reps = (1,) * (nd - len(reps)) + reps
+    out = P(*(a if reps[i] == 1 else None for i, a in enumerate(xa)))
+    return (out,), (out,), {}
+
+
+@register_spmd_rule("expand")
+def _expand_rule(x: P, shape=(), in_shape=(), **kw):
+    """Broadcast (size-1 -> n) dims replicated; real dims keep their
+    placement.  New leading dims are replicated."""
+    xa = _axes(x)
+    ins = tuple(in_shape)
+    outs = tuple(shape)
+    lead = len(outs) - len(ins)
+    ent = []
+    for i, _ in enumerate(outs):
+        if i < lead:
+            ent.append(None)
+        else:
+            j = i - lead
+            a = xa[j] if j < len(xa) else None
+            ent.append(a if (j < len(ins) and ins[j] != 1) else None)
+    in_x = P(*(a if (j < len(ins) and ins[j] != 1) else None
+               for j, a in enumerate(xa)))
+    return (in_x,), (P(*ent),), {}
+
+
+@register_spmd_rule("stack")
+def _stack_rule(*specs: P, axis: int = 0, ndim: Optional[int] = None,
+                **kw):
+    """Common operand placement, new axis replicated."""
+    base = next((s for s in specs if _axes(s)), None)
+    xa = _axes(base) if base is not None else ()
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    ax = axis % (nd + 1)
+    out = P(*(tuple(xa[:ax]) + (None,) + tuple(xa[ax:])))
+    in_s = P(*xa)
+    return tuple(in_s for _ in specs), (out,), {}
+
+
+@register_spmd_rule("pad")
+def _pad_rule(x: P, paddings=(), ndim: Optional[int] = None, **kw):
+    """Padded dims replicated (halo writes cross shard boundaries)."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    pads = list(paddings)
+    per_dim = [(pads[2 * i], pads[2 * i + 1]) if 2 * i + 1 < len(pads)
+               else (0, 0) for i in range(nd)]
+    out = P(*(None if any(per_dim[i]) else a for i, a in enumerate(xa)))
+    return (out,), (out,), {}
+
+
+@register_spmd_rule("roll")
+@register_spmd_rule("flip")
+def _roll_rule(x: P, axis=None, shifts=None, ndim: Optional[int] = None,
+               **kw):
+    """Rolled/flipped axes replicated (elements cross shard
+    boundaries)."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    if axis is None:
+        moved = set(range(nd))
+    else:
+        ax = axis if isinstance(axis, (tuple, list)) else (axis,)
+        moved = {a % max(nd, 1) for a in ax}
+    out = P(*(None if i in moved else a for i, a in enumerate(xa)))
+    return (out,), (out,), {}
+
+
+@register_spmd_rule("take_along_axis")
+def _take_along_axis_rule(x: P, index: P = None, axis: int = 0,
+                          ndim: Optional[int] = None, **kw):
+    """Gather along ``axis``: that dim replicated on both operands, out
+    follows index's other dims / x's placement."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    ax = axis % max(nd, 1)
+    spec = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    return (spec, spec), (spec,), {}
+
+
+@register_spmd_rule("one_hot")
+def _one_hot_rule(x: P, num_classes: int = 1, **kw):
+    """Index dims pass through; the new class dim is replicated."""
+    xa = _axes(x)
+    return (P(*xa),), (P(*(xa + (None,))),), {}
+
+
+@register_spmd_rule("logsumexp")
+def _logsumexp_rule(x: P, axis=None, keepdim: bool = False,
+                    ndim: Optional[int] = None, **kw):
+    return _reduce_rule(x, axis=axis, keepdim=keepdim, ndim=ndim, **kw)
+
+
+@register_spmd_rule("flashmask_attention")
+@register_spmd_rule("scaled_dot_product_attention")
+@register_spmd_rule("memory_efficient_attention")
+def _attention_rule(q: P, k: P = None, v: P = None, *rest, **kw):
+    """[b, s, h, d] attention: batch + head shards pass through, the
+    seq axis must be replicated (every q row needs every kv row; seq
+    sharding is the SEP/ring path, not a per-op rule) and head_dim is
+    replicated — the flash rule generalised to the whole score-based
+    attention family (reference fused attention spmd rules)."""
+    qa = _axes(q) + (None,) * (4 - len(_axes(q)))
+    spec = P(qa[0], None, qa[2], None)
+    n_in = 3 + len(rest)
+    return (spec,) * n_in, (spec,), {}
+
+
+@register_spmd_rule("flash_attn_unpadded")
+def _flash_unpadded_rule(q: P, k: P = None, v: P = None, cu_q: P = None,
+                         cu_k: P = None, **kw):
+    """Packed [total, h, d]: only the head axis is shardable (the token
+    axis is ragged; cu_seqlens are tiny and replicated)."""
+    qa = _axes(q) + (None,) * (3 - len(_axes(q)))
+    spec = P(None, qa[1], None)
+    return (spec, spec, spec, P(), P()), (spec,), {}
+
+
 # ---------------------------------------------------------------- shard_op
 
 def shard_op(op_name: str, mesh, *in_tensors, rule_kwargs=None, **op_kwargs):

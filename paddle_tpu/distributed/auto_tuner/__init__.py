@@ -54,6 +54,11 @@ def default_candidates(tuner_cfg: Dict[str, Any]) -> Dict[str, List]:
                                      else [int(v)]))
     v = tuner_cfg.get("use_recompute", [False, True])
     out["use_recompute"] = list(v) if isinstance(v, (list, tuple)) else [bool(v)]
+    v = tuner_cfg.get("pipeline_schedule", ["1F1B"])
+    out["pipeline_schedule"] = (["FThenB", "1F1B", "VPP", "ZBH1"]
+                                if v == "auto" else
+                                (list(v) if isinstance(v, (list, tuple))
+                                 else [str(v)]))
     return out
 
 
@@ -122,6 +127,51 @@ def prune_by_memory_estimation(tuner_cfg, cur, history):
             / (cur["mp_degree"] * cur["pp_degree"]))
     total_gb = (weights + grads + optim + acts) / 1e9
     return total_gb > hbm
+
+
+@register_prune
+def prune_by_schedule_cost(tuner_cfg, cur, history):
+    """Model-based schedule prune: replay each candidate pipeline
+    schedule's table under the measured/estimated per-stage times
+    (parallel.schedules.simulate_cost) and prune any schedule modelled
+    >``schedule_cost_slack`` (default 5%) slower than the best for this
+    (pp, m) — the cost model does the trial runs' job for the schedule
+    dimension (reference analog: pipeline_zero_bubble.py:62 cost
+    reasoning)."""
+    sched = cur.get("pipeline_schedule")
+    if not sched:
+        return False
+    p = int(cur.get("pp_degree", 1))
+    if p <= 1:
+        # no pipeline -> every schedule runs the same program; keep
+        # exactly one name so the tuner doesn't burn duplicate trials
+        return sched != "1F1B"
+    gbs = int(tuner_cfg.get("global_batch_size", 8))
+    mbs = max(int(cur.get("micro_batch_size", 1)), 1)
+    dp = max(int(cur.get("dp_degree", 1))
+             * int(cur.get("sharding_degree", 1)), 1)
+    m = max(gbs // (mbs * dp), 1)
+    v = int(tuner_cfg.get("vpp_chunks", 2))
+    layers = int(tuner_cfg.get("num_layers", 0))
+    if sched == "VPP" and (v < 2 or (layers and layers % (p * v))):
+        return True
+    if layers and layers % p:
+        return True
+    from ...parallel.schedules import rank_schedules
+
+    try:
+        ranked = rank_schedules(
+            p, m, t_f=float(tuner_cfg.get("stage_fwd_time", 1.0)),
+            t_b=tuner_cfg.get("stage_bwd_time"),
+            t_p2p=float(tuner_cfg.get("p2p_time", 0.0)), v=v)
+    except ValueError:
+        return False
+    by_name = {c.name: c.makespan for c in ranked}
+    if sched not in by_name:
+        return True
+    best = min(by_name.values())
+    slack = float(tuner_cfg.get("schedule_cost_slack", 0.05))
+    return by_name[sched] > best * (1.0 + slack)
 
 
 @register_prune

@@ -193,6 +193,15 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group: Group = None,
     partial = _axis_partial(t, g)
 
     def _finish(val, remaining_partial=()):
+        from ..common import flags as _flags
+
+        if sync_op and _flags.get_flag("FLAGS_sync_nccl_allreduce"):
+            # the NCCL stream-sync analog: XLA dispatch is async, so the
+            # eager collective blocks until the result is materialised
+            try:
+                val.block_until_ready()
+            except AttributeError:
+                pass
         if is_tensor:
             tensor.set_value(val)
             tensor._partial_axes = tuple(remaining_partial)

@@ -133,8 +133,15 @@ def launch(args=None) -> int:
     # proc, which is the scale-down testbed.  Multi-launcher setups
     # (explicit --master or --rank > 0) keep the min_nodes rendezvous
     # semantics: scaling them requires a coordinated re-rendezvous.
+    # the local scale-down testbed needs an explicit opt-in
+    # (PADDLE_ELASTIC_LOCAL=1 or --standalone-ish single node): inferring
+    # it from a missing --master would silently give a genuine
+    # multi-node elastic deployment the wrong (all-local) topology
+    local_elastic = os.environ.get("PADDLE_ELASTIC_LOCAL", "") in (
+        "1", "true", "True")
     single_host = (mgr.max_nodes == 1
-                   or (args.master is None and args.rank == 0
+                   or (local_elastic and args.master is None
+                       and args.rank == 0
                        and mgr.max_nodes > mgr.min_nodes))
     # single-host elastic starts at FULL size and scales DOWN one node
     # per failed generation until min_nodes (the reference manager's

@@ -97,7 +97,13 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
             pass
         except Exception:
             # a real kernel regression must not silently become a ~12x
-            # slowdown: warn once, then fall back
+            # slowdown: warn once, then fall back — unless
+            # FLAGS_enable_api_kernel_fallback=false (the phi
+            # fallback-to-CPU-kernel gate), which makes it raise
+            from ...common import flags as _flags
+
+            if not _flags.get_flag("FLAGS_enable_api_kernel_fallback"):
+                raise
             global _WARNED_FALLBACK
             if not _WARNED_FALLBACK:
                 _WARNED_FALLBACK = True

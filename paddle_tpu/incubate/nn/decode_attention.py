@@ -63,6 +63,12 @@ def _mmha_op(x, cache_kv, seq_lens, rotary_embs=None, *, num_heads: int,
             jnp.stack([kc, vc], axis=0))
 
 
+# reference-name alias: the _-suffixed (inplace-signature) op variant
+# (paddle/phi/ops/yaml/ops.yaml masked_multihead_attention_) — same
+# math; "inplace" is a buffer-reuse contract XLA donation handles
+register("masked_multihead_attention_", amp="white")(_mmha_op.raw_fn)
+
+
 def masked_multihead_attention(x, cache_kv, seq_lens, rotary_embs=None,
                                num_heads: Optional[int] = None,
                                head_dim: Optional[int] = None, scale=None,
@@ -178,9 +184,16 @@ def _mea_op(query, key, value, attn_bias=None, *, p: float = 0.0,
 
 def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
                                scale=None, training=True, causal=False,
-                               chunk=512, **kw):
+                               chunk=None, **kw):
     """xformers-style memory-efficient attention (reference
     incubate/nn/functional/memory_efficient_attention.py); dropout ``p``
-    is accepted for parity (inference path ignores it)."""
+    is accepted for parity (inference path ignores it).  The KV chunk
+    size defaults to FLAGS_multi_block_attention_min_partition_size
+    (the GPU multi-block decode partition knob)."""
+    if chunk is None:
+        from ...common import flags as _flags
+
+        chunk = int(_flags.get_flag(
+            "FLAGS_multi_block_attention_min_partition_size"))
     return _mea_op(query, key, value, attn_bias, p=p, scale=scale,
                    causal=causal, chunk=chunk)

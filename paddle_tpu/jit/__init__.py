@@ -59,6 +59,9 @@ class TracedLayer:
         self._broken_sigs: set = set()
         self._sot = None          # SegmentRunner, created on first break
         self._sot_disabled = False
+        import threading as _threading
+
+        self._sot_lock = _threading.Lock()
         if self._is_layer:
             layer = fn_or_layer
 
@@ -204,18 +207,26 @@ class TracedLayer:
             return self._target(*args, **kwargs)
         from . import sot as _sot
 
-        if self._sot is None:
-            self._sot = _sot.SegmentRunner()
+        if not self._sot_lock.acquire(blocking=False):
+            # another thread is running this layer's runner — its
+            # nodes/env are single-segment state; run this call eager
+            return self._target(*args, **kwargs)
         try:
+            if self._sot is None:
+                self._sot = _sot.SegmentRunner()
             with _tape.no_grad():
                 with _sot.segmented(self._sot):
                     out = self._target(*args, **kwargs)
                 return self._sot.finalize(out)
-        except Exception:
-            # segmentation is an optimisation — never a correctness
-            # cliff.  Disable it for this callable and run plain eager.
+        except _sot.SotError:
+            # machinery fault only — user exceptions propagate (re-
+            # running them eagerly would silently duplicate host side
+            # effects).  Disable segmentation for this callable and run
+            # plain eager.
             self._sot_disabled = True
             return self._target(*args, **kwargs)
+        finally:
+            self._sot_lock.release()
 
     # introspection ---------------------------------------------------------
     def lower(self, *args, **kwargs):

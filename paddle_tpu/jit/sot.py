@@ -28,6 +28,7 @@ segment across ops.
 
 from __future__ import annotations
 
+import weakref as _weakref
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -45,6 +46,14 @@ from ..ops.registry import _SOT_TLS  # noqa: E402
 
 def active_runner():
     return getattr(_SOT_TLS, "rec", None)
+
+
+class SotError(RuntimeError):
+    """Failure inside the SOT segmentation machinery itself (segment
+    compile/execute, orphaned lazies) — distinct from exceptions the
+    user's callable raises, so TracedLayer can fall back to plain eager
+    ONLY for machinery faults and let user errors propagate (no silent
+    side-effect re-execution)."""
 
 _STATS = {"segments_compiled": 0, "segments_hit": 0, "flushes": 0,
           "breaks": 0}
@@ -64,7 +73,8 @@ class LazyArray:
     jax.Array surface Tensor uses for metadata (shape/ndim/dtype) and
     flushes the owning segment on any host materialisation."""
 
-    __slots__ = ("aval", "_runner", "_concrete", "_env_idx", "_epoch")
+    __slots__ = ("aval", "_runner", "_concrete", "_env_idx", "_epoch",
+                 "__weakref__")
     _lazy_tensor_value_ = True  # Tensor.__init__ pass-through marker
 
     def __init__(self, aval, runner, env_idx, epoch):
@@ -96,15 +106,16 @@ class LazyArray:
     def force(self):
         if self._concrete is None:
             if self._runner is None:
-                raise RuntimeError(
+                raise SotError(
                     "lazy tensor escaped an aborted SOT segment (the "
                     "segmented call raised before this value was "
                     "computed); it has no value")
             self._runner.flush()
             if self._concrete is None:
-                raise RuntimeError(
+                raise SotError(
                     "lazy tensor was not materialised by its segment "
-                    "flush (escaped a cleared segment)")
+                    "flush (dead at flush time or escaped a cleared "
+                    "segment)")
         return self._concrete
 
     def __array__(self, dtype=None):
@@ -139,7 +150,7 @@ class LazyArray:
 
 class _Node:
     __slots__ = ("op_name", "fn", "treedef", "slots", "statics",
-                 "out_treedef", "outs")
+                 "out_treedef")
 
     def __init__(self, op_name, fn, treedef, slots, statics):
         self.op_name = op_name
@@ -258,23 +269,27 @@ class SegmentRunner:
         for o in out_leaves:
             la = LazyArray(jax.ShapeDtypeStruct(o.shape, o.dtype), self,
                            len(self.env), self.epoch)
-            self.env.append(la)
+            # env holds WEAK refs: an intermediate whose Tensor died by
+            # flush time is not returned from the compiled segment, so
+            # XLA can fuse/DCE it — only externally-held values
+            # materialise (the fusion win the segmenting exists for)
+            self.env.append(_weakref.ref(la))
             outs.append(la)
-        node.outs = outs
         self.nodes.append(node)
         out_tree = jax.tree_util.tree_unflatten(out_treedef, outs)
         return _wrap_like(op, out_tree)
 
     # -- flushing -----------------------------------------------------------
-    def _segment_key(self):
+    @staticmethod
+    def _key_of(nodes, ext_vals):
         parts = []
-        for n in self.nodes:
+        for n in nodes:
             parts.append((n.op_name, str(n.treedef), tuple(n.slots),
                           tuple(repr(s) for s in n.statics)))
         ext_sig = tuple((tuple(np.shape(v)),
                          str(v.dtype if isinstance(v, jax.Array)
                              else np.asarray(v).dtype))
-                        for v in self.ext_vals)
+                        for v in ext_vals)
         return (tuple(parts), ext_sig)
 
     def flush(self):
@@ -285,43 +300,56 @@ class SegmentRunner:
         _STATS["flushes"] += 1
         nodes, env = self.nodes, self.env
         ext_vals = self.ext_vals
-        key = self._segment_key()
-        compiled = self.cache.get(key)
-        if compiled is None:
-            _STATS["segments_compiled"] += 1
-            # node/env lists are captured by value (the wiring in `key`
-            # guarantees any later call with this key replays identically)
-            snap_nodes = list(nodes)
-
-            def replay(ext):
-                environ: List[Any] = []
-                for n in snap_nodes:
-                    full = []
-                    for s in n.slots:
-                        kind, idx = s
-                        if kind == "lazy":
-                            full.append(environ[idx])
-                        elif kind == "ext":
-                            full.append(ext[idx])
-                        else:
-                            full.append(n.statics[idx])
-                    a_, k_ = jax.tree_util.tree_unflatten(n.treedef, full)
-                    out = n.fn(*a_, **k_)
-                    environ.extend(jax.tree_util.tree_leaves(out))
-                return environ
-
-            if len(self.cache) >= self.CACHE_CAP:
-                self.cache.pop(next(iter(self.cache)))  # FIFO evict
-            compiled = self.cache[key] = jax.jit(replay)
-        else:
-            _STATS["segments_hit"] += 1
-        results = compiled([jnp.asarray(v) for v in ext_vals])
-        for la, val in zip(env, results):
-            la._concrete = val
+        # clear state FIRST: a machinery failure below must not leave a
+        # half-flushed segment behind
         self.segments_run += 1
         self.epoch += 1
         self.nodes, self.env = [], []
         self.ext_vals, self.ext_ids = [], {}
+        # liveness snapshot: only env slots whose LazyArray is still
+        # externally referenced become segment outputs
+        live = [(i, r()) for i, r in enumerate(env)]
+        live = [(i, la) for i, la in live if la is not None]
+        live_idx = tuple(i for i, _ in live)
+        try:
+            key = (self._key_of(nodes, ext_vals), live_idx)
+            compiled = self.cache.get(key)
+            if compiled is None:
+                _STATS["segments_compiled"] += 1
+                # node list captured by value (the wiring in `key`
+                # guarantees later calls with this key replay identically)
+                snap_nodes = list(nodes)
+
+                def replay(ext):
+                    environ: List[Any] = []
+                    for n in snap_nodes:
+                        full = []
+                        for s in n.slots:
+                            kind, idx = s
+                            if kind == "lazy":
+                                full.append(environ[idx])
+                            elif kind == "ext":
+                                full.append(ext[idx])
+                            else:
+                                full.append(n.statics[idx])
+                        a_, k_ = jax.tree_util.tree_unflatten(n.treedef,
+                                                              full)
+                        out = n.fn(*a_, **k_)
+                        environ.extend(jax.tree_util.tree_leaves(out))
+                    return [environ[i] for i in live_idx]
+
+                if len(self.cache) >= self.CACHE_CAP:
+                    self.cache.pop(next(iter(self.cache)))  # FIFO evict
+                compiled = self.cache[key] = jax.jit(replay)
+            else:
+                _STATS["segments_hit"] += 1
+            results = compiled([jnp.asarray(v) for v in ext_vals])
+        except Exception as e:
+            # machinery fault (segment trace/compile/execute) — tag it so
+            # TracedLayer falls back to eager for THIS callable only
+            raise SotError(f"segment compile/execute failed: {e}") from e
+        for (_, la), val in zip(live, results):
+            la._concrete = val
 
     def finalize(self, out_tree):
         """Flush the trailing segment and replace lazy leaves of the
@@ -373,8 +401,10 @@ class segmented:
             # abort pending work: orphan the escaped lazies so touching
             # one raises (force() checks _runner) instead of yielding
             # a silent None
-            for la in self.runner.env:
-                la._runner = None
+            for r in self.runner.env:
+                la = r()
+                if la is not None:
+                    la._runner = None
             self.runner.nodes, self.runner.env = [], []
             self.runner.ext_vals, self.runner.ext_ids = [], {}
             self.runner.epoch += 1

@@ -46,6 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .llama import (LlamaConfig, LLAMA_SHARDING_PLAN, plan_spec_for,
                     _filter_spec_to_mesh, _rope_tables)
+from ..parallel import compat as _compat
 from ..parallel.pipelining import pipeline_apply
 from ..parallel.sep import ulysses_attention
 from ..parallel.ring_attention import ring_flash_attention
@@ -210,7 +211,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                             sep_attn: str = "ulysses",
                             schedule: str = "gpipe",
                             virtual_chunks: int = 1,
-                            data_axes: Tuple[str, ...] = ("dp", "sharding")):
+                            data_axes: Tuple[str, ...] = ("dp", "sharding"),
+                            cpu_bf16: str = "promote"):
     """Build the fully-composed hybrid train step:
 
         step(params, opt_state, step_no, lr, input_ids, labels)
@@ -239,12 +241,53 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
     for ax in HYBRID_AXES:
         if ax not in mesh.axis_names:
             raise ValueError(f"hybrid mesh must carry axis {ax!r}")
+    fp32_wire = False
     if compute_dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
         # XLA:CPU's AllReducePromotion pass aborts ("Invalid binary
-        # instruction opcode copy") on the bf16 collectives this program
-        # emits (psum/ppermute transposes inside the manual region); TPU
-        # handles bf16 collectives natively.  Promote on CPU only.
-        compute_dtype = jnp.float32
+        # instruction opcode copy") cloning any shardy-emitted bf16
+        # all-reduce (the reduction region is rooted at a Sharding
+        # custom-call CreateBinary can't clone); TPU handles bf16
+        # collectives natively.  Two CPU modes:
+        # - "promote" (default): whole program fp32 — safe everywhere.
+        # - "fp32-wire": COMPUTE stays genuinely bf16; only the
+        #   shard_map boundary values and the manual collectives
+        #   (parallel/compat.py) ride fp32 wires.  This is the CI mode
+        #   that exercises the same bf16 program the TPU runs; it
+        #   cannot host auto-axis (mp/sharding) bf16 reductions, which
+        #   the partitioner inserts out of our reach.
+        if cpu_bf16 == "promote":
+            compute_dtype = jnp.float32
+        elif cpu_bf16 == "fp32-wire":
+            fp32_wire = True
+            if mesh.shape["mp"] > 1 or mesh.shape["sharding"] > 1:
+                raise NotImplementedError(
+                    "cpu_bf16='fp32-wire' supports manual-axis "
+                    "compositions (pp/sep, and dp on the schedule-"
+                    "explicit path); mp/sharding insert auto bf16 "
+                    "reductions that crash XLA:CPU — use "
+                    "cpu_bf16='promote' for those meshes")
+            if mesh.shape["dp"] > 1 and schedule.lower() == "gpipe":
+                # on the gpipe path dp is an AUTO axis: the outer
+                # jax.grad makes the partitioner insert a bf16 grad
+                # all-reduce over dp — the same crash.  dp is manual
+                # (and safe) only on the schedule-explicit path.
+                raise NotImplementedError(
+                    "cpu_bf16='fp32-wire' with dp>1 needs the "
+                    "schedule-explicit path (schedule='1F1B'/'ZBH1'), "
+                    "where dp is a manual axis; gpipe's auto-dp grad "
+                    "reduction is bf16 and crashes XLA:CPU")
+        else:
+            raise ValueError(f"unknown cpu_bf16 mode {cpu_bf16!r}")
+
+    def _wire_in(t):
+        """bf16 -> fp32 at the shard_map boundary (cpu fp32-wire)."""
+        return (t.astype(jnp.float32)
+                if fp32_wire and t.dtype == jnp.bfloat16 else t)
+
+    def _wire_body(t):
+        """fp32 -> bf16 on entry into the manual region body."""
+        return (t.astype(jnp.bfloat16)
+                if fp32_wire and t.dtype == jnp.float32 else t)
     L = cfg.num_hidden_layers
     pp = mesh.shape[pp_axis]
     sep = mesh.shape[sep_axis]
@@ -281,6 +324,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         """Manual region over {pp, sep}.  stacked leaves: [L/pp, ...]
         (auto-sharded over sharding/mp on trailing dims); x: [m, mb,
         s_local, hidden]; cos/sin: [s_local, head_dim]."""
+        stacked = jax.tree_util.tree_map(_wire_body, stacked)
+        x, cos, sin = _wire_body(x), _wire_body(cos), _wire_body(sin)
         layer_step = _make_layer_step(cos, sin)
 
         def stage_fn(stage_params, act):
@@ -293,7 +338,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         # the replicated-out-spec read is valid on every rank
         is_last = (lax.axis_index(pp_axis)
                    == lax.axis_size(pp_axis) - 1).astype(outs.dtype)
-        return lax.psum(outs * is_last, pp_axis)
+        return _wire_in(_compat.psum(outs * is_last, pp_axis))
 
     shmap = jax.shard_map(
         pipeline_body, mesh=mesh, axis_names={pp_axis, sep_axis},
@@ -314,19 +359,17 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             raise NotImplementedError(
                 "schedule-explicit hybrid needs an untied lm_head (the "
                 "embedding lives outside the pipeline)")
-        if mesh.shape["dp"] > 1:
-            # batch dims must stay unsharded over AUTO axes inside the
-            # executor: its per-rank lax.switch branches diverge across
-            # pp rows, and GSPMD-inserted batch collectives inside those
-            # branches deadlock the collective rendezvous (XLA:CPU
-            # reproduces it deterministically).  FSDP ('sharding') on
-            # WEIGHTS is fine — proven by tests; dp would silently
-            # replicate compute, so reject it loudly.  Use
-            # schedule='gpipe' for dp/sharding batch composition.
-            raise NotImplementedError(
-                "schedule-explicit hybrid (1F1B/ZBH1) composes "
-                "pp x sep x mp with FSDP-at-rest weights; dp>1 requires "
-                "schedule='gpipe'")
+        # dp composes as a MANUAL axis here: batch dims must not be
+        # sharded over AUTO axes inside the executor (its per-rank
+        # lax.switch branches diverge across pp rows, and GSPMD-inserted
+        # batch collectives inside those branches deadlock the
+        # collective rendezvous — XLA:CPU reproduces it
+        # deterministically).  Instead the batch is split over dp
+        # manually, each dp rank runs the schedule on its shard, and the
+        # micro-batch grads are psum'ed over dp AT SCHEDULE END —
+        # uniform across ranks, outside the divergent branches (the
+        # fused_allreduce_gradients analog,
+        # fleet/utils/hybrid_parallel_util.py:249).
         from ..parallel.pipelining import pipeline_train_step
         from ..parallel.schedules import build_schedule
 
@@ -343,11 +386,18 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
 
         _vpp_order, _vpp_inv = vpp_device_major_order(pp, vch)
 
+    dpd = mesh.shape["dp"]
+    dp_entry = "dp" if dpd > 1 else None
+
     def pipeline_body_sched(chunked, x, y, cos, sin, head_params):
         """chunked leaves arrive [v, L/(pp*v), ...] per rank (v=1 for
-        1F1B/ZBH1; VPP device-major chunks otherwise); x [m, mb,
-        s_local, h]; y [m, mb, s_local]; head_params = final norm + LM
-        head (grads via the executor's loss-params channel)."""
+        1F1B/ZBH1; VPP device-major chunks otherwise); x [m, mb_local,
+        s_local, h] (mb split over manual dp); y [m, mb_local, s_local];
+        head_params = final norm + LM head (grads via the executor's
+        loss-params channel)."""
+        chunked = jax.tree_util.tree_map(_wire_body, chunked)
+        head_params = jax.tree_util.tree_map(_wire_body, head_params)
+        x, cos, sin = _wire_body(x), _wire_body(cos), _wire_body(sin)
         layer_step = _make_layer_step(cos, sin)
 
         def stage_fn(chunk, act):
@@ -361,28 +411,35 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                 logits.astype(jnp.float32), axis=-1)
             gold = jnp.take_along_axis(
                 logits, y_mb[..., None], axis=-1)[..., 0].astype(jnp.float32)
-            # local-token mean / sep degree: summed over sep below, this
-            # is the GLOBAL token mean (equal shard sizes)
-            return (lse - gold).mean() / sep
+            # local-token mean / (sep*dp) degree: summed over sep+dp
+            # below, this is the GLOBAL token mean (equal shard sizes)
+            return (lse - gold).mean() / (sep * dpd)
 
         loss, sgrads, hgrads, dxs = pipeline_train_step(
             stage_fn, loss_fn, sched, chunked, x, y, axis=pp_axis,
             loss_params=head_params, want_x_grad=True)
-        if sep > 1:
-            loss = lax.psum(loss, sep_axis)
+        reduce_axes = tuple(ax for ax, deg in ((sep_axis, sep),
+                                               ("dp", dpd)) if deg > 1)
+        if reduce_axes:
+            # uniform across ranks, AFTER the divergent schedule — the
+            # manual-dp grad allreduce (and the sep grad reduction)
+            loss = _compat.psum(loss, reduce_axes)
             sgrads = jax.tree_util.tree_map(
-                lambda a: lax.psum(a, sep_axis), sgrads)
+                lambda a: _compat.psum(a, reduce_axes), sgrads)
             hgrads = jax.tree_util.tree_map(
-                lambda a: lax.psum(a, sep_axis), hgrads)
-        return loss, sgrads, hgrads, dxs
+                lambda a: _compat.psum(a, reduce_axes), hgrads)
+        sgrads = jax.tree_util.tree_map(_wire_in, sgrads)
+        hgrads = jax.tree_util.tree_map(_wire_in, hgrads)
+        return loss, sgrads, hgrads, _wire_in(dxs)
 
     shmap_sched = jax.shard_map(
-        pipeline_body_sched, mesh=mesh, axis_names={pp_axis, sep_axis},
-        in_specs=(P("pp"), P(None, None, sep_entry, None),
-                  P(None, None, sep_entry),
+        pipeline_body_sched, mesh=mesh,
+        axis_names={pp_axis, sep_axis, "dp"},
+        in_specs=(P("pp"), P(None, dp_entry, sep_entry, None),
+                  P(None, dp_entry, sep_entry),
                   P(sep_entry, None), P(sep_entry, None), P()),
         out_specs=(P(), P("pp"), P(),
-                   P(None, None, sep_entry, None)),
+                   P(None, dp_entry, sep_entry, None)),
         check_vma=False) if sched is not None else None
 
     def loss_fn(params, input_ids, labels):
@@ -396,7 +453,9 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             x, NamedSharding(mesh, P(None, batch_entry, sep_entry, None)))
         cos = cos_full[:S].astype(compute_dtype)
         sin = sin_full[:S].astype(compute_dtype)
-        h = shmap(stacked, x, cos, sin)
+        h = shmap(jax.tree_util.tree_map(_wire_in, stacked), _wire_in(x),
+                  _wire_in(cos), _wire_in(sin))
+        h = _wire_body(h)
         h = _rms_norm(h, outer["model.norm.weight"], cfg.rms_norm_eps)
         if cfg.tie_word_embeddings:
             logits = h @ outer["model.embed_tokens.weight"].T
@@ -443,16 +502,19 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         """Schedule-explicit train step: grads come from the executor's
         in-schedule vjps (stages), loss-params channel (norm + head) and
         x-grad channel (embedding), not from an outer jax.grad."""
-        if sep_entry is not None:
-            # batch stays REPLICATED over dp/sharding here (see the
-            # build-time guard); only the sep split applies
-            bs = NamedSharding(mesh, P(None, sep_entry))
+        if sep_entry is not None or dp_entry is not None:
+            # batch splits over MANUAL dp (and sep); 'sharding' stays a
+            # weights-only (FSDP-at-rest) axis on this path
+            bs = NamedSharding(mesh, P(dp_entry, sep_entry))
             input_ids = lax.with_sharding_constraint(input_ids, bs)
             labels = lax.with_sharding_constraint(labels, bs)
         cast = _cast(params)
         outer, stacked = _split(cast)
         B, S = input_ids.shape
         mb = B // m
+        if mb % dpd:
+            raise ValueError(
+                f"micro-batch size {mb} not divisible by dp degree {dpd}")
         ids = input_ids.reshape(m, mb, S)
         y = labels.reshape(m, mb, S)
 
@@ -461,7 +523,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
 
         x, embed_vjp = jax.vjp(embed_fn, outer["model.embed_tokens.weight"])
         x = lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(None, None, sep_entry, None)))
+            x, NamedSharding(mesh, P(None, dp_entry, sep_entry, None)))
         cos = cos_full[:S].astype(compute_dtype)
         sin = sin_full[:S].astype(compute_dtype)
         nstage = pp * sched.v
@@ -476,8 +538,10 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         chunked = jax.tree_util.tree_map(_to_chunks, stacked)
         head_params = {"norm": cast["model.norm.weight"],
                        "head": cast["lm_head.weight"]}
-        loss, sgrads, hgrads, dxs = shmap_sched(chunked, x, y, cos, sin,
-                                                head_params)
+        loss, sgrads, hgrads, dxs = shmap_sched(
+            jax.tree_util.tree_map(_wire_in, chunked), _wire_in(x), y,
+            _wire_in(cos), _wire_in(sin),
+            jax.tree_util.tree_map(_wire_in, head_params))
         (d_embed,) = embed_vjp(dxs.astype(x.dtype))
         grads = {}
         for suffix, g in sgrads.items():

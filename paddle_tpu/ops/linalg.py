@@ -55,7 +55,12 @@ def inner(x, y):
 
 @register("einsum", amp="white")
 def einsum(equation, *operands):
-    return jnp.einsum(equation, *operands)
+    from ..common import flags as _flags
+
+    # FLAGS_einsum_opt: exhaustive contraction-order search (the
+    # reference flag's intermediate-reuse intent, XLA-native form)
+    opt = "optimal" if _flags.get_flag("FLAGS_einsum_opt") else "auto"
+    return jnp.einsum(equation, *operands, optimize=opt)
 
 
 @register("addmm", amp="white")

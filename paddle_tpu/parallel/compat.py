@@ -1,0 +1,48 @@
+"""Collective dtype compatibility for the CPU test backend.
+
+XLA:CPU's AllReducePromotion pass aborts ("Invalid binary instruction
+opcode copy") on bf16 manual collectives (ppermute/psum/all_to_all inside
+shard_map regions); TPU handles bf16 collectives natively.  These
+wrappers promote JUST the collective to fp32 on the cpu backend — the
+surrounding compute stays bf16, so CI on the 8-device CPU mesh exercises
+the same bf16 program the TPU runs, modulo fp32 wire precision (strictly
+MORE precise, so parity tolerances remain valid).
+
+On TPU the wrappers are identity pass-throughs (bf16 on the wire —
+halving ICI bytes is exactly why the hybrid step computes in bf16).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _promote(x):
+    return (jax.default_backend() == "cpu"
+            and getattr(x, "dtype", None) == jnp.bfloat16)
+
+
+def ppermute(x, axis_name, perm):
+    if _promote(x):
+        return lax.ppermute(x.astype(jnp.float32), axis_name,
+                            perm).astype(jnp.bfloat16)
+    return lax.ppermute(x, axis_name, perm)
+
+
+def psum(x, axis_name):
+    if _promote(x):
+        return lax.psum(x.astype(jnp.float32),
+                        axis_name).astype(jnp.bfloat16)
+    return lax.psum(x, axis_name)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, *, tiled=False):
+    if _promote(x):
+        return lax.all_to_all(x.astype(jnp.float32), axis_name,
+                              split_axis=split_axis,
+                              concat_axis=concat_axis,
+                              tiled=tiled).astype(jnp.bfloat16)
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import compat as _compat
+
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
                    axis: str = "pp", num_microbatches: int | None = None,
@@ -74,7 +76,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
         emit = emit.reshape((m,) + (1,) * (outs.ndim - 1))
         outs = jnp.where(emit, out.astype(outs.dtype)[None], outs)
         # advance the ring: stage i's output becomes stage i+1's input
-        state = lax.ppermute(out, axis, perm)
+        state = _compat.ppermute(out, axis, perm)
         return state, outs
 
     _, outs = lax.fori_loop(0, ticks, tick, (state, outs))
@@ -330,9 +332,9 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
         # barriers pin the global order fwd(t) -> bwd(t) -> fwd(t+1): the
         # first sequences the pair inside the tick, the second makes
         # EVERY carry output (hence all of tick t+1) depend on bwd(t).
-        fcarry = lax.ppermute(fsend, axis, perm_r)
+        fcarry = _compat.ppermute(fsend, axis, perm_r)
         fcarry, bsend = lax.optimization_barrier((fcarry, bsend))
-        bcarry = lax.ppermute(bsend, axis, perm_l)
+        bcarry = _compat.ppermute(bsend, axis, perm_l)
         return lax.optimization_barrier(
             (stash, gin, fcarry, bcarry, gacc, lacc, dxs, loss_acc))
 
@@ -340,13 +342,13 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
     _, _, _, _, gacc, lacc, dxs, loss_acc = lax.fori_loop(
         0, sched.ticks, tick, init)
     # only the last rank accumulated real losses; share it
-    loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), axis)
+    loss = _compat.psum(jnp.where(is_last, loss_acc, 0.0), axis)
     out = [loss, gacc]
     if loss_params is not None:
         # real only on the last rank (masked zeros elsewhere): share
         out.append(jax.tree_util.tree_map(
-            lambda a: lax.psum(a, axis), lacc))
+            lambda a: _compat.psum(a, axis), lacc))
     if want_x_grad:
         # real only on rank 0 (first global stage)
-        out.append(lax.psum(jnp.where(is_first, dxs, 0.0), axis))
+        out.append(_compat.psum(jnp.where(is_first, dxs, 0.0), axis))
     return tuple(out)

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import compat as _compat
+
 
 def _interpret():
     return jax.default_backend() == "cpu"
@@ -120,8 +122,8 @@ def _ring_forward_loop(q, k, v, axis, causal, scale):
         src = (me - i) % p  # after i hops we hold rank (me - i)'s block
         m, l, acc = merge((m, l, acc), (kb, vb), src)
         if i != p - 1:
-            kb = lax.ppermute(kb, axis, perm)
-            vb = lax.ppermute(vb, axis, perm)
+            kb = _compat.ppermute(kb, axis, perm)
+            vb = _compat.ppermute(vb, axis, perm)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o = acc / l_safe.transpose(0, 2, 1, 3)
     # global logsumexp of each row (backward residual): lse = m + log(l)
@@ -197,10 +199,10 @@ def _ring_bwd(axis, causal, scale, res, g):
         # again carrying all devices' contributions; the k/v blocks
         # themselves are no longer needed after the last compute
         if i != p - 1:
-            kb = lax.ppermute(kb, axis, perm)
-            vb = lax.ppermute(vb, axis, perm)
-        dkb = lax.ppermute(dkb, axis, perm)
-        dvb = lax.ppermute(dvb, axis, perm)
+            kb = _compat.ppermute(kb, axis, perm)
+            vb = _compat.ppermute(vb, axis, perm)
+        dkb = _compat.ppermute(dkb, axis, perm)
+        dvb = _compat.ppermute(dvb, axis, perm)
     return dq.astype(q.dtype), dkb.astype(k.dtype), dvb.astype(v.dtype)
 
 

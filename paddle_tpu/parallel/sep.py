@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import compat as _compat
+
 
 def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = True,
                       scale: Optional[float] = None):
@@ -38,11 +40,11 @@ def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = True,
 
     # seq→heads: [b, s/P, h, d] → [b, s, h/P, d]
     def fwd(x):
-        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+        return _compat.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
 
     # heads→seq: inverse exchange
     def bwd(x):
-        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+        return _compat.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
     qg, kg, vg = fwd(q), fwd(k), fwd(v)
     from ..ops.pallas.flash_attention import flash_attention_raw

@@ -56,7 +56,10 @@ class RecordEvent:
             "name": self.name, "cat": self.event_type, "ph": "X",
             "ts": self._begin / 1000.0,
             "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
-            "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFF,
+            # full ident: masking could collide two threads into one
+            # (pid, tid) sweep lane and corrupt the per-thread self-time
+            # subtraction in summarize_events
+            "pid": os.getpid(), "tid": threading.get_ident(),
         })
 
     def __enter__(self):

@@ -209,3 +209,382 @@ class nn:
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+
+# --------------------------------------------------------------------------
+# round-4 breadth (VERDICT r3 next#7): the phi sparse core set —
+# unary zoo w/ grads, binary/multiary, nn.functional incl. conv3d /
+# pooling / softmax / sparse attention, so a sparse GNN or sparse-
+# attention block trains.  Reference: paddle/phi/kernels/sparse/ and
+# python/paddle/sparse/{unary,binary,multiary}.py.
+# --------------------------------------------------------------------------
+
+def _like(x, data, coo=None):
+    """Rebuild a sparse tensor of x's format with new values."""
+    c = coo if coo is not None else _coo(x)
+    out = jsparse.BCOO((data, c.indices), shape=c.shape)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCooTensor(out).to_sparse_csr()
+    return SparseCooTensor(out)
+
+
+def _unary(fn_name, jfn):
+    def op(x, *args, **kw):
+        c = _coo(x)
+        return _like(x, jfn(c.data, *args, **kw), c)
+
+    op.__name__ = fn_name
+    op.__doc__ = (f"Elementwise {fn_name} on the stored values "
+                  "(reference python/paddle/sparse/unary.py — zero-"
+                  "preserving, so the pattern is unchanged).")
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)  # noqa: A001 — reference name
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor):  # noqa: A001 — reference name
+    c = _coo(x)
+    return _like(x, jnp.power(c.data, factor), c)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    c = _coo(x)
+    data = c.data if value_dtype is None else c.data.astype(
+        jnp.dtype(value_dtype))
+    idx = c.indices if index_dtype is None else c.indices.astype(
+        jnp.dtype(index_dtype))
+    return _like(x, data, jsparse.BCOO((data, idx), shape=c.shape))
+
+
+def isnan(x):
+    c = _coo(x)
+    return _like(x, jnp.isnan(c.data), c)
+
+
+def divide(x, y):
+    c = _coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # same-pattern divide (reference: elementwise on a coalesced
+        # pair) — pairing values positionally is only valid when the
+        # patterns MATCH, so verify (host-side; patterns are concrete)
+        c = c.sum_duplicates()
+        yc = _coo(y).sum_duplicates()
+        if c.indices.shape != yc.indices.shape or not np.array_equal(
+                np.asarray(c.indices), np.asarray(yc.indices)):
+            raise ValueError(
+                "sparse divide requires matching sparsity patterns "
+                "(dense semantics would produce inf/nan at mismatched "
+                "entries); densify one operand for mixed patterns")
+        return _like(x, c.data / yc.data, c)
+    dense_vals = _val(y)[tuple(c.indices[:, i] for i in range(c.ndim))]
+    return _like(x, c.data / dense_vals, c)
+
+
+def mv(x, vec):
+    """sparse [M, N] @ dense [N] -> dense [M]."""
+    return Tensor(_coo(x) @ _val(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta * input + alpha * (x @ y) (reference sparse.addmm; x sparse,
+    input/y dense)."""
+    return Tensor(float(beta) * _val(input)
+                  + float(alpha) * (_coo(x) @ _val(y)))
+
+
+def mask_as(x, mask):
+    """Sample dense ``x`` at ``mask``'s sparsity pattern."""
+    m = _coo(mask)
+    vals = _val(x)[tuple(m.indices[:, i] for i in range(m.ndim))]
+    return _like(mask, vals, m)
+
+
+def transpose(x, perm):
+    out = SparseCooTensor(_coo(x).transpose(tuple(perm)))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    c = _coo(x)
+    if axis is None:
+        out = c.data.sum()
+        if dtype is not None:
+            out = out.astype(jnp.dtype(dtype))
+        return Tensor(out.reshape((1,) * c.ndim) if keepdim
+                      else out)
+    dense = c.todense().sum(axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        dense = dense.astype(jnp.dtype(dtype))
+    return Tensor(dense)
+
+
+def reshape(x, shape):
+    return SparseCooTensor(jsparse.bcoo_reshape(
+        _coo(x).sum_duplicates(), new_sizes=tuple(shape)))
+
+
+def coalesce(x):
+    return SparseCooTensor(_coo(x).sum_duplicates())
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001 — reference name
+    c = _coo(x).sum_duplicates()
+    keep = jnp.ones((c.nse,), bool)
+    shifts = [0] * c.ndim
+    new_shape = list(c.shape)
+    for ax, s, e in zip(axes, starts, ends):
+        ax = ax % c.ndim
+        s = s if s >= 0 else s + c.shape[ax]
+        e = min(e if e >= 0 else e + c.shape[ax], c.shape[ax])
+        keep = keep & (c.indices[:, ax] >= s) & (c.indices[:, ax] < e)
+        shifts[ax] = s
+        new_shape[ax] = e - s
+    # static shapes: keep all slots, park dropped entries at index 0
+    # with value 0 (they coalesce away on densify)
+    idx = c.indices - jnp.asarray(shifts, c.indices.dtype)[None, :]
+    idx = jnp.where(keep[:, None], idx, 0)
+    val = jnp.where(keep, c.data, 0)
+    return SparseCooTensor(jsparse.BCOO((val, idx),
+                                        shape=tuple(new_shape)))
+
+
+# ------------------------------------------------------------- sparse nn
+
+
+def _segment_softmax(values, rows, nrows):
+    """Softmax over the entries of each row segment (rows: per-entry
+    row ids) — shared by sparse softmax and both sparse-attention
+    paths."""
+    rowmax = jnp.full((nrows,), -jnp.inf).at[rows].max(values)
+    e = jnp.exp(values - rowmax[rows])
+    denom = jnp.zeros((nrows,)).at[rows].add(e)
+    return e / jnp.maximum(denom[rows], 1e-30)
+
+
+def _sddmm_softmax_spmm(qh, kh, vh, rows, cols, nrows, scale, bias=None):
+    """One attention head over a sparse score pattern: SDDMM at
+    (rows, cols), segment softmax per row, scatter-add spmm with V.
+    Returns (out [nrows, d], raw scores, softmax probs)."""
+    scores = jnp.einsum("nd,nd->n", qh[rows], kh[cols]) * scale
+    if bias is not None:
+        scores = scores + bias
+    p = _segment_softmax(scores, rows, nrows)
+    out = jnp.zeros((nrows, vh.shape[-1])).at[rows].add(
+        p[:, None] * vh[cols])
+    return out, scores, p
+
+
+def _csr_row_softmax(values, crows):
+    """Row-wise softmax over CSR stored values (static shapes: segment
+    softmax via row ids)."""
+    crows = jnp.asarray(crows)
+    nnz = values.shape[0]
+    rows = jnp.searchsorted(crows[1:], jnp.arange(nnz), side="right")
+    return _segment_softmax(values, rows, crows.shape[0] - 1)
+
+
+def softmax(x, axis=-1):
+    """Sparse softmax over the last axis, zeros excluded (reference
+    sparse/softmax_kernel: softmax over stored entries per row)."""
+    if axis != -1:
+        raise NotImplementedError("sparse softmax supports axis=-1")
+    if isinstance(x, SparseCsrTensor):
+        b = x._bcsr
+        vals = _csr_row_softmax(b.data, b.indptr)
+        return SparseCsrTensor(jsparse.BCSR((vals, b.indices, b.indptr),
+                                            shape=b.shape))
+    c = _coo(x).sum_duplicates()
+    # group by all-but-last index dims
+    lead = c.indices[:, :-1]
+    strides = np.concatenate([np.cumprod(c.shape[-2:0:-1])[::-1], [1]])
+    row_id = (lead * jnp.asarray(strides, lead.dtype)[None, :]).sum(1) \
+        if lead.shape[1] else jnp.zeros((c.nse,), jnp.int32)
+    nrows = int(np.prod(c.shape[:-1])) or 1
+    return _like(x, _segment_softmax(c.data, row_id, nrows), c)
+
+
+def _sparse_conv(x, weight, strides, paddings, dilations, groups, subm,
+                 nd):
+    """Shared sparse conv2d/3d: densify -> XLA conv (MXU) -> sample at
+    the active output sites.  Semantically the reference's gather-GEMM-
+    scatter sparse conv (phi/kernels/sparse/conv_kernel.h); the densify
+    form trades worst-case memory for XLA's conv pipeline, the right
+    default on TPU where conv lowers to the systolic array.  ``subm``:
+    output pattern = input pattern (submanifold conv, the GNN
+    backbone)."""
+    c = _coo(x).sum_duplicates()
+    w = _val(weight)                       # [*k, Cin, Cout]
+    dense = c.todense()                    # [N, *spatial, Cin]
+    n = dense.shape[0]
+    cin, cout = w.shape[-2], w.shape[-1]
+    # NDHWC/NHWC -> NC... for lax.conv
+    perm_in = (0, nd + 1) + tuple(range(1, nd + 1))
+    xt = dense.transpose(perm_in)
+    wt = w.transpose((nd + 1, nd) + tuple(range(nd)))  # [Cout, Cin, *k]
+    if subm:
+        # same-pattern output: stride 1, SAME padding
+        pads = [((w.shape[i] - 1) * dilations[i] // 2,
+                 (w.shape[i] - 1) * dilations[i]
+                 - (w.shape[i] - 1) * dilations[i] // 2)
+                for i in range(nd)]
+        out = jax.lax.conv_general_dilated(
+            xt, wt, (1,) * nd, pads, rhs_dilation=tuple(dilations),
+            feature_group_count=groups)
+    else:
+        pads = [(paddings[i], paddings[i]) for i in range(nd)]
+        out = jax.lax.conv_general_dilated(
+            xt, wt, tuple(strides), pads, rhs_dilation=tuple(dilations),
+            feature_group_count=groups)
+    out = out.transpose((0,) + tuple(range(2, nd + 2)) + (1,))  # N...C
+    if subm:
+        # x indices are [N, *spatial, C]; the active SITES are the
+        # UNIQUE [N, *spatial] prefixes (multi-channel entries share a
+        # site) — output carries every Cout channel at each active site
+        # (reference submanifold semantics).  Host-side dedupe: sparse
+        # patterns are data-dependent, these ops are eager-level.
+        sites = jnp.asarray(np.unique(np.asarray(c.indices[:, :nd + 1]),
+                                      axis=0))
+        vals = out[tuple(sites[:, i] for i in range(nd + 1))]
+        # [sites, Cout] -> one entry per (site, channel)
+        nsite = sites.shape[0]
+        full_idx = jnp.concatenate(
+            [jnp.repeat(sites, cout, axis=0),
+             jnp.tile(jnp.arange(cout, dtype=sites.dtype)[:, None],
+                      (nsite, 1))], axis=1)
+        return SparseCooTensor(jsparse.BCOO(
+            (vals.reshape(-1), full_idx), shape=out.shape))
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC"):
+    """Sparse conv3d (reference python/paddle/sparse/nn/functional/
+    conv.py:362): x COO [N, D, H, W, C], weight [kd, kh, kw, Cin/g,
+    Cout]."""
+    st = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dl = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    out = _sparse_conv(x, weight, st, pd, dl, groups, subm=False, nd=3)
+    if bias is not None:
+        c = out._bcoo
+        out = SparseCooTensor(jsparse.BCOO(
+            (c.data + _val(bias)[c.indices[:, -1]], c.indices),
+            shape=c.shape))
+    return out
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None):
+    """Submanifold sparse conv3d (reference conv.py:468): the output
+    keeps the INPUT's active sites — no dilation of the active set, the
+    property sparse CNN backbones rely on."""
+    dl = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    out = _sparse_conv(x, weight, (1, 1, 1), (0, 0, 0), dl, groups,
+                       subm=True, nd=3)
+    if bias is not None:
+        c = out._bcoo
+        out = SparseCooTensor(jsparse.BCOO(
+            (c.data + _val(bias)[c.indices[:, -1]], c.indices),
+            shape=c.shape))
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC"):
+    """Sparse max pooling (reference sparse/pool_kernel.h): windows max
+    over ACTIVE entries only; output sites = windows containing at
+    least one active input."""
+    ks = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+          else tuple(kernel_size))
+    if stride is None:
+        st = ks
+    elif isinstance(stride, int):
+        st = (stride,) * 3
+    else:
+        st = tuple(stride)
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    c = _coo(x).sum_duplicates()
+    dense = c.todense()
+    occ = jnp.zeros(dense.shape, bool).at[
+        tuple(c.indices[:, i] for i in range(c.ndim))].set(
+            c.data == c.data)
+    neg = jnp.where(occ, dense, -jnp.inf)
+    window = (1,) + ks + (1,)
+    strides = (1,) + st + (1,)
+    pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+    pooled = jax.lax.reduce_window(neg, -jnp.inf, jax.lax.max, window,
+                                   strides, pads)
+    any_occ = jax.lax.reduce_window(occ, False, jnp.logical_or, window,
+                                    strides, pads)
+    pooled = jnp.where(any_occ, pooled, 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(pooled))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None):
+    """Sparse attention (reference sparse/fused_attention_kernel.h):
+    scores computed ONLY at ``sparse_mask``'s pattern (SDDMM), row
+    softmax over the stored entries, then sparse @ V.
+
+    query/key/value: dense [b, h, s, d]; sparse_mask: CSR/COO [s, s]
+    pattern shared across (b, h)."""
+    q = _val(query)
+    k = _val(key)
+    v = _val(value)
+    b, h, s, d = q.shape
+    m = _coo(sparse_mask).sum_duplicates()
+    rows, cols = m.indices[:, 0], m.indices[:, 1]
+    scale = 1.0 / math.sqrt(d)
+
+    def one_head(qh, kh, vh):
+        out, _, _ = _sddmm_softmax_spmm(qh, kh, vh, rows, cols, s, scale)
+        return out
+
+    out = jax.vmap(jax.vmap(one_head))(q, k, v)
+    return Tensor(out.astype(q.dtype))
+
+
+import math  # noqa: E402  (attention scale)
+
+nn.functional = type("functional", (), {})()
+nn.functional.relu = relu
+nn.functional.softmax = softmax
+nn.functional.conv3d = conv3d
+nn.functional.subm_conv3d = subm_conv3d
+nn.functional.max_pool3d = max_pool3d
+nn.functional.attention = attention
+
+
+def relu6(x):
+    c = _coo(x)
+    return _like(x, jnp.clip(c.data, 0.0, 6.0), c)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    c = _coo(x)
+    return _like(x, jnp.where(c.data >= 0, c.data,
+                              negative_slope * c.data), c)
+
+
+nn.functional.relu6 = relu6
+nn.functional.leaky_relu = leaky_relu

@@ -145,6 +145,10 @@ else:
          "--log_dir", str(log_dir), str(script)],
         cwd="/root/repo", capture_output=True, text=True, timeout=300,
         env={**os.environ,
+             # explicit opt-in for the local elastic scale-down testbed
+             # (round-4 advisor fix: no longer inferred from a missing
+             # --master)
+             "PADDLE_ELASTIC_LOCAL": "1",
              "PYTHONPATH": "/root/repo" + os.pathsep
              + os.environ.get("PYTHONPATH", "")})
     assert r.returncode == 0, (r.stdout, r.stderr)

@@ -343,3 +343,83 @@ def test_custom_device_plugin_path_env(tmp_path):
 
     with _pytest.raises(ValueError):
         D.register_custom_device("cpu", platform="tpu")  # builtin guard
+
+
+# --------------------------------------------------------------------------
+# round-4: reference-flag completeness (wired + exempt == flags.cc)
+# --------------------------------------------------------------------------
+
+def test_reference_flag_completeness():
+    """Every flag in the reference's paddle/common/flags.cc is either
+    WIRED (same FLAGS_ name, real effect) or EXEMPT with a documented
+    reason (FLAG_EXEMPTIONS) — and never both (VERDICT r3 next#8)."""
+    import re
+
+    from paddle_tpu.common import flags as F
+
+    src_path = "/root/reference/paddle/common/flags.cc"
+    try:
+        src = open(src_path).read()
+    except OSError:
+        pytest.skip("reference tree not available")
+    ref = set(re.findall(r"(?:PD|PHI)_DEFINE_\w+\(\s*([a-zA-Z0-9_]+)", src))
+    assert len(ref) >= 175, f"reference extraction broke: {len(ref)}"
+    wired = {n[len("FLAGS_"):] for n in F.get_flags(None)}
+    exempt = set(F.FLAG_EXEMPTIONS)
+    uncovered = ref - wired - exempt
+    assert not uncovered, f"flags.cc names neither wired nor exempt: " \
+        f"{sorted(uncovered)}"
+    assert not (wired & exempt), f"both wired and exempt: " \
+        f"{sorted(wired & exempt)}"
+    # every exemption carries a non-trivial reason
+    for name, why in F.FLAG_EXEMPTIONS.items():
+        assert isinstance(why, str) and len(why) > 10, name
+
+
+def test_new_wired_flags_have_effects():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.common import flags as F
+
+    # einsum_opt switches the contraction planner without changing results
+    a = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(5, 6).astype(np.float32))
+    base = paddle.einsum("ij,jk->ik", a, b).numpy()
+    paddle.set_flags({"FLAGS_einsum_opt": True})
+    try:
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", a, b).numpy(), base, rtol=1e-6)
+    finally:
+        paddle.set_flags({"FLAGS_einsum_opt": False})
+
+    # decode chunk size follows the flag
+    from paddle_tpu.incubate.nn import memory_efficient_attention
+
+    q = paddle.to_tensor(np.random.rand(1, 4, 2, 8).astype(np.float32))
+    k = paddle.to_tensor(np.random.rand(1, 16, 2, 8).astype(np.float32))
+    paddle.set_flags(
+        {"FLAGS_multi_block_attention_min_partition_size": 8})
+    try:
+        out = memory_efficient_attention(q, k, k)
+    finally:
+        paddle.set_flags(
+            {"FLAGS_multi_block_attention_min_partition_size": 512})
+    assert tuple(out.shape) == (1, 4, 2, 8)
+
+    # selected_gpus filters accelerator enumeration (cpu unaffected)
+    import paddle_tpu.core.device as D
+
+    n = D.device_count("cpu")
+    paddle.set_flags({"FLAGS_selected_gpus": "0"})
+    try:
+        assert D.device_count("cpu") == n
+    finally:
+        paddle.set_flags({"FLAGS_selected_gpus": ""})
+
+    # kernel-fallback gate exists and round-trips
+    paddle.set_flags({"FLAGS_enable_api_kernel_fallback": False})
+    try:
+        assert not F.get_flag("FLAGS_enable_api_kernel_fallback")
+    finally:
+        paddle.set_flags({"FLAGS_enable_api_kernel_fallback": True})

@@ -142,8 +142,9 @@ def test_hybrid_schedule_executor_parity(schedule):
 
 def test_hybrid_schedule_fsdp_weights():
     """1F1B composes with FSDP-at-rest weights ('sharding' on weight
-    dims); batch stays replicated — dp>1 is rejected loudly (the
-    executor's divergent branches cannot host auto batch collectives)."""
+    dims); the batch may NOT shard over auto axes (the executor's
+    divergent branches cannot host auto batch collectives) — dp
+    composes as a manual axis instead (next test)."""
     cfg, model, state0, ids, labels = _setup()
     base_loss, _ = _baseline(model, state0, ids, labels)
     mesh = hybrid_mesh(jax.devices("cpu"), pp=2, sharding=2, mp=2)
@@ -151,9 +152,31 @@ def test_hybrid_schedule_fsdp_weights():
                       num_microbatches=2, schedule="1F1B")
     np.testing.assert_allclose(loss, base_loss, rtol=1e-4)
 
-    mesh_dp = hybrid_mesh(jax.devices("cpu"), pp=2, dp=2, sharding=2)
-    with pytest.raises(NotImplementedError):
-        build_hybrid_train_step(cfg, None, mesh_dp, schedule="1F1B")
+
+def test_hybrid_schedule_dp_parity():
+    """1F1B with dp>1: the batch splits over MANUAL dp inside the
+    executor's shard_map, micro-batch grads psum over dp at schedule
+    end (the fused_allreduce_gradients analog) — loss and updated
+    params must match the pp=1 step (VERDICT r3 next#4)."""
+    cfg, model, state0, ids, labels = _setup()
+    base_loss, base_params = _baseline(model, state0, ids, labels)
+    mesh = hybrid_mesh(jax.devices("cpu"), pp=2, dp=2, sharding=2)
+    loss, params = _hybrid(cfg, model, state0, ids, labels, mesh,
+                           num_microbatches=2, schedule="1F1B")
+    np.testing.assert_allclose(loss, base_loss, rtol=1e-4)
+    _assert_state_close(params, base_params)
+
+
+def test_hybrid_schedule_dp_sep_parity():
+    """ZBH1 with dp x sep x pp composed (manual dp + manual sep in one
+    schedule-explicit program)."""
+    cfg, model, state0, ids, labels = _setup()
+    base_loss, base_params = _baseline(model, state0, ids, labels)
+    mesh = hybrid_mesh(jax.devices("cpu"), pp=2, dp=2, sep=2)
+    loss, params = _hybrid(cfg, model, state0, ids, labels, mesh,
+                           num_microbatches=2, schedule="ZBH1")
+    np.testing.assert_allclose(loss, base_loss, rtol=1e-4)
+    _assert_state_close(params, base_params)
 
 
 def test_hybrid_vpp_parity():
@@ -172,5 +195,82 @@ def test_hybrid_vpp_parity():
     loss, params = _hybrid(cfg, model, state0, ids, labels, mesh,
                            num_microbatches=2, schedule="VPP",
                            virtual_chunks=2)
+    np.testing.assert_allclose(loss, base_loss, rtol=1e-4)
+    _assert_state_close(params, base_params)
+
+
+def test_hybrid_bf16_parity():
+    """The composed flagship in bf16 (fp32 masters, loss-scale-free):
+    genuinely bf16 compute on the CPU CI backend via cpu_bf16='fp32-wire'
+    (collectives+boundaries ride fp32 wires; see parallel/compat.py) —
+    loss parity vs the fp32 baseline within bf16 tolerance (VERDICT r3
+    next#9)."""
+    cfg, model, state0, ids, labels = _setup()
+    base_loss, base_params = _baseline(model, state0, ids, labels)
+    mesh = hybrid_mesh(jax.devices("cpu"), pp=2, sep=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    hstate = shard_hybrid_state(
+        stack_llama_state({k: v.copy() for k, v in state0.items()},
+                          cfg.num_hidden_layers), mesh)
+    opt_state = opt.init_state(hstate)
+    step = build_hybrid_train_step(cfg, opt, mesh,
+                                   compute_dtype=jnp.bfloat16,
+                                   num_microbatches=2,
+                                   cpu_bf16="fp32-wire")
+    loss, new_h, _ = step(hstate, opt_state, 0, 1e-3, ids, labels)
+    assert abs(float(loss) - base_loss) / base_loss < 0.02
+    new_params = {k: np.asarray(v) for k, v in unstack_llama_state(
+        new_h, cfg.num_hidden_layers).items()}
+    # bf16 grads move fp32 masters: direction parity, loose magnitude
+    for k in new_params:
+        np.testing.assert_allclose(new_params[k], base_params[k],
+                                   atol=5e-3, rtol=5e-2, err_msg=k)
+
+
+def test_hybrid_bf16_schedule_dp():
+    """bf16 1F1B with manual dp — the schedule-explicit executor's grads
+    (in-schedule vjps + dp psum) in bf16 compute."""
+    cfg, model, state0, ids, labels = _setup()
+    base_loss, _ = _baseline(model, state0, ids, labels)
+    mesh = hybrid_mesh(jax.devices("cpu"), pp=2, dp=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    hstate = shard_hybrid_state(
+        stack_llama_state({k: v.copy() for k, v in state0.items()},
+                          cfg.num_hidden_layers), mesh)
+    opt_state = opt.init_state(hstate)
+    step = build_hybrid_train_step(cfg, opt, mesh,
+                                   compute_dtype=jnp.bfloat16,
+                                   num_microbatches=2, schedule="1F1B",
+                                   cpu_bf16="fp32-wire")
+    loss, _, _ = step(hstate, opt_state, 0, 1e-3, ids, labels)
+    assert abs(float(loss) - base_loss) / base_loss < 0.02
+
+
+def test_hybrid_bf16_rejects_auto_axes_on_cpu():
+    cfg, model, state0, ids, labels = _setup()
+    mesh = hybrid_mesh(jax.devices("cpu"), pp=2, mp=2)
+    with pytest.raises(NotImplementedError):
+        build_hybrid_train_step(cfg, None, mesh,
+                                compute_dtype=jnp.bfloat16,
+                                cpu_bf16="fp32-wire")
+
+
+def test_hybrid_sep4_composition():
+    """sep=4 composed with pp=2 on the flagship (8 kv heads so the
+    Ulysses alltoall splits 4 ways) — closes VERDICT r3 weak#6 (sep
+    degree >2 never composed with the flagship)."""
+    cfg = LlamaConfig.debug(vocab=128, hidden=32, layers=2, heads=8,
+                            kv_heads=8, inter=64, max_pos=64)
+    model = LlamaForCausalLM(cfg)
+    state0 = {k: v.copy() for k, v in model.functional_state().items()}
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    base_loss, base_params = _baseline(model, state0, ids, labels)
+    mesh = hybrid_mesh(jax.devices("cpu"), pp=2, sep=4)
+    loss, params = _hybrid(cfg, model, state0, ids, labels, mesh,
+                           num_microbatches=2)
     np.testing.assert_allclose(loss, base_loss, rtol=1e-4)
     _assert_state_close(params, base_params)

@@ -226,10 +226,22 @@ def _grad_check(entry, name, inputs, kwargs, gname, out_index=None):
     op = all_ops()[name]
     rng = np.random.RandomState(0)
 
+    # the FD loop evaluates the kernel 2x per element: jit it ONCE so
+    # repeated evals hit a compiled executable (interpret-mode Pallas
+    # kernels re-trace per eager call — seconds each, minutes per case)
+    @jax.jit
+    def _run_compiled(jin):
+        out = op.fn(**jin, **kwargs)
+        o = out[out_index or 0] if isinstance(out, (tuple, list)) else out
+        return o.astype(jnp.float64) if jnp.issubdtype(
+            o.dtype, jnp.floating) else o
+
     def run_raw(np_inputs):
         jin = {k: (jnp.asarray(v) if not isinstance(v, list)
                    else [jnp.asarray(e) for e in v])
                for k, v in np_inputs.items()}
+        if op.cacheable:
+            return np.asarray(_run_compiled(jin), dtype=np.float64)
         out = op.fn(**jin, **kwargs)
         o = out[out_index or 0] if isinstance(out, (tuple, list)) else out
         return np.asarray(o, dtype=np.float64)

@@ -132,3 +132,87 @@ def test_schedule_tables_valid_various_sizes():
                             ("VPP", 2)]:
                 s = build_schedule(name, p, m, v)
                 assert s.ticks > 0
+
+
+# --------------------------------------------------------------------------
+# cost model (round-4: per-tick cost x table simulation)
+# --------------------------------------------------------------------------
+
+def test_cost_model_matches_analytic_bubbles():
+    """With uniform per-op times, the modelled bubble fraction of
+    FThenB/1F1B must equal the analytic (p-1)/(m+p-1)."""
+    from paddle_tpu.parallel.schedules import build_schedule, simulate_cost
+
+    for p, m in [(4, 8), (4, 16), (8, 8)]:
+        analytic = (p - 1) / (m + p - 1)
+        for name in ("FThenB", "1F1B"):
+            c = simulate_cost(build_schedule(name, p=p, m=m),
+                              t_f=1.0, t_b=2.0)
+            assert abs(c.bubble_frac - analytic) < 1e-9, (name, p, m)
+
+
+def test_cost_model_ranking():
+    """ZBH1 < VPP < 1F1B/FThenB on makespan at zero p2p cost — the
+    zero-bubble and interleaving claims, reproduced by simulation on
+    >=3 configs (VERDICT r3 next#10)."""
+    from paddle_tpu.parallel.schedules import rank_schedules
+
+    for p, m in [(4, 8), (4, 16), (8, 8)]:
+        ranked = rank_schedules(p, m, t_f=1.0, t_b=2.0)
+        names = [c.name for c in ranked]
+        assert names[0] == "ZBH1", (p, m, names)
+        assert names[1] == "VPP", (p, m, names)
+        spans = {c.name: c.makespan for c in ranked}
+        assert spans["ZBH1"] < spans["VPP"] < spans["1F1B"] + 1e-9
+
+
+def test_cost_model_p2p_penalises_vpp():
+    """VPP does v x the p2p hops; with expensive links its modelled
+    advantage over FThenB must shrink or invert."""
+    from paddle_tpu.parallel.schedules import rank_schedules
+
+    free = {c.name: c.makespan for c in rank_schedules(4, 8, t_f=1.0,
+                                                       t_b=2.0)}
+    slow = {c.name: c.makespan for c in rank_schedules(4, 8, t_f=1.0,
+                                                       t_b=2.0,
+                                                       t_p2p=0.5)}
+    gain_free = free["FThenB"] - free["VPP"]
+    gain_slow = slow["FThenB"] - slow["VPP"]
+    assert gain_slow < gain_free
+
+
+def test_cost_model_zbh1_uneven_xw_split():
+    """ZBH1's win persists when dw != dx (the real-model case the X/W
+    split exists for)."""
+    from paddle_tpu.parallel.schedules import rank_schedules
+
+    ranked = rank_schedules(4, 8, t_f=1.0, t_b=2.2, t_w=0.9)
+    assert ranked[0].name == "ZBH1"
+
+
+def test_auto_tuner_schedule_dimension():
+    """The tuner's schedule dimension prunes by modelled makespan: the
+    surviving schedules are exactly those within the cost-model slack of
+    the modelled best for (pp, m)."""
+    from paddle_tpu.distributed.auto_tuner import AutoTuner
+    from paddle_tpu.parallel.schedules import rank_schedules
+
+    t = AutoTuner({"num_devices": 8, "global_batch_size": 16,
+                   "num_layers": 8, "pipeline_schedule": "auto",
+                   "pp_degree": [2], "mp_degree": [1],
+                   "sharding_degree": [1], "dp_degree": [4],
+                   "micro_batch_size": [1], "use_recompute": [False],
+                   "task_limit": 10_000})
+    seen = set()
+    while True:
+        cfg = t.search_once()
+        if cfg is None:
+            break
+        seen.add(cfg["pipeline_schedule"])
+        t.add_cfg(cfg, metric=1.0)
+    # pp=2, m = 16 / (mbs 1 * dp 4) = 4
+    ranked = rank_schedules(2, 4, t_f=1.0)
+    best = ranked[0].makespan
+    want = {c.name for c in ranked if c.makespan <= best * 1.05}
+    assert seen == want, (seen, want)
+    assert "ZBH1" in seen and "FThenB" not in seen and "1F1B" not in seen

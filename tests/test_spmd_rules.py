@@ -235,3 +235,159 @@ def test_flatten_sharded_range_consistent():
     assert _norm(ins[0]) == ()
     got = _run("flatten", [_arr(4, 4, 8)], ins, start_axis=0, stop_axis=1)
     assert got == _norm(outs[0])
+
+
+# --------------------------------------------------------------------------
+# round-4 rules (VERDICT r3 next#6): scatter/gather-nd, where, cumsum,
+# topk/argmax, tile/expand/stack, pad/roll/flip, attention-score family
+# --------------------------------------------------------------------------
+
+def _iarr(*shape, high=4):
+    rng = np.random.RandomState(1)
+    return jnp.asarray(rng.randint(0, high, shape).astype(np.int32))
+
+
+def test_scatter_axis_replicated():
+    x, idx, upd = _arr(8, 6), _iarr(4, high=8), _arr(4, 6)
+    _check("scatter", [x, idx, upd], [P("x", "y"), P(), P()],
+           rule_kwargs={"axis": 0, "ndim": 2})
+
+
+def test_put_along_axis():
+    x = _arr(8, 6)
+    idx = _iarr(8, 6, high=6)
+    val = _arr(8, 6)
+    _check("put_along_axis", [x, idx, val], [P("x", "y"), P(), P()],
+           rule_kwargs={"axis": 1, "ndim": 2}, op_kwargs={"axis": 1})
+
+
+def test_gather_nd():
+    x = _arr(6, 8)
+    idx = _iarr(4, 1, high=6)
+    _check("gather_nd", [x, idx], [P("x", None), P()],
+           rule_kwargs={"index_ndim": 2})
+
+
+def test_where_follows_sharded_operand():
+    c = jnp.asarray(np.random.RandomState(0).rand(8, 4) > 0.5)
+    x, y = _arr(8, 4), _arr(8, 4)
+    _check("where", [c, x, y], [P(), P("x", None), P()])
+
+
+def test_cumsum_axis_replicated():
+    x = _arr(8, 6)
+    _check("cumsum", [x], [P("x", "y")], rule_kwargs={"axis": 1,
+                                                      "ndim": 2},
+           op_kwargs={"axis": 1})
+
+
+def test_cumprod_axis_replicated():
+    x = _arr(8, 6)
+    _check("cumprod", [x], [P("x", "y")], rule_kwargs={"axis": 0,
+                                                       "ndim": 2},
+           op_kwargs={"dim": 0})
+
+
+def test_topk_axis_replicated():
+    x = _arr(8, 16)
+    _check("topk", [x], [P("x", "y")], rule_kwargs={"axis": 1, "ndim": 2},
+           op_kwargs={"k": 3, "axis": 1}, out_index=0)
+
+
+def test_argmax_drops_axis():
+    x = _arr(8, 16)
+    _check("argmax", [x], [P("x", "y")],
+           rule_kwargs={"axis": 1, "ndim": 2}, op_kwargs={"axis": 1})
+
+
+def test_tile_replicates_repeated_dim():
+    x = _arr(8, 6)
+    _check("tile", [x], [P("x", "y")],
+           rule_kwargs={"repeat_times": (1, 3), "ndim": 2},
+           op_kwargs={"repeat_times": (1, 3)})
+
+
+def test_expand_broadcast_dim_replicated():
+    x = _arr(8, 1)
+    _check("expand", [x], [P("x", None)],
+           rule_kwargs={"shape": (8, 6), "in_shape": (8, 1)},
+           op_kwargs={"shape": (8, 6)})
+
+
+def test_stack_inserts_replicated_axis():
+    a, b = _arr(8, 6), _arr(8, 6)
+    mesh = _mesh()
+    ins, outs, _ = SR.infer_forward("stack", P("x", None), P("x", None),
+                                    axis=0, ndim=2)
+    placed = [jax.device_put(v, NamedSharding(mesh, s))
+              for v, s in zip([a, b], ins)]
+    out = jax.jit(lambda u, v: get_op("stack").fn([u, v], axis=0))(*placed)
+    assert _norm(out.sharding.spec) == _norm(outs[0])
+
+
+def test_pad_replicates_padded_dims():
+    x = _arr(8, 6)
+    _check("pad", [x], [P("x", "y")],
+           rule_kwargs={"paddings": (0, 0, 1, 1), "ndim": 2},
+           op_kwargs={"pad": (0, 0, 1, 1)})
+
+
+def test_roll_flip_replicate_moved_axis():
+    x = _arr(8, 6)
+    _check("roll", [x], [P("x", "y")],
+           rule_kwargs={"axis": 0, "ndim": 2},
+           op_kwargs={"shifts": 2, "axis": 0})
+    _check("flip", [x], [P("x", "y")],
+           rule_kwargs={"axis": 1, "ndim": 2}, op_kwargs={"axis": 1})
+
+
+def test_take_along_axis_rule():
+    x = _arr(8, 6)
+    idx = _iarr(8, 6, high=6)
+    _check("take_along_axis", [x, idx], [P("x", None), P()],
+           rule_kwargs={"axis": 1, "ndim": 2}, op_kwargs={"axis": 1})
+
+
+def test_one_hot_appends_replicated_class_dim():
+    x = _iarr(8, high=5)
+    _check("one_hot", [x], [P("x")], rule_kwargs={"num_classes": 5},
+           op_kwargs={"num_classes": 5})
+
+
+def test_logsumexp_reduces():
+    x = _arr(8, 6)
+    _check("logsumexp", [x], [P("x", "y")],
+           rule_kwargs={"axis": 1, "ndim": 2}, op_kwargs={"axis": 1})
+
+
+def test_attention_family_batch_head_shards():
+    q = _arr(4, 8, 4, 8)
+    for name, kwargs in [("scaled_dot_product_attention", {}),
+                         ("memory_efficient_attention", {"chunk": 4})]:
+        _check(name, [q, q, q],
+               [P("x", None, "y", None), P(), P()], op_kwargs=kwargs)
+
+
+def test_flashmask_attention_rule_diverges_from_gspmd():
+    """A documented DIVERGENCE: GSPMD cannot propagate shardings through
+    pallas_call (it replicates the output), while the curated rule
+    correctly says batch/head axes shard — exactly the case where the
+    rule is load-bearing (shard_op/to_static consult it; GSPMD alone
+    would silently replicate the flash compute)."""
+    q = _arr(1, 16, 2, 8)
+    idx = jnp.asarray(np.full((1, 1, 16, 1), 16, np.int32))
+    mesh = _mesh()
+    ins, outs, _ = SR.infer_forward("flashmask_attention",
+                                    P(None, None, "y", None), P(), P())
+    assert _norm(outs[0]) == (None, None, "y")   # rule: heads shard
+    placed = [jax.device_put(v, NamedSharding(mesh, s))
+              for v, s in zip([q, q, q], ins[:3])]
+    fn = get_op("flashmask_attention").fn
+    out = jax.jit(lambda a, b, c: fn(a, b, c, idx, causal=True))(*placed)
+    # GSPMD's unconstrained choice: full replication (the divergence)
+    assert _norm(out.sharding.spec) == ()
+
+
+def test_rule_count_target():
+    """Round-4 target: the curated library covers ~60 rules."""
+    assert len(SR._RULES) >= 60, len(SR._RULES)
