@@ -58,10 +58,17 @@ def _accel_devices(device_type: str):
         try:
             idx = {int(i) for i in str(sel).split(",") if i.strip() != ""}
         except ValueError:
-            return devs
+            raise ValueError(
+                f"FLAGS_selected_gpus={sel!r} is not a comma-separated "
+                "index list") from None
         picked = [d for i, d in enumerate(devs) if i in idx]
-        if picked:
-            return picked
+        if not picked and devs:
+            # silently widening to ALL devices would defeat the
+            # restriction the operator asked for — fail loudly instead
+            raise ValueError(
+                f"FLAGS_selected_gpus={sel!r} selects none of the "
+                f"{len(devs)} visible {device_type} devices")
+        return picked or devs
     return devs
 
 

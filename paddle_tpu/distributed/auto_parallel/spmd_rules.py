@@ -360,6 +360,17 @@ def _gather_rule(x: P, index: P, axis: int = 0,
     return (in_x, index), (out,), {}
 
 
+def _replicate_axis(x: P, axis, ndim=None) -> P:
+    """x's spec padded to ndim with ``axis`` forced replicated — the
+    shared shape of the scatter/scan/sort/arg rules (an op that needs
+    the whole axis on one shard)."""
+    xa = _axes(x)
+    nd = ndim if ndim is not None else len(xa)
+    xa = xa + (None,) * (nd - len(xa))
+    ax = axis % max(nd, 1)
+    return P(*(None if i == ax else a for i, a in enumerate(xa)))
+
+
 @register_spmd_rule("scatter")
 @register_spmd_rule("put_along_axis")
 def _scatter_rule(x: P, index: P = None, updates: P = None, axis: int = 0,
@@ -367,11 +378,7 @@ def _scatter_rule(x: P, index: P = None, updates: P = None, axis: int = 0,
     """Scatter writes along ``axis``: that dim must be replicated on every
     operand (arbitrary destinations), other dims follow x (reference
     scatter.cc / put_along_axis semantics)."""
-    xa = _axes(x)
-    nd = ndim if ndim is not None else len(xa)
-    xa = xa + (None,) * (nd - len(xa))
-    ax = axis % max(nd, 1)
-    out = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    out = _replicate_axis(x, axis, ndim)
     # index is a (possibly lower-rank) id tensor — replicated; updates
     # share the destination placement (their scatter dim is already None)
     return (out, P(), out), (out,), {}
@@ -414,11 +421,7 @@ def _where_rule(cond: P, x: P = None, y: P = None, **kw):
 def _cumsum_rule(x: P, axis: int = 0, ndim: Optional[int] = None, **kw):
     """Scan axis replicated (a sharded scan needs a carry exchange);
     other dims pass through — reference cumsum spmd rule."""
-    xa = _axes(x)
-    nd = ndim if ndim is not None else len(xa)
-    xa = xa + (None,) * (nd - len(xa))
-    ax = axis % max(nd, 1)
-    out = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    out = _replicate_axis(x, axis, ndim)
     return (out,), (out,), {}
 
 
@@ -427,11 +430,7 @@ def _topk_rule(x: P, k: int = 1, axis: int = -1,
                ndim: Optional[int] = None, **kw):
     """topk axis replicated (global order needs the whole axis); values
     and indices share the spec."""
-    xa = _axes(x)
-    nd = ndim if ndim is not None else len(xa)
-    xa = xa + (None,) * (nd - len(xa))
-    ax = axis % max(nd, 1)
-    out = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    out = _replicate_axis(x, axis, ndim)
     return (out,), (out, out), {}
 
 
@@ -443,9 +442,8 @@ def _arg_reduce_rule(x: P, axis: int = 0, keepdim: bool = False,
     output drops (or keeps) that dim."""
     xa = _axes(x)
     nd = ndim if ndim is not None else len(xa)
-    xa = xa + (None,) * (nd - len(xa))
     ax = axis % max(nd, 1)
-    in_x = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    in_x = _replicate_axis(x, axis, ndim)
     if keepdim:
         out = in_x
     else:
@@ -537,11 +535,7 @@ def _take_along_axis_rule(x: P, index: P = None, axis: int = 0,
                           ndim: Optional[int] = None, **kw):
     """Gather along ``axis``: that dim replicated on both operands, out
     follows index's other dims / x's placement."""
-    xa = _axes(x)
-    nd = ndim if ndim is not None else len(xa)
-    xa = xa + (None,) * (nd - len(xa))
-    ax = axis % max(nd, 1)
-    spec = P(*(None if i == ax else a for i, a in enumerate(xa)))
+    spec = _replicate_axis(x, axis, ndim)
     return (spec, spec), (spec,), {}
 
 
@@ -569,8 +563,9 @@ def _attention_rule(q: P, k: P = None, v: P = None, *rest, **kw):
     attention family (reference fused attention spmd rules)."""
     qa = _axes(q) + (None,) * (4 - len(_axes(q)))
     spec = P(qa[0], None, qa[2], None)
-    n_in = 3 + len(rest)
-    return (spec,) * n_in, (spec,), {}
+    # extra operands (startend_row_indices / attn_bias) have layouts
+    # unrelated to q's — replicate them rather than mis-placing q's spec
+    return (spec, spec, spec) + (P(),) * len(rest), (spec,), {}
 
 
 @register_spmd_rule("flash_attn_unpadded")

@@ -104,7 +104,8 @@ class LlamaAttention(Layer):
         self.v_proj = nn.Linear(h, cfg.num_key_value_heads * d, bias_attr=False)
         self.o_proj = nn.Linear(cfg.num_attention_heads * d, h, bias_attr=False)
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None,
+                startend_row_indices=None):
         cfg = self.cfg
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, cfg.num_attention_heads, cfg.head_dim])
@@ -122,7 +123,24 @@ class LlamaAttention(Layer):
         # SEGMENT ids ([b, s], normalized by LlamaModel): 1/0 for padded
         # batches, arbitrary ids for packed sequences — splash-attention
         # semantics on both backends.
-        if attn_mask is not None:
+        if startend_row_indices is not None:
+            if attn_mask is not None:
+                # composing band masks with segment ids is ambiguous —
+                # encode BOTH constraints into startend_row_indices (a
+                # causal document mask expresses packed segments) and
+                # pass only that; the reference flash API likewise
+                # rejects conflicting mask arguments
+                raise ValueError(
+                    "pass either attention_mask (segment ids) or "
+                    "startend_row_indices (FlashMask bands), not both")
+            # FlashMask band masks (causal document / share-question /
+            # sliding window — python/paddle/nn/functional/
+            # flash_attention.py:1098 semantics) on the flagship path
+            from ..ops.registry import dispatch
+
+            out = dispatch("flashmask_attention", q, k, v,
+                           startend_row_indices, causal=True)
+        elif attn_mask is not None:
             out = flash_attention(q, k, v, causal=True,
                                   q_segment_ids=attn_mask,
                                   kv_segment_ids=attn_mask)
@@ -150,9 +168,11 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None,
+                startend_row_indices=None):
         x = x + self.self_attn(self.input_layernorm(x), cos, sin,
-                               attn_mask=attn_mask)
+                               attn_mask=attn_mask,
+                               startend_row_indices=startend_row_indices)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -179,8 +199,14 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, position_ids=None, attention_mask=None):
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                startend_row_indices=None):
         from ..autograd import is_grad_enabled
+
+        if startend_row_indices is not None and not isinstance(
+                startend_row_indices, Tensor):
+            startend_row_indices = Tensor(
+                jnp.asarray(startend_row_indices, jnp.int32))
 
         s = input_ids.shape[-1]
         x = self.embed_tokens(input_ids)
@@ -230,15 +256,17 @@ class LlamaModel(Layer):
         for layer in self.layers:
             if use_remat:
                 x = _remat_layer_call(layer, x, cos, sin, self.remat_policy,
-                                      attention_mask)
+                                      attention_mask, startend_row_indices)
             else:
-                x = layer(x, cos, sin, attn_mask=attention_mask)
+                x = layer(x, cos, sin, attn_mask=attention_mask,
+                          startend_row_indices=startend_row_indices)
             x = _pin(x)
         return self.norm(x)
 
 
 def _remat_layer_call(layer: "LlamaDecoderLayer", x: Tensor, cos: Tensor,
-                      sin: Tensor, policy=None, attn_mask=None) -> Tensor:
+                      sin: Tensor, policy=None, attn_mask=None,
+                      startend_row_indices=None) -> Tensor:
     """Run one decoder layer under jax.checkpoint: activations inside the
     layer are recomputed in backward (the analog of the reference's
     recompute pass, strategy.recompute / fleet recompute_configs).
@@ -253,17 +281,22 @@ def _remat_layer_call(layer: "LlamaDecoderLayer", x: Tensor, cos: Tensor,
     state = {k: (t._value if isinstance(t, Tensor) else t)
              for k, t in layer.state_dict().items()}
 
-    @functools.partial(jax.checkpoint, policy=policy, static_argnums=(4,))
-    def body(state, xv, cosv, sinv, has_mask, maskv):
+    @functools.partial(jax.checkpoint, policy=policy,
+                       static_argnums=(4, 6))
+    def body(state, xv, cosv, sinv, has_mask, maskv, has_sri, sriv):
         with no_grad():
             out = layer.functional_call(
                 state, Tensor(xv), Tensor(cosv), Tensor(sinv),
-                attn_mask=Tensor(maskv) if has_mask else None)
+                attn_mask=Tensor(maskv) if has_mask else None,
+                startend_row_indices=Tensor(sriv) if has_sri else None)
         return out._value
 
     mv = attn_mask._value if attn_mask is not None else jnp.zeros((), bool)
+    sv = (startend_row_indices._value if startend_row_indices is not None
+          else jnp.zeros((), bool))
     return Tensor(body(state, x._value, cos._value, sin._value,
-                       attn_mask is not None, mv))
+                       attn_mask is not None, mv,
+                       startend_row_indices is not None, sv))
 
 
 class LlamaForCausalLM(Layer):
@@ -276,10 +309,12 @@ class LlamaForCausalLM(Layer):
         else:
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
 
-    def forward(self, input_ids, position_ids=None, attention_mask=None):
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                startend_row_indices=None):
         from ..ops.linalg import matmul
 
-        h = self.model(input_ids, position_ids, attention_mask)
+        h = self.model(input_ids, position_ids, attention_mask,
+                       startend_row_indices=startend_row_indices)
         if self.cfg.tie_word_embeddings:
             # tape-recorded matmul against the embedding Parameter itself so
             # the head contributes gradients to embed_tokens in eager mode

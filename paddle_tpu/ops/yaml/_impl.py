@@ -2230,7 +2230,8 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
     k = x.shape[-1]
     x2 = xf.reshape(-1, k)
     wscale = (jnp.asarray(weight_scale, jnp.float32) / 127.0
-              if weight_scale is not None else jnp.float32(1.0 / 127.0))
+              if weight_scale is not None
+              else jnp.full((weight.shape[-1],), 1.0 / 127.0, jnp.float32))
     col_amax = jnp.abs(x2).max(axis=0)                       # [K]
     outlier = col_amax > threshold                           # [K]
     # fp path: outlier columns only

@@ -314,3 +314,66 @@ class TestDispatchSurface:
             return_softmax=True)
         assert tuple(out.shape) == (12, 2, 8)
         assert sm is None
+
+
+class TestFlagshipIntegration:
+    def test_llama_doc_mask_equals_segment_mask(self):
+        """FlashMask causal document mask on LlamaForCausalLM must equal
+        the segment-id packed path (same math, two mask encodings)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
+                                kv_heads=2, inter=64, max_pos=32)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, 64, (1, 16)).astype(np.int32))
+        seqlens = [7, 9]
+        seg = np.concatenate([np.full(n, i + 1, np.int32)
+                              for i, n in enumerate(seqlens)])
+        # position ids restart per document (packed training layout)
+        pos = np.concatenate([np.arange(n) for n in seqlens]
+                             ).astype(np.int32)[None]
+        sri = causal_document_row_indices(seqlens)
+        out_seg = model(ids, position_ids=paddle.to_tensor(pos),
+                        attention_mask=paddle.to_tensor(seg[None]))
+        out_fm = model(ids, position_ids=paddle.to_tensor(pos),
+                       startend_row_indices=paddle.to_tensor(
+                           np.asarray(sri)))
+        np.testing.assert_allclose(np.asarray(out_fm.numpy()),
+                                   np.asarray(out_seg.numpy()),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_llama_sliding_window_with_remat(self):
+        """Sliding-window FlashMask runs through the remat (recompute)
+        layer path and differs from full causal (window actually cuts
+        context)."""
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       build_train_step)
+
+        cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
+                                kv_heads=2, inter=64, max_pos=32)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(1)
+        ids = paddle.to_tensor(
+            rng.integers(0, 64, (1, 16)).astype(np.int32))
+        sri = sliding_window_row_indices(16, 3, causal=True)
+        sri_b = paddle.to_tensor(np.asarray(sri))
+        out_w = model(ids, startend_row_indices=sri_b)
+        out_full = model(ids)
+        assert np.abs(np.asarray(out_w.numpy())
+                      - np.asarray(out_full.numpy())).max() > 1e-3
+        # remat path parity
+        model.model.remat = True
+        try:
+            with paddle.no_grad():
+                out_remat = model(ids, startend_row_indices=sri_b)
+        finally:
+            model.model.remat = False
+        np.testing.assert_allclose(np.asarray(out_remat.numpy()),
+                                   np.asarray(out_w.numpy()),
+                                   rtol=2e-4, atol=2e-5)

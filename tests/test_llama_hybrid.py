@@ -274,3 +274,22 @@ def test_hybrid_sep4_composition():
                            num_microbatches=2)
     np.testing.assert_allclose(loss, base_loss, rtol=1e-4)
     _assert_state_close(params, base_params)
+
+
+def test_hybrid_vpp_dp_parity():
+    """Interleaved VPP composed with MANUAL dp (same executor dataflow
+    as 1F1B-dp): 4 layers, v=2 chunks per rank, batch split over dp."""
+    cfg = LlamaConfig.debug(vocab=128, hidden=32, layers=4, heads=4,
+                            kv_heads=2, inter=64, max_pos=64)
+    model = LlamaForCausalLM(cfg)
+    state0 = {k: v.copy() for k, v in model.functional_state().items()}
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    base_loss, base_params = _baseline(model, state0, ids, labels)
+    mesh = hybrid_mesh(jax.devices("cpu"), pp=2, dp=2)
+    loss, params = _hybrid(cfg, model, state0, ids, labels, mesh,
+                           num_microbatches=2, schedule="VPP",
+                           virtual_chunks=2)
+    np.testing.assert_allclose(loss, base_loss, rtol=1e-4)
+    _assert_state_close(params, base_params)
