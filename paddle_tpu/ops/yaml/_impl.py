@@ -2466,3 +2466,77 @@ def sparse_attention(q, k, v, offset, columns, key_padding_mask=None,
         qf, kf, vf, offset.astype(jnp.int32), columns.astype(jnp.int32),
         kpm)
     return out.astype(q.dtype), sdd, sm
+
+
+def warprnnt(input, label, input_lengths, label_lengths, blank=0,
+             fastemit_lambda=0.0):
+    """ref: phi warprnnt (ops.yaml:5109) — RNN-Transducer loss
+    (Graves 2012).  input: [B, T, U+1, V] joint-network LOG-SOFTMAX (or
+    logits; normalised here), label [B, U] int, per-sample lengths.
+    Returns (loss [B], grad placeholder) like the reference's
+    (loss, warprnntgrad) pair — the grad intermediate is produced by
+    autodiff here, so a zeros tensor stands in for the second output.
+
+    TPU-native DP: alpha[t, u] computed by a lax.scan over t with an
+    inner scan over u (the within-row recurrence) — static shapes,
+    length masks; differentiable end-to-end (the reference ships a
+    separate warprnnt_grad kernel; XLA derives it from this scan)."""
+    x = jnp.asarray(input, jnp.float32)
+    b, t_max, u1_max, v = x.shape
+    u_max = u1_max - 1
+    logp = jax.nn.log_softmax(x, axis=-1)
+    labels = jnp.asarray(label, jnp.int32)
+    t_len = jnp.asarray(input_lengths, jnp.int32)
+    u_len = jnp.asarray(label_lengths, jnp.int32)
+
+    # per (t, u): log-prob of emitting the NEXT label, and of blank
+    lbl_pad = jnp.concatenate(
+        [labels, jnp.zeros((b, 1), jnp.int32)], axis=1)      # [B, U+1]
+    p_lab = jnp.take_along_axis(
+        logp, lbl_pad[:, None, :, None], axis=-1)[..., 0]    # [B, T, U+1]
+    p_blank = logp[..., blank]                               # [B, T, U+1]
+    if fastemit_lambda:
+        # FastEmit regularisation (arXiv 2010.11148): boost label emission
+        p_lab = p_lab + math.log1p(float(fastemit_lambda))
+    NEG = -1e30
+
+    def step_t(alpha_prev, t):
+        # horizontal move (t-1 -> t at same u): blank at t-1
+        from_blank = alpha_prev + p_blank[:, t - 1, :]       # [B, U+1]
+
+        def step_u(carry, u):
+            # vertical move (u-1 -> u at same t): label at (t, u-1)
+            diag = jnp.where(
+                u > 0,
+                carry + p_lab[:, t, jnp.maximum(u - 1, 0)],
+                jnp.full((b,), NEG))
+            horiz = from_blank[:, u]
+            val = jnp.logaddexp(jnp.where(u > 0, diag, NEG), horiz)
+            # t=0 row: only vertical moves from alpha[0,0]=0
+            return val, val
+
+        _, cols = jax.lax.scan(step_u, jnp.full((b,), NEG),
+                               jnp.arange(u1_max))
+        alpha_t = cols.T                                     # [B, U+1]
+        return alpha_t, alpha_t
+
+    # t = 0 row: alpha[0, u] = sum of label emissions along u
+    def init_u(carry, u):
+        val = jnp.where(u == 0, jnp.zeros((b,)),
+                        carry + p_lab[:, 0, jnp.maximum(u - 1, 0)])
+        return val, val
+
+    _, cols0 = jax.lax.scan(init_u, jnp.zeros((b,)), jnp.arange(u1_max))
+    alpha0 = cols0.T
+
+    if t_max > 1:
+        _, alphas = jax.lax.scan(step_t, alpha0, jnp.arange(1, t_max))
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+    else:
+        alphas = alpha0[None]                                # [T, B, U+1]
+    # final: alpha[T_b - 1, U_b] + blank(T_b - 1, U_b)
+    bidx = jnp.arange(b)
+    a_fin = alphas[t_len - 1, bidx, u_len]                   # [B]
+    blank_fin = p_blank[bidx, t_len - 1, u_len]
+    loss = -(a_fin + blank_fin)
+    return loss, jnp.zeros_like(x)

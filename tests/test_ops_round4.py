@@ -6,6 +6,8 @@ matrix_rank_atol_rtol."""
 import numpy as np
 import pytest
 
+import jax
+
 import jax.numpy as jnp
 
 from paddle_tpu.ops.yaml import _impl
@@ -184,3 +186,65 @@ class TestUnpoolRank:
                                          jnp.asarray(0.01, jnp.float32),
                                          None, hermitian=True)
         assert int(r2) == 3
+
+
+class TestWarpRNNT:
+    @staticmethod
+    def _brute_force(logp, labels, T, U, blank):
+        """Enumerate every monotone (right/down) lattice path from (0,0)
+        to (T-1, U) ending in blank; logp [T, U+1, V]."""
+        import itertools
+
+        paths = []
+
+        def walk(t, u, acc):
+            if t == T - 1 and u == U:
+                paths.append(acc + logp[t, u, blank])
+                return
+            if t + 1 < T:                       # blank: consume a frame
+                walk(t + 1, u, acc + logp[t, u, blank])
+            if u < U:                           # emit the next label
+                walk(t, u + 1, acc + logp[t, u, labels[u]])
+
+        walk(0, 0, 0.0)
+        m = max(paths)
+        return -(m + np.log(np.sum(np.exp(np.asarray(paths) - m))))
+
+    def test_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        B, T, U, V = 2, 4, 2, 5
+        x = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+        labels = np.array([[1, 2], [3, 4]], np.int32)
+        t_len = np.array([4, 3], np.int32)
+        u_len = np.array([2, 1], np.int32)
+        loss, _ = _impl.warprnnt(jnp.asarray(x), jnp.asarray(labels),
+                                 jnp.asarray(t_len), jnp.asarray(u_len))
+        logp = np.asarray(jax.nn.log_softmax(jnp.asarray(x), axis=-1))
+        for bi in range(B):
+            want = self._brute_force(logp[bi], labels[bi],
+                                     int(t_len[bi]), int(u_len[bi]), 0)
+            np.testing.assert_allclose(float(np.asarray(loss)[bi]), want,
+                                       rtol=1e-5, err_msg=f"sample {bi}")
+
+    def test_grad_flows(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((1, 3, 2, 4)), jnp.float32)
+
+        def loss_fn(x):
+            loss, _ = _impl.warprnnt(
+                x, jnp.asarray([[2]], jnp.int32),
+                jnp.asarray([3], jnp.int32), jnp.asarray([1], jnp.int32))
+            return loss.sum()
+
+        g = jax.grad(loss_fn)(x)
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_fastemit_changes_loss(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((1, 3, 2, 4)), jnp.float32)
+        args = (jnp.asarray([[2]], jnp.int32), jnp.asarray([3], jnp.int32),
+                jnp.asarray([1], jnp.int32))
+        l0, _ = _impl.warprnnt(x, *args)
+        l1, _ = _impl.warprnnt(x, *args, fastemit_lambda=0.1)
+        assert abs(float(l0[0]) - float(l1[0])) > 1e-6
