@@ -28,7 +28,7 @@ from .loss import (
     NLLLoss, SmoothL1Loss,
     MarginRankingLoss, SoftMarginLoss, HingeEmbeddingLoss,
     CosineEmbeddingLoss, TripletMarginLoss, MultiLabelSoftMarginLoss,
-    GaussianNLLLoss, PoissonNLLLoss, CTCLoss,
+    GaussianNLLLoss, PoissonNLLLoss, CTCLoss, RNNTLoss,
 )
 from .transformer import (
     MultiHeadAttention, Transformer, TransformerDecoder,

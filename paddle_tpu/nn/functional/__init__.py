@@ -331,3 +331,20 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return (loss / Tensor(jnp.maximum(ll, 1).astype(jnp.float32))).mean()
 
 
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean"):
+    """RNN-Transducer loss over the warprnnt op (reference
+    functional/loss.py:2070 rnnt_loss; lattice DP in ops/yaml/_impl.py
+    warprnnt)."""
+    loss = dispatch("warprnnt", input, label, input_lengths,
+                    label_lengths, blank=blank,
+                    fastemit_lambda=fastemit_lambda)
+    if isinstance(loss, (tuple, list)):
+        loss = loss[0]
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return loss.sum()
+    return loss.mean()
+
+

@@ -207,3 +207,19 @@ class CTCLoss(Layer):
     def forward(self, logits, labels, input_lengths, label_lengths):
         return F.ctc_loss(logits, labels, input_lengths, label_lengths,
                           self.blank, self.reduction)
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss layer (reference nn/layer/loss.py:1365)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)

@@ -2471,19 +2471,23 @@ def sparse_attention(q, k, v, offset, columns, key_padding_mask=None,
 def warprnnt(input, label, input_lengths, label_lengths, blank=0,
              fastemit_lambda=0.0):
     """ref: phi warprnnt (ops.yaml:5109) — RNN-Transducer loss
-    (Graves 2012).  input: [B, T, U+1, V] joint-network LOG-SOFTMAX (or
-    logits; normalised here), label [B, U] int, per-sample lengths.
+    (Graves 2012).  input: [B, T, U+1, V] joint-network logits
+    (log-softmax applied here), label [B, U] int, per-sample lengths.
     Returns (loss [B], grad placeholder) like the reference's
-    (loss, warprnntgrad) pair — the grad intermediate is produced by
+    (loss, warprnntgrad) pair — the grad intermediate comes from
     autodiff here, so a zeros tensor stands in for the second output.
 
-    TPU-native DP: alpha[t, u] computed by a lax.scan over t with an
-    inner scan over u (the within-row recurrence) — static shapes,
-    length masks; differentiable end-to-end (the reference ships a
-    separate warprnnt_grad kernel; XLA derives it from this scan)."""
+    TPU-native DP: scan over time with the within-row label recurrence
+    alpha[t,u] = logaddexp(alpha[t-1,u]+blank, alpha[t,u-1]+label) done
+    as a jax.lax.associative_scan over affine maps in the (logaddexp, +)
+    semiring — O(T) sequential steps with O(log U) depth each, instead
+    of T*U sequential iterations.  FastEmit (arXiv 2010.11148) is the
+    gradient-scaling form: label-emission log-probs enter as
+    (1+lambda)*p - lambda*stop_gradient(p), leaving the loss VALUE
+    unchanged while scaling emission gradients by (1+lambda) — the
+    paper's semantics, not a constant shift."""
     x = jnp.asarray(input, jnp.float32)
     b, t_max, u1_max, v = x.shape
-    u_max = u1_max - 1
     logp = jax.nn.log_softmax(x, axis=-1)
     labels = jnp.asarray(label, jnp.int32)
     t_len = jnp.asarray(input_lengths, jnp.int32)
@@ -2496,41 +2500,38 @@ def warprnnt(input, label, input_lengths, label_lengths, blank=0,
         logp, lbl_pad[:, None, :, None], axis=-1)[..., 0]    # [B, T, U+1]
     p_blank = logp[..., blank]                               # [B, T, U+1]
     if fastemit_lambda:
-        # FastEmit regularisation (arXiv 2010.11148): boost label emission
-        p_lab = p_lab + math.log1p(float(fastemit_lambda))
+        lam = float(fastemit_lambda)
+        p_lab = (1.0 + lam) * p_lab - lam * jax.lax.stop_gradient(p_lab)
     NEG = -1e30
 
-    def step_t(alpha_prev, t):
-        # horizontal move (t-1 -> t at same u): blank at t-1
-        from_blank = alpha_prev + p_blank[:, t - 1, :]       # [B, U+1]
+    def combine(f1, f2):
+        # compose affine maps f(x) = logaddexp(b, x + a) in application
+        # order f2 o f1: (a, b) -> (a1+a2, logaddexp(b2, b1+a2))
+        a1, b1 = f1
+        a2, b2 = f2
+        return a1 + a2, jnp.logaddexp(b2, b1 + a2)
 
-        def step_u(carry, u):
-            # vertical move (u-1 -> u at same t): label at (t, u-1)
-            diag = jnp.where(
-                u > 0,
-                carry + p_lab[:, t, jnp.maximum(u - 1, 0)],
-                jnp.full((b,), NEG))
-            horiz = from_blank[:, u]
-            val = jnp.logaddexp(jnp.where(u > 0, diag, NEG), horiz)
-            # t=0 row: only vertical moves from alpha[0,0]=0
-            return val, val
+    def row_solve(h, c):
+        """Solve x[u] = logaddexp(h[u], x[u-1] + c[u-1]) with x[-1]
+        treated as -inf: per-u affine maps scanned associatively."""
+        a = jnp.concatenate([jnp.full((b, 1), NEG), c[:, :-1]], axis=1)
+        _, xs = jax.lax.associative_scan(combine, (a.T, h.T), axis=0)
+        return xs.T                                          # [B, U+1]
 
-        _, cols = jax.lax.scan(step_u, jnp.full((b,), NEG),
-                               jnp.arange(u1_max))
-        alpha_t = cols.T                                     # [B, U+1]
+    # t = 0 row: alpha[0, u] = cumsum of label emissions along u
+    alpha0 = jnp.concatenate(
+        [jnp.zeros((b, 1)),
+         jnp.cumsum(p_lab[:, 0, :-1], axis=1)], axis=1)      # [B, U+1]
+
+    def step_t(alpha_prev, rows):
+        blank_row, lab_row = rows                            # [B, U+1]
+        alpha_t = row_solve(alpha_prev + blank_row, lab_row)
         return alpha_t, alpha_t
 
-    # t = 0 row: alpha[0, u] = sum of label emissions along u
-    def init_u(carry, u):
-        val = jnp.where(u == 0, jnp.zeros((b,)),
-                        carry + p_lab[:, 0, jnp.maximum(u - 1, 0)])
-        return val, val
-
-    _, cols0 = jax.lax.scan(init_u, jnp.zeros((b,)), jnp.arange(u1_max))
-    alpha0 = cols0.T
-
     if t_max > 1:
-        _, alphas = jax.lax.scan(step_t, alpha0, jnp.arange(1, t_max))
+        xs = (jnp.moveaxis(p_blank[:, :-1], 1, 0),           # blank at t-1
+              jnp.moveaxis(p_lab[:, 1:], 1, 0))              # label at t
+        _, alphas = jax.lax.scan(step_t, alpha0, xs)
         alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
     else:
         alphas = alpha0[None]                                # [T, B, U+1]

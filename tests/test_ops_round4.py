@@ -240,11 +240,38 @@ class TestWarpRNNT:
         assert np.isfinite(np.asarray(g)).all()
         assert float(jnp.abs(g).sum()) > 0
 
-    def test_fastemit_changes_loss(self):
+    def test_fastemit_scales_gradients_not_loss(self):
+        """FastEmit's gradient-scaling semantics (arXiv 2010.11148):
+        the loss VALUE is unchanged (every path emits exactly U labels,
+        so a value-level bonus would be a per-sample constant) while
+        label-emission gradients scale by (1+lambda)."""
         rng = np.random.default_rng(2)
         x = jnp.asarray(rng.standard_normal((1, 3, 2, 4)), jnp.float32)
         args = (jnp.asarray([[2]], jnp.int32), jnp.asarray([3], jnp.int32),
                 jnp.asarray([1], jnp.int32))
         l0, _ = _impl.warprnnt(x, *args)
         l1, _ = _impl.warprnnt(x, *args, fastemit_lambda=0.1)
-        assert abs(float(l0[0]) - float(l1[0])) > 1e-6
+        np.testing.assert_allclose(float(l0[0]), float(l1[0]), rtol=1e-6)
+
+        def loss_with(lam):
+            return lambda x: _impl.warprnnt(
+                x, *args, fastemit_lambda=lam)[0].sum()
+
+        g0 = np.asarray(jax.grad(loss_with(0.0))(x))
+        g1 = np.asarray(jax.grad(loss_with(0.1))(x))
+        assert np.abs(g1 - g0).max() > 1e-5   # gradients DO change
+
+    def test_nn_surface(self):
+        import paddle_tpu as paddle
+
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 4, 3, 5)).astype(np.float32))
+        lbl = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int32))
+        tl = paddle.to_tensor(np.array([4, 3], np.int32))
+        ul = paddle.to_tensor(np.array([2, 1], np.int32))
+        loss = paddle.nn.functional.rnnt_loss(x, lbl, tl, ul)
+        assert np.isfinite(float(loss.numpy()))
+        layer = paddle.nn.RNNTLoss(reduction="sum")
+        loss2 = layer(x, lbl, tl, ul)
+        assert np.isfinite(float(loss2.numpy()))
