@@ -2541,3 +2541,184 @@ def warprnnt(input, label, input_lengths, label_lengths, blank=0,
     blank_fin = p_blank[bidx, t_len - 1, u_len]
     loss = -(a_fin + blank_fin)
     return loss, jnp.zeros_like(x)
+
+
+def ctc_align(input, input_length=None, blank=0, merge_repeated=True,
+              padding_value=0):
+    """ref: phi ctc_align (ops.yaml:1140) — greedy CTC decode cleanup:
+    merge repeats, drop blanks, left-pack, pad with padding_value.
+    Host-side (data-dependent lengths, like the reference CPU kernel)."""
+    x = np.asarray(input)
+    b, t = x.shape
+    if input_length is not None:
+        lens = np.asarray(input_length).reshape(-1)
+    else:
+        lens = np.full((b,), t)
+    out = np.full((b, t), padding_value, x.dtype)
+    out_len = np.zeros((b, 1), np.int32)
+    for i in range(b):
+        prev = None
+        k = 0
+        for j in range(int(lens[i])):
+            v = int(x[i, j])
+            if merge_repeated and prev is not None and v == prev:
+                prev = v
+                continue
+            prev = v
+            if v != blank:
+                out[i, k] = v
+                k += 1
+        out_len[i, 0] = k
+    return jnp.asarray(out), jnp.asarray(out_len)
+
+
+def crf_decoding(emission, transition, label=None, length=None):
+    """ref: phi crf_decoding (ops.yaml:1094) — Viterbi decode with the
+    linear_chain_crf layout: transition[0] = start scores,
+    transition[1] = stop scores, transition[2:] = pairwise [K, K].
+    emission [B, T, K] (padded batch + length) or [T, K].  Returns the
+    decoded path [B, T] (0 past each length); with ``label`` given,
+    returns 1 where the decode AGREES with label (the reference's
+    correctness-indicator mode).  lax.scan over time, argmax
+    backtrace."""
+    e = jnp.asarray(emission, jnp.float32)
+    squeeze = e.ndim == 2
+    if squeeze:
+        e = e[None]
+    b, t_max, k = e.shape
+    trans = jnp.asarray(transition, jnp.float32)
+    start, stop, pair = trans[0], trans[1], trans[2:]
+    lens = (jnp.asarray(length, jnp.int32).reshape(-1)
+            if length is not None else jnp.full((b,), t_max, jnp.int32))
+
+    def step(alpha, e_t):
+        # scores[b, i, j] = alpha[b, i] + pair[i, j]
+        scores = alpha[:, :, None] + pair[None]
+        best = scores.max(axis=1) + e_t                  # [B, K]
+        back = jnp.argmax(scores, axis=1)                # [B, K]
+        return best, (best, back)
+
+    alpha0 = start[None] + e[:, 0]
+    if t_max > 1:
+        _, (alphas, backs) = jax.lax.scan(
+            step, alpha0, jnp.moveaxis(e[:, 1:], 1, 0))
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,K]
+        backs = jnp.concatenate(
+            [jnp.zeros((1, b, k), backs.dtype), backs], axis=0)
+    else:
+        alphas = alpha0[None]
+        backs = jnp.zeros((1, b, k), jnp.int32)
+    bidx = jnp.arange(b)
+    final = alphas[lens - 1, bidx] + stop[None]          # [B, K]
+    last_tag = jnp.argmax(final, axis=-1)                # [B]
+
+    def walk(carry, t):
+        # iterate t from T-1 down; carry = decoded tag at position t+1
+        # (or a placeholder beyond each sample's length)
+        tag_here = jnp.where(t == lens - 1, last_tag, carry)
+        prev_tag = backs[t, bidx, tag_here]
+        nxt = jnp.where(t <= lens - 1, prev_tag, tag_here)
+        return nxt, tag_here
+
+    _, path_rev = jax.lax.scan(walk, last_tag,
+                               jnp.arange(t_max - 1, -1, -1))
+    path = jnp.flip(jnp.moveaxis(path_rev, 0, 1), axis=1)  # [B, T]
+    path = jnp.where(jnp.arange(t_max)[None, :] < lens[:, None], path, 0)
+    if label is not None:
+        lbl = jnp.asarray(label).reshape(b, -1)
+        agree = (path == lbl).astype(jnp.int64)
+        agree = jnp.where(jnp.arange(t_max)[None, :] < lens[:, None],
+                          agree, 0)
+        return agree[0] if squeeze else agree
+    return (path[0] if squeeze else path).astype(jnp.int64)
+
+
+def bipartite_match(dist_mat, match_type="bipartite",
+                    dist_threshold=0.5):
+    """ref: phi bipartite_match (ops.yaml:563) — greedy global max
+    matching (the reference's BipartiteMatch): repeatedly take the
+    largest remaining entry, match its (row, col), remove both; then
+    optionally ('per_prediction') match leftover cols to their argmax
+    row when dist > threshold.  Host-side like the reference CPU
+    kernel."""
+    d = np.array(np.asarray(dist_mat), np.float32, copy=True)
+    squeeze = d.ndim == 2
+    if squeeze:
+        d = d[None]
+    bsz, n, m = d.shape
+    match_idx = np.full((bsz, m), -1, np.int32)
+    match_dist = np.zeros((bsz, m), np.float32)
+    for bi in range(bsz):
+        w = d[bi].copy()
+        for _ in range(min(n, m)):
+            flat = np.argmax(w)
+            r, c = divmod(int(flat), m)
+            if w[r, c] <= 0:
+                break
+            match_idx[bi, c] = r
+            match_dist[bi, c] = w[r, c]
+            w[r, :] = -1.0
+            w[:, c] = -1.0
+        if match_type == "per_prediction":
+            for c in range(m):
+                if match_idx[bi, c] == -1:
+                    r = int(np.argmax(d[bi][:, c]))
+                    if d[bi][r, c] >= dist_threshold:
+                        match_idx[bi, c] = r
+                        match_dist[bi, c] = d[bi][r, c]
+    if squeeze:
+        match_idx, match_dist = match_idx[0], match_dist[0]
+    return jnp.asarray(match_idx), jnp.asarray(match_dist)
+
+
+def psroi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+               output_channels=1, spatial_scale=1.0):
+    """ref: phi psroi_pool (ops.yaml:3714; cpu/psroi_pool_kernel.cc) —
+    position-sensitive ROI average pooling (R-FCN): input channel
+    c*ph*pw + i*pw + j feeds output channel c at bin (i, j).
+
+    Reference geometry exactly: roi_start = round(coord) * scale,
+    roi_end = (round(coord) + 1) * scale, sizes clamped to >= 0.1;
+    empty bins yield 0.  Traced masked-mean per bin (differentiable wrt
+    x, vmapped over ROIs — the roi_pool pattern; empty ROI sets give a
+    [0, C, ph, pw] result)."""
+    xv = jnp.asarray(x, jnp.float32)
+    n, c_in, H, W = xv.shape
+    ph, pw = pooled_height, pooled_width
+    if c_in != output_channels * ph * pw:
+        raise ValueError(
+            f"psroi_pool: input channels {c_in} != output_channels*"
+            f"pooled_height*pooled_width {output_channels * ph * pw}")
+    img_ids = _roi_image_ids(n, boxes.shape[0], boxes_num)
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+    chan = (jnp.arange(output_channels)[:, None] * ph * pw
+            + jnp.arange(ph * pw)[None, :])        # [C_out, ph*pw]
+
+    def one_roi(box, img_id):
+        x1 = jnp.round(box[0]) * spatial_scale
+        y1 = jnp.round(box[1]) * spatial_scale
+        x2 = (jnp.round(box[2]) + 1.0) * spatial_scale
+        y2 = (jnp.round(box[3]) + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        feat = jnp.take(xv, img_id, axis=0)        # [C_in, H, W]
+        bins = []
+        for i in range(ph):
+            for j in range(pw):
+                hs = jnp.floor(i * bh + y1)
+                he = jnp.ceil((i + 1) * bh + y1)
+                ws = jnp.floor(j * bw + x1)
+                we = jnp.ceil((j + 1) * bw + x1)
+                mask = ((ys[:, None] >= hs) & (ys[:, None] < he)
+                        & (xs[None, :] >= ws) & (xs[None, :] < we))
+                cnt = mask.sum()
+                fb = jnp.take(feat, chan[:, i * pw + j], axis=0)  # [C_out,H,W]
+                tot = jnp.where(mask[None], fb, 0.0).sum((-1, -2))
+                bins.append(jnp.where(cnt > 0, tot / jnp.maximum(cnt, 1),
+                                      0.0))
+        return jnp.stack(bins, -1).reshape(output_channels, ph, pw)
+
+    return jax.vmap(one_roi)(jnp.asarray(boxes, jnp.float32),
+                             img_ids).astype(jnp.asarray(x).dtype)

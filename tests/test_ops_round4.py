@@ -275,3 +275,133 @@ class TestWarpRNNT:
         layer = paddle.nn.RNNTLoss(reduction="sum")
         loss2 = layer(x, lbl, tl, ul)
         assert np.isfinite(float(loss2.numpy()))
+
+
+class TestDetectionSequenceOps:
+    def test_ctc_align(self):
+        out, lens = _impl.ctc_align(
+            jnp.asarray([[1, 1, 0, 2, 2, 0, 3], [0, 0, 5, 5, 5, 0, 0]],
+                        jnp.int32),
+            jnp.asarray([7, 7], jnp.int32), blank=0)
+        np.testing.assert_array_equal(np.asarray(out)[0][:3], [1, 2, 3])
+        assert int(np.asarray(lens)[0, 0]) == 3
+        np.testing.assert_array_equal(np.asarray(out)[1][:1], [5])
+        assert int(np.asarray(lens)[1, 0]) == 1
+        # merge_repeated=False keeps the repeats
+        out2, lens2 = _impl.ctc_align(
+            jnp.asarray([[1, 1, 0, 2]], jnp.int32),
+            jnp.asarray([4], jnp.int32), merge_repeated=False)
+        np.testing.assert_array_equal(np.asarray(out2)[0][:3], [1, 1, 2])
+
+    def test_crf_decoding_matches_bruteforce(self):
+        import itertools
+
+        rng = np.random.default_rng(0)
+        B, T, K = 2, 4, 3
+        e = rng.standard_normal((B, T, K)).astype(np.float32)
+        trans = rng.standard_normal((K + 2, K)).astype(np.float32)
+        lens = np.array([4, 2], np.int32)
+        path = _impl.crf_decoding(jnp.asarray(e), jnp.asarray(trans),
+                                  length=jnp.asarray(lens))
+        start, stop, pair = trans[0], trans[1], trans[2:]
+        for bi in range(B):
+            L = int(lens[bi])
+            best, best_score = None, -np.inf
+            for p in itertools.product(range(K), repeat=L):
+                sc = start[p[0]] + e[bi, 0, p[0]]
+                for t in range(1, L):
+                    sc += pair[p[t - 1], p[t]] + e[bi, t, p[t]]
+                sc += stop[p[-1]]
+                if sc > best_score:
+                    best_score, best = sc, p
+            got = np.asarray(path)[bi][:L]
+            np.testing.assert_array_equal(got, best, err_msg=f"b{bi}")
+            # padding zeros past length
+            assert (np.asarray(path)[bi][L:] == 0).all()
+
+    def test_crf_decoding_label_agreement(self):
+        rng = np.random.default_rng(1)
+        e = rng.standard_normal((1, 3, 3)).astype(np.float32)
+        trans = rng.standard_normal((5, 3)).astype(np.float32)
+        path = _impl.crf_decoding(jnp.asarray(e), jnp.asarray(trans))
+        agree = _impl.crf_decoding(jnp.asarray(e), jnp.asarray(trans),
+                                   label=path)
+        assert (np.asarray(agree) == 1).all()
+
+    def test_bipartite_match_greedy(self):
+        d = np.asarray([[[0.9, 0.1, 0.2],
+                         [0.8, 0.7, 0.3]]], np.float32)  # [1, 2 rows, 3 cols]
+        idx, dist = _impl.bipartite_match(jnp.asarray(d))
+        # global max 0.9 -> (r0, c0); next best among remaining: 0.7 (r1, c1)
+        np.testing.assert_array_equal(np.asarray(idx)[0], [0, 1, -1])
+        np.testing.assert_allclose(np.asarray(dist)[0][:2], [0.9, 0.7])
+        # per_prediction mode fills col 2 from its argmax row if >= thresh
+        idx2, _ = _impl.bipartite_match(jnp.asarray(d),
+                                        match_type="per_prediction",
+                                        dist_threshold=0.25)
+        np.testing.assert_array_equal(np.asarray(idx2)[0], [0, 1, 1])
+
+    def test_psroi_pool_channel_routing(self):
+        # 8 channels = 2 out x 2x2 bins; make each input channel constant
+        x = np.zeros((1, 8, 4, 4), np.float32)
+        for c in range(8):
+            x[0, c] = c + 1
+        boxes = np.asarray([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        out = _impl.psroi_pool(jnp.asarray(x), jnp.asarray(boxes),
+                               pooled_height=2, pooled_width=2,
+                               output_channels=2)
+        # out[n, c, i, j] = const of channel c*4 + i*2 + j
+        want = np.zeros((1, 2, 2, 2), np.float32)
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    want[0, c, i, j] = c * 4 + i * 2 + j + 1
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+    def test_psroi_pool_reference_geometry_and_grads(self):
+        """Bin edges follow the phi kernel exactly (roi_start =
+        round(coord)*scale, roi_end = (round(coord)+1)*scale); grads
+        flow to x; an empty ROI set gives a [0, C, ph, pw] result."""
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((1, 8, 6, 6)).astype(np.float32)
+        boxes = np.asarray([[1.0, 0.0, 3.6, 4.0]], np.float32)
+        out = _impl.psroi_pool(jnp.asarray(x), jnp.asarray(boxes),
+                               pooled_height=2, pooled_width=2,
+                               output_channels=2)
+        # brute-force the phi geometry
+        ph = pw = 2
+        x1 = round(1.0) * 1.0
+        y1 = round(0.0) * 1.0
+        x2 = (round(3.6) + 1.0) * 1.0
+        y2 = (round(4.0) + 1.0) * 1.0
+        rh, rw = max(y2 - y1, 0.1), max(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(2):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = int(np.floor(i * bh + y1))
+                    he = int(np.ceil((i + 1) * bh + y1))
+                    ws = int(np.floor(j * bw + x1))
+                    we = int(np.ceil((j + 1) * bw + x1))
+                    hs, he = max(hs, 0), min(he, 6)
+                    ws, we = max(ws, 0), min(we, 6)
+                    ch = c * 4 + i * 2 + j
+                    want = (x[0, ch, hs:he, ws:we].mean()
+                            if he > hs and we > ws else 0.0)
+                    np.testing.assert_allclose(
+                        float(np.asarray(out)[0, c, i, j]), want,
+                        rtol=1e-5, err_msg=f"c{c} bin({i},{j})")
+
+        def loss(xv):
+            return _impl.psroi_pool(xv, jnp.asarray(boxes),
+                                    pooled_height=2, pooled_width=2,
+                                    output_channels=2).sum()
+
+        g = jax.grad(loss)(jnp.asarray(x))
+        assert float(jnp.abs(g).sum()) > 0
+
+        empty = _impl.psroi_pool(jnp.asarray(x),
+                                 jnp.zeros((0, 4), jnp.float32),
+                                 pooled_height=2, pooled_width=2,
+                                 output_channels=2)
+        assert empty.shape == (0, 2, 2, 2)
