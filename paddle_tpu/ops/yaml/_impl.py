@@ -2653,12 +2653,14 @@ def bipartite_match(dist_mat, match_type="bipartite",
         for _ in range(min(n, m)):
             flat = np.argmax(w)
             r, c = divmod(int(flat), m)
-            if w[r, c] <= 0:
+            if not np.isfinite(w[r, c]):
                 break
+            # reference matches until rows run out (max_dist init -1):
+            # zero-distance pairs DO match
             match_idx[bi, c] = r
             match_dist[bi, c] = w[r, c]
-            w[r, :] = -1.0
-            w[:, c] = -1.0
+            w[r, :] = -np.inf
+            w[:, c] = -np.inf
         if match_type == "per_prediction":
             for c in range(m):
                 if match_idx[bi, c] == -1:
@@ -2722,3 +2724,171 @@ def psroi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
 
     return jax.vmap(one_roi)(jnp.asarray(boxes, jnp.float32),
                              img_ids).astype(jnp.asarray(x).dtype)
+
+
+def conv2d_transpose_bias(x, filter, bias=None, strides=(1, 1),
+                          paddings=(0, 0), output_padding=(),
+                          output_size=(), padding_algorithm="EXPLICIT",
+                          groups=1, dilations=(1, 1), data_format="NCHW"):
+    """ref: phi conv2d_transpose_bias (ops.yaml:1011) — transpose conv
+    + bias in one op (the kernels fuse; XLA fuses the add anyway)."""
+    from ..nn_ops import conv2d_transpose
+
+    if output_size:
+        raise NotImplementedError(
+            "conv2d_transpose_bias: explicit output_size — use "
+            "output_padding")
+    if padding_algorithm not in ("EXPLICIT", ""):
+        raise NotImplementedError(
+            f"conv2d_transpose_bias: padding_algorithm="
+            f"{padding_algorithm!r}; pass explicit paddings")
+    # bias threads into conv2d_transpose, which adds it data_format-aware
+    return conv2d_transpose.raw_fn(
+        x, filter, bias, stride=strides, padding=paddings,
+        output_padding=(tuple(output_padding) if output_padding else 0),
+        groups=groups, dilation=dilations, data_format=data_format)
+
+
+def depthwise_conv2d_transpose(x, filter, strides=(1, 1), paddings=(0, 0),
+                               output_padding=(), output_size=(),
+                               padding_algorithm="EXPLICIT", groups=None,
+                               dilations=(1, 1), data_format="NCHW"):
+    """ref: phi depthwise_conv2d_transpose — grouped (depthwise)
+    transpose conv; groups defaults to the input channel count (the
+    depthwise contract)."""
+    from ..nn_ops import conv2d_transpose
+
+    if output_size:
+        raise NotImplementedError(
+            "depthwise_conv2d_transpose: explicit output_size — use "
+            "output_padding")
+    if padding_algorithm not in ("EXPLICIT", ""):
+        raise NotImplementedError(
+            f"depthwise_conv2d_transpose: padding_algorithm="
+            f"{padding_algorithm!r}; pass explicit paddings")
+    ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    return conv2d_transpose.raw_fn(
+        x, filter, None, stride=strides, padding=paddings,
+        output_padding=(tuple(output_padding) if output_padding else 0),
+        groups=(groups if groups else x.shape[ch_axis]),
+        dilation=dilations, data_format=data_format)
+
+
+def _bn_act_core(x, z, scale, bias, mean, variance, momentum, epsilon,
+                 act_type):
+    """Shared fused BN(+add)+activation training math (NHWC per the
+    reference fused kernels)."""
+    red = tuple(i for i in range(x.ndim) if i != x.ndim - 1)
+    batch_mean = x.mean(axis=red)
+    batch_var = x.var(axis=red)
+    inv = jax.lax.rsqrt(batch_var + epsilon)
+    y = (x - batch_mean) * inv * scale + bias
+    if z is not None:
+        y = y + z
+    act = {"relu": jax.nn.relu, "identity": lambda t: t,
+           "": lambda t: t}.get(act_type)
+    if act is None:
+        raise NotImplementedError(f"bn act_type {act_type!r}")
+    out = act(y)
+    mean_out = mean * momentum + batch_mean * (1 - momentum)
+    var_out = variance * momentum + batch_var * (1 - momentum)
+    reserve = jnp.zeros((0,), x.dtype)
+    return out, mean_out, var_out, batch_mean, batch_var, reserve
+
+
+def fused_batch_norm_act(x, scale, bias, mean, variance, momentum=0.9,
+                         epsilon=1e-5, act_type="relu"):
+    """ref: phi fused_batch_norm_act (ops.yaml:2124) — train-mode BN
+    fused with the activation (XLA fuses the chain on TPU)."""
+    return _bn_act_core(x, None, scale, bias, mean, variance, momentum,
+                        epsilon, act_type)
+
+
+def fused_bn_add_activation(x, z, scale, bias, mean, variance,
+                            momentum=0.9, epsilon=1e-5, act_type="relu"):
+    """ref: phi fused_bn_add_activation (ops.yaml:2137) — BN + residual
+    add + activation."""
+    return _bn_act_core(x, z, scale, bias, mean, variance, momentum,
+                        epsilon, act_type)
+
+
+def sync_batch_norm_(x, mean, variance, scale, bias, is_test=False,
+                     momentum=0.9, epsilon=1e-5, data_format="NCHW",
+                     use_global_stats=False, trainable_statistics=False):
+    """ref: phi sync_batch_norm_ (ops.yaml:4653).  On TPU the SYNC in
+    SyncBatchNorm is free: under jit with a dp-sharded batch, the batch
+    mean/var reductions are global — GSPMD inserts the cross-replica
+    psum the reference implements with NCCL by hand."""
+    ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+
+    def rs(t):
+        return jnp.asarray(t).reshape(shape)
+
+    if is_test or use_global_stats:
+        inv = jax.lax.rsqrt(jnp.asarray(variance) + epsilon)
+        out = (x - rs(mean)) * rs(inv) * rs(scale) + rs(bias)
+        reserve = jnp.zeros((0,), x.dtype)
+        return (out, jnp.asarray(mean), jnp.asarray(variance),
+                jnp.asarray(mean), jnp.asarray(variance), reserve)
+    batch_mean = x.mean(axis=red)
+    batch_var = x.var(axis=red)
+    inv = jax.lax.rsqrt(batch_var + epsilon)
+    out = (x - rs(batch_mean)) * rs(inv) * rs(scale) + rs(bias)
+    mean_out = jnp.asarray(mean) * momentum + batch_mean * (1 - momentum)
+    var_out = jnp.asarray(variance) * momentum + batch_var * (1 - momentum)
+    reserve = jnp.zeros((0,), x.dtype)
+    return out, mean_out, var_out, batch_mean, batch_var, reserve
+
+
+def lookup_table_dequant(w, ids, padding_idx=-1):
+    """ref: phi lookup_table_dequant (ops.yaml:3013; cpu kernel
+    lookup_table_dequant_kernel.cc) — embedding rows stored as
+    [min, max, packed-uint8...] fp32 words; dequant:
+    out = (max - min)/256 * byte + min; padding rows are zeros."""
+    w = jnp.asarray(w, jnp.float32)
+    ids_a = jnp.asarray(ids, jnp.int32)
+    flat = ids_a.reshape(-1)
+    quant_number = w.shape[1]
+    row_width = (quant_number - 2) * 4
+    rows = w[flat]                                   # [N, quant_number]
+    mins = rows[:, 0:1]
+    maxs = rows[:, 1:2]
+    packed = rows[:, 2:]
+    bytes_ = jax.lax.bitcast_convert_type(packed, jnp.uint8
+                                          ).reshape(flat.shape[0],
+                                                    row_width)
+    scale = (maxs - mins) / 256.0
+    out = bytes_.astype(jnp.float32) * scale + mins
+    if padding_idx != -1:
+        out = jnp.where((flat == padding_idx)[:, None], 0.0, out)
+    return out.reshape(ids_a.shape + (row_width,))
+
+
+def index_select_strided(x, index, axis=0):
+    """ref: phi index_select_strided (ops.yaml:2591) — select ONE index
+    along axis (the strided-view variant of index_select; a take on
+    TPU, where strided views are layout assignments XLA owns)."""
+    return jnp.take(jnp.asarray(x), int(index), axis=axis)
+
+
+def set_value_with_tensor(x, values, starts, ends, steps, axes,
+                          decrease_axes=(), none_axes=()):
+    """ref: phi set_value_with_tensor (ops.yaml:4243) — strided slice
+    assignment x[starts:ends:steps on axes] = values."""
+    if none_axes:
+        raise NotImplementedError(
+            "set_value_with_tensor: none_axes (newaxis inserts) — "
+            "reshape values at the call site instead")
+    x = jnp.asarray(x)
+    v = jnp.asarray(values, x.dtype)
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, steps):
+        idx[int(ax)] = slice(int(s), int(e), int(st))
+    for ax in decrease_axes:
+        # values were given without this (size-1) sliced dim
+        v = jnp.expand_dims(v, int(ax))
+    return x.at[tuple(idx)].set(jnp.broadcast_to(
+        v, jax.eval_shape(lambda t: t[tuple(idx)], x).shape))

@@ -341,6 +341,13 @@ class TestDetectionSequenceOps:
                                         dist_threshold=0.25)
         np.testing.assert_array_equal(np.asarray(idx2)[0], [0, 1, 1])
 
+    def test_bipartite_match_zero_distances(self):
+        """Zero-distance pairs still match (phi max_dist init -1)."""
+        d = np.zeros((1, 2, 2), np.float32)
+        idx, dist = _impl.bipartite_match(jnp.asarray(d))
+        assert (np.asarray(idx)[0] >= 0).all()
+        np.testing.assert_allclose(np.asarray(dist)[0], [0.0, 0.0])
+
     def test_psroi_pool_channel_routing(self):
         # 8 channels = 2 out x 2x2 bins; make each input channel constant
         x = np.zeros((1, 8, 4, 4), np.float32)
@@ -405,3 +412,92 @@ class TestDetectionSequenceOps:
                                  pooled_height=2, pooled_width=2,
                                  output_channels=2)
         assert empty.shape == (0, 2, 2, 2)
+
+
+class TestFusedBNAndFriends:
+    def test_fused_batch_norm_act_math(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((2, 4, 3)).astype(np.float32)
+        sc = rng.standard_normal(3).astype(np.float32)
+        b = rng.standard_normal(3).astype(np.float32)
+        rm = np.zeros(3, np.float32)
+        rv = np.ones(3, np.float32)
+        out, m_out, v_out, sm, sv, _ = _impl.fused_batch_norm_act(
+            jnp.asarray(x), jnp.asarray(sc), jnp.asarray(b),
+            jnp.asarray(rm), jnp.asarray(rv), momentum=0.9,
+            epsilon=1e-5, act_type="relu")
+        bm = x.mean((0, 1))
+        bv = x.var((0, 1))
+        want = np.maximum((x - bm) / np.sqrt(bv + 1e-5) * sc + b, 0)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(m_out), 0.1 * bm, rtol=1e-4)
+
+    def test_fused_bn_add_activation(self):
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((2, 4, 3)).astype(np.float32)
+        z = rng.standard_normal((2, 4, 3)).astype(np.float32)
+        one = np.ones(3, np.float32)
+        zero = np.zeros(3, np.float32)
+        out, *_ = _impl.fused_bn_add_activation(
+            jnp.asarray(x), jnp.asarray(z), jnp.asarray(one),
+            jnp.asarray(zero), jnp.asarray(zero), jnp.asarray(one))
+        bm, bv = x.mean((0, 1)), x.var((0, 1))
+        want = np.maximum((x - bm) / np.sqrt(bv + 1e-5) + z, 0)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_sync_batch_norm_modes(self):
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+        m = rng.standard_normal(3).astype(np.float32)
+        v = rng.uniform(0.5, 1.5, 3).astype(np.float32)
+        sc = rng.standard_normal(3).astype(np.float32)
+        b = rng.standard_normal(3).astype(np.float32)
+        out_eval, *_ = _impl.sync_batch_norm_(
+            jnp.asarray(x), jnp.asarray(m), jnp.asarray(v),
+            jnp.asarray(sc), jnp.asarray(b), is_test=True)
+        want = ((x - m.reshape(1, 3, 1, 1))
+                / np.sqrt(v.reshape(1, 3, 1, 1) + 1e-5)
+                * sc.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1))
+        np.testing.assert_allclose(np.asarray(out_eval), want, rtol=1e-4,
+                                   atol=1e-5)
+        out_tr, m_out, v_out, sm, sv, _ = _impl.sync_batch_norm_(
+            jnp.asarray(x), jnp.asarray(m), jnp.asarray(v),
+            jnp.asarray(sc), jnp.asarray(b), is_test=False)
+        np.testing.assert_allclose(np.asarray(sm), x.mean((0, 2, 3)),
+                                   rtol=1e-4)
+
+    def test_lookup_table_dequant(self):
+        # build a row: [min, max, packed bytes 0..7]
+        mins, maxs = -1.0, 3.0
+        by = np.arange(8, dtype=np.uint8)
+        packed = by.view(np.float32)                    # 2 fp32 words
+        row = np.concatenate([[mins, maxs], packed]).astype(np.float32)
+        w = np.stack([row, row * 0 + row])              # 2 identical rows
+        out = _impl.lookup_table_dequant(jnp.asarray(w),
+                                         jnp.asarray([0], jnp.int32))
+        want = (maxs - mins) / 256.0 * by.astype(np.float32) + mins
+        np.testing.assert_allclose(np.asarray(out)[0], want, rtol=1e-6)
+        # padding idx zeros the row
+        out_pad = _impl.lookup_table_dequant(
+            jnp.asarray(w), jnp.asarray([1], jnp.int32), padding_idx=1)
+        assert (np.asarray(out_pad) == 0).all()
+
+    def test_set_value_with_tensor(self):
+        x = np.zeros((4, 5), np.float32)
+        vals = np.ones((2, 5), np.float32) * 7
+        out = _impl.set_value_with_tensor(
+            jnp.asarray(x), jnp.asarray(vals), starts=[0], ends=[4],
+            steps=[2], axes=[0])
+        want = x.copy()
+        want[0::2] = 7
+        np.testing.assert_array_equal(np.asarray(out), want)
+        # decrease_axes: scalar-indexed dim, values given without it
+        out2 = _impl.set_value_with_tensor(
+            jnp.asarray(x), jnp.asarray(np.full((5,), 3.0, np.float32)),
+            starts=[1], ends=[2], steps=[1], axes=[0],
+            decrease_axes=[0])
+        want2 = x.copy()
+        want2[1] = 3
+        np.testing.assert_array_equal(np.asarray(out2), want2)
