@@ -2892,3 +2892,108 @@ def set_value_with_tensor(x, values, starts, ends, steps, axes,
         v = jnp.expand_dims(v, int(ax))
     return x.at[tuple(idx)].set(jnp.broadcast_to(
         v, jax.eval_shape(lambda t: t[tuple(idx)], x).shape))
+
+
+def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8,
+                   equal_nan=False):
+    """ref: phi accuracy_check (ops.yaml:31) — allclose-style comparison
+    used by the auto-parallel/prim accuracy checkers; returns a scalar
+    bool tensor."""
+    # no downcast: float64/complex compare at their native precision
+    # (amp/debugging.check_accuracy widens for the same reason)
+    return jnp.asarray(jnp.allclose(jnp.asarray(x), jnp.asarray(y),
+                                    rtol=rtol, atol=atol,
+                                    equal_nan=equal_nan))
+
+
+def enable_check_model_nan_inf(x, flag=1):
+    """ref: phi enable_check_model_nan_inf — turn the model-level
+    nan/inf checker on from inside a program; wired to
+    FLAGS_check_nan_inf (the same switch the dispatch layer consults)."""
+    from ...common import flags as _flags
+
+    _flags.set_flags({"FLAGS_check_nan_inf": True})
+    return jnp.asarray(x)
+
+
+def disable_check_model_nan_inf(x, flag=0):
+    """ref: phi disable_check_model_nan_inf — counterpart switch-off."""
+    from ...common import flags as _flags
+
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+    return jnp.asarray(x)
+
+
+def collect_fpn_proposals(multi_level_rois, multi_level_scores,
+                          multi_level_rois_num=None, post_nms_top_n=-1):
+    """ref: phi collect_fpn_proposals (ops.yaml:944) — concat per-level
+    ROIs, keep the global top-N by score.  Single-image form
+    (rois_num=[N]); the batched LoD form composes at the caller."""
+    rois = jnp.concatenate([jnp.asarray(r) for r in multi_level_rois],
+                           axis=0)
+    scores = jnp.concatenate(
+        [jnp.asarray(s).reshape(-1) for s in multi_level_scores], axis=0)
+    n = scores.shape[0]
+    k = n if post_nms_top_n is None or post_nms_top_n <= 0 \
+        else min(post_nms_top_n, n)
+    _, order = jax.lax.top_k(scores, k)
+    out = rois[order]
+    return out, jnp.asarray([k], jnp.int32)
+
+
+def coalesce_tensor(input, dtype=None, copy_data=True, set_constant=False,
+                    persist_output=False, constant=0.0, use_align=True,
+                    align_size=-1, size_of_dtype=-1, concated_shapes=(),
+                    concated_ranks=()):
+    """ref: phi coalesce_tensor (ops.yaml:934) — fuse a tensor list into
+    ONE contiguous buffer and hand back per-tensor pieces.  On TPU the
+    fused buffer is what grad-bucketing/NCCL staging wanted; XLA already
+    fuses collectives, so the op's value here is the API: (views, fused)
+    with reference-compatible ordering."""
+    xs = [jnp.asarray(t) for t in input]
+    dt = jnp.dtype(dtype) if dtype is not None else xs[0].dtype
+    flat = [t.astype(dt).reshape(-1) for t in xs]
+    fused = jnp.concatenate(flat, axis=0)
+    if set_constant:
+        fused = jnp.full_like(fused, constant)
+    outs = []
+    ofs = 0
+    for t in xs:
+        n = int(np.prod(t.shape)) if t.shape else 1
+        outs.append(fused[ofs:ofs + n].reshape(t.shape))
+        ofs += n
+    # flat tuple (out_0..out_n-1, fused): the reference's
+    # (Tensor[] output, Tensor fused_output) pair with the list splatted
+    # (framework outputs are flat tensor tuples)
+    return (*outs, fused)
+
+
+def read_file(filename="", dtype="uint8", place=None):
+    """ref: phi read_file (ops.yaml:3829) — raw file bytes as a uint8
+    tensor (host io, like the reference CPU kernel)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return jnp.asarray(np.frombuffer(data, np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", place=None):
+    """ref: phi decode_jpeg (ops.yaml:1246) — decode an encoded JPEG
+    byte tensor to [C, H, W] uint8 (host-side via PIL, the CPU analog
+    of the reference's nvjpeg path)."""
+    import io
+
+    from PIL import Image
+
+    data = bytes(np.asarray(x).astype(np.uint8).tobytes())
+    img = Image.open(io.BytesIO(data))
+    if mode not in ("unchanged", ""):
+        conv = {"gray": "L", "rgb": "RGB"}.get(mode)
+        if conv is None:
+            raise NotImplementedError(f"decode_jpeg mode {mode!r}")
+        img = img.convert(conv)
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                        # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)           # [C, H, W]
+    return jnp.asarray(arr)

@@ -501,3 +501,68 @@ class TestFusedBNAndFriends:
         want2 = x.copy()
         want2[1] = 3
         np.testing.assert_array_equal(np.asarray(out2), want2)
+
+
+class TestIoDebugOps:
+    def test_nan_inf_toggles(self):
+        from paddle_tpu.common import flags as F
+
+        orig = F.get_flag("FLAGS_check_nan_inf")
+        try:
+            _impl.enable_check_model_nan_inf(jnp.zeros(2))
+            assert F.get_flag("FLAGS_check_nan_inf") is True
+            _impl.disable_check_model_nan_inf(jnp.zeros(2))
+            assert F.get_flag("FLAGS_check_nan_inf") is False
+        finally:
+            F.set_flags({"FLAGS_check_nan_inf": orig})
+
+    def test_collect_fpn_proposals(self):
+        rois = [jnp.asarray(np.arange(8, dtype=np.float32).reshape(2, 4)),
+                jnp.asarray(np.arange(8, 16, dtype=np.float32
+                                      ).reshape(2, 4))]
+        scores = [jnp.asarray([0.1, 0.9]), jnp.asarray([0.5, 0.3])]
+        out, num = _impl.collect_fpn_proposals(rois, scores,
+                                               post_nms_top_n=3)
+        assert int(num[0]) == 3
+        # ordered by score: 0.9 (level0 roi1), 0.5 (level1 roi0), 0.3
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   np.arange(4, 8, dtype=np.float32))
+        np.testing.assert_allclose(np.asarray(out)[1],
+                                   np.arange(8, 12, dtype=np.float32))
+
+    def test_coalesce_tensor(self):
+        a = jnp.asarray(np.ones((2, 3), np.float32))
+        b = jnp.asarray(np.full((4,), 2.0, np.float32))
+        *outs, fused = _impl.coalesce_tensor([a, b])
+        assert fused.shape == (10,)
+        np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(a))
+        np.testing.assert_allclose(np.asarray(outs[1]), np.asarray(b))
+        *outs2, fused2 = _impl.coalesce_tensor([a, b], set_constant=True,
+                                               constant=3.0)
+        assert (np.asarray(fused2) == 3.0).all()
+        assert (np.asarray(outs2[0]) == 3.0).all()
+
+    def test_read_file_decode_jpeg_roundtrip(self, tmp_path):
+        from PIL import Image
+
+        # smooth gradient: random noise is pathological for JPEG
+        gy, gx = np.mgrid[0:8, 0:10]
+        img = np.stack([gy * 20, gx * 20, gy * 10 + gx * 10],
+                       -1).astype(np.uint8)
+        p = tmp_path / "t.jpg"
+        Image.fromarray(img).save(p, quality=95)
+        raw = _impl.read_file(str(p))
+        assert raw.dtype == jnp.uint8 and raw.ndim == 1
+        dec = _impl.decode_jpeg(raw)
+        assert dec.shape == (3, 8, 10)
+        # JPEG is lossy: close, not equal
+        err = np.abs(np.asarray(dec).astype(np.int32)
+                     - img.transpose(2, 0, 1).astype(np.int32)).mean()
+        assert err < 12, err
+        gray = _impl.decode_jpeg(raw, mode="gray")
+        assert gray.shape == (1, 8, 10)
+
+    def test_accuracy_check(self):
+        x = jnp.asarray([1.0, 2.0])
+        assert bool(_impl.accuracy_check(x, x + 1e-9))
+        assert not bool(_impl.accuracy_check(x, x + 1.0))
