@@ -2899,11 +2899,12 @@ def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8,
     """ref: phi accuracy_check (ops.yaml:31) — allclose-style comparison
     used by the auto-parallel/prim accuracy checkers; returns a scalar
     bool tensor."""
-    # no downcast: float64/complex compare at their native precision
-    # (amp/debugging.check_accuracy widens for the same reason)
-    return jnp.asarray(jnp.allclose(jnp.asarray(x), jnp.asarray(y),
-                                    rtol=rtol, atol=atol,
-                                    equal_nan=equal_nan))
+    # host-side numpy compare: jnp.asarray would truncate float64 to
+    # float32 under the default x64-off config — exactly the precision
+    # an accuracy checker must keep
+    ok = np.allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol,
+                     equal_nan=equal_nan)
+    return jnp.asarray(bool(ok))
 
 
 def enable_check_model_nan_inf(x, flag=1):
@@ -2997,3 +2998,250 @@ def decode_jpeg(x, mode="unchanged", place=None):
     else:
         arr = arr.transpose(2, 0, 1)           # [C, H, W]
     return jnp.asarray(arr)
+
+
+# --------------------------------------------------------------------------
+# GNN neighbor sampling (reference paddle/phi/kernels/graph_*.cc; data-
+# dependent host-side algorithms like the reference CPU kernels — the
+# sampled subgraph then trains on-device via paddle.geometric)
+# --------------------------------------------------------------------------
+
+def _np_rng():
+    """Host-side numpy Generator seeded from the FRAMEWORK generator, so
+    paddle.seed() reproduces sampled subgraphs like every other random
+    op (module-header contract)."""
+    key = _key()
+    data = np.asarray(jax.random.key_data(key)).reshape(-1)
+    return np.random.default_rng([int(v) & 0x7FFFFFFF for v in data])
+
+
+def _compact_nodes(primary, extra):
+    """Order-preserving compaction: primary nodes first, then unseen
+    extras; returns (index_of dict, out_nodes list)."""
+    seen = {}
+    out_nodes = []
+    for v in list(primary) + list(extra):
+        v = int(v)
+        if v not in seen:
+            seen[v] = len(out_nodes)
+            out_nodes.append(v)
+    return seen, out_nodes
+
+
+def _sample_row_neighbors(row, colptr, nodes, sample_size, rng,
+                          edge_weight=None, eids=None):
+    """Per-node neighbor sampling over CSC (colptr/row) storage; returns
+    (neighbors, counts, eid_list)."""
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        s, e = int(colptr[v]), int(colptr[v + 1])
+        deg = e - s
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(s, e)
+        elif edge_weight is not None:
+            w = np.maximum(np.asarray(edge_weight[s:e], np.float64), 0)
+            nz = np.flatnonzero(w)
+            if w.sum() <= 0:
+                pick = s + rng.choice(deg, size=sample_size,
+                                      replace=False)
+            elif len(nz) >= sample_size:
+                pick = s + rng.choice(deg, size=sample_size,
+                                      replace=False, p=w / w.sum())
+            else:
+                # fewer positive-weight edges than requested: take them
+                # all, fill uniformly from the zero-weight rest
+                zeros = np.setdiff1d(np.arange(deg), nz)
+                fill = rng.choice(zeros, size=sample_size - len(nz),
+                                  replace=False)
+                pick = s + np.concatenate([nz, fill])
+        else:
+            pick = s + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(row[pick])
+        out_c.append(len(pick))
+        if eids is not None:
+            out_e.append(eids[pick])
+    neigh = (np.concatenate(out_n) if out_n else np.zeros(0, np.int64))
+    es = (np.concatenate(out_e) if out_e and eids is not None
+          else np.zeros(0, np.int64))
+    return neigh, np.asarray(out_c, np.int32), es
+
+
+def graph_sample_neighbors(row, colptr, x, eids=None, perm_buffer=None,
+                           sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False):
+    """ref: phi graph_sample_neighbors (ops.yaml:2299) — uniform
+    neighbor sampling for the nodes in x over CSC (row, colptr)."""
+    rng = _np_rng()
+    rownp = np.asarray(row).reshape(-1)
+    cp = np.asarray(colptr).reshape(-1)
+    nodes = np.asarray(x).reshape(-1)
+    en = np.asarray(eids).reshape(-1) if (return_eids and eids is not None
+                                          ) else None
+    neigh, cnt, es = _sample_row_neighbors(rownp, cp, nodes, sample_size,
+                                           rng, eids=en)
+    return (jnp.asarray(neigh), jnp.asarray(cnt),
+            jnp.asarray(es if en is not None else np.zeros(0, np.int64)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              eids=None, sample_size=-1,
+                              return_eids=False):
+    """ref: phi weighted_sample_neighbors (ops.yaml:5155) — neighbor
+    sampling proportional to edge weights."""
+    rng = _np_rng()
+    rownp = np.asarray(row).reshape(-1)
+    cp = np.asarray(colptr).reshape(-1)
+    nodes = np.asarray(input_nodes).reshape(-1)
+    w = np.asarray(edge_weight).reshape(-1)
+    en = np.asarray(eids).reshape(-1) if (return_eids and eids is not None
+                                          ) else None
+    neigh, cnt, es = _sample_row_neighbors(rownp, cp, nodes, sample_size,
+                                           rng, edge_weight=w, eids=en)
+    return (jnp.asarray(neigh), jnp.asarray(cnt),
+            jnp.asarray(es if en is not None else np.zeros(0, np.int64)))
+
+
+def reindex_graph(x, neighbors, count, hashtable_value=None,
+                  hashtable_index=None):
+    """ref: phi reindex_graph (ops.yaml:3883) — compact the sampled
+    subgraph: out_nodes = unique(x ++ neighbors) with x first (order
+    preserved), edges reindexed into that space."""
+    xs = np.asarray(x).reshape(-1)
+    nb = np.asarray(neighbors).reshape(-1)
+    cnt = np.asarray(count).reshape(-1)
+    seen, out_nodes = _compact_nodes(xs, nb)
+    reindex_src = np.asarray([seen[int(v)] for v in nb], np.int64)
+    # dst: node i of x repeated count[i] times (the sampling fan-in)
+    dst = np.repeat(np.arange(len(xs)), cnt)
+    return (jnp.asarray(reindex_src), jnp.asarray(dst),
+            jnp.asarray(np.asarray(out_nodes, np.int64)))
+
+
+def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(),
+                       return_eids=False):
+    """ref: phi graph_khop_sampler (ops.yaml:2288) — multi-hop neighbor
+    sampling + reindex in one call: per hop, sample sample_sizes[k]
+    neighbors of the frontier, then compact all touched nodes."""
+    rng = _np_rng()
+    rownp = np.asarray(row).reshape(-1)
+    cp = np.asarray(colptr).reshape(-1)
+    seeds = np.asarray(x).reshape(-1)
+    en = np.asarray(eids).reshape(-1) if (return_eids and eids is not None
+                                          ) else None
+    frontier = seeds
+    all_src, all_dst_nodes, all_eids = [], [], []
+    for k in sample_sizes:
+        neigh, cnt, es = _sample_row_neighbors(rownp, cp, frontier,
+                                               int(k), rng, eids=en)
+        all_src.append(neigh)
+        all_dst_nodes.append(np.repeat(frontier, cnt))
+        if en is not None:
+            all_eids.append(es)
+        frontier = np.unique(neigh)
+    src = (np.concatenate(all_src) if all_src else np.zeros(0, np.int64))
+    dstn = (np.concatenate(all_dst_nodes) if all_dst_nodes
+            else np.zeros(0, np.int64))
+    seen, out_nodes = _compact_nodes(seeds, src)
+    out_src = np.asarray([seen[int(v)] for v in src], np.int64)
+    out_dst = np.asarray([seen[int(v)] for v in dstn], np.int64)
+    reindex_x = np.asarray([seen[int(v)] for v in seeds], np.int64)
+    sample_index = np.asarray(out_nodes, np.int64)
+    oe = (np.concatenate(all_eids) if all_eids else np.zeros(0, np.int64))
+    return (jnp.asarray(out_src), jnp.asarray(out_dst),
+            jnp.asarray(sample_index), jnp.asarray(reindex_x),
+            jnp.asarray(oe))
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=True):
+    """ref: phi generate_proposals (ops.yaml:2277) — RPN proposal
+    generation: decode anchor deltas, clip to the image, filter small
+    boxes, NMS, keep top-N.  Single-image host-side pipeline over the
+    on-device decode (the reference CUDA kernel's structure)."""
+    sc = np.asarray(scores, np.float32)          # [N, A, H, W]
+    bd = np.asarray(bbox_deltas, np.float32)     # [N, 4A, H, W]
+    ims = np.asarray(im_shape, np.float32)       # [N, 2]
+    an = np.asarray(anchors, np.float32).reshape(-1, 4)
+    var = np.asarray(variances, np.float32).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+    rois_all, probs_all, nums = [], [], []
+    for i in range(n):
+        s_i = sc[i].transpose(1, 2, 0).reshape(-1)            # [H*W*A]
+        d_i = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1
+                                                  ).reshape(-1, 4)
+        k = min(pre_nms_top_n, s_i.shape[0]) if pre_nms_top_n > 0 \
+            else s_i.shape[0]
+        order = np.argsort(-s_i)[:k]
+        # anchors arrive either per-cell [A, 4] (tiled across the map)
+        # or full [H*W*A, 4] (reference [H, W, A, 4] flattened) — index
+        # the full table directly, never a squared tile
+        if an.shape[0] == a:
+            an_full = np.tile(an, (h * w, 1))
+            var_full = np.tile(var, (h * w, 1))
+        elif an.shape[0] == h * w * a:
+            an_full, var_full = an, var
+        else:
+            raise ValueError(
+                f"anchors rows {an.shape[0]} must be A={a} or "
+                f"H*W*A={h * w * a}")
+        s_k, d_k, an_k, var_k = (s_i[order], d_i[order], an_full[order],
+                                 var_full[order])
+        # decode (the reference's box_coder DECODE_CENTER_SIZE w/ variance)
+        aw = an_k[:, 2] - an_k[:, 0] + offset
+        ah = an_k[:, 3] - an_k[:, 1] + offset
+        ax = an_k[:, 0] + aw * 0.5
+        ay = an_k[:, 1] + ah * 0.5
+        cx = var_k[:, 0] * d_k[:, 0] * aw + ax
+        cy = var_k[:, 1] * d_k[:, 1] * ah + ay
+        cw = np.exp(np.minimum(var_k[:, 2] * d_k[:, 2], 10.0)) * aw
+        ch = np.exp(np.minimum(var_k[:, 3] * d_k[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - cw / 2, cy - ch / 2,
+                          cx + cw / 2 - offset, cy + ch / 2 - offset], 1)
+        # clip to image
+        hh, ww = ims[i, 0], ims[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, ww - offset)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, hh - offset)
+        # filter tiny boxes
+        bw_ = boxes[:, 2] - boxes[:, 0] + offset
+        bh_ = boxes[:, 3] - boxes[:, 1] + offset
+        keep = (bw_ >= min_size) & (bh_ >= min_size)
+        boxes, s_k = boxes[keep], s_k[keep]
+        # greedy NMS (adaptive threshold per the reference: eta < 1
+        # decays nms_thresh each round while it stays above 0.5)
+        order2 = np.argsort(-s_k)
+        picked = []
+        thresh = nms_thresh
+        while len(order2) and (post_nms_top_n <= 0
+                               or len(picked) < post_nms_top_n):
+            j = order2[0]
+            picked.append(j)
+            if len(order2) == 1:
+                break
+            rest = order2[1:]
+            xx1 = np.maximum(boxes[j, 0], boxes[rest, 0])
+            yy1 = np.maximum(boxes[j, 1], boxes[rest, 1])
+            xx2 = np.minimum(boxes[j, 2], boxes[rest, 2])
+            yy2 = np.minimum(boxes[j, 3], boxes[rest, 3])
+            iw = np.maximum(xx2 - xx1 + offset, 0)
+            ih = np.maximum(yy2 - yy1 + offset, 0)
+            inter = iw * ih
+            area_j = (boxes[j, 2] - boxes[j, 0] + offset) * \
+                (boxes[j, 3] - boxes[j, 1] + offset)
+            area_r = (boxes[rest, 2] - boxes[rest, 0] + offset) * \
+                (boxes[rest, 3] - boxes[rest, 1] + offset)
+            iou = inter / np.maximum(area_j + area_r - inter, 1e-10)
+            order2 = rest[iou <= thresh]
+            if eta < 1.0 and thresh * eta > 0.5:
+                thresh *= eta
+        rois_all.append(boxes[picked])
+        probs_all.append(s_k[picked])
+        nums.append(len(picked))
+    rois = (np.concatenate(rois_all) if rois_all
+            else np.zeros((0, 4), np.float32))
+    probs = (np.concatenate(probs_all) if probs_all
+             else np.zeros((0,), np.float32))
+    return (jnp.asarray(rois), jnp.asarray(probs.reshape(-1, 1)),
+            jnp.asarray(np.asarray(nums, np.int32)))

@@ -566,3 +566,98 @@ class TestIoDebugOps:
         x = jnp.asarray([1.0, 2.0])
         assert bool(_impl.accuracy_check(x, x + 1e-9))
         assert not bool(_impl.accuracy_check(x, x + 1.0))
+
+
+class TestGraphSampling:
+    # triangle graph in CSC: node v's in-neighbors are the other two
+    ROW = np.asarray([1, 2, 0, 2, 0, 1], np.int64)
+    COLPTR = np.asarray([0, 2, 4, 6], np.int64)
+
+    def test_sample_neighbors_membership(self):
+        neigh, cnt, _ = _impl.graph_sample_neighbors(
+            self.ROW, self.COLPTR, np.asarray([0, 1, 2], np.int64),
+            sample_size=1)
+        cnt = np.asarray(cnt)
+        assert (cnt == 1).all()
+        neigh = np.asarray(neigh)
+        allowed = {0: {1, 2}, 1: {0, 2}, 2: {0, 1}}
+        for i, v in enumerate([0, 1, 2]):
+            assert int(neigh[i]) in allowed[v]
+        # sample_size -1: full neighborhoods
+        neigh2, cnt2, _ = _impl.graph_sample_neighbors(
+            self.ROW, self.COLPTR, np.asarray([0], np.int64),
+            sample_size=-1)
+        assert set(np.asarray(neigh2).tolist()) == {1, 2}
+
+    def test_weighted_sampling_bias(self):
+        # edge weights heavily favor the first neighbor of node 0
+        w = np.asarray([100.0, 0.001, 1, 1, 1, 1], np.float32)
+        hits = 0
+        for _ in range(20):
+            n, _, _ = _impl.weighted_sample_neighbors(
+                self.ROW, self.COLPTR, w, np.asarray([0], np.int64),
+                sample_size=1)
+            hits += int(np.asarray(n)[0] == 1)
+        assert hits >= 16   # ~1e5:1 odds per draw
+
+    def test_reindex_graph(self):
+        src, dst, nodes = _impl.reindex_graph(
+            np.asarray([5, 9], np.int64),
+            np.asarray([9, 7, 5, 3], np.int64),
+            np.asarray([2, 2], np.int32))
+        nodes = np.asarray(nodes)
+        np.testing.assert_array_equal(nodes, [5, 9, 7, 3])
+        np.testing.assert_array_equal(np.asarray(src), [1, 2, 0, 3])
+        np.testing.assert_array_equal(np.asarray(dst), [0, 0, 1, 1])
+
+    def test_khop_invariants(self):
+        out_src, out_dst, sample_index, reindex_x, _ = \
+            _impl.graph_khop_sampler(self.ROW, self.COLPTR,
+                                     np.asarray([0], np.int64),
+                                     sample_sizes=[2, 2])
+        nodes = np.asarray(sample_index)
+        assert nodes[0] == 0                     # seeds first
+        assert set(nodes.tolist()) <= {0, 1, 2}
+        src, dst = np.asarray(out_src), np.asarray(out_dst)
+        assert src.shape == dst.shape
+        assert (src < len(nodes)).all() and (dst < len(nodes)).all()
+        # every sampled edge exists in the original triangle graph
+        for s, d in zip(src, dst):
+            u, v = int(nodes[s]), int(nodes[d])
+            assert u != v
+
+
+class TestGenerateProposals:
+    def test_pipeline_invariants(self):
+        rng = np.random.default_rng(0)
+        scores = rng.random((1, 3, 2, 2)).astype(np.float32)
+        deltas = (rng.random((1, 12, 2, 2)).astype(np.float32) - 0.5) * 0.2
+        anchors = np.asarray([[0, 0, 8, 8], [2, 2, 12, 12],
+                              [4, 4, 20, 20]], np.float32)
+        var = np.ones((3, 4), np.float32)
+        rois, probs, num = _impl.generate_proposals(
+            scores, deltas, np.asarray([[32.0, 32.0]], np.float32),
+            anchors, var, pre_nms_top_n=12, post_nms_top_n=5,
+            nms_thresh=0.7, min_size=2.0)
+        rois = np.asarray(rois)
+        probs = np.asarray(probs).reshape(-1)
+        assert int(np.asarray(num)[0]) == rois.shape[0] <= 5
+        # clipped to the image
+        assert (rois[:, 0::2] >= 0).all() and (rois[:, 0::2] <= 31).all()
+        assert (rois[:, 1::2] >= 0).all() and (rois[:, 1::2] <= 31).all()
+        # min size respected
+        assert ((rois[:, 2] - rois[:, 0] + 1) >= 2.0).all()
+        # scores sorted descending (greedy NMS order)
+        assert (np.diff(probs) <= 1e-6).all()
+
+    def test_zero_delta_decodes_to_anchor(self):
+        scores = np.ones((1, 1, 1, 1), np.float32)
+        deltas = np.zeros((1, 4, 1, 1), np.float32)
+        anchors = np.asarray([[4, 4, 12, 12]], np.float32)
+        var = np.ones((1, 4), np.float32)
+        rois, _, _ = _impl.generate_proposals(
+            scores, deltas, np.asarray([[32.0, 32.0]], np.float32),
+            anchors, var, pre_nms_top_n=5, post_nms_top_n=5,
+            nms_thresh=0.7, min_size=1.0)
+        np.testing.assert_allclose(np.asarray(rois)[0], [4, 4, 12, 12],
+                                   atol=1e-5)
