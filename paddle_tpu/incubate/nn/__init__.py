@@ -1,6 +1,6 @@
 from . import attention, decode_attention, fused
 from .decode_attention import (
-    block_multihead_attention, masked_multihead_attention,
+    block_multihead_attention, flash_decoding, masked_multihead_attention,
     memory_efficient_attention,
 )
 from .fused import (

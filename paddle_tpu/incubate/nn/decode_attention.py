@@ -28,7 +28,18 @@ import jax.numpy as jnp
 from ...ops.registry import register
 
 __all__ = ["masked_multihead_attention", "block_multihead_attention",
-           "memory_efficient_attention"]
+           "memory_efficient_attention", "flash_decoding"]
+
+
+def flash_decoding(q, k_cache, v_cache, seq_lens, scale=None):
+    """Pallas flash-decoding step (ops/pallas/decode_attention.py): one
+    query token per sequence against a dense KV cache, HBM traffic
+    scaling with the actual ``seq_lens`` rather than the cache capacity.
+    q [B, H, D]; k_cache/v_cache [B, KVH, T, D] (GQA group-major);
+    seq_lens [B] = valid rows.  Returns [B, H, D]."""
+    from ...ops.pallas.decode_attention import flash_decoding_op
+
+    return flash_decoding_op(q, k_cache, v_cache, seq_lens, scale=scale)
 
 
 @register("masked_multihead_attention", amp="white")
@@ -48,17 +59,14 @@ def _mmha_op(x, cache_kv, seq_lens, rotary_embs=None, *, num_heads: int,
             rotated = jnp.concatenate([-t2, t1], axis=-1)
             return t * cos[:, None, :] + rotated * sin[:, None, :]
         q, k = rot(q), rot(k)
-    t_max = cache_kv.shape[3]
     bidx = jnp.arange(b)
     kc = cache_kv[0].at[bidx, :, seq_lens, :].set(k)    # [B, H, T, D]
     vc = cache_kv[1].at[bidx, :, seq_lens, :].set(v)
-    scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    logits = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
-                        kc.astype(jnp.float32)) * scale
-    mask = jnp.arange(t_max)[None, :] <= seq_lens[:, None]  # [B, T]
-    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
-    p = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bht,bhtd->bhd", p, vc.astype(jnp.float32))
+    # attention itself is the Pallas flash-decoding kernel: KV streamed
+    # once with online softmax, HBM traffic bounded by seq_lens not T
+    from ...ops.pallas.decode_attention import flash_decode_raw
+
+    out = flash_decode_raw(q, kc, vc, seq_lens + 1, scale=scale)
     return (out.reshape(b, h * d).astype(x.dtype),
             jnp.stack([kc, vc], axis=0))
 
@@ -102,20 +110,12 @@ def _block_mha_op(qkv, key_cache, value_cache, seq_lens, block_tables, *,
     phys = block_tables[bidx, blk_idx]                  # [B]
     key_cache = key_cache.at[phys, :, slot, :].set(k)
     value_cache = value_cache.at[phys, :, slot, :].set(v)
-    # gather each sequence's pages: [B, MaxBlocks, H, BS, D]
-    safe_tables = jnp.maximum(block_tables, 0)
-    ks = key_cache[safe_tables]                         # [B, MB, H, BS, D]
-    vs = value_cache[safe_tables]
-    mb = block_tables.shape[1]
-    ks = jnp.moveaxis(ks, 2, 1).reshape(b, h, mb * bs, d)
-    vs = jnp.moveaxis(vs, 2, 1).reshape(b, h, mb * bs, d)
-    scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    logits = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
-                        ks.astype(jnp.float32)) * scale
-    mask = jnp.arange(mb * bs)[None, :] <= seq_lens[:, None]
-    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
-    p = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bht,bhtd->bhd", p, vs.astype(jnp.float32))
+    # attention via the Pallas paged kernel: the page indirection lives
+    # in the DMA index map — no gathered [B, MB, H, BS, D] copy
+    from ...ops.pallas.decode_attention import paged_decode_raw
+
+    out = paged_decode_raw(q, key_cache, value_cache, seq_lens + 1,
+                           block_tables, scale=scale)
     return out.astype(qkv.dtype), key_cache, value_cache
 
 

@@ -7,11 +7,14 @@ preallocated cache) plus the generation loops PaddleNLP layers over it.
 
 TPU-native design: the whole decode is TWO compiled programs —
 - prefill: one forward over the prompt that also returns the per-layer
-  K/V tensors (written into a [L, B, max_len, kvh, d] cache), and
+  K/V tensors (written into a [L, B, kvh, max_len, d] cache — head-major,
+  the Pallas flash-decoding kernel's layout), and
 - a ``lax.scan`` over decode steps: each step embeds one token, runs every
-  layer against the cache (GQA grouped einsums, fp32 softmax with a
-  position mask), appends its K/V via ``dynamic_update_slice``, samples
-  (greedy / temperature / top-k / top-p) and carries the PRNG key chain.
+  layer against the cache through the Pallas flash-decoding kernel
+  (ops/pallas/decode_attention.py — online softmax, HBM traffic bounded
+  by the CURRENT position rather than max_len), appends its K/V via
+  ``dynamic_update_slice``, samples (greedy / temperature / top-k /
+  top-p) and carries the PRNG key chain.
 No per-token python dispatch, no cache reallocation, static shapes
 throughout — the XLA-friendly formulation of the reference's CUDA decode
 kernels.
@@ -71,11 +74,12 @@ class _Weights:
 def _block(w: _Weights, i, x, cos, sin, mask, k_all=None, v_all=None,
            cache_pos=None):
     """One decoder layer. x [b, s, hdim]; without a cache (prefill) it
-    attends x's own K/V causally; with k_all/v_all ([b, M, kvh, d] layer
+    attends x's own K/V causally; with k_all/v_all ([b, kvh, M, d] layer
     cache) and ``cache_pos``, x's K/V are first written at that position,
-    then attention runs over the whole cache. Returns
-    (y, k_attended, v_attended) — the prompt's K/V in prefill, the updated
-    layer cache in decode."""
+    then attention runs over the cache through the Pallas flash-decoding
+    kernel (HBM traffic bounded by cache_pos+s, not M). Returns
+    (y, k_attended, v_attended) — the prompt's K/V ([b, s, kvh, d]) in
+    prefill, the updated layer cache in decode."""
     cfg = w.cfg
     b, s, _ = x.shape
     h, kvh, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -85,23 +89,47 @@ def _block(w: _Weights, i, x, cos, sin, mask, k_all=None, v_all=None,
     k = (xin @ w.layer(i, "self_attn.k_proj.weight")).reshape(b, s, kvh, d)
     v = (xin @ w.layer(i, "self_attn.v_proj.weight")).reshape(b, s, kvh, d)
     q, k = _apply_rope(q, k, cos, sin)
-    if k_all is None:
-        k_all, v_all = k, v
-    else:
-        k_all = lax.dynamic_update_slice(k_all, k.astype(k_all.dtype),
-                                         (0, cache_pos, 0, 0))
-        v_all = lax.dynamic_update_slice(v_all, v.astype(v_all.dtype),
-                                         (0, cache_pos, 0, 0))
-    # GQA: group q heads over kv heads, attend in fp32
     g = h // kvh
-    qg = q.reshape(b, s, kvh, g, d).astype(jnp.float32)
-    scores = jnp.einsum("bskgd,bSkd->bskgS", qg,
-                        k_all.astype(jnp.float32)) * (d ** -0.5)
-    if mask is not None:
-        scores = scores + mask[None, :, None, None, :]
-    probs = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("bskgS,bSkd->bskgd", probs, v_all.astype(jnp.float32))
-    ctx = ctx.reshape(b, s, h * d).astype(x.dtype)
+    if k_all is None:
+        # prefill: attend x's own K/V with the causal mask (one big
+        # MXU-friendly batched matmul over [S, S])
+        k_all, v_all = k, v
+        qg = q.reshape(b, s, kvh, g, d).astype(jnp.float32)
+        scores = jnp.einsum("bskgd,bSkd->bskgS", qg,
+                            k_all.astype(jnp.float32)) * (d ** -0.5)
+        if mask is not None:
+            scores = scores + mask[None, :, None, None, :]
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bskgS,bSkd->bskgd", probs,
+                         v_all.astype(jnp.float32))
+        ctx = ctx.reshape(b, s, h * d).astype(x.dtype)
+    else:
+        # write the new K/V at cache_pos ([b, kvh, M, d] cache layout)
+        kt = jnp.moveaxis(k, 1, 2).astype(k_all.dtype)   # [b, kvh, s, d]
+        vt = jnp.moveaxis(v, 1, 2).astype(v_all.dtype)
+        k_all = lax.dynamic_update_slice(k_all, kt, (0, 0, cache_pos, 0))
+        v_all = lax.dynamic_update_slice(v_all, vt, (0, 0, cache_pos, 0))
+        if s == 1 and mask is None:
+            # single-token decode: Pallas flash-decoding kernel (HBM
+            # traffic bounded by cache_pos+1, not M)
+            from ..ops.pallas.decode_attention import flash_decode_raw
+
+            lens = jnp.broadcast_to(cache_pos + 1, (b,)).astype(jnp.int32)
+            ctx = flash_decode_raw(q.reshape(b, h, d), k_all, v_all,
+                                   lens, scale=d ** -0.5)
+            ctx = ctx.reshape(b, s, h * d).astype(x.dtype)
+        else:
+            # chunked prefill against an existing cache (s > 1, or an
+            # explicit mask): general grouped attention over the cache
+            qg = q.reshape(b, s, kvh, g, d).astype(jnp.float32)
+            scores = jnp.einsum("bskgd,bkSd->bskgS", qg,
+                                k_all.astype(jnp.float32)) * (d ** -0.5)
+            if mask is not None:
+                scores = scores + mask[None, :, None, None, :]
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bskgS,bkSd->bskgd", probs,
+                             v_all.astype(jnp.float32))
+            ctx = ctx.reshape(b, s, h * d).astype(x.dtype)
     x = x + ctx @ w.layer(i, "self_attn.o_proj.weight")
     xm = _rms_norm(x, w.layer(i, "post_attention_layernorm.weight"), eps)
     gate = xm @ w.layer(i, "mlp.gate_proj.weight")
@@ -111,20 +139,17 @@ def _block(w: _Weights, i, x, cos, sin, mask, k_all=None, v_all=None,
 
 
 def _decode_step(w: _Weights, cos_tab, sin_tab, token, pos, k_cache, v_cache):
-    """One-token step. token [b], pos scalar; caches [L, b, M, kvh, d].
+    """One-token step. token [b], pos scalar; caches [L, b, kvh, M, d].
     Each layer goes through the same _block as prefill, writing its K/V at
     ``pos`` before attending. Returns (logits [b, V], k_cache, v_cache)."""
     cfg = w.cfg
-    M = k_cache.shape[2]
     x = jnp.take(w["model.embed_tokens.weight"], token[:, None], axis=0)
     cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1)[None, :, None, :]
     sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1)[None, :, None, :]
     cos = cos.astype(x.dtype)
     sin = sin.astype(x.dtype)
-    valid = (jnp.arange(M) <= pos)[None, :]  # [1 (q pos), M]
-    mask = jnp.where(valid, 0.0, -jnp.inf).astype(jnp.float32)
     for i in range(cfg.num_hidden_layers):
-        x, kl, vl = _block(w, i, x, cos, sin, mask, k_cache[i], v_cache[i],
+        x, kl, vl = _block(w, i, x, cos, sin, None, k_cache[i], v_cache[i],
                            pos)
         k_cache = k_cache.at[i].set(kl)
         v_cache = v_cache.at[i].set(vl)
@@ -169,12 +194,12 @@ def _generate_jit(params, ids, key, cfg_id, max_new_tokens,
     cos = jnp.take(cos_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
     sin = jnp.take(sin_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
     causal = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0, -jnp.inf)
-    k_cache = jnp.zeros((L, b, M, kvh, d), x.dtype)
-    v_cache = jnp.zeros((L, b, M, kvh, d), x.dtype)
+    k_cache = jnp.zeros((L, b, kvh, M, d), x.dtype)
+    v_cache = jnp.zeros((L, b, kvh, M, d), x.dtype)
     for i in range(L):
         x, k, v = _block(w, i, x, cos, sin, causal)
-        k_cache = k_cache.at[i, :, :S].set(k)
-        v_cache = v_cache.at[i, :, :S].set(v)
+        k_cache = k_cache.at[i, :, :, :S].set(jnp.moveaxis(k, 1, 2))
+        v_cache = v_cache.at[i, :, :, :S].set(jnp.moveaxis(v, 1, 2))
     x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
     last_logits = w.head(x[:, -1])
 
@@ -226,12 +251,12 @@ def _beam_search_jit(params, ids, cfg_id, max_new_tokens, num_beams,
     cos = jnp.take(cos_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
     sin = jnp.take(sin_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
     causal = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0, -jnp.inf)
-    k_cache = jnp.zeros((L, b, M, kvh, d), x.dtype)
-    v_cache = jnp.zeros((L, b, M, kvh, d), x.dtype)
+    k_cache = jnp.zeros((L, b, kvh, M, d), x.dtype)
+    v_cache = jnp.zeros((L, b, kvh, M, d), x.dtype)
     for i in range(L):
         x, k, v = _block(w, i, x, cos, sin, causal)
-        k_cache = k_cache.at[i, :, :S].set(k)
-        v_cache = v_cache.at[i, :, :S].set(v)
+        k_cache = k_cache.at[i, :, :, :S].set(jnp.moveaxis(k, 1, 2))
+        v_cache = v_cache.at[i, :, :, :S].set(jnp.moveaxis(v, 1, 2))
     x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
     logp0 = jax.nn.log_softmax(w.head(x[:, -1]).astype(jnp.float32), axis=-1)
     V = logp0.shape[-1]
@@ -242,16 +267,16 @@ def _beam_search_jit(params, ids, cfg_id, max_new_tokens, num_beams,
     gen_len = jnp.ones((b, B), jnp.int32)
     toks_buf = jnp.zeros((b, B, max_new_tokens), jnp.int32)
     toks_buf = toks_buf.at[:, :, 0].set(tok)
-    # beams share the prompt cache: tile to [L, b*B, M, ...]
+    # beams share the prompt cache: tile to [L, b*B, kvh, M, d]
     k_cache = jnp.repeat(k_cache, B, axis=1)
     v_cache = jnp.repeat(v_cache, B, axis=1)
 
     def gather_cache(c, parent):
-        # c: [L, b*B, M, kvh, d] -> reorder the beam sub-axis by parent
-        cv = c.reshape(L, b, B, M, kvh, d)
+        # c: [L, b*B, kvh, M, d] -> reorder the beam sub-axis by parent
+        cv = c.reshape(L, b, B, kvh, M, d)
         idx = parent[None, :, :, None, None, None]
         cv = jnp.take_along_axis(cv, idx, axis=2)
-        return cv.reshape(L, b * B, M, kvh, d)
+        return cv.reshape(L, b * B, kvh, M, d)
 
     def step(carry, t):
         alive_logp, tok, toks_buf, gen_len, done, k_cache, v_cache = carry

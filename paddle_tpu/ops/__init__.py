@@ -24,3 +24,6 @@ from .pallas import flash_attention as _flash  # noqa: F401  (registers
 #                          dependent on which feature module loads first)
 from .pallas import flashmask as _flashmask  # noqa: F401  (registers
 #                          flashmask_attention + flash_attn_varlen_qkvpacked)
+from .pallas import decode_attention as _flash_decode  # noqa: F401
+#                          (registers flash_decoding — the Pallas KV-cache
+#                          decode kernel)

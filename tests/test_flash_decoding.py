@@ -1,0 +1,157 @@
+"""Pallas flash-decoding kernel (ops/pallas/decode_attention.py) vs naive
+softmax reference — the TPU analog of the reference's
+masked_multihead_attention CUDA kernel
+(paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.decode_attention import flash_decode_raw
+
+
+def _naive(q, kc, vc, lens):
+    """q [B,H,D]; kc/vc [B,KVH,T,D]; lens [B] -> [B,H,D] fp64."""
+    b, h, d = q.shape
+    kvh = kc.shape[1]
+    rep = h // kvh
+    out = np.zeros((b, h, d))
+    for bi in range(b):
+        for hi in range(h):
+            g = hi // rep
+            t = int(lens[bi])
+            if t == 0:
+                continue
+            logits = (kc[bi, g, :t].astype(np.float64)
+                      @ q[bi, hi].astype(np.float64)) / np.sqrt(d)
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            out[bi, hi] = p @ vc[bi, g, :t].astype(np.float64)
+    return out
+
+
+@pytest.mark.parametrize("h,kvh", [(4, 4), (8, 2), (6, 1)])
+def test_flash_decode_parity(h, kvh):
+    rng = np.random.RandomState(0)
+    b, d, t_max = 3, 32, 300            # t_max spans >1 k block of 128
+    lens = np.array([1, 130, 300], np.int32)
+    q = rng.randn(b, h, d).astype(np.float32)
+    kc = rng.randn(b, kvh, t_max, d).astype(np.float32)
+    vc = rng.randn(b, kvh, t_max, d).astype(np.float32)
+
+    out = flash_decode_raw(q, kc, vc, lens, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), _naive(q, kc, vc, lens),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_decode_garbage_past_len():
+    """Cache rows past seq_len hold NaN/inf garbage (unwritten slots):
+    the kernel's masking must keep them out of the result — this is what
+    lets the DMA-clamped index map revisit stale blocks safely."""
+    rng = np.random.RandomState(1)
+    b, h, d, t_max = 2, 4, 16, 256
+    lens = np.array([7, 131], np.int32)
+    q = rng.randn(b, h, d).astype(np.float32)
+    kc = np.full((b, h, t_max, d), np.nan, np.float32)
+    vc = np.full((b, h, t_max, d), np.inf, np.float32)
+    for bi in range(b):
+        kc[bi, :, :lens[bi]] = rng.randn(h, lens[bi], d)
+        vc[bi, :, :lens[bi]] = rng.randn(h, lens[bi], d)
+
+    out = np.asarray(flash_decode_raw(q, kc, vc, lens, block_k=128))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, _naive(q, kc, vc, lens),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_decode_zero_len_rows():
+    rng = np.random.RandomState(2)
+    b, h, d, t_max = 2, 2, 8, 64
+    lens = np.array([0, 5], np.int32)
+    q = rng.randn(b, h, d).astype(np.float32)
+    kc = rng.randn(b, h, t_max, d).astype(np.float32)
+    vc = rng.randn(b, h, t_max, d).astype(np.float32)
+    out = np.asarray(flash_decode_raw(q, kc, vc, lens))
+    assert np.allclose(out[0], 0.0)
+    np.testing.assert_allclose(out[1], _naive(q, kc, vc, lens)[1],
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_decode_bf16():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    b, h, kvh, d, t_max = 2, 8, 4, 64, 256
+    lens = np.array([100, 256], np.int32)
+    q = rng.randn(b, h, d).astype(np.float32)
+    kc = rng.randn(b, kvh, t_max, d).astype(np.float32)
+    vc = rng.randn(b, kvh, t_max, d).astype(np.float32)
+    out = flash_decode_raw(jnp.asarray(q, jnp.bfloat16),
+                           jnp.asarray(kc, jnp.bfloat16),
+                           jnp.asarray(vc, jnp.bfloat16), lens)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               _naive(q, kc, vc, lens), rtol=0.1, atol=0.1)
+
+
+@pytest.mark.parametrize("h,kvh", [(4, 4), (8, 2)])
+def test_paged_decode_parity(h, kvh):
+    """Pallas paged kernel == dense attention over the logical sequence,
+    with physical pages deliberately shuffled."""
+    from paddle_tpu.ops.pallas.decode_attention import paged_decode_raw
+
+    rng = np.random.RandomState(5)
+    b, d, page, nblocks, mp = 2, 32, 16, 12, 4
+    lens = np.array([10, 60], np.int32)     # 60 < mp*page = 64
+    tables = np.array([[7, 2, 9, 0], [1, 11, 4, 8]], np.int32)
+    q = rng.randn(b, h, d).astype(np.float32)
+    kcache = rng.randn(nblocks, kvh, page, d).astype(np.float32)
+    vcache = rng.randn(nblocks, kvh, page, d).astype(np.float32)
+
+    out = np.asarray(paged_decode_raw(q, kcache, vcache, lens, tables))
+
+    # build the logical dense cache from the page tables
+    kc = np.zeros((b, kvh, mp * page, d), np.float32)
+    vc = np.zeros((b, kvh, mp * page, d), np.float32)
+    for bi in range(b):
+        for pi in range(mp):
+            kc[bi, :, pi * page:(pi + 1) * page] = kcache[tables[bi, pi]]
+            vc[bi, :, pi * page:(pi + 1) * page] = vcache[tables[bi, pi]]
+    np.testing.assert_allclose(out, _naive(q, kc, vc, lens),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_paged_decode_unused_slots_are_negative():
+    """Unused table slots are -1 (the reference's convention): they sit
+    past seq_len so they must never be dereferenced."""
+    from paddle_tpu.ops.pallas.decode_attention import paged_decode_raw
+
+    rng = np.random.RandomState(6)
+    b, h, d, page, nblocks = 1, 2, 16, 8, 4
+    lens = np.array([5], np.int32)
+    tables = np.array([[3, -1, -1]], np.int32)
+    q = rng.randn(b, h, d).astype(np.float32)
+    kcache = rng.randn(nblocks, h, page, d).astype(np.float32)
+    vcache = rng.randn(nblocks, h, page, d).astype(np.float32)
+    out = np.asarray(paged_decode_raw(q, kcache, vcache, lens, tables))
+    kc = kcache[tables[0, :1]].transpose(1, 0, 2, 3).reshape(
+        1, h, page, d)
+    vc = vcache[tables[0, :1]].transpose(1, 0, 2, 3).reshape(
+        1, h, page, d)
+    np.testing.assert_allclose(out, _naive(q, kc, vc, lens),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_incubate_flash_decoding_surface():
+    rng = np.random.RandomState(4)
+    b, h, d, t_max = 2, 4, 16, 128
+    lens = np.array([3, 60], np.int32)
+    q = rng.randn(b, h, d).astype(np.float32)
+    kc = rng.randn(b, h, t_max, d).astype(np.float32)
+    vc = rng.randn(b, h, t_max, d).astype(np.float32)
+    out = paddle.incubate.nn.flash_decoding(
+        paddle.to_tensor(q), paddle.to_tensor(kc), paddle.to_tensor(vc),
+        paddle.to_tensor(lens))
+    np.testing.assert_allclose(np.asarray(out._value),
+                               _naive(q, kc, vc, lens),
+                               rtol=2e-4, atol=2e-5)
