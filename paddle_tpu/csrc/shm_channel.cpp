@@ -1,0 +1,295 @@
+// shm_channel — process-shared ring-buffer byte channel for DataLoader
+// worker -> parent batch transfer.
+//
+// TPU-native analog of the reference's shared-memory loader plumbing:
+// paddle/fluid/memory/allocation/mmap_allocator.cc (shared-memory tensor
+// transfer between loader worker processes and the trainer) plus the
+// bounded blocking queue the readers push through
+// (paddle/fluid/operators/reader/blocking_queue.h).  Native code is the
+// point here: the consumer blocks in C (ctypes releases the GIL), so a
+// waiting trainer thread never serializes Python worker threads, and the
+// batch payload crosses the process boundary as two memcpys (worker
+// numpy buffer -> ring, ring -> preallocated parent numpy buffer) with
+// no pickling of array data and no pipe syscalls per batch.
+//
+// Layout: [Header | ring bytes].  Single producer, single consumer.
+// Messages are 8-byte little-endian length-prefixed; bodies may wrap.
+// Robust process-shared mutex: a worker dying mid-send surfaces as
+// SHMCH_CLOSED/-EOWNERDEAD to the parent instead of a deadlock.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -pthread shm_channel.cpp -lrt
+
+#include <cerrno>
+#include <new>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+  uint64_t capacity;  // ring payload bytes
+  uint64_t head;      // total bytes consumed (mod capacity = read pos)
+  uint64_t tail;      // total bytes produced (mod capacity = write pos)
+  uint32_t closed;    // producer hung up
+};
+
+struct Handle {
+  Header* h;
+  uint8_t* data;
+  uint64_t map_len;
+  int owner;  // created (and therefore unlinks) the segment
+  char name[240];
+};
+
+timespec deadline_in(long timeout_ms) {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  return ts;
+}
+
+// lock with robustness recovery; returns 0 or negative errno
+int lock_mu(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    // previous owner (a worker) died holding the lock: state is a byte
+    // ring, always structurally consistent — recover and mark closed so
+    // the consumer drains and stops
+    pthread_mutex_consistent(&h->mu);
+    h->closed = 1;
+    return 0;
+  }
+  return rc ? -rc : 0;
+}
+
+constexpr int SHMCH_OK = 0;
+constexpr int SHMCH_TIMEOUT = -1;
+constexpr int SHMCH_CLOSED = -2;
+constexpr int SHMCH_ERR = -3;
+
+// copy n bytes into the ring at tail (caller holds lock and checked room)
+void ring_write(Header* h, uint8_t* data, const uint8_t* src, uint64_t n) {
+  uint64_t pos = h->tail % h->capacity;
+  uint64_t first = n < h->capacity - pos ? n : h->capacity - pos;
+  memcpy(data + pos, src, first);
+  if (n > first) memcpy(data, src + first, n - first);
+  h->tail += n;
+}
+
+void ring_read(Header* h, const uint8_t* data, uint8_t* dst, uint64_t n) {
+  uint64_t pos = h->head % h->capacity;
+  uint64_t first = n < h->capacity - pos ? n : h->capacity - pos;
+  memcpy(dst, data + pos, first);
+  if (n > first) memcpy(dst + first, data, n - first);
+  h->head += n;
+}
+
+// stream n bytes (blocking in chunks as space frees)
+int stream_send(Handle* hd, const uint8_t* src, uint64_t n, long timeout_ms) {
+  Header* h = hd->h;
+  uint64_t sent = 0;
+  while (sent < n) {
+    if (lock_mu(h) != 0) return SHMCH_ERR;
+    timespec dl = deadline_in(timeout_ms);
+    while (h->tail - h->head == h->capacity && !h->closed) {
+      int rc = pthread_cond_timedwait(&h->not_full, &h->mu, &dl);
+      if (rc == ETIMEDOUT) {
+        pthread_mutex_unlock(&h->mu);
+        return SHMCH_TIMEOUT;
+      }
+      if (rc == EOWNERDEAD) {
+        pthread_mutex_consistent(&h->mu);
+        h->closed = 1;
+      }
+    }
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return SHMCH_CLOSED;
+    }
+    uint64_t room = h->capacity - (h->tail - h->head);
+    uint64_t chunk = n - sent < room ? n - sent : room;
+    ring_write(h, hd->data, src + sent, chunk);
+    sent += chunk;
+    pthread_cond_signal(&h->not_empty);
+    pthread_mutex_unlock(&h->mu);
+  }
+  return SHMCH_OK;
+}
+
+int stream_recv(Handle* hd, uint8_t* dst, uint64_t n, long timeout_ms) {
+  Header* h = hd->h;
+  uint64_t got = 0;
+  while (got < n) {
+    if (lock_mu(h) != 0) return SHMCH_ERR;
+    timespec dl = deadline_in(timeout_ms);
+    while (h->tail == h->head && !h->closed) {
+      int rc = pthread_cond_timedwait(&h->not_empty, &h->mu, &dl);
+      if (rc == ETIMEDOUT) {
+        pthread_mutex_unlock(&h->mu);
+        return SHMCH_TIMEOUT;
+      }
+      if (rc == EOWNERDEAD) {
+        pthread_mutex_consistent(&h->mu);
+        h->closed = 1;
+      }
+    }
+    if (h->tail == h->head && h->closed) {
+      // producer hung up and the ring is drained
+      pthread_mutex_unlock(&h->mu);
+      return SHMCH_CLOSED;
+    }
+    uint64_t avail = h->tail - h->head;
+    uint64_t chunk = n - got < avail ? n - got : avail;
+    ring_read(h, hd->data, dst + got, chunk);
+    got += chunk;
+    pthread_cond_signal(&h->not_full);
+    pthread_mutex_unlock(&h->mu);
+  }
+  return SHMCH_OK;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shmch_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t len = sizeof(Header) + capacity;
+  if (ftruncate(fd, (off_t)len) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* h = static_cast<Header*>(p);
+  memset(h, 0, sizeof(Header));
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_full, &ca);
+  pthread_cond_init(&h->not_empty, &ca);
+  h->capacity = capacity;
+  h->head = 0;
+  h->tail = 0;
+  h->closed = 0;
+  Handle* hd = new Handle();
+  hd->h = h;
+  hd->data = (uint8_t*)p + sizeof(Header);
+  hd->map_len = len;
+  hd->owner = 1;
+  strncpy(hd->name, name, sizeof(hd->name) - 1);
+  return hd;
+}
+
+void* shmch_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                 MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) return nullptr;
+  Handle* hd = new Handle();
+  hd->h = (Header*)p;
+  hd->data = (uint8_t*)p + sizeof(Header);
+  hd->map_len = (uint64_t)st.st_size;
+  hd->owner = 0;
+  strncpy(hd->name, name, sizeof(hd->name) - 1);
+  return hd;
+}
+
+// poison the stream: a partially-written frame would desynchronize the
+// length-prefixed protocol (the consumer would read body bytes as a
+// length) — mark closed so the peer gets SHMCH_CLOSED instead
+static void shmch_poison(Handle* hd) {
+  if (lock_mu(hd->h) == 0) {
+    hd->h->closed = 1;
+    pthread_cond_broadcast(&hd->h->not_empty);
+    pthread_cond_broadcast(&hd->h->not_full);
+    pthread_mutex_unlock(&hd->h->mu);
+  }
+}
+
+// one framed message: 8-byte LE length, then the body
+int shmch_send_msg(void* handle, const uint8_t* buf, uint64_t n,
+                   long timeout_ms) {
+  Handle* hd = (Handle*)handle;
+  uint8_t hdr[8];
+  memcpy(hdr, &n, 8);
+  uint64_t tail0;
+  {
+    if (lock_mu(hd->h) != 0) return SHMCH_ERR;
+    tail0 = hd->h->tail;
+    pthread_mutex_unlock(&hd->h->mu);
+  }
+  int rc = stream_send(hd, hdr, 8, timeout_ms);
+  if (rc == SHMCH_OK) rc = stream_send(hd, buf, n, timeout_ms);
+  if (rc != SHMCH_OK && hd->h->tail != tail0) shmch_poison(hd);
+  return rc;
+}
+
+// phase 1: consume the length prefix (returns >= 0 length, or negative
+// status).  phase 2 (shmch_recv_body) reads exactly that many bytes,
+// typically straight into a preallocated numpy buffer.
+int64_t shmch_recv_len(void* handle, long timeout_ms) {
+  Handle* hd = (Handle*)handle;
+  uint64_t n = 0;
+  int rc = stream_recv(hd, (uint8_t*)&n, 8, timeout_ms);
+  if (rc != SHMCH_OK) return rc;
+  return (int64_t)n;
+}
+
+int shmch_recv_body(void* handle, uint8_t* dst, uint64_t n, long timeout_ms) {
+  return stream_recv((Handle*)handle, dst, n, timeout_ms);
+}
+
+// producer hangup: consumer drains buffered bytes then sees SHMCH_CLOSED
+void shmch_close_write(void* handle) {
+  Handle* hd = (Handle*)handle;
+  if (lock_mu(hd->h) == 0) {
+    hd->h->closed = 1;
+    pthread_cond_broadcast(&hd->h->not_empty);
+    pthread_cond_broadcast(&hd->h->not_full);
+    pthread_mutex_unlock(&hd->h->mu);
+  }
+}
+
+void shmch_close(void* handle) {
+  Handle* hd = (Handle*)handle;
+  munmap((void*)hd->h, hd->map_len);
+  if (hd->owner) shm_unlink(hd->name);
+  delete hd;
+}
+
+}  // extern "C"

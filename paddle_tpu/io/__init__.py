@@ -10,6 +10,7 @@ runs) — the analog of the reference's pin-memory + async H2D stream.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from typing import Any, Callable, Iterable, List, Optional, Sequence
@@ -272,22 +273,45 @@ def _wrap_np(obj):
     return obj
 
 
+# terminal marker a shared-memory drain thread enqueues when its worker
+# hangs up (normal exit or death) — lets the parent distinguish "done"
+# from "still producing"
+_WORKER_DONE = object()
+
+
 def _mp_worker(dataset, collate_fn, index_q, result_q, worker_id,
-               worker_init_fn):
+               worker_init_fn, shm_name=None):
     """Worker-process loop (analog of the reference's _worker_loop,
-    io/dataloader/worker.py): pull index lists, emit collated numpy."""
+    io/dataloader/worker.py): pull index lists, emit collated numpy.
+    With ``shm_name`` the batch rides the native shared-memory ring
+    (csrc/shm_channel.cpp — the reference's mmap_allocator transfer)
+    instead of being pickled through the mp.Queue pipe."""
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
-    while True:
-        item = index_q.get()
-        if item is None:
-            break
-        batch_idx, indices = item
-        try:
-            batch = collate_fn([dataset[i] for i in indices])
-            result_q.put((batch_idx, batch, None))
-        except Exception as e:  # propagate to the parent iterator
-            result_q.put((batch_idx, None, e))
+    ch = None
+    if shm_name is not None:
+        from .shm_channel import ShmChannel, send_batch
+
+        ch = ShmChannel(shm_name)
+    try:
+        while True:
+            item = index_q.get()
+            if item is None:
+                break
+            batch_idx, indices = item
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                err = None
+            except Exception as e:  # propagate to the parent iterator
+                batch, err = None, e
+            if ch is not None:
+                send_batch(ch, batch_idx, batch, err)
+            else:
+                result_q.put((batch_idx, batch, err))
+    finally:
+        if ch is not None:
+            ch.close_write()
+            ch.close()
 
 
 class _MultiprocessIter:
@@ -304,21 +328,60 @@ class _MultiprocessIter:
         self._outstanding_cap = max(2, loader.prefetch_factor) * self._nw
         self._collate = loader.worker_collate_fn or _np_collate
         self._index_qs = [ctx.Queue() for _ in range(self._nw)]
-        self._result_q = ctx.Queue()
+        self._channels = []
+        self._readers = []
+        if loader.use_shared_memory:
+            # native shared-memory rings (csrc/shm_channel.cpp): one per
+            # worker; a parent thread per ring blocks in C (GIL released)
+            # and feeds the common reassembly queue
+            from .shm_channel import (ShmChannel, ShmChannelClosed,
+                                      recv_batch)
+
+            self._result_q = queue.Queue()
+            for w in range(self._nw):
+                name = f"/ptpu_dl_{os.getpid()}_{id(self) & 0xffff}_{w}"
+                self._channels.append(ShmChannel(
+                    name, capacity=loader.shm_capacity, create=True))
+
+            def _drain(ch, wid):
+                # always enqueue a terminal marker so __next__ can tell
+                # "worker finished/died" apart from "still producing" —
+                # a silent return would turn worker death into a hang
+                while True:
+                    try:
+                        bidx, batch, err = recv_batch(ch)
+                    except ShmChannelClosed:
+                        self._result_q.put((_WORKER_DONE, wid, None))
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        self._result_q.put((-1, None, e))
+                        self._result_q.put((_WORKER_DONE, wid, None))
+                        return
+                    self._result_q.put((bidx, batch, err))
+        else:
+            self._result_q = ctx.Queue()
         self._workers = [
             ctx.Process(target=_mp_worker,
                         args=(loader.dataset, self._collate,
-                              self._index_qs[w], self._result_q, w,
-                              loader.worker_init_fn),
+                              self._index_qs[w],
+                              None if self._channels else self._result_q,
+                              w, loader.worker_init_fn,
+                              self._channels[w].name if self._channels
+                              else None),
                         daemon=True)
             for w in range(self._nw)]
         for p in self._workers:
             p.start()
+        for w, ch in enumerate(self._channels):
+            t = threading.Thread(target=_drain, args=(ch, w), daemon=True)
+            t.start()
+            self._readers.append(t)
         self._batches = enumerate(iter(loader.batch_sampler))
         self._sent = 0
         self._next_out = 0
         self._hold = {}
         self._exhausted = False
+        self._done_workers = set()
         self._fill()
 
     def _fill(self):
@@ -340,7 +403,20 @@ class _MultiprocessIter:
             self._shutdown()
             raise StopIteration
         while self._next_out not in self._hold:
-            bidx, batch, err = self._result_q.get()
+            item = self._result_q.get()
+            if item[0] is _WORKER_DONE:
+                self._done_workers.add(item[1])
+                # the awaited batch routes to a fixed worker (bidx % nw);
+                # if that worker hung up without delivering it, no amount
+                # of waiting will produce it
+                if self._next_out % self._nw in self._done_workers:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker {self._next_out % self._nw} "
+                        f"exited before producing batch {self._next_out} "
+                        f"(shared-memory mode)")
+                continue
+            bidx, batch, err = item
             if err is not None:
                 self._shutdown()
                 raise err
@@ -356,10 +432,30 @@ class _MultiprocessIter:
                 q.put(None)
             except Exception:
                 pass
+        # mark every ring closed FIRST: wakes workers blocked mid-send
+        # (their send returns CLOSED -> they exit) and reader threads
+        # blocked in native recv (they see CLOSED after draining)
+        for ch in self._channels:
+            try:
+                ch.close_write()
+            except Exception:
+                pass
         for p in self._workers:
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
+        for t in self._readers:
+            t.join(timeout=10)
+        for ch, t in zip(self._channels, self._readers):
+            # never unmap under a still-blocked reader thread (use-after-
+            # free); leaking the mapping is the safe failure mode
+            if not t.is_alive():
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+        self._channels = []
+        self._readers = []
 
     def __del__(self):
         try:
@@ -373,8 +469,11 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=False, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 shm_capacity=64 * 1024 * 1024):
         self.dataset = dataset
+        self.use_shared_memory = use_shared_memory
+        self.shm_capacity = shm_capacity
         self.collate_fn = collate_fn or default_collate_fn
         # with worker processes, collation happens numpy-side in the
         # child; a user collate_fn is honored there (must return numpy)

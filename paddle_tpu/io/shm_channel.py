@@ -1,0 +1,207 @@
+"""Python surface over the native shared-memory batch channel
+(paddle_tpu/csrc/shm_channel.cpp).
+
+Analog of the reference's shared-memory DataLoader transfer
+(paddle/fluid/memory/allocation/mmap_allocator.cc +
+operators/reader/blocking_queue.h): `DataLoader(use_shared_memory=True)`
+workers push collated numpy batches through a per-worker ring; array
+payloads cross as raw bytes (two memcpys, no pickling), and the parent
+blocks in native code with the GIL released.
+
+Batch wire format (one batch = 1 + n_arrays framed messages):
+1. pickle of (batch_idx, treedef-with-placeholders, [(dtype, shape)...],
+   exception-or-None)
+2. each array's raw bytes, received straight into a preallocated
+   np.empty of the advertised dtype/shape.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+OK, TIMEOUT, CLOSED, ERR = 0, -1, -2, -3
+
+
+def _csrc_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(_csrc_dir(), "shm_channel.cpp")
+        so = os.path.join(_csrc_dir(), "libshm_channel.so")
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            tmp = f"{so}.tmp.{os.getpid()}"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", src, "-o", tmp, "-lrt"]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        lib.shmch_create.restype = ctypes.c_void_p
+        lib.shmch_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shmch_open.restype = ctypes.c_void_p
+        lib.shmch_open.argtypes = [ctypes.c_char_p]
+        lib.shmch_send_msg.restype = ctypes.c_int
+        lib.shmch_send_msg.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_uint64, ctypes.c_long]
+        lib.shmch_recv_len.restype = ctypes.c_int64
+        lib.shmch_recv_len.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.shmch_recv_body.restype = ctypes.c_int
+        lib.shmch_recv_body.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_uint64, ctypes.c_long]
+        lib.shmch_close_write.argtypes = [ctypes.c_void_p]
+        lib.shmch_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+class ShmChannelError(RuntimeError):
+    pass
+
+
+class ShmChannelClosed(ShmChannelError):
+    """Producer hung up (worker exit/death) and the ring is drained."""
+
+
+class ShmChannelTimeout(ShmChannelError):
+    pass
+
+
+def _check(rc: int):
+    if rc == TIMEOUT:
+        raise ShmChannelTimeout("shm channel timed out")
+    if rc == CLOSED:
+        raise ShmChannelClosed("shm channel closed by peer")
+    if rc < 0:
+        raise ShmChannelError(f"shm channel error {rc}")
+
+
+class ShmChannel:
+    """Single-producer/single-consumer shared-memory byte channel."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False):
+        self._lib = _load_lib()
+        self.name = name
+        if create:
+            self._h = self._lib.shmch_create(name.encode(), capacity)
+        else:
+            self._h = self._lib.shmch_open(name.encode())
+        if not self._h:
+            raise ShmChannelError(
+                f"could not {'create' if create else 'open'} shm channel "
+                f"{name!r}")
+
+    def send_bytes(self, data: bytes, timeout_ms: int = 600_000):
+        data = bytes(data)
+        _check(self._lib.shmch_send_msg(self._h, data, len(data),
+                                        timeout_ms))
+
+    def send_array(self, arr: np.ndarray, timeout_ms: int = 600_000):
+        arr = np.ascontiguousarray(arr)
+        _check(self._lib.shmch_send_msg(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
+            timeout_ms))
+
+    def recv_len(self, timeout_ms: int = 600_000) -> int:
+        n = self._lib.shmch_recv_len(self._h, timeout_ms)
+        if n < 0:
+            _check(int(n))
+        return int(n)
+
+    def recv_into(self, arr: np.ndarray, timeout_ms: int = 600_000):
+        """Read exactly arr.nbytes into ``arr``'s buffer (phase 2 after
+        recv_len) — the ring -> numpy memcpy happens in native code."""
+        assert arr.flags["C_CONTIGUOUS"]
+        _check(self._lib.shmch_recv_body(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
+            timeout_ms))
+
+    def recv_bytes(self, timeout_ms: int = 600_000) -> bytes:
+        n = self.recv_len(timeout_ms)
+        buf = ctypes.create_string_buffer(n)
+        _check(self._lib.shmch_recv_body(self._h, buf, n, timeout_ms))
+        return buf.raw
+
+    def close_write(self):
+        if self._h:
+            self._lib.shmch_close_write(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.shmch_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---- batch (pytree of numpy arrays) protocol ----
+
+_PLACEHOLDER = "__shm_array__"
+
+
+def _flatten(obj, arrays: List[np.ndarray]):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_flatten(o, arrays) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _flatten(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        arrays.append(np.ascontiguousarray(obj))
+        return (_PLACEHOLDER, len(arrays) - 1)
+    return obj
+
+
+def _unflatten(obj, arrays: List[np.ndarray]):
+    if (isinstance(obj, tuple) and len(obj) == 2
+            and obj[0] == _PLACEHOLDER):
+        return arrays[obj[1]]
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unflatten(o, arrays) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _unflatten(v, arrays) for k, v in obj.items()}
+    return obj
+
+
+def send_batch(ch: ShmChannel, batch_idx: int, batch, err=None,
+               timeout_ms: int = 600_000):
+    arrays: List[np.ndarray] = []
+    tree = None if err is not None else _flatten(batch, arrays)
+    meta = pickle.dumps(
+        (batch_idx, tree, [(a.dtype.str, a.shape) for a in arrays], err))
+    ch.send_bytes(meta, timeout_ms)
+    for a in arrays:
+        ch.send_array(a, timeout_ms)
+
+
+def recv_batch(ch: ShmChannel,
+               timeout_ms: int = 600_000) -> Tuple[int, object, object]:
+    meta = ch.recv_bytes(timeout_ms)
+    batch_idx, tree, specs, err = pickle.loads(meta)
+    arrays = []
+    for dtype, shape in specs:
+        a = np.empty(shape, dtype=np.dtype(dtype))
+        n = ch.recv_len(timeout_ms)
+        if n != a.nbytes:
+            raise ShmChannelError(
+                f"array frame size mismatch: {n} != {a.nbytes}")
+        if a.nbytes:
+            ch.recv_into(a, timeout_ms)
+        arrays.append(a)
+    return batch_idx, (None if err is not None else _unflatten(tree, arrays)), err

@@ -1,0 +1,134 @@
+"""Native shared-memory batch channel (csrc/shm_channel.cpp) — the
+DataLoader worker->parent transfer path (reference analog:
+paddle/fluid/memory/allocation/mmap_allocator.cc + blocking_queue.h)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.shm_channel import (ShmChannel, ShmChannelClosed,
+                                       ShmChannelTimeout, recv_batch,
+                                       send_batch)
+
+
+def _pair(capacity=4096):
+    name = f"/ptpu_test_{os.getpid()}_{threading.get_ident() & 0xffff}"
+    prod = ShmChannel(name, capacity=capacity, create=True)
+    cons = ShmChannel(name)
+    return prod, cons
+
+
+def test_roundtrip_and_wraparound():
+    prod, cons = _pair(capacity=1024)   # messages must wrap repeatedly
+    msgs = [os.urandom(300) for _ in range(50)]
+    got = []
+
+    def producer():
+        for m in msgs:
+            prod.send_bytes(m)
+        prod.close_write()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    while True:
+        try:
+            got.append(cons.recv_bytes(timeout_ms=10_000))
+        except ShmChannelClosed:
+            break
+    t.join()
+    assert got == msgs
+    cons.close()
+    prod.close()
+
+
+def test_message_larger_than_capacity_streams():
+    prod, cons = _pair(capacity=1024)
+    big = os.urandom(10_000)            # 10x the ring: chunked streaming
+
+    t = threading.Thread(target=lambda: prod.send_bytes(big))
+    t.start()
+    out = cons.recv_bytes(timeout_ms=10_000)
+    t.join()
+    assert out == big
+    cons.close()
+    prod.close()
+
+
+def test_recv_timeout():
+    prod, cons = _pair()
+    with pytest.raises(ShmChannelTimeout):
+        cons.recv_len(timeout_ms=100)
+    cons.close()
+    prod.close()
+
+
+def test_batch_protocol_pytree():
+    prod, cons = _pair(capacity=1 << 16)
+    batch = {"x": np.arange(12, dtype=np.float32).reshape(3, 4),
+             "y": (np.ones((2, 2), np.int64), "label"),
+             "z": [np.zeros(0, np.float32)]}
+    send_batch(prod, 7, batch)
+    bidx, got, err = recv_batch(cons)
+    assert bidx == 7 and err is None
+    np.testing.assert_array_equal(got["x"], batch["x"])
+    np.testing.assert_array_equal(got["y"][0], batch["y"][0])
+    assert got["y"][1] == "label" and got["z"][0].size == 0
+    cons.close()
+    prod.close()
+
+
+def test_batch_protocol_error():
+    prod, cons = _pair()
+    send_batch(prod, 3, None, err=ValueError("boom"))
+    bidx, got, err = recv_batch(cons)
+    assert bidx == 3 and got is None and isinstance(err, ValueError)
+    cons.close()
+    prod.close()
+
+
+class _SquareDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return (np.full((4, 3), i, np.float32),
+                np.asarray(i * i, np.int64))
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_shared_memory_parity():
+    ds = _SquareDataset(37)
+    ref = [(np.asarray(x._value), np.asarray(y._value))
+           for x, y in DataLoader(ds, batch_size=5, num_workers=0,
+                                  shuffle=False)]
+    got = [(np.asarray(x._value), np.asarray(y._value))
+           for x, y in DataLoader(ds, batch_size=5, num_workers=2,
+                                  use_shared_memory=True, shuffle=False)]
+    assert len(got) == len(ref)
+    for (xr, yr), (xg, yg) in zip(ref, got):
+        np.testing.assert_array_equal(xr, xg)
+        np.testing.assert_array_equal(yr, yg)
+
+
+class _FailingDataset(Dataset):
+    def __getitem__(self, i):
+        if i == 11:
+            raise RuntimeError("bad sample 11")
+        return np.zeros(2, np.float32)
+
+    def __len__(self):
+        return 20
+
+
+def test_dataloader_shared_memory_error_propagates():
+    dl = DataLoader(_FailingDataset(), batch_size=4, num_workers=2,
+                    use_shared_memory=True)
+    with pytest.raises(RuntimeError, match="bad sample 11"):
+        for _ in dl:
+            pass
