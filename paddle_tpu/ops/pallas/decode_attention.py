@@ -42,8 +42,14 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF, _sds
 
 
-def _decode_kernel(seq_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, block_k: int, scale: float):
+def _decode_kernel(*refs, block_k: int, scale: float):
+    """Shared online-softmax decode body.  Serves BOTH the dense and the
+    paged variant: the ONLY difference between them is the k/v BlockSpec
+    index maps (set up by the callers), so the leading scalar-prefetch
+    refs vary (dense: seq_lens; paged: seq_lens + block tables) and the
+    kernel reads just seq_lens."""
+    seq_ref = refs[0]
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs[-7:]
     bi = pl.program_id(0)                   # batch
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
@@ -160,51 +166,6 @@ def flash_decode_raw(q, k_cache, v_cache, seq_lens, scale=None,
     return out[:, :, :rep].reshape(b, h, d)
 
 
-def _paged_kernel(seq_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, page: int, scale: float):
-    bi = pl.program_id(0)
-    pi = pl.program_id(1)
-    np_ = pl.num_programs(1)
-    slen = seq_ref[bi]
-
-    @pl.when(pi == 0)
-    def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    def compute():
-        q = q_ref[0]                        # [kvh, rp, d]
-        k = k_ref[0]                        # [kvh, page, d]
-        s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale  # [kvh, rp, page]
-        kpos = pi * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(kpos < slen, s, NEG_INF)
-        m_prev = m_scr[:, :, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_scr[:, :, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0]
-        rpos = pi * page + jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
-        v = jnp.where(rpos < slen, v, jnp.zeros_like(v))
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
-
-    pl.when(pi * page < slen)(compute)
-
-    @pl.when(pi == np_ - 1)
-    def _():
-        l = l_scr[:, :, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        valid = m_scr[:, :, :1] > NEG_INF * 0.5
-        o_ref[0] = jnp.where(valid, acc_scr[:] / l, 0.0).astype(o_ref.dtype)
-
-
 def paged_decode_raw(q, key_cache, value_cache, seq_lens, block_tables,
                      scale=None, interpret=None):
     """Paged (vLLM-layout) flash decode: q [b, h, d]; key/value_cache
@@ -258,7 +219,7 @@ def paged_decode_raw(q, key_cache, value_cache, seq_lens, block_tables,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, page=page, scale=float(scale)),
+        functools.partial(_decode_kernel, block_k=page, scale=float(scale)),
         grid_spec=grid_spec,
         out_shape=_sds((b, kvh, rp, d), q.dtype),
         compiler_params=pltpu.CompilerParams(

@@ -3245,3 +3245,632 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
              else np.zeros((0,), np.float32))
     return (jnp.asarray(rois), jnp.asarray(probs.reshape(-1, 1)),
             jnp.asarray(np.asarray(nums, np.int32)))
+
+
+# --------------------------------------------------------------------------
+# round-4 long-tail closures: FlowNet correlation, ads-CTR batched/rank
+# ops, DP-SGD, TDM tree ops, YOLO fused head/post
+# --------------------------------------------------------------------------
+
+def correlation(input1, input2, pad_size, kernel_size, max_displacement,
+                stride1, stride2, corr_type_multiply=1):
+    """ref: phi correlation (ops.yaml:1060; kernel
+    gpu/correlation_kernel.cu correlation_forward) — FlowNet cost volume:
+    out[n, d, i, j] = mean over (c, kernel window) of
+    input1[.., h1+jj, w1+ii] * input2[.., h1+dy+jj, w1+dx+ii], with
+    (dy, dx) the d-th displacement on the stride2 grid and
+    h1 = max_displacement + i*stride1 in pad_size-padded coordinates.
+    Pure jnp (rolls + box filter): differentiable, fuses under XLA."""
+    n, c, H, W = input1.shape
+    krad = (kernel_size - 1) // 2
+    drad = max_displacement // stride2
+    border = krad + max_displacement
+    pH, pW = H + 2 * pad_size, W + 2 * pad_size
+    out_h = -(-(pH - 2 * border) // stride1)
+    out_w = -(-(pW - 2 * border) // stride1)
+    p1 = jnp.pad(input1, ((0, 0), (0, 0), (pad_size, pad_size),
+                          (pad_size, pad_size))).astype(jnp.float32)
+    p2 = jnp.pad(input2, ((0, 0), (0, 0), (pad_size, pad_size),
+                          (pad_size, pad_size))).astype(jnp.float32)
+    nelems = kernel_size * kernel_size * c
+    hi = max_displacement - krad + jnp.arange(out_h) * stride1
+    wi = max_displacement - krad + jnp.arange(out_w) * stride1
+    planes = []
+    for dy in range(-drad, drad + 1):
+        for dx in range(-drad, drad + 1):
+            # align input2 shifted by the displacement; rolled wrap rows
+            # never reach the sliced interior (|shift| <= max_disp)
+            p2s = jnp.roll(p2, (-dy * stride2, -dx * stride2), axis=(2, 3))
+            prod = jnp.sum(p1 * p2s, axis=1)               # [n, pH, pW]
+            box = lax.reduce_window(
+                prod, 0.0, lax.add, (1, kernel_size, kernel_size),
+                (1, 1, 1), "valid")                        # [n, pH-k+1, ..]
+            planes.append(box[:, hi[:, None], wi[None, :]] / nelems)
+    out = jnp.stack(planes, axis=1)                        # [n, D*D, oh, ow]
+    return out.astype(input1.dtype)
+
+
+def batch_fc(input, w, bias):
+    """ref: phi batch_fc (ops.yaml:461; gpu/batch_fc_kernel.cu) —
+    per-slot FC: input [slot, ins, in] x w [slot, in, out] + bias
+    [slot, out].  One batched MXU matmul."""
+    return (jnp.einsum("sni,sio->sno", input, w)
+            + bias[:, None, :]).astype(input.dtype)
+
+
+def rank_attention(x, rank_offset, rank_param, max_rank=3, max_size=0):
+    """ref: phi rank_attention (ops.yaml:3816; funcs/rank_attention.cu.h
+    expand_input/expand_param + batched GEMM) — ads-CTR rank-aware
+    attention.  rank_offset [ins, 1+2*max_rank] int: col0 = instance
+    rank (1-based, <=0 invalid), then (faster_k, index_k) pairs; block k
+    of input_help is x[index_k], and its parameter block is
+    rank_param[(rank-1)*max_rank + (faster_k-1)] viewed as
+    [max_rank*max_rank, fea, para_col].  out = sum_k input_k @ param_k."""
+    ins, fea = x.shape
+    pcol = rank_param.shape[1]
+    ro = rank_offset.astype(jnp.int32)
+    rank = ro[:, 0]                          # [ins], 1-based
+    faster = ro[:, 1::2]                     # [ins, max_rank]
+    index = ro[:, 2::2]                      # [ins, max_rank]
+    valid = (rank > 0)[:, None] & (faster > 0)
+    xg = x[jnp.clip(index, 0, ins - 1)]      # [ins, max_rank, fea]
+    input_help = jnp.where(valid[..., None], xg, 0.0)
+    pview = rank_param.reshape(max_rank * max_rank, fea, pcol)
+    start = jnp.clip((rank[:, None] - 1) * max_rank + (faster - 1),
+                     0, max_rank * max_rank - 1)
+    pg = jnp.where(valid[..., None, None], pview[start], 0.0)
+    out = jnp.einsum("ikf,ikfp->ip", input_help, pg)
+    return (input_help.reshape(ins, max_rank * fea).astype(x.dtype),
+            out.astype(x.dtype),
+            rank.astype(x.dtype)[:, None])
+
+
+def dpsgd(param, grad, learning_rate, clip=10.0, batch_size=16.0,
+          sigma=1.0, seed=0):
+    """ref: phi dpsgd (ops.yaml:1469; cpu/dpsgd_kernel.cc) — DP-SGD
+    (Abadi et al., CCS16): l2-clip the gradient, add ONE shared gaussian
+    noise draw scaled by sigma/batch_size.  Noise rides the framework
+    generator unless an explicit nonzero seed is given (reference
+    semantics: seed 0 -> time-seeded)."""
+    g32 = grad.astype(jnp.float32)
+    l2 = jnp.sqrt(jnp.sum(g32 * g32))
+    scale = jnp.where(l2 > clip, l2 / clip, 1.0)
+    key = (jax.random.PRNGKey(seed) if seed else _key())
+    noise = sigma * jax.random.normal(key, ())
+    lr = jnp.reshape(learning_rate.astype(jnp.float32), ())
+    out = param.astype(jnp.float32) - lr * (g32 / scale
+                                            + noise / batch_size)
+    return out.astype(param.dtype)
+
+
+def tdm_child(x, tree_info, child_nums, dtype="int32"):
+    """ref: phi tdm_child (ops.yaml:4718; cpu/tdm_child_kernel.cc) —
+    TDM tree lookup: tree_info rows are [item_id, layer_id, ancestor,
+    child_0..]; node 0 or childless nodes emit zeros.  leaf_mask marks
+    children that are items (item_id != 0)."""
+    xv = np.asarray(x)
+    ti = np.asarray(tree_info)
+    flat = xv.reshape(-1).astype(np.int64)
+    np_dtype = np.dtype(str(dtype)) if not isinstance(dtype, np.dtype) \
+        else dtype
+    child = np.zeros((flat.size, child_nums), np_dtype)
+    mask = np.zeros((flat.size, child_nums), np_dtype)
+    for i, nid in enumerate(flat):
+        if nid == 0 or ti[nid, 3] == 0:
+            continue
+        ch = ti[nid, 3:3 + child_nums].astype(np.int64)
+        child[i] = ch
+        mask[i] = (ti[ch, 0] != 0).astype(np_dtype)
+    shape = tuple(xv.shape) + (child_nums,)
+    return jnp.asarray(child.reshape(shape)), jnp.asarray(
+        mask.reshape(shape))
+
+
+def tdm_sampler(x, travel, layer, output_positive=True,
+                neg_samples_num_list=(), layer_offset_lod=(), seed=0,
+                dtype=2):
+    """ref: phi tdm_sampler (ops.yaml:4728; cpu/tdm_sampler_kernel.cc) —
+    per-layer negative sampling along each item's tree path (travel row);
+    positives carry label 1; padding layers (travel id 0) emit masked
+    zeros; negatives are drawn uniformly per layer without replacement,
+    never equal to the positive."""
+    xv = np.asarray(x).reshape(-1).astype(np.int64)
+    tr = np.asarray(travel).reshape(-1)
+    ly = np.asarray(layer).reshape(-1)
+    rng = np.random.default_rng(seed) if seed else _np_rng()
+    nlist = list(neg_samples_num_list)
+    lod = list(layer_offset_lod)
+    srl = sum(n + int(bool(output_positive)) for n in nlist)
+    out = np.zeros((xv.size, srl), np.int64)
+    lab = np.zeros((xv.size, srl), np.int64)
+    msk = np.ones((xv.size, srl), np.int64)
+    for i, iid in enumerate(xv):
+        off = 0
+        for li, nneg in enumerate(nlist):
+            pos = int(tr[iid * len(nlist) + li])
+            width = nneg + int(bool(output_positive))
+            if pos == 0:  # padding layer for this item
+                msk[i, off:off + width] = 0
+                lab[i, off:off + width] = 0
+                out[i, off:off + width] = 0
+                off += width
+                continue
+            if output_positive:
+                out[i, off] = pos
+                lab[i, off] = 1
+                off += 1
+            nodes = ly[lod[li]:lod[li + 1]]
+            eligible = np.where(nodes != pos)[0]
+            if eligible.size < nneg:
+                raise ValueError(
+                    f"tdm_sampler: layer {li} has {eligible.size} "
+                    f"non-positive nodes but {nneg} negatives requested")
+            picks = rng.choice(eligible, size=nneg, replace=False)
+            for s in picks:
+                out[i, off] = nodes[s]
+                lab[i, off] = 0
+                off += 1
+    return jnp.asarray(out), jnp.asarray(lab), jnp.asarray(msk)
+
+
+def yolo_box_head(x, anchors, class_num):
+    """ref: phi yolo_box_head (ops.yaml:5186;
+    gpu/yolo_box_head_kernel.cu) — per-anchor activation: sigmoid on
+    x, y, objectness and class logits; exp on w, h.  Layout
+    [n, a*(5+C), h, w]."""
+    n, ch, h, w = x.shape
+    a = len(anchors) // 2
+    xs = x.reshape(n, a, 5 + class_num, h, w)
+    tx = jax.nn.sigmoid(xs[:, :, 0])
+    ty = jax.nn.sigmoid(xs[:, :, 1])
+    tw = jnp.exp(xs[:, :, 2])
+    th = jnp.exp(xs[:, :, 3])
+    obj = jax.nn.sigmoid(xs[:, :, 4])
+    cls = jax.nn.sigmoid(xs[:, :, 5:])
+    out = jnp.concatenate([jnp.stack([tx, ty, tw, th, obj], axis=2), cls],
+                          axis=2)
+    return out.reshape(n, ch, h, w).astype(x.dtype)
+
+
+def _yolo_decode_scale(inp, im_shape, im_scale, anchors, ds, class_num,
+                       conf_thresh):
+    """Decode one head-activated scale for one image into [k, 5+C] rows
+    (obj, x1, y1, x2, y2, probs*obj) — YoloTensorParseKernel semantics,
+    row-major (y, x, anchor) order instead of atomicAdd order."""
+    a = len(anchors) // 2
+    c, h, w = inp.shape
+    pic_h = im_shape[0] / im_scale[0]
+    pic_w = im_shape[1] / im_scale[1]
+    grid = h
+    netw, neth = ds * h, ds * w    # reference passes (ds*h, ds*w)
+    v = inp.reshape(a, 5 + class_num, h, w)
+    rows = []
+    for y_id in range(h):
+        for x_id in range(w):
+            for z in range(a):
+                obj = float(v[z, 4, y_id, x_id])
+                if obj < conf_thresh:
+                    continue
+                bx = (float(v[z, 0, y_id, x_id]) + x_id) * pic_w / grid
+                by = (float(v[z, 1, y_id, x_id]) + y_id) * pic_h / grid
+                bw = float(v[z, 2, y_id, x_id]) * anchors[2 * z] \
+                    * pic_w / netw
+                bh = float(v[z, 3, y_id, x_id]) * anchors[2 * z + 1] \
+                    * pic_h / neth
+                x1 = max(bx - bw / 2, 0.0)
+                y1 = max(by - bh / 2, 0.0)
+                x2 = min(bx + bw / 2, pic_w - 1)
+                y2 = min(by + bh / 2, pic_h - 1)
+                probs = np.asarray(v[z, 5:, y_id, x_id]) * obj
+                rows.append([obj, x1, y1, x2, y2] + probs.tolist())
+    return rows
+
+
+def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
+                  anchors0, anchors1, anchors2, class_num, conf_thresh,
+                  downsample_ratio0, downsample_ratio1, downsample_ratio2,
+                  clip_bbox=True, scale_x_y=1.0, nms_threshold=0.45):
+    """ref: phi yolo_box_post (ops.yaml:5196;
+    gpu/yolo_box_post_kernel.cu) — three-scale YOLO decode + darknet
+    class-grouped greedy NMS.  Output rows [class, objectness, x1, y1,
+    x2, y2] per surviving det (suppressed dets keep a zeroed row, as the
+    reference emits every collected det), nms_rois_num [batch]."""
+    scales = [(np.asarray(boxes0), list(anchors0), downsample_ratio0),
+              (np.asarray(boxes1), list(anchors1), downsample_ratio1),
+              (np.asarray(boxes2), list(anchors2), downsample_ratio2)]
+    shp = np.asarray(image_shape)
+    scl = np.asarray(image_scale)
+    batch = shp.shape[0]
+    all_rows, nums = [], []
+    for b in range(batch):
+        dets = []
+        for inp, anc, ds in scales:
+            dets += _yolo_decode_scale(inp[b], shp[b], scl[b], anc, ds,
+                                       class_num, conf_thresh)
+        dets = [
+            {"obj": r[0], "box": r[1:5], "probs": np.asarray(r[5:]),
+             "cls": int(np.argmax(r[5:])) if max(r[5:]) > 0 else -1}
+            for r in dets]
+        # darknet NMS: group by max-prob class, sort desc by that class
+        # prob, suppress same-class overlaps
+        dets.sort(key=lambda d: (d["cls"], -d["probs"][d["cls"]]
+                                 if d["cls"] >= 0 else -d["obj"]))
+        for i in range(len(dets)):
+            if dets[i]["obj"] == 0:
+                continue
+            for j in range(i + 1, len(dets)):
+                if dets[j]["cls"] != dets[i]["cls"]:
+                    break
+                if dets[j]["obj"] == 0:
+                    continue
+                if _box_iou_xyxy(dets[i]["box"], dets[j]["box"]) \
+                        > nms_threshold:
+                    dets[j]["obj"] = 0.0
+                    dets[j]["probs"][:] = 0
+        for d in dets:
+            all_rows.append([float(d["cls"]), d["obj"], *d["box"]])
+        nums.append(len(dets))
+    out = (np.asarray(all_rows, np.float32) if all_rows
+           else np.zeros((1, 6), np.float32))
+    return jnp.asarray(out), jnp.asarray(np.asarray(nums, np.int32))
+
+
+def _box_iou_xyxy(a, b):
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(),
+              anchor_mask=(), class_num=1, ignore_thresh=0.7,
+              downsample_ratio=32, use_label_smooth=True, scale_x_y=1.0):
+    """ref: phi yolo_loss (ops.yaml:5206; cpu/yolo_loss_kernel.cc) —
+    YOLOv3 training loss.  x [n, mask*(5+C), h, w]; gt_box [n, b, 4]
+    normalized cxcywh; gt_label [n, b] int; optional gt_score [n, b].
+    Returns (loss [n], objectness_mask [n, mask, h, w],
+    gt_match_mask [n, b]).  Matching/routing is integer (stop-grad);
+    the loss terms are jnp, so d(loss)/dx matches the reference grad
+    kernel's analytic path."""
+    anchors = list(anchors)
+    amask = list(anchor_mask)
+    n, _, h, w = x.shape
+    mask_num = len(amask)
+    an_num = len(anchors) // 2
+    b = gt_box.shape[1]
+    input_size = downsample_ratio * h
+    scale = scale_x_y
+    bias = -0.5 * (scale - 1.0)
+    v = x.reshape(n, mask_num, 5 + class_num, h, w).astype(jnp.float32)
+    gt = gt_box.astype(jnp.float32)
+    if gt_score is None:
+        gt_score = jnp.ones((n, b), jnp.float32)
+
+    def bce(logit, label):
+        return (jnp.maximum(logit, 0.0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    # --- ignore pass: every predicted box vs every valid gt ---
+    gi_, gj_ = jnp.meshgrid(jnp.arange(w), jnp.arange(h))  # [h, w]
+    px = (gi_[None, None] + jax.nn.sigmoid(v[:, :, 0]) * scale + bias) / h
+    py = (gj_[None, None] + jax.nn.sigmoid(v[:, :, 1]) * scale + bias) / h
+    aw = jnp.asarray([anchors[2 * m] for m in amask], jnp.float32)
+    ah = jnp.asarray([anchors[2 * m + 1] for m in amask], jnp.float32)
+    pw = jnp.exp(v[:, :, 2]) * aw[None, :, None, None] / input_size
+    ph = jnp.exp(v[:, :, 3]) * ah[None, :, None, None] / input_size
+
+    def iou_cxcywh(x1, y1, w1, h1, x2, y2, w2, h2):
+        ov_w = (jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+                - jnp.maximum(x1 - w1 / 2, x2 - w2 / 2))
+        ov_h = (jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+                - jnp.maximum(y1 - h1 / 2, y2 - h2 / 2))
+        inter = jnp.where((ov_w < 0) | (ov_h < 0), 0.0, ov_w * ov_h)
+        return inter / (w1 * h1 + w2 * h2 - inter)
+
+    valid = (gt[:, :, 2] > 1e-6) & (gt[:, :, 3] > 1e-6)       # [n, b]
+    iou = iou_cxcywh(px[..., None], py[..., None], pw[..., None],
+                     ph[..., None],
+                     gt[:, None, None, None, :, 0],
+                     gt[:, None, None, None, :, 1],
+                     gt[:, None, None, None, :, 2],
+                     gt[:, None, None, None, :, 3])   # [n, m, h, w, b]
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=-1) if b else jnp.zeros_like(px)
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+    # --- positive pass, vectorized over the gt axis: each gt picks its
+    # best wh-IoU anchor; routing is integer so the whole pass is a few
+    # gathers plus one masked scatter (no per-gt python unrolling) ---
+    smooth = min(1.0 / class_num, 1.0 / 40) if use_label_smooth else 0.0
+    label_pos, label_neg = 1.0 - smooth, smooth
+    aw_all = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    ah_all = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    gi = jnp.clip((gt[:, :, 0] * w).astype(jnp.int32), 0, w - 1)  # [n, b]
+    gj = jnp.clip((gt[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+    a_iou = iou_cxcywh(0.0, 0.0, aw_all[None, None, :],
+                       ah_all[None, None, :], 0.0, 0.0,
+                       gt[:, :, 2:3], gt[:, :, 3:4])      # [n, b, an]
+    best_n = jnp.argmax(a_iou, axis=-1)                   # [n, b]
+    lut = np.full(an_num, -1, np.int32)
+    for mi, m in enumerate(amask):
+        lut[m] = mi
+    midx = jnp.asarray(lut)[best_n]                       # [n, b]
+    pos = valid & (midx >= 0)
+    match = jnp.where(valid, jnp.where(midx >= 0, midx, -1), -1) \
+        .astype(jnp.int32)
+    mi_safe = jnp.maximum(midx, 0)
+    i_idx = jnp.arange(n)[:, None]
+    cell = v[i_idx, mi_safe, :, gj, gi]                   # [n, b, 5+C]
+    # reference passes grid_size=h for both axes (square grids)
+    tx = gt[:, :, 0] * h - gi
+    ty = gt[:, :, 1] * h - gj
+    # aw_all/ah_all are anchors normalized by input_size, so
+    # log(gt.w * input_size / anchor) == log(gt.w / aw_all)
+    tw = jnp.log(jnp.maximum(gt[:, :, 2], 1e-9) / aw_all[best_n])
+    th = jnp.log(jnp.maximum(gt[:, :, 3], 1e-9) / ah_all[best_n])
+    box_scale = (2.0 - gt[:, :, 2] * gt[:, :, 3]) * gt_score
+    lloc = (bce(cell[:, :, 0], tx) + bce(cell[:, :, 1], ty)
+            + jnp.abs(cell[:, :, 2] - tw)
+            + jnp.abs(cell[:, :, 3] - th)) * box_scale
+    cls_t = jnp.where(jnp.arange(class_num)[None, None, :]
+                      == gt_label[:, :, None], label_pos, label_neg)
+    lcls = jnp.sum(bce(cell[:, :, 5:], cls_t), axis=-1) * gt_score
+    loss = jnp.sum(jnp.where(pos, lloc + lcls, 0.0), axis=1)
+    # masked scatter of scores into obj_mask: non-positive gts route to
+    # a dummy trailing cell that is dropped afterwards
+    flat = obj_mask.reshape(n, -1)
+    flat = jnp.concatenate([flat, jnp.zeros((n, 1), flat.dtype)], axis=1)
+    cell_idx = (mi_safe * (h * w) + gj * w + gi)
+    cell_idx = jnp.where(pos, cell_idx, mask_num * h * w)
+    flat = flat.at[i_idx, cell_idx].set(
+        jnp.where(pos, gt_score, 0.0))
+    obj_mask = flat[:, :-1].reshape(n, mask_num, h, w)
+
+    # --- objectness loss over the final mask ---
+    obj_logit = v[:, :, 4]
+    lobj = jnp.where(obj_mask > 1e-5, bce(obj_logit, 1.0) * obj_mask,
+                     jnp.where(obj_mask > -0.5, bce(obj_logit, 0.0), 0.0))
+    loss = loss + jnp.sum(lobj, axis=(1, 2, 3))
+    return (loss.astype(x.dtype), obj_mask.astype(x.dtype), match)
+
+
+def gru_unit(input, hidden_prev, weight, bias=None, activation=2,
+             gate_activation=1, origin_mode=False):
+    """ref: phi gru_unit (ops.yaml:2348; impl/gru_unit_kernel_impl.h) —
+    one GRU step.  weight is the reference's PACKED layout: the flat
+    buffer is [D, 2D] (update|reset) followed by [D, D] (candidate),
+    regardless of the declared [D, 3D] dims.  Activation codes:
+    0 identity, 1 sigmoid, 2 tanh, 3 relu."""
+    acts = {0: lambda t: t, 1: jax.nn.sigmoid, 2: jnp.tanh,
+            3: jax.nn.relu}
+    act, gate_act = acts[activation], acts[gate_activation]
+    D = hidden_prev.shape[1]
+    wf = weight.reshape(-1)
+    w_g = wf[:2 * D * D].reshape(D, 2 * D)
+    w_c = wf[2 * D * D:3 * D * D].reshape(D, D)
+    g = input + (bias.reshape(1, 3 * D) if bias is not None else 0.0)
+    gu_r = g[:, :2 * D] + hidden_prev @ w_g
+    u = gate_act(gu_r[:, :D])
+    r = gate_act(gu_r[:, D:])
+    reset_hidden_prev = r * hidden_prev
+    c = act(g[:, 2 * D:] + reset_hidden_prev @ w_c)
+    if origin_mode:
+        hidden = c + u * (hidden_prev - c)
+    else:
+        hidden = u * (c - hidden_prev) + hidden_prev
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return (gate.astype(input.dtype),
+            reset_hidden_prev.astype(input.dtype),
+            hidden.astype(input.dtype))
+
+
+# --- chunk_eval (NER chunking metric; impl/chunk_eval_kernel_impl.h) ---
+
+_CHUNK_SCHEMES = {"IOB": (2, 0, 1, -1, -1), "IOE": (2, -1, 0, 1, -1),
+                  "IOBES": (4, 0, 1, 2, 3), "plain": (1, -1, -1, -1, -1)}
+
+
+def _chunk_segments(seq, num_chunk_types, scheme):
+    ntag, tb, ti, te, ts = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+    segs = []
+    in_chunk, start, tag, typ = False, 0, -1, other
+    for i, lab in enumerate(seq):
+        prev_tag, prev_type = tag, typ
+        tag, typ = int(lab) % ntag, int(lab) // ntag
+
+        def chunk_end():
+            if prev_type == other:
+                return False
+            if typ == other or typ != prev_type:
+                return True
+            if prev_tag in (tb, ti) and prev_tag >= 0:
+                return tag in (tb, ts)
+            return prev_tag in (te, ts) and prev_tag >= 0
+
+        def chunk_begin():
+            if prev_type == other:
+                return typ != other
+            if typ == other:
+                return False
+            if typ != prev_type:
+                return True
+            if tag == tb or tag == ts:
+                return tag >= 0
+            if tag in (ti, te) and tag >= 0:
+                return prev_tag in (te, ts) and prev_tag >= 0
+            return False
+
+        if in_chunk and chunk_end():
+            segs.append((start, i - 1, prev_type))
+            in_chunk = False
+        if chunk_begin():
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(seq) - 1, typ))
+    return segs
+
+
+def chunk_eval(inference, label, seq_length=None, num_chunk_types=1,
+               chunk_scheme="IOB", excluded_chunk_types=()):
+    """ref: phi chunk_eval (ops.yaml:5229) — precision/recall/F1 over
+    predicted vs labeled chunks.  Padded batch mode: inference/label
+    [n, t] int64 with per-row seq_length [n] (None -> full rows)."""
+    inf = np.asarray(inference).reshape(np.asarray(inference).shape[0], -1)
+    lab = np.asarray(label).reshape(inf.shape)
+    lens = (np.asarray(seq_length).reshape(-1) if seq_length is not None
+            else np.full((inf.shape[0],), inf.shape[1], np.int64))
+    excl = set(int(e) for e in excluded_chunk_types)
+    n_inf = n_lab = n_cor = 0
+    for i in range(inf.shape[0]):
+        L = int(lens[i])
+        si = [s for s in _chunk_segments(inf[i, :L], num_chunk_types,
+                                         chunk_scheme)
+              if s[2] not in excl]
+        sl = [s for s in _chunk_segments(lab[i, :L], num_chunk_types,
+                                         chunk_scheme)
+              if s[2] not in excl]
+        n_inf += len(si)
+        n_lab += len(sl)
+        n_cor += len(set(si) & set(sl))
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if n_cor else 0.0
+    return (jnp.asarray(p, jnp.float32), jnp.asarray(r, jnp.float32),
+            jnp.asarray(f1, jnp.float32),
+            jnp.asarray(n_inf, jnp.int64), jnp.asarray(n_lab, jnp.int64),
+            jnp.asarray(n_cor, jnp.int64))
+
+
+def im2sequence(x, y=None, kernels=(1, 1), strides=(1, 1),
+                paddings=(0, 0, 0, 0), out_stride=(1, 1)):
+    """ref: phi im2sequence (ops.yaml:2509; impl/im2sequence_kernel_
+    impl.h) — im2col rows: [N*oh*ow, C*kh*kw] (channel-major patch
+    layout, kCFO).  The y/out_stride real-size variant is LoD-output;
+    unsupported (dense surface)."""
+    if y is not None:
+        raise NotImplementedError(
+            "im2sequence with per-image real sizes produces ragged "
+            "(LoD) output; the dense TPU surface supports the fixed-"
+            "shape variant")
+    n, c, H, W = x.shape
+    kh, kw = kernels
+    up, left, down, right = paddings
+    xp = jnp.pad(x, ((0, 0), (0, 0), (up, down), (left, right)))
+    patches = lax.conv_general_dilated_patches(
+        xp, (kh, kw), tuple(strides), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))   # [n, c*kh*kw, oh, ow]
+    oh, ow = patches.shape[2], patches.shape[3]
+    rows = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return rows.astype(x.dtype)
+
+
+def sequence_pool(x, lod=None, is_test=False, pooltype="AVERAGE",
+                  pad_value=0.0):
+    """ref: phi sequence_pool (ops.yaml:4231; cpu/sequence_pool_
+    kernel.cc) — segment pooling over a packed [T, D] stream.  The LoD
+    rides as an explicit ``lod`` offsets vector [n+1] (host) — the
+    dense-surface translation of the reference's LoD tensor input.
+    Returns (out [n, D], max_index [n, D] — argmax rows for MAX, else
+    zeros)."""
+    if lod is None:
+        raise ValueError("sequence_pool needs lod offsets (the packed "
+                         "stream's segment boundaries)")
+    off = np.asarray(lod).reshape(-1).astype(np.int64)
+    nseq = off.size - 1
+    T = x.shape[0]
+    ids = np.searchsorted(off[1:], np.arange(T), side="right")
+    ids_j = jnp.asarray(ids)
+    lens = jnp.asarray((off[1:] - off[:-1]).astype(np.float32))
+    empty = lens == 0
+    D = x.shape[1]
+    xf = x.astype(jnp.float32)
+    if pooltype in ("AVERAGE", "SUM", "SQRT"):
+        s = jax.ops.segment_sum(xf, ids_j, num_segments=nseq)
+        if pooltype == "AVERAGE":
+            out = s / jnp.maximum(lens, 1.0)[:, None]
+        elif pooltype == "SQRT":
+            out = s / jnp.sqrt(jnp.maximum(lens, 1.0))[:, None]
+        else:
+            out = s
+        maxi = jnp.zeros((nseq, D), jnp.int32)
+    elif pooltype in ("MAX", "MIN"):
+        big = jnp.float32(3.4e38)
+        init = -big if pooltype == "MAX" else big
+        seg = jax.ops.segment_max if pooltype == "MAX" else jax.ops.segment_min
+        out = seg(xf, ids_j, num_segments=nseq)
+        out = jnp.where(jnp.isfinite(out), out, init)
+        # argmax row index within the packed stream (reference MaxIndex)
+        eq = xf == out[ids_j]
+        pos = jnp.where(eq, jnp.arange(T)[:, None], T)
+        maxi = jax.ops.segment_min(pos, ids_j,
+                                   num_segments=nseq).astype(jnp.int32)
+    elif pooltype in ("FIRST", "LAST"):
+        idx = np.where(off[:-1] < off[1:],
+                       off[:-1] if pooltype == "FIRST" else off[1:] - 1,
+                       0)
+        out = xf[jnp.asarray(idx)]
+        maxi = jnp.zeros((nseq, D), jnp.int32)
+    else:
+        raise ValueError(f"unknown pooltype {pooltype}")
+    out = jnp.where(empty[:, None], jnp.float32(pad_value), out)
+    return out.astype(x.dtype), maxi
+
+
+def sequence_conv(x, padding_data=None, filter=None, context_length=3,
+                  padding_trainable=False, context_start=0,
+                  context_stride=1, lod=None):
+    """ref: phi sequence_conv (ops.yaml:4208; cpu/sequence_conv_
+    kernel.cc via funcs/context_project.h) — per-sequence context-window
+    projection on a packed [T, D] stream with explicit ``lod`` offsets:
+    row t's context is rows t+context_start .. +context_length-1 of ITS
+    OWN sequence (zeros outside), flattened then @ filter
+    [context_length*D, out]."""
+    if padding_trainable:
+        raise NotImplementedError("trainable context padding is a "
+                                  "PS-era feature; zero padding only")
+    if lod is None:
+        raise ValueError("sequence_conv needs lod offsets")
+    if context_stride != 1:
+        raise NotImplementedError("context_stride > 1 unsupported in the "
+                                  "reference too (ContextProject)")
+    off = np.asarray(lod).reshape(-1).astype(np.int64)
+    T, D = x.shape
+    ids = np.searchsorted(off[1:], np.arange(T), side="right")
+    cols = []
+    xf = x.astype(jnp.float32)
+    for j in range(context_length):
+        s = context_start + j
+        src = np.arange(T) + s
+        ok = (src >= 0) & (src < T)
+        ok &= ids[np.clip(src, 0, T - 1)] == ids
+        srcj = jnp.asarray(np.where(ok, np.clip(src, 0, T - 1), 0))
+        cols.append(jnp.where(jnp.asarray(ok)[:, None], xf[srcj], 0.0))
+    ctx = jnp.concatenate(cols, axis=1)          # [T, ctx*D]
+    return (ctx @ filter.astype(jnp.float32)).astype(x.dtype)
+
+
+def match_matrix_tensor(x, y, w, dim_t=1, x_lod=None, y_lod=None):
+    """ref: phi match_matrix_tensor (ops.yaml:3114;
+    cpu/match_matrix_tensor_kernel.cc) — text-matching gram matrices:
+    tmp = x @ w.reshape(D, dim_t*D); per pair b and channel t:
+    x_b W_t y_b^T flattened in (b, t, row, col) order.  Packed [Tx, D] /
+    [Ty, D] streams with explicit lod offsets."""
+    if x_lod is None or y_lod is None:
+        raise ValueError("match_matrix_tensor needs x_lod / y_lod")
+    offl = np.asarray(x_lod).reshape(-1).astype(np.int64)
+    offr = np.asarray(y_lod).reshape(-1).astype(np.int64)
+    D = x.shape[1]
+    xf, yf, wf = (t.astype(jnp.float32) for t in (x, y, w))
+    tmp = xf @ wf.reshape(D, dim_t * D)          # [Tx, dt*D]
+    pieces = []
+    for b in range(offl.size - 1):
+        xl = tmp[int(offl[b]):int(offl[b + 1])].reshape(-1, dim_t, D)
+        yr = yf[int(offr[b]):int(offr[b + 1])]
+        g = jnp.einsum("ltd,rd->tlr", xl, yr)    # [dt, len_l, len_r]
+        pieces.append(g.reshape(-1))
+    out = (jnp.concatenate(pieces) if pieces else jnp.zeros((0,)))
+    return (out.reshape(-1, 1).astype(x.dtype),
+            tmp.reshape(-1, 1).astype(x.dtype))

@@ -661,3 +661,392 @@ class TestGenerateProposals:
             nms_thresh=0.7, min_size=1.0)
         np.testing.assert_allclose(np.asarray(rois)[0], [4, 4, 12, 12],
                                    atol=1e-5)
+
+
+class TestCorrelation:
+    def test_brute_force_parity(self):
+        """Cost volume vs a direct loop over displacements/windows
+        (gpu/correlation_kernel.cu correlation_forward semantics)."""
+        rng = np.random.default_rng(3)
+        n, c, H, W = 1, 2, 6, 6
+        pad, ksize, md, s1, s2 = 1, 3, 1, 1, 1
+        a = rng.standard_normal((n, c, H, W)).astype(np.float32)
+        b = rng.standard_normal((n, c, H, W)).astype(np.float32)
+        got = np.asarray(_impl.correlation(jnp.asarray(a), jnp.asarray(b),
+                                           pad, ksize, md, s1, s2))
+        krad = (ksize - 1) // 2
+        border = krad + md
+        pH, pW = H + 2 * pad, W + 2 * pad
+        p1 = np.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        p2 = np.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        oh = -(-(pH - 2 * border) // s1)
+        ow = -(-(pW - 2 * border) // s1)
+        D = 2 * (md // s2) + 1
+        want = np.zeros((n, D * D, oh, ow), np.float32)
+        nelems = ksize * ksize * c
+        for d_i, dy in enumerate(range(-(md // s2), md // s2 + 1)):
+            for d_j, dx in enumerate(range(-(md // s2), md // s2 + 1)):
+                for i in range(oh):
+                    for j in range(ow):
+                        h1 = md + i * s1
+                        w1 = md + j * s1
+                        acc = 0.0
+                        for jj in range(-krad, krad + 1):
+                            for ii in range(-krad, krad + 1):
+                                acc += float(np.sum(
+                                    p1[0, :, h1 + jj, w1 + ii]
+                                    * p2[0, :, h1 + dy * s2 + jj,
+                                         w1 + dx * s2 + ii]))
+                        want[0, d_i * D + d_j, i, j] = acc / nelems
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_output_shape_matches_infermeta(self):
+        x = jnp.zeros((2, 3, 16, 16), jnp.float32)
+        out = _impl.correlation(x, x, pad_size=4, kernel_size=1,
+                                max_displacement=4, stride1=1, stride2=2)
+        # CorrelationOutputSize: D = 2*(4//2)+1 = 5 -> 25 channels;
+        # oh = ceil((16+8-2*(0+4))/1) = 16
+        assert out.shape == (2, 25, 16, 16)
+
+
+class TestRankAttention:
+    def test_brute_force_expand_gemm(self):
+        """funcs/rank_attention.cu.h expand_input/expand_param + GEMM,
+        including invalid (rank<=0 / faster<=0) blocks zeroing."""
+        rng = np.random.default_rng(4)
+        ins, fea, mr, pcol = 4, 3, 3, 5
+        x = rng.standard_normal((ins, fea)).astype(np.float32)
+        param = rng.standard_normal((mr * mr * fea, pcol)).astype(np.float32)
+        ro = np.array([[1, 1, 0, 2, 1, 0, 0],
+                       [2, 1, 2, 0, 0, 1, 3],
+                       [0, 0, 0, 0, 0, 0, 0],
+                       [3, 3, 1, 2, 2, 1, 0]], np.int32)
+        ih, out, ins_rank = _impl.rank_attention(
+            jnp.asarray(x), jnp.asarray(ro), jnp.asarray(param), mr)
+        pview = param.reshape(mr * mr, fea, pcol)
+        want = np.zeros((ins, pcol), np.float32)
+        want_ih = np.zeros((ins, mr * fea), np.float32)
+        for i in range(ins):
+            rank = ro[i, 0]
+            for k in range(mr):
+                faster, idx = ro[i, 2 * k + 1], ro[i, 2 * k + 2]
+                if rank <= 0 or faster <= 0:
+                    continue
+                want_ih[i, k * fea:(k + 1) * fea] = x[idx]
+                want += 0  # keep loop explicit
+                want[i] += x[idx] @ pview[(rank - 1) * mr + (faster - 1)]
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ih), want_ih, rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ins_rank).ravel(),
+                                      ro[:, 0].astype(np.float32))
+
+
+class TestBatchFCDpsgd:
+    def test_batch_fc_slot_independence(self):
+        rng = np.random.default_rng(5)
+        inp = rng.standard_normal((3, 4, 5)).astype(np.float32)
+        w = rng.standard_normal((3, 5, 6)).astype(np.float32)
+        b = rng.standard_normal((3, 6)).astype(np.float32)
+        out = np.asarray(_impl.batch_fc(jnp.asarray(inp), jnp.asarray(w),
+                                        jnp.asarray(b)))
+        for s in range(3):
+            np.testing.assert_allclose(out[s], inp[s] @ w[s] + b[s],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_dpsgd_clip_and_noise(self):
+        p = jnp.ones((4,), jnp.float32)
+        g = jnp.full((4,), 2.0, jnp.float32)   # l2 = 4 > clip 1 -> /4
+        lr = jnp.asarray([0.5], jnp.float32)
+        out = np.asarray(_impl.dpsgd(p, g, lr, clip=1.0, batch_size=1.0,
+                                     sigma=0.0, seed=3))
+        np.testing.assert_allclose(out, 1.0 - 0.5 * (2.0 / 4.0), rtol=1e-6)
+        # deterministic under explicit seed, noisy with sigma
+        a = np.asarray(_impl.dpsgd(p, g, lr, sigma=2.0, seed=11))
+        b = np.asarray(_impl.dpsgd(p, g, lr, sigma=2.0, seed=11))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestTDM:
+    TREE = np.array([[0, 0, 0, 0, 0],     # 0: padding
+                     [0, 1, 0, 3, 4],     # 1: root-ish, children 3,4
+                     [0, 1, 0, 5, 0],     # 2: child 5 only
+                     [7, 2, 1, 0, 0],     # 3: item 7 (leaf)
+                     [8, 2, 1, 0, 0],     # 4: item 8 (leaf)
+                     [0, 2, 2, 0, 0]],    # 5: non-item leaf
+                    np.int64)
+
+    def test_tdm_child(self):
+        child, mask = _impl.tdm_child(jnp.asarray([[1], [2], [0]]),
+                                      jnp.asarray(self.TREE), 2)
+        np.testing.assert_array_equal(np.asarray(child),
+                                      [[[3, 4]], [[5, 0]], [[0, 0]]])
+        # node 3/4 are items -> mask 1; node 5 item_id 0 -> 0; padding 0
+        np.testing.assert_array_equal(np.asarray(mask),
+                                      [[[1, 1]], [[0, 0]], [[0, 0]]])
+
+    def test_tdm_sampler_semantics(self):
+        travel = jnp.asarray([1, 3, 2, 5])    # item0 path [1,3]; item1 [2,5]
+        layer = jnp.asarray([1, 2, 3, 4, 5, 6])
+        out, lab, mask = _impl.tdm_sampler(
+            jnp.asarray([0, 1]), travel, layer, output_positive=True,
+            neg_samples_num_list=[1, 1], layer_offset_lod=[0, 2, 6],
+            seed=5)
+        out, lab, mask = (np.asarray(out), np.asarray(lab),
+                          np.asarray(mask))
+        assert out.shape == (2, 4)
+        # positives at slots 0 and 2 with label 1
+        np.testing.assert_array_equal(out[:, 0], [1, 2])
+        np.testing.assert_array_equal(lab[:, 0], [1, 1])
+        np.testing.assert_array_equal(lab[:, 2], [1, 1])
+        # negatives drawn from the right layer and never the positive
+        assert out[0, 1] in (2,) and out[1, 1] in (1,)
+        assert out[0, 3] in (4, 5, 6) and out[0, 3] != 3
+        assert mask.all()
+
+    def test_tdm_sampler_padding_layer(self):
+        travel = jnp.asarray([1, 0])          # second layer is padding
+        layer = jnp.asarray([1, 2, 3, 4])
+        out, lab, mask = _impl.tdm_sampler(
+            jnp.asarray([0]), travel, layer, output_positive=True,
+            neg_samples_num_list=[1, 1], layer_offset_lod=[0, 2, 4],
+            seed=2)
+        np.testing.assert_array_equal(np.asarray(mask)[0, 2:], [0, 0])
+        np.testing.assert_array_equal(np.asarray(out)[0, 2:], [0, 0])
+
+
+class TestYoloBox:
+    def test_head_activations(self):
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((1, 14, 4, 4)).astype(np.float32)
+        out = np.asarray(_impl.yolo_box_head(jnp.asarray(x),
+                                             [10, 13, 16, 30], 2))
+        v = x.reshape(1, 2, 7, 4, 4)
+        o = out.reshape(1, 2, 7, 4, 4)
+        sig = lambda t: 1 / (1 + np.exp(-t))
+        np.testing.assert_allclose(o[:, :, 0], sig(v[:, :, 0]), rtol=1e-5)
+        np.testing.assert_allclose(o[:, :, 2], np.exp(v[:, :, 2]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(o[:, :, 4], sig(v[:, :, 4]), rtol=1e-5)
+        np.testing.assert_allclose(o[:, :, 5:], sig(v[:, :, 5:]),
+                                   rtol=1e-5)
+
+    def test_post_decode_and_nms(self):
+        """Two identical high-conf anchors at one cell -> NMS keeps one
+        live row; geometry follows YoloTensorParseKernel."""
+        C, h = 1, 1
+        a = [8, 8, 8, 8]            # two anchors, biases 8x8
+        inp = np.zeros((1, 2 * (5 + C), h, h), np.float32)
+        for z in range(2):
+            base = z * (5 + C)
+            inp[0, base + 0] = 0.5  # tx
+            inp[0, base + 1] = 0.5  # ty
+            inp[0, base + 2] = 1.0  # tw (already exp'd by head)
+            inp[0, base + 3] = 1.0
+            inp[0, base + 4] = 0.9  # obj
+            inp[0, base + 5] = 0.8  # class prob
+        zero = np.zeros_like(inp)
+        shp = jnp.asarray([[32.0, 32.0]], jnp.float32)
+        scl = jnp.asarray([[1.0, 1.0]], jnp.float32)
+        out, nums = _impl.yolo_box_post(
+            jnp.asarray(inp), jnp.asarray(zero), jnp.asarray(zero),
+            shp, scl, a, a, a, C, 0.5, 32, 16, 8, True, 1.0, 0.45)
+        out, nums = np.asarray(out), np.asarray(nums)
+        assert nums[0] == 2                     # both collected
+        live = out[out[:, 1] > 0]
+        assert len(live) == 1                   # one suppressed by NMS
+        cls, obj, x1, y1, x2, y2 = live[0]
+        # bx = (0.5 + 0)*32/1 = 16; bw = 1*8*32/(32*1) = 8 -> [12, 20]
+        assert cls == 0 and abs(obj - 0.9) < 1e-6
+        np.testing.assert_allclose([x1, y1, x2, y2], [12, 12, 20, 20],
+                                   rtol=1e-5)
+
+
+class TestYoloLoss:
+    def test_constructed_case_parity(self):
+        """Reference-trace parity on a 1-gt case: hand-compute the three
+        loss terms (location + class at the matched cell, objectness
+        everywhere) per cpu/yolo_loss_kernel.cc."""
+        rng = np.random.default_rng(7)
+        n, C, h = 1, 1, 2
+        anchors = [10, 13, 16, 30]
+        amask = [0, 1]
+        x = rng.standard_normal((n, 2 * (5 + C), h, h)).astype(np.float32)
+        gt_box = np.array([[[0.4, 0.4, 0.5, 0.5]]], np.float32)
+        gt_label = np.array([[0]], np.int32)
+        loss, obj_mask, match = _impl.yolo_loss(
+            jnp.asarray(x), jnp.asarray(gt_box), jnp.asarray(gt_label),
+            None, anchors, amask, C, ignore_thresh=0.7,
+            downsample_ratio=32, use_label_smooth=True)
+        loss = float(np.asarray(loss)[0])
+        input_size = 32 * h
+
+        def sig(t):
+            return 1 / (1 + np.exp(-t))
+
+        def bce(l, t):
+            return max(l, 0) - l * t + np.log1p(np.exp(-abs(l)))
+
+        v = x.reshape(2, 5 + C, h, h)
+        # best anchor for gt (0.5, 0.5) wh: anchor wh/input_size
+        ious = []
+        for a in range(2):
+            aw, ah = anchors[2 * a] / input_size, anchors[2 * a + 1] / input_size
+            iw, ih = min(aw, 0.5), min(ah, 0.5)
+            ious.append(iw * ih / (aw * ah + 0.25 - iw * ih))
+        best = int(np.argmax(ious))
+        gi = gj = int(0.4 * h)
+        smooth = min(1.0 / C, 1 / 40)
+        cell = v[best, :, gj, gi]
+        tx = 0.4 * h - gi
+        tw = np.log(0.5 * input_size / anchors[2 * best])
+        th = np.log(0.5 * input_size / anchors[2 * best + 1])
+        sc = 2.0 - 0.25
+        want = sc * (bce(cell[0], tx) + bce(cell[1], tx)
+                     + abs(cell[2] - tw) + abs(cell[3] - th))
+        want += bce(cell[5], 1.0 - smooth)   # matched class, label 0
+        # objectness: positive cell label 1, others 0 unless ignored
+        om = np.asarray(obj_mask)[0]
+        for a in range(2):
+            for yy in range(h):
+                for xx in range(h):
+                    o = om[a, yy, xx]
+                    if o > 1e-5:
+                        want += bce(v[a, 4, yy, xx], 1.0) * o
+                    elif o > -0.5:
+                        want += bce(v[a, 4, yy, xx], 0.0)
+        assert abs(loss - want) < 1e-4
+        assert int(np.asarray(match)[0, 0]) == best
+        # invalid gt (zero wh) would be -1
+        _, _, m2 = _impl.yolo_loss(
+            jnp.asarray(x), jnp.zeros((1, 1, 4), jnp.float32),
+            jnp.asarray(gt_label), None, anchors, amask, C)
+        assert int(np.asarray(m2)[0, 0]) == -1
+
+
+class TestGRUUnit:
+    def test_packed_weight_equations(self):
+        rng = np.random.default_rng(8)
+        B, D = 3, 4
+        x = rng.standard_normal((B, 3 * D)).astype(np.float32)
+        hp = rng.standard_normal((B, D)).astype(np.float32)
+        w = rng.standard_normal((D, 3 * D)).astype(np.float32)
+        b = rng.standard_normal((1, 3 * D)).astype(np.float32)
+        gate, rhp, hidden = _impl.gru_unit(
+            jnp.asarray(x), jnp.asarray(hp), jnp.asarray(w),
+            jnp.asarray(b))
+        wf = w.reshape(-1)
+        wg = wf[:2 * D * D].reshape(D, 2 * D)
+        wc = wf[2 * D * D:].reshape(D, D)
+        g = x + b
+        ur = g[:, :2 * D] + hp @ wg
+        sig = lambda t: 1 / (1 + np.exp(-t))
+        u, r = sig(ur[:, :D]), sig(ur[:, D:])
+        rh = r * hp
+        c = np.tanh(g[:, 2 * D:] + rh @ wc)
+        np.testing.assert_allclose(np.asarray(hidden),
+                                   u * (c - hp) + hp, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rhp), rh, rtol=1e-5,
+                                   atol=1e-6)
+        # origin_mode flips the interpolation
+        _, _, h2 = _impl.gru_unit(jnp.asarray(x), jnp.asarray(hp),
+                                  jnp.asarray(w), jnp.asarray(b),
+                                  origin_mode=True)
+        np.testing.assert_allclose(np.asarray(h2), c + u * (hp - c),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestChunkEval:
+    def test_iob_exact_match(self):
+        # B-ORG I-ORG O B-PER I-PER with 2 chunk types: labels
+        # B-type0=0, I-type0=1, B-type1=2, I-type1=3, O=4
+        seq = [[0, 1, 4, 2, 3]]
+        p, r, f1, ni, nl, nc = _impl.chunk_eval(
+            jnp.asarray(seq, jnp.int64), jnp.asarray(seq, jnp.int64),
+            num_chunk_types=2, chunk_scheme="IOB")
+        assert float(p) == 1.0 and float(r) == 1.0 and float(f1) == 1.0
+        assert int(ni) == 2 and int(nc) == 2
+
+    def test_iob_partial_and_excluded(self):
+        inf = [[0, 1, 4, 2, 3]]
+        lab = [[0, 4, 4, 2, 3]]    # first chunk shorter in label
+        p, r, f1, ni, nl, nc = _impl.chunk_eval(
+            jnp.asarray(inf, jnp.int64), jnp.asarray(lab, jnp.int64),
+            num_chunk_types=2, chunk_scheme="IOB")
+        assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+        # excluding type 1 drops the matching PER chunk
+        p, r, f1, ni, nl, nc = _impl.chunk_eval(
+            jnp.asarray(inf, jnp.int64), jnp.asarray(lab, jnp.int64),
+            num_chunk_types=2, chunk_scheme="IOB",
+            excluded_chunk_types=[1])
+        assert int(nc) == 0 and int(ni) == 1
+
+    def test_seq_length_cuts_padding(self):
+        inf = [[0, 1, 0, 0, 0]]
+        lab = [[0, 1, 0, 0, 0]]
+        _, _, _, ni, _, _ = _impl.chunk_eval(
+            jnp.asarray(inf, jnp.int64), jnp.asarray(lab, jnp.int64),
+            seq_length=jnp.asarray([2], jnp.int64),
+            num_chunk_types=1, chunk_scheme="IOB")
+        assert int(ni) == 1
+
+
+class TestSequenceOpsPacked:
+    def test_sequence_pool_types(self):
+        x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(6, 2))
+        lod = [0, 2, 2, 6]                       # middle segment empty
+        avg, _ = _impl.sequence_pool(x, lod, pooltype="AVERAGE",
+                                     pad_value=-7.0)
+        np.testing.assert_allclose(np.asarray(avg)[0], [1.0, 2.0])
+        np.testing.assert_allclose(np.asarray(avg)[1], [-7.0, -7.0])
+        np.testing.assert_allclose(np.asarray(avg)[2], [7.0, 8.0])
+        mx, mi = _impl.sequence_pool(x, lod, pooltype="MAX")
+        np.testing.assert_allclose(np.asarray(mx)[2], [10.0, 11.0])
+        np.testing.assert_array_equal(np.asarray(mi)[2], [5, 5])
+        sq, _ = _impl.sequence_pool(x, lod, pooltype="SQRT")
+        np.testing.assert_allclose(np.asarray(sq)[0],
+                                   np.asarray([2.0, 4.0]) / np.sqrt(2))
+        first, _ = _impl.sequence_pool(x, lod, pooltype="FIRST")
+        np.testing.assert_allclose(np.asarray(first)[2], [4.0, 5.0])
+        last, _ = _impl.sequence_pool(x, lod, pooltype="LAST")
+        np.testing.assert_allclose(np.asarray(last)[0], [2.0, 3.0])
+
+    def test_sequence_conv_boundaries(self):
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((5, 3)).astype(np.float32)
+        f = rng.standard_normal((9, 2)).astype(np.float32)
+        lod = [0, 2, 5]
+        out = np.asarray(_impl.sequence_conv(
+            jnp.asarray(x), None, jnp.asarray(f), context_length=3,
+            context_start=-1, lod=lod))
+        # row 0 of seq0: context rows [-1, 0, 1] -> [0, x0, x1]
+        ctx = np.concatenate([np.zeros(3, np.float32), x[0], x[1]])
+        np.testing.assert_allclose(out[0], ctx @ f, rtol=1e-5)
+        # row 1 of seq0: [x0, x1, 0] (row 2 belongs to seq1)
+        ctx = np.concatenate([x[0], x[1], np.zeros(3, np.float32)])
+        np.testing.assert_allclose(out[1], ctx @ f, rtol=1e-5)
+
+    def test_im2sequence_rows(self):
+        x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        rows = np.asarray(_impl.im2sequence(x, kernels=(2, 2),
+                                            strides=(2, 2)))
+        assert rows.shape == (4, 4)
+        np.testing.assert_allclose(rows[0], [0, 1, 4, 5])
+        np.testing.assert_allclose(rows[3], [10, 11, 14, 15])
+
+    def test_match_matrix_tensor_brute(self):
+        rng = np.random.default_rng(10)
+        x = rng.standard_normal((4, 3)).astype(np.float32)
+        y = rng.standard_normal((5, 3)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3)).astype(np.float32)
+        out, tmp = _impl.match_matrix_tensor(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), dim_t=2,
+            x_lod=[0, 2, 4], y_lod=[0, 3, 5])
+        out = np.asarray(out).ravel()
+        want = []
+        for b, (xl, xr, yl, yr) in enumerate([(0, 2, 0, 3), (2, 4, 3, 5)]):
+            for t in range(2):
+                g = x[xl:xr] @ w[:, t, :] @ y[yl:yr].T
+                want.extend(g.ravel().tolist())
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
