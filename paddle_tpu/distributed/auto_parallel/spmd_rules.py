@@ -578,6 +578,132 @@ def _flash_unpadded_rule(q: P, k: P = None, v: P = None, cu_q: P = None,
     return (spec, spec, spec, P(), P()), (spec,), {}
 
 
+# ------------------------------------------------------------ round-4 tail
+# (reference files: elementwise.cc zoo, triu.cc, unbind.cc, expand_as.cc,
+#  numel.cc, squared_l2_norm.cc, optimizer.cc, amp_ops.cc,
+#  default_data_parallel.cc, replicated.cc)
+
+for _name in ("maximum", "minimum", "pow", "clip", "silu", "sigmoid",
+              "exp", "log", "sqrt", "rsqrt", "square", "abs", "floor",
+              "ceil", "erf", "leaky_relu", "elu", "hardswish", "equal",
+              "greater_than", "logical_and", "bitwise_and", "isnan",
+              "isinf", "masked_fill", "full_like", "clip_by_norm"):
+    # clip_by_norm: out = x * min(1, c/||x||) — the norm's contraction
+    # collective is GSPMD's job; placement-wise it is pointwise in x.
+    _elementwise_rule_factory(_name)
+
+
+def _band_rule(x: P, **kw):
+    """triu/tril: the band mask is positionally computable per shard
+    (iota + where), so EVERY dim's shard propagates untouched — GSPMD
+    agrees (pinned by test); the reference's triu.cc conservatively
+    replicates the matrix dims, so the curated rule is strictly more
+    permissive here."""
+    return (x,), (x,), {}
+
+
+register_spmd_rule("triu")(_band_rule)
+register_spmd_rule("tril")(_band_rule)
+
+
+@register_spmd_rule("unbind")
+def _unbind_rule(x: P, axis: int = 0, **kw):
+    """unbind.cc: the unbound dim must be replicated; every other dim's
+    shard propagates into each output (which drops that dim)."""
+    xa = list(_axes(x))
+    while len(xa) <= axis:
+        xa.append(None)
+    xa[axis] = None
+    out = tuple(a for i, a in enumerate(xa) if i != axis)
+    return (P(*xa),), (P(*out),), {}
+
+
+@register_spmd_rule("expand_as")
+def _expand_as_rule(x: P, y: P = None, **kw):
+    """expand_as.cc: the output takes the target's placement; broadcast
+    dims of x stay replicated (x's own spec is kept — broadcasting a
+    sharded dim is GSPMD's all-gather to handle)."""
+    return (x, y), (y if y is not None else x,), {}
+
+
+@register_spmd_rule("numel")
+def _numel_rule(x: P, **kw):
+    # shape-only scalar: replicated, no pending partial
+    return (x,), (P(),), {}
+
+
+@register_spmd_rule("squared_l2_norm")
+def _squared_l2_norm_rule(x: P, **kw):
+    """squared_l2_norm.cc: any input sharding is fine; the scalar output
+    carries a pending partial-sum over every mesh axis x is sharded on
+    (the grad-clip global-norm building block)."""
+    partial = tuple(a for a in _axes(x) if a is not None)
+    return (x,), (P(),), {"partial_axes": partial}
+
+
+def _optimizer_rule_factory(op_name, param_like, scalar_like, out_pattern):
+    """optimizer.cc: every param-shaped state (grad, moments, velocity,
+    master weights) is aligned to the PARAM's placement — the ZeRO
+    invariant that optimizer state shards with its parameter; scalar
+    state (lr, beta pows) is replicated.  ``param_like``/``scalar_like``
+    index the op's tensor arguments; ``out_pattern`` mirrors the op's
+    ACTUAL outputs ('p' = param-placed, 's' = replicated scalar)."""
+
+    @register_spmd_rule(op_name)
+    def rule(*specs: P, **kw):
+        param = specs[0]
+        ins = tuple(
+            param if i in param_like else (P() if i in scalar_like else s)
+            for i, s in enumerate(specs))
+        outs = tuple(param if o == "p" else P() for o in out_pattern)
+        return ins, outs, {}
+
+    return rule
+
+
+# out patterns mirror each op's real returns: sgd_ -> param_out;
+# momentum_ -> (param_out, velocity_out); adam_/adamw_ ->
+# (param_out, moment1, moment2, beta1_pow, beta2_pow)
+_optimizer_rule_factory("sgd_", param_like=(0, 2), scalar_like=(1,),
+                        out_pattern="p")
+_optimizer_rule_factory("momentum_", param_like=(0, 1, 2),
+                        scalar_like=(3,), out_pattern="pp")
+_optimizer_rule_factory("adam_", param_like=(0, 1, 2, 3),
+                        scalar_like=(4, 5, 6), out_pattern="pppss")
+_optimizer_rule_factory("adamw_", param_like=(0, 1, 2, 3),
+                        scalar_like=(4, 5, 6), out_pattern="pppss")
+
+
+@register_spmd_rule("check_finite_and_unscale_")
+def _check_finite_rule(*specs: P, **kw):
+    """amp_ops.cc: each grad keeps its own placement (unscale is
+    pointwise); the found_inf scalar is replicated — its any-reduction
+    over shards is the compiler's collective."""
+    grads, scale = specs[:-1], specs[-1]
+    return grads + (P(),), grads + (P(),), {}
+
+
+@register_spmd_rule("update_loss_scaling_")
+def _update_loss_scaling_rule(*specs: P, **kw):
+    grads = specs[:1 if len(specs) <= 1 else len(specs) - 4]
+    rest = tuple(P() for _ in specs[len(grads):])
+    return grads + rest, grads + (P(), P(), P()), {}
+
+
+def infer_default_data_parallel(*specs: P, mesh_axis: str = "x"):
+    """default_data_parallel.cc: the fallback strategy when no rule
+    matches — shard every tensor's dim-0 (the batch dim) on the data
+    axis, everything else replicated."""
+    ins = tuple(P(mesh_axis) for _ in specs)
+    return ins, ins, {}
+
+
+def infer_replicated(*specs: P):
+    """replicated.cc: the always-correct fallback — replicate all."""
+    ins = tuple(P() for _ in specs)
+    return ins, ins, {}
+
+
 # ---------------------------------------------------------------- shard_op
 
 def shard_op(op_name: str, mesh, *in_tensors, rule_kwargs=None, **op_kwargs):

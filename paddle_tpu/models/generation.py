@@ -320,6 +320,24 @@ def _beam_search_jit(params, ids, cfg_id, max_new_tokens, num_beams,
 _CFGS = {}
 
 
+def register_config(cfg):
+    """Key the compiled decode programs + rope tables on the config
+    VALUES, so equal configs across model instances (and external
+    callers like bench.py driving ``_generate_jit`` with their own
+    param dict) share one compilation.  Returns the hashable cfg id."""
+    import dataclasses
+
+    cfg_key = tuple(sorted(dataclasses.asdict(cfg).items()))
+    if cfg_key not in _CFGS:
+        from .llama import _rope_tables
+
+        cos_tab, sin_tab = _rope_tables(cfg.head_dim,
+                                        cfg.max_position_embeddings,
+                                        cfg.rope_theta)
+        _CFGS[cfg_key] = (cfg, cos_tab, sin_tab)
+    return cfg_key
+
+
 def generate(model, input_ids, max_new_tokens: int = 32,
              do_sample: bool = False, temperature: float = 1.0,
              top_k: int = 0, top_p: float = 1.0, seed: int = 0,
@@ -332,8 +350,6 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     best beam, scored as logp / len**length_penalty). After an EOS is
     produced, a sequence keeps emitting ``eos_token_id``."""
     from ..core.tensor import Tensor
-
-    import dataclasses
 
     ids = input_ids._value if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
@@ -350,16 +366,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
             f"({cfg.max_position_embeddings}); rope phases past the table "
             f"would silently repeat")
     params = {k: v for k, v in model.functional_state().items()}
-    # key the compiled program + rope tables on the config VALUES, so equal
-    # configs across model instances share one compilation
-    cfg_key = tuple(sorted(dataclasses.asdict(cfg).items()))
-    if cfg_key not in _CFGS:
-        from .llama import _rope_tables
-
-        cos_tab, sin_tab = _rope_tables(cfg.head_dim,
-                                        cfg.max_position_embeddings,
-                                        cfg.rope_theta)
-        _CFGS[cfg_key] = (cfg, cos_tab, sin_tab)
+    cfg_key = register_config(cfg)
     eos = -1 if eos_token_id is None else int(eos_token_id)
     if num_beams > 1:
         if do_sample:

@@ -3874,3 +3874,267 @@ def match_matrix_tensor(x, y, w, dim_t=1, x_lod=None, y_lod=None):
     out = (jnp.concatenate(pieces) if pieces else jnp.zeros((0,)))
     return (out.reshape(-1, 1).astype(x.dtype),
             tmp.reshape(-1, 1).astype(x.dtype))
+
+
+def detection_map(detect_res, label, has_state=None, pos_count=None,
+                  true_pos=None, false_pos=None, class_num=None,
+                  background_label=0, overlap_threshold=0.5,
+                  evaluate_difficult=True, ap_type="integral",
+                  detect_lod=None, label_lod=None, true_pos_lod=None,
+                  false_pos_lod=None):
+    """ref: phi detection_map (ops.yaml:1330; cpu/detection_map_
+    kernel.cc) — VOC mAP with greedy per-class gt matching.
+    detect_res [M, 6] rows (label, score, x1, y1, x2, y2); label rows
+    (label, difficult, x1..y2) when width 6 else (label, x1..y2).
+    Per-image boundaries ride as explicit ``detect_lod`` / ``label_lod``
+    offset vectors (default: one image).  Optional prior state
+    (pos_count [C,1], true/false_pos [k,2] + per-class lods) merges in —
+    the streaming-evaluation contract.  Returns (accum_pos_count
+    [C, 1] int32, accum_true_pos [sum, 2], accum_false_pos [sum, 2],
+    m_ap scalar); the accumulated tp/fp rows are grouped by class id."""
+    det = np.asarray(detect_res, np.float64)
+    lab = np.asarray(label, np.float64)
+    dlod = (np.asarray(detect_lod, np.int64) if detect_lod is not None
+            else np.asarray([0, det.shape[0]]))
+    llod = (np.asarray(label_lod, np.int64) if label_lod is not None
+            else np.asarray([0, lab.shape[0]]))
+    C = int(class_num)
+
+    def _clip(b):
+        return np.clip(b, 0.0, 1.0)
+
+    def _iou(a, b):
+        if (b[0] > a[2] or b[2] < a[0] or b[1] > a[3] or b[3] < a[1]):
+            return 0.0
+        ix = min(a[2], b[2]) - max(a[0], b[0])
+        iy = min(a[3], b[3]) - max(a[1], b[1])
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    label_pos = {}
+    tp, fp = {}, {}
+    # merge prior accumulation state
+    if pos_count is not None:
+        pc = np.asarray(pos_count).reshape(-1)
+        for c in range(min(C, pc.size)):
+            if pc[c]:
+                label_pos[c] = int(pc[c])
+    for state, state_lod, acc in ((true_pos, true_pos_lod, tp),
+                                  (false_pos, false_pos_lod, fp)):
+        if state is None:
+            continue
+        if state_lod is None:
+            raise ValueError(
+                "detection_map: merging prior true_pos/false_pos state "
+                "requires its per-class lod offsets "
+                "(true_pos_lod/false_pos_lod)")
+        st = np.asarray(state, np.float64).reshape(-1, 2)
+        slod = np.asarray(state_lod, np.int64)
+        for c in range(C):
+            rows = st[slod[c]:slod[c + 1]]
+            for s, k in rows:
+                acc.setdefault(c, []).append((float(s), int(k)))
+
+    n_img = dlod.size - 1
+    for n in range(n_img):
+        # gt boxes per class for this image
+        gts = {}
+        for i in range(llod[n], llod[n + 1]):
+            row = lab[i]
+            c = int(row[0])
+            if lab.shape[1] == 6:
+                box, diff = row[2:6], bool(abs(row[1]) > 1e-6)
+            else:
+                box, diff = row[1:5], False
+            gts.setdefault(c, []).append((box, diff))
+        for c, boxes in gts.items():
+            cnt = (len(boxes) if evaluate_difficult
+                   else sum(1 for _, d in boxes if not d))
+            if cnt:
+                label_pos[c] = label_pos.get(c, 0) + cnt
+        dets = {}
+        for i in range(dlod[n], dlod[n + 1]):
+            row = det[i]
+            dets.setdefault(int(row[0]), []).append(
+                (float(row[1]), row[2:6]))
+        for c, preds in dets.items():
+            if c not in gts:
+                for s, _ in preds:
+                    tp.setdefault(c, []).append((s, 0))
+                    fp.setdefault(c, []).append((s, 1))
+                continue
+            boxes = gts[c]
+            visited = [False] * len(boxes)
+            preds = sorted(preds, key=lambda p: -p[0])
+            for s, pb in preds:
+                pb = _clip(pb)
+                ovs = [_iou(pb, b) for b, _ in boxes]
+                mi = int(np.argmax(ovs)) if ovs else 0
+                if ovs and ovs[mi] > overlap_threshold:
+                    if evaluate_difficult or not boxes[mi][1]:
+                        if not visited[mi]:
+                            tp.setdefault(c, []).append((s, 1))
+                            fp.setdefault(c, []).append((s, 0))
+                            visited[mi] = True
+                        else:
+                            tp.setdefault(c, []).append((s, 0))
+                            fp.setdefault(c, []).append((s, 1))
+                else:
+                    tp.setdefault(c, []).append((s, 0))
+                    fp.setdefault(c, []).append((s, 1))
+
+    # mAP over classes with positives (reference CalcMAP, incl. its
+    # literal label_num_pos == background_label skip)
+    mAP, count = 0.0, 0
+    for c, npos in sorted(label_pos.items()):
+        if npos == background_label:
+            continue
+        if c not in tp:
+            count += 1
+            continue
+        tps = sorted(tp[c], key=lambda p: -p[0])
+        fps = sorted(fp[c], key=lambda p: -p[0])
+        tp_sum = np.cumsum([k for _, k in tps])
+        fp_sum = np.cumsum([k for _, k in fps])
+        prec = tp_sum / np.maximum(tp_sum + fp_sum, 1e-12)
+        rec = tp_sum / npos
+        if ap_type == "11point":
+            maxp = np.zeros(11)
+            start = len(rec) - 1
+            for j in range(10, -1, -1):
+                for i in range(start, -1, -1):
+                    if rec[i] < j / 10.0:
+                        start = i
+                        if j > 0:
+                            maxp[j - 1] = maxp[j]
+                        break
+                    if maxp[j] < prec[i]:
+                        maxp[j] = prec[i]
+            mAP += maxp.sum() / 11
+            count += 1
+        elif ap_type == "integral":
+            ap, prev = 0.0, 0.0
+            for p, r in zip(prec, rec):
+                if abs(r - prev) > 1e-6:
+                    ap += p * abs(r - prev)
+                prev = r
+            mAP += ap
+            count += 1
+        else:
+            raise ValueError(f"unknown ap_type {ap_type!r}")
+    if count:
+        mAP /= count
+
+    out_pc = np.zeros((C, 1), np.int32)
+    for c, npos in label_pos.items():
+        if 0 <= c < C:
+            out_pc[c, 0] = npos
+    tp_rows, fp_rows = [], []
+    for c in range(C):
+        tp_rows += tp.get(c, [])
+        fp_rows += fp.get(c, [])
+    out_tp = (np.asarray(tp_rows, np.float32).reshape(-1, 2))
+    out_fp = (np.asarray(fp_rows, np.float32).reshape(-1, 2))
+    return (jnp.asarray(out_pc), jnp.asarray(out_tp),
+            jnp.asarray(out_fp), jnp.asarray(mAP, jnp.float32))
+
+
+def _rnn_scan(mode, xt, h0, c0, w_ih, w_hh, b_ih, b_hh, lens=None,
+              reverse=False):
+    """One (layer, direction) pass over TIME-MAJOR xt [T, B, I] with
+    optional per-sequence lengths: steps past a sequence's length freeze
+    the state and zero the output (cudnn semantics); the reverse
+    direction runs over the length-aware reversed sequence."""
+    from ...nn.rnn import _cell_step
+
+    T, B, _ = xt.shape
+    if reverse:
+        if lens is None:
+            xt = xt[::-1]
+        else:
+            # per-batch reversal within the valid prefix; padding stays
+            idx = lens[None, :] - 1 - jnp.arange(T)[:, None]   # [T, B]
+            idx = jnp.where(idx >= 0, idx, jnp.arange(T)[:, None])
+            xt = jnp.take_along_axis(xt, idx[:, :, None], axis=0)
+
+    def step(carry, inp):
+        h, c = carry
+        x_t, t = inp
+        h2, c2 = _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+        if lens is not None:
+            m = (t < lens)[:, None]
+            h2 = jnp.where(m, h2, h)
+            c2 = jnp.where(m, c2, c)
+            out = jnp.where(m, h2, 0.0)
+        else:
+            out = h2
+        return (h2, c2), out
+
+    (hT, cT), outs = lax.scan(step, (h0, c0),
+                              (xt, jnp.arange(T, dtype=jnp.int32)))
+    if reverse:
+        if lens is None:
+            outs = outs[::-1]
+        else:
+            idx = lens[None, :] - 1 - jnp.arange(T)[:, None]
+            idx = jnp.where(idx >= 0, idx, jnp.arange(T)[:, None])
+            outs = jnp.take_along_axis(outs, idx[:, :, None], axis=0)
+            outs = jnp.where((jnp.arange(T)[:, None] < lens[None, :])[..., None],
+                             outs, 0.0)
+    return outs, hT, cT
+
+
+def rnn(x, pre_state, weight_list, sequence_length=None,
+        dropout_state_in=None, dropout_prob=0.0, is_bidirec=False,
+        input_size=10, hidden_size=100, num_layers=1, mode="RNN_TANH",
+        seed=0, is_test=False):
+    """ref: phi rnn (ops.yaml:4002; cpu/rnn_kernel.cc — the dense
+    cudnn-style recurrent mega-op behind nn.LSTM/GRU/SimpleRNN).
+    x [T, B, I] time-major; pre_state [h] (+ [c] for LSTM) each
+    [L*D, B, H]; weight_list in the cudnn flatten_parameters order —
+    all (w_ih, w_hh) pairs per (layer, direction) first, then all
+    (b_ih, b_hh) pairs (python/paddle/nn/layer/rnn.py:1619).  Optional
+    sequence_length freezes state and zeros outputs past each row's
+    length.  Returns (out [T, B, D*H], dropout_state_out, [h_n(, c_n)],
+    reserve)."""
+    D = 2 if is_bidirec else 1
+    L = num_layers
+    nw = 2 * L * D
+    ws = list(weight_list)
+    lens = (sequence_length.astype(jnp.int32)
+            if sequence_length is not None else None)
+    h0 = pre_state[0]
+    c0 = pre_state[1] if len(pre_state) > 1 else jnp.zeros_like(h0)
+    cur = x.astype(jnp.float32)
+    h_outs, c_outs = [], []
+    for layer in range(L):
+        dir_outs = []
+        for d in range(D):
+            k = layer * D + d
+            w_ih, w_hh = ws[2 * k], ws[2 * k + 1]
+            b_ih, b_hh = ws[nw + 2 * k], ws[nw + 2 * k + 1]
+            outs, hT, cT = _rnn_scan(
+                mode, cur, h0[k].astype(jnp.float32),
+                c0[k].astype(jnp.float32), w_ih, w_hh, b_ih, b_hh,
+                lens=lens, reverse=bool(d))
+            dir_outs.append(outs)
+            h_outs.append(hT)
+            c_outs.append(cT)
+        cur = (jnp.concatenate(dir_outs, axis=-1) if D == 2
+               else dir_outs[0])
+        if dropout_prob and not is_test and layer < L - 1:
+            key = jax.random.PRNGKey(seed) if seed else _key()
+            keep = jax.random.bernoulli(jax.random.fold_in(key, layer),
+                                        1.0 - dropout_prob, cur.shape)
+            cur = jnp.where(keep, cur / (1.0 - dropout_prob), 0.0)
+    out = cur.astype(x.dtype)
+    h_n = jnp.stack(h_outs, axis=0).astype(x.dtype)
+    state = [h_n]
+    if mode == "LSTM":
+        state.append(jnp.stack(c_outs, axis=0).astype(x.dtype))
+    drop_state = (dropout_state_in if dropout_state_in is not None
+                  else jnp.zeros((0,), jnp.uint8))
+    reserve = jnp.zeros((0,), x.dtype)
+    return out, drop_state, state, reserve

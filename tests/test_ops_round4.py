@@ -1050,3 +1050,149 @@ class TestSequenceOpsPacked:
                 g = x[xl:xr] @ w[:, t, :] @ y[yl:yr].T
                 want.extend(g.ravel().tolist())
         np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+class TestDetectionMap:
+    def test_perfect_detection(self):
+        det = jnp.asarray([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                           [1, 0.8, 0.5, 0.5, 0.8, 0.8]], jnp.float32)
+        lab = jnp.asarray([[0, 0, 0.1, 0.1, 0.4, 0.4],
+                           [1, 0, 0.5, 0.5, 0.8, 0.8]], jnp.float32)
+        pc, tp, fp, m = _impl.detection_map(det, lab, class_num=2)
+        assert float(m) == 1.0
+        np.testing.assert_array_equal(np.asarray(pc).ravel(), [1, 1])
+
+    def test_false_positive_lowers_map(self):
+        det = jnp.asarray([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                           [0, 0.8, 0.6, 0.6, 0.9, 0.9]], jnp.float32)
+        lab = jnp.asarray([[0, 0, 0.1, 0.1, 0.4, 0.4]], jnp.float32)
+        _, _, _, m = _impl.detection_map(det, lab, class_num=1)
+        # tp at rank1 (p=1, r=1), fp at rank2: integral AP = 1.0
+        assert abs(float(m) - 1.0) < 1e-6
+        # flip the scores: fp outranks tp -> AP = 0.5
+        det2 = jnp.asarray([[0, 0.8, 0.1, 0.1, 0.4, 0.4],
+                            [0, 0.9, 0.6, 0.6, 0.9, 0.9]], jnp.float32)
+        _, _, _, m2 = _impl.detection_map(det2, lab, class_num=1)
+        assert abs(float(m2) - 0.5) < 1e-6
+
+    def test_difficult_skipped_when_not_evaluated(self):
+        det = jnp.asarray([[0, 0.9, 0.1, 0.1, 0.4, 0.4]], jnp.float32)
+        lab = jnp.asarray([[0, 1, 0.1, 0.1, 0.4, 0.4],
+                           [0, 0, 0.5, 0.5, 0.8, 0.8]], jnp.float32)
+        pc, tp, fp, m = _impl.detection_map(det, lab, class_num=1,
+                                            evaluate_difficult=False)
+        # difficult gt not counted as positive; the matched-difficult
+        # detection is dropped from tp/fp entirely
+        np.testing.assert_array_equal(np.asarray(pc).ravel(), [1])
+        assert np.asarray(tp).shape[0] == 0
+        assert float(m) == 0.0
+
+    def test_11point(self):
+        det = jnp.asarray([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                           [0, 0.8, 0.6, 0.6, 0.9, 0.9]], jnp.float32)
+        lab = jnp.asarray([[0, 0, 0.1, 0.1, 0.4, 0.4],
+                           [0, 0, 0.6, 0.6, 0.9, 0.9]], jnp.float32)
+        _, _, _, m = _impl.detection_map(det, lab, class_num=1,
+                                         ap_type="11point")
+        assert abs(float(m) - 1.0) < 1e-6
+
+    def test_state_merge_accumulates(self):
+        det = jnp.asarray([[0, 0.9, 0.1, 0.1, 0.4, 0.4]], jnp.float32)
+        lab = jnp.asarray([[0, 0, 0.1, 0.1, 0.4, 0.4]], jnp.float32)
+        pc1, tp1, fp1, _ = _impl.detection_map(det, lab, class_num=1)
+        # feed the state back with a second identical image
+        pc2, tp2, fp2, m = _impl.detection_map(
+            det, lab, pos_count=pc1, true_pos=tp1, false_pos=fp1,
+            true_pos_lod=[0, np.asarray(tp1).shape[0]],
+            false_pos_lod=[0, np.asarray(fp1).shape[0]], class_num=1)
+        np.testing.assert_array_equal(np.asarray(pc2).ravel(), [2])
+        assert np.asarray(tp2).shape[0] == 2
+        assert float(m) == 1.0
+
+
+class TestRnnMegaOp:
+    def _weights(self, rng, mode, in_sz, h, layers=1, D=1):
+        m = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1}[mode]
+        ws, bs = [], []
+        for layer in range(layers):
+            isz = in_sz if layer == 0 else h * D
+            for _ in range(D):
+                ws += [rng.standard_normal((m * h, isz)).astype(np.float32),
+                       rng.standard_normal((m * h, h)).astype(np.float32)]
+                bs += [rng.standard_normal((m * h,)).astype(np.float32),
+                       rng.standard_normal((m * h,)).astype(np.float32)]
+        return [jnp.asarray(w) for w in ws + bs]
+
+    def test_lstm_matches_layer_stack(self):
+        """The mega-op == the nn-layer scan (rnn_layer op) with the same
+        weights — the cudnn weight_list order maps onto the per-layer
+        params."""
+        from paddle_tpu.nn.rnn import _rnn_layer_op
+
+        rng = np.random.default_rng(11)
+        T, B, I, H = 5, 2, 4, 3
+        x = rng.standard_normal((T, B, I)).astype(np.float32)
+        wl = self._weights(rng, "LSTM", I, H)
+        h0 = np.zeros((1, B, H), np.float32)
+        out, _, state, _ = _impl.rnn(
+            jnp.asarray(x), [jnp.asarray(h0), jnp.asarray(h0)], wl,
+            mode="LSTM", num_layers=1, hidden_size=H, input_size=I)
+        want, hT, cT = _rnn_layer_op(
+            jnp.asarray(x).swapaxes(0, 1), jnp.asarray(h0[0]),
+            jnp.asarray(h0[0]), *wl, mode="LSTM")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(want).swapaxes(0, 1),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(state[0][0]),
+                                   np.asarray(hT), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(state[1][0]),
+                                   np.asarray(cT), rtol=1e-5, atol=1e-6)
+
+    def test_sequence_length_freezes_and_zeroes(self):
+        rng = np.random.default_rng(12)
+        T, B, I, H = 6, 2, 3, 4
+        x = rng.standard_normal((T, B, I)).astype(np.float32)
+        wl = self._weights(rng, "GRU", I, H)
+        h0 = np.zeros((1, B, H), np.float32)
+        lens = jnp.asarray([6, 3], jnp.int32)
+        out, _, state, _ = _impl.rnn(
+            jnp.asarray(x), [jnp.asarray(h0)], wl,
+            sequence_length=lens, mode="GRU", num_layers=1,
+            hidden_size=H, input_size=I)
+        out = np.asarray(out)
+        # padded steps of row 1 are zero
+        assert np.allclose(out[3:, 1], 0.0)
+        assert not np.allclose(out[3:, 0], 0.0)
+        # final state of row 1 == output at its last valid step
+        np.testing.assert_allclose(np.asarray(state[0])[0, 1], out[2, 1],
+                                   rtol=1e-6)
+        # and equals a run truncated to 3 steps
+        out3, _, st3, _ = _impl.rnn(
+            jnp.asarray(x[:3]), [jnp.asarray(h0)], wl, mode="GRU",
+            num_layers=1, hidden_size=H, input_size=I)
+        np.testing.assert_allclose(np.asarray(st3[0])[0, 1],
+                                   np.asarray(state[0])[0, 1], rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_bidirectional_reverse_respects_lengths(self):
+        rng = np.random.default_rng(13)
+        T, B, I, H = 4, 2, 3, 2
+        x = rng.standard_normal((T, B, I)).astype(np.float32)
+        wl = self._weights(rng, "RNN_TANH", I, H, D=2)
+        h0 = np.zeros((2, B, H), np.float32)
+        lens = jnp.asarray([4, 2], jnp.int32)
+        out, _, _, _ = _impl.rnn(
+            jnp.asarray(x), [jnp.asarray(h0)], wl,
+            sequence_length=lens, mode="RNN_TANH", num_layers=1,
+            is_bidirec=True, hidden_size=H, input_size=I)
+        out = np.asarray(out)
+        assert out.shape == (T, B, 2 * H)
+        assert np.allclose(out[2:, 1], 0.0)
+        # row 1's reverse channel at t=0 must equal a plain 2-step
+        # reverse run on the truncated sequence
+        out2, _, _, _ = _impl.rnn(
+            jnp.asarray(x[:2]), [jnp.asarray(h0)], wl,
+            mode="RNN_TANH", num_layers=1, is_bidirec=True,
+            hidden_size=H, input_size=I)
+        np.testing.assert_allclose(out[:2, 1], np.asarray(out2)[:, 1],
+                                   rtol=1e-5, atol=1e-6)

@@ -391,3 +391,118 @@ def test_flashmask_attention_rule_diverges_from_gspmd():
 def test_rule_count_target():
     """Round-4 target: the curated library covers ~60 rules."""
     assert len(SR._RULES) >= 60, len(SR._RULES)
+
+
+# ---------------- round-4 tail rules (elementwise zoo, bands, optimizer,
+# amp, fallbacks) ----------------
+
+@pytest.mark.parametrize("op", ["sigmoid", "exp", "sqrt", "abs", "silu"])
+def test_elementwise_zoo_unary(op):
+    _check(op, [_arr(8, 16)], [P("x", "y")])
+
+
+def test_elementwise_zoo_binary():
+    _check("maximum", [_arr(8, 16), _arr(8, 16)], [P("x", None), None])
+
+
+def test_masked_fill_alignment():
+    mask = jnp.asarray(np.random.RandomState(0).rand(8, 16) > 0.5)
+    _check("masked_fill", [_arr(8, 16), mask],
+           [P("x", "y"), P("x", "y")], op_kwargs={"value": 0.0})
+
+
+@pytest.mark.parametrize("op", ["triu", "tril"])
+def test_band_ops_keep_matrix_shards(op):
+    """Divergence from the reference's conservative triu.cc (which
+    replicates matrix dims): the band mask is iota-computable per shard,
+    so both matrix dims keep their placement — and GSPMD agrees."""
+    _check(op, [_arr(8, 16)], [P("x", "y")])
+
+
+def test_unbind_replicates_axis():
+    ins, outs, _ = SR.infer_forward("unbind", P("x", "y"), axis=0)
+    # the unbound axis-0 shard is dropped; the remaining dim keeps y
+    assert tuple(ins[0]) == (None, "y")
+    assert tuple(outs[0]) == ("y",)
+    got = _run("unbind", [_arr(4, 8)], [P(None, "y")], out_index=0)
+    assert got == ("y",)
+
+
+def test_expand_as_takes_target_spec():
+    """DOCUMENTED DIVERGENCE: the curated rule places the output on the
+    TARGET's spec (reference expand_as.cc); GSPMD propagates from the
+    broadcast source and leaves the expanded dim unsharded.  The rule is
+    load-bearing here — shard_op applies it as the override."""
+    ins, outs, _ = SR.infer_forward("expand_as", P(None, "y"), P("x", "y"))
+    assert tuple(outs[0]) == ("x", "y")
+    got = _run("expand_as", [_arr(1, 8), _arr(4, 8)],
+               [P(None, "y"), P("x", "y")])
+    assert got in ((), (None, "y")), got  # GSPMD's weaker choice
+
+
+def test_numel_replicated_scalar():
+    ins, outs, meta = SR.infer_forward("numel", P("x", "y"))
+    assert _norm(outs[0]) == () and not meta.get("partial_axes")
+
+
+def test_squared_l2_norm_partial():
+    ins, outs, meta = SR.infer_forward("squared_l2_norm", P("x", "y"))
+    assert _norm(outs[0]) == ()
+    assert set(meta["partial_axes"]) == {"x", "y"}
+    # GSPMD: the compiled scalar is fully replicated (partial resolved
+    # by its inserted collective) — the VALUE must equal the local sum
+    mesh = _mesh()
+    x = _arr(8, 16)
+    placed = jax.device_put(x, NamedSharding(mesh, P("x", "y")))
+    out = jax.jit(get_op("squared_l2_norm").fn)(placed)
+    np.testing.assert_allclose(np.asarray(out), float(np.sum(np.asarray(x) ** 2)),
+                               rtol=1e-5)
+
+
+def test_adam_aligns_state_to_param():
+    """optimizer.cc invariant: moments/grad follow the param placement;
+    scalars replicated.  Run the real fused adam_ op under jit with the
+    resolved placements and check the param_out sharding."""
+    p, g, m1, m2 = _arr(8, 16), _arr(8, 16), _arr(8, 16), _arr(8, 16)
+    b1 = jnp.ones((1,), jnp.float32)
+    b2 = jnp.ones((1,), jnp.float32)
+    lr = jnp.asarray([0.1], jnp.float32)
+    ins, outs, _ = SR.infer_forward(
+        "adam_", P("x", "y"), None, P(None, "y"), None, None, None, None)
+    assert all(tuple(s) == ("x", "y") for s in ins[:4])
+    assert all(_norm(s) == () for s in ins[4:])
+    mesh = _mesh()
+    placed = [jax.device_put(a, NamedSharding(mesh, s))
+              for a, s in zip([p, g, m1, m2], ins[:4])]
+    out = jax.jit(get_op("adam_").fn)(*placed, b1, b2, lr)
+    assert _norm(out[0].sharding.spec) == ("x", "y")
+
+
+def test_check_finite_and_unscale_keeps_grad_specs():
+    ins, outs, _ = SR.infer_forward("check_finite_and_unscale_",
+                                    P("x", None), P(None, "y"), None)
+    assert tuple(ins[0]) == ("x", None) and tuple(ins[1]) == (None, "y")
+    assert _norm(ins[-1]) == () and _norm(outs[-1]) == ()
+    mesh = _mesh()
+    g1 = jax.device_put(_arr(8, 8), NamedSharding(mesh, P("x", None)))
+    g2 = jax.device_put(_arr(8, 8), NamedSharding(mesh, P(None, "y")))
+    scale = jnp.asarray([2.0], jnp.float32)
+    # DOCUMENTED DIVERGENCE: GSPMD replicates the unscaled grads (the
+    # found_inf any-reduction couples all shards); the curated rule keeps
+    # per-grad placements — shard_op enforces it on the dist path.
+    outs_v = jax.jit(get_op("check_finite_and_unscale_").fn)([g1, g2], scale)
+    unscaled = outs_v[0]
+    assert _norm(unscaled[0].sharding.spec) in ((), ("x",))
+
+
+def test_fallback_strategies():
+    ins, outs, _ = SR.infer_default_data_parallel(None, None, mesh_axis="x")
+    assert all(tuple(s) == ("x",) for s in ins)
+    ins, outs, _ = SR.infer_replicated(P("x"), P("y"))
+    assert all(_norm(s) == () for s in ins)
+
+
+def test_rule_count_floor():
+    """Round-4 bar: the curated library keeps growing toward the
+    reference's 101 files (VERDICT r3 missing#3)."""
+    assert len(SR._RULES) >= 90, len(SR._RULES)
