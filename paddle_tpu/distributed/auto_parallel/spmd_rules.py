@@ -609,8 +609,11 @@ register_spmd_rule("tril")(_band_rule)
 @register_spmd_rule("unbind")
 def _unbind_rule(x: P, axis: int = 0, **kw):
     """unbind.cc: the unbound dim must be replicated; every other dim's
-    shard propagates into each output (which drops that dim)."""
+    shard propagates into each output (which drops that dim).  The spec
+    is taken as full-rank for negative-axis normalisation."""
     xa = list(_axes(x))
+    if axis < 0:
+        axis += len(xa)
     while len(xa) <= axis:
         xa.append(None)
     xa[axis] = None

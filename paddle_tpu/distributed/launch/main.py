@@ -101,7 +101,9 @@ def _run_gang(args, world: int, nproc: int, endpoints: List[str],
     shutdown_flag["kill"] = _kill_workers
     try:
         while True:
-            if shutdown_flag["requested"]:
+            if shutdown_flag["requested"] or shutdown_flag.get("scale_up"):
+                # shutdown, or an elastic JOIN preempting this generation
+                # for a re-rendezvous at a larger world
                 _kill_workers()
                 break
             done = [p.poll() for p in procs]
@@ -122,6 +124,20 @@ def _run_gang(args, world: int, nproc: int, endpoints: List[str],
     return [p.returncode for p in procs]
 
 
+def announce_join(master: str = "127.0.0.1:49178", timeout: float = 30):
+    """Announce a (returning or new) node to an elastic launcher: bumps
+    the control store's join counter; the launcher preempts the running
+    gang and re-rendezvous at a larger world (<= max_nodes).  The analog
+    of a node's etcd registration waking the reference elastic manager
+    (fleet/elastic/manager.py watch path)."""
+    from ..store import TCPStore
+
+    mhost, mport = master.rsplit(":", 1)
+    store = TCPStore(mhost, int(mport), is_master=False, world_size=1,
+                     timeout=timeout)
+    return store.add("elastic/join_req", 1)
+
+
 def launch(args=None) -> int:
     from ..fleet.elastic import ElasticManager, ElasticStatus
 
@@ -139,8 +155,14 @@ def launch(args=None) -> int:
     # multi-node elastic deployment the wrong (all-local) topology
     local_elastic = os.environ.get("PADDLE_ELASTIC_LOCAL", "") in (
         "1", "true", "True")
+    # under the explicit opt-in, a loopback --master stays local too (it
+    # just pins the control-store port — concurrent testbeds need
+    # distinct ports)
+    master_is_local = (args.master is None
+                       or args.master.rsplit(":", 1)[0] in
+                       ("127.0.0.1", "localhost"))
     single_host = (mgr.max_nodes == 1
-                   or (local_elastic and args.master is None
+                   or (local_elastic and master_is_local
                        and args.rank == 0
                        and mgr.max_nodes > mgr.min_nodes))
     # single-host elastic starts at FULL size and scales DOWN one node
@@ -156,6 +178,40 @@ def launch(args=None) -> int:
     rdv_store = None
     if single_host:
         endpoints = [f"127.0.0.1:{base_port + i}" for i in range(world)]
+        if local_elastic and mgr.max_nodes > mgr.min_nodes:
+            # elastic control store: a returning/new node announces
+            # itself (announce_join) and the launcher preempts the gang
+            # for a SCALE-UP re-rendezvous — the reference elastic
+            # manager's watch-and-expand path
+            # (fleet/elastic/manager.py:125)
+            import threading
+
+            from ..store import TCPStore
+
+            mhost, mport = master.rsplit(":", 1)
+            ctrl = TCPStore(mhost, int(mport), is_master=True,
+                            world_size=1, timeout=60)
+            # the ctrl store owns the master port; workers' jax
+            # coordinator must not collide with it (same split as the
+            # multi-node rendezvous branch)
+            shutdown_flag["jax_coordinator"] = f"{mhost}:{int(mport) + 1}"
+            shutdown_flag["joins_consumed"] = 0
+
+            def _watch_joins():
+                while not shutdown_flag["requested"]:
+                    try:
+                        n = ctrl.add("elastic/join_req", 0)
+                    except Exception:
+                        return
+                    # each announced join is consumed by ONE scale-up;
+                    # pending joins keep preempting until drained
+                    if (n > shutdown_flag["joins_consumed"]
+                            and not shutdown_flag.get("scale_up")):
+                        shutdown_flag["scale_up"] = True
+                        shutdown_flag["kill"]()
+                    time.sleep(0.5)
+
+            threading.Thread(target=_watch_joins, daemon=True).start()
     else:
         # multi-node rendezvous over the native TCPStore hosted at
         # --master by node 0 (the HTTPMaster/ETCDMaster analog,
@@ -184,23 +240,51 @@ def launch(args=None) -> int:
         shutdown_flag["kill"]()
 
     signal.signal(signal.SIGTERM, _on_sigterm)
+    generation = 0
     while True:
         if shutdown_flag["requested"]:
             sys.stderr.write("launch: shutdown requested (SIGTERM); not "
                              "starting a new gang\n")
             return 0
         codes = _run_gang(args, world, world if single_host else nproc,
-                          endpoints, master, mgr.restart_count,
-                          shutdown_flag)
+                          endpoints, master, generation, shutdown_flag)
         if shutdown_flag["requested"]:
             # intentional stop is a clean exit, not a failure
             sys.stderr.write("launch: shutdown requested (SIGTERM); not "
                              "restarting\n")
             return 0
+        scale_up = shutdown_flag.pop("scale_up", False)
+        if scale_up and all(c == 0 for c in codes):
+            # the gang finished cleanly while the join raced in: the job
+            # is done — do not restart a completed job
+            sys.stderr.write("launch: join raced a completed gang; job "
+                             "finished\n")
+            return 0
+        if scale_up:
+            # a node announced itself: re-rendezvous at a LARGER world
+            # (bounded by max_nodes); a join is capacity returning, so it
+            # does not consume the restart budget
+            shutdown_flag["joins_consumed"] = (
+                shutdown_flag.get("joins_consumed", 0) + 1)
+            generation += 1
+            if nnodes < mgr.max_nodes:
+                nnodes += 1
+                world = nnodes * nproc
+                endpoints = [f"127.0.0.1:{base_port + i}"
+                             for i in range(world)]
+                sys.stderr.write(
+                    f"launch: node joined; elastic SCALE-UP "
+                    f"re-rendezvous at world={world}\n")
+            else:
+                sys.stderr.write(
+                    "launch: join announced at max_nodes; restarting "
+                    "at the same world\n")
+            continue
         status = mgr.decide(codes)
         if status is ElasticStatus.COMPLETED:
             return 0
         if status is ElasticStatus.RESTART:
+            generation += 1
             if single_host and nnodes > mgr.min_nodes:
                 nnodes -= 1
                 world = nnodes * nproc

@@ -123,15 +123,25 @@ class FusedMultiTransformer(Layer):
             cache = lax.dynamic_update_slice(
                 cache, v[None].astype(cache.dtype), (1, 0, 0, ts, 0))
             k_all, v_all = cache[0], cache[1]
-        qh = jnp.moveaxis(q, 1, 2).astype(jnp.float32)
-        scores = jnp.einsum("bnsd,bnSd->bnsS", qh,
-                            k_all.astype(jnp.float32)) * (hd ** -0.5)
-        if mask is not None:
-            scores = scores + mask
-        probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bnsS,bnSd->bnsd", probs,
-                         v_all.astype(jnp.float32)).astype(x.dtype)
-        ctx = jnp.moveaxis(ctx, 1, 2).reshape(b, s, nh * hd)
+        if cache is not None and s == 1 and mask is None:
+            # single-token decode: Pallas flash-decoding kernel over the
+            # [b, nh, M, hd] cache (HBM traffic bounded by ts+1, not M)
+            from ...ops.pallas.decode_attention import flash_decode_raw
+
+            lens = jnp.broadcast_to(ts + 1, (b,)).astype(jnp.int32)
+            ctx = flash_decode_raw(q.reshape(b, nh, hd), k_all, v_all,
+                                   lens, scale=hd ** -0.5)
+            ctx = ctx.reshape(b, s, nh * hd).astype(x.dtype)
+        else:
+            qh = jnp.moveaxis(q, 1, 2).astype(jnp.float32)
+            scores = jnp.einsum("bnsd,bnSd->bnsS", qh,
+                                k_all.astype(jnp.float32)) * (hd ** -0.5)
+            if mask is not None:
+                scores = scores + mask
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bnsS,bnSd->bnsd", probs,
+                             v_all.astype(jnp.float32)).astype(x.dtype)
+            ctx = jnp.moveaxis(ctx, 1, 2).reshape(b, s, nh * hd)
         x = x + ctx @ self.linear_weights[i]._value \
             + self.linear_biases[i]._value
         xm = _ln(x, self.ffn_ln_scales[i]._value,
@@ -192,12 +202,21 @@ class FusedMultiTransformer(Layer):
     def _decode(self, x, cache_vals, ts, attn_mask=None):
         if cache_vals is None:
             raise ValueError("decode (time_step given) requires caches")
-        M = cache_vals[0].shape[3]
-        valid = (jnp.arange(M) <= ts)
-        mask = jnp.where(valid, 0.0, -jnp.inf).astype(jnp.float32)[None, None,
-                                                                   None, :]
-        if attn_mask is not None:  # e.g. padding mask over cache positions
-            mask = mask + attn_mask
+        if attn_mask is None and x.shape[1] == 1:
+            # single-token decode with no user mask: the Pallas
+            # flash-decoding kernel bounds attention to positions <= ts
+            # itself (and bounds the HBM traffic with it) — no
+            # materialised position mask needed
+            mask = None
+        else:  # user mask, or multi-token chunk: masked XLA path (the
+            # position mask is what keeps stale cache slots past ts out)
+            M = cache_vals[0].shape[3]
+            valid = (jnp.arange(M) <= ts)
+            mask = jnp.where(valid, 0.0,
+                             -jnp.inf).astype(jnp.float32)[None, None,
+                                                           None, :]
+            if attn_mask is not None:
+                mask = mask + attn_mask
         new_caches = []
         for i in range(self.num_layers):
             x, _, _, c = self._layer(i, x, mask, cache_vals[i], ts)

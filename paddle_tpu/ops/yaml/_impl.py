@@ -3881,7 +3881,7 @@ def detection_map(detect_res, label, has_state=None, pos_count=None,
                   background_label=0, overlap_threshold=0.5,
                   evaluate_difficult=True, ap_type="integral",
                   detect_lod=None, label_lod=None, true_pos_lod=None,
-                  false_pos_lod=None):
+                  false_pos_lod=None, return_state_lods=False):
     """ref: phi detection_map (ops.yaml:1330; cpu/detection_map_
     kernel.cc) — VOC mAP with greedy per-class gt matching.
     detect_res [M, 6] rows (label, score, x1, y1, x2, y2); label rows
@@ -3891,7 +3891,11 @@ def detection_map(detect_res, label, has_state=None, pos_count=None,
     (pos_count [C,1], true/false_pos [k,2] + per-class lods) merges in —
     the streaming-evaluation contract.  Returns (accum_pos_count
     [C, 1] int32, accum_true_pos [sum, 2], accum_false_pos [sum, 2],
-    m_ap scalar); the accumulated tp/fp rows are grouped by class id."""
+    m_ap scalar); the accumulated tp/fp rows are grouped by class id.
+    ``return_state_lods=True`` appends the per-class (tp_lod, fp_lod)
+    offset vectors — the dense-surface stand-in for the LoD the
+    reference attaches to its state outputs, required to feed the state
+    back for class_num > 1."""
     det = np.asarray(detect_res, np.float64)
     lab = np.asarray(label, np.float64)
     dlod = (np.asarray(detect_lod, np.int64) if detect_lod is not None
@@ -4032,13 +4036,20 @@ def detection_map(detect_res, label, has_state=None, pos_count=None,
         if 0 <= c < C:
             out_pc[c, 0] = npos
     tp_rows, fp_rows = [], []
+    tp_lod, fp_lod = [0], [0]
     for c in range(C):
         tp_rows += tp.get(c, [])
         fp_rows += fp.get(c, [])
+        tp_lod.append(len(tp_rows))
+        fp_lod.append(len(fp_rows))
     out_tp = (np.asarray(tp_rows, np.float32).reshape(-1, 2))
     out_fp = (np.asarray(fp_rows, np.float32).reshape(-1, 2))
-    return (jnp.asarray(out_pc), jnp.asarray(out_tp),
+    outs = (jnp.asarray(out_pc), jnp.asarray(out_tp),
             jnp.asarray(out_fp), jnp.asarray(mAP, jnp.float32))
+    if return_state_lods:
+        return outs + (jnp.asarray(np.asarray(tp_lod, np.int64)),
+                       jnp.asarray(np.asarray(fp_lod, np.int64)))
+    return outs
 
 
 def _rnn_scan(mode, xt, h0, c0, w_ih, w_hh, b_ih, b_hh, lens=None,

@@ -155,3 +155,93 @@ else:
     assert "SCALE-DOWN re-rendezvous at world=2" in r.stderr
     gen1 = (log_dir / "workerlog.0.restart1").read_text()
     assert "RESUMED_OK world=2" in gen1, gen1
+
+
+def test_elastic_scale_down_then_up(tmp_path):
+    """The full elastic cycle (reference fleet/elastic/manager.py watch
+    paths): world=2 -> a worker dies AFTER a sharded checkpoint lands ->
+    SCALE-DOWN re-rendezvous at world=1 and resume -> the "replaced"
+    node announces itself (announce_join) -> the launcher preempts the
+    gang and SCALE-UPs back to world=2 -> resume again with the state
+    resharded onto the larger mesh."""
+    script = tmp_path / "updown_worker.py"
+    ckpt = tmp_path / "ckpt"
+    flag = tmp_path / "saved.flag"
+    script.write_text(f"""
+import os, sys, time
+import numpy as np
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+gen = int(os.environ["PADDLE_RESTART_COUNT"])
+master = os.environ["PADDLE_MASTER"]
+ckpt = {str(ckpt)!r}
+flag = {str(flag)!r}
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import Shard
+
+data = np.arange(32, dtype=np.float32).reshape(8, 4)
+
+if gen == 0:                      # world 2: save, then worker 1 "dies"
+    if rank == 0:
+        mesh = dist.ProcessMesh(np.arange(2), ["x"])
+        t = dist.shard_tensor(paddle.to_tensor(data), mesh, [Shard(0)])
+        dist.save_state_dict({{"w": t, "step": 3}}, ckpt)
+        open(flag, "w").close()
+        time.sleep(60)            # hold the gang until worker 1 fails it
+    else:
+        for _ in range(1200):
+            if os.path.exists(flag):
+                sys.exit(21)      # dies only after the checkpoint landed
+            time.sleep(0.1)
+        sys.exit(0)
+elif gen == 1:                    # world 1: resume, then capacity returns
+    assert world == 1, world
+    mesh = dist.ProcessMesh(np.arange(1), ["x"])
+    t = dist.shard_tensor(paddle.zeros([8, 4]), mesh, [Shard(0)])
+    sd = {{"w": t, "step": 0}}
+    dist.load_state_dict(sd, ckpt)
+    np.testing.assert_allclose(np.asarray(t._value), data)
+    assert sd["step"] == 3
+    print("RESUMED_DOWN world=1")
+    from paddle_tpu.distributed.launch.main import announce_join
+    announce_join(master)         # the replacement node comes back
+    time.sleep(60)                # preempted by the SCALE-UP rendezvous
+elif gen == 2:                    # world 2 again: resharded resume
+    assert world == 2, world
+    if rank == 0:
+        mesh = dist.ProcessMesh(np.arange(2), ["x"])
+        t = dist.shard_tensor(paddle.zeros([8, 4]), mesh, [Shard(0)])
+        sd = {{"w": t, "step": 0}}
+        dist.load_state_dict(sd, ckpt)
+        np.testing.assert_allclose(np.asarray(t._value), data)
+        assert len(t._value.sharding.mesh.devices.flatten()) == 2
+        print("SCALED_UP_OK world=2 step=4")
+    sys.exit(0)
+else:
+    sys.exit(99)
+""")
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1:2", "--nproc_per_node", "1", "--max_restart", "2",
+         "--master", None or "127.0.0.1:49214",
+         "--log_dir", str(log_dir), str(script)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=300,
+        env={**os.environ,
+             "PADDLE_ELASTIC_LOCAL": "1",
+             "PYTHONPATH": "/root/repo" + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "SCALE-DOWN re-rendezvous at world=1" in r.stderr
+    assert "SCALE-UP re-rendezvous at world=2" in r.stderr
+    gen1 = (log_dir / "workerlog.0.restart1").read_text()
+    assert "RESUMED_DOWN world=1" in gen1, gen1
+    gen2 = (log_dir / "workerlog.0.restart2").read_text()
+    assert "SCALED_UP_OK world=2" in gen2, gen2

@@ -1097,16 +1097,22 @@ class TestDetectionMap:
         assert abs(float(m) - 1.0) < 1e-6
 
     def test_state_merge_accumulates(self):
-        det = jnp.asarray([[0, 0.9, 0.1, 0.1, 0.4, 0.4]], jnp.float32)
-        lab = jnp.asarray([[0, 0, 0.1, 0.1, 0.4, 0.4]], jnp.float32)
-        pc1, tp1, fp1, _ = _impl.detection_map(det, lab, class_num=1)
+        """Streaming evaluation with class_num=2: the returned per-class
+        state lods feed the next call's merge."""
+        det = jnp.asarray([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                           [1, 0.8, 0.5, 0.5, 0.8, 0.8]], jnp.float32)
+        lab = jnp.asarray([[0, 0, 0.1, 0.1, 0.4, 0.4],
+                           [1, 0, 0.5, 0.5, 0.8, 0.8]], jnp.float32)
+        pc1, tp1, fp1, _, tlod, flod = _impl.detection_map(
+            det, lab, class_num=2, return_state_lods=True)
+        np.testing.assert_array_equal(np.asarray(tlod), [0, 1, 2])
         # feed the state back with a second identical image
         pc2, tp2, fp2, m = _impl.detection_map(
             det, lab, pos_count=pc1, true_pos=tp1, false_pos=fp1,
-            true_pos_lod=[0, np.asarray(tp1).shape[0]],
-            false_pos_lod=[0, np.asarray(fp1).shape[0]], class_num=1)
-        np.testing.assert_array_equal(np.asarray(pc2).ravel(), [2])
-        assert np.asarray(tp2).shape[0] == 2
+            true_pos_lod=np.asarray(tlod), false_pos_lod=np.asarray(flod),
+            class_num=2)
+        np.testing.assert_array_equal(np.asarray(pc2).ravel(), [2, 2])
+        assert np.asarray(tp2).shape[0] == 4
         assert float(m) == 1.0
 
 
