@@ -196,6 +196,10 @@ def launch(args=None) -> int:
             # multi-node rendezvous branch)
             shutdown_flag["jax_coordinator"] = f"{mhost}:{int(mport) + 1}"
             shutdown_flag["joins_consumed"] = 0
+            # one lock covers flag-set (watcher) and pop+consume (main
+            # loop): without it a watcher tick between the two could
+            # turn one announce_join into two scale-ups
+            shutdown_flag["join_lock"] = threading.Lock()
 
             def _watch_joins():
                 while not shutdown_flag["requested"]:
@@ -205,9 +209,12 @@ def launch(args=None) -> int:
                         return
                     # each announced join is consumed by ONE scale-up;
                     # pending joins keep preempting until drained
-                    if (n > shutdown_flag["joins_consumed"]
-                            and not shutdown_flag.get("scale_up")):
-                        shutdown_flag["scale_up"] = True
+                    with shutdown_flag["join_lock"]:
+                        fire = (n > shutdown_flag["joins_consumed"]
+                                and not shutdown_flag.get("scale_up"))
+                        if fire:
+                            shutdown_flag["scale_up"] = True
+                    if fire:
                         shutdown_flag["kill"]()
                     time.sleep(0.5)
 
@@ -253,19 +260,31 @@ def launch(args=None) -> int:
             sys.stderr.write("launch: shutdown requested (SIGTERM); not "
                              "restarting\n")
             return 0
-        scale_up = shutdown_flag.pop("scale_up", False)
+        join_lock = shutdown_flag.get("join_lock")
+        if join_lock:
+            with join_lock:
+                scale_up = shutdown_flag.pop("scale_up", False)
+                if scale_up:
+                    shutdown_flag["joins_consumed"] += 1
+        else:
+            scale_up = shutdown_flag.pop("scale_up", False)
         if scale_up and all(c == 0 for c in codes):
             # the gang finished cleanly while the join raced in: the job
             # is done — do not restart a completed job
             sys.stderr.write("launch: join raced a completed gang; job "
                              "finished\n")
             return 0
+        if scale_up and not all(c in (0, -signal.SIGTERM) for c in codes):
+            # a REAL worker crash raced the join: route it through the
+            # elastic manager (restart budget) — the pending join fires
+            # again on the next generation via the watcher
+            with join_lock:
+                shutdown_flag["joins_consumed"] -= 1
+            scale_up = False
         if scale_up:
             # a node announced itself: re-rendezvous at a LARGER world
             # (bounded by max_nodes); a join is capacity returning, so it
             # does not consume the restart budget
-            shutdown_flag["joins_consumed"] = (
-                shutdown_flag.get("joins_consumed", 0) + 1)
             generation += 1
             if nnodes < mgr.max_nodes:
                 nnodes += 1

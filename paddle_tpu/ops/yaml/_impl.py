@@ -4149,3 +4149,129 @@ def rnn(x, pre_state, weight_list, sequence_length=None,
                   else jnp.zeros((0,), jnp.uint8))
     reserve = jnp.zeros((0,), x.dtype)
     return out, drop_state, state, reserve
+
+
+# --------------------------------------------------------------------------
+# Deep Gradient Compression (Lin et al., ICLR'18) — reference
+# phi/kernels/gpu/dgc_kernel.cu + impl/dgc_momentum_kernel_impl.h + the
+# fluid DGC optimizer wrapper.  Top-k sparsification with error feedback
+# and momentum factor masking; the communication side (sparse allreduce
+# over encode/gather buffers) is the collective layer's job.
+# --------------------------------------------------------------------------
+
+def _dgc_period_sparsity(sparsity, cur_step, rampup_steps):
+    if not sparsity:
+        return 0.999
+    idx = int(cur_step * len(sparsity) / rampup_steps) \
+        if rampup_steps > 0 else len(sparsity) - 1
+    return sparsity[min(idx, len(sparsity) - 1)]
+
+
+def dgc(u, v, grad, param=None, current_step=None, nranks=None, m=0.9,
+        use_nesterov=True, sparsity=(), rampup_begin_step=0.0,
+        rampup_step=0.0, regular_coeff=0.0, regular_type=0):
+    """ref: phi dgc (ops.yaml:1344; gpu/dgc_kernel.cu).  Local momentum
+    + error-feedback accumulation + top-k selection with momentum factor
+    masking.  encode_grad layout (documented — the reference delegates
+    to libdgc's k_select): [2k] = k selected values then k flat indices
+    cast to the dtype; gather_buff is the zeroed [2k*nranks] allgather
+    staging buffer.  Before rampup_begin_step DGC is bypassed:
+    grad_out = nranks*grad (+regularization), u/v untouched, k=0."""
+    nr = float(np.asarray(nranks).reshape(-1)[0])
+    step = float(np.asarray(current_step).reshape(-1)[0])
+    if nr <= 1:
+        raise ValueError("dgc: num_trainers must be > 1 (DGC compresses "
+                         "cross-rank gradient traffic)")
+    g = grad.astype(jnp.float32)
+    if regular_type == 0:
+        gout = nr * g
+    elif regular_type == 1:    # L1Decay
+        gout = nr * g + regular_coeff * jnp.sign(param.astype(jnp.float32))
+    elif regular_type == 2:    # L2Decay
+        gout = nr * g + regular_coeff * param.astype(jnp.float32)
+    else:
+        raise ValueError("dgc: regular_type must be 0|1|2")
+    dt = grad.dtype
+    if dt != jnp.float32:
+        raise TypeError("dgc: float32 gradients only (reference "
+                        "registers the kernel for float)")
+    if int(step) < int(rampup_begin_step):
+        return (u, v, jnp.zeros((0,), dt), gout.astype(dt),
+                jnp.zeros((1,), jnp.int32),
+                jnp.zeros((0,), dt))
+    ratio = 1.0 - _dgc_period_sparsity(
+        list(sparsity), step - rampup_begin_step, rampup_step)
+    if not (0.0 <= ratio < 1.0):
+        raise ValueError(f"dgc sparsity ratio {ratio} out of [0, 1)")
+    numel = int(np.prod(grad.shape))
+    k = max(int(numel * ratio), 1)
+    uf = u.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if use_nesterov:
+        u_out = m * (uf + gout)
+        v_out = u_out + vf + gout
+    else:
+        u_out = m * uf + gout
+        v_out = u_out + vf
+    flat = v_out.reshape(-1)
+    _, idx = lax.top_k(jnp.abs(flat), k)
+    vals = flat[idx]
+    idx_bits = lax.bitcast_convert_type(idx.astype(jnp.int32), jnp.float32)
+    encode = jnp.concatenate([vals, idx_bits])
+    # error feedback: communicated entries leave the residual; momentum
+    # factor masking also clears them from the momentum buffer
+    flat = flat.at[idx].set(0.0)
+    u_flat = u_out.reshape(-1).at[idx].set(0.0)
+    return (u_flat.reshape(u.shape).astype(dt),
+            flat.reshape(v.shape).astype(dt),
+            encode,
+            jnp.zeros_like(grad),
+            jnp.full((1,), k, jnp.int32),
+            jnp.zeros((2 * k * int(nr),), dt))
+
+
+def dgc_momentum(param, grad, velocity, learning_rate, master_param=None,
+                 current_step_tensor=None, nranks_tensor=None, mu=0.9,
+                 use_nesterov=False, regularization_method="",
+                 regularization_coeff=0.0, multi_precision=False,
+                 rescale_grad=1.0, rampup_begin_step=-1.0):
+    """ref: phi dgc_momentum (ops.yaml:1369;
+    impl/dgc_momentum_kernel_impl.h): grad_out = grad/nranks; BEFORE
+    rampup_begin_step the update is plain momentum; after it, plain SGD
+    (the momentum lives inside the dgc op's u buffer)."""
+    nr = float(np.asarray(nranks_tensor).reshape(-1)[0])
+    step = float(np.asarray(current_step_tensor).reshape(-1)[0])
+    if nr <= 1:
+        raise ValueError("dgc_momentum: num_trainers must be > 1")
+    g = grad.astype(jnp.float32) * rescale_grad
+    if regularization_method == "l2_decay":
+        g = g + regularization_coeff * param.astype(jnp.float32)
+    lr = jnp.reshape(learning_rate.astype(jnp.float32), ())
+    grad_out = (grad.astype(jnp.float32) / nr).astype(grad.dtype)
+    if int(step) < int(rampup_begin_step):
+        vel = mu * velocity.astype(jnp.float32) + g
+        if use_nesterov:
+            p_out = param.astype(jnp.float32) - lr * (g + mu * vel)
+        else:
+            p_out = param.astype(jnp.float32) - lr * vel
+        return (p_out.astype(param.dtype), vel.astype(velocity.dtype),
+                master_param, grad_out)
+    p_out = (param.astype(jnp.float32)
+             - lr * grad.astype(jnp.float32))   # raw grad: reference
+    # SGDDenseKernel gets the unmodified gradient
+    return (p_out.astype(param.dtype), velocity, master_param, grad_out)
+
+
+def dgc_clip_by_norm(x, current_step, max_norm, rampup_begin_step=-1.0):
+    """ref: phi dgc_clip_by_norm (ops.yaml:1357): ordinary clip_by_norm,
+    but a no-op before rampup_begin_step (clipping only matters once DGC
+    sparsification starts amplifying local grads); negative
+    rampup_begin_step disables the op (reference early-return)."""
+    step = float(np.asarray(current_step).reshape(-1)[0])
+    if rampup_begin_step < 0 or step < rampup_begin_step:
+        return x
+    xf = x.astype(jnp.float32)
+    norm = jnp.sqrt(jnp.sum(xf * xf))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      1.0)
+    return (xf * scale).astype(x.dtype)

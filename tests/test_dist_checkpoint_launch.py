@@ -231,7 +231,7 @@ else:
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nnodes", "1:2", "--nproc_per_node", "1", "--max_restart", "2",
-         "--master", None or "127.0.0.1:49214",
+         "--master", "127.0.0.1:49214",
          "--log_dir", str(log_dir), str(script)],
         cwd="/root/repo", capture_output=True, text=True, timeout=300,
         env={**os.environ,

@@ -1202,3 +1202,78 @@ class TestRnnMegaOp:
             hidden_size=H, input_size=I)
         np.testing.assert_allclose(out[:2, 1], np.asarray(out2)[:, 1],
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestDGC:
+    def test_error_feedback_and_masking(self):
+        rng = np.random.default_rng(14)
+        n = 8
+        g = rng.standard_normal(n).astype(np.float32)
+        u0 = np.zeros(n, np.float32)
+        v0 = np.zeros(n, np.float32)
+        u1, v1, enc, gout, k, buf = _impl.dgc(
+            jnp.asarray(u0), jnp.asarray(v0), jnp.asarray(g), None,
+            jnp.asarray([5.0]), jnp.asarray([2.0]), m=0.9,
+            use_nesterov=False, sparsity=[0.75], rampup_begin_step=0.0,
+            rampup_step=1.0)
+        kk = int(np.asarray(k)[0])
+        assert kk == 2                              # 8 * (1 - 0.75)
+        # u = m*0 + 2g = 2g; v = u + 0 = 2g, top-2 |v| selected
+        want_v = 2.0 * g
+        order = np.argsort(-np.abs(want_v))[:2]
+        enc = np.asarray(enc)
+        np.testing.assert_allclose(sorted(enc[:2]), sorted(want_v[order]),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(
+            sorted(enc[2:].view(np.int32)), sorted(order))
+        # error feedback: residual keeps unselected, zero at selected;
+        # momentum factor masking zeroes u there too
+        v1 = np.asarray(v1)
+        u1 = np.asarray(u1)
+        assert np.allclose(v1[order], 0) and np.allclose(u1[order], 0)
+        others = [i for i in range(n) if i not in order.tolist()]
+        np.testing.assert_allclose(v1[others], want_v[others], rtol=1e-5)
+        # dense grad contribution is consumed (zeroed)
+        assert np.allclose(np.asarray(gout), 0)
+        assert np.asarray(buf).shape == (2 * kk * 2,)
+
+    def test_rampup_bypass(self):
+        g = jnp.asarray(np.ones(4, np.float32))
+        u1, v1, enc, gout, k, _ = _impl.dgc(
+            jnp.zeros(4), jnp.zeros(4), g, None, jnp.asarray([1.0]),
+            jnp.asarray([2.0]), sparsity=[0.75], rampup_begin_step=5.0,
+            rampup_step=1.0)
+        assert np.asarray(enc).size == 0 and int(np.asarray(k)[0]) == 0
+        np.testing.assert_allclose(np.asarray(gout), 2.0)  # nranks * g
+        assert np.allclose(np.asarray(v1), 0)
+
+    def test_dgc_momentum_switches_to_sgd(self):
+        p = jnp.asarray(np.ones(4, np.float32))
+        g = jnp.asarray(np.full(4, 0.5, np.float32))
+        vel = jnp.asarray(np.full(4, 0.2, np.float32))
+        lr = jnp.asarray([0.1], jnp.float32)
+        # before rampup: momentum
+        po, vo, _, go = _impl.dgc_momentum(
+            p, g, vel, lr, p, jnp.asarray([1.0]), jnp.asarray([2.0]),
+            mu=0.9, rampup_begin_step=10.0)
+        want_vel = 0.9 * 0.2 + 0.5
+        np.testing.assert_allclose(np.asarray(vo), want_vel, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(po), 1 - 0.1 * want_vel,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(go), 0.25)   # grad / nranks
+        # after rampup: plain sgd, velocity untouched
+        po2, vo2, _, _ = _impl.dgc_momentum(
+            p, g, vel, lr, p, jnp.asarray([20.0]), jnp.asarray([2.0]),
+            mu=0.9, rampup_begin_step=10.0)
+        np.testing.assert_allclose(np.asarray(po2), 1 - 0.1 * 0.5,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(vo2), 0.2)
+
+    def test_dgc_clip_by_norm_gating(self):
+        x = jnp.asarray(np.full(4, 2.0, np.float32))   # norm 4 > 1
+        clipped = np.asarray(_impl.dgc_clip_by_norm(
+            x, jnp.asarray([5.0]), max_norm=1.0, rampup_begin_step=0.0))
+        np.testing.assert_allclose(np.linalg.norm(clipped), 1.0, rtol=1e-5)
+        passthru = np.asarray(_impl.dgc_clip_by_norm(
+            x, jnp.asarray([5.0]), max_norm=1.0, rampup_begin_step=10.0))
+        np.testing.assert_allclose(passthru, 2.0)
