@@ -132,3 +132,24 @@ def test_dataloader_shared_memory_error_propagates():
     with pytest.raises(RuntimeError, match="bad sample 11"):
         for _ in dl:
             pass
+
+
+def test_dataloader_shared_memory_soak_many_small_batches():
+    """Ring-accounting soak: hundreds of small frames across 4 workers
+    wrap the ring many times; order and content must hold exactly."""
+    class Tiny(Dataset):
+        def __getitem__(self, i):
+            return np.full((7,), i, np.int32)
+
+        def __len__(self):
+            return 400
+
+    dl = DataLoader(Tiny(), batch_size=2, num_workers=4,
+                    use_shared_memory=True, shm_capacity=16 * 1024)
+    seen = []
+    for (x,) in ((b,) if not isinstance(b, (list, tuple)) else b
+                 for b in dl):
+        arr = np.asarray(x._value)
+        assert (arr[0] == arr[0][0]).all()
+        seen.append(int(arr[0][0]))
+    assert seen == list(range(0, 400, 2))
