@@ -277,6 +277,9 @@ def _wrap_np(obj):
 # hangs up (normal exit or death) — lets the parent distinguish "done"
 # from "still producing"
 _WORKER_DONE = object()
+# process-global monotonic ids keep two live _MultiprocessIter objects from
+# colliding on a shm segment name (id(self) can be reused after GC)
+_SHM_SEGMENT_IDS = itertools.count()
 
 
 def _mp_worker(dataset, collate_fn, index_q, result_q, worker_id,
@@ -335,11 +338,12 @@ class _MultiprocessIter:
             # worker; a parent thread per ring blocks in C (GIL released)
             # and feeds the common reassembly queue
             from .shm_channel import (ShmChannel, ShmChannelClosed,
-                                      recv_batch)
+                                      ShmChannelTimeout, recv_batch)
 
             self._result_q = queue.Queue()
+            seg = next(_SHM_SEGMENT_IDS)
             for w in range(self._nw):
-                name = f"/ptpu_dl_{os.getpid()}_{id(self) & 0xffff}_{w}"
+                name = f"/ptpu_dl_{os.getpid()}_{seg}_{w}"
                 self._channels.append(ShmChannel(
                     name, capacity=loader.shm_capacity, create=True))
 
@@ -350,6 +354,19 @@ class _MultiprocessIter:
                 while True:
                     try:
                         bidx, batch, err = recv_batch(ch)
+                    except ShmChannelTimeout:
+                        # an idle training loop (long eval pause) is not a
+                        # worker failure — keep polling while the worker
+                        # lives.  A SIGKILLed worker never close_write()s
+                        # the ring, so timeout + dead process is the ONLY
+                        # signal for that failure mode; treat it as death.
+                        if self._workers[wid].is_alive():
+                            continue
+                        self._result_q.put((-1, None, RuntimeError(
+                            f"DataLoader worker {wid} died (shm channel "
+                            f"timed out and process is gone)")))
+                        self._result_q.put((_WORKER_DONE, wid, None))
+                        return
                     except ShmChannelClosed:
                         self._result_q.put((_WORKER_DONE, wid, None))
                         return

@@ -4239,6 +4239,10 @@ def dgc_momentum(param, grad, velocity, learning_rate, master_param=None,
     impl/dgc_momentum_kernel_impl.h): grad_out = grad/nranks; BEFORE
     rampup_begin_step the update is plain momentum; after it, plain SGD
     (the momentum lives inside the dgc op's u buffer)."""
+    if rampup_begin_step < 0:
+        # reference DGCMomentumKernel returns before touching any output
+        # (and before the nranks check) when rampup_begin_step < 0
+        return param, velocity, master_param, grad
     nr = float(np.asarray(nranks_tensor).reshape(-1)[0])
     step = float(np.asarray(current_step_tensor).reshape(-1)[0])
     if nr <= 1:
