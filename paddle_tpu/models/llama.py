@@ -356,6 +356,22 @@ LLAMA_SHARDING_PLAN = {
 }
 
 
+def _gold_logit(lv, labels):
+    """Label-logit pick as an iota-compare masked reduction, NOT
+    ``take_along_axis``: the gather's transpose is a [tokens, vocab]
+    scatter-add whose SPMD placement falls back to involuntary full
+    rematerialization on hybrid meshes (replicating the logits-grad every
+    step), while a select+reduce fuses with the adjacent logsumexp pass
+    and shards like any elementwise op.  Exact same values — one nonzero
+    per row (the reference reads the label column directly in its fused
+    softmax-with-CE kernel, paddle/phi/kernels/gpu/
+    c_softmax_with_cross_entropy_kernel.cu)."""
+    vocab = lv.shape[-1]
+    hit = labels[..., None] == jax.lax.broadcasted_iota(
+        jnp.int32, lv.shape, lv.ndim - 1)
+    return jnp.where(hit, lv.astype(jnp.float32), 0.0).sum(axis=-1)
+
+
 def plan_spec_for(name: str, plan: Optional[Dict[str, P]] = None) -> P:
     plan = plan if plan is not None else LLAMA_SHARDING_PLAN
     for suffix, spec in plan.items():
@@ -467,9 +483,7 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
         # ([tokens, vocab] fp32 is >1GB at bench shapes; the cast and the
         # extra read/write were pure HBM burn)
         lse = jax.scipy.special.logsumexp(lv.astype(jnp.float32), axis=-1)
-        gold = jnp.take_along_axis(lv, labels[..., None],
-                                   axis=-1)[..., 0].astype(jnp.float32)
-        nll = lse - gold
+        nll = lse - _gold_logit(lv, labels)
         if attn_mask is None:
             return nll.mean()
         w = (attn_mask > 0).astype(jnp.float32)

@@ -45,7 +45,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .llama import (LlamaConfig, LLAMA_SHARDING_PLAN, plan_spec_for,
-                    _filter_spec_to_mesh, _rope_tables)
+                    _filter_spec_to_mesh, _gold_logit, _rope_tables)
 from ..parallel import compat as _compat
 from ..parallel.pipelining import pipeline_apply
 from ..parallel.sep import ulysses_attention
@@ -374,17 +374,19 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         from ..parallel.schedules import build_schedule
 
         vch = max(int(virtual_chunks), 1)
+        if schedule.upper() == "ZBV" and vch == 1:
+            vch = 2              # ZBV's two-chunk zigzag is intrinsic
         if L % (pp * vch):
             raise ValueError(
                 f"{L} layers not divisible by pp*virtual_chunks = "
                 f"{pp}*{vch}")
         sched = build_schedule(schedule, p=pp, m=m, v=vch)
-        # Megatron VPP placement (single source of truth:
-        # parallel.pipelining.vpp_device_major_order), applied here to
-        # layer-BLOCKS instead of per-stage param lists
-        from ..parallel.pipelining import vpp_device_major_order
+        # chunk placement (single source of truth: the schedule's
+        # stage_of — Megatron-interleaved for VPP, zigzag for ZBV),
+        # applied here to layer-BLOCKS instead of per-stage param lists
+        from ..parallel.pipelining import device_major_order
 
-        _vpp_order, _vpp_inv = vpp_device_major_order(pp, vch)
+        _vpp_order, _vpp_inv = device_major_order(sched)
 
     dpd = mesh.shape["dp"]
     dp_entry = "dp" if dpd > 1 else None
@@ -409,8 +411,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             logits = h @ lp["head"]
             lse = jax.scipy.special.logsumexp(
                 logits.astype(jnp.float32), axis=-1)
-            gold = jnp.take_along_axis(
-                logits, y_mb[..., None], axis=-1)[..., 0].astype(jnp.float32)
+            gold = _gold_logit(logits, y_mb)
             # local-token mean / (sep*dp) degree: summed over sep+dp
             # below, this is the GLOBAL token mean (equal shard sizes)
             return (lse - gold).mean() / (sep * dpd)
@@ -448,7 +449,11 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         B, S = input_ids.shape
         mb = B // m
         ids = input_ids.reshape(m, mb, S)
-        x = jnp.take(outer["model.embed_tokens.weight"], ids, axis=0)
+        # mode="clip": token ids are in-range by construction; the default
+        # fill mode's bounds-check pred ops are extra reshard candidates
+        # for the SPMD partitioner on hybrid meshes
+        x = jnp.take(outer["model.embed_tokens.weight"], ids, axis=0,
+                     mode="clip")
         x = lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(None, batch_entry, sep_entry, None)))
         cos = cos_full[:S].astype(compute_dtype)
@@ -466,9 +471,14 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32),
                                           axis=-1)
         ylb = labels.reshape(m, mb, S)
-        gold = jnp.take_along_axis(logits, ylb[..., None],
-                                   axis=-1)[..., 0].astype(jnp.float32)
-        return (lse - gold).mean()
+        nll = lse - _gold_logit(logits, ylb)
+        if batch_entry is not None:
+            # pin the per-token nll to the batch layout BEFORE the mean:
+            # without it GSPMD mixes the lse/gold operand shardings and
+            # falls back to involuntary full rematerialization on the add
+            nll = lax.with_sharding_constraint(
+                nll, NamedSharding(mesh, P(None, batch_entry)))
+        return nll.mean()
 
     grad_fn = jax.value_and_grad(loss_fn)
 
@@ -519,7 +529,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         y = labels.reshape(m, mb, S)
 
         def embed_fn(w):
-            return jnp.take(w, ids, axis=0)
+            return jnp.take(w, ids, axis=0, mode="clip")
 
         x, embed_vjp = jax.vjp(embed_fn, outer["model.embed_tokens.weight"])
         x = lax.with_sharding_constraint(
