@@ -18,13 +18,18 @@ from ...ops.registry import register, dispatch
 
 @register("fused_rms_norm", amp="black")
 def _fused_rms_norm_op(x, weight=None, epsilon=1e-6):
+    if weight is not None:
+        # custom-vjp path: saves rrms so the backward's dw/dx reductions
+        # stay single-level (see ops/nn_ops._rms_norm_weighted_bwd — the
+        # autodiff fusion re-derived var inside the cross-token dw reduce
+        # at ~20% of the whole 574M bench step)
+        from ...ops.nn_ops import _rms_norm_weighted
+
+        return _rms_norm_weighted(x, jnp.asarray(weight), float(epsilon))
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-    out = xf * lax.rsqrt(var + epsilon)
-    if weight is not None:
-        out = out * weight.astype(jnp.float32)
-    return out.astype(dtype)
+    return (xf * lax.rsqrt(var + epsilon)).astype(dtype)
 
 
 def fused_rms_norm(x, weight=None, epsilon=1e-6):

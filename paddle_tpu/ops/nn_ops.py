@@ -11,6 +11,8 @@ AMP-black (fp32 accumulate), mirroring the reference AMP lists
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -175,15 +177,57 @@ def layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=-1):
     return out
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm_weighted(x, weight, epsilon):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + epsilon)
+            * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rms_norm_weighted_fwd(x, weight, epsilon):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    rrms = lax.rsqrt(var + epsilon)
+    out = (xf * rrms * weight.astype(jnp.float32)).astype(x.dtype)
+    return out, (x, weight, rrms)
+
+
+def _rms_norm_weighted_bwd(epsilon, res, dy):
+    """Hand-written backward SAVING rrms: letting autodiff recompute
+    var inside the dw reduction fuses a per-token inner reduce into the
+    cross-token one — XLA:TPU lowers that two-level reduction at ~15-30x
+    the bandwidth bound (profiled on the 574M bench step: 145ms of a
+    680ms step in bf16[hidden] multiply_reduce fusions).  With rrms as a
+    saved residual both reductions are single-level and bandwidth-bound.
+    Math (same as the reference's rms_norm_grad_kernel,
+    paddle/phi/kernels/gpu/rms_norm_grad_kernel.cu): with
+    xhat = x * rrms, dw = sum_t dy_t*xhat_t and
+    dx = rrms * w * (dy - xhat * mean_d(dy * w * xhat))."""
+    x, weight, rrms = res
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    wf = jnp.asarray(weight, jnp.float32)
+    xhat = xf * rrms
+    dxhat = dyf * wf
+    dw = jnp.sum(dyf * xhat.astype(jnp.float32),
+                 axis=tuple(range(x.ndim - 1)))
+    proj = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx = rrms * (dxhat - xhat * proj)
+    return dx.astype(x.dtype), dw.astype(jnp.asarray(weight).dtype)
+
+
+_rms_norm_weighted.defvjp(_rms_norm_weighted_fwd, _rms_norm_weighted_bwd)
+
+
 @register("rms_norm", amp="black")
 def rms_norm(x, weight=None, epsilon=1e-6):
+    if weight is not None:
+        return _rms_norm_weighted(x, jnp.asarray(weight), float(epsilon))
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-    out = (xf * lax.rsqrt(var + epsilon)).astype(dtype)
-    if weight is not None:
-        out = out * weight
-    return out
+    return (xf * lax.rsqrt(var + epsilon)).astype(dtype)
 
 
 @register("batch_norm_infer", amp="black")

@@ -101,27 +101,84 @@ def _band_block_covered(bands, qi, ki, block_q, block_k, seq_q, seq_k):
     return jnp.all(lt_cov | ut_cov | join1 | join2 | pad)
 
 
-def _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
-               seq_q, seq_k, qs, ks, bands=None):
-    """Run ``compute`` only if the (qi, ki) tile can contain unmasked
-    entries: causal triangle test AND (for segmented/ragged inputs) the
-    segment-interval overlap test AND (for FlashMask) the band cover
-    test."""
-    cond = None
+def _live_tables(b, mask_h, nq, nk, block_q, block_k, seq_q, seq_k,
+                 causal, q_seg=None, k_seg=None, bands=None):
+    """In-graph (traced) live-tile tables for the COMPRESSED grid: for
+    every gate row (one per batch entry × mask head) and q tile, the list
+    of k tiles that can contain unmasked entries, live ones first in
+    ascending order, dead slots repeating the last live index.
+
+    The kernels' k-side BlockSpec index maps read these via scalar
+    prefetch: a dead grid step maps to the SAME block as the previous
+    step, so Mosaic elides its DMA entirely — HBM traffic scales with
+    the LIVE tile count, not the rectangular grid.  (The round-4 kernels
+    gated only the MXU work; the full-grid k/v streaming was why the
+    varlen/flashmask wins evaporated in the backward, BENCH_r04
+    fwdbwd_speedup_x = 1.039.)  Same predicates as _seg_block_overlap /
+    _band_block_covered, vectorised over the whole grid.
+
+    Returns live [gb, nq, nk] bool with gb = b * mask_h; feed through
+    _compress_live (and its transpose for the dkv fallback kernel)."""
+    qi = jnp.arange(nq, dtype=jnp.int32)
+    ki = jnp.arange(nk, dtype=jnp.int32)
+    live = jnp.ones((1, nq, nk), bool)
     if causal:
-        cond = (qi + 1) * block_q - 1 >= ki * block_k
-    if has_segments:
-        ov = _seg_block_overlap(qs, ks, qi, ki, block_q, block_k,
-                                seq_q, seq_k)
-        cond = ov if cond is None else jnp.logical_and(cond, ov)
+        live = live & ((qi[:, None] + 1) * block_q - 1
+                       >= ki[None, :] * block_k)[None]
+    if q_seg is not None:
+        big = jnp.int32(2 ** 30)
+
+        def _mm(seg, nb, blk, seq):
+            seg = seg.astype(jnp.int32)
+            pad = nb * blk - seq
+            lo = jnp.pad(seg, ((0, 0), (0, pad)), constant_values=big)
+            hi = jnp.pad(seg, ((0, 0), (0, pad)), constant_values=-big)
+            return (lo.reshape(-1, nb, blk).min(-1),
+                    hi.reshape(-1, nb, blk).max(-1))
+
+        qmn, qmx = _mm(q_seg, nq, block_q, seq_q)
+        kmn, kmx = _mm(k_seg, nk, block_k, seq_k)
+        ov = ((qmn[:, :, None] <= kmx[:, None, :])
+              & (qmx[:, :, None] >= kmn[:, None, :]))         # [b, nq, nk]
+        if mask_h > 1:
+            ov = jnp.repeat(ov, mask_h, axis=0)
+        live = live & ov
     if bands is not None:
-        live = jnp.logical_not(_band_block_covered(
-            bands, qi, ki, block_q, block_k, seq_q, seq_k))
-        cond = live if cond is None else jnp.logical_and(cond, live)
-    if cond is None:
-        compute()
-    else:
-        pl.when(cond)(compute)
+        lts, lte, uts, ute = (x.astype(jnp.int32).reshape(b * mask_h, -1)
+                              for x in bands)                 # [gb, sk]
+        q_lo = (qi * block_q)[None, :, None]                  # [1, nq, 1]
+        q_hi = jnp.minimum((qi + 1) * block_q, seq_q)[None, :, None]
+        lts, lte, uts, ute = (x[:, None, :] for x in (lts, lte, uts, ute))
+        lt_cov = (lts <= q_lo) & (lte >= q_hi)
+        ut_cov = (uts <= q_lo) & (ute >= q_hi)
+        join1 = (lts <= q_lo) & (uts <= lte) & (ute >= q_hi)
+        join2 = (uts <= q_lo) & (lts <= ute) & (lte >= q_hi)
+        cov = lt_cov | ut_cov | join1 | join2                 # [gb, nq, sk]
+        pad = nk * block_k - cov.shape[-1]
+        cov = jnp.pad(cov, ((0, 0), (0, 0), (0, pad)), constant_values=True)
+        cov = cov.reshape(cov.shape[0], nq, nk, block_k).all(-1)
+        live = live & ~cov
+    # one gate row per (batch, mask head): pure-causal tables broadcast
+    # over b so the kernels' row addressing is uniform (row =
+    # _kv_index(bh, h, gate_h))
+    gb = b * (mask_h if bands is not None else 1)
+    if live.shape[0] == 1 and gb > 1:
+        live = jnp.broadcast_to(live, (gb, nq, nk))
+    assert live.shape[0] == gb, (live.shape, gb)
+    return live
+
+
+def _compress_live(live):
+    """live [gb, nq, nk] bool -> (count [gb, nq], idx [gb, nq, nk]): live
+    column indices first (ascending), dead slots repeating the last live
+    one (count == 0 rows point at 0; their compute is fully gated)."""
+    gb, nq, nk = live.shape
+    col = jnp.arange(nk, dtype=jnp.int32)[None, None, :]
+    count = live.sum(-1).astype(jnp.int32)
+    order = jnp.argsort(jnp.where(live, col, nk + col),
+                        axis=-1).astype(jnp.int32)
+    jsel = jnp.minimum(col, jnp.maximum(count[..., None] - 1, 0))
+    return count, jnp.take_along_axis(order, jsel, axis=-1)
 
 
 def _band_mask(s, bands, qi, ki, block_q, block_k):
@@ -138,11 +195,13 @@ def _band_mask(s, bands, qi, ki, block_q, block_k):
 
 
 def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
-                  block_k: int, seq_q: int, seq_k: int,
-                  has_segments: bool = False, has_bands: bool = False):
+                  block_k: int, seq_q: int, seq_k: int, h: int,
+                  gate_h: int, has_segments: bool = False,
+                  has_bands: bool = False):
     refs = list(refs)
-    q_ref, k_ref, v_ref = refs[:3]
-    pos = 3
+    cnt_ref, kx_ref = refs[:2]                     # scalar prefetch
+    q_ref, k_ref, v_ref = refs[2:5]
+    pos = 5
     qs_ref = ks_ref = None
     if has_segments:
         qs_ref, ks_ref = refs[pos:pos + 2]
@@ -152,11 +211,14 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
         band_refs = refs[pos:pos + 4]
         pos += 4
     o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[pos:]
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    row = _kv_index(bh, h, gate_h)
+    ki = kx_ref[row, qi, j]                        # ACTUAL k tile index
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
@@ -172,21 +234,28 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
             preferred_element_type=jnp.float32) * scale   # [BQ, BK] f32
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
+        # ONE combined keep-mask -> ONE select over the f32 tile: the
+        # kernel is VPU-bound at these shapes, every avoided [BQ, BK]
+        # f32 pass counts (bool ops are cheaper than f32 selects)
+        keep = None
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = q_pos >= k_pos
         if has_segments:
             # splash-attention-style segment mask: a q position attends
             # only keys of its own segment (padding = its own segment id)
-            s = jnp.where(qs_ref[0, 0][:, None] == ks_ref[0, 0][None, :],
-                          s, NEG_INF)
+            seg = qs_ref[0, 0][:, None] == ks_ref[0, 0][None, :]
+            keep = seg if keep is None else keep & seg
+        if seq_k % block_k != 0:
+            # mask the grid-padding columns of the last k tile
+            pad = k_pos < seq_k
+            keep = pad if keep is None else keep & pad
+        if keep is not None:
+            s = jnp.where(keep, s, NEG_INF)
         if has_bands:
             s = _band_mask(s, [b[0, 0] for b in band_refs], qi, ki,
                            block_q, block_k)
-        if seq_k % block_k != 0:
-            # mask the grid-padding columns of the last k tile
-            s = jnp.where(k_pos < seq_k, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                      # [BQ, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -208,15 +277,12 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    # fully-masked tiles (causal triangle / disjoint segments / FlashMask
-    # band-covered) skip the MXU work entirely
-    _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
-               seq_q, seq_k,
-               qs_ref[0, 0] if has_segments else None,
-               ks_ref[0, 0] if has_segments else None,
-               bands=[b[0, 0] for b in band_refs] if has_bands else None)
+    # the compressed index list holds live tiles first: step j is real
+    # work iff j < count (dead steps repeated the previous block index,
+    # so their DMA was already elided — no MXU work AND no HBM traffic)
+    pl.when(j < cnt_ref[row, qi])(compute)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nk - 1)
     def _():
         l = l_scr[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
@@ -285,56 +351,75 @@ def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
     sk = k.shape[1]
     block_q = _clamp_block(block_q, sq)
     block_k = _clamp_block(block_k, sk)
-    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+    grid = (bh, nq, nk)
     has_segments = q_seg is not None
     has_bands = bands is not None
+    gate_h = mask_h if has_bands else 1
+    b = bh // h
+    live = _live_tables(b, mask_h if has_bands else 1, nq, nk, block_q,
+                        block_k, sq, sk, causal, q_seg=q_seg, k_seg=k_seg,
+                        bands=bands)
+    cnt, kx = _compress_live(live)
+
+    def _kx(bb, i, j, cnt_ref, kx_ref):
+        return kx_ref[_kv_index(bb, h, gate_h), i, j]
 
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, c, x: (b, i, 0)),
         pl.BlockSpec((1, block_k, d),
-                     lambda b, i, j: (_kv_index(b, h, kvh), j, 0)),
+                     lambda b, i, j, c, x: (_kv_index(b, h, kvh),
+                                            _kx(b, i, j, c, x), 0)),
         pl.BlockSpec((1, block_k, d),
-                     lambda b, i, j: (_kv_index(b, h, kvh), j, 0)),
+                     lambda b, i, j, c, x: (_kv_index(b, h, kvh),
+                                            _kx(b, i, j, c, x), 0)),
     ]
     inputs = [q, k, v]
     if has_segments:
         in_specs += [
-            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b // h, 0, i)),
-            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h, 0, j)),
+            pl.BlockSpec((1, 8, block_q),
+                         lambda b, i, j, c, x: (b // h, 0, i)),
+            pl.BlockSpec((1, 8, block_k),
+                         lambda b, i, j, c, x: (b // h, 0,
+                                                _kx(b, i, j, c, x))),
         ]
         # sublane-replicated (b, 8, s): a flat (1, BQ) int block violates
         # Mosaic's (8, 128) min tile, same workaround as the lse rows
         inputs += [_seg3(q_seg), _seg3(k_seg)]
     if has_bands:
-        bspec = pl.BlockSpec((1, 8, block_k),
-                             lambda b, i, j: (_kv_index(b, h, mask_h), 0, j))
+        bspec = pl.BlockSpec(
+            (1, 8, block_k),
+            lambda b, i, j, c, x: (_kv_index(b, h, mask_h), 0,
+                                   _kx(b, i, j, c, x)))
         in_specs += [bspec] * 4
         inputs += list(_bands3(bands))
 
     return pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=sq,
-                          seq_k=sk, has_segments=has_segments,
-                          has_bands=has_bands),
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
-        ),
+                          seq_k=sk, h=h, gate_h=gate_h,
+                          has_segments=has_segments, has_bands=has_bands),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, c, x: (b, i, 0)),
+                pl.BlockSpec((1, 8, block_q), lambda b, i, j, c, x: (b, 0, i)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-replicated)
+                pltpu.VMEM((block_q, 128), jnp.float32),  # l
+                pltpu.VMEM((block_q, d), jnp.float32),    # acc
+            ]),
         out_shape=(
             _sds((bh, sq, d), q.dtype),
             _sds((bh, 8, sq), jnp.float32),
         ),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),   # m (lane-replicated)
-            pltpu.VMEM((block_q, 128), jnp.float32),   # l
-            pltpu.VMEM((block_q, d), jnp.float32),     # acc
-        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(*inputs)
+    )(cnt, kx, *inputs)
 
 
 # --------------------------------------------------------------------------
@@ -359,14 +444,20 @@ def _bwd_tile_common(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
         jnp.int32, (block_q, block_k), 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
+    # combined keep-mask, one select (VPU-bound kernel — see _flash_kernel)
+    keep = None
     if causal:
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        keep = q_pos >= k_pos
     if qs is not None:
-        s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
+        seg = qs[:, None] == ks[None, :]
+        keep = seg if keep is None else keep & seg
+    if seq_k % block_k != 0:
+        pad = k_pos < seq_k
+        keep = pad if keep is None else keep & pad
+    if keep is not None:
+        s = jnp.where(keep, s, NEG_INF)
     if bands is not None:
         s = _band_mask(s, bands, qi, ki, block_q, block_k)
-    if seq_k % block_k != 0:
-        s = jnp.where(k_pos < seq_k, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])                  # [BQ, BK]
     if seq_q % block_q != 0:
         # padded q rows have NaN lse — zero them via where (not multiply)
@@ -381,11 +472,95 @@ def _bwd_tile_common(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
     return p, ds
 
 
-def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
-                         seq_q, seq_k, has_segments=False, has_bands=False):
+def _flash_bwd_fused_kernel(*refs, scale, causal, block_q, block_k, seq_q,
+                            seq_k, h, kvh, gate_h, nq,
+                            has_segments=False, has_bands=False):
+    """ONE-pass backward (round-5): grid (b*kvh, t, j) with
+    t = q_head_in_group * nq + q_tile and j the COMPRESSED k-tile slot.
+    Each live tile recomputes (p, ds) once and feeds all three grads —
+    dq into a [BQ, d] scratch (flushed per q row), dk/dv into
+    full-sequence VMEM scratch (flushed once per kv head at the end) —
+    5 matmuls/tile vs 7 for the two-kernel split that recomputed the
+    score matrix twice (reference ships one backward kernel for the same
+    reason: paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu)."""
     refs = list(refs)
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
-    pos = 6
+    cnt_ref, kx_ref = refs[:2]
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[2:8]
+    pos = 8
+    qs_ref = ks_ref = None
+    if has_segments:
+        qs_ref, ks_ref = refs[pos:pos + 2]
+        pos += 2
+    band_refs = None
+    if has_bands:
+        band_refs = refs[pos:pos + 4]
+        pos += 4
+    dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr = refs[pos:]
+    b2, t, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nt, nk = pl.num_programs(1), pl.num_programs(2)
+    rep = h // kvh
+    qi = t % nq
+    bh = (b2 // kvh) * h + (b2 % kvh) * rep + t // nq
+    row = _kv_index(bh, h, gate_h)
+    ki = kx_ref[row, qi, j]
+
+    @pl.when((t == 0) & (j == 0))
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0]
+        do = do_ref[0]
+        if seq_q % block_q != 0:
+            q = _mask_rows(q, qi * block_q, seq_q, block_q)
+            do = _mask_rows(do, qi * block_q, seq_q, block_q)
+        k = k_ref[0]
+        v = v_ref[0]
+        if seq_k % block_k != 0:
+            k = _mask_rows(k, ki * block_k, seq_k, block_k)
+            v = _mask_rows(v, ki * block_k, seq_k, block_k)
+        p, ds = _bwd_tile_common(
+            q, k, v, do, lse_ref[0, 0], delta_ref[0, 0], qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            seq_q=seq_q, seq_k=seq_k,
+            qs=None if qs_ref is None else qs_ref[0, 0],
+            ks=None if ks_ref is None else ks_ref[0, 0],
+            bands=[b[0, 0] for b in band_refs] if has_bands else None)
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BQ, d]
+        off = ki * block_k
+        dv_scr[pl.ds(off, block_k), :] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BK, d]
+        dk_scr[pl.ds(off, block_k), :] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BK, d]
+
+    pl.when(j < cnt_ref[row, qi])(compute)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+    @pl.when((t == nt - 1) & (j == nk - 1))
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
+                         seq_q, seq_k, h, gate_h,
+                         has_segments=False, has_bands=False):
+    refs = list(refs)
+    cnt_ref, kx_ref = refs[:2]
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[2:8]
+    pos = 8
     qs_ref = ks_ref = None
     if has_segments:
         qs_ref, ks_ref = refs[pos:pos + 2]
@@ -395,10 +570,12 @@ def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
         band_refs = refs[pos:pos + 4]
         pos += 4
     dq_ref, acc_scr = refs[pos:]
-    qi, ki = pl.program_id(1), pl.program_id(2)
+    bh, qi, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
+    row = _kv_index(bh, h, gate_h)
+    ki = kx_ref[row, qi, j]
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
@@ -419,25 +596,25 @@ def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BQ, d]
 
-    _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
-               seq_q, seq_k,
-               qs_ref[0, 0] if has_segments else None,
-               ks_ref[0, 0] if has_segments else None,
-               bands=[b[0, 0] for b in band_refs] if has_bands else None)
+    pl.when(j < cnt_ref[row, qi])(compute)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nk - 1)
     def _():
         dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
-                          seq_k, nq, has_segments=False, has_bands=False):
-    """Grid (b*kvh, ki, t) with t = q_head_in_group * nq + q_tile — the
-    whole kv group's q heads iterate innermost so dk/dv out-block revisits
+                          seq_k, nq, h, kvh, gate_h,
+                          has_segments=False, has_bands=False):
+    """Fallback (sequence too long for the fused kernel's full-seq dk/dv
+    scratch): grid (b*kvh, ki, t) with t = q_head_in_group * nq + jq and
+    jq the COMPRESSED q-tile slot (transposed live tables) — the whole
+    kv group's q heads iterate innermost so dk/dv out-block revisits
     stay consecutive (a Pallas requirement)."""
     refs = list(refs)
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
-    pos = 6
+    cnt_ref, qx_ref = refs[:2]
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[2:8]
+    pos = 8
     qs_ref = ks_ref = None
     if has_segments:
         qs_ref, ks_ref = refs[pos:pos + 2]
@@ -447,9 +624,12 @@ def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
         band_refs = refs[pos:pos + 4]
         pos += 4
     dk_ref, dv_ref, dk_scr, dv_scr = refs[pos:]
-    ki, t = pl.program_id(1), pl.program_id(2)
+    b2, ki, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nt = pl.num_programs(2)
-    qi = t % nq
+    rep = h // kvh
+    bh = (b2 // kvh) * h + (b2 % kvh) * rep + t // nq
+    row = _kv_index(bh, h, gate_h)
+    qi = qx_ref[row, ki, t % nq]
 
     @pl.when(t == 0)
     def _():
@@ -476,11 +656,7 @@ def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BK, d]
 
-    _tile_gate(compute, causal, has_segments, qi, ki, block_q, block_k,
-               seq_q, seq_k,
-               qs_ref[0, 0] if has_segments else None,
-               ks_ref[0, 0] if has_segments else None,
-               bands=[b[0, 0] for b in band_refs] if has_bands else None)
+    pl.when((t % nq) < cnt_ref[row, ki])(compute)
 
     @pl.when(t == nt - 1)
     def _():
@@ -488,100 +664,219 @@ def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_q,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+# full-sequence dk/dv scratch budget for the fused backward (VMEM is
+# ~16MB/core; leave room for the streamed blocks + double buffering)
+_FUSED_BWD_VMEM_BUDGET = 6 * 2 ** 20
+
+
 def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
                     h: int, kvh: int, block_q: int = 512, block_k: int = 512,
                     interpret: bool = False, q_seg=None, k_seg=None,
                     bands=None, mask_h: int = 1):
     """q/o/do: [b*h, s, d]; k/v: [b*kvh, s, d].  Returns (dq [b*h,..],
-    dk, dv [b*kvh,..]) — kv grads summed over each GQA group in-kernel."""
+    dk, dv [b*kvh,..]) — kv grads summed over each GQA group in-kernel.
+
+    Dispatch: ONE fused kernel (5 matmuls/tile, k tiles compressed to the
+    live list) when the full-sequence dk/dv scratch fits VMEM; otherwise
+    the two-kernel split (dq + dkv), also with compressed tile lists."""
     bh, sq, d = q.shape
     bkv, sk, _ = k.shape
     rep = h // kvh
     block_q = _clamp_block(block_q, sq)
     block_k = _clamp_block(block_k, sk)
+    # the backward holds three [BQ, BK] f32 tile intermediates (s/p/ds)
+    # PLUS (fused path) the full-sequence dk/dv scratch in VMEM at once:
+    # clamp the tile area (k side first — with the compressed live lists
+    # dead-tile overhead no longer argues for huge tiles) so scoped VMEM
+    # stays under the ~16MB/core limit (measured: 1024x1024 tiles +
+    # 6144x64 scratch blow it at 18.6MB; 1024x512 fits)
+    while block_q * block_k > 512 * 1024 and (block_q > 128 or block_k > 128):
+        if block_k >= block_q and block_k > 128:
+            block_k //= 2
+        else:
+            block_q //= 2
     nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
     has_segments = q_seg is not None
     has_bands = bands is not None
+    gate_h = mask_h if has_bands else 1
+    b = bh // h
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                        # [bh, sq]
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, sq))
 
+    live = _live_tables(b, mask_h if has_bands else 1, nq, nk, block_q,
+                        block_k, sq, sk, causal, q_seg=q_seg, k_seg=k_seg,
+                        bands=bands)
+    cnt, kx = _compress_live(live)
+
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, seq_q=sq, seq_k=sk,
                   has_segments=has_segments, has_bands=has_bands)
-    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    if has_segments:
+        q_seg = _seg3(q_seg)
+        k_seg = _seg3(k_seg)
+    if has_bands:
+        bands = _bands3(bands)
+
+    def _qflat(b2, t):
+        return (b2 // kvh) * h + (b2 % kvh) * rep + t // nq
+
+    sk_pad = nk * block_k
+    if 2 * sk_pad * d * 4 <= _FUSED_BWD_VMEM_BUDGET:
+        # ---- fused one-pass backward: grid (b*kvh, qhead*nq + qi, j) ----
+        def _kxf(b2, t, j, c, x):
+            return x[_kv_index(_qflat(b2, t), h, gate_h), t % nq, j]
+
+        qspec = pl.BlockSpec((1, block_q, d),
+                             lambda b2, t, j, c, x: (_qflat(b2, t),
+                                                     t % nq, 0))
+        kspec = pl.BlockSpec((1, block_k, d),
+                             lambda b2, t, j, c, x: (b2, _kxf(b2, t, j, c, x),
+                                                     0))
+        rowspec = pl.BlockSpec((1, 8, block_q),
+                               lambda b2, t, j, c, x: (_qflat(b2, t), 0,
+                                                       t % nq))
+        in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+        inputs = [q, k, v, do, lse, delta]
+        if has_segments:
+            in_specs += [
+                pl.BlockSpec((1, 8, block_q),
+                             lambda b2, t, j, c, x: (b2 // kvh, 0, t % nq)),
+                pl.BlockSpec((1, 8, block_k),
+                             lambda b2, t, j, c, x: (b2 // kvh, 0,
+                                                     _kxf(b2, t, j, c, x))),
+            ]
+            inputs += [q_seg, k_seg]
+        if has_bands:
+            bspec = pl.BlockSpec(
+                (1, 8, block_k),
+                lambda b2, t, j, c, x: ((b2 // kvh) * mask_h
+                                        + ((b2 % kvh) * mask_h) // kvh, 0,
+                                        _kxf(b2, t, j, c, x)))
+            in_specs += [bspec] * 4
+            inputs += list(bands)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_fused_kernel, **common, h=h,
+                              kvh=kvh, gate_h=gate_h, nq=nq),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bkv, rep * nq, nk),
+                in_specs=in_specs,
+                out_specs=[
+                    qspec,
+                    pl.BlockSpec((1, sk_pad, d),
+                                 lambda b2, t, j, c, x: (b2, 0, 0)),
+                    pl.BlockSpec((1, sk_pad, d),
+                                 lambda b2, t, j, c, x: (b2, 0, 0)),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((block_q, d), jnp.float32),
+                    pltpu.VMEM((sk_pad, d), jnp.float32),
+                    pltpu.VMEM((sk_pad, d), jnp.float32),
+                ]),
+            out_shape=(_sds((bh, sq, d), q.dtype),
+                       _sds((bkv, sk_pad, d), k.dtype),
+                       _sds((bkv, sk_pad, d), v.dtype)),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(cnt, kx, *inputs)
+        return dq, dk[:, :sk], dv[:, :sk]
+
+    # ---- fallback: two kernels (dq then dkv), compressed tile lists ----
+    def _kxd(bb, i, j, c, x):
+        return x[_kv_index(bb, h, gate_h), i, j]
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j, c, x: (b, i, 0))
     kspec = pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (_kv_index(b, h, kvh), j, 0))
-    rowspec = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
+                         lambda b, i, j, c, x: (_kv_index(b, h, kvh),
+                                                _kxd(b, i, j, c, x), 0))
+    rowspec = pl.BlockSpec((1, 8, block_q), lambda b, i, j, c, x: (b, 0, i))
 
     dq_in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
     dq_inputs = [q, k, v, do, lse, delta]
     if has_segments:
-        q_seg = _seg3(q_seg)
-        k_seg = _seg3(k_seg)
         dq_in_specs += [
-            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b // h, 0, i)),
-            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h, 0, j)),
+            pl.BlockSpec((1, 8, block_q),
+                         lambda b, i, j, c, x: (b // h, 0, i)),
+            pl.BlockSpec((1, 8, block_k),
+                         lambda b, i, j, c, x: (b // h, 0,
+                                                _kxd(b, i, j, c, x))),
         ]
         dq_inputs += [q_seg, k_seg]
     if has_bands:
-        bands = _bands3(bands)
-        bspec = pl.BlockSpec((1, 8, block_k),
-                             lambda b, i, j: (_kv_index(b, h, mask_h), 0, j))
+        bspec = pl.BlockSpec(
+            (1, 8, block_k),
+            lambda b, i, j, c, x: (_kv_index(b, h, mask_h), 0,
+                                   _kxd(b, i, j, c, x)))
         dq_in_specs += [bspec] * 4
         dq_inputs += list(bands)
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, **common),
-        grid=(bh, nq, pl.cdiv(sk, block_k)),
-        in_specs=dq_in_specs,
-        out_specs=qspec,
+        functools.partial(_flash_bwd_dq_kernel, **common, h=h,
+                          gate_h=gate_h),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nq, nk),
+            in_specs=dq_in_specs,
+            out_specs=qspec,
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]),
         out_shape=_sds((bh, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(*dq_inputs)
+    )(cnt, kx, *dq_inputs)
 
-    # dkv grid: (b*kvh, ki, t) with t covering the group's q heads x tiles
-    def _qflat(b2, t):
-        return (b2 // kvh) * h + (b2 % kvh) * rep + t // nq
+    # dkv grid: (b*kvh, ki, t) with t covering the group's q heads x
+    # COMPRESSED q tiles (transposed live tables)
+    cntq, qx = _compress_live(live.transpose(0, 2, 1))
+
+    def _qxi(b2, j, t, c, x):
+        return x[_kv_index(_qflat(b2, t), h, gate_h), j, t % nq]
 
     qspec2 = pl.BlockSpec((1, block_q, d),
-                          lambda b2, j, t: (_qflat(b2, t), t % nq, 0))
-    kspec2 = pl.BlockSpec((1, block_k, d), lambda b2, j, t: (b2, j, 0))
+                          lambda b2, j, t, c, x: (_qflat(b2, t),
+                                                  _qxi(b2, j, t, c, x), 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b2, j, t, c, x: (b2, j, 0))
     rowspec2 = pl.BlockSpec((1, 8, block_q),
-                            lambda b2, j, t: (_qflat(b2, t), 0, t % nq))
+                            lambda b2, j, t, c, x: (_qflat(b2, t), 0,
+                                                    _qxi(b2, j, t, c, x)))
     kv_in_specs = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
     kv_inputs = [q, k, v, do, lse, delta]
     if has_segments:
         kv_in_specs += [
             pl.BlockSpec((1, 8, block_q),
-                         lambda b2, j, t: (b2 // kvh, 0, t % nq)),
-            pl.BlockSpec((1, 8, block_k), lambda b2, j, t: (b2 // kvh, 0, j)),
+                         lambda b2, j, t, c, x: (b2 // kvh, 0,
+                                                 _qxi(b2, j, t, c, x))),
+            pl.BlockSpec((1, 8, block_k),
+                         lambda b2, j, t, c, x: (b2 // kvh, 0, j)),
         ]
         kv_inputs += [q_seg, k_seg]
     if has_bands:
         # map the kv-flat grid index to its mask row (mask_h is 1 or kvh)
         bspec2 = pl.BlockSpec(
             (1, 8, block_k),
-            lambda b2, j, t: ((b2 // kvh) * mask_h
-                              + ((b2 % kvh) * mask_h) // kvh, 0, j))
+            lambda b2, j, t, c, x: ((b2 // kvh) * mask_h
+                                    + ((b2 % kvh) * mask_h) // kvh, 0, j))
         kv_in_specs += [bspec2] * 4
         kv_inputs += list(bands)
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, **common, nq=nq),
-        grid=(bkv, pl.cdiv(sk, block_k), rep * nq),
-        in_specs=kv_in_specs,
-        out_specs=(kspec2, kspec2),
+        functools.partial(_flash_bwd_dkv_kernel, **common, nq=nq, h=h,
+                          kvh=kvh, gate_h=gate_h),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bkv, nk, rep * nq),
+            in_specs=kv_in_specs,
+            out_specs=[kspec2, kspec2],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)]),
         out_shape=(_sds((bkv, sk, d), k.dtype),
                    _sds((bkv, sk, d), v.dtype)),
-        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
-                        pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(*kv_inputs)
+    )(cntq, qx, *kv_inputs)
     return dq, dk, dv
 
 
@@ -639,7 +934,11 @@ def _select_blocks(q, k, v, causal, scale, h, kvh, interpret,
         return cached
     if (not _at.enabled() or interpret
             or isinstance(q, jax.core.Tracer)):
-        return 512, 512
+        # r5 default: with the compressed live lists dead tiles cost no
+        # DMA, so bigger tiles win on the pipeline/VPU floor (v5e,
+        # flagship shape s1024 d128: fwd 0.64 vs 0.89ms, fwd+bwd 1.42 vs
+        # 1.53ms; d64 padded-dense fwd+bwd 2.88 vs 3.01ms)
+        return 1024, 1024
     cands = [(bq, bk) for bq, bk in _BLOCK_CANDIDATES
              if bq <= max(sq, 256) and bk <= max(sk, 256)]
 
@@ -740,8 +1039,9 @@ def flash_attn_unpadded_raw(q, k, v, cu_seqlens_q, cu_seqlens_k,
                             scale=None, causal: bool = False,
                             interpret=None):
     """Ragged flash attention on a PACKED token stream — no padding
-    compute at all, and disjoint-segment (q, k) tiles skip the MXU work
-    via the kernel's segment-interval gate (_tile_gate).
+    compute at all, and disjoint-segment (q, k) tiles skip BOTH the MXU
+    work and the k/v DMA via the compressed live-tile lists
+    (_live_tables/_compress_live scalar-prefetch index maps).
 
     q: [total_q, h, d]; k, v: [total_k, kvh, d]; cu_seqlens_*: [b+1]
     int32 cumulative offsets (reference flash_attn_unpadded layout).
