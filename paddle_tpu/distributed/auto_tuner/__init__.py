@@ -55,7 +55,8 @@ def default_candidates(tuner_cfg: Dict[str, Any]) -> Dict[str, List]:
     v = tuner_cfg.get("use_recompute", [False, True])
     out["use_recompute"] = list(v) if isinstance(v, (list, tuple)) else [bool(v)]
     v = tuner_cfg.get("pipeline_schedule", ["1F1B"])
-    out["pipeline_schedule"] = (["FThenB", "1F1B", "VPP", "ZBH1"]
+    out["pipeline_schedule"] = (["FThenB", "1F1B", "VPP", "ZBH1",
+                                 "ZBV"]
                                 if v == "auto" else
                                 (list(v) if isinstance(v, (list, tuple))
                                  else [str(v)]))
@@ -154,6 +155,8 @@ def prune_by_schedule_cost(tuner_cfg, cur, history):
     v = int(tuner_cfg.get("vpp_chunks", 2))
     layers = int(tuner_cfg.get("num_layers", 0))
     if sched == "VPP" and (v < 2 or (layers and layers % (p * v))):
+        return True
+    if sched == "ZBV" and layers and layers % (p * 2):
         return True
     if layers and layers % p:
         return True

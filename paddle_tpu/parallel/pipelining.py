@@ -90,6 +90,19 @@ def stack_stage_params(per_stage_params: list) -> Any:
                                   *per_stage_params)
 
 
+def device_major_order(sched):
+    """Placement-aware device-major position list for a Schedule:
+    stacked position r*v + j holds global stage ``sched.stage_of(r, j)``
+    (Megatron-interleaved for VPP, zigzag for ZBV).  Returns (order,
+    inverse) with the same contract as vpp_device_major_order."""
+    p, v = sched.p, sched.v
+    order = [sched.stage_of(r, j) for r in range(p) for j in range(v)]
+    inv = [0] * (p * v)
+    for pos, st in enumerate(order):
+        inv[st] = pos
+    return order, inv
+
+
 def vpp_device_major_order(p: int, v: int):
     """Megatron VPP placement as a position list: stacked position
     r*v + j holds global stage j*p + r (device-major), so sharding dim 0
@@ -173,10 +186,11 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
     mb_t = jnp.asarray(sched.mb)
     chunk_t = jnp.asarray(sched.chunk)
     slot_t = jnp.asarray(sched.slot)
-    frs_t = jnp.asarray(sched.frecv_slot)
-    frm_t = jnp.asarray(sched.frecv_mask)
-    grs_t = jnp.asarray(sched.grecv_slot)
-    grm_t = jnp.asarray(sched.grecv_mask)
+    rs_t = jnp.asarray(sched.recv_slot)      # [3, p, ticks] per channel
+    rm_t = jnp.asarray(sched.recv_mask)
+    ri_t = jnp.asarray(sched.recv_isact)
+    asend_t = jnp.asarray(sched.asend_ch)
+    gsend_t = jnp.asarray(sched.gsend_ch)
 
     def _varying(z):
         try:
@@ -187,8 +201,10 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
     S = sched.num_slots
     stash0 = _varying(jnp.zeros((S,) + act_shape, act_dtype))
     gin0 = _varying(jnp.zeros((S,) + act_shape, act_dtype))
-    fcarry0 = _varying(jnp.zeros(act_shape, act_dtype))
-    bcarry0 = _varying(jnp.zeros(act_shape, act_dtype))
+    # one carry per comm channel: rightward ring, leftward ring, local
+    # (the V placement's same-rank stage hand-off)
+    carries0 = tuple(_varying(jnp.zeros(act_shape, act_dtype))
+                     for _ in range(3))
     gacc0 = jax.tree_util.tree_map(
         lambda a: _varying(jnp.zeros(a.shape, jnp.float32)), stage_params)
     # loss-head grads (final norm/LM head outside the stages) and the
@@ -201,8 +217,11 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
         if want_x_grad else _varying(jnp.zeros((), jnp.float32))
     loss0 = _varying(jnp.zeros((), jnp.float32))
 
-    is_last = (me == p - 1)      # last GLOBAL stage = chunk v-1 on rank p-1
-    is_first = (me == 0)         # first global stage = chunk 0 on rank 0
+    # placement-aware: interleaved puts the last global stage on rank
+    # p-1; the ZBV zigzag turns back so rank 0 holds BOTH stage 0 and
+    # the last stage (v even)
+    is_last = (me == sched.rank_of_stage(p * sched.v - 1))
+    is_first = (me == sched.rank_of_stage(0))
 
     def _chunk_params(ch):
         return jax.tree_util.tree_map(
@@ -214,15 +233,19 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
                                                idx, 0)
 
     def tick(t, carry):
-        stash, gin, fcarry, bcarry, gacc, lacc, dxs, loss_acc = carry
+        stash, gin, carries, gacc, lacc, dxs, loss_acc = carry
 
-        # 1) store this tick's arrivals (what last tick's ppermute brought)
-        frs, frm = frs_t[me, t], frm_t[me, t]
-        cur = lax.dynamic_index_in_dim(stash, frs, 0, keepdims=False)
-        stash = _upd(stash, jnp.where(frm == 1, fcarry, cur), frs)
-        grs, grm = grs_t[me, t], grm_t[me, t]
-        curg = lax.dynamic_index_in_dim(gin, grs, 0, keepdims=False)
-        gin = _upd(gin, jnp.where(grm == 1, bcarry, curg), grs)
+        # 1) store this tick's arrivals (what last tick's channels
+        # delivered): per channel, an activation goes to the stash, an
+        # upstream grad to the grad buffer
+        for ch in range(3):
+            sl_, mk, ia = rs_t[ch, me, t], rm_t[ch, me, t], ri_t[ch, me, t]
+            cur = lax.dynamic_index_in_dim(stash, sl_, 0, keepdims=False)
+            stash = _upd(stash, jnp.where((mk == 1) & (ia == 1),
+                                          carries[ch], cur), sl_)
+            curg = lax.dynamic_index_in_dim(gin, sl_, 0, keepdims=False)
+            gin = _upd(gin, jnp.where((mk == 1) & (ia == 0),
+                                      carries[ch], curg), sl_)
 
         k = kind_t[me, t]
         mb = jnp.maximum(mb_t[me, t], 0)
@@ -324,22 +347,32 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
         stash, gin, gacc, lacc, dxs, loss_acc, fsend, bsend = lax.switch(
             k, branches, stash, gin, gacc, lacc, dxs, loss_acc)
 
+        # route the op's outputs onto their channels: the activation and
+        # the dx each go right / left / local per the schedule tables
+        # (interleaved: acts always right, grads always left; ZBV: odd
+        # chunks reverse, the V turn stays local).  One op per tick
+        # produces at most one act and one dx, so a channel carries at
+        # most one value.
+        adir, gdir = asend_t[me, t], gsend_t[me, t]
+        sends = [jnp.where(adir == ch, fsend, 0).astype(act_dtype)
+                 + jnp.where(gdir == ch, bsend, 0).astype(act_dtype)
+                 for ch in range(3)]
         # the two directional permutes are data-INDEPENDENT (and so are
         # the fwd chains of CONSECUTIVE ticks); without explicit ordering
         # edges, per-device thunk schedulers can enter collectives in
         # different orders and deadlock the rendezvous (observed on
         # XLA:CPU with auto batch axes alongside manual pp).  Two
-        # barriers pin the global order fwd(t) -> bwd(t) -> fwd(t+1): the
-        # first sequences the pair inside the tick, the second makes
-        # EVERY carry output (hence all of tick t+1) depend on bwd(t).
-        fcarry = _compat.ppermute(fsend, axis, perm_r)
-        fcarry, bsend = lax.optimization_barrier((fcarry, bsend))
-        bcarry = _compat.ppermute(bsend, axis, perm_l)
+        # barriers pin the global order right(t) -> left(t) -> right(t+1):
+        # the first sequences the pair inside the tick, the second makes
+        # EVERY carry output (hence all of tick t+1) depend on left(t).
+        c0 = _compat.ppermute(sends[0], axis, perm_r)
+        c0, s1 = lax.optimization_barrier((c0, sends[1]))
+        c1 = _compat.ppermute(s1, axis, perm_l)
         return lax.optimization_barrier(
-            (stash, gin, fcarry, bcarry, gacc, lacc, dxs, loss_acc))
+            (stash, gin, (c0, c1, sends[2]), gacc, lacc, dxs, loss_acc))
 
-    init = (stash0, gin0, fcarry0, bcarry0, gacc0, lacc0, dxs0, loss0)
-    _, _, _, _, gacc, lacc, dxs, loss_acc = lax.fori_loop(
+    init = (stash0, gin0, carries0, gacc0, lacc0, dxs0, loss0)
+    _, _, _, gacc, lacc, dxs, loss_acc = lax.fori_loop(
         0, sched.ticks, tick, init)
     # only the last rank accumulated real losses; share it
     loss = _compat.psum(jnp.where(is_last, loss_acc, 0.0), axis)

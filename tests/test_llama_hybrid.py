@@ -199,6 +199,27 @@ def test_hybrid_vpp_parity():
     _assert_state_close(params, base_params)
 
 
+def test_hybrid_zbv_parity():
+    """ZBV zero-bubble V schedule on the flagship: 4 layers in the
+    zigzag placement (device r holds stages {r, 2p-1-r}; chunk-1
+    activations flow LEFT, the V turn stays on-rank) — loss and param
+    parity vs the pp=1 step (reference pipeline_zero_bubble.py:343
+    VScheduleCreator)."""
+    cfg = LlamaConfig.debug(vocab=128, hidden=32, layers=4, heads=4,
+                            kv_heads=2, inter=64, max_pos=64)
+    model = LlamaForCausalLM(cfg)
+    state0 = {k: v.copy() for k, v in model.functional_state().items()}
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    base_loss, base_params = _baseline(model, state0, ids, labels)
+    mesh = hybrid_mesh(jax.devices("cpu"), pp=2, sep=2, mp=2)
+    loss, params = _hybrid(cfg, model, state0, ids, labels, mesh,
+                           num_microbatches=2, schedule="ZBV")
+    np.testing.assert_allclose(loss, base_loss, rtol=1e-4)
+    _assert_state_close(params, base_params)
+
+
 def test_hybrid_bf16_parity():
     """The composed flagship in bf16 (fp32 masters, loss-scale-free):
     genuinely bf16 compute on the CPU CI backend via cpu_bf16='fp32-wire'

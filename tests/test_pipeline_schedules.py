@@ -60,11 +60,16 @@ def _reference(params, x, y):
 
 
 def _run_sched(name, v=1):
+    from paddle_tpu.parallel.pipelining import device_major_order
+
+    sched = build_schedule(name, p=PP, m=M, v=v)
+    v = sched.v
     nstage = PP * v
     params, x, y = _make_problem(nstage)
-    sched = build_schedule(name, p=PP, m=M, v=v)
-    stacked = (stack_stage_params_interleaved(params, PP) if v > 1
-               else stack_stage_params(params))
+    # stack by the schedule's placement (interleaved for VPP, zigzag
+    # for ZBV): position r*v + j holds stage sched.stage_of(r, j)
+    order, _ = device_major_order(sched)
+    stacked = stack_stage_params([params[s] for s in order])
     pspec = {"w": P("pp", None, None), "b": P("pp", None)}
 
     def body(sp, x, y):
@@ -78,11 +83,6 @@ def _run_sched(name, v=1):
     ref_loss, ref_grads = _reference(params, x, y)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5,
                                err_msg=f"{name}: loss mismatch")
-    # grads arrive in stacked order; map back to per-stage for comparison
-    if v > 1:
-        order = [j * PP + r for r in range(PP) for j in range(v)]
-    else:
-        order = list(range(nstage))
     for pos, stage in enumerate(order):
         for key in ("w", "b"):
             np.testing.assert_allclose(
@@ -98,6 +98,43 @@ def test_schedule_parity(name):
 
 def test_vpp_parity():
     _run_sched("VPP", v=2)
+
+
+def test_zbv_parity():
+    """ZBV (zero-bubble V, zigzag placement): exact loss+grad parity on
+    the executor — the odd chunk's activations flow LEFT and the p-1->p
+    hop stays on-rank, exercising all three comm channels (reference:
+    pipeline_zero_bubble.py:343 VScheduleCreator)."""
+    _run_sched("ZBV", v=2)
+
+
+def test_zbv_placement_and_memory():
+    from paddle_tpu.parallel.schedules import build_schedule
+
+    s = build_schedule("ZBV", PP, M)
+    # zigzag: rank p-1 owns the V turn (stages p-1 and p); rank 0 owns
+    # first AND last global stages
+    assert s.stage_of(PP - 1, 0) == PP - 1
+    assert s.stage_of(PP - 1, 1) == PP
+    assert s.rank_of_stage(2 * PP - 1) == 0
+    # memory parity with 1F1B: <= 2p half-layer chunk slots (+2 slack)
+    assert s.num_slots <= 2 * PP + 2, s.num_slots
+
+
+def test_zbv_beats_zbh1_bubble_fraction():
+    """The ZBV claim (VERDICT r4 next#7 'done' bar): modelled bubble
+    fraction below ZBH1's at v=2 under equal F/Bx/W times (ZBV chunk ops
+    are half-size: its per-op times scale by 1/2)."""
+    from paddle_tpu.parallel.schedules import build_schedule, simulate_cost
+
+    for p, m in [(4, 8), (4, 16), (8, 16), (8, 32)]:
+        cv = simulate_cost(build_schedule("ZBV", p, m),
+                           t_f=0.5, t_b=1.0, t_w=0.5)
+        ch = simulate_cost(build_schedule("ZBH1", p, m),
+                           t_f=1.0, t_b=2.0, t_w=1.0)
+        assert cv.bubble_frac < ch.bubble_frac, \
+            (p, m, cv.bubble_frac, ch.bubble_frac)
+        assert cv.makespan < ch.makespan, (p, m)
 
 
 def test_1f1b_memory_bound():
@@ -152,18 +189,20 @@ def test_cost_model_matches_analytic_bubbles():
 
 
 def test_cost_model_ranking():
-    """ZBH1 < VPP < 1F1B/FThenB on makespan at zero p2p cost — the
+    """ZBV < ZBH1 < VPP < 1F1B/FThenB on makespan at zero p2p cost — the
     zero-bubble and interleaving claims, reproduced by simulation on
-    >=3 configs (VERDICT r3 next#10)."""
+    >=3 configs (VERDICT r3 next#10; r4 next#7 adds ZBV on top)."""
     from paddle_tpu.parallel.schedules import rank_schedules
 
     for p, m in [(4, 8), (4, 16), (8, 8)]:
         ranked = rank_schedules(p, m, t_f=1.0, t_b=2.0)
         names = [c.name for c in ranked]
-        assert names[0] == "ZBH1", (p, m, names)
-        assert names[1] == "VPP", (p, m, names)
+        assert names[0] == "ZBV", (p, m, names)
+        assert names[1] == "ZBH1", (p, m, names)
+        assert names[2] == "VPP", (p, m, names)
         spans = {c.name: c.makespan for c in ranked}
-        assert spans["ZBH1"] < spans["VPP"] < spans["1F1B"] + 1e-9
+        assert spans["ZBV"] < spans["ZBH1"] < spans["VPP"] \
+            < spans["1F1B"] + 1e-9
 
 
 def test_cost_model_p2p_penalises_vpp():
