@@ -31,6 +31,51 @@ __all__ = ["masked_multihead_attention", "block_multihead_attention",
            "memory_efficient_attention", "flash_decoding"]
 
 
+# --------------------------------------------------------------------------
+# int8 KV-cache quantization (reference: fused_ops.yaml:46-67
+# block_multihead_attention's cache_k/v_quant_scales /
+# cache_k/v_dequant_scales / dynamic_cachekv_quant / quant_round_type /
+# max_bound / min_bound args; kernel
+# paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)
+# --------------------------------------------------------------------------
+
+def _quant_round(x, round_type: int):
+    """0 = round-nearest-ties-even; 1 = round-half-away-from-zero (the
+    reference's two quant_round_type modes)."""
+    if int(round_type) == 0:
+        return jnp.rint(x)
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+def quant_to_int8(x, scale, round_type: int = 1, max_bound: float = 127.0,
+                  min_bound: float = -127.0):
+    """Quantize [..., KVH, D] values with per-head ``scale`` ([KVH]
+    static or [B, KVH] dynamic) into int8 cache entries."""
+    s = jnp.asarray(scale, jnp.float32)
+    if s.ndim == 1:                 # [KVH] -> broadcast over batch
+        s = s[None]
+    y = _quant_round(x.astype(jnp.float32) * s[..., None], round_type)
+    return jnp.clip(y, min_bound, max_bound).astype(jnp.int8)
+
+
+def _expand_kv_scale_to_q_heads(scale, b, h, kvh):
+    """[KVH] or [B, KVH] dequant scale -> [B, H, 1] over the GQA group
+    (each q head uses its kv head's scale)."""
+    s = jnp.asarray(scale, jnp.float32)
+    if s.ndim == 1:
+        s = jnp.broadcast_to(s[None], (b, kvh))
+    return jnp.repeat(s, h // kvh, axis=1)[..., None]   # [B, H, 1]
+
+
+def _dynamic_absmax_scales(x, max_bound=127.0):
+    """Per-(batch, head) dynamic quant scales from the new token's
+    absmax: quant = bound/absmax, dequant = absmax/bound (the
+    dynamic_cachekv_quant mode computes scales on the fly)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)   # [B, KVH]
+    absmax = jnp.maximum(absmax, 1e-6)
+    return max_bound / absmax, absmax / max_bound
+
+
 def flash_decoding(q, k_cache, v_cache, seq_lens, scale=None):
     """Pallas flash-decoding step (ops/pallas/decode_attention.py): one
     query token per sequence against a dense KV cache, HBM traffic
@@ -44,9 +89,13 @@ def flash_decoding(q, k_cache, v_cache, seq_lens, scale=None):
 
 @register("masked_multihead_attention", amp="white")
 def _mmha_op(x, cache_kv, seq_lens, rotary_embs=None, *, num_heads: int,
-             head_dim: int, scale=None):
-    """One decode step. x [B, 3*H*D] fused qkv; cache_kv [2, B, H, T, D];
-    seq_lens [B] current lengths (new token is written at that offset).
+             head_dim: int, scale=None, cache_k_quant_scales=None,
+             cache_v_quant_scales=None, cache_k_dequant_scales=None,
+             cache_v_dequant_scales=None, quant_round_type=1,
+             max_bound=127.0, min_bound=-127.0):
+    """One decode step. x [B, 3*H*D] fused qkv; cache_kv [2, B, H, T, D]
+    (bf16/f32 or INT8 with the cache_*_scales quant args); seq_lens [B]
+    current lengths (new token is written at that offset).
     Returns (out [B, H*D], new_cache_kv)."""
     b = x.shape[0]
     h, d = num_heads, head_dim
@@ -59,14 +108,36 @@ def _mmha_op(x, cache_kv, seq_lens, rotary_embs=None, *, num_heads: int,
             rotated = jnp.concatenate([-t2, t1], axis=-1)
             return t * cos[:, None, :] + rotated * sin[:, None, :]
         q, k = rot(q), rot(k)
+    quantized = cache_kv.dtype == jnp.int8
+    if quantized:
+        if cache_k_quant_scales is None or cache_v_quant_scales is None \
+                or cache_k_dequant_scales is None \
+                or cache_v_dequant_scales is None:
+            raise ValueError(
+                "int8 KV cache needs cache_k/v_quant_scales AND "
+                "cache_k/v_dequant_scales (reference "
+                "masked_multihead_attention cachekv_quant contract)")
+        k = quant_to_int8(k, cache_k_quant_scales, quant_round_type,
+                          max_bound, min_bound)
+        v = quant_to_int8(v, cache_v_quant_scales, quant_round_type,
+                          max_bound, min_bound)
     bidx = jnp.arange(b)
     kc = cache_kv[0].at[bidx, :, seq_lens, :].set(k)    # [B, H, T, D]
     vc = cache_kv[1].at[bidx, :, seq_lens, :].set(v)
     # attention itself is the Pallas flash-decoding kernel: KV streamed
     # once with online softmax, HBM traffic bounded by seq_lens not T
+    # (int8 caches stream HALF the bytes; dequant scales fold into q and
+    # the output — see block_multihead_attention)
     from ...ops.pallas.decode_attention import flash_decode_raw
 
-    out = flash_decode_raw(q, kc, vc, seq_lens + 1, scale=scale)
+    qk = q
+    if quantized:
+        qk = (q.astype(jnp.float32) * _expand_kv_scale_to_q_heads(
+            cache_k_dequant_scales, b, h, h)).astype(q.dtype)
+    out = flash_decode_raw(qk, kc, vc, seq_lens + 1, scale=scale)
+    if quantized:
+        out = out.astype(jnp.float32) * _expand_kv_scale_to_q_heads(
+            cache_v_dequant_scales, b, h, h)
     return (out.reshape(b, h * d).astype(x.dtype),
             jnp.stack([kc, vc], axis=0))
 
@@ -80,7 +151,12 @@ register("masked_multihead_attention_", amp="white")(_mmha_op.raw_fn)
 def masked_multihead_attention(x, cache_kv, seq_lens, rotary_embs=None,
                                num_heads: Optional[int] = None,
                                head_dim: Optional[int] = None, scale=None,
-                               **kw):
+                               cache_k_quant_scales=None,
+                               cache_v_quant_scales=None,
+                               cache_k_dequant_scales=None,
+                               cache_v_dequant_scales=None,
+                               quant_round_type=1, max_bound=127.0,
+                               min_bound=-127.0, **kw):
     """Public wrapper (reference masked_multihead_attention_): infers
     (num_heads, head_dim) from the cache when not given."""
     if num_heads is None:
@@ -88,41 +164,111 @@ def masked_multihead_attention(x, cache_kv, seq_lens, rotary_embs=None,
     if head_dim is None:
         head_dim = cache_kv.shape[-1]
     return _mmha_op(x, cache_kv, seq_lens, rotary_embs,
-                    num_heads=num_heads, head_dim=head_dim, scale=scale)
+                    num_heads=num_heads, head_dim=head_dim, scale=scale,
+                    cache_k_quant_scales=cache_k_quant_scales,
+                    cache_v_quant_scales=cache_v_quant_scales,
+                    cache_k_dequant_scales=cache_k_dequant_scales,
+                    cache_v_dequant_scales=cache_v_dequant_scales,
+                    quant_round_type=quant_round_type,
+                    max_bound=max_bound, min_bound=min_bound)
 
 
 @register("block_multihead_attention", amp="white")
 def _block_mha_op(qkv, key_cache, value_cache, seq_lens, block_tables, *,
-                  scale=None):
+                  scale=None, cache_k_quant_scales=None,
+                  cache_v_quant_scales=None, cache_k_dequant_scales=None,
+                  cache_v_dequant_scales=None, quant_round_type=1,
+                  max_bound=127.0, min_bound=-127.0):
     """Paged decode step.
 
-    qkv [B, 3, H, D]; key/value_cache [NBlocks, H, BS, D]; seq_lens [B]
-    (tokens already in cache); block_tables [B, MaxBlocksPerSeq] int32
-    (-1 = unused). Writes the new token then attends over the pages.
+    qkv [B, 3, H, D]; key/value_cache [NBlocks, H, BS, D] (bf16/f32, or
+    INT8 with the cache_*_scales quant args — the serving memory-bound
+    path where int8 halves the cache stream); seq_lens [B] (tokens
+    already in cache); block_tables [B, MaxBlocksPerSeq] int32 (-1 =
+    unused).  Writes the new token then attends over the pages.
     Returns (out [B, H, D], key_cache, value_cache)."""
     b, _, h, d = qkv.shape
+    kvh = key_cache.shape[1]
     bs = key_cache.shape[2]
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    quantized = key_cache.dtype == jnp.int8
+    if quantized:
+        if cache_k_quant_scales is None or cache_v_quant_scales is None \
+                or cache_k_dequant_scales is None \
+                or cache_v_dequant_scales is None:
+            raise ValueError(
+                "int8 KV cache needs cache_k/v_quant_scales AND "
+                "cache_k/v_dequant_scales ([num_head] static or "
+                "[batch, num_head] dynamic — reference fused_ops.yaml "
+                "block_multihead_attention)")
+        kq = quant_to_int8(k, cache_k_quant_scales, quant_round_type,
+                           max_bound, min_bound)
+        vq = quant_to_int8(v, cache_v_quant_scales, quant_round_type,
+                           max_bound, min_bound)
+    else:
+        kq, vq = k, v
     # write the new token into its page slot
     blk_idx = seq_lens // bs
     slot = seq_lens % bs
     bidx = jnp.arange(b)
     phys = block_tables[bidx, blk_idx]                  # [B]
-    key_cache = key_cache.at[phys, :, slot, :].set(k)
-    value_cache = value_cache.at[phys, :, slot, :].set(v)
+    key_cache = key_cache.at[phys, :, slot, :].set(kq)
+    value_cache = value_cache.at[phys, :, slot, :].set(vq)
     # attention via the Pallas paged kernel: the page indirection lives
-    # in the DMA index map — no gathered [B, MB, H, BS, D] copy
+    # in the DMA index map — no gathered [B, MB, H, BS, D] copy.  The
+    # per-head dequant scales fold OUTSIDE the kernel: k's into q (they
+    # multiply q·k^T linearly), v's into the output — the kernel only
+    # widens int8 blocks after the (halved) DMA.
     from ...ops.pallas.decode_attention import paged_decode_raw
 
-    out = paged_decode_raw(q, key_cache, value_cache, seq_lens + 1,
+    qk = q
+    if quantized:
+        qk = (q.astype(jnp.float32) * _expand_kv_scale_to_q_heads(
+            cache_k_dequant_scales, b, h, kvh)).astype(q.dtype)
+    out = paged_decode_raw(qk, key_cache, value_cache, seq_lens + 1,
                            block_tables, scale=scale)
+    if quantized:
+        out = out.astype(jnp.float32) * _expand_kv_scale_to_q_heads(
+            cache_v_dequant_scales, b, h, kvh)
     return out.astype(qkv.dtype), key_cache, value_cache
 
 
 def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
-                              block_tables, scale=None, **kw):
+                              block_tables, scale=None,
+                              cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None,
+                              use_dynamic_cachekv_quant=False,
+                              quant_round_type=1, max_bound=127.0,
+                              min_bound=-127.0, **kw):
+    """Reference-parity entry (incubate/nn/functional/
+    block_multihead_attention.py): static scales are [num_head]; with
+    ``use_dynamic_cachekv_quant`` the caller maintains [batch, num_head]
+    running-absmax scales (helper: ``_dynamic_absmax_scales``) — the
+    running-max contract means a sequence's whole cache is covered by its
+    current scale.  The flag is validated against the scale RANK so a
+    mode/shape mismatch fails loudly instead of mis-broadcasting."""
+    if key_cache.dtype == jnp.int8 and cache_k_quant_scales is not None:
+        want = 2 if use_dynamic_cachekv_quant else 1
+        for nm, s in (("cache_k_quant_scales", cache_k_quant_scales),
+                      ("cache_v_quant_scales", cache_v_quant_scales),
+                      ("cache_k_dequant_scales", cache_k_dequant_scales),
+                      ("cache_v_dequant_scales", cache_v_dequant_scales)):
+            if s is not None and jnp.ndim(s) != want:
+                raise ValueError(
+                    f"{nm}: expected rank {want} "
+                    f"({'[batch, num_head] dynamic' if want == 2 else '[num_head] static'}"
+                    f" — use_dynamic_cachekv_quant={use_dynamic_cachekv_quant}),"
+                    f" got shape {jnp.shape(s)}")
     return _block_mha_op(qkv, key_cache, value_cache, seq_lens,
-                         block_tables, scale=scale)
+                         block_tables, scale=scale,
+                         cache_k_quant_scales=cache_k_quant_scales,
+                         cache_v_quant_scales=cache_v_quant_scales,
+                         cache_k_dequant_scales=cache_k_dequant_scales,
+                         cache_v_dequant_scales=cache_v_dequant_scales,
+                         quant_round_type=quant_round_type,
+                         max_bound=max_bound, min_bound=min_bound)
 
 
 @register("memory_efficient_attention", amp="white")

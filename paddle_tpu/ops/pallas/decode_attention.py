@@ -64,6 +64,14 @@ def _decode_kernel(*refs, block_k: int, scale: float):
     def compute():
         q = q_ref[0]                        # [kvh, rp, d]
         k = k_ref[0]                        # [kvh, block_k, d]
+        if k.dtype == jnp.int8:
+            # int8 KV cache: HALF the HBM traffic of bf16 on this
+            # bandwidth-bound kernel; the per-head dequant scales are
+            # folded into q (k side) and the output (v side) by the
+            # callers, so the kernel only widens the streamed block
+            # (reference: block_multi_head_attention_kernel.cu
+            # cachekv_quant path)
+            k = k.astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale  # [kvh, rp, BK]
@@ -77,6 +85,8 @@ def _decode_kernel(*refs, block_k: int, scale: float):
         p = jnp.exp(s - m_new)              # [kvh, rp, BK]
         l_new = l_scr[:, :, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0]                        # [kvh, BK, d]
+        if v.dtype == jnp.int8:
+            v = v.astype(q.dtype)
         # rows past slen carry whatever the cache holds (p there is 0,
         # but 0 * inf/nan would poison acc) — zero them
         rpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)

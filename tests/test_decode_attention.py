@@ -141,3 +141,149 @@ def test_memory_efficient_attention_grad():
                                rtol=1e-3, atol=1e-4)
     np.testing.assert_allclose(np.asarray(v.grad._value), np.asarray(gv),
                                rtol=1e-3, atol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# int8 KV cache (reference fused_ops.yaml block_multihead_attention
+# cache_k/v_quant_scales + dequant_scales + dynamic_cachekv_quant args)
+# --------------------------------------------------------------------------
+
+def test_masked_multihead_attention_int8_cache():
+    """Static per-head int8 cache quant: parity with the bf16-cache path
+    within quantization tolerance, and the cache itself stays int8."""
+    from paddle_tpu.incubate.nn.decode_attention import quant_to_int8
+
+    rng = np.random.RandomState(2)
+    b, h, d, t_max = 2, 4, 8, 16
+    lens = np.array([5, 9], np.int32)
+    raw = np.zeros((2, b, h, t_max, d), np.float32)
+    for bi in range(b):
+        raw[:, bi, :, :lens[bi]] = rng.randn(2, h, lens[bi], d)
+    x = rng.randn(b, 3 * h * d).astype(np.float32)
+
+    # per-head static scales from the cache contents' absmax
+    kabs = np.abs(raw[0]).max(axis=(0, 2, 3)) + 1e-6          # [H]
+    vabs = np.abs(raw[1]).max(axis=(0, 2, 3)) + 1e-6
+    kq_s, kdq_s = 127.0 / kabs * 0.5, kabs / 127.0 * 2.0      # headroom
+    vq_s, vdq_s = 127.0 / vabs * 0.5, vabs / 127.0 * 2.0
+    cache_i8 = np.stack([
+        np.asarray(quant_to_int8(jnp.asarray(raw[0].transpose(0, 2, 1, 3)
+                                             .reshape(b * t_max, h, d)),
+                                 jnp.asarray(kq_s))).reshape(b, t_max, h, d)
+        .transpose(0, 2, 1, 3),
+        np.asarray(quant_to_int8(jnp.asarray(raw[1].transpose(0, 2, 1, 3)
+                                             .reshape(b * t_max, h, d)),
+                                 jnp.asarray(vq_s))).reshape(b, t_max, h, d)
+        .transpose(0, 2, 1, 3),
+    ])
+
+    out_i8, cache2 = masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache_i8),
+        paddle.to_tensor(lens),
+        cache_k_quant_scales=jnp.asarray(kq_s),
+        cache_v_quant_scales=jnp.asarray(vq_s),
+        cache_k_dequant_scales=jnp.asarray(kdq_s),
+        cache_v_dequant_scales=jnp.asarray(vdq_s))
+    assert np.asarray(cache2._value).dtype == np.int8
+
+    out_ref, _ = masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(raw), paddle.to_tensor(lens))
+    got = np.asarray(out_i8._value)
+    want = np.asarray(out_ref._value)
+    err = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert err < 0.05, f"int8 cache rel err {err}"
+
+
+def test_block_multihead_attention_int8_cache_and_dynamic_scales():
+    """Paged int8 cache (static scales) + the dynamic [batch, num_head]
+    scale shape both run and match the fp32-cache result within quant
+    tolerance."""
+    from paddle_tpu.incubate.nn.decode_attention import (
+        _dynamic_absmax_scales, quant_to_int8)
+
+    rng = np.random.RandomState(3)
+    b, h, d, bs, nblocks, mb = 2, 2, 8, 4, 8, 3
+    lens = np.array([6, 10], np.int32)
+    tables = np.array([[3, 0, 5], [1, 7, 2]], np.int32)
+    dense_k = rng.randn(b, h, mb * bs, d).astype(np.float32)
+    dense_v = rng.randn(b, h, mb * bs, d).astype(np.float32)
+    qkv = rng.randn(b, 3, h, d).astype(np.float32)
+
+    kabs = np.abs(dense_k).max(axis=(0, 2, 3)) + 1e-6
+    vabs = np.abs(dense_v).max(axis=(0, 2, 3)) + 1e-6
+    kq_s, kdq_s = 127.0 / kabs * 0.5, kabs / 127.0 * 2.0
+    vq_s, vdq_s = 127.0 / vabs * 0.5, vabs / 127.0 * 2.0
+
+    kcache8 = np.zeros((nblocks, h, bs, d), np.int8)
+    vcache8 = np.zeros((nblocks, h, bs, d), np.int8)
+    kcache = np.zeros((nblocks, h, bs, d), np.float32)
+    vcache = np.zeros((nblocks, h, bs, d), np.float32)
+    for bi in range(b):
+        for t in range(lens[bi]):
+            phys = tables[bi, t // bs]
+            kcache[phys, :, t % bs] = dense_k[bi, :, t]
+            vcache[phys, :, t % bs] = dense_v[bi, :, t]
+            kcache8[phys, :, t % bs] = np.asarray(quant_to_int8(
+                jnp.asarray(dense_k[bi, :, t][None]), jnp.asarray(kq_s)))[0]
+            vcache8[phys, :, t % bs] = np.asarray(quant_to_int8(
+                jnp.asarray(dense_v[bi, :, t][None]), jnp.asarray(vq_s)))[0]
+
+    out8, kc8, vc8 = block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kcache8),
+        paddle.to_tensor(vcache8), paddle.to_tensor(lens),
+        paddle.to_tensor(tables),
+        cache_k_quant_scales=jnp.asarray(kq_s),
+        cache_v_quant_scales=jnp.asarray(vq_s),
+        cache_k_dequant_scales=jnp.asarray(kdq_s),
+        cache_v_dequant_scales=jnp.asarray(vdq_s))
+    assert np.asarray(kc8._value).dtype == np.int8
+
+    out_ref, _, _ = block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kcache),
+        paddle.to_tensor(vcache), paddle.to_tensor(lens),
+        paddle.to_tensor(tables))
+    got, want = np.asarray(out8._value), np.asarray(out_ref._value)
+    err = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert err < 0.05, f"paged int8 rel err {err}"
+
+    # dynamic [batch, num_head] scale SHAPE (use_dynamic_cachekv_quant):
+    # the caller maintains running per-sequence scales; quant and dequant
+    # must stay a consistent pair, so broadcast the known-good static
+    # values into the dynamic shape and check the path end-to-end
+    out_dyn, _, _ = block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kcache8),
+        paddle.to_tensor(vcache8), paddle.to_tensor(lens),
+        paddle.to_tensor(tables),
+        cache_k_quant_scales=jnp.broadcast_to(jnp.asarray(kq_s)[None],
+                                              (b, h)),
+        cache_v_quant_scales=jnp.broadcast_to(jnp.asarray(vq_s)[None],
+                                              (b, h)),
+        cache_k_dequant_scales=jnp.broadcast_to(jnp.asarray(kdq_s)[None],
+                                                (b, h)),
+        cache_v_dequant_scales=jnp.broadcast_to(jnp.asarray(vdq_s)[None],
+                                                (b, h)),
+        use_dynamic_cachekv_quant=True)
+    got_dyn = np.asarray(out_dyn._value)
+    err = np.abs(got_dyn - want).max() / (np.abs(want).max() + 1e-9)
+    assert err < 0.06, f"dynamic-scale paged int8 rel err {err}"
+
+    # the helper's quant/dequant pair is self-inverse within 1 LSB
+    kq_d, kdq_d = _dynamic_absmax_scales(jnp.asarray(qkv[:, 1]))
+    rt = np.asarray(quant_to_int8(jnp.asarray(qkv[:, 1]), kq_d)
+                    ).astype(np.float32) * np.asarray(kdq_d)[..., None]
+    assert np.abs(rt - qkv[:, 1]).max() <= np.asarray(kdq_d).max() * 0.51
+
+
+def test_quant_round_types():
+    from paddle_tpu.incubate.nn.decode_attention import quant_to_int8
+
+    x = jnp.asarray([[[0.5, 1.5, -0.5, -1.5, 2.5]]], jnp.float32)
+    s = jnp.asarray([1.0])
+    # ties-to-even
+    np.testing.assert_array_equal(
+        np.asarray(quant_to_int8(x, s, round_type=0))[0, 0],
+        [0, 2, 0, -2, 2])
+    # half away from zero
+    np.testing.assert_array_equal(
+        np.asarray(quant_to_int8(x, s, round_type=1))[0, 0],
+        [1, 2, -1, -2, 3])
