@@ -10,5 +10,12 @@ from .fused import (
 from .attention import flash_attention
 from .fused_transformer import FusedMultiTransformer
 
-# paddle-compat namespace: paddle.incubate.nn.functional.*
-from . import fused as functional
+# paddle-compat namespace: paddle.incubate.nn.functional.* (name-complete
+# vs the reference functional __init__, incl. the round-5 serving tail)
+from . import functional
+from .functional import (blha_get_max_len, fused_bias_act,
+                         fused_bias_dropout_residual_layer_norm,
+                         fused_dropout_add, fused_feedforward,
+                         fused_gate_attention, fused_linear,
+                         fused_multi_head_attention,
+                         variable_length_memory_efficient_attention)
