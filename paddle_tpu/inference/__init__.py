@@ -332,3 +332,8 @@ class Predictor:
 
 def create_predictor(config_or_layer, layer=None):
     return Predictor(config_or_layer, layer)
+
+
+# continuous-batching serving engine (round-5; reference capability:
+# the serving loop around block_multihead_attention)
+from .serving import ContinuousBatchingEngine, PageAllocator  # noqa: E402

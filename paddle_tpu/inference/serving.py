@@ -1,0 +1,446 @@
+"""Continuous-batching LLM serving engine over the paged KV cache.
+
+The capability the reference's block_multihead_attention signature exists
+for (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu;
+Python entry python/paddle/incubate/nn/functional/
+block_multihead_attention.py): a scheduler that ADMITS new prompts into a
+RUNNING decode batch, grows sequences page by page, EVICTS finished ones
+and reuses their pages — the reference models the mixed prefill/decode
+step with its ``seq_lens_encoder`` / ``seq_lens_decoder`` /
+``seq_lens_this_time`` triplet, which this engine's step report mirrors.
+
+TPU-first shape: the host owns the (cheap, branchy) scheduling — slot
+and page bookkeeping, admission, eviction; the device runs two compiled
+programs with STATIC shapes:
+
+- ``prefill``: full causal forward of one prompt (padded to a power-of-2
+  bucket so retraces stay logarithmic), whose per-layer K/V are scattered
+  into the slot's pages;
+- ``decode_chunk``: ``decode_chunk_steps`` single-token steps for ALL
+  slots in one jit (a ``lax.scan``), each step routing attention through
+  the Pallas paged flash-decoding kernel (ops/pallas/
+  decode_attention.py: page indirection in the DMA index maps, HBM
+  traffic bounded by live lengths).  Inactive slots compute masked
+  garbage that is never read — the price of static shapes, paid once per
+  slot instead of per-retrace.
+
+Chunked decode amortizes host-round-trip latency (through the dev
+tunnel, ~100ms/call) AND is the admission granularity: new requests wait
+at most ``decode_chunk_steps`` tokens — the same knob vLLM-style servers
+expose.
+
+Page size is autotunable: ``page_size="auto"`` measures the paged kernel
+across candidate sizes for this model's shape (ops/autotune.py cache) —
+round-4 measured 64-token pages paying ~3x the dense kernel's grid
+overhead; bigger pages amortize it at the cost of allocation granularity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray                  # [S] int32
+    max_new_tokens: int
+    arrival: float = 0.0
+
+
+@dataclasses.dataclass
+class Finished:
+    rid: int
+    tokens: np.ndarray                  # generated tokens (incl. first)
+    prompt_len: int
+
+
+def tune_page_size(b, kvh, d, capacity, dtype=jnp.bfloat16,
+                   candidates=(64, 128, 256, 512)):
+    """Measure paged_decode_raw across page sizes for this serving shape
+    (cached per signature).  Falls back to 128 when autotune is off or
+    under interpret/CPU."""
+    from ..ops import autotune as _at
+    from ..ops.pallas.decode_attention import paged_decode_raw
+
+    key = ("paged_page_size", b, kvh, d, capacity, str(dtype))
+    cached = _at.AutoTuneCache.instance().lookup(key)
+    if cached is not None:
+        return cached
+    if not _at.enabled() or jax.default_backend() == "cpu":
+        return 128
+
+    def measure(page):
+        npages_seq = capacity // page
+        npages = b * npages_seq
+        kc = jnp.zeros((npages, kvh, page, d), dtype)
+        vc = jnp.zeros((npages, kvh, page, d), dtype)
+        tables = jnp.arange(npages, dtype=jnp.int32).reshape(b, npages_seq)
+        q = jnp.ones((b, kvh, d), dtype)
+        lens = jnp.full((b,), capacity // 2, jnp.int32)
+        return _at.time_fn(lambda: jax.block_until_ready(
+            paged_decode_raw(q, kc, vc, lens, tables)))
+
+    return _at.AutoTuneCache.instance().tune(
+        key, [p for p in candidates if capacity % p == 0], measure)
+
+
+class PageAllocator:
+    """Host-side physical-page free list (reuse is LIFO so hot pages stay
+    cache/TLB friendly)."""
+
+    def __init__(self, num_pages: int):
+        self.free: List[int] = list(range(num_pages - 1, -1, -1))
+        self.total = num_pages
+
+    def alloc(self) -> Optional[int]:
+        return self.free.pop() if self.free else None
+
+    def release(self, pages) -> None:
+        self.free.extend(reversed(list(pages)))
+
+    @property
+    def available(self) -> int:
+        return len(self.free)
+
+
+class ContinuousBatchingEngine:
+    """Greedy-decode continuous batching over a paged cache.
+
+    params/cfg: the flagship Llama functional state (models/generation.py
+    weight naming).  ``max_slots`` bounds the in-flight batch;
+    ``num_pages`` x ``page_size`` is the shared KV pool per layer."""
+
+    def __init__(self, cfg, params, max_slots: int = 8,
+                 num_pages: int = 64, page_size="auto",
+                 max_seq_len: Optional[int] = None,
+                 decode_chunk_steps: int = 8, eos_id: int = -1):
+        from ..models.generation import _CFGS, register_config
+
+        self.cfg = cfg
+        self.params = params
+        self.cfg_id = register_config(cfg)
+        _, self.cos_tab, self.sin_tab = _CFGS[self.cfg_id]
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len or cfg.max_position_embeddings)
+        if page_size == "auto":
+            page_size = tune_page_size(
+                self.max_slots, cfg.num_key_value_heads, cfg.head_dim,
+                self.max_seq_len)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        # the LAST physical page is a reserved scribble target: masked
+        # (inactive) slots in the static-shape decode program write their
+        # garbage K/V there instead of corrupting a live page
+        self.trash_page = self.num_pages - 1
+        self.pages_per_seq = -(-self.max_seq_len // self.page_size)
+        self.chunk = int(decode_chunk_steps)
+        self.eos_id = int(eos_id)
+
+        L = cfg.num_hidden_layers
+        kvh, d = cfg.num_key_value_heads, cfg.head_dim
+        dt = next(iter(params.values())).dtype
+        self.k_pages = jnp.zeros((L, self.num_pages, kvh, self.page_size, d),
+                                 dt)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        # host-side slot state
+        self.tables = np.full((self.max_slots, self.pages_per_seq), -1,
+                              np.int32)
+        self.seq_lens = np.zeros(self.max_slots, np.int32)
+        self.active = np.zeros(self.max_slots, bool)
+        self.cur_tok = np.zeros(self.max_slots, np.int32)
+        self.budget = np.zeros(self.max_slots, np.int32)
+        self.slot_rid = np.full(self.max_slots, -1, np.int64)
+        self.slot_pages: Dict[int, List[int]] = {}
+        self.out_tokens: Dict[int, List[int]] = {}
+        self.prompt_lens: Dict[int, int] = {}
+        self.alloc = PageAllocator(self.num_pages - 1)
+        self.queue: deque[Request] = deque()
+        self._next_rid = 0
+        self.finished: List[Finished] = []
+        # step report (reference seq_lens_encoder/decoder/this_time
+        # semantics: encoder = prompt tokens prefilled this step,
+        # decoder = cached tokens of decoding slots, this_time = tokens
+        # processed this step)
+        self.last_report: Dict[str, np.ndarray] = {}
+
+    # ---------------- device programs ----------------
+
+    @partial(jax.jit, static_argnames=("self_cfg_id", "chunk"),
+             donate_argnums=(1, 2))
+    def _decode_chunk_jit(params, k_pages, v_pages, tables, seq_lens,
+                          tok, active, cos_tab, sin_tab, self_cfg_id,
+                          chunk):
+        from ..models.generation import _CFGS, _Weights
+
+        cfg, _, _ = _CFGS[self_cfg_id]
+        w = _Weights(cfg, params)
+        L = cfg.num_hidden_layers
+        h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        page = k_pages.shape[3]
+        nslots = tok.shape[0]
+        from ..ops.pallas.decode_attention import paged_decode_raw
+
+        def one_step(carry, _):
+            k_pages, v_pages, seq_lens, tok, done = carry
+            x = jnp.take(w["model.embed_tokens.weight"], tok[:, None],
+                         axis=0)
+            cos = jnp.take(cos_tab, seq_lens, axis=0)[:, None, None, :]
+            sin = jnp.take(sin_tab, seq_lens, axis=0)[:, None, None, :]
+            cos = cos.astype(x.dtype)
+            sin = sin.astype(x.dtype)
+            from ..models.generation import (_apply_rope, _rms_norm)
+
+            blk = seq_lens // page
+            slot = seq_lens % page
+            bidx = jnp.arange(nslots)
+            phys = tables[bidx, blk]                       # [nslots]
+            # masked slots (inactive/finished) scribble into the reserved
+            # trash page — their table entries are -1
+            phys = jnp.where(done | (phys < 0), k_pages.shape[1] - 1, phys)
+            for i in range(L):
+                xin = _rms_norm(x, w.layer(i, "input_layernorm.weight"),
+                                cfg.rms_norm_eps)
+                q = (xin @ w.layer(i, "self_attn.q_proj.weight")
+                     ).reshape(nslots, 1, h, d)
+                k = (xin @ w.layer(i, "self_attn.k_proj.weight")
+                     ).reshape(nslots, 1, kvh, d)
+                v = (xin @ w.layer(i, "self_attn.v_proj.weight")
+                     ).reshape(nslots, 1, kvh, d)
+                q, k = _apply_rope(q, k, cos, sin)
+                kp = k_pages[i].at[phys, :, slot, :].set(
+                    k[:, 0].astype(k_pages.dtype))
+                vp = v_pages[i].at[phys, :, slot, :].set(
+                    v[:, 0].astype(v_pages.dtype))
+                k_pages = k_pages.at[i].set(kp)
+                v_pages = v_pages.at[i].set(vp)
+                ctx = paged_decode_raw(q.reshape(nslots, h, d), kp, vp,
+                                       seq_lens + 1, tables,
+                                       scale=d ** -0.5)
+                x = x + (ctx.reshape(nslots, 1, h * d).astype(x.dtype)
+                         @ w.layer(i, "self_attn.o_proj.weight"))
+                xm = _rms_norm(x, w.layer(i, "post_attention_layernorm"
+                                             ".weight"), cfg.rms_norm_eps)
+                gate = xm @ w.layer(i, "mlp.gate_proj.weight")
+                up = xm @ w.layer(i, "mlp.up_proj.weight")
+                x = x + (jax.nn.silu(gate) * up) @ w.layer(
+                    i, "mlp.down_proj.weight")
+            x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
+            logits = w.head(x[:, 0]).astype(jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, tok, nxt)
+            seq_lens = jnp.where(active & ~done, seq_lens + 1, seq_lens)
+            return (k_pages, v_pages, seq_lens, nxt, done), nxt
+
+        done0 = ~active
+        (k_pages, v_pages, seq_lens, tok, _), toks = lax.scan(
+            one_step, (k_pages, v_pages, seq_lens, tok, done0), None,
+            length=chunk)
+        return k_pages, v_pages, seq_lens, tok, jnp.moveaxis(toks, 0, 1)
+
+    @partial(jax.jit, static_argnames=("self_cfg_id", "bucket"))
+    def _prefill_jit(params, ids, length, cos_tab, sin_tab, self_cfg_id,
+                     bucket):
+        """Causal prefill of ONE prompt padded to ``bucket``; returns
+        (first sampled token, per-layer K/V [L, bucket, kvh, d])."""
+        from ..models.generation import _CFGS, _Weights, _block, _rms_norm
+
+        cfg, _, _ = _CFGS[self_cfg_id]
+        w = _Weights(cfg, params)
+        L = cfg.num_hidden_layers
+        x = jnp.take(w["model.embed_tokens.weight"], ids[None], axis=0)
+        pos = jnp.arange(bucket)
+        cos = jnp.take(cos_tab, pos, axis=0)[None, :, None, :].astype(x.dtype)
+        sin = jnp.take(sin_tab, pos, axis=0)[None, :, None, :].astype(x.dtype)
+        # causal AND padding-masked (padded rows attend real prefix only;
+        # their outputs are discarded)
+        causal = jnp.where(jnp.tril(jnp.ones((bucket, bucket), bool)),
+                           0.0, -jnp.inf)
+        ks, vs = [], []
+        for i in range(L):
+            x, k, v = _block(w, i, x, cos, sin, causal)
+            ks.append(k[0])
+            vs.append(v[0])
+        x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
+        last = jnp.take(x[0], length - 1, axis=0)
+        logits = w.head(last[None]).astype(jnp.float32)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+        return tok, jnp.stack(ks), jnp.stack(vs)
+
+    @partial(jax.jit, static_argnames=("npages", "page_size"),
+             donate_argnums=(0, 1))
+    def _write_pages_jit(k_pages, v_pages, ks, vs, pg, npages, page_size):
+        """Write a prompt's per-layer K/V ([L, bucket, kvh, d]) into its
+        physical pages — one compiled dispatch per admission.  Pages
+        beyond the prompt's real length land in the trash page."""
+        kt = jnp.moveaxis(ks, 1, 2).astype(k_pages.dtype)  # [L, kvh, B, d]
+        vt = jnp.moveaxis(vs, 1, 2).astype(v_pages.dtype)
+        pad = npages * page_size - kt.shape[2]
+        if pad > 0:      # bucket smaller than the page span: zero-pad
+            kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        for j in range(npages):
+            lo = j * page_size
+            k_pages = k_pages.at[:, pg[j], :, :, :].set(
+                kt[:, :, lo:lo + page_size])
+            v_pages = v_pages.at[:, pg[j], :, :, :].set(
+                vt[:, :, lo:lo + page_size])
+        return k_pages, v_pages
+
+    # ---------------- host scheduler ----------------
+
+    def add_request(self, prompt, max_new_tokens: int = 32, rid=None,
+                    arrival: float = 0.0):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        if self._pages_needed(len(prompt) + max_new_tokens) \
+                > self.alloc.total:
+            raise ValueError(
+                f"request needs "
+                f"{self._pages_needed(len(prompt) + max_new_tokens)} pages "
+                f"but the pool only has {self.alloc.total} — it could "
+                f"never be admitted (head-of-line livelock)")
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        self.queue.append(Request(int(rid), prompt, int(max_new_tokens),
+                                  arrival))
+        return rid
+
+    def _pages_needed(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)
+
+    def _admit(self) -> List[int]:
+        """Admit queued prompts into free slots while pages last.  Full
+        prompt + generation budget is reserved up front (no mid-flight
+        OOM — the reference serving stack reserves block budgets the
+        same way)."""
+        admitted = []
+        free_slots = np.nonzero(~self.active)[0]
+        si = 0
+        while self.queue and si < len(free_slots):
+            req = self.queue[0]
+            need = self._pages_needed(len(req.prompt) + req.max_new_tokens)
+            if need > self.alloc.available:
+                break                      # head-of-line waits for pages
+            self.queue.popleft()
+            slot = int(free_slots[si])
+            si += 1
+            pages = [self.alloc.alloc() for _ in range(need)]
+            self.slot_pages[slot] = pages
+            self.tables[slot] = -1
+            self.tables[slot, :need] = pages
+            s = len(req.prompt)
+            bucket = max(16, 1 << (s - 1).bit_length())
+            ids = np.zeros(bucket, np.int32)
+            ids[:s] = req.prompt
+            tok, ks, vs = ContinuousBatchingEngine._prefill_jit(
+                self.params, jnp.asarray(ids), jnp.asarray(s, jnp.int32),
+                self.cos_tab, self.sin_tab, self_cfg_id=self.cfg_id,
+                bucket=bucket)
+            # scatter the prompt K/V into this slot's pages in ONE
+            # dispatch (per-page eager .at[].set would rewrite the whole
+            # pool per page — >1s of tunnel dispatch per admission)
+            npg = self._pages_needed(bucket)
+            pg = np.full(npg, self.trash_page, np.int32)
+            pg[:self._pages_needed(s)] = pages[:self._pages_needed(s)]
+            self.k_pages, self.v_pages = \
+                ContinuousBatchingEngine._write_pages_jit(
+                    self.k_pages, self.v_pages, ks, vs,
+                    jnp.asarray(pg), npages=npg,
+                    page_size=self.page_size)
+            self.active[slot] = True
+            self.seq_lens[slot] = s
+            self.cur_tok[slot] = int(tok)
+            self.budget[slot] = req.max_new_tokens - 1
+            self.slot_rid[slot] = req.rid
+            self.out_tokens[req.rid] = [int(tok)]
+            self.prompt_lens[req.rid] = s
+            admitted.append((slot, s))
+            if int(tok) == self.eos_id or req.max_new_tokens <= 1:
+                self._finish(slot)
+        return admitted
+
+    def _finish(self, slot: int):
+        rid = int(self.slot_rid[slot])
+        self.finished.append(Finished(rid,
+                                      np.asarray(self.out_tokens.pop(rid),
+                                                 np.int32),
+                                      self.prompt_lens.pop(rid)))
+        self.alloc.release(self.slot_pages.pop(slot))
+        self.active[slot] = False
+        self.tables[slot] = -1
+        self.seq_lens[slot] = 0
+        self.slot_rid[slot] = -1
+
+    def step(self):
+        """One scheduler iteration: admit, run a decode chunk, evict.
+        Returns the number of tokens generated this iteration."""
+        admitted = self._admit()
+        enc = np.zeros(self.max_slots, np.int32)
+        for s, plen in admitted:
+            enc[s] = plen
+        if not self.active.any():
+            self.last_report = {
+                "seq_lens_encoder": enc,
+                "seq_lens_decoder": np.zeros(self.max_slots, np.int32),
+                "seq_lens_this_time": enc.copy(),
+            }
+            return 0
+        steps = self.chunk   # FIXED length: one compiled program
+        k_pages, v_pages, seq_lens, tok, toks = \
+            ContinuousBatchingEngine._decode_chunk_jit(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(self.tables), jnp.asarray(self.seq_lens),
+                jnp.asarray(self.cur_tok), jnp.asarray(self.active),
+                self.cos_tab, self.sin_tab, self_cfg_id=self.cfg_id,
+                chunk=steps)
+        self.k_pages, self.v_pages = k_pages, v_pages
+        toks = np.asarray(toks)                       # [slots, steps]
+        self.seq_lens = np.asarray(seq_lens).copy()
+        self.cur_tok = np.asarray(tok).copy()
+        produced = 0
+        dec = np.where(self.active, self.seq_lens, 0).astype(np.int32)
+        this_time = enc.copy()
+        for s in np.nonzero(self.active)[0]:
+            rid = int(self.slot_rid[s])
+            take = int(min(steps, self.budget[s]))
+            for t in toks[s, :take]:
+                self.out_tokens[rid].append(int(t))
+                produced += 1
+                this_time[s] += 1
+                if int(t) == self.eos_id:
+                    break
+            self.budget[s] -= take
+            hit_eos = self.eos_id in toks[s, :take]
+            if self.budget[s] <= 0 or hit_eos:
+                self._finish(int(s))
+        self.last_report = {
+            "seq_lens_encoder": enc,
+            "seq_lens_decoder": dec,
+            "seq_lens_this_time": this_time,
+        }
+        return produced
+
+    def run(self, max_iters: int = 10_000):
+        """Drive until queue + slots drain.  Returns finished requests
+        sorted by rid."""
+        it = 0
+        while (self.queue or self.active.any()) and it < max_iters:
+            self.step()
+            it += 1
+        if self.queue or self.active.any():
+            raise RuntimeError("serving loop did not drain")
+        return sorted(self.finished, key=lambda f: f.rid)
