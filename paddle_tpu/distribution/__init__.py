@@ -563,3 +563,11 @@ def _kl_mvn(p, q):
     logdet = (jnp.log(jnp.diagonal(lq, axis1=-2, axis2=-1)).sum(-1)
               - jnp.log(jnp.diagonal(lp, axis1=-2, axis2=-1)).sum(-1))
     return Tensor(0.5 * (tr + (z ** 2).sum(-1) - d) + logdet)
+
+from .special import (ContinuousBernoulli, Constraint, Independent as  # noqa: E402
+                      IndependentVariable, LKJCholesky, Positive, Range,
+                      Real, Simplex, Stack as StackVariable, Variable,
+                      positive, real, simplex)
+
+__all__ += ["ContinuousBernoulli", "LKJCholesky", "Constraint", "Real",
+            "Range", "Positive", "Simplex", "Variable"]

@@ -13,6 +13,19 @@ from ..nn.layer import Layer
 
 
 class Callback:
+    """Base callback (reference python/paddle/hapi/callbacks.py Callback):
+    the full hook set, with ``self.model`` set by fit()."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
     def on_train_begin(self, logs=None):
         pass
 
@@ -25,12 +38,22 @@ class Callback:
     def on_epoch_end(self, epoch, logs=None):
         pass
 
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
     def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
         pass
 
 
 class ProgBarLogger(Callback):
     def __init__(self, log_freq=10, verbose=1):
+        super().__init__()
         self.log_freq = log_freq
         self.verbose = verbose
 
@@ -41,6 +64,157 @@ class ProgBarLogger(Callback):
             print(f"step {step} - {items}")
 
 
+class EarlyStopping(Callback):
+    """Stop training when ``monitor`` stops improving (reference
+    hapi/callbacks.py EarlyStopping): ``mode`` in {'auto','min','max'},
+    ``patience`` epochs of grace, optional ``baseline``, and
+    ``save_best_model`` into fit()'s save_dir."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stopped_epoch = -1
+
+    def _better(self, cur, ref):
+        if self.mode == "min":
+            return cur < ref - self.min_delta
+        return cur > ref + self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self.best = self.baseline
+        self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = logs[self.monitor]
+        if isinstance(cur, (list, tuple, np.ndarray)):
+            cur = float(np.asarray(cur).reshape(-1)[0])
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and self.model is not None and \
+                    self.params.get("save_dir"):
+                self.model.save(self.params["save_dir"] + "/best_model")
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                if self.model is not None:
+                    self.model.stop_training = True
+                self.stopped_epoch = self.params.get("epoch", -1)
+                if self.verbose:
+                    print(f"EarlyStopping: no {self.monitor} improvement "
+                          f"for {self.wait} evals; stopping")
+
+
+class ModelCheckpoint(Callback):
+    """Periodic checkpoint save (reference hapi/callbacks.py
+    ModelCheckpoint): every ``save_freq`` epochs into ``save_dir``."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        d = self.save_dir or self.params.get("save_dir")
+        if d and self.model is not None and (epoch + 1) % self.save_freq == 0:
+            self.model.save(f"{d}/{epoch}")
+
+    def on_train_end(self, logs=None):
+        d = self.save_dir or self.params.get("save_dir")
+        if d and self.model is not None:
+            self.model.save(f"{d}/final")
+
+
+class LRScheduler(Callback):
+    """Drive the optimizer's LRScheduler from the training loop
+    (reference hapi/callbacks.py LRScheduler): ``by_step`` steps it per
+    batch, ``by_epoch`` per epoch (exactly one must be set)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        if by_step == by_epoch:
+            raise ValueError("set exactly one of by_step / by_epoch")
+        self.by_step = by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference hapi/callbacks.py VisualDL).
+    The VisualDL writer is a GPU-ecosystem dependency; this analog
+    appends JSON-lines scalar records to ``log_dir/scalars.jsonl`` —
+    same hook points, greppable output."""
+
+    def __init__(self, log_dir="./log", log_freq=1):
+        super().__init__()
+        self.log_dir = log_dir
+        self.log_freq = max(int(log_freq), 1)
+        self._step = 0
+        self._fh = None
+
+    def on_train_begin(self, logs=None):
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._fh = open(self.log_dir + "/scalars.jsonl", "a")
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def _write(self, tag, logs):
+        import json
+
+        if self._fh is None:       # eval-only / manual use
+            self.on_train_begin()
+        rec = {"tag": tag, "step": self._step}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(np.asarray(v).reshape(-1)[0])
+            except (TypeError, ValueError):
+                continue
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % self.log_freq == 0:
+            self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
 class Model:
     """paddle.Model analog wrapping a Layer for fit/evaluate/predict."""
 
@@ -49,6 +223,7 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
@@ -95,23 +270,41 @@ class Model:
         else:
             loader = train_data
         callbacks = callbacks or [ProgBarLogger(log_freq, verbose)]
+        self.stop_training = False
         for cb in callbacks:
+            cb.set_model(self)
+            cb.set_params({"save_dir": save_dir, "epochs": epochs,
+                           "verbose": verbose})
             cb.on_train_begin()
         history = {"loss": []}
         for epoch in range(epochs):
             for cb in callbacks:
+                cb.params["epoch"] = epoch
                 cb.on_epoch_begin(epoch)
             for step, batch in enumerate(loader):
                 *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
+                for cb in callbacks:
+                    cb.on_train_batch_begin(step)
                 losses, _ = self.train_batch(xs, [y])
                 logs = {"loss": losses[0] if losses else 0.0}
                 history["loss"].append(logs["loss"])
                 for cb in callbacks:
                     cb.on_train_batch_end(step, logs)
             for cb in callbacks:
-                cb.on_epoch_end(epoch)
+                cb.on_epoch_end(epoch, {"loss": history["loss"][-1]
+                                        if history["loss"] else 0.0})
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                for cb in callbacks:
+                    cb.on_eval_begin()
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0)
+                history.setdefault("eval", []).append(eval_logs)
+                for cb in callbacks:
+                    cb.on_eval_end(eval_logs)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/epoch{epoch}")
+            if self.stop_training:
+                break
         for cb in callbacks:
             cb.on_train_end()
         return history

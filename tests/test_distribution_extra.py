@@ -201,3 +201,91 @@ class TestReviewRegressions:
         b = D.Binomial(1_000_000, np.float32(0.25))
         s = b.sample((16,)).numpy()
         np.testing.assert_allclose(s.mean(), 250_000, rtol=0.01)
+
+
+# --------------------------------------------------------------------------
+# round-5: ContinuousBernoulli, LKJCholesky, constraint machinery
+# (reference continuous_bernoulli.py / lkj_cholesky.py / constraint.py)
+# --------------------------------------------------------------------------
+
+def test_continuous_bernoulli_stats_and_logprob():
+    from paddle_tpu.distribution import ContinuousBernoulli
+    import scipy.integrate as si
+
+    for p in (0.2, 0.4999, 0.5, 0.7):
+        d = ContinuousBernoulli(p)
+        # pdf integrates to 1 and mean matches numeric integral
+        xs = np.linspace(1e-6, 1 - 1e-6, 4001)
+        pdf = np.asarray(d.prob(xs.astype(np.float32)))
+        total = si.trapezoid(pdf, xs)
+        np.testing.assert_allclose(total, 1.0, rtol=2e-3)
+        mean_num = si.trapezoid(pdf * xs, xs)
+        np.testing.assert_allclose(float(np.asarray(d.mean)), mean_num,
+                                   rtol=5e-3, atol=1e-3)
+        var_num = si.trapezoid(pdf * (xs - mean_num) ** 2, xs)
+        np.testing.assert_allclose(float(np.asarray(d.variance)), var_num,
+                                   rtol=1e-2, atol=1e-3)
+
+
+def test_continuous_bernoulli_cdf_icdf_sample():
+    from paddle_tpu.distribution import ContinuousBernoulli
+
+    d = ContinuousBernoulli(0.3)
+    u = np.linspace(0.01, 0.99, 21).astype(np.float32)
+    x = np.asarray(d.icdf(u))
+    np.testing.assert_allclose(np.asarray(d.cdf(x)), u, rtol=1e-4,
+                               atol=1e-5)
+    s = np.asarray(d.sample((4000,))._value)
+    assert s.min() >= 0 and s.max() <= 1
+    np.testing.assert_allclose(s.mean(), float(np.asarray(d.mean)),
+                               atol=0.02)
+
+
+def test_lkj_cholesky_sample_and_logprob():
+    from paddle_tpu.distribution import LKJCholesky
+
+    for method in ("onion", "cvine"):
+        d = LKJCholesky(dim=3, concentration=1.5, sample_method=method)
+        L = np.asarray(d.sample((64,))._value)
+        assert L.shape == (64, 3, 3)
+        # lower-triangular with unit-norm rows -> L @ L.T is a
+        # correlation matrix
+        assert np.allclose(np.triu(L, 1), 0.0, atol=1e-6)
+        C = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(np.diagonal(C, axis1=-2, axis2=-1),
+                                   1.0, atol=1e-5)
+        ev = np.linalg.eigvalsh(C)
+        assert (ev > -1e-5).all()
+    # log_prob: uniform case (concentration=1) assigns equal density to
+    # any valid factor's ordering-invariant part; just check finiteness
+    # and that higher concentration favors identity-like factors
+    d1 = LKJCholesky(dim=3, concentration=1.0)
+    d5 = LKJCholesky(dim=3, concentration=5.0)
+    eye = np.eye(3, dtype=np.float32)
+    skew = np.asarray(d1.sample((1,))._value)[0]
+    lp_eye_1, lp_eye_5 = float(np.asarray(d1.log_prob(eye))), \
+        float(np.asarray(d5.log_prob(eye)))
+    assert np.isfinite(lp_eye_1) and np.isfinite(lp_eye_5)
+    # concentration > 1 concentrates mass near identity
+    lp_skew_5 = float(np.asarray(d5.log_prob(skew)))
+    assert lp_eye_5 >= lp_skew_5
+
+
+def test_constraint_machinery():
+    from paddle_tpu.distribution import (Positive, Range, Real, Simplex,
+                                         Variable)
+    from paddle_tpu.distribution.special import Independent
+
+    import jax.numpy as jnp
+
+    assert bool(Positive()(jnp.asarray(2.0)))
+    assert not bool(Positive()(jnp.asarray(-1.0)))
+    assert bool(Range(0, 1)(jnp.asarray(0.5)))
+    assert bool(Real()(jnp.asarray(3.0)))
+    assert bool(Simplex()(jnp.asarray([0.2, 0.8])))
+    assert not bool(Simplex()(jnp.asarray([0.5, 0.9])))
+    v = Variable(event_rank=0, constraint=Positive())
+    iv = Independent(v, 1)
+    assert bool(iv.constraint(jnp.asarray([1.0, 2.0])))
+    assert not bool(iv.constraint(jnp.asarray([1.0, -2.0])))
+    assert iv.event_rank == 1

@@ -1,0 +1,109 @@
+"""hapi Model + the round-5 callback set (reference
+python/paddle/hapi/callbacks.py: EarlyStopping, ModelCheckpoint,
+LRScheduler, VisualDL) and Model.summary."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi import (Callback, EarlyStopping, LRScheduler, Model,
+                             ModelCheckpoint, ProgBarLogger, VisualDL)
+from paddle_tpu.io import Dataset
+
+
+class _ToyDS(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        self.y = (self.x.sum(-1) > 0).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _model():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = Model(net)
+    m.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters()),
+              paddle.nn.CrossEntropyLoss(),
+              paddle.metric.Accuracy())
+    return m
+
+
+def test_early_stopping_stops_training():
+    m = _model()
+    calls = {"epochs": 0}
+
+    class Counter(Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            calls["epochs"] += 1
+
+    # a monitor that never improves past the baseline stops after
+    # patience evals
+    es = EarlyStopping(monitor="loss", mode="min", patience=1,
+                       baseline=-1.0, save_best_model=False, verbose=0)
+    m.fit(_ToyDS(), eval_data=_ToyDS(), batch_size=8, epochs=10,
+          verbose=0, callbacks=[es, Counter()])
+    assert m.stop_training
+    assert calls["epochs"] < 10
+
+
+def test_early_stopping_tracks_best():
+    es = EarlyStopping(monitor="acc", mode="max", patience=2,
+                       save_best_model=False, verbose=0)
+    es.set_model(Model(nn.Linear(2, 2)))
+    es.on_train_begin()
+    for v in (0.5, 0.6, 0.55, 0.58, 0.61):
+        es.on_eval_end({"acc": v})
+    assert es.best == 0.61
+    assert not es.model.stop_training
+
+
+def test_model_checkpoint_saves(tmp_path):
+    m = _model()
+    d = str(tmp_path / "ckpt")
+    m.fit(_ToyDS(16), batch_size=8, epochs=2, verbose=0,
+          callbacks=[ModelCheckpoint(save_freq=1, save_dir=d)])
+    assert os.path.exists(d + "/0.pdparams")
+    assert os.path.exists(d + "/1.pdparams")
+    assert os.path.exists(d + "/final.pdparams")
+
+
+def test_lr_scheduler_callback_steps():
+    net = nn.Linear(8, 2)
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=net.parameters())
+    m = Model(net)
+    m.prepare(opt, paddle.nn.CrossEntropyLoss())
+    m.fit(_ToyDS(16), batch_size=8, epochs=2, verbose=0,
+          callbacks=[LRScheduler(by_step=False, by_epoch=True)])
+    # two epochs -> two decays
+    assert sched.last_lr == pytest.approx(0.1 * 0.25)
+
+
+def test_visualdl_writes_scalars(tmp_path):
+    d = str(tmp_path / "log")
+    m = _model()
+    m.fit(_ToyDS(16), eval_data=_ToyDS(16), batch_size=8, epochs=1,
+          verbose=0, callbacks=[VisualDL(log_dir=d)])
+    recs = [json.loads(l) for l in open(d + "/scalars.jsonl")]
+    tags = {r["tag"] for r in recs}
+    assert "train" in tags and "eval" in tags
+    assert any("loss" in r for r in recs)
+
+
+def test_model_summary_counts_params():
+    m = _model()
+    info = m.summary()
+    want = 8 * 16 + 16 + 16 * 2 + 2
+    assert info["total_params"] == want
