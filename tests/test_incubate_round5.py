@@ -1,0 +1,212 @@
+"""Round-5 incubate functional tail: blha_get_max_len, fused_bias_act,
+fused_gate_attention, variable_length_memory_efficient_attention,
+fused_dropout_add and the fused-transformer trio — goldens vs the
+reference pseudo-code (python/paddle/incubate/nn/functional/*)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def _np(x):
+    return np.asarray(getattr(x, "_value", x))
+
+
+def test_blha_get_max_len():
+    enc, dec = IF.blha_get_max_len(jnp.asarray([3, 41, 7], jnp.int32),
+                                   jnp.asarray([9, 2, 30], jnp.int32), 3)
+    assert _np(enc).tolist() == [41]
+    assert _np(dec).tolist() == [30]
+
+
+def test_fused_bias_act_gelu_and_bias():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    b = rng.standard_normal(8).astype(np.float32)
+    got = _np(IF.fused_bias_act(jnp.asarray(x), bias=jnp.asarray(b),
+                                act_method="gelu"))
+    import scipy.special as sp
+
+    y = x + b
+    want = y * 0.5 * (1.0 + sp.erf(y / np.sqrt(2.0)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_bias_act_swiglu_smooth_quant():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 8)).astype(np.float32)
+    shift = np.full(4, 0.1, np.float32)
+    smooth = np.full(4, 2.0, np.float32)
+    got = _np(IF.fused_bias_act(jnp.asarray(x), act_method="swiglu",
+                                shift=jnp.asarray(shift),
+                                smooth=jnp.asarray(smooth)))
+    a, b = x[:, :4], x[:, 4:]
+    silu = a / (1 + np.exp(-a))
+    want = (silu * b + 0.1) * 2.0
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # int8 output quantization with round-half-away
+    q = _np(IF.fused_bias_act(jnp.asarray(x), act_method="relu",
+                              quant_scale=10.0, quant_round_type=1,
+                              quant_max_bound=127, quant_min_bound=-127))
+    assert q.dtype == np.int8
+    ref = np.clip(np.sign(np.maximum(x, 0) * 10)
+                  * np.floor(np.abs(np.maximum(x, 0) * 10) + 0.5),
+                  -127, 127)
+    np.testing.assert_array_equal(q, ref.astype(np.int8))
+
+
+def test_fused_bias_act_dequant_scales():
+    x = np.array([[10, -20, 30, 40]], np.int32)
+    dq = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    got = _np(IF.fused_bias_act(jnp.asarray(x), dequant_scales=jnp.asarray(dq),
+                                act_method="relu"))
+    want = np.maximum(x * dq, 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_fused_gate_attention_merged_qkv_parity():
+    """Exact reference pseudo-code replay (fused_gate_attention.py
+    docstring) with merged qkv + gating."""
+    rng = np.random.default_rng(2)
+    n, b, q_len, a, h, c = 2, 3, 4, 8, 2, 4
+    qd = rng.standard_normal((n, b, q_len, a)).astype(np.float32)
+    qkv_w = rng.standard_normal((3, h, c, a)).astype(np.float32)
+    gate_w = rng.standard_normal((a, h, c)).astype(np.float32)
+    gate_b = rng.standard_normal((h, c)).astype(np.float32)
+    out_w = rng.standard_normal((h, c, a)).astype(np.float32)
+    out_b = rng.standard_normal((a,)).astype(np.float32)
+
+    got = _np(IF.fused_gate_attention(
+        jnp.asarray(qd), qkv_weight=jnp.asarray(qkv_w),
+        gate_linear_weight=jnp.asarray(gate_w),
+        gate_linear_bias=jnp.asarray(gate_b),
+        out_linear_weight=jnp.asarray(out_w),
+        out_linear_bias=jnp.asarray(out_b), merge_qkv=True))
+
+    qkv = np.einsum("nbqa,thca->tnbqhc", qd, qkv_w)
+    qh, kh, vh = qkv[0] * (c ** -0.5), qkv[1], qkv[2]
+    logits = np.einsum("nbqhc,nbkhc->nbhqk", qh, kh)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    ctx = np.einsum("nbhqk,nbkhc->nbqhc", w, vh)
+    gate = 1 / (1 + np.exp(-(np.einsum("nbqa,ahc->nbqhc", qd, gate_w)
+                             + gate_b)))
+    want = np.einsum("nbqhc,hco->nbqo", ctx * gate, out_w) + out_b
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_gate_attention_separate_weights_no_gate():
+    rng = np.random.default_rng(3)
+    n, b, q_len, m_len, a, h, c = 1, 2, 3, 5, 6, 2, 3
+    qd = rng.standard_normal((n, b, q_len, a)).astype(np.float32)
+    kd = rng.standard_normal((n, b, m_len, a)).astype(np.float32)
+    qw = rng.standard_normal((a, h, c)).astype(np.float32)
+    kw = rng.standard_normal((a, h, c)).astype(np.float32)
+    vw = rng.standard_normal((a, h, c)).astype(np.float32)
+    ow = rng.standard_normal((h, c, a)).astype(np.float32)
+
+    got = _np(IF.fused_gate_attention(
+        jnp.asarray(qd), key=jnp.asarray(kd), query_weight=jnp.asarray(qw),
+        key_weight=jnp.asarray(kw), value_weight=jnp.asarray(vw),
+        out_linear_weight=jnp.asarray(ow), has_gating=False,
+        merge_qkv=False))
+
+    qh = np.einsum("nbqa,ahc->nbqhc", qd, qw) * (c ** -0.5)
+    kh = np.einsum("nbka,ahc->nbkhc", kd, kw)
+    vh = np.einsum("nbka,ahc->nbkhc", kd, vw)
+    logits = np.einsum("nbqhc,nbkhc->nbhqk", qh, kh)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    ctx = np.einsum("nbhqk,nbkhc->nbqhc", w, vh)
+    want = np.einsum("nbqhc,hco->nbqo", ctx, ow)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def _naive_varlen(q, k, v, ql, kl, causal):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    out = np.zeros_like(q)
+    for bi in range(b):
+        for hi in range(h):
+            for i in range(ql[bi]):
+                keys = kl[bi]
+                s = (q[bi, hi, i] @ k[bi, hi, :keys].T) / np.sqrt(d)
+                if causal:
+                    s[i + 1:] = -np.inf
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[bi, hi, i] = p @ v[bi, hi, :keys]
+    return out
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_variable_length_memory_efficient_attention(causal):
+    rng = np.random.default_rng(4)
+    b, h, s, d = 2, 2, 16, 8
+    q = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    k = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    ql = np.array([10, 16], np.int32)
+    kl = np.array([10, 16], np.int32)
+    got = _np(IF.variable_length_memory_efficient_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(ql), jnp.asarray(kl), causal=causal))
+    want = _naive_varlen(q, k, v, ql, kl, causal)
+    for bi in range(b):
+        np.testing.assert_allclose(got[bi, :, :ql[bi]], want[bi, :, :ql[bi]],
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_fused_dropout_add_and_bias_dropout_residual_ln():
+    x = np.ones((4, 6), np.float32) * 2
+    y = np.ones((4, 6), np.float32)
+    out = _np(IF.fused_dropout_add(jnp.asarray(x), jnp.asarray(y), p=0.0))
+    np.testing.assert_allclose(out, 3.0)
+    # eval mode drops nothing regardless of p
+    out = _np(IF.fused_dropout_add(jnp.asarray(x), jnp.asarray(y), p=0.9,
+                                   training=False))
+    np.testing.assert_allclose(out, 3.0)
+
+    ln_w = np.ones(6, np.float32)
+    ln_b = np.zeros(6, np.float32)
+    res = _np(IF.fused_bias_dropout_residual_layer_norm(
+        jnp.asarray(x), jnp.asarray(y), bias=jnp.asarray(np.full(6, 0.5)),
+        ln_scale=jnp.asarray(ln_w), ln_bias=jnp.asarray(ln_b),
+        dropout_rate=0.0))
+    h = x + 0.5 + y
+    mu = h.mean(-1, keepdims=True)
+    want = (h - mu) / np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(res, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_feedforward_and_mha_run():
+    rng = np.random.default_rng(5)
+    b, s, dim = 2, 4, 8
+    x = rng.standard_normal((b, s, dim)).astype(np.float32)
+    w1 = rng.standard_normal((dim, 16)).astype(np.float32)
+    w2 = rng.standard_normal((16, dim)).astype(np.float32)
+    out = _np(IF.fused_feedforward(
+        jnp.asarray(x), jnp.asarray(w1), jnp.asarray(w2),
+        dropout1_rate=0.0, dropout2_rate=0.0, pre_layer_norm=True,
+        ln1_scale=jnp.asarray(np.ones(dim, np.float32))))
+    # pre-LN: residual + ffn(ln(x))
+    xf = x.astype(np.float64)
+    mu = xf.mean(-1, keepdims=True)
+    ln = (xf - mu) / np.sqrt(xf.var(-1, keepdims=True) + 1e-5)
+    want = x + np.maximum(ln @ w1, 0) @ w2
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-4)
+
+    h, hd = 2, 4
+    qkv_w = rng.standard_normal((3, h, hd, dim)).astype(np.float32)
+    lin_w = rng.standard_normal((dim, dim)).astype(np.float32)
+    out = _np(IF.fused_multi_head_attention(
+        jnp.asarray(x), jnp.asarray(qkv_w), jnp.asarray(lin_w),
+        pre_layer_norm=False, dropout_rate=0.0, attn_dropout_rate=0.0,
+        ln_scale=jnp.asarray(np.ones(dim, np.float32))))
+    assert out.shape == (b, s, dim)
+    assert np.isfinite(out).all()
+    # post-LN output is normalized per token
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-4)

@@ -213,11 +213,12 @@ def test_flash_block_autotune_uses_cache():
         # segment ids must NOT hit the unsegmented entry
         seg = jnp.zeros((4, 1024), jnp.int32)
         assert _select_blocks(q, k, k, True, 0.125, 4, 4, True,
-                              q_seg=seg, k_seg=seg) == (512, 512)
+                              q_seg=seg, k_seg=seg) == (1024, 1024)
     finally:
         AutoTuneCache.instance().clear()
-    # cache miss + autotune off -> measured default
-    assert _select_blocks(q, k, k, True, 0.125, 4, 4, True) == (512, 512)
+    # cache miss + autotune off -> measured default (r5: 1024 tiles —
+    # compressed live lists made dead-tile DMA free, big tiles win)
+    assert _select_blocks(q, k, k, True, 0.125, 4, 4, True) == (1024, 1024)
 
 
 def test_stream_event_compat():
