@@ -423,6 +423,330 @@ def _flash_forward(q, k, v, causal: bool, scale: float, h: int, kvh: int,
 
 
 # --------------------------------------------------------------------------
+# head-batched (HB) kernels for the unmasked dense path: grid rows are
+# b*kvh GQA GROUPS, the group's ``rep`` q heads ride a leading block dim.
+# k/v stream ONCE per group instead of once per q head (rep x less k/v
+# DMA, rep x fewer grid rows), and the group's kv-grad summation falls
+# out of a free [rep, BQ] -> [rep*BQ] reshape before the dk/dv matmuls.
+# Measured v5e, flagship shape (b6 s1024 h16 kvh4 d128): fwd 0.48 vs
+# 0.64ms, fwd+bwd below.  Masked paths (segments/bands) keep the
+# per-head kernels above with their compressed live-tile lists.
+# --------------------------------------------------------------------------
+
+def _hb_flash_kernel(*refs, scale, causal, block_q, block_k, seq_q, seq_k,
+                     rep):
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    qi, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    ki = j
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0].reshape(rep * block_q, -1)        # [rep*BQ, d]
+        k = k_ref[0]                                   # [BK, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = s.reshape(rep, block_q, block_k)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = None
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            keep = q_pos >= k_pos
+        if seq_k % block_k != 0:
+            pad = k_pos < seq_k
+            keep = pad if keep is None else keep & pad
+        if keep is not None:
+            s = jnp.where(keep[None], s, NEG_INF)
+        m_prev = m_scr[:].reshape(rep, block_q, 128)[:, :, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_prev = l_scr[:].reshape(rep, block_q, 128)[:, :, :1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        vt = v_ref[0]
+        if seq_k % block_k != 0:
+            row_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, vt.shape, 0)
+            vt = jnp.where(row_pos < seq_k, vt, jnp.zeros_like(vt))
+        pv = jax.lax.dot_general(
+            p.reshape(rep * block_q, block_k).astype(vt.dtype), vt,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc = acc_scr[:].reshape(rep, block_q, -1)
+        acc = acc * alpha + pv.reshape(rep, block_q, -1)
+        acc_scr[:] = acc.reshape(rep * block_q, -1)
+        m_scr[:] = jnp.broadcast_to(m_new, (rep, block_q, 128)).reshape(
+            rep * block_q, 128)
+        l_scr[:] = jnp.broadcast_to(l_new, (rep, block_q, 128)).reshape(
+            rep * block_q, 128)
+
+    if causal:
+        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _():
+        m = m_scr[:].reshape(rep, block_q, 128)[:, :, :1]
+        l = l_scr[:].reshape(rep, block_q, 128)[:, :, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        valid = m > NEG_INF * 0.5
+        acc = acc_scr[:].reshape(rep, block_q, -1)
+        o_ref[0] = jnp.where(valid, acc / l, 0.0).astype(o_ref.dtype)
+        lse_col = jnp.where(valid, m + jnp.log(l), -NEG_INF)
+        lse_ref[0] = jnp.broadcast_to(
+            jnp.swapaxes(lse_col, 1, 2), (rep, 8, block_q))
+
+
+def _hb_flash_forward(q, k, v, causal, scale, block_q=256, block_k=1024,
+                      interpret=False):
+    """q [b*kvh, rep, s, d]; k/v [b*kvh, s, d] -> (o [b*kvh, rep, s, d],
+    lse [b*kvh, rep, 8, s])."""
+    bkv, rep, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _clamp_block(block_q, sq)
+    block_k = _clamp_block(block_k, sk)
+    grid = (bkv, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    return pl.pallas_call(
+        functools.partial(_hb_flash_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq,
+                          seq_k=sk, rep=rep),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, rep, block_q, d), lambda b, i, j: (b, 0, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, rep, block_q, d), lambda b, i, j: (b, 0, i, 0)),
+            pl.BlockSpec((1, rep, 8, block_q), lambda b, i, j: (b, 0, 0, i)),
+        ),
+        out_shape=(
+            _sds((bkv, rep, sq, d), q.dtype),
+            _sds((bkv, rep, 8, sq), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rep * block_q, 128), jnp.float32),
+            pltpu.VMEM((rep * block_q, 128), jnp.float32),
+            pltpu.VMEM((rep * block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _hb_bwd_kernel(*refs, scale, causal, block_q, block_k, seq_q, seq_k,
+                   rep):
+    """Fused HB backward: grid (b*kvh, qi, ki); dq in [rep*BQ, d] scratch
+    (flushed per q row), dk/dv in full-sequence scratch (flushed once per
+    group) — the group's kv-grad sum IS the [rep*BQ, BK]^T matmul."""
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr) = refs
+    qi, j = pl.program_id(1), pl.program_id(2)
+    nq, nk = pl.num_programs(1), pl.num_programs(2)
+    ki = j
+
+    @pl.when((qi == 0) & (j == 0))
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q2 = q_ref[0].reshape(rep * block_q, -1)
+        do2 = do_ref[0].reshape(rep * block_q, -1)
+        if seq_q % block_q != 0:
+            pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (rep, block_q), 1)
+            live = (pos < seq_q).reshape(rep * block_q, 1)
+            q2 = jnp.where(live, q2, jnp.zeros_like(q2))
+            do2 = jnp.where(live, do2, jnp.zeros_like(do2))
+        k = k_ref[0]
+        v = v_ref[0]
+        if seq_k % block_k != 0:
+            k = _mask_rows(k, ki * block_k, seq_k, block_k)
+            v = _mask_rows(v, ki * block_k, seq_k, block_k)
+        s = jax.lax.dot_general(
+            q2, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = s.reshape(rep, block_q, block_k)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = None
+        if causal:
+            keep = q_pos >= k_pos
+        if seq_k % block_k != 0:
+            pad = k_pos < seq_k
+            keep = pad if keep is None else keep & pad
+        if keep is not None:
+            s = jnp.where(keep[None], s, NEG_INF)
+        lse = lse_ref[0]                               # [rep, 8, BQ]
+        p = jnp.exp(s - jnp.swapaxes(lse[:, :1, :], 1, 2))
+        if seq_q % block_q != 0:
+            # padded q rows carry garbage/NaN lse — zero via where
+            p = jnp.where((q_pos < seq_q)[None], p, 0.0)
+        p2 = p.reshape(rep * block_q, block_k)
+        dp = jax.lax.dot_general(
+            do2, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [rep*BQ, BK]
+        delta = jnp.swapaxes(delta_ref[0][:, :1, :], 1, 2)  # [rep, BQ, 1]
+        ds = (p * (dp.reshape(rep, block_q, block_k) - delta)
+              * scale)
+        if seq_q % block_q != 0:
+            ds = jnp.where((q_pos < seq_q)[None], ds, 0.0)
+        if seq_k % block_k != 0:
+            ds = jnp.where((k_pos < seq_k)[None], ds, 0.0)
+        ds2 = ds.reshape(rep * block_q, block_k)
+        dq_scr[:] += jax.lax.dot_general(
+            ds2.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [rep*BQ, d]
+        off = ki * block_k
+        dv_scr[pl.ds(off, block_k), :] += jax.lax.dot_general(
+            p2.astype(do2.dtype), do2, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BK, d]
+        dk_scr[pl.ds(off, block_k), :] += jax.lax.dot_general(
+            ds2.astype(q2.dtype), q2, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].reshape(rep, block_q, -1).astype(dq_ref.dtype)
+
+    @pl.when((qi == nq - 1) & (j == nk - 1))
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _hb_bwd_blocks(rep, sq, sk, d):
+    """Backward tile sizes for the HB kernel, rep-aware: the s/p/ds/dp
+    intermediates are [rep*BQ, BK] f32, so the tile-area clamp scales
+    with rep (single admissibility source for the kernel AND the routing
+    gate).  Returns (block_q, block_k) or None when the full-seq dk/dv
+    scratch cannot fit."""
+    block_q, block_k = 512, 512
+    while rep * block_q * block_k > 512 * 512 and \
+            (block_q > 128 or block_k > 128):
+        if block_q >= block_k and block_q > 128:
+            block_q //= 2
+        else:
+            block_k //= 2
+    block_q = _clamp_block(block_q, sq)
+    block_k = _clamp_block(block_k, sk)
+    sk_pad = pl.cdiv(sk, block_k) * block_k
+    if 2 * sk_pad * d * 4 > _FUSED_BWD_VMEM_BUDGET:
+        return None
+    return block_q, block_k
+
+
+def _hb_flash_backward(q, k, v, o, lse, do, causal, scale, interpret=False):
+    """HB layouts as in _hb_flash_forward; returns (dq [b*kvh, rep, s, d],
+    dk, dv [b*kvh, s, d] — group-summed in-kernel)."""
+    bkv, rep, sq, d = q.shape
+    sk = k.shape[1]
+    blocks = _hb_bwd_blocks(rep, sq, sk, d)
+    if blocks is None:
+        raise FlashUnsupportedError("sequence too long for the HB fused "
+                                    "backward's full-seq scratch")
+    block_q, block_k = blocks
+    nk = pl.cdiv(sk, block_k)
+    sk_pad = nk * block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                           # [bkv, rep, sq]
+    delta = jnp.broadcast_to(delta[:, :, None, :], (bkv, rep, 8, sq))
+    qspec = pl.BlockSpec((1, rep, block_q, d), lambda b, i, j: (b, 0, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, rep, 8, block_q),
+                           lambda b, i, j: (b, 0, 0, i))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_hb_bwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq,
+                          seq_k=sk, rep=rep),
+        grid=(bkv, pl.cdiv(sq, block_q), nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=(
+            qspec,
+            pl.BlockSpec((1, sk_pad, d), lambda b, i, j: (b, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i, j: (b, 0, 0)),
+        ),
+        out_shape=(
+            _sds((bkv, rep, sq, d), q.dtype),
+            _sds((bkv, sk_pad, d), k.dtype),
+            _sds((bkv, sk_pad, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rep * block_q, d), jnp.float32),
+            pltpu.VMEM((sk_pad, d), jnp.float32),
+            pltpu.VMEM((sk_pad, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk[:, :sk], dv[:, :sk]
+
+
+def _hb_enabled() -> bool:
+    """Opt-in toggle for the head-batched kernels (see the routing note
+    in flash_attention_raw)."""
+    import os
+
+    return os.environ.get("PADDLE_TPU_FLASH_HEAD_BATCHED", "0") == "1"
+
+
+def _to_hb(q, k, v, h, kvh):
+    """[b, s, h, d] q + [b, s, kvh, d] k/v -> HB layouts (free reshapes:
+    q's heads are group-major, matching _kv_index)."""
+    b, s, _, d = q.shape
+    rep = h // kvh
+    qhb = q.transpose(0, 2, 1, 3).reshape(b * kvh, rep, s, d)
+    khb = k.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
+    vhb = v.transpose(0, 2, 1, 3).reshape(b * kvh, s, d)
+    return qhb, khb, vhb
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_hb(q, k, v, causal, scale, interpret):
+    out, _ = _flash_hb_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _flash_hb_fwd(q, k, v, causal, scale, interpret):
+    o, lse = _hb_flash_forward(q, k, v, causal, scale, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_hb_bwd(causal, scale, interpret, res, g):
+    q, k, v, o, lse = res
+    dq, dk, dv = _hb_flash_backward(q, k, v, o, lse, g, causal, scale,
+                                    interpret=interpret)
+    return dq, dk, dv
+
+
+_flash_hb.defvjp(_flash_hb_fwd, _flash_hb_bwd)
+
+
+# --------------------------------------------------------------------------
 # tiled backward (flash-v2): dq kernel (k innermost) + dkv kernel
 # (q innermost), recomputing p from (q,k,lse) per tile — no s^2 residency
 # --------------------------------------------------------------------------
@@ -1015,6 +1339,26 @@ def flash_attention_raw(q, k, v, causal: bool = True, scale=None,
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError("q_segment_ids and kv_segment_ids must be given "
                          "together")
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    sk = k.shape[1]
+    # OPT-IN head-batched path (env PADDLE_TPU_FLASH_HEAD_BATCHED=1):
+    # one k/v stream
+    # per GQA group + fused group-summed backward — measured 7% faster
+    # fwd+bwd at the flagship shape (1.315 vs 1.418 ms) with identical
+    # accuracy vs f32 ground truth.  NOT the default: the kernels
+    # reproducibly crash the tunnel's tpu_compile_helper when embedded in
+    # a lax.scan/fori_loop (standalone jit compiles and passes the
+    # numeric gate), so routing them under the accum train step would
+    # break the headline bench.  Revisit when the toolchain moves.
+    if _hb_enabled() and (q_segment_ids is None and mask_bands is None
+                          and blocks is None and h % kvh == 0 and sk == s
+                          and _hb_bwd_blocks(h // kvh, s, sk, d)
+                          is not None):
+        qhb, khb, vhb = _to_hb(q, k, v, h, kvh)
+        ohb = _flash_hb(qhb, khb, vhb, bool(causal), float(scale),
+                        bool(interpret))
+        return ohb.reshape(b, kvh * (h // kvh), s, d).transpose(0, 2, 1, 3)
     return _flash(q, k, v, q_segment_ids, kv_segment_ids,
                   None if mask_bands is None else tuple(mask_bands),
                   bool(causal), float(scale), bool(interpret),
