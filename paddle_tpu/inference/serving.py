@@ -122,7 +122,8 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg, params, max_slots: int = 8,
                  num_pages: int = 64, page_size="auto",
                  max_seq_len: Optional[int] = None,
-                 decode_chunk_steps: int = 8, eos_id: int = -1):
+                 decode_chunk_steps: int = 8, eos_id: int = -1,
+                 cache_dtype=None):
         from ..models.generation import _CFGS, register_config
 
         self.cfg = cfg
@@ -148,6 +149,15 @@ class ContinuousBatchingEngine:
         L = cfg.num_hidden_layers
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
         dt = next(iter(params.values())).dtype
+        if cache_dtype is not None:
+            dt = jnp.dtype(cache_dtype)
+        self.cache_dtype = dt
+        # int8 cache: frozen per-(layer, kv-head) scales, auto-calibrated
+        # from the FIRST prefill's K/V absmax (2x headroom) — a single
+        # self-consistent quant/dequant pair for the whole run (the
+        # reference's static cachekv_quant mode; see incubate/nn/
+        # decode_attention.py for the dynamic per-sequence contract)
+        self.kv_scales = None
         self.k_pages = jnp.zeros((L, self.num_pages, kvh, self.page_size, d),
                                  dt)
         self.v_pages = jnp.zeros_like(self.k_pages)
@@ -178,7 +188,7 @@ class ContinuousBatchingEngine:
              donate_argnums=(1, 2))
     def _decode_chunk_jit(params, k_pages, v_pages, tables, seq_lens,
                           tok, active, cos_tab, sin_tab, self_cfg_id,
-                          chunk):
+                          chunk, kv_scales=None):
         from ..models.generation import _CFGS, _Weights
 
         cfg, _, _ = _CFGS[self_cfg_id]
@@ -217,15 +227,37 @@ class ContinuousBatchingEngine:
                 v = (xin @ w.layer(i, "self_attn.v_proj.weight")
                      ).reshape(nslots, 1, kvh, d)
                 q, k = _apply_rope(q, k, cos, sin)
-                kp = k_pages[i].at[phys, :, slot, :].set(
-                    k[:, 0].astype(k_pages.dtype))
-                vp = v_pages[i].at[phys, :, slot, :].set(
-                    v[:, 0].astype(v_pages.dtype))
+                kw_, vw_ = k[:, 0], v[:, 0]
+                qd = q.reshape(nslots, h, d)
+                if k_pages.dtype == jnp.int8:
+                    # quantize the new token; fold k-dequant into q and
+                    # v-dequant into the context (exact per-head linear
+                    # folds — see incubate/nn/decode_attention.py)
+                    kqs = kv_scales["kq"][i][None, :, None]
+                    vqs = kv_scales["vq"][i][None, :, None]
+                    kw_ = jnp.clip(
+                        jnp.sign(kw_.astype(jnp.float32) * kqs)
+                        * jnp.floor(jnp.abs(kw_.astype(jnp.float32) * kqs)
+                                    + 0.5), -127, 127).astype(jnp.int8)
+                    vw_ = jnp.clip(
+                        jnp.sign(vw_.astype(jnp.float32) * vqs)
+                        * jnp.floor(jnp.abs(vw_.astype(jnp.float32) * vqs)
+                                    + 0.5), -127, 127).astype(jnp.int8)
+                    rep_ = h // kvh
+                    kdq = jnp.repeat(kv_scales["kdq"][i], rep_)
+                    qd = (qd.astype(jnp.float32)
+                          * kdq[None, :, None]).astype(q.dtype)
+                kp = k_pages[i].at[phys, :, slot, :].set(kw_)
+                vp = v_pages[i].at[phys, :, slot, :].set(vw_)
                 k_pages = k_pages.at[i].set(kp)
                 v_pages = v_pages.at[i].set(vp)
-                ctx = paged_decode_raw(q.reshape(nslots, h, d), kp, vp,
+                ctx = paged_decode_raw(qd, kp, vp,
                                        seq_lens + 1, tables,
                                        scale=d ** -0.5)
+                if k_pages.dtype == jnp.int8:
+                    rep_ = h // kvh
+                    vdq = jnp.repeat(kv_scales["vdq"][i], rep_)
+                    ctx = ctx.astype(jnp.float32) * vdq[None, :, None]
                 x = x + (ctx.reshape(nslots, 1, h * d).astype(x.dtype)
                          @ w.layer(i, "self_attn.o_proj.weight"))
                 xm = _rms_norm(x, w.layer(i, "post_attention_layernorm"
@@ -296,6 +328,14 @@ class ContinuousBatchingEngine:
                 vt[:, :, lo:lo + page_size])
         return k_pages, v_pages
 
+    @staticmethod
+    def _quant(x, scale):
+        """x [L, tokens, kvh, d] x per-(L, kvh) scale -> int8."""
+        y = jnp.sign(x.astype(jnp.float32) * scale[:, None, :, None]) \
+            * jnp.floor(jnp.abs(x.astype(jnp.float32)
+                                * scale[:, None, :, None]) + 0.5)
+        return jnp.clip(y, -127, 127).astype(jnp.int8)
+
     # ---------------- host scheduler ----------------
 
     def add_request(self, prompt, max_new_tokens: int = 32, rid=None,
@@ -350,6 +390,18 @@ class ContinuousBatchingEngine:
                 self.params, jnp.asarray(ids), jnp.asarray(s, jnp.int32),
                 self.cos_tab, self.sin_tab, self_cfg_id=self.cfg_id,
                 bucket=bucket)
+            if self.cache_dtype == jnp.int8 and self.kv_scales is None:
+                # calibrate once: absmax per (layer, kv head) over the
+                # first prompt's real tokens, 2x headroom
+                kabs = jnp.max(jnp.abs(ks[:, :s].astype(jnp.float32)),
+                               axis=(1, 3)) * 2.0 + 1e-6     # [L, kvh]
+                vabs = jnp.max(jnp.abs(vs[:, :s].astype(jnp.float32)),
+                               axis=(1, 3)) * 2.0 + 1e-6
+                self.kv_scales = {"kq": 127.0 / kabs, "kdq": kabs / 127.0,
+                                  "vq": 127.0 / vabs, "vdq": vabs / 127.0}
+            if self.cache_dtype == jnp.int8:
+                ks = self._quant(ks, self.kv_scales["kq"])
+                vs = self._quant(vs, self.kv_scales["vq"])
             # scatter the prompt K/V into this slot's pages in ONE
             # dispatch (per-page eager .at[].set would rewrite the whole
             # pool per page — >1s of tunnel dispatch per admission)
@@ -406,7 +458,7 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self.tables), jnp.asarray(self.seq_lens),
                 jnp.asarray(self.cur_tok), jnp.asarray(self.active),
                 self.cos_tab, self.sin_tab, self_cfg_id=self.cfg_id,
-                chunk=steps)
+                chunk=steps, kv_scales=self.kv_scales)
         self.k_pages, self.v_pages = k_pages, v_pages
         toks = np.asarray(toks)                       # [slots, steps]
         self.seq_lens = np.asarray(seq_lens).copy()

@@ -145,3 +145,31 @@ def test_serving_rejects_oversized_prompt(tiny_model):
     eng = _engine(cfg, params, max_seq_len=32)
     with pytest.raises(ValueError):
         eng.add_request(np.zeros(30, np.int32), max_new_tokens=8)
+
+
+def test_serving_int8_cache_close_to_bf16(tiny_model):
+    """cache_dtype=int8: frozen auto-calibrated per-(layer, head) scales;
+    the greedy token streams should match the fp32-cache engine for most
+    steps (quantization may flip rare near-ties, but the run must
+    complete and mostly agree) — the serving-side composition of the
+    int8 KV-cache capability."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (6, 11)]
+
+    outs = {}
+    for dt in (None, jnp.int8):
+        eng = _engine(cfg, params, cache_dtype=dt)
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=8)
+        done = eng.run()
+        outs[dt] = [f.tokens for f in done]
+        if dt == jnp.int8:
+            assert eng.k_pages.dtype == jnp.int8
+            assert eng.kv_scales is not None
+
+    total_matching_tokens = sum(
+        (np.asarray(a[:len(b)]) == np.asarray(b[:len(a)])).mean()
+        for a, b in zip(outs[None], outs[jnp.int8])) / len(prompts)
+    assert total_matching_tokens > 0.7, (outs, total_matching_tokens)
