@@ -93,6 +93,14 @@ def tune_page_size(b, kvh, d, capacity, dtype=jnp.bfloat16,
         key, [p for p in candidates if capacity % p == 0], measure)
 
 
+def _round_int8(x):
+    """Round-half-away-from-zero to int8 range (the reference's
+    quant_round_type=1; shared by calibration-time and decode-time
+    quantization)."""
+    y = jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+    return jnp.clip(y, -127, 127).astype(jnp.int8)
+
+
 class PageAllocator:
     """Host-side physical-page free list (reuse is LIFO so hot pages stay
     cache/TLB friendly)."""
@@ -229,33 +237,28 @@ class ContinuousBatchingEngine:
                 q, k = _apply_rope(q, k, cos, sin)
                 kw_, vw_ = k[:, 0], v[:, 0]
                 qd = q.reshape(nslots, h, d)
+                rep_ = h // kvh
                 if k_pages.dtype == jnp.int8:
                     # quantize the new token; fold k-dequant into q and
                     # v-dequant into the context (exact per-head linear
                     # folds — see incubate/nn/decode_attention.py)
-                    kqs = kv_scales["kq"][i][None, :, None]
-                    vqs = kv_scales["vq"][i][None, :, None]
-                    kw_ = jnp.clip(
-                        jnp.sign(kw_.astype(jnp.float32) * kqs)
-                        * jnp.floor(jnp.abs(kw_.astype(jnp.float32) * kqs)
-                                    + 0.5), -127, 127).astype(jnp.int8)
-                    vw_ = jnp.clip(
-                        jnp.sign(vw_.astype(jnp.float32) * vqs)
-                        * jnp.floor(jnp.abs(vw_.astype(jnp.float32) * vqs)
-                                    + 0.5), -127, 127).astype(jnp.int8)
-                    rep_ = h // kvh
+                    kw_ = _round_int8(kw_.astype(jnp.float32)
+                                      * kv_scales["kq"][i][None, :, None])
+                    vw_ = _round_int8(vw_.astype(jnp.float32)
+                                      * kv_scales["vq"][i][None, :, None])
                     kdq = jnp.repeat(kv_scales["kdq"][i], rep_)
                     qd = (qd.astype(jnp.float32)
                           * kdq[None, :, None]).astype(q.dtype)
-                kp = k_pages[i].at[phys, :, slot, :].set(kw_)
-                vp = v_pages[i].at[phys, :, slot, :].set(vw_)
+                kp = k_pages[i].at[phys, :, slot, :].set(
+                    kw_.astype(k_pages.dtype))
+                vp = v_pages[i].at[phys, :, slot, :].set(
+                    vw_.astype(v_pages.dtype))
                 k_pages = k_pages.at[i].set(kp)
                 v_pages = v_pages.at[i].set(vp)
                 ctx = paged_decode_raw(qd, kp, vp,
                                        seq_lens + 1, tables,
                                        scale=d ** -0.5)
                 if k_pages.dtype == jnp.int8:
-                    rep_ = h // kvh
                     vdq = jnp.repeat(kv_scales["vdq"][i], rep_)
                     ctx = ctx.astype(jnp.float32) * vdq[None, :, None]
                 x = x + (ctx.reshape(nslots, 1, h * d).astype(x.dtype)
@@ -331,10 +334,8 @@ class ContinuousBatchingEngine:
     @staticmethod
     def _quant(x, scale):
         """x [L, tokens, kvh, d] x per-(L, kvh) scale -> int8."""
-        y = jnp.sign(x.astype(jnp.float32) * scale[:, None, :, None]) \
-            * jnp.floor(jnp.abs(x.astype(jnp.float32)
-                                * scale[:, None, :, None]) + 0.5)
-        return jnp.clip(y, -127, 127).astype(jnp.int8)
+        return _round_int8(x.astype(jnp.float32)
+                           * scale[:, None, :, None])
 
     # ---------------- host scheduler ----------------
 
