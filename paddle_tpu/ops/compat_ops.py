@@ -1,0 +1,329 @@
+"""Top-level tensor-API long tail (round-5): the `paddle.*` names from
+the reference's python/paddle/__init__.py __all__ that had no
+implementation yet — special functions, stacking/splitting helpers,
+distance/quantile/scatter utilities.  Each is a registered op (tape +
+Tensor aware via the registry decorator) with a YAML golden where the
+generated harness fits, or a dedicated test in
+tests/test_compat_ops.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ------------------------------ special functions ---------------------------
+
+
+@register("gammainc", amp="black")
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (reference paddle.gammainc)."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+@register("multigammaln", amp="black")
+def multigammaln(x, p):
+    """Multivariate log-gamma (reference paddle.multigammaln)."""
+    import math
+
+    i = jnp.arange(int(p), dtype=jnp.float32)
+    return (p * (p - 1) / 4.0) * math.log(math.pi) + jnp.sum(
+        lax.lgamma(jnp.asarray(x, jnp.float32)[..., None] - 0.5 * i),
+        axis=-1)
+
+
+@register("sinc", amp="black")
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@register("ldexp")
+def ldexp(x, y):
+    return x * jnp.power(2.0, y).astype(jnp.result_type(x, jnp.float32))
+
+
+@register("frexp")
+def frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+@register("signbit")
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@register("sgn")
+def sgn(x):
+    """sign for real; x/|x| for complex (reference paddle.sgn)."""
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.maximum(mag, 1e-38))
+    return jnp.sign(x)
+
+
+@register("isin")
+def isin(x, test_x, assume_unique=False, invert=False):
+    return jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
+
+
+@register("isneginf")
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+@register("isposinf")
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+@register("isreal")
+def isreal(x):
+    return jnp.isreal(x)
+
+
+@register("gcd")
+def gcd(x, y):
+    return jnp.gcd(jnp.asarray(x, jnp.int64), jnp.asarray(y, jnp.int64))
+
+
+@register("lcm")
+def lcm(x, y):
+    return jnp.lcm(jnp.asarray(x, jnp.int64), jnp.asarray(y, jnp.int64))
+
+
+@register("deg2rad", amp="black")
+def deg2rad(x):
+    return jnp.deg2rad(jnp.asarray(x, jnp.float32)
+                       if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+                       else x)
+
+
+@register("rad2deg", amp="black")
+def rad2deg(x):
+    return jnp.rad2deg(jnp.asarray(x, jnp.float32)
+                       if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+                       else x)
+
+
+@register("polar", amp="black")
+def polar(abs, angle):  # noqa: A002
+    return (abs * jnp.cos(angle) + 1j * (abs * jnp.sin(angle))).astype(
+        jnp.complex64)
+
+
+# ------------------------------ reductions / quantiles ----------------------
+
+
+def _quantile_impl(x, q, axis, keepdim, interpolation, ignore_nan):
+    xf = jnp.asarray(x, jnp.float32)
+    qv = jnp.asarray(q, jnp.float32)
+    method = interpolation
+    fn = jnp.nanquantile if ignore_nan else jnp.quantile
+    out = fn(xf, qv, axis=axis, keepdims=keepdim, method=method)
+    return out
+
+
+@register("quantile", amp="black")
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return _quantile_impl(x, q, axis, keepdim, interpolation, False)
+
+
+@register("nanquantile", amp="black")
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return _quantile_impl(x, q, axis, keepdim, interpolation, True)
+
+
+@register("trapezoid", amp="black")
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, jnp.asarray(x), axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@register("cumulative_trapezoid", amp="black")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    y = jnp.asarray(y)
+    n = y.shape[axis]
+    y0 = lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    y1 = lax.slice_in_dim(y, 1, n, axis=axis)
+    avg = (y0 + y1) * 0.5
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            d = jnp.diff(x)
+            shape = [1] * y.ndim
+            shape[axis] = d.shape[0]
+            d = d.reshape(shape)
+        else:
+            d = jnp.diff(x, axis=axis)
+        avg = avg * d
+    else:
+        avg = avg * (1.0 if dx is None else dx)
+    return jnp.cumsum(avg, axis=axis)
+
+
+# ------------------------------ distance ------------------------------------
+
+
+@register("cdist", amp="black")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    """Pairwise p-norm distance [.., M, D] x [.., N, D] -> [.., M, N]
+    (reference paddle.cdist).  p=2 rides the MXU via the gram expansion."""
+    xf = jnp.asarray(x, jnp.float32)
+    yf = jnp.asarray(y, jnp.float32)
+    if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+        x2 = jnp.sum(xf ** 2, -1, keepdims=True)           # [.., M, 1]
+        y2 = jnp.sum(yf ** 2, -1, keepdims=True)           # [.., N, 1]
+        g = jnp.einsum("...md,...nd->...mn", xf, yf)
+        d2 = x2 + jnp.swapaxes(y2, -1, -2) - 2.0 * g
+        return jnp.sqrt(jnp.maximum(d2, 0.0))
+    diff = jnp.abs(xf[..., :, None, :] - yf[..., None, :, :])
+    if p == 0:
+        return jnp.sum((diff != 0).astype(jnp.float32), -1)
+    if jnp.isinf(p):
+        return jnp.max(diff, -1)
+    return jnp.sum(diff ** p, -1) ** (1.0 / p)
+
+
+@register("pdist", amp="black")
+def pdist(x, p=2.0):
+    """Condensed pairwise distance of [N, D] -> [N*(N-1)/2]
+    (reference paddle.pdist; upper-triangle row order)."""
+    n = x.shape[0]
+    full = cdist.raw_fn(x, x, p=p)
+    iu = jnp.triu_indices(n, k=1)
+    return full[iu]
+
+
+# ------------------------------ structure / stacking ------------------------
+
+
+@register("add_n")
+def add_n(inputs):
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+@register("block_diag")
+def block_diag(inputs):
+    mats = [jnp.atleast_2d(jnp.asarray(m)) for m in inputs]
+    return jax.scipy.linalg.block_diag(*mats)
+
+
+@register("cartesian_prod")
+def cartesian_prod(x):
+    grids = jnp.meshgrid(*[jnp.asarray(t).reshape(-1) for t in x],
+                         indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+@register("combinations")
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+
+    n = x.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = jnp.asarray(list(it), jnp.int32).reshape(-1, r)
+    return jnp.take(jnp.asarray(x), idx, axis=0)
+
+
+@register("vander")
+def vander(x, n=None, increasing=False):
+    xv = jnp.asarray(x)
+    m = xv.shape[0] if n is None else int(n)
+    powers = jnp.arange(m)
+    if not increasing:
+        powers = powers[::-1]
+    return xv[:, None] ** powers[None, :].astype(xv.dtype)
+
+
+@register("diagonal_scatter")
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    xv = jnp.asarray(x)
+    ax1 = axis1 % xv.ndim
+    ax2 = axis2 % xv.ndim
+    n = jnp.diagonal(jnp.zeros(xv.shape, bool), offset=offset,
+                     axis1=axis1, axis2=axis2).shape[-1]
+    i = jnp.arange(n)
+    r = i - min(offset, 0)
+    c = i + max(offset, 0)
+    # scatter along the two axes via explicit advanced indexing
+    other_axes = [a for a in range(xv.ndim) if a not in (ax1, ax2)]
+    grid = jnp.meshgrid(*[jnp.arange(xv.shape[a]) for a in other_axes],
+                        i, indexing="ij")
+    coords = [None] * xv.ndim
+    for gi, a in enumerate(other_axes):
+        coords[a] = grid[gi]
+    coords[ax1] = jnp.broadcast_to(r, grid[-1].shape)
+    coords[ax2] = jnp.broadcast_to(c, grid[-1].shape)
+    return xv.at[tuple(coords)].set(jnp.asarray(y, xv.dtype))
+
+
+@register("slice_scatter")
+def slice_scatter(x, value, axes, starts, ends, strides=None):
+    xv = jnp.asarray(x)
+    strides = strides or [1] * len(axes)
+    idx = [slice(None)] * xv.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(int(s), int(e), int(st))
+    return xv.at[tuple(idx)].set(jnp.asarray(value, xv.dtype))
+
+
+@register("masked_scatter")
+def masked_scatter(x, mask, value):
+    """Fill masked positions (row-major order) from value's leading
+    elements (reference paddle.masked_scatter)."""
+    xv = jnp.asarray(x)
+    m = jnp.broadcast_to(jnp.asarray(mask, bool), xv.shape).reshape(-1)
+    src = jnp.asarray(value).reshape(-1)
+    # position among masked elements for each flat index
+    pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+    take_idx = jnp.clip(pos, 0, src.shape[0] - 1)
+    out = jnp.where(m, src[take_idx], xv.reshape(-1))
+    return out.reshape(xv.shape)
+
+
+@register("scatter_nd")
+def scatter_nd(index, updates, shape):
+    z = jnp.zeros(tuple(int(s) for s in shape),
+                  jnp.asarray(updates).dtype)
+    idx = jnp.asarray(index, jnp.int32)
+    return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(jnp.asarray(updates))
+
+
+
+@register("tensordot")
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)) and len(axes) == 2 \
+            and isinstance(axes[0], (list, tuple)):
+        axes = (tuple(axes[0]), tuple(axes[1]))
+    return jnp.tensordot(jnp.asarray(x), jnp.asarray(y), axes=axes)
+
+
+@register("histogram_bin_edges", amp="black")
+def histogram_bin_edges(input, bins=100, min=0.0, max=0.0):  # noqa: A002
+    iv = jnp.asarray(input, jnp.float32)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo_t, hi_t = jnp.min(iv), jnp.max(iv)
+        same = lo_t == hi_t
+        lo_t = jnp.where(same, lo_t - 1, lo_t)
+        hi_t = jnp.where(same, hi_t + 1, hi_t)
+        return jnp.linspace(lo_t, hi_t, int(bins) + 1)
+    return jnp.linspace(lo, hi, int(bins) + 1)
+
+
+@register("histogramdd", amp="black")
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    xv = jnp.asarray(x, jnp.float32)
+    h, edges = jnp.histogramdd(xv, bins=bins, range=ranges,
+                               density=density, weights=weights)
+    return h, tuple(edges)
+
