@@ -51,3 +51,5 @@ def _late_bind_clip():
     ClipGradByNorm = _clip.ClipGradByNorm
     ClipGradByGlobalNorm = _clip.ClipGradByGlobalNorm
     ClipGradByValue = _clip.ClipGradByValue
+
+from .extra_layers import *  # noqa: F401,F403  (round-5 layer long tail)

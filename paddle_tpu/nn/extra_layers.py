@@ -1,0 +1,565 @@
+"""Round-5 nn layer long tail (reference python/paddle/nn/__init__.py
+__all__): pooling/pad/norm/loss/conv-transpose layer classes over the
+functional surface, plus seq2seq decoding (BiRNN, BeamSearchDecoder,
+dynamic_decode) and SpectralNorm."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.registry import dispatch
+from . import functional as F
+from .layer import Layer, Parameter
+
+__all__ = [
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "AvgPool3D", "MaxPool3D", "MaxUnPool1D",
+    "MaxUnPool3D", "LPPool1D", "LPPool2D", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "Pad1D", "Pad3D", "ZeroPad1D", "ZeroPad2D",
+    "ZeroPad3D", "InstanceNorm1D", "InstanceNorm3D", "Softmax2D",
+    "Unflatten", "PairwiseDistance", "MultiMarginLoss",
+    "TripletMarginWithDistanceLoss", "HSigmoidLoss",
+    "AdaptiveLogSoftmaxWithLoss", "FeatureAlphaDropout", "Conv1DTranspose",
+    "Conv3DTranspose", "SpectralNorm", "BiRNN", "BeamSearchDecoder",
+    "dynamic_decode",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ------------------------------ pooling -------------------------------------
+
+
+class _PoolNd(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.kw = kw
+
+
+class MaxPool3D(_PoolNd):
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool3D(_PoolNd):
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(AdaptiveAvgPool1D):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size)
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        # return_mask forwards to the functional (which raises loudly
+        # for the unsupported index round-trip instead of silently
+        # dropping the flag)
+        return F.adaptive_max_pool1d(x, self.output_size,
+                                     return_mask=self.return_mask)
+
+
+class AdaptiveAvgPool3D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(AdaptiveMaxPool1D):
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size,
+                                     return_mask=self.return_mask)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class MaxUnPool3D(MaxUnPool1D):
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding)
+
+
+class LPPool2D(LPPool1D):
+    def forward(self, x):
+        return dispatch("lp_pool2d", x, self.norm_type, self.kernel_size,
+                        self.stride, self.padding)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+
+    def forward(self, x):
+        return dispatch("fractional_max_pool2d", x, self.output_size,
+                        random_u=self.random_u)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    def forward(self, x):
+        return dispatch("fractional_max_pool3d", x, self.output_size,
+                        random_u=self.random_u)
+
+
+# ------------------------------ padding -------------------------------------
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value,
+                     data_format=self.data_format)
+
+
+class Pad3D(Pad1D):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad1D(Pad1D):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, data_format=self.data_format)
+
+
+class ZeroPad3D(Pad1D):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+# ------------------------------ norm / shape --------------------------------
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self._parameters["weight"] = None
+        else:
+            self.weight = Parameter(jnp.ones((num_features,), jnp.float32))
+        if bias_attr is False:
+            self._parameters["bias"] = None
+        else:
+            self.bias = Parameter(jnp.zeros((num_features,), jnp.float32))
+
+    def forward(self, x):
+        return F.instance_norm(x, self._parameters.get("weight"),
+                               self._parameters.get("bias"),
+                               epsilon=self._epsilon)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr)
+
+
+class Softmax2D(Layer):
+    """Softmax over the CHANNEL dim of NCHW inputs (reference
+    nn.Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        import paddle_tpu as _p
+
+        return _p.unflatten(x, self.axis, self.shape)
+
+
+# ------------------------------ losses --------------------------------------
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer over the registered hsigmoid_loss
+    op (reference nn.HSigmoidLoss; SimpleCode tree)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        rng = np.random.RandomState(0)
+        self.weight = Parameter(jnp.asarray(
+            rng.randn(num_classes - 1, feature_size).astype(np.float32)
+            * 0.01))
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((num_classes - 1,),
+                                            jnp.float32))
+        else:
+            self._parameters["bias"] = None
+
+    def forward(self, input, label):  # noqa: A002
+        return dispatch("hsigmoid_loss", input, label, self.num_classes,
+                        self._parameters["weight"],
+                        self._parameters.get("bias"))
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax layer (reference nn.AdaptiveLogSoftmaxWithLoss):
+    head over [shortlist + clusters], projected tails per cluster."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(cutoffs)
+        head_size = cutoffs[0] + self.n_clusters
+        rng = np.random.RandomState(0)
+        self.head_weight = Parameter(jnp.asarray(
+            rng.randn(in_features, head_size).astype(np.float32) * 0.02))
+        if head_bias:
+            self.head_bias = Parameter(jnp.zeros((head_size,), jnp.float32))
+        else:
+            self._parameters["head_bias"] = None
+        self._tails = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = Parameter(jnp.asarray(
+                rng.randn(in_features, hsz).astype(np.float32) * 0.02))
+            w2 = Parameter(jnp.asarray(
+                rng.randn(hsz, osz).astype(np.float32) * 0.02))
+            self._parameters[f"tail_{i}_proj"] = w1
+            self._parameters[f"tail_{i}_out"] = w2
+            self._tails.append((f"tail_{i}_proj", f"tail_{i}_out"))
+
+    def forward(self, input, label):  # noqa: A002
+        tails = [(self._parameters[a], self._parameters[b])
+                 for a, b in self._tails]
+        out, loss = F.adaptive_log_softmax_with_loss(
+            input, label, self._parameters["head_weight"], tails,
+            self.cutoffs, self._parameters.get("head_bias"))
+        return out, loss
+
+    def log_prob(self, input):  # noqa: A002
+        """Full [N, n_classes] log-probabilities."""
+        xf = _val(input).astype(jnp.float32)
+        head = xf @ _val(self._parameters["head_weight"])
+        if self._parameters.get("head_bias") is not None:
+            head = head + _val(self._parameters["head_bias"])
+        head_lp = jax.nn.log_softmax(head, axis=-1)
+        shortlist = self.cutoffs[0]
+        parts = [head_lp[:, :shortlist]]
+        for i, (a, b) in enumerate(self._tails):
+            tl = (xf @ _val(self._parameters[a])) @ _val(
+                self._parameters[b])
+            tail_lp = jax.nn.log_softmax(tl, axis=-1)
+            parts.append(head_lp[:, shortlist + i:shortlist + i + 1]
+                         + tail_lp)
+        return Tensor(jnp.concatenate(parts, axis=1))
+
+    def predict(self, input):  # noqa: A002
+        return Tensor(jnp.argmax(self.log_prob(input)._value, axis=-1))
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+# ------------------------------ convs ---------------------------------------
+
+
+class _ConvTransposeNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd,
+                 stride=1, padding=0, output_padding=0, dilation=1,
+                 groups=1, weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        from .initializer import XavierUniform
+
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        w = XavierUniform()((in_channels, out_channels // groups) + ks,
+                            jnp.float32)
+        self.weight = Parameter(w)
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((out_channels,), jnp.float32))
+        else:
+            self._parameters["bias"] = None
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, output_padding, dilation, groups,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv1d_transpose(
+            x, self._parameters["weight"], self._parameters.get("bias"),
+            stride=self.stride, padding=self.padding,
+            output_padding=self.output_padding, groups=self.groups,
+            dilation=self.dilation)
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, output_padding, dilation, groups,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return dispatch(
+            "conv3d_transpose", x, self._parameters["weight"],
+            self._parameters.get("bias"), stride=self.stride,
+            padding=self.padding, output_padding=self.output_padding,
+            groups=self.groups, dilation=self.dilation)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight tensor by power iteration
+    (reference nn.SpectralNorm): returns W / sigma_max."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        rng = np.random.RandomState(0)
+        self.weight_u = Parameter(jnp.asarray(
+            rng.randn(h).astype(np.float32)))
+        self.weight_v = Parameter(jnp.asarray(
+            rng.randn(w).astype(np.float32)))
+
+    def forward(self, weight):
+        wv = _val(weight)
+        mat = jnp.moveaxis(wv, self.dim, 0).reshape(wv.shape[self.dim], -1)
+        u = _val(self.weight_u)
+        v = _val(self.weight_v)
+        for _ in range(max(1, self.power_iters)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        sigma = u @ mat @ v
+        self.weight_u._value = u
+        self.weight_v._value = v
+        return Tensor(wv / (sigma + self.eps))
+
+
+# ------------------------------ seq2seq decode ------------------------------
+
+
+class BiRNN(Layer):
+    """Bidirectional cell wrapper (reference nn.BiRNN): runs the forward
+    and backward cells over the sequence and concatenates outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .rnn import RNN
+
+        fw = RNN(self.cell_fw, time_major=self.time_major)
+        bw = RNN(self.cell_bw, time_major=self.time_major,
+                 is_reverse=True)
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, st_fw = fw(inputs, s_fw)
+        o_bw, st_bw = bw(inputs, s_bw)
+        out = Tensor(jnp.concatenate([_val(o_fw), _val(o_bw)], axis=-1))
+        return out, (st_fw, st_bw)
+
+
+class BeamSearchDecoder:
+    """Reference nn.BeamSearchDecoder over an RNN cell: step-wise beam
+    expansion driven by dynamic_decode."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        if embedding_fn is None:
+            raise ValueError(
+                "BeamSearchDecoder needs embedding_fn (token ids -> cell "
+                "inputs); the decoder cannot guess the cell's input "
+                "width")
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, inits, batch_size):
+        k = self.beam_size
+        tok = jnp.full((batch_size, k), self.start_token, jnp.int32)
+        lp = jnp.tile(jnp.asarray([[0.0] + [-1e9] * (k - 1)], jnp.float32),
+                      (batch_size, 1))
+        fin = jnp.zeros((batch_size, k), bool)
+        return tok, lp, fin, inits
+
+    def step(self, tokens, states):
+        """One cell step over flattened [B*K] beams -> log-probs."""
+        emb = self.embedding_fn(Tensor(tokens))
+        out, new_states = self.cell(emb, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        return jax.nn.log_softmax(_val(logits).astype(jnp.float32),
+                                  axis=-1), new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=1,
+                   **kwargs):
+    """Run a BeamSearchDecoder to completion (reference
+    paddle.nn.dynamic_decode): returns (token ids [B, K, T], beam
+    log-probs [B, K])."""
+    tok, lp, fin, states = decoder.initialize(inits, batch_size)
+    b, k = tok.shape
+    seqs = []
+    for _ in range(max_step_num):
+        flat_tok = tok.reshape(b * k)
+        logp, states = decoder.step(flat_tok, states)
+        v = logp.shape[-1]
+        logp = logp.reshape(b, k, v)
+        # finished beams only extend with end_token at zero cost
+        pad = jnp.full((b, k, v), -1e9).at[:, :, decoder.end_token].set(0.0)
+        logp = jnp.where(fin[:, :, None], pad, logp)
+        total = lp[:, :, None] + logp
+        flat = total.reshape(b, k * v)
+        lp, idx = jax.lax.top_k(flat, k)
+        beam = idx // v
+        tok = (idx % v).astype(jnp.int32)
+        fin = jnp.take_along_axis(fin, beam, axis=1) | \
+            (tok == decoder.end_token)
+        seqs = [jnp.take_along_axis(s, beam, axis=1) for s in seqs]
+        seqs.append(tok)
+        if bool(fin.all()):
+            break
+    ids = jnp.stack(seqs, axis=-1)
+    return Tensor(ids), Tensor(lp)

@@ -348,3 +348,80 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     return loss.mean()
 
 
+
+
+# ---- round-5 functional long tail (reference nn/functional __all__) ----
+from ...ops.nn_ops import (  # noqa: F401
+    adaptive_avg_pool1d, adaptive_avg_pool3d, adaptive_log_softmax_with_loss,
+    adaptive_max_pool1d, adaptive_max_pool3d, avg_pool3d, conv1d_transpose,
+    log_sigmoid, lp_pool1d, max_pool3d, max_unpool1d, max_unpool3d,
+    multi_margin_loss, pairwise_distance,
+    triplet_margin_with_distance_loss, zeropad2d,
+)
+from ...ops.registry import dispatch as _rdispatch
+
+
+def _op_alias(_name):
+    def _fn(*args, **kwargs):
+        return _rdispatch(_name, *args, **kwargs)
+
+    _fn.__name__ = _name
+    _fn.__doc__ = f"Functional alias of the registered op ``{_name}``."
+    return _fn
+
+
+# registered elsewhere in the op library; exposed here for reference
+# name parity (python/paddle/nn/functional/__init__.py)
+for _n in ("bilinear", "conv3d_transpose", "flash_attn_qkvpacked",
+           "fractional_max_pool2d", "fractional_max_pool3d", "gather_tree",
+           "hsigmoid_loss", "label_smooth", "log_loss", "lp_pool2d",
+           "margin_cross_entropy", "sequence_mask", "sparse_attention"):
+    if _n not in globals():
+        globals()[_n] = _op_alias(_n)
+del _n
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Channel-masked alpha dropout (reference nn.functional
+    .feature_alpha_dropout): whole channels are dropped to the SELU
+    negative saturation with affine correction."""
+    if not training or p == 0.0:
+        return x
+    from ...core.tensor import Tensor as _T
+
+    xv = x._value if isinstance(x, _T) else jnp.asarray(x)
+    keep_shape = xv.shape[:2]
+    mask = jax.random.bernoulli(_random._key(), 1.0 - p, keep_shape)
+    return dispatch("feature_alpha_dropout", x, mask, p=p)
+
+
+def _inplace_act(name, base):
+    def fn(x, *args, **kwargs):
+        from ...autograd import is_grad_enabled
+        from ...core.tensor import Tensor as _T
+
+        out = base(x, *args, **kwargs)
+        if isinstance(x, _T):
+            if is_grad_enabled() and not getattr(x, "stop_gradient", True):
+                raise RuntimeError(
+                    f"{name}: in-place activation on a grad-requiring "
+                    f"tensor under an active tape (reference "
+                    f"tensor-version error); use {name[:-1]}")
+            x._value = (out._value if isinstance(out, _T)
+                        else jnp.asarray(out)).astype(x._value.dtype)
+            return x
+        return out
+
+    fn.__name__ = name
+    fn.__doc__ = f"In-place variant of ``{name[:-1]}`` (reference " \
+                 f"nn.functional.{name})."
+    return fn
+
+
+relu_ = _inplace_act("relu_", relu)
+elu_ = _inplace_act("elu_", elu)
+hardtanh_ = _inplace_act("hardtanh_", hardtanh)
+leaky_relu_ = _inplace_act("leaky_relu_", leaky_relu)
+softmax_ = _inplace_act("softmax_", softmax)
+tanh_ = _inplace_act("tanh_", lambda x: dispatch("tanh", x))
+thresholded_relu_ = _inplace_act("thresholded_relu_", thresholded_relu)

@@ -961,3 +961,313 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
     if normalizer is not None:
         out = out / normalizer
     return _reduce(out, reduction)
+
+
+# ---- round-5 nn.functional long tail (reference python/paddle/nn/
+# functional __all__) ----
+
+
+@register("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NCDHW"):
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return _pool(x, init, lax.max, kernel_size, stride, padding,
+                 data_format)
+
+
+@register("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0,
+               count_include_pad=True, data_format="NCDHW"):
+    return _pool(x, 0.0, lax.add, kernel_size, stride, padding,
+                 data_format, count_include_pad=count_include_pad,
+                 is_avg=True)
+
+
+def _adaptive_pool_nd(x, output_size, spatial_axes, is_avg):
+    """Rank-generic adaptive pooling: per-axis bins floor(i*L/O) ..
+    ceil((i+1)*L/O) (the reference/torch bin rule), reduced jointly.
+    Static shapes: python loops over output positions."""
+    import itertools
+
+    sizes = [x.shape[a] for a in spatial_axes]
+    outs = [o if isinstance(output_size, int) else output_size[i]
+            for i, o in enumerate([output_size] * len(spatial_axes)
+                                  if isinstance(output_size, int)
+                                  else output_size)]
+    # fast path: divisible -> fixed-window pool
+    if all(s % o == 0 for s, o in zip(sizes, outs)):
+        kern = [s // o for s, o in zip(sizes, outs)]
+        window = [1] * x.ndim
+        for a, k in zip(spatial_axes, kern):
+            window[a] = k
+        red = lax.reduce_window(
+            x, 0.0 if is_avg else -jnp.inf, lax.add if is_avg else lax.max,
+            tuple(window), tuple(window), "VALID")
+        if is_avg:
+            denom = 1
+            for k in kern:
+                denom *= k
+            red = red / denom
+        return red
+    slabs = []
+    for pos in itertools.product(*[range(o) for o in outs]):
+        piece = x
+        for a, i, s, o in zip(spatial_axes, pos, sizes, outs):
+            lo = (i * s) // o
+            hi = -(-((i + 1) * s) // o)
+            piece = lax.slice_in_dim(piece, lo, hi, axis=a)
+        red = piece
+        for a in sorted(spatial_axes, reverse=True):
+            red = (jnp.mean if is_avg else jnp.max)(red, axis=a)
+        slabs.append(red)
+    stacked = jnp.stack(slabs, axis=-1)
+    shp = list(stacked.shape[:-1]) + outs
+    out = stacked.reshape(shp)
+    # move the flattened output block back into the spatial axes' order
+    perm = list(range(len(stacked.shape) - 1))
+    nsp = len(spatial_axes)
+    base = len(perm)
+    order = []
+    si = 0
+    for a in range(x.ndim):
+        if a in spatial_axes:
+            order.append(base + si)
+            si += 1
+        else:
+            order.append(perm.pop(0))
+    return jnp.transpose(out, order)
+
+
+@register("adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_pool_nd(x, output_size if isinstance(output_size, int)
+                             else output_size[0], (2,), True)
+
+
+@register("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    out = _adaptive_pool_nd(x, output_size if isinstance(output_size, int)
+                            else output_size[0], (2,), False)
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d(return_mask=True): use max_pool1d + "
+            "max_pool2d_with_index for recoverable indices")
+    return out
+
+
+@register("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    axes = (1, 2, 3) if data_format == "NDHWC" else (2, 3, 4)
+    return _adaptive_pool_nd(x, output_size, axes, True)
+
+
+@register("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) is a GPU-index "
+            "round-trip feature; indices are not tracked on this path")
+    return _adaptive_pool_nd(x, output_size, (2, 3, 4), False)
+
+
+@register("lp_pool1d")
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL"):
+    """(sum x^p)^(1/p) over windows — SIGNED x^p, matching the
+    reference/torch (odd norm_type differs from |x|^p); ceil_mode pads
+    zeros on the right (zeros are inert in a p-sum)."""
+    p = float(norm_type)
+    k = _norm_tuple(kernel_size, 1)[0]
+    s_ = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
+    pd = _norm_tuple(padding, 1)[0]
+    if ceil_mode:
+        l_axis = 2 if data_format == "NCL" else 1
+        L = x.shape[l_axis]
+        rem = (L + 2 * pd - k) % s_
+        if rem:
+            cfg = [(0, 0)] * x.ndim
+            cfg[l_axis] = (0, s_ - rem)
+            x = jnp.pad(x, cfg)
+    s = _pool(x ** p, 0.0, lax.add, kernel_size, stride, padding,
+              data_format)
+    return jnp.sign(s) * jnp.abs(s) ** (1.0 / p)
+
+
+@register("max_unpool1d")
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    """1-D unpool via the 2-D kernel on a height-1 plane."""
+    n, c, l = x.shape
+    k = _norm_tuple(kernel_size, 1)[0]
+    s = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
+    p = _norm_tuple(padding, 1)[0]
+    ol = (l - 1) * s - 2 * p + k if output_size is None else (
+        output_size[-1] if not isinstance(output_size, int)
+        else output_size)
+    flat = jnp.reshape(x, (n, c, l))
+    fidx = jnp.reshape(indices, (n, c, l)).astype(jnp.int32)
+    out = jnp.zeros((n, c, ol), x.dtype)
+    bi = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    return out.at[bi, ci, fidx].set(flat)
+
+
+@register("max_unpool3d")
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    n, c, d, h, w = x.shape
+    kd, kh, kw = _norm_tuple(kernel_size, 3)
+    sd, sh, sw = _norm_tuple(stride if stride is not None else kernel_size,
+                             3)
+    pd, ph, pw = _norm_tuple(padding, 3)
+    if output_size is None:
+        od = (d - 1) * sd - 2 * pd + kd
+        oh = (h - 1) * sh - 2 * ph + kh
+        ow = (w - 1) * sw - 2 * pw + kw
+    else:
+        od, oh, ow = _norm_tuple(output_size, 3)
+    flat = jnp.reshape(x, (n, c, d * h * w))
+    fidx = jnp.reshape(indices, (n, c, d * h * w)).astype(jnp.int32)
+    out = jnp.zeros((n, c, od * oh * ow), x.dtype)
+    bi = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    out = out.at[bi, ci, fidx].set(flat)
+    return out.reshape(n, c, od, oh, ow)
+
+
+@register("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register("pairwise_distance", amp="black")
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = jnp.asarray(x, jnp.float32) - jnp.asarray(y, jnp.float32) + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+@register("zeropad2d")
+def zeropad2d(x, padding, data_format="NCHW"):
+    l, r, t, b = _norm_tuple(padding, 4)
+    if data_format == "NHWC":
+        cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+    else:
+        cfg = [(0, 0), (0, 0), (t, b), (l, r)]
+    return jnp.pad(x, cfg)
+
+
+@register("feature_alpha_dropout")
+def feature_alpha_dropout(x, mask, p=0.5):
+    """Channel-wise alpha dropout (reference nn.functional
+    .feature_alpha_dropout): masked CHANNELS are set to the SELU
+    negative saturation and the output is affinely corrected to keep
+    mean/variance (mask sampled per (N, C) by the wrapper)."""
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    neg_sat = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + neg_sat ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * neg_sat * (1 - keep)
+    m = jnp.reshape(mask, mask.shape + (1,) * (x.ndim - mask.ndim))
+    out = jnp.where(m, x, neg_sat)
+    return a * out + b
+
+
+@register("multi_margin_loss", amp="black")
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean"):
+    n, c = input.shape
+    xf = jnp.asarray(input, jnp.float32)
+    gold = jnp.take_along_axis(xf, label[:, None].astype(jnp.int32),
+                               axis=1)
+    m = jnp.maximum(margin - gold + xf, 0.0) ** p
+    if weight is not None:
+        m = m * jnp.asarray(weight, jnp.float32)[label.astype(jnp.int32),
+                                                 None]
+    hit = jax.nn.one_hot(label.astype(jnp.int32), c, dtype=jnp.float32)
+    loss = jnp.sum(m * (1.0 - hit), axis=1) / c
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@register("triplet_margin_with_distance_loss", amp="black")
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    if distance_function is None:
+        def distance_function(a, b):
+            return jnp.linalg.norm(
+                jnp.asarray(a, jnp.float32) - jnp.asarray(b, jnp.float32)
+                + 1e-6, axis=-1)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        dn = jnp.minimum(dn, dn2)
+    loss = jnp.maximum(dp - dn + margin, 0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@register("conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL"):
+    """1-D transposed conv via the 2-D kernel on a height-1 plane."""
+    squeeze_axis = 2 if data_format == "NCL" else 1
+    x4 = jnp.expand_dims(x, squeeze_axis)
+    w4 = jnp.expand_dims(weight, 2)
+
+    def _t(v):
+        return _norm_tuple(v, 1)[0]
+
+    from .registry import get_op
+
+    out = get_op("conv2d_transpose").fn(
+        x4, w4, bias=bias, stride=(1, _t(stride)),
+        padding=(0, _t(padding)), output_padding=(0, _t(output_padding)),
+        groups=groups, dilation=(1, _t(dilation)),
+        data_format="NCHW" if data_format == "NCL" else "NHWC")
+    return jnp.squeeze(out, squeeze_axis)
+
+
+@register("adaptive_log_softmax_with_loss", amp="black")
+def adaptive_log_softmax_with_loss(input, label, head_weight,  # noqa: A002
+                                   tail_weights, cutoffs, head_bias=None):
+    """Adaptive softmax (reference nn.functional
+    .adaptive_log_softmax_with_loss; Grave et al. 2017): frequent words
+    in the head, rare clusters through projected tails.  Returns
+    (per-sample log-prob of the target, mean loss)."""
+    xf = jnp.asarray(input, jnp.float32)
+    lab = jnp.asarray(label, jnp.int32)
+    cut = [0] + list(cutoffs)
+    head_logits = xf @ jnp.asarray(head_weight, jnp.float32)
+    if head_bias is not None:
+        head_logits = head_logits + jnp.asarray(head_bias, jnp.float32)
+    head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+    shortlist = cut[1]
+    out = jnp.zeros(xf.shape[0], jnp.float32)
+    in_head = lab < shortlist
+    gold_head = jnp.take_along_axis(
+        head_lp, jnp.clip(lab, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+    out = jnp.where(in_head, gold_head, out)
+    for ci in range(len(cut) - 2):
+        lo, hi = cut[ci + 1], cut[ci + 2]
+        in_c = (lab >= lo) & (lab < hi)
+        w1, w2 = tail_weights[ci]
+        tl = (xf @ jnp.asarray(w1, jnp.float32)) @ jnp.asarray(
+            w2, jnp.float32)
+        tail_lp = jax.nn.log_softmax(tl, axis=-1)
+        gold_tail = jnp.take_along_axis(
+            tail_lp, jnp.clip(lab - lo, 0, hi - lo - 1)[:, None],
+            axis=1)[:, 0]
+        cluster_lp = head_lp[:, shortlist + ci]
+        out = jnp.where(in_c, cluster_lp + gold_tail, out)
+    return out, -out.mean()

@@ -3,8 +3,8 @@
 from . import lr
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from .optimizer import (
-    SGD, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
-    Optimizer, RMSProp,
+    ASGD, LBFGS, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+    LarsMomentum, Momentum, NAdam, Optimizer, RAdam, RMSProp, Rprop,
 )
 
 # make nn.ClipGradBy* available (reference exposes them under paddle.nn)

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -440,3 +441,289 @@ class LarsMomentum(Optimizer):
         v = self._momentum * state["velocity"] \
             + local_lr * (g + self._lars_wd * w)
         return (w - v).astype(value.dtype), {"velocity": v}
+
+
+# ---- round-5 optimizer long tail (reference python/paddle/optimizer) ----
+
+
+class Adadelta(Optimizer):
+    """Reference paddle.optimizer.Adadelta (Zeiler 2012): accumulated
+    squared gradients + accumulated squared updates, no learning-rate
+    sensitivity beyond the scale factor."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def init_param_state(self, value):
+        return {"avg_squared_grad": jnp.zeros_like(value),
+                "avg_squared_update": jnp.zeros_like(value)}
+
+    def update(self, value, grad, state, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * value
+        g2 = self._rho * state["avg_squared_grad"] \
+            + (1 - self._rho) * grad * grad
+        upd = grad * jnp.sqrt(state["avg_squared_update"] + self._epsilon) \
+            / jnp.sqrt(g2 + self._epsilon)
+        u2 = self._rho * state["avg_squared_update"] \
+            + (1 - self._rho) * upd * upd
+        return value - lr * upd, {"avg_squared_grad": g2,
+                                  "avg_squared_update": u2}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference paddle.optimizer.ASGD; phi asgd_kernel):
+    ``d`` is the running SUM of the last ``batch_num`` gradients held in
+    a circular buffer; the step is param -= (lr / n) * d with
+    n = min(seen, batch_num) — SGD over the gradient average."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._batch_num = max(int(batch_num), 1)
+
+    def init_param_state(self, value):
+        flat = int(np.prod(value.shape)) if value.shape else 1
+        return {"d": jnp.zeros((flat,), jnp.float32),
+                "hist": jnp.zeros((self._batch_num, flat), jnp.float32),
+                "seen": jnp.zeros((), jnp.int32)}
+
+    def update(self, value, grad, state, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * value
+        g = jnp.asarray(grad, jnp.float32).reshape(-1)
+        slot = state["seen"] % self._batch_num
+        y = state["hist"][slot]                    # grad evicted this turn
+        d = state["d"] - y + g                     # kernel: d - y + grad
+        hist = state["hist"].at[slot].set(g)
+        n = jnp.minimum(state["seen"] + 1, self._batch_num).astype(
+            jnp.float32)
+        new_value = value - ((lr / n) * d).reshape(value.shape).astype(
+            value.dtype)
+        return new_value, {"d": d, "hist": hist, "seen": state["seen"] + 1}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference paddle.optimizer.Rprop): per-weight
+    step sizes grown/shrunk by the gradient-sign agreement; gradients'
+    magnitudes are ignored."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def init_param_state(self, value):
+        return {"prev_grad": jnp.zeros_like(value),
+                "step_size": jnp.full_like(jnp.asarray(value, jnp.float32),
+                                           float(self.get_lr()))}
+
+    def update(self, value, grad, state, lr, step):
+        sign = jnp.sign(grad * state["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        step_size = jnp.clip(state["step_size"] * factor, self._lr_min,
+                             self._lr_max)
+        # on sign flip the reference zeroes the gradient (no step, keep
+        # direction memory cleared)
+        eff_grad = jnp.where(sign < 0, 0.0, grad)
+        new_value = value - jnp.sign(eff_grad) * step_size
+        return new_value, {"prev_grad": eff_grad, "step_size": step_size}
+
+
+class NAdam(Optimizer):
+    """Reference paddle.optimizer.NAdam (Dozat 2016): Adam with Nesterov
+    momentum via the mu-product schedule."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+        self._psi = momentum_decay
+
+    def init_param_state(self, value):
+        return {"m": jnp.zeros_like(value, jnp.float32),
+                "v": jnp.zeros_like(value, jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def update(self, value, grad, state, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * value
+        t = jnp.asarray(step, jnp.float32)
+        gf = grad.astype(jnp.float32)
+        mu_t = self._beta1 * (1.0 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state["mu_product"] * mu_t
+        m = self._beta1 * state["m"] + (1 - self._beta1) * gf
+        v = self._beta2 * state["v"] + (1 - self._beta2) * gf * gf
+        m_hat = mu_t1 * m / (1 - mu_prod * mu_t1) \
+            + (1 - mu_t) * gf / (1 - mu_prod)
+        v_hat = v / (1 - self._beta2 ** t)
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return (value - upd.astype(value.dtype),
+                {"m": m, "v": v, "mu_product": mu_prod})
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference paddle.optimizer.RAdam, Liu et al.
+    2020): variance rectification switches between SGD-with-momentum and
+    Adam as the variance estimate warms up."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+
+    def init_param_state(self, value):
+        return {"m": jnp.zeros_like(value, jnp.float32),
+                "v": jnp.zeros_like(value, jnp.float32)}
+
+    def update(self, value, grad, state, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * value
+        t = jnp.asarray(step, jnp.float32)
+        gf = grad.astype(jnp.float32)
+        m = self._beta1 * state["m"] + (1 - self._beta1) * gf
+        v = self._beta2 * state["v"] + (1 - self._beta2) * gf * gf
+        rho_inf = 2.0 / (1 - self._beta2) - 1.0
+        # 1 - beta2^t via expm1 — the naive f32 subtraction loses enough
+        # precision to flip the rho_t > 5 branch near the threshold
+        # (torch/paddle compute this in float64)
+        log_b2 = jnp.log(jnp.asarray(self._beta2, jnp.float32))
+        one_minus_beta2_t = -jnp.expm1(t * log_b2)
+        beta2_t = 1.0 - one_minus_beta2_t
+        rho_t = rho_inf - 2.0 * t * beta2_t / one_minus_beta2_t
+        m_hat = m / (1 - self._beta1 ** t)
+        rect = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                        / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                      1e-12))
+        v_hat = jnp.sqrt(v / one_minus_beta2_t)
+        adam_step = rect * m_hat / (v_hat + self._epsilon)
+        sgd_step = m_hat
+        upd = lr * jnp.where(rho_t > 5.0, adam_step, sgd_step)
+        return value - upd.astype(value.dtype), {"m": m, "v": v}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference paddle.optimizer.LBFGS): two-loop
+    recursion over the last ``history_size`` (s, y) pairs.  The eager
+    API follows the reference: ``step(closure)`` re-evaluates the loss;
+    the functional update() performs ONE direction step using the stored
+    curvature pairs (line search ``strong_wolfe`` is approximated by the
+    fixed learning rate — the reference's default line_search_fn=None
+    path)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=10,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._hist = int(history_size)
+        self._max_iter = int(max_iter)
+        self._tol_grad = float(tolerance_grad)
+        self._tol_change = float(tolerance_change)
+
+    def init_param_state(self, value):
+        h = self._hist
+        flat = int(np.prod(value.shape)) if value.shape else 1
+        return {"s": jnp.zeros((h, flat), jnp.float32),
+                "y": jnp.zeros((h, flat), jnp.float32),
+                "rho": jnp.zeros((h,), jnp.float32),
+                "prev_x": jnp.zeros((flat,), jnp.float32),
+                "prev_g": jnp.zeros((flat,), jnp.float32),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def update(self, value, grad, state, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * value
+        shape = value.shape
+        x = jnp.asarray(value, jnp.float32).reshape(-1)
+        g = jnp.asarray(grad, jnp.float32).reshape(-1)
+        h = self._hist
+        cnt = state["count"]
+
+        # push the newest (s, y) pair once we have a previous point
+        s_new = x - state["prev_x"]
+        y_new = g - state["prev_g"]
+        sy = jnp.dot(s_new, y_new)
+        valid = (cnt > 0) & (sy > 1e-10)
+        s_buf = jnp.where(valid, jnp.roll(state["s"], -1, 0)
+                          .at[-1].set(s_new), state["s"])
+        y_buf = jnp.where(valid, jnp.roll(state["y"], -1, 0)
+                          .at[-1].set(y_new), state["y"])
+        rho_buf = jnp.where(valid, jnp.roll(state["rho"], -1)
+                            .at[-1].set(1.0 / jnp.maximum(sy, 1e-10)),
+                            state["rho"])
+
+        # two-loop recursion (zero rho entries are inert)
+        def first(i, carry):
+            q, alphas = carry
+            j = h - 1 - i
+            a = rho_buf[j] * jnp.dot(s_buf[j], q)
+            return q - a * y_buf[j], alphas.at[j].set(a)
+
+        q, alphas = jax.lax.fori_loop(
+            0, h, first, (g, jnp.zeros((h,), jnp.float32)))
+        ys = jnp.dot(y_buf[-1], y_buf[-1])
+        gamma = jnp.where(ys > 0, jnp.dot(s_buf[-1], y_buf[-1])
+                          / jnp.maximum(ys, 1e-10), 1.0)
+        r = q * jnp.where(valid | (cnt > 1), gamma, 1.0)
+
+        def second(j, r):
+            b = rho_buf[j] * jnp.dot(y_buf[j], r)
+            return r + s_buf[j] * (alphas[j] - b)
+
+        r = jax.lax.fori_loop(0, h, second, r)
+        new_x = x - lr * r
+        new_state = {"s": s_buf, "y": y_buf, "rho": rho_buf,
+                     "prev_x": x, "prev_g": g, "count": cnt + 1}
+        return new_x.reshape(shape).astype(value.dtype), new_state
+
+    def step(self, closure=None):
+        """Reference LBFGS.step(closure): up to ``max_iter`` inner
+        iterations, stopping on the gradient / parameter-change
+        tolerances; returns the final loss.  Without a closure, one
+        direction step over the accumulated .grad."""
+        if closure is None:
+            return super().step()
+        import numpy as _np
+
+        loss = None
+        for _ in range(self._max_iter):
+            for p in self._parameters:
+                if getattr(p, "_grad", None) is not None:
+                    p._grad = None
+            loss = closure()
+            gmax = 0.0
+            before = [_np.asarray(p._value).copy()
+                      for p in self._parameters]
+            for p in self._parameters:
+                if getattr(p, "_grad", None) is not None:
+                    gmax = max(gmax, float(_np.abs(
+                        _np.asarray(p._grad._value
+                                    if hasattr(p._grad, "_value")
+                                    else p._grad)).max()))
+            if gmax <= self._tol_grad:
+                break
+            super().step()
+            change = max(float(_np.abs(_np.asarray(p._value) - b).max())
+                         for p, b in zip(self._parameters, before))
+            if change <= self._tol_change:
+                break
+        return loss
