@@ -48,3 +48,14 @@ def init_parallel_env():
     jax.distributed (see env.init_distributed)."""
     from .collective import _ensure_default
     return _ensure_default()
+
+# round-5 surface completion (reference distributed __all__ parity)
+from . import io  # noqa: F401,E402
+from .compat import (  # noqa: F401,E402
+    CountFilterEntry, DistAttr, ParallelMode, ProbabilityEntry, ReduceType,
+    ShowClickEntry, all_gather_object, alltoall_single,
+    broadcast_object_list, dtensor_from_fn, gather, get_backend,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, irecv, is_available,
+    isend, recv, reduce, scatter_object_list, send, shard_scaler, split,
+)
+from .fleet import InMemoryDataset, QueueDataset  # noqa: F401,E402

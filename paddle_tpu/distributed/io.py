@@ -1,0 +1,38 @@
+"""paddle.distributed.io (reference python/paddle/distributed/io.py):
+persistable-variable save/load for distributed programs — here the
+sharded checkpoint API IS the implementation (checkpoint/save_state_dict
+reshard-on-load covers the reference's use cases)."""
+
+from __future__ import annotations
+
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, **kw):
+    """Reference io.save_persistables: static-graph persistables dump.
+    The dynamic analog: save the program's state dict (callers pass a
+    Layer or a state dict via main_program)."""
+    state = main_program
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    if not isinstance(state, dict):
+        raise ValueError(
+            "save_persistables: pass a Layer or state dict as "
+            "main_program (static Programs are replaced by jit.to_static "
+            "— SURVEY.md §3.4)")
+    save_state_dict(state, dirname)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, **kw):
+    state = main_program
+    if hasattr(state, "state_dict"):
+        sd = state.state_dict()
+        load_state_dict(sd, dirname)
+        state.set_state_dict(sd)
+        return sd
+    if isinstance(state, dict):
+        load_state_dict(state, dirname)
+        return state
+    raise ValueError("load_persistables: pass a Layer or state dict")

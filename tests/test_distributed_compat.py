@@ -1,0 +1,146 @@
+"""Round-5 distributed surface: store-backed p2p (send/recv), object
+collectives, gloo barrier — exercised with TWO real processes through
+the launcher (the reference's multiprocess-test norm) — plus the
+single-process enum/config/name checks."""
+
+import ast
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.distributed import env
+env.init_distributed()
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+rank = jax.process_index()
+
+# ---- p2p over the coordination store ----
+if rank == 0:
+    dist.send(paddle.to_tensor(np.asarray([1.5, 2.5], np.float32)), dst=1)
+    got = paddle.to_tensor(np.zeros(2, np.float32))
+    dist.recv(got, src=1)
+    assert np.allclose(np.asarray(got._value), [7.0, 8.0]), got._value
+else:
+    buf = paddle.to_tensor(np.zeros(2, np.float32))
+    dist.recv(buf, src=0)
+    assert np.allclose(np.asarray(buf._value), [1.5, 2.5]), buf._value
+    dist.send(paddle.to_tensor(np.asarray([7.0, 8.0], np.float32)), dst=0)
+print("P2P_OK", flush=True)
+
+# ---- object collectives ----
+objs = []
+dist.all_gather_object(objs, {"rank": rank, "msg": f"hello-{rank}"})
+assert [o["rank"] for o in objs] == [0, 1], objs
+
+bl = [["payload", 42]] if rank == 0 else [None]
+dist.broadcast_object_list(bl, src=0)
+assert bl[0] == ["payload", 42], bl
+
+out = [None]
+dist.scatter_object_list(out, [["a"], ["b"]] if rank == 0 else None, src=0)
+assert out[0] == [["a"], ["b"]][rank], out
+print("OBJ_OK", flush=True)
+
+dist.gloo_barrier()
+print("BARRIER_OK", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_p2p_and_object_collectives(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2",
+         "--master", f"127.0.0.1:{_free_port()}",
+         "--log_dir", str(log_dir), str(script)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=280)
+    logs = "\n".join((log_dir / f"workerlog.{i}").read_text()
+                     for i in range(2)
+                     if (log_dir / f"workerlog.{i}").exists())
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:],
+                               logs[-3000:])
+    for marker in ("P2P_OK", "OBJ_OK", "BARRIER_OK"):
+        assert logs.count(marker) == 2, (marker, logs[-3000:])
+
+
+def test_enums_entries_and_split():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    assert dist.ReduceType.kRedSum == 0
+    assert dist.CountFilterEntry(5).to_attr() == "count_filter_entry:5"
+    assert dist.ProbabilityEntry(0.25).to_attr() == "probability_entry:0.25"
+    assert dist.ShowClickEntry("show", "click").to_attr() == \
+        "show_click_entry:show:click"
+    with pytest.raises(ValueError):
+        dist.CountFilterEntry(-1)
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(1.5)
+
+    # megatron split helper (reference mp_ops.py:706): creates the
+    # sharded weight and computes — single-process mp degree 1 behaves
+    # like the plain op
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    out = dist.split(x, (8, 6), operation="linear", axis=1)
+    assert list(np.asarray(out._value).shape) == [4, 6]
+    out_row = dist.split(x, (8, 6), operation="linear", axis=0)
+    assert list(np.asarray(out_row._value).shape) == [4, 6]
+    ids = paddle.to_tensor(np.asarray([[1, 2], [3, 0]], np.int64))
+    emb = dist.split(ids, (10, 5), operation="embedding")
+    assert list(np.asarray(emb._value).shape) == [2, 2, 5]
+    with pytest.raises(ValueError):
+        dist.split(x, (8, 6), operation="conv")
+    assert dist.get_backend() == "XLA"
+    assert dist.is_available()
+    assert isinstance(dist.DistAttr(), dist.DistAttr)
+
+
+def test_distributed_namespace_parity():
+    ref = "/root/reference/python/paddle/distributed/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not available")
+    import paddle_tpu as paddle
+
+    tree = ast.parse(open(ref).read())
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            try:
+                vals = ast.literal_eval(node.value)
+            except Exception:
+                continue
+            if isinstance(vals, list) and all(isinstance(v, str)
+                                              for v in vals):
+                names += vals
+    missing = [n for n in names if not hasattr(paddle.distributed, n)]
+    assert not missing, sorted(missing)
