@@ -214,6 +214,12 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"# serving bench failed: {e}", file=sys.stderr)
     try:
+        extras["serving_8b_int8"] = _serving_8b_int8_bench()
+        print(f"# serving 8b int8: {extras['serving_8b_int8']}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"# serving 8b int8 bench failed: {e}", file=sys.stderr)
+    try:
         with open("BENCH_EXTRA.json", "w") as f:
             json.dump(extras, f, indent=1)
     except OSError:
@@ -315,6 +321,20 @@ def _varlen_vs_dense_bench():
     tpg = _chained_device_time(grad_step(packed), qp, n_lo=3, n_hi=27)
     tdg = _chained_device_time(grad_step(dense), qd, n_lo=3, n_hi=27)
 
+    # auto-dispatch path (round 6): padding-aware kernel choice over the
+    # SAME padded workload — at 32% padding it must pick the dense-masked
+    # kernel (trace-time choice -> identical compiled program, never
+    # slower than its fallback); the packed win is captured at high
+    # padding below
+    from paddle_tpu.ops.pallas.flash_attention import (
+        PACKED_PADDING_CROSSOVER, flash_attention_auto)
+
+    def auto_mid(q):
+        return flash_attention_auto(q, q, q, seqlens, causal=True,
+                                    interpret=False)
+
+    tag = _chained_device_time(grad_step(auto_mid), qd, n_lo=3, n_hi=27)
+
     # second point: HIGH padding (~64%) — the regime the varlen path
     # exists for.  Round-5's fused backward + compressed-grid dense
     # kernel moved the crossover: at 32% padding the (equally-improved)
@@ -343,7 +363,25 @@ def _varlen_vs_dense_bench():
                                   n_lo=3, n_hi=27)
     tdg_hi = _chained_device_time(grad_step(dense_hi), qd_hi,
                                   n_lo=3, n_hi=27)
+
+    def auto_hi(q):
+        return flash_attention_auto(q, q, q, seqlens_hi, causal=True,
+                                    interpret=False)
+
+    tag_hi = _chained_device_time(grad_step(auto_hi), qd_hi,
+                                  n_lo=3, n_hi=27)
     return {
+        "auto_fwdbwd_ms": round(tag * 1e3, 3),
+        "auto_vs_dense_fwdbwd_x": round(tdg / tag, 3),
+        "auto_choice_midpad": (
+            "packed" if 1 - total / (b * maxlen)
+            >= PACKED_PADDING_CROSSOVER else "dense"),
+        "auto_hi_fwdbwd_ms": round(tag_hi * 1e3, 3),
+        "auto_vs_dense_hi_fwdbwd_x": round(tdg_hi / tag_hi, 3),
+        "auto_choice_hipad": (
+            "packed" if 1 - total_hi / (b * maxlen)
+            >= PACKED_PADDING_CROSSOVER else "dense"),
+        "crossover_padding_frac": PACKED_PADDING_CROSSOVER,
         "packed_ms": round(tp * 1e3, 3),
         "dense_masked_ms": round(td * 1e3, 3),
         "speedup_x": round(td / tp, 3),
@@ -606,37 +644,16 @@ def _serving_bench(params, cfg):
     # device time per batched decode step: fill a warm engine, then time
     # the COMPILED decode-chunk program at two chunk lengths — the slope
     # cancels the tunnel RTT (and the fixed dispatch cost), same
-    # methodology as decode_e2e
+    # methodology as decode_e2e.  time_decode_chunk syncs via a scalar
+    # readback (the tunnel's block_until_ready can return early) and
+    # leaves the host schedule untouched, so both lengths see the same
+    # fill.
     eng = make_engine(8)
     for p, bdg in zip(prompts[:8], [512] * 8):
         eng.add_request(p, max_new_tokens=bdg)
     eng._admit()
 
-    def chunk_time(chunk, reps=3):
-        fn = type(eng)._decode_chunk_jit
-        fixed = (jnp.asarray(eng.tables), jnp.asarray(eng.seq_lens),
-                 jnp.asarray(eng.cur_tok), jnp.asarray(eng.active),
-                 eng.cos_tab, eng.sin_tab)
-
-        def call():
-            # the pools are DONATED through the decode program: thread
-            # them (fresh buffers come back; stale ones are invalid).
-            # Sync via a SCALAR readback — the tunnel's block_until_ready
-            # has been observed returning early (BASELINE.md notes)
-            out = fn(eng.params, eng.k_pages, eng.v_pages, *fixed,
-                     self_cfg_id=eng.cfg_id, chunk=chunk)
-            eng.k_pages, eng.v_pages = out[0], out[1]
-            float(out[3][0])
-
-        call()
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            call()
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    t_lo, t_hi = chunk_time(4), chunk_time(20)
+    t_lo, t_hi = eng.time_decode_chunk(4), eng.time_decode_chunk(20)
     per_step = (t_hi - t_lo) / 16.0
     total_new = float(sum(budgets))
     out = {
@@ -644,6 +661,7 @@ def _serving_bench(params, cfg):
         "total_new_tokens": int(total_new),
         "wall_tokens_per_sec_chunk16": round(ntok_hi / dt_hi, 1),
         "admission": "3 requests / 2 iterations (mid-decode joins)",
+        "pages_per_step": eng.pages_per_step,
         "method": "warm-batch chunk-length slope (4 vs 20; RTT cancels)",
     }
     if per_step > 1e-5:
@@ -657,5 +675,240 @@ def _serving_bench(params, cfg):
     return out
 
 
+def _serving_8b_int8_bench():
+    """llama-8B-shaped single-chip serving leg: weight-only int8 params
+    (per-out-channel scales, dequant fused into the consumer dots — int8
+    is what streams from HBM) + int8 KV cache, through the same
+    continuous-batching engine.  Round-5 verdict Weak #3: every e2e
+    inference number was 574M-only even though int8 weights (~8GB) +
+    int8 KV fit one v5e chip.  Weights are randomly initialized on
+    device (throughput is layout/dtype-faithful; token VALUES are
+    meaningless and never read beyond the sync)."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() == "cpu":
+        return {"skipped": "cpu fallback: the 8B-shaped leg needs a real "
+                           "chip (8GB int8 weights; CPU run would measure "
+                           "the host, not the serving path)"}
+
+    from paddle_tpu.models import LlamaConfig
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    cfg = LlamaConfig(vocab_size=128256, hidden_size=4096,
+                      intermediate_size=14336, num_hidden_layers=32,
+                      num_attention_heads=32, num_key_value_heads=8,
+                      max_position_embeddings=2048,
+                      tie_word_embeddings=True)
+    h, kvh, d, inter = 4096, 1024, 128, 14336
+    key = jax.random.PRNGKey(0)
+
+    def w8(key, shape):
+        return jax.random.randint(key, shape, -127, 128, jnp.int8)
+
+    def sc(shape):
+        return jnp.full(shape, 0.004, jnp.float32)
+
+    params = {
+        "model.embed_tokens.weight": w8(jax.random.fold_in(key, 1),
+                                        (cfg.vocab_size, h)),
+        "model.embed_tokens.weight._scale": sc((cfg.vocab_size,)),
+        "model.norm.weight": jnp.ones((h,), jnp.bfloat16),
+    }
+    shapes = {
+        "self_attn.q_proj.weight": (h, h),
+        "self_attn.k_proj.weight": (h, kvh),
+        "self_attn.v_proj.weight": (h, kvh),
+        "self_attn.o_proj.weight": (h, h),
+        "mlp.gate_proj.weight": (h, inter),
+        "mlp.up_proj.weight": (h, inter),
+        "mlp.down_proj.weight": (inter, h),
+    }
+    for i in range(cfg.num_hidden_layers):
+        lk = jax.random.fold_in(key, 100 + i)
+        params[f"model.layers.{i}.input_layernorm.weight"] = \
+            jnp.ones((h,), jnp.bfloat16)
+        params[f"model.layers.{i}.post_attention_layernorm.weight"] = \
+            jnp.ones((h,), jnp.bfloat16)
+        for j, (name, shape) in enumerate(sorted(shapes.items())):
+            params[f"model.layers.{i}.{name}"] = \
+                w8(jax.random.fold_in(lk, j), shape)
+            params[f"model.layers.{i}.{name}._scale"] = sc((shape[1],))
+    weight_bytes = sum(int(np.prod(v.shape)) for k, v in params.items()
+                       if v.dtype == jnp.int8)
+
+    eng = ContinuousBatchingEngine(
+        cfg, params, max_slots=8, num_pages=8 * 16 + 1, page_size=128,
+        max_seq_len=2048, decode_chunk_steps=8, cache_dtype=jnp.int8)
+    rng = np.random.default_rng(0)
+    for _ in range(8):
+        eng.add_request(rng.integers(0, cfg.vocab_size, (128,)).astype(
+            np.int32), max_new_tokens=512)
+    eng._admit()
+
+    t_lo, t_hi = eng.time_decode_chunk(4), eng.time_decode_chunk(20)
+    per_step = (t_hi - t_lo) / 16.0
+    # weight-streaming floor: every decode step reads the full int8
+    # weight set once (v5e ~819GB/s HBM)
+    floor_ms = weight_bytes / 819e9 * 1e3
+    out = {
+        "model": "llama3-8b-shaped (random int8 weights, tied head)",
+        "weight_gb_int8": round(weight_bytes / 1e9, 2),
+        "cache_dtype": "int8",
+        "slots": 8,
+        "pages_per_step": eng.pages_per_step,
+        "weight_stream_floor_ms": round(floor_ms, 3),
+        "method": "warm-batch chunk-length slope (4 vs 20; RTT cancels)",
+    }
+    if per_step > 1e-5:
+        out["device_ms_per_batched_step"] = round(per_step * 1e3, 3)
+        out["device_tokens_per_sec"] = round(8 / per_step, 1)
+        out["vs_weight_stream_floor_x"] = round(per_step * 1e3 / floor_ms,
+                                                2)
+    else:
+        out["device_slope_failed"] = round(per_step * 1e3, 4)
+    return out
+
+
+def smoke():
+    """CPU-safe tier-1 gate over the serving/varlen dispatch hot paths
+    (round-6 satellite: dispatch-layer regressions must fail the suite,
+    not surface one round later in the BENCH json).  Tiny shapes,
+    interpret-mode kernels, <60s on a laptop CPU.  Returns a dict with
+    an overall ``ok`` plus one entry per leg; raises nothing (failures
+    are reported in the dict so the CLI can print a useful JSON)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle  # noqa: F401 (registers ops)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import (generate,
+                                              quantize_params_int8)
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _attn_reference, flash_attention_auto)
+    from paddle_tpu.ops.pallas.decode_attention import (flash_decode_raw,
+                                                        paged_decode_raw)
+
+    legs = {}
+    rng = np.random.default_rng(0)
+    paddle.seed(7)
+    cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
+                            kv_heads=2, inter=64, max_pos=128)
+    model = LlamaForCausalLM(cfg)
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 11)]
+
+    # 1. pipelined continuous-batching engine: greedy parity vs the
+    #    one-shot generate path (the whole scheduler + paged kernel)
+    try:
+        eng = ContinuousBatchingEngine(cfg, params, max_slots=2,
+                                       num_pages=17, page_size=16,
+                                       max_seq_len=64,
+                                       decode_chunk_steps=3)
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=5)
+        done = eng.run()
+        ok = len(done) == len(prompts)
+        for i, p in enumerate(prompts):
+            ref = generate(model, p[None], max_new_tokens=5,
+                           do_sample=False)
+            ref = np.asarray(ref._value if hasattr(ref, "_value")
+                             else ref)[0, len(p):]
+            ok = ok and (done[i].tokens == ref[:len(done[i].tokens)]).all()
+        legs["serving_pipeline_parity"] = {"ok": bool(ok)}
+    except Exception as e:  # noqa: BLE001
+        legs["serving_pipeline_parity"] = {"ok": False, "error": repr(e)}
+
+    # 2. padding-aware varlen dispatch: both branches numerically match
+    #    the reference at their respective padding regimes
+    try:
+        b, s, h, d = 2, 32, 4, 16
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        res = {}
+        # low_pad sits below PACKED_PADDING_CROSSOVER (dense branch),
+        # high_pad above it (pad 0.4375 > 0.40 -> packed branch), so the
+        # smoke gate compiles and checks BOTH kernels
+        for name, lens in (("low_pad", [30, 32]), ("high_pad", [4, 32])):
+            got = np.asarray(flash_attention_auto(q, q, q, lens,
+                                                  causal=True))
+            okl = True
+            for i, n in enumerate(lens):
+                want = np.asarray(_attn_reference(
+                    q[i:i + 1, :n], q[i:i + 1, :n], q[i:i + 1, :n],
+                    True, d ** -0.5))
+                okl = okl and np.abs(got[i, :n] - want[0]).max() < 2e-4
+            res[name] = bool(okl)
+        legs["varlen_auto_dispatch"] = {"ok": all(res.values()), **res}
+    except Exception as e:  # noqa: BLE001
+        legs["varlen_auto_dispatch"] = {"ok": False, "error": repr(e)}
+
+    # 3. multi-page paged decode kernel == dense decode kernel on the
+    #    same logical cache (shuffled physical pages)
+    try:
+        b, h, kvh, d, page, mp = 2, 4, 2, 32, 8, 4
+        lens = np.array([9, 26], np.int32)
+        kc = rng.standard_normal((b, kvh, mp * page, d)).astype(np.float32)
+        vc = rng.standard_normal((b, kvh, mp * page, d)).astype(np.float32)
+        qd = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+        perm = rng.permutation(b * mp)
+        tables = perm.reshape(b, mp).astype(np.int32)
+        kp = np.zeros((b * mp, kvh, page, d), np.float32)
+        vp = np.zeros((b * mp, kvh, page, d), np.float32)
+        for bi in range(b):
+            for j in range(mp):
+                kp[tables[bi, j]] = kc[bi, :, j * page:(j + 1) * page]
+                vp[tables[bi, j]] = vc[bi, :, j * page:(j + 1) * page]
+        dense_o = np.asarray(flash_decode_raw(
+            qd, jnp.asarray(kc), jnp.asarray(vc), jnp.asarray(lens)))
+        paged_o = np.asarray(paged_decode_raw(
+            qd, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(lens),
+            jnp.asarray(tables), pages_per_step=2))
+        legs["paged_multipage_kernel"] = {
+            "ok": bool(np.abs(dense_o - paged_o).max() < 2e-4)}
+    except Exception as e:  # noqa: BLE001
+        legs["paged_multipage_kernel"] = {"ok": False, "error": repr(e)}
+
+    # 4. weight-only int8 params through the serving engine, checked
+    #    against the int8-weight ONE-SHOT generate on the same params
+    #    (int8 KV there vs fp cache here can flip rare near-ties only)
+    try:
+        from paddle_tpu.models.generation import (_generate_jit,
+                                                  register_config)
+
+        qp = quantize_params_int8(params)
+        eng = ContinuousBatchingEngine(cfg, qp, max_slots=1,
+                                       num_pages=9, page_size=16,
+                                       max_seq_len=64,
+                                       decode_chunk_steps=3,
+                                       cache_dtype=jnp.int8)
+        eng.add_request(prompts[0], max_new_tokens=4)
+        done = eng.run()
+        toks = done[0].tokens
+        ref = np.asarray(_generate_jit(
+            qp, jnp.asarray(prompts[0][None]), jax.random.PRNGKey(0),
+            cfg_id=register_config(cfg), max_new_tokens=4,
+            do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+            eos_id=-1))[0]
+        match = float((toks == ref).mean()) if len(toks) == 4 else 0.0
+        legs["int8_weight_serving"] = {
+            "ok": bool(len(toks) == 4 and match >= 0.75),
+            "match_vs_oneshot": match}
+    except Exception as e:  # noqa: BLE001
+        legs["int8_weight_serving"] = {"ok": False, "error": repr(e)}
+
+    return {"smoke": True,
+            "backend": jax.default_backend(),
+            "ok": all(leg.get("ok") for leg in legs.values()),
+            **legs}
+
+
 if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        res = smoke()
+        print(json.dumps(res))
+        sys.exit(0 if res["ok"] else 1)
     main()

@@ -168,3 +168,19 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     if optimizers is None:
         return models if single_model else model_list
     return (models if single_model else model_list), optimizers
+
+
+def is_float16_supported(device=None):
+    """Reference paddle.amp.is_float16_supported: whether the current
+    device computes in fp16.  TPU matrix units are bf16-native; fp16 is
+    emulated — report support only where XLA maps it onto hardware
+    (GPU), i.e. False on TPU/CPU backends."""
+    import jax
+
+    return jax.default_backend() in ("gpu", "cuda", "rocm")
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the TPU-native compute dtype (and XLA:CPU emulates it
+    correctly, matching the reference's True on capable hardware)."""
+    return True

@@ -168,17 +168,69 @@ def hessian(ys, xs, batch_axis=None):
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._hooks = None       # (pack, unpack) active at save time
         self.materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        hooks = _current_saved_tensors_hooks()
+        if hooks is not None:
+            pack, _ = hooks
+            self._saved = tuple(pack(t) for t in tensors)
+            self._hooks = hooks
+        else:
+            self._saved = tensors
+
+    def _unpacked(self):
+        if self._hooks is None:
+            return self._saved
+        _, unpack = self._hooks
+        return tuple(unpack(p) for p in self._saved)
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
+
+
+import threading as _threading  # noqa: E402
+
+_hooks_tls = _threading.local()
+
+
+def _current_saved_tensors_hooks():
+    stack = getattr(_hooks_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+class saved_tensors_hooks:
+    """Analog of paddle.autograd.saved_tensors_hooks
+    (python/paddle/autograd/saved_tensors_hooks.py): a context manager
+    installing a (pack, unpack) pair applied to tensors saved for
+    backward — the activation-offload / compression hook point.
+
+    Scope note (deliberate, documented): on this stack the hook pair
+    applies to tensors saved through ``PyLayerContext.save_for_backward``
+    — pack runs at save time, unpack when ``saved_tensor`` is read in
+    backward.  Residuals of REGISTERED ops live inside their ``jax.vjp``
+    closures (autograd/tape.py design note) where XLA already manages
+    their placement; wrap a region in a PyLayer to route its residuals
+    through these hooks."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        if not hasattr(_hooks_tls, "stack"):
+            _hooks_tls.stack = []
+        _hooks_tls.stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_tls.stack.pop()
+        return False
 
 
 class PyLayer:

@@ -571,3 +571,43 @@ from .special import (ContinuousBernoulli, Constraint, Independent as  # noqa: E
 
 __all__ += ["ContinuousBernoulli", "LKJCholesky", "Constraint", "Real",
             "Range", "Positive", "Simplex", "Variable"]
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    python/paddle/distribution/exponential_family.py): subclasses expose
+    natural parameters and the log-normalizer A(theta); ``entropy`` uses
+    the Bregman identity H = A(theta) - <theta, grad A(theta)> -
+    E[carrier measure], with the gradient taken by jax instead of the
+    reference's imperative double-backward."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nat = [p._value if isinstance(p, Tensor) else jnp.asarray(p)
+               for p in self._natural_parameters]
+        nat = [n.astype(jnp.float32) for n in nat]
+
+        def lognorm_sum(*thetas):
+            out = self._log_normalizer(*[Tensor(t) for t in thetas])
+            out = out._value if isinstance(out, Tensor) else out
+            return jnp.sum(out), out
+
+        grads, lognorm = jax.grad(lognorm_sum, argnums=tuple(
+            range(len(nat))), has_aux=True)(*nat)
+        ent = -jnp.asarray(self._mean_carrier_measure) + lognorm
+        for th, g in zip(nat, grads):
+            ent = ent - th * g
+        return Tensor(ent)
+
+
+__all__ += ["ExponentialFamily"]

@@ -1,0 +1,30 @@
+"""paddle.incubate.autotune — runtime autotuning config entry.
+
+Analog of python/paddle/incubate/autotune.py set_config: a JSON-ish dict
+(or file) toggling kernel autotuning.  On this stack the consumer is
+ops/autotune.py (Pallas block sizes, paged-decode pages-per-step, the
+varlen packed/dense dispatcher), switched by FLAGS_use_autotune."""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config"]
+
+
+def set_config(config=None):
+    """Enable/disable kernel autotune.  ``config`` may be None (enable
+    everything, the reference default), a dict like
+    {"kernel": {"enable": True, "tuning_range": [1, 10]}}, or a path to
+    a JSON file with that layout.  Only the kernel section is meaningful
+    on TPU (layout/dataloader tuning is discharged onto XLA/the input
+    pipeline)."""
+    from ..common import flags as _flags
+
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    enable = True
+    if isinstance(config, dict):
+        enable = bool(config.get("kernel", {}).get("enable", True))
+    _flags.set_flags({"FLAGS_use_autotune": enable})

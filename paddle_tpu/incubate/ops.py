@@ -1,0 +1,145 @@
+"""paddle_tpu.incubate — top-level incubate ops.
+
+Analogs of python/paddle/incubate/operators/{softmax_mask_fuse.py,
+softmax_mask_fuse_upper_triangle.py, graph_send_recv.py,
+graph_khop_sampler.py} and python/paddle/incubate/nn/loss.py
+(identity_loss).  The fused-softmax pair are the transformer-attention
+fusions the reference hand-writes in CUDA
+(fused_softmax_mask_kernel.cu); on TPU they are single XLA fusions."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import geometric as _geo
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fusion: x [b, h, sq, sk] fp scores,
+    mask broadcastable [b, 1, sq, sk] additive (-inf style) mask."""
+    xv, mv = _v(x), _v(mask)
+    s = xv.astype(jnp.float32) + mv.astype(jnp.float32)
+    out = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    out = out / jnp.sum(out, axis=-1, keepdims=True)
+    return Tensor(out.astype(xv.dtype))
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal fused softmax: positions ABOVE the diagonal are masked
+    (the reference's fused_softmax_mask_upper_triangle kernel for
+    GPT-style attention scores [b, h, s, s])."""
+    xv = _v(x)
+    sq, sk = xv.shape[-2], xv.shape[-1]
+    causal = jnp.tril(jnp.ones((sq, sk), bool))
+    s = jnp.where(causal, xv.astype(jnp.float32), -1e30)
+    out = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    out = out / jnp.sum(out, axis=-1, keepdims=True)
+    return Tensor(out.astype(xv.dtype))
+
+
+def identity_loss(x, reduction="none"):
+    """python/paddle/incubate/nn/loss.py identity_loss: pass the input
+    through as the loss with the requested reduction (int codes are the
+    reference's 0=sum, 1=mean, 2=none)."""
+    if isinstance(reduction, int):
+        reduction = {0: "sum", 1: "mean", 2: "none"}.get(reduction)
+    if reduction not in ("none", "mean", "sum"):
+        raise ValueError(f"unknown reduction {reduction!r}")
+    from .. import ops as _ops  # noqa: F401 (registry populated)
+    from ..ops.registry import dispatch
+
+    if reduction == "mean":
+        return dispatch("mean", x)
+    if reduction == "sum":
+        return dispatch("sum", x)
+    return x if isinstance(x, Tensor) else Tensor(_v(x))
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy-name alias of geometric.send_u_recv (the reference keeps
+    both entry points; incubate's predates the geometric namespace)."""
+    return _geo.send_u_recv(x, src_index, dst_index,
+                            reduce_op=pool_type, out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Legacy-name alias of geometric.sample_neighbors."""
+    return _geo.sample_neighbors(row, colptr, input_nodes,
+                                 sample_size=sample_size, eids=eids,
+                                 return_eids=return_eids,
+                                 perm_buffer=perm_buffer)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    """Legacy-name alias of geometric.reindex_graph."""
+    return _geo.reindex_graph(x, neighbors, count,
+                              value_buffer=value_buffer,
+                              index_buffer=index_buffer)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbor sampling over a CSC graph (python/paddle/incubate/
+    operators/graph_khop_sampler.py): one uniform sample_neighbors pass
+    per hop, frontier = previous hop's (deduplicated) neighbors, then
+    one global reindex onto contiguous ids.  Host-side and nondiff,
+    like the reference's CPU kernel.  Returns
+    (edge_src, edge_dst, sample_index, reindex_x) — the sampled edges in
+    reindexed ids, the unique node list, and the reindexed seeds."""
+    seeds = np.asarray(_v(input_nodes)).reshape(-1).astype(np.int64)
+    frontier = seeds
+    all_src, all_dst = [], []
+    for size in list(sample_sizes):
+        if frontier.size == 0:
+            break
+        neigh, cnt = _geo.sample_neighbors(row, colptr, frontier,
+                                           sample_size=int(size))
+        nv = np.asarray(_v(neigh)).reshape(-1)
+        cv = np.asarray(_v(cnt)).reshape(-1)
+        dst = np.repeat(frontier, cv)
+        all_src.append(nv)
+        all_dst.append(dst)
+        frontier = np.unique(nv)
+    if all_src:
+        src = np.concatenate(all_src)
+        dst = np.concatenate(all_dst)
+    else:
+        src = np.empty(0, np.int64)
+        dst = np.empty(0, np.int64)
+    # reindex: seeds first (0..n_seed), then new nodes in first-seen order
+    mapping = {}
+    order = []
+    for n in list(seeds) + list(dst) + list(src):
+        n = int(n)
+        if n not in mapping:
+            mapping[n] = len(mapping)
+            order.append(n)
+    edge_src = np.asarray([mapping[int(n)] for n in src], np.int64)
+    edge_dst = np.asarray([mapping[int(n)] for n in dst], np.int64)
+    sample_index = np.asarray(order, np.int64)
+    reindex_x = np.asarray([mapping[int(n)] for n in seeds], np.int64)
+    if return_eids:
+        # fail fast rather than fabricate ids: the host sampler does not
+        # track which CSC positions were drawn, so real edge ids are not
+        # recoverable here — silently wrong ids would corrupt downstream
+        # edge-feature lookups
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True) is not supported: the "
+            "host-side sampler does not track sampled edge positions; "
+            "sample with return_eids=False and look features up by "
+            "(src, dst) instead")
+    return (Tensor(jnp.asarray(edge_src)), Tensor(jnp.asarray(edge_dst)),
+            Tensor(jnp.asarray(sample_index)),
+            Tensor(jnp.asarray(reindex_x)))

@@ -193,3 +193,93 @@ class LBFGS(Optimizer):
                 break
             loss, g = f_new, g_new
         return Tensor(jnp.asarray(loss, jnp.float32))
+
+
+class ModelAverage(Optimizer):
+    """modelaverage.py — maintain a running average of the parameters over
+    a sliding window and swap it in for evaluation.
+
+    ``step()`` (called after the inner training step) banks the current
+    weights into the accumulators; ``apply()`` swaps the averaged weights
+    in (a context manager, like the reference's); ``restore()`` puts the
+    trained weights back.  The window grows until
+    ``max_average_window`` (or ``average_window_rate`` x steps), then the
+    oldest contributions are retired wholesale — the reference's
+    sum_1/sum_2/sum_3 rotation, kept here as (old_sum, cur_sum) blocks."""
+
+    def __init__(self, average_window_rate: float,
+                 parameters: Optional[List] = None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._parameters = list(parameters or [])
+        # accumulators stay DEVICE arrays: step() only enqueues adds
+        # (async dispatch), nothing syncs until apply() reads back
+        self._old_sum = [jnp.zeros(p._value.shape, jnp.float32)
+                         for p in self._parameters]
+        self._old_cnt = 0
+        self._cur_sum = [jnp.zeros_like(s) for s in self._old_sum]
+        self._cur_cnt = 0
+        self._step_count = 0
+        self._backup = None
+
+    def step(self):
+        self._step_count += 1
+        for i, p in enumerate(self._parameters):
+            self._cur_sum[i] = self._cur_sum[i] +                 p._value.astype(jnp.float32)
+        self._cur_cnt += 1
+        window = min(self.max_window,
+                     max(self.min_window,
+                         int(self.avg_rate * self._step_count)))
+        if self._cur_cnt >= window:
+            # rotate: current block becomes the retained old block
+            self._old_sum = self._cur_sum
+            self._old_cnt = self._cur_cnt
+            self._cur_sum = [jnp.zeros_like(s) for s in self._old_sum]
+            self._cur_cnt = 0
+
+    def _averaged(self, i):
+        cnt = self._old_cnt + self._cur_cnt
+        if cnt == 0:
+            return np.asarray(self._parameters[i]._value, np.float32)
+        return np.asarray((self._old_sum[i] + self._cur_sum[i]) / cnt)
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Context manager: parameters hold their AVERAGED values inside
+        the block (restored on exit when ``need_restore``)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._backup = [np.asarray(p._value).copy()
+                            for p in self._parameters]
+            for i, p in enumerate(self._parameters):
+                p.set_value(jnp.asarray(self._averaged(i), p.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._parameters, self._backup):
+            p.set_value(jnp.asarray(b, p.dtype))
+        self._backup = None
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameters:
+            p.clear_gradient(set_to_zero)
+
+    def state_dict(self):
+        return {"old_sum": self._old_sum, "old_cnt": self._old_cnt,
+                "cur_sum": self._cur_sum, "cur_cnt": self._cur_cnt,
+                "step_count": self._step_count}
+
+
+__all__.append("ModelAverage")

@@ -20,9 +20,35 @@ programs with STATIC shapes:
   slots in one jit (a ``lax.scan``), each step routing attention through
   the Pallas paged flash-decoding kernel (ops/pallas/
   decode_attention.py: page indirection in the DMA index maps, HBM
-  traffic bounded by live lengths).  Inactive slots compute masked
-  garbage that is never read — the price of static shapes, paid once per
-  slot instead of per-retrace.
+  traffic bounded by live lengths, several physical pages fused into one
+  grid step).  Inactive slots compute masked garbage that is never read
+  — the price of static shapes, paid once per slot instead of per-
+  retrace.
+
+Step-time design (round 6 — closing the gap to the weight-streaming
+floor):
+
+- the page pools are PER-LAYER arrays carried through the scan, so each
+  step's cache update is one direct scatter into the layer's pool.  The
+  previous [L, pages, ...] slab forced a slice + whole-layer
+  dynamic-update per layer per step, which XLA materialised as layer-pool
+  copies (~2x the pool's HBM bytes per step on top of the weight
+  stream);
+- the paged kernel iterates ``pages_per_step`` physical pages per grid
+  step (tune_pages_per_step), recovering the dense decode kernel's
+  ~512-token window instead of paying one grid trip per page;
+- the host scheduler runs ONE CHUNK AHEAD: ``step()`` launches the next
+  decode chunk against the device-resident token carry BEFORE reading
+  back the previous chunk's tokens, so admission/eviction bookkeeping
+  overlaps device execution and the device queue is never drained by
+  host logic.  Eviction therefore lands one chunk late; the lookahead
+  chunk's tokens for a finished slot are discarded at harvest (its
+  writes land in its own reserved pages or the trash page, and the
+  pages are only freed AFTER the stale chunk was already dispatched —
+  single-stream device ordering makes the overlap safe);
+- all host->device scheduling state rides in ONE packed int32 array
+  (page tables + seq lens + active/dirty masks + restart tokens) — one
+  transfer per chunk, applied on-device.
 
 Chunked decode amortizes host-round-trip latency (through the dev
 tunnel, ~100ms/call) AND is the admission granularity: new requests wait
@@ -32,7 +58,14 @@ expose.
 Page size is autotunable: ``page_size="auto"`` measures the paged kernel
 across candidate sizes for this model's shape (ops/autotune.py cache) —
 round-4 measured 64-token pages paying ~3x the dense kernel's grid
-overhead; bigger pages amortize it at the cost of allocation granularity.
+overhead; bigger pages amortize it at the cost of allocation granularity
+(and round-6's multi-page grid steps take the residual overhead out).
+
+Weight-only int8: params produced by models/generation.
+quantize_params_int8 (int8 matrices + per-out-channel scales) run
+through the same compiled programs — dequant fuses into the consumer
+dots, so an 8B-shaped model's weight stream halves (the bench.py
+llama-8B serving leg).
 """
 
 from __future__ import annotations
@@ -124,15 +157,17 @@ class ContinuousBatchingEngine:
     """Greedy-decode continuous batching over a paged cache.
 
     params/cfg: the flagship Llama functional state (models/generation.py
-    weight naming).  ``max_slots`` bounds the in-flight batch;
+    weight naming; weight-only int8 dicts from quantize_params_int8 work
+    unchanged).  ``max_slots`` bounds the in-flight batch;
     ``num_pages`` x ``page_size`` is the shared KV pool per layer."""
 
     def __init__(self, cfg, params, max_slots: int = 8,
                  num_pages: int = 64, page_size="auto",
                  max_seq_len: Optional[int] = None,
                  decode_chunk_steps: int = 8, eos_id: int = -1,
-                 cache_dtype=None):
+                 cache_dtype=None, pages_per_step="auto"):
         from ..models.generation import _CFGS, register_config
+        from ..ops.pallas.decode_attention import tune_pages_per_step
 
         self.cfg = cfg
         self.params = params
@@ -147,8 +182,8 @@ class ContinuousBatchingEngine:
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
         # the LAST physical page is a reserved scribble target: masked
-        # (inactive) slots in the static-shape decode program write their
-        # garbage K/V there instead of corrupting a live page
+        # (inactive/overrun) slots in the static-shape decode program
+        # write their garbage K/V there instead of corrupting a live page
         self.trash_page = self.num_pages - 1
         self.pages_per_seq = -(-self.max_seq_len // self.page_size)
         self.chunk = int(decode_chunk_steps)
@@ -156,19 +191,33 @@ class ContinuousBatchingEngine:
 
         L = cfg.num_hidden_layers
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
-        dt = next(iter(params.values())).dtype
+        dt = next(iter(v for k, v in params.items()
+                       if not k.endswith("._scale"))).dtype
+        if not jnp.issubdtype(dt, jnp.floating):
+            dt = jnp.bfloat16              # int8-weight dicts: bf16 cache
         if cache_dtype is not None:
             dt = jnp.dtype(cache_dtype)
         self.cache_dtype = dt
+        if pages_per_step == "auto":
+            pages_per_step = tune_pages_per_step(
+                self.max_slots, kvh, self.page_size, d, self.pages_per_seq,
+                dt)
+        self.pages_per_step = int(pages_per_step)
         # int8 cache: frozen per-(layer, kv-head) scales, auto-calibrated
         # from the FIRST prefill's K/V absmax (2x headroom) — a single
         # self-consistent quant/dequant pair for the whole run (the
         # reference's static cachekv_quant mode; see incubate/nn/
         # decode_attention.py for the dynamic per-sequence contract)
         self.kv_scales = None
-        self.k_pages = jnp.zeros((L, self.num_pages, kvh, self.page_size, d),
-                                 dt)
-        self.v_pages = jnp.zeros_like(self.k_pages)
+        # PER-LAYER pools: each decode-step cache write is one direct
+        # scatter into its layer's pool (a fused [L, ...] slab would cost
+        # a slice + whole-layer dynamic-update per layer per step)
+        self.k_pages = tuple(
+            jnp.zeros((self.num_pages, kvh, self.page_size, d), dt)
+            for _ in range(L))
+        self.v_pages = tuple(
+            jnp.zeros((self.num_pages, kvh, self.page_size, d), dt)
+            for _ in range(L))
         # host-side slot state
         self.tables = np.full((self.max_slots, self.pages_per_seq), -1,
                               np.int32)
@@ -184,6 +233,14 @@ class ContinuousBatchingEngine:
         self.queue: deque[Request] = deque()
         self._next_rid = 0
         self.finished: List[Finished] = []
+        # pipelined-launch state: chunks in flight (launched, not yet
+        # harvested), the device-resident token carry from the newest
+        # launch, per-slot dirty mask (host rewrote the slot since the
+        # last launch) and pending (launched-but-unharvested) steps
+        self._inflight: deque = deque()
+        self._dev_tok = None
+        self._dirty = np.ones(self.max_slots, bool)
+        self._pending = np.zeros(self.max_slots, np.int32)
         # step report (reference seq_lens_encoder/decoder/this_time
         # semantics: encoder = prompt tokens prefilled this step,
         # decoder = cached tokens of decoding slots, this_time = tokens
@@ -192,11 +249,20 @@ class ContinuousBatchingEngine:
 
     # ---------------- device programs ----------------
 
-    @partial(jax.jit, static_argnames=("self_cfg_id", "chunk"),
+    @partial(jax.jit, static_argnames=("self_cfg_id", "chunk",
+                                       "pages_per_step"),
              donate_argnums=(1, 2))
-    def _decode_chunk_jit(params, k_pages, v_pages, tables, seq_lens,
-                          tok, active, cos_tab, sin_tab, self_cfg_id,
-                          chunk, kv_scales=None):
+    def _decode_chunk_jit(params, k_pages, v_pages, sched, dev_tok,
+                          cos_tab, sin_tab, self_cfg_id, chunk,
+                          pages_per_step, kv_scales=None):
+        """``chunk`` decode steps for all slots.  ``sched`` is the packed
+        host scheduling state, ONE int32 [slots, P+4] upload per chunk:
+        columns [0:P) page tables, P seq lens, P+1 active, P+2 dirty,
+        P+3 restart token.  ``dev_tok`` is the previous chunk's token
+        carry (still on device — the lookahead pipeline never reads it
+        back); slots the host rewrote since that launch (admissions,
+        evictions) take their restart token from the sched upload
+        instead."""
         from ..models.generation import _CFGS, _Weights
 
         cfg, _, _ = _CFGS[self_cfg_id]
@@ -204,14 +270,20 @@ class ContinuousBatchingEngine:
         L = cfg.num_hidden_layers
         h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                      cfg.head_dim)
-        page = k_pages.shape[3]
-        nslots = tok.shape[0]
+        page = k_pages[0].shape[2]
+        P = sched.shape[1] - 4
+        tables = sched[:, :P]
+        seq0 = sched[:, P]
+        active = sched[:, P + 1] > 0
+        dirty = sched[:, P + 2] > 0
+        tok0 = jnp.where(dirty, sched[:, P + 3], dev_tok)
+        nslots = sched.shape[0]
+        trash = k_pages[0].shape[0] - 1
         from ..ops.pallas.decode_attention import paged_decode_raw
 
         def one_step(carry, _):
             k_pages, v_pages, seq_lens, tok, done = carry
-            x = jnp.take(w["model.embed_tokens.weight"], tok[:, None],
-                         axis=0)
+            x = w.embed(tok[:, None])
             cos = jnp.take(cos_tab, seq_lens, axis=0)[:, None, None, :]
             sin = jnp.take(sin_tab, seq_lens, axis=0)[:, None, None, :]
             cos = cos.astype(x.dtype)
@@ -221,10 +293,12 @@ class ContinuousBatchingEngine:
             blk = seq_lens // page
             slot = seq_lens % page
             bidx = jnp.arange(nslots)
-            phys = tables[bidx, blk]                       # [nslots]
-            # masked slots (inactive/finished) scribble into the reserved
-            # trash page — their table entries are -1
-            phys = jnp.where(done | (phys < 0), k_pages.shape[1] - 1, phys)
+            phys = tables[bidx, jnp.minimum(blk, P - 1)]   # [nslots]
+            # masked slots (inactive/finished) and overrun slots (the
+            # lookahead chunk of an already-finished sequence) scribble
+            # into the reserved trash page
+            phys = jnp.where(done | (phys < 0) | (blk >= P), trash, phys)
+            new_k, new_v = [], []
             for i in range(L):
                 xin = _rms_norm(x, w.layer(i, "input_layernorm.weight"),
                                 cfg.rms_norm_eps)
@@ -238,7 +312,7 @@ class ContinuousBatchingEngine:
                 kw_, vw_ = k[:, 0], v[:, 0]
                 qd = q.reshape(nslots, h, d)
                 rep_ = h // kvh
-                if k_pages.dtype == jnp.int8:
+                if k_pages[i].dtype == jnp.int8:
                     # quantize the new token; fold k-dequant into q and
                     # v-dequant into the context (exact per-head linear
                     # folds — see incubate/nn/decode_attention.py)
@@ -249,16 +323,19 @@ class ContinuousBatchingEngine:
                     kdq = jnp.repeat(kv_scales["kdq"][i], rep_)
                     qd = (qd.astype(jnp.float32)
                           * kdq[None, :, None]).astype(q.dtype)
+                # ONE scatter into this layer's pool (per-layer pools:
+                # no [L, ...] slab slice/update on the hot path)
                 kp = k_pages[i].at[phys, :, slot, :].set(
-                    kw_.astype(k_pages.dtype))
+                    kw_.astype(k_pages[i].dtype))
                 vp = v_pages[i].at[phys, :, slot, :].set(
-                    vw_.astype(v_pages.dtype))
-                k_pages = k_pages.at[i].set(kp)
-                v_pages = v_pages.at[i].set(vp)
+                    vw_.astype(v_pages[i].dtype))
+                new_k.append(kp)
+                new_v.append(vp)
                 ctx = paged_decode_raw(qd, kp, vp,
                                        seq_lens + 1, tables,
-                                       scale=d ** -0.5)
-                if k_pages.dtype == jnp.int8:
+                                       scale=d ** -0.5,
+                                       pages_per_step=pages_per_step)
+                if kp.dtype == jnp.int8:
                     vdq = jnp.repeat(kv_scales["vdq"][i], rep_)
                     ctx = ctx.astype(jnp.float32) * vdq[None, :, None]
                 x = x + (ctx.reshape(nslots, 1, h * d).astype(x.dtype)
@@ -274,13 +351,13 @@ class ContinuousBatchingEngine:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             nxt = jnp.where(done, tok, nxt)
             seq_lens = jnp.where(active & ~done, seq_lens + 1, seq_lens)
-            return (k_pages, v_pages, seq_lens, nxt, done), nxt
+            return (tuple(new_k), tuple(new_v), seq_lens, nxt, done), nxt
 
         done0 = ~active
-        (k_pages, v_pages, seq_lens, tok, _), toks = lax.scan(
-            one_step, (k_pages, v_pages, seq_lens, tok, done0), None,
+        (k_pages, v_pages, _, tok, _), toks = lax.scan(
+            one_step, (k_pages, v_pages, seq0, tok0, done0), None,
             length=chunk)
-        return k_pages, v_pages, seq_lens, tok, jnp.moveaxis(toks, 0, 1)
+        return k_pages, v_pages, tok, jnp.moveaxis(toks, 0, 1)
 
     @partial(jax.jit, static_argnames=("self_cfg_id", "bucket"))
     def _prefill_jit(params, ids, length, cos_tab, sin_tab, self_cfg_id,
@@ -292,7 +369,7 @@ class ContinuousBatchingEngine:
         cfg, _, _ = _CFGS[self_cfg_id]
         w = _Weights(cfg, params)
         L = cfg.num_hidden_layers
-        x = jnp.take(w["model.embed_tokens.weight"], ids[None], axis=0)
+        x = w.embed(ids[None])
         pos = jnp.arange(bucket)
         cos = jnp.take(cos_tab, pos, axis=0)[None, :, None, :].astype(x.dtype)
         sin = jnp.take(sin_tab, pos, axis=0)[None, :, None, :].astype(x.dtype)
@@ -315,21 +392,25 @@ class ContinuousBatchingEngine:
              donate_argnums=(0, 1))
     def _write_pages_jit(k_pages, v_pages, ks, vs, pg, npages, page_size):
         """Write a prompt's per-layer K/V ([L, bucket, kvh, d]) into its
-        physical pages — one compiled dispatch per admission.  Pages
-        beyond the prompt's real length land in the trash page."""
-        kt = jnp.moveaxis(ks, 1, 2).astype(k_pages.dtype)  # [L, kvh, B, d]
-        vt = jnp.moveaxis(vs, 1, 2).astype(v_pages.dtype)
+        physical pages — one compiled dispatch per admission, one
+        batched scatter per layer pool.  Pages beyond the prompt's real
+        length land in the trash page."""
+        L = ks.shape[0]
+        kt = jnp.moveaxis(ks, 1, 2)                  # [L, kvh, B, d]
+        vt = jnp.moveaxis(vs, 1, 2)
         pad = npages * page_size - kt.shape[2]
         if pad > 0:      # bucket smaller than the page span: zero-pad
             kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad), (0, 0)))
             vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        for j in range(npages):
-            lo = j * page_size
-            k_pages = k_pages.at[:, pg[j], :, :, :].set(
-                kt[:, :, lo:lo + page_size])
-            v_pages = v_pages.at[:, pg[j], :, :, :].set(
-                vt[:, :, lo:lo + page_size])
-        return k_pages, v_pages
+        kvh, d = kt.shape[1], kt.shape[3]
+        # [L, kvh, npages, page, d] -> [L, npages, kvh, page, d]
+        kt = kt.reshape(L, kvh, npages, page_size, d).transpose(0, 2, 1, 3, 4)
+        vt = vt.reshape(L, kvh, npages, page_size, d).transpose(0, 2, 1, 3, 4)
+        new_k = tuple(k_pages[i].at[pg].set(kt[i].astype(k_pages[i].dtype))
+                      for i in range(L))
+        new_v = tuple(v_pages[i].at[pg].set(vt[i].astype(v_pages[i].dtype))
+                      for i in range(L))
+        return new_k, new_v
 
     @staticmethod
     def _quant(x, scale):
@@ -419,6 +500,8 @@ class ContinuousBatchingEngine:
             self.cur_tok[slot] = int(tok)
             self.budget[slot] = req.max_new_tokens - 1
             self.slot_rid[slot] = req.rid
+            self._dirty[slot] = True
+            self._pending[slot] = 0
             self.out_tokens[req.rid] = [int(tok)]
             self.prompt_lens[req.rid] = s
             admitted.append((slot, s))
@@ -437,63 +520,149 @@ class ContinuousBatchingEngine:
         self.tables[slot] = -1
         self.seq_lens[slot] = 0
         self.slot_rid[slot] = -1
+        self._dirty[slot] = True
+        self._pending[slot] = 0
 
-    def step(self):
-        """One scheduler iteration: admit, run a decode chunk, evict.
-        Returns the number of tokens generated this iteration."""
-        admitted = self._admit()
-        enc = np.zeros(self.max_slots, np.int32)
-        for s, plen in admitted:
-            enc[s] = plen
+    def _pack_sched(self) -> np.ndarray:
+        P = self.pages_per_seq
+        sched = np.empty((self.max_slots, P + 4), np.int32)
+        sched[:, :P] = self.tables
+        sched[:, P] = self.seq_lens
+        sched[:, P + 1] = self.active
+        sched[:, P + 2] = self._dirty
+        sched[:, P + 3] = self.cur_tok
+        return sched
+
+    def _launch(self) -> bool:
+        """Dispatch the next decode chunk (async) against the current
+        host schedule and the device-resident token carry.  Returns
+        False when no active slot could still produce a consumable token
+        (all remaining budget is already covered by in-flight chunks)."""
         if not self.active.any():
-            self.last_report = {
-                "seq_lens_encoder": enc,
-                "seq_lens_decoder": np.zeros(self.max_slots, np.int32),
-                "seq_lens_this_time": enc.copy(),
-            }
-            return 0
-        steps = self.chunk   # FIXED length: one compiled program
-        k_pages, v_pages, seq_lens, tok, toks = \
-            ContinuousBatchingEngine._decode_chunk_jit(
-                self.params, self.k_pages, self.v_pages,
-                jnp.asarray(self.tables), jnp.asarray(self.seq_lens),
-                jnp.asarray(self.cur_tok), jnp.asarray(self.active),
-                self.cos_tab, self.sin_tab, self_cfg_id=self.cfg_id,
-                chunk=steps, kv_scales=self.kv_scales)
-        self.k_pages, self.v_pages = k_pages, v_pages
-        toks = np.asarray(toks)                       # [slots, steps]
-        self.seq_lens = np.asarray(seq_lens).copy()
-        self.cur_tok = np.asarray(tok).copy()
+            return False
+        remaining = self.budget - self._pending
+        if not (self.active & (remaining > 0)).any():
+            return False
+        dev_tok = (self._dev_tok if self._dev_tok is not None
+                   else jnp.zeros((self.max_slots,), jnp.int32))
+        out = ContinuousBatchingEngine._decode_chunk_jit(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(self._pack_sched()), dev_tok,
+            self.cos_tab, self.sin_tab, self_cfg_id=self.cfg_id,
+            chunk=self.chunk, pages_per_step=self.pages_per_step,
+            kv_scales=self.kv_scales)
+        self.k_pages, self.v_pages, self._dev_tok, toks = out
+        self._inflight.append({
+            "toks": toks,
+            "steps": self.chunk,
+            "rids": self.slot_rid.copy(),
+            "launched_active": self.active.copy(),
+        })
+        # the host mirror advances deterministically (the scan adds one
+        # token per step per active slot) — no readback needed
+        self.seq_lens = np.where(self.active,
+                                 self.seq_lens + self.chunk,
+                                 self.seq_lens).astype(np.int32)
+        self._pending = np.where(self.active,
+                                 self._pending + self.chunk,
+                                 self._pending).astype(np.int32)
+        self._dirty[:] = False
+        return True
+
+    def _harvest(self, force: bool = False):
+        """Consume the oldest in-flight chunk's tokens (the only
+        host<->device sync on the serving path).  With the one-chunk
+        lookahead, this normally runs while the NEXT chunk executes on
+        device; ``force`` drains the pipeline when nothing new was
+        launched this step."""
+        this_time = np.zeros(self.max_slots, np.int32)
+        if not self._inflight or (len(self._inflight) < 2 and not force):
+            return 0, this_time
+        inf = self._inflight.popleft()
+        toks = np.asarray(inf["toks"])                # [slots, steps]
         produced = 0
-        dec = np.where(self.active, self.seq_lens, 0).astype(np.int32)
-        this_time = enc.copy()
-        for s in np.nonzero(self.active)[0]:
-            rid = int(self.slot_rid[s])
-            take = int(min(steps, self.budget[s]))
+        for s in np.nonzero(inf["launched_active"])[0]:
+            s = int(s)
+            rid = int(inf["rids"][s])
+            if (rid < 0 or not self.active[s]
+                    or int(self.slot_rid[s]) != rid):
+                continue            # evicted (or slot reused) since launch
+            take = int(min(inf["steps"], self.budget[s]))
+            hit_eos = False
             for t in toks[s, :take]:
                 self.out_tokens[rid].append(int(t))
                 produced += 1
                 this_time[s] += 1
                 if int(t) == self.eos_id:
+                    hit_eos = True
                     break
             self.budget[s] -= take
-            hit_eos = self.eos_id in toks[s, :take]
+            self._pending[s] = max(0, int(self._pending[s]) - inf["steps"])
             if self.budget[s] <= 0 or hit_eos:
-                self._finish(int(s))
+                self._finish(s)
+        return produced, this_time
+
+    def step(self):
+        """One scheduler iteration: admit, launch the next decode chunk,
+        harvest the previous one.  Returns the number of tokens
+        consumed this iteration (0 while the pipeline fills)."""
+        admitted = self._admit()
+        enc = np.zeros(self.max_slots, np.int32)
+        for s, plen in admitted:
+            enc[s] = plen
+        launched = self._launch()
+        # decoder lens snapshot BEFORE this harvest's evictions (the
+        # reference reports the lens the step ran with)
+        dec = np.where(self.active, self.seq_lens, 0).astype(np.int32)
+        produced, this_dec = self._harvest(force=not launched)
         self.last_report = {
             "seq_lens_encoder": enc,
             "seq_lens_decoder": dec,
-            "seq_lens_this_time": this_time,
+            "seq_lens_this_time": enc + this_dec,
         }
         return produced
 
     def run(self, max_iters: int = 10_000):
-        """Drive until queue + slots drain.  Returns finished requests
-        sorted by rid."""
+        """Drive until queue + slots + in-flight chunks drain.  Returns
+        finished requests sorted by rid."""
         it = 0
-        while (self.queue or self.active.any()) and it < max_iters:
+        while ((self.queue or self.active.any() or self._inflight)
+               and it < max_iters):
             self.step()
             it += 1
-        if self.queue or self.active.any():
+        if self.queue or self.active.any() or self._inflight:
             raise RuntimeError("serving loop did not drain")
         return sorted(self.finished, key=lambda f: f.rid)
+
+    # ---------------- bench helper ----------------
+
+    def time_decode_chunk(self, chunk: int, reps: int = 3) -> float:
+        """Wall-time one COMPILED decode chunk of ``chunk`` steps on the
+        current batch (bench.py's chunk-length-slope methodology).  Syncs
+        via a scalar readback — the tunnel's block_until_ready has been
+        observed returning early.  Mutates only the page pools (donated
+        through the program); the host schedule is left untouched so
+        repeated calls measure the same fill."""
+        import time as _time
+
+        sched_np = self._pack_sched()
+        sched_np[:, self.pages_per_seq + 2] = 1     # all dirty: restart
+        sched = jnp.asarray(sched_np)               # from host cur_tok
+        dirty_tok = jnp.asarray(self.cur_tok)
+
+        def call():
+            out = ContinuousBatchingEngine._decode_chunk_jit(
+                self.params, self.k_pages, self.v_pages, sched, dirty_tok,
+                self.cos_tab, self.sin_tab, self_cfg_id=self.cfg_id,
+                chunk=chunk, pages_per_step=self.pages_per_step,
+                kv_scales=self.kv_scales)
+            self.k_pages, self.v_pages = out[0], out[1]
+            float(out[2][0])
+
+        call()                              # compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            call()
+            best = min(best, _time.perf_counter() - t0)
+        return best

@@ -136,6 +136,105 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class WeightedRandomSampler(Sampler):
+    """paddle.io.WeightedRandomSampler: draw ``num_samples`` indices with
+    probability proportional to ``weights``."""
+
+    def __init__(self, weights, num_samples, replacement=True):
+        super().__init__(None)
+        self.weights = np.asarray(
+            weights._value if hasattr(weights, "_value") else weights,
+            np.float64).reshape(-1)
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.num_samples = int(num_samples)
+        if not replacement and self.num_samples > len(self.weights):
+            raise ValueError("num_samples exceeds population without "
+                             "replacement")
+        self.replacement = bool(replacement)
+        self._epoch = 0
+
+    def __iter__(self):
+        self._epoch += 1
+        rng = np.random.RandomState(self._epoch * 2654435761 % (2 ** 31))
+        p = self.weights / self.weights.sum()
+        idx = rng.choice(len(p), size=self.num_samples,
+                         replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """paddle.io.SubsetRandomSampler: a random permutation of a fixed
+    index subset."""
+
+    def __init__(self, indices):
+        super().__init__(None)
+        self.indices = list(indices)
+        self._epoch = 0
+
+    def __iter__(self):
+        self._epoch += 1
+        rng = np.random.RandomState(self._epoch * 2654435761 % (2 ** 31))
+        return iter([self.indices[i]
+                     for i in rng.permutation(len(self.indices))])
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ComposeDataset(Dataset):
+    """paddle.io.ComposeDataset: zip same-length map-style datasets —
+    item i is the concatenation of every dataset's (tuple-normalized)
+    item i."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        n = len(self.datasets[0])
+        if any(len(d) != n for d in self.datasets):
+            raise ValueError("ComposeDataset datasets must share length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else (item,))
+        return tuple(out)
+
+
+class WorkerInfo:
+    """paddle.io.get_worker_info() payload inside a DataLoader worker."""
+
+    __slots__ = ("id", "num_workers", "dataset")
+
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: that worker's WorkerInfo
+    (id / num_workers / dataset, for IterableDataset sharding); None in
+    the main process — reference contract
+    (python/paddle/io/dataloader/worker.py get_worker_info)."""
+    return _worker_info
+
+
 class BatchSampler(Sampler):
     def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
                  drop_last=False):
@@ -283,12 +382,14 @@ _SHM_SEGMENT_IDS = itertools.count()
 
 
 def _mp_worker(dataset, collate_fn, index_q, result_q, worker_id,
-               worker_init_fn, shm_name=None):
+               worker_init_fn, shm_name=None, num_workers=1):
     """Worker-process loop (analog of the reference's _worker_loop,
     io/dataloader/worker.py): pull index lists, emit collated numpy.
     With ``shm_name`` the batch rides the native shared-memory ring
     (csrc/shm_channel.cpp — the reference's mmap_allocator transfer)
     instead of being pickled through the mp.Queue pipe."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     ch = None
@@ -384,7 +485,7 @@ class _MultiprocessIter:
                               None if self._channels else self._result_q,
                               w, loader.worker_init_fn,
                               self._channels[w].name if self._channels
-                              else None),
+                              else None, self._nw),
                         daemon=True)
             for w in range(self._nw)]
         for p in self._workers:

@@ -52,23 +52,96 @@ def _apply_rope(q, k, cos, sin):
 
 class _Weights:
     """Name-indexed view over functional_state (paddle Linear weights are
-    [in, out]: y = x @ W)."""
+    [in, out]: y = x @ W).
+
+    Weight-only int8 support: a weight named ``N`` may ride with a
+    sibling ``N._scale`` (per-output-channel fp scales from
+    quantize_params_int8).  Accessors dequantize ``int8 -> compute
+    dtype`` right at the consumer, so under jit XLA fuses the convert +
+    scale into the dot's operand stream and int8 is what leaves HBM —
+    the reference's weight_only_linear capability (python/paddle/nn/
+    quant/quantized_linear.py) realized as an XLA fusion instead of a
+    custom kernel.  Embedding lookups gather int8 ROWS first and
+    dequantize after (never materialising the full fp matrix)."""
 
     def __init__(self, cfg, params):
         self.cfg = cfg
         self.p = params
+        self._dt = None
+        for k, v in params.items():
+            if k.endswith("._scale"):
+                continue
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                self._dt = v.dtype
+                break
+        if self._dt is None:
+            self._dt = jnp.bfloat16
+
+    def _deq(self, name):
+        w = self.p[name]
+        sc = self.p.get(name + "._scale")
+        if sc is None:
+            return w
+        # per-out-channel (last axis) scales; convert+multiply fuse into
+        # the consuming dot — int8 streams from HBM, fp stays in VMEM
+        return w.astype(self._dt) * sc.astype(self._dt)[None, :]
 
     def layer(self, i, name):
-        return self.p[f"model.layers.{i}.{name}"]
+        return self._deq(f"model.layers.{i}.{name}")
+
+    def embed(self, ids):
+        """Token embedding lookup: gather rows, then dequantize the
+        gathered rows only (per-row scales for the [vocab, hidden]
+        matrix)."""
+        w = self.p["model.embed_tokens.weight"]
+        rows = jnp.take(w, ids, axis=0)
+        sc = self.p.get("model.embed_tokens.weight._scale")
+        if sc is None:
+            return rows
+        return rows.astype(self._dt) * jnp.take(
+            sc.astype(self._dt), ids, axis=0)[..., None]
 
     def head(self, x):
         if "lm_head.weight" in self.p:
-            return x @ self.p["lm_head.weight"]
-        # tied embeddings: reuse the embedding matrix transposed
-        return x @ self.p["model.embed_tokens.weight"].T
+            w = self.p["lm_head.weight"]
+            sc = self.p.get("lm_head.weight._scale")
+            if sc is None:
+                return x @ w
+            return (x @ w.astype(self._dt)) * sc.astype(self._dt)[None, :]
+        # tied embeddings: reuse the embedding matrix transposed (the
+        # per-row embed scales become per-out-channel head scales)
+        w = self.p["model.embed_tokens.weight"]
+        sc = self.p.get("model.embed_tokens.weight._scale")
+        if sc is None:
+            return x @ w.T
+        return (x @ w.T.astype(self._dt)) * sc.astype(self._dt)[None, :]
 
     def __getitem__(self, k):
-        return self.p[k]
+        return self._deq(k)
+
+
+def quantize_params_int8(params, keep=("norm", "layernorm")):
+    """Weight-only int8 quantization of a functional_state dict:
+    2D floating weights become int8 with a per-output-channel
+    (symmetric absmax) fp32 ``<name>._scale`` sibling; 1D weights
+    (norm gains) and anything matching ``keep`` stay in fp.  The
+    embedding matrix is quantized per ROW (its rows are gathered, its
+    transpose is the tied head's [hidden, vocab])."""
+    out = {}
+    for name, w in params.items():
+        is_embed = name.endswith("embed_tokens.weight")
+        if (w.ndim != 2 or not jnp.issubdtype(w.dtype, jnp.floating)
+                or any(s in name for s in keep)):
+            out[name] = w
+            continue
+        axis = 1 if is_embed else 0          # reduce over the in-dim
+        absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis)
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        den = scale[:, None] if is_embed else scale[None, :]
+        q = jnp.round(w.astype(jnp.float32) / den)
+        out[name] = jnp.clip(q, -127, 127).astype(jnp.int8)
+        out[name + "._scale"] = scale
+    return out
 
 
 def _block(w: _Weights, i, x, cos, sin, mask, k_all=None, v_all=None,
@@ -143,7 +216,7 @@ def _decode_step(w: _Weights, cos_tab, sin_tab, token, pos, k_cache, v_cache):
     Each layer goes through the same _block as prefill, writing its K/V at
     ``pos`` before attending. Returns (logits [b, V], k_cache, v_cache)."""
     cfg = w.cfg
-    x = jnp.take(w["model.embed_tokens.weight"], token[:, None], axis=0)
+    x = w.embed(token[:, None])
     cos = lax.dynamic_slice_in_dim(cos_tab, pos, 1)[None, :, None, :]
     sin = lax.dynamic_slice_in_dim(sin_tab, pos, 1)[None, :, None, :]
     cos = cos.astype(x.dtype)
@@ -190,7 +263,7 @@ def _generate_jit(params, ids, key, cfg_id, max_new_tokens,
 
     # ---- prefill: full causal forward, capture per-layer K/V ----
     positions = jnp.broadcast_to(jnp.arange(S), (b, S))
-    x = jnp.take(w["model.embed_tokens.weight"], ids, axis=0)
+    x = w.embed(ids)
     cos = jnp.take(cos_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
     sin = jnp.take(sin_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
     causal = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0, -jnp.inf)
@@ -247,7 +320,7 @@ def _beam_search_jit(params, ids, cfg_id, max_new_tokens, num_beams,
 
     # ---- prefill (per prompt, beams share it) ----
     positions = jnp.broadcast_to(jnp.arange(S), (b, S))
-    x = jnp.take(w["model.embed_tokens.weight"], ids, axis=0)
+    x = w.embed(ids)
     cos = jnp.take(cos_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
     sin = jnp.take(sin_tab, positions, axis=0)[:, :, None, :].astype(x.dtype)
     causal = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0, -jnp.inf)

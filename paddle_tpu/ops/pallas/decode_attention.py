@@ -39,15 +39,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import NEG_INF, _sds
+from .flash_attention import NEG_INF, _CompilerParams, _sds
 
 
 def _decode_kernel(*refs, block_k: int, scale: float):
-    """Shared online-softmax decode body.  Serves BOTH the dense and the
-    paged variant: the ONLY difference between them is the k/v BlockSpec
-    index maps (set up by the callers), so the leading scalar-prefetch
-    refs vary (dense: seq_lens; paged: seq_lens + block tables) and the
-    kernel reads just seq_lens."""
+    """Online-softmax decode body for the DENSE cache layout (the paged
+    variant lives in _paged_decode_kernel, which iterates several
+    physical pages per grid step)."""
     seq_ref = refs[0]
     q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs[-7:]
     bi = pl.program_id(0)                   # batch
@@ -169,26 +167,155 @@ def flash_decode_raw(q, k_cache, v_cache, seq_lens, scale=None,
                           scale=float(scale)),
         grid_spec=grid_spec,
         out_shape=_sds((b, kvh, rp, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(seq, qg, k_cache, v_cache)
     return out[:, :, :rep].reshape(b, h, d)
 
 
+def _paged_decode_kernel(*refs, page: int, pp: int, scale: float):
+    """Paged online-softmax decode body iterating ``pp`` physical pages
+    per grid step.  The per-page k/v refs were DMA'd independently by
+    ``pp`` scalar-prefetch index maps (ragged page iteration fused into
+    the block pipeline); the kernel walks them in order, updating the
+    same fp32 VMEM online-softmax state the dense kernel uses.  Decode
+    blocks are tiny, so per-grid-step overhead dominates — folding pp
+    pages into one step recovers the dense kernel's ~512-token window
+    (measured r4/r5: 64-128 token pages paid ~3x the dense kernel's
+    grid overhead)."""
+    seq_ref = refs[0]
+    q_ref = refs[2]
+    k_refs = refs[3:3 + pp]
+    v_refs = refs[3 + pp:3 + 2 * pp]
+    o_ref, m_scr, l_scr, acc_scr = refs[-4:]
+    bi = pl.program_id(0)
+    gi = pl.program_id(1)
+    ng = pl.num_programs(1)
+    slen = seq_ref[bi]
+
+    @pl.when(gi == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]                            # [kvh, rp, d]
+    for j in range(pp):
+        start = (gi * pp + j) * page
+
+        def compute(j=j, start=start):
+            k = k_refs[j][0]                # [kvh, page, d]
+            if k.dtype == jnp.int8:
+                # int8 KV: half the HBM stream; dequant scales are folded
+                # into q / the output by the callers
+                k = k.astype(q.dtype)
+            s = jax.lax.dot_general(
+                q, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * scale
+            kpos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(kpos < slen, s, NEG_INF)
+            m_prev = m_scr[:, :, :1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = (l_scr[:, :, :1] * alpha
+                     + jnp.sum(p, axis=-1, keepdims=True))
+            v = v_refs[j][0]
+            if v.dtype == jnp.int8:
+                v = v.astype(q.dtype)
+            rpos = start + jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+            v = jnp.where(rpos < slen, v, jnp.zeros_like(v))
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+            l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+        # sub-blocks entirely past the live length skip the MXU work
+        # (their DMA was already elided by the clamped index maps)
+        pl.when(start < slen)(compute)
+
+    @pl.when(gi == ng - 1)
+    def _():
+        l = l_scr[:, :, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        valid = m_scr[:, :, :1] > NEG_INF * 0.5
+        o_ref[0] = jnp.where(valid, acc_scr[:] / l, 0.0).astype(o_ref.dtype)
+
+
+# VMEM budget for the resident paged k+v blocks (double-buffered by the
+# pipeline): bounds pages_per_step for large page x head configs
+_PAGED_VMEM_BUDGET = 8 * 1024 * 1024
+# token window one grid step should cover — the dense kernel's default
+# block_k, where per-step overhead stops dominating (v5e measured)
+_PAGED_TARGET_WINDOW = 512
+
+
+def default_pages_per_step(page: int, kvh: int, d: int, max_pages: int,
+                           itemsize: int = 2) -> int:
+    """Heuristic pp: cover ~_PAGED_TARGET_WINDOW tokens per grid step,
+    capped by the page count and the double-buffered VMEM budget."""
+    pp = max(1, _PAGED_TARGET_WINDOW // max(page, 1))
+    pp = min(pp, max_pages)
+    blk = 2 * 2 * page * kvh * d * itemsize      # k+v, double-buffered
+    while pp > 1 and pp * blk > _PAGED_VMEM_BUDGET:
+        pp //= 2
+    return max(1, pp)
+
+
+def tune_pages_per_step(b, kvh, page, d, max_pages, dtype=jnp.bfloat16):
+    """Measure paged_decode_raw across pages-per-step candidates for this
+    serving shape (cached per signature; ops/autotune.py pattern).
+    Returns the heuristic default when autotune is off or on CPU."""
+    from .. import autotune as _at
+
+    default = default_pages_per_step(page, kvh, d, max_pages,
+                                     jnp.dtype(dtype).itemsize)
+    key = ("paged_pages_per_step", b, kvh, page, d, max_pages, str(dtype))
+    cached = _at.AutoTuneCache.instance().lookup(key)
+    if cached is not None:
+        return cached
+    if not _at.enabled() or jax.default_backend() == "cpu":
+        return default
+
+    npages = b * max_pages
+    kc = jnp.zeros((npages, kvh, page, d), dtype)
+    vc = jnp.zeros((npages, kvh, page, d), dtype)
+    tables = jnp.arange(npages, dtype=jnp.int32).reshape(b, max_pages)
+    qx = jnp.ones((b, kvh, d), dtype)
+    lens = jnp.full((b,), (max_pages * page) // 2, jnp.int32)
+
+    def measure(pp):
+        return _at.time_fn(lambda: jax.block_until_ready(
+            paged_decode_raw(qx, kc, vc, lens, tables, pages_per_step=pp)))
+
+    cands = sorted({p for p in (1, 2, 4, 8)
+                    if p <= max_pages} | {default})
+    return _at.AutoTuneCache.instance().tune(key, cands, measure)
+
+
 def paged_decode_raw(q, key_cache, value_cache, seq_lens, block_tables,
-                     scale=None, interpret=None):
+                     scale=None, interpret=None, pages_per_step="auto"):
     """Paged (vLLM-layout) flash decode: q [b, h, d]; key/value_cache
     [n_blocks, kvh, page, d]; seq_lens [b] (valid tokens, INCLUDING the
     current one — the caller writes the new token's K/V into its page
     slot first); block_tables [b, max_pages] int32 physical page ids
     (-1 for unused slots).
 
-    The page indirection lives in the BlockSpec index map: each grid
-    step's k/v DMA reads ``block_tables`` via scalar prefetch and fetches
-    that physical page directly from HBM — no gathered [b, pages, ...]
-    copy of the cache is ever materialised (the XLA fallback's cost).
-    Pages past seq_len clamp to the last valid page (DMA elided)."""
+    The page indirection lives in the BlockSpec index maps: each grid
+    step DMAs ``pages_per_step`` physical pages straight from HBM via
+    independent scalar-prefetch-driven index maps — ragged page
+    iteration fused into the kernel's block pipeline; no gathered
+    [b, pages, ...] copy of the cache is ever materialised (the XLA
+    fallback's cost).  Pages past seq_len clamp to the last valid page
+    (DMA elided) and their compute is skipped, so both HBM traffic AND
+    grid-step count are bounded by the live lengths, not capacity.
+
+    ``pages_per_step``: physical pages per grid step ("auto" targets a
+    ~512-token window per step — the dense kernel's block size — under
+    a VMEM budget; serving pre-tunes it via tune_pages_per_step)."""
     b, h, d = q.shape
     kvh, page = key_cache.shape[1], key_cache.shape[2]
     if h % kvh != 0:
@@ -200,6 +327,11 @@ def paged_decode_raw(q, key_cache, value_cache, seq_lens, block_tables,
     rep = h // kvh
     rp = -(-rep // 8) * 8
     max_pages = block_tables.shape[1]
+    if pages_per_step == "auto":
+        pages_per_step = default_pages_per_step(
+            page, kvh, d, max_pages, jnp.dtype(key_cache.dtype).itemsize)
+    pp = max(1, min(int(pages_per_step), max_pages))
+    ng = -(-max_pages // pp)
 
     qg = q.reshape(b, kvh, rep, d)
     if rp != rep:
@@ -207,21 +339,27 @@ def paged_decode_raw(q, key_cache, value_cache, seq_lens, block_tables,
     seq = seq_lens.astype(jnp.int32)
     tables = block_tables.astype(jnp.int32)
 
-    def kv_map(bi, pi, seq_ref, tab_ref):
-        last = jnp.maximum((seq_ref[bi] + page - 1) // page - 1, 0)
-        phys = tab_ref[bi, jnp.minimum(pi, last)]
-        return (jnp.maximum(phys, 0), 0, 0, 0)
+    def kv_map(j):
+        def _map(bi, gi, seq_ref, tab_ref):
+            # clamp to the last page holding valid rows (and to the table
+            # width — lookahead scheduling may run a slot past capacity):
+            # out-of-range steps revisit it and Mosaic elides the DMA
+            last = jnp.maximum((seq_ref[bi] + page - 1) // page - 1, 0)
+            last = jnp.minimum(last, max_pages - 1)
+            phys = tab_ref[bi, jnp.minimum(gi * pp + j, last)]
+            return (jnp.maximum(phys, 0), 0, 0, 0)
+        return _map
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, kvh, rp, d), lambda bi, pi, s, t: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, kvh, page, d), kv_map),
-            pl.BlockSpec((1, kvh, page, d), kv_map),
-        ],
+        grid=(b, ng),
+        in_specs=(
+            [pl.BlockSpec((1, kvh, rp, d), lambda bi, gi, s, t: (bi, 0, 0, 0))]
+            + [pl.BlockSpec((1, kvh, page, d), kv_map(j)) for j in range(pp)]
+            + [pl.BlockSpec((1, kvh, page, d), kv_map(j)) for j in range(pp)]
+        ),
         out_specs=pl.BlockSpec((1, kvh, rp, d),
-                               lambda bi, pi, s, t: (bi, 0, 0, 0)),
+                               lambda bi, gi, s, t: (bi, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((kvh, rp, 128), jnp.float32),
             pltpu.VMEM((kvh, rp, 128), jnp.float32),
@@ -229,13 +367,14 @@ def paged_decode_raw(q, key_cache, value_cache, seq_lens, block_tables,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, block_k=page, scale=float(scale)),
+        functools.partial(_paged_decode_kernel, page=page, pp=pp,
+                          scale=float(scale)),
         grid_spec=grid_spec,
         out_shape=_sds((b, kvh, rp, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(seq, tables, qg, key_cache, value_cache)
+    )(seq, tables, qg, *([key_cache] * pp), *([value_cache] * pp))
     return out[:, :, :rep].reshape(b, h, d)
 
 
@@ -250,6 +389,8 @@ def flash_decoding_op(q, k_cache, v_cache, seq_lens, scale=None):
 
 @register("paged_flash_decoding", amp="white")
 def paged_flash_decoding_op(q, key_cache, value_cache, seq_lens,
-                            block_tables, scale=None):
+                            block_tables, scale=None,
+                            pages_per_step="auto"):
     return paged_decode_raw(q, key_cache, value_cache, seq_lens,
-                            block_tables, scale=scale)
+                            block_tables, scale=scale,
+                            pages_per_step=pages_per_step)

@@ -278,3 +278,36 @@ class PTQ:
         _replace_sublayers(model, conv)
         model.eval()
         return model
+
+
+class BaseQuanter(Layer):
+    """Abstract quanter contract (reference python/paddle/quantization/
+    base_quanter.py): a layer that fake-quantizes activations/weights in
+    forward and exposes its quantization parameters."""
+
+    def forward(self, input):  # noqa: A002
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def bit_length(self):
+        return 8
+
+    def quant_axis(self):
+        return -1
+
+
+class BaseObserver(BaseQuanter):
+    """Abstract observer contract (reference base_observer.py): a
+    quanter that additionally CALIBRATES — it watches activations during
+    PTQ sampling and derives thresholds afterwards."""
+
+    def cal_thresholds(self):
+        raise NotImplementedError
+
+
+__all__ += ["BaseQuanter", "BaseObserver"]

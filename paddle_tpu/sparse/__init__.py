@@ -588,3 +588,46 @@ def leaky_relu(x, negative_slope=0.01):
 
 nn.functional.relu6 = relu6
 nn.functional.leaky_relu = leaky_relu
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA (reference python/paddle/sparse/multiary
+    pca_lowrank for sparse inputs; mirrors paddle.linalg.pca_lowrank).
+    Accepts SparseCooTensor / SparseCsrTensor / dense [m, n]; returns
+    (U [m, q], S [q], V [n, q]) with x ~ U diag(S) V^T after optional
+    mean-centering.  Randomized range finder + ``niter`` subspace
+    iterations, economy SVD on the projected panel."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xv = x.to_dense()
+        xv = xv._value if hasattr(xv, "_value") else jnp.asarray(xv)
+    else:
+        xv = x._value if hasattr(x, "_value") else jnp.asarray(x)
+    xv = xv.astype(jnp.float32)
+    if xv.ndim != 2:
+        raise ValueError(f"pca_lowrank expects a matrix, got {xv.shape}")
+    m, n = xv.shape
+    if q is None:
+        q = min(6, m, n)
+    if not 0 < q <= min(m, n):
+        raise ValueError(f"q={q} out of range for shape {xv.shape}")
+    if center:
+        xv = xv - jnp.mean(xv, axis=0, keepdims=True)
+    from ..ops.random import _key
+
+    omega = jax.random.normal(_key(), (n, q), jnp.float32)
+    y = xv @ omega
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(int(niter)):
+        z = xv.T @ qmat
+        zq, _ = jnp.linalg.qr(z)
+        y = xv @ zq
+        qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ xv                       # [q, n]
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ ub
+    from ..core.tensor import Tensor as _T
+
+    return _T(u), _T(s), _T(vt.T)
+
+
+__all__ += ["pca_lowrank"]

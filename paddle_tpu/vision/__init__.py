@@ -1,3 +1,4 @@
 """paddle_tpu.vision (analog of python/paddle/vision)."""
 
 from . import datasets, models, transforms
+from .image import get_image_backend, image_load, set_image_backend  # noqa: E402,F401

@@ -1,0 +1,85 @@
+"""paddle_tpu.vision image backend selection (reference
+python/paddle/vision/image.py).
+
+The reference toggles between PIL and OpenCV decoders; this stack
+supports ``pil`` (when Pillow is importable) and a dependency-free
+``numpy`` backend that reads uncompressed PPM/PGM plus .npy arrays —
+enough for dataset plumbing in CI containers without image libraries."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Select the decoder ``image_load`` uses ('pil' or 'cv2' per the
+    reference; plus 'numpy' here)."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "numpy"):
+        raise ValueError(
+            f"expected backend are one of ['pil', 'cv2', 'numpy'], but "
+            f"got {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def _load_netpbm(path):
+    with open(path, "rb") as f:
+        # the spec allows magic and dimensions on ONE whitespace-separated
+        # header line ("P6 4 4 255"): split tokens, first is the magic
+        head = f.readline().split()
+        magic = head[0] if head else b""
+        if magic not in (b"P5", b"P6"):
+            raise ValueError(f"{path}: not a binary PGM/PPM file")
+        dims = [int(tok) for tok in head[1:]]
+        while len(dims) < 3:
+            line = f.readline()
+            if not line:
+                raise ValueError(f"{path}: truncated PGM/PPM header")
+            if line.startswith(b"#"):
+                continue
+            dims += [int(tok) for tok in line.split()]
+        w, h, maxval = dims[0], dims[1], dims[2]
+        ch = 3 if magic == b"P6" else 1
+        dt = np.uint8 if maxval < 256 else ">u2"
+        data = np.frombuffer(f.read(), dt, count=w * h * ch)
+    img = data.reshape(h, w, ch)
+    return img[:, :, 0] if ch == 1 else img
+
+
+def image_load(path, backend=None):
+    """Load an image file with the selected backend (reference
+    image_load).  Returns a PIL.Image for 'pil', an ndarray otherwise."""
+    backend = backend or _image_backend
+    if backend == "pil":
+        try:
+            from PIL import Image
+        except ImportError:
+            backend = "numpy"   # container without Pillow: fall through
+        else:
+            return Image.open(path)
+    if backend == "cv2":
+        try:
+            import cv2
+        except ImportError as e:
+            raise ImportError(
+                "image_load(backend='cv2') needs opencv-python; use "
+                "set_image_backend('pil'/'numpy')") from e
+        return cv2.imread(path)
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npy":
+        return np.load(path)
+    if ext in (".ppm", ".pgm"):
+        return _load_netpbm(path)
+    raise ValueError(
+        f"numpy image backend reads .npy/.ppm/.pgm, got {path!r}; "
+        f"install Pillow for general formats")

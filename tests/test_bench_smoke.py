@@ -1,0 +1,23 @@
+"""Tier-1 gate over bench.py --smoke (round-6 satellite): dispatch-layer
+regressions in the serving and varlen hot paths must fail the SUITE, not
+show up one round later in the next BENCH json.  Runs the same smoke()
+the CLI mode uses — tiny shapes, interpret-mode kernels, CPU-safe."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_bench_smoke_green():
+    res = bench.smoke()
+    assert res["smoke"] is True
+    # each leg reports ok + optional error detail; assert them
+    # individually so a regression names its leg
+    for leg in ("serving_pipeline_parity", "varlen_auto_dispatch",
+                "paged_multipage_kernel", "int8_weight_serving"):
+        assert res[leg].get("ok"), (leg, res[leg])
+    assert res["ok"]

@@ -16,10 +16,21 @@ from paddle_tpu.models.generation import generate
 
 @pytest.fixture(scope="module")
 def tiny_model():
+    # Seed EXPLICITLY before building the model: module-scoped fixtures
+    # instantiate before the function-scoped autouse ``_seed`` fixture,
+    # so without this the params depended on whatever RNG state the
+    # previous test left behind — the root cause of the suite-order
+    # flake in test_serving_int8_cache_close_to_bf16 (VERDICT r5 Weak
+    # #4: near-tie greedy tokens flipped with different random params).
+    import paddle_tpu as paddle
+
+    state = paddle.get_rng_state()
+    paddle.seed(20240806)
     cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
                             kv_heads=2, inter=64, max_pos=128)
     model = LlamaForCausalLM(cfg)
     params = {k: jnp.asarray(v) for k, v in model.functional_state().items()}
+    paddle.set_rng_state(state)
     return cfg, model, params
 
 
@@ -164,12 +175,59 @@ def test_serving_int8_cache_close_to_bf16(tiny_model):
         for p in prompts:
             eng.add_request(p, max_new_tokens=8)
         done = eng.run()
-        outs[dt] = [f.tokens for f in done]
+        # keyed by rid (run() sorts by rid) — order-independent pairing
+        outs[dt] = {f.rid: f.tokens for f in done}
         if dt == jnp.int8:
-            assert eng.k_pages.dtype == jnp.int8
+            assert all(kp.dtype == jnp.int8 for kp in eng.k_pages)
             assert eng.kv_scales is not None
 
+    assert sorted(outs[None]) == sorted(outs[jnp.int8])
     total_matching_tokens = sum(
         (np.asarray(a[:len(b)]) == np.asarray(b[:len(a)])).mean()
-        for a, b in zip(outs[None], outs[jnp.int8])) / len(prompts)
+        for a, b in ((outs[None][r], outs[jnp.int8][r])
+                     for r in sorted(outs[None]))) / len(prompts)
     assert total_matching_tokens > 0.7, (outs, total_matching_tokens)
+
+
+def test_serving_slot_reuse_under_lookahead(tiny_model):
+    """Round-6 pipelined scheduler: with ONE slot, requests run strictly
+    one after another through slot 0 — the stale lookahead chunk of a
+    finished request must never leak tokens into (or corrupt the pages
+    of) the request that reuses its slot.  Greedy parity with one-shot
+    generate() proves both."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (6, 9, 5)]
+    eng = _engine(cfg, params, max_slots=1, num_pages=5,
+                  decode_chunk_steps=3)
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=7)
+    done = eng.run()
+    assert len(done) == len(prompts)
+    for i, p in enumerate(prompts):
+        ref = generate(model, p[None], max_new_tokens=7, do_sample=False)
+        ref_new = np.asarray(ref._value if hasattr(ref, "_value") else ref
+                             )[0, len(p):]
+        np.testing.assert_array_equal(
+            done[i].tokens, ref_new[:len(done[i].tokens)],
+            err_msg=f"request {i} corrupted by slot reuse")
+        assert len(done[i].tokens) == 7
+    assert eng.alloc.available == 4 and not eng._inflight
+
+
+def test_serving_pipeline_overlaps_chunks(tiny_model):
+    """The scheduler keeps one chunk in flight: after a step that
+    launched, the previous chunk (if any) was harvested and the new one
+    is pending; run() drains the pipeline completely."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(8)
+    eng = _engine(cfg, params)
+    eng.add_request(rng.integers(1, cfg.vocab_size, (5,)).astype(np.int32),
+                    max_new_tokens=12)
+    produced0 = eng.step()          # admit + launch; nothing to harvest
+    assert produced0 == 0 and len(eng._inflight) == 1
+    produced1 = eng.step()          # launch #2, harvest #1
+    assert produced1 == 4 and len(eng._inflight) == 1
+    eng.run()
+    assert not eng._inflight and not eng.active.any()
