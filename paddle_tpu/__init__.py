@@ -201,7 +201,8 @@ from .frontend_compat import _install_inplace as _mk_inplace
 globals().update(_mk_inplace(globals()))
 mod_ = globals()["remainder_"]     # reference: mod_ == remainder_
 floor_mod_ = globals()["remainder_"]
-from .frontend_compat import bernoulli_, cast_, geometric_, normal_  # noqa: F401,E402
+from .frontend_compat import (bernoulli_, cast_, fill_, geometric_,  # noqa: F401,E402
+                              normal_, zero_)
 del _mk_inplace
 
 # snapshot the framework-shipped op set (custom ops registered by user

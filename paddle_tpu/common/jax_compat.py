@@ -1,0 +1,64 @@
+"""jax cross-version compat shims (round-7).
+
+The toolchain floor moves under this repo: PR-1 aliased
+pltpu.TPUCompilerParams/CompilerParams and the ShapeDtypeStruct(vma=)
+field inside flash_attention.py; this module is the shared home for the
+next such gaps.  ``jax.shard_map`` was promoted out of jax.experimental
+after 0.4.x (kwargs renamed: check_rep -> check_vma, manual axes became
+``axis_names`` instead of the complementary ``auto`` set), and
+``jax.sharding.set_mesh`` did not exist there at all.  On older jax the
+hybrid-parallel stack (llama_hybrid, pipeline_parallel, MoE pipelining,
+auto_parallel api) failed at attribute lookup; route those calls through
+this module.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None):
+    """jax.shard_map where available; the jax.experimental fallback
+    otherwise, with check_vma mapped onto check_rep and ``axis_names``
+    (manual axes) mapped onto the complementary ``auto`` set."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {}
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {}
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def axis_size(axis):
+    """Static size of a bound (manual) mesh axis: jax.lax.axis_size on
+    new jax; on 0.4.x ``jax.core.axis_frame(name)`` resolves it (that
+    version returns the bare int; guard the frame-object form too)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    import jax.core as _jc
+
+    fr = _jc.axis_frame(axis)
+    return fr if isinstance(fr, int) else fr.size
+
+
+def set_mesh(mesh):
+    """Context manager binding ``mesh`` as the ambient mesh.  Newer jax
+    ships jax.sharding.set_mesh; on older jax the Mesh object itself is
+    the context manager that binds the physical mesh for jit bodies."""
+    sm = getattr(jax.sharding, "set_mesh", None)
+    if sm is not None:
+        return sm(mesh)
+    return mesh

@@ -182,7 +182,9 @@ class PipelineParallel:
                 return pipeline_train_step(stage_fn, loss_fn, sched, sp,
                                            xb, yb, axis="pp")
 
-            fn = jax.jit(jax.shard_map(
+            from ....common.jax_compat import shard_map as _shard_map
+
+            fn = jax.jit(_shard_map(
                 body, mesh=mesh, in_specs=(pspec, P(None), P(None)),
                 out_specs=(P(), pspec), check_vma=False))
             self._compiled_cache[key] = fn

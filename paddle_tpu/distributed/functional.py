@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 
 
+from ..common.jax_compat import axis_size as _axis_size
+
 def _unwrap(x):
     from ..core.tensor import Tensor
     return x._value if isinstance(x, Tensor) else x
@@ -146,7 +148,7 @@ def axis_size(axis: str):
 
 
 def _axis_size_static(axis: str) -> int:
-    return int(lax.axis_size(axis))
+    return int(_axis_size(axis))
 
 
 def barrier(axis: str = "dp"):

@@ -73,6 +73,10 @@ _INPLACE_BASES = [
     "bitwise_left_shift", "bitwise_right_shift", "gammainc", "gammaincc",
     "gammaln", "gcd", "i0", "lcm", "ldexp", "logit", "masked_scatter",
     "multigammaln", "polygamma", "renorm", "sinc",
+    # round-7 tranche (tensor-method satellite: these also bind onto
+    # Tensor as `t.<base>_()` methods in ops/tensor_methods.py)
+    "add", "subtract", "clip", "exp", "sqrt", "rsqrt", "sigmoid",
+    "ceil", "floor", "round", "reciprocal", "scale",
 ]
 
 
@@ -308,6 +312,36 @@ def cast_(x, dtype):
         x._value = out
         return x
     return _wrap(out)
+
+
+def _guard_inplace_fill(x, name):
+    """Same active-tape guard as _inplace_of: a fill ignores x's VALUES,
+    but it still overwrites a buffer another op may have saved for its
+    backward — the hazard is the buffer, not the input dependence."""
+    from .autograd import is_grad_enabled
+
+    if isinstance(x, Tensor) and is_grad_enabled() \
+            and not getattr(x, "stop_gradient", True):
+        raise RuntimeError(
+            f"{name}: in-place write to a grad-requiring tensor under an "
+            f"active tape would corrupt saved activations (reference "
+            f"raises the tensor-version error here)")
+
+
+def zero_(x):
+    """Fill with zeros in place (reference paddle.Tensor.zero_)."""
+    _guard_inplace_fill(x, "zero_")
+    v = _val(x)
+    return _fill_inplace(x, jnp.zeros(v.shape, v.dtype))
+
+
+def fill_(x, value):
+    """Fill with a scalar in place (reference paddle.Tensor.fill_)."""
+    _guard_inplace_fill(x, "fill_")
+    v = _val(x)
+    if isinstance(value, Tensor):
+        value = value.item()
+    return _fill_inplace(x, jnp.full(v.shape, value, v.dtype))
 
 
 def _fill_inplace(x, sample):

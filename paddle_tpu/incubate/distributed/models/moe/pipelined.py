@@ -30,6 +30,8 @@ MOE_BLOCK_SPECS = {
 }
 
 
+from .....common.jax_compat import axis_size as _axis_size
+
 def init_pipelined_moe_params(mesh: Mesh, num_layers: int, num_expert: int,
                               d_model: int, d_hidden: int,
                               seed: int = 0) -> Dict[str, Any]:
@@ -75,11 +77,14 @@ def pipelined_moe_forward(params: Dict[str, Any], x, mesh: Mesh,
         outs = pipeline_apply(stage_fn, sp, x, axis="pp",
                               squeeze_stage_dim=False)
         last = (jax.lax.axis_index("pp")
-                == jax.lax.axis_size("pp") - 1).astype(outs.dtype)
+                == _axis_size("pp") - 1).astype(outs.dtype)
         return jax.lax.psum(outs * last, "pp")
 
-    with jax.sharding.set_mesh(mesh):
-        return jax.jit(jax.shard_map(
+    from .....common.jax_compat import set_mesh as _set_mesh, \
+        shard_map as _shard_map
+
+    with _set_mesh(mesh):
+        return jax.jit(_shard_map(
             body, mesh=mesh, axis_names={"pp"},
             in_specs=(P("pp"), P(None)), out_specs=P(None),
             check_vma=False))(params, x)

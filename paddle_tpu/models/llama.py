@@ -416,10 +416,32 @@ def apply_llama_sharding(model: Layer, mesh: Mesh,
 # The compiled train step
 # --------------------------------------------------------------------------
 
+def _accum_fold(accum_steps: int, cap: int = 8) -> int:
+    """Largest divisor of ``accum_steps`` not exceeding ``cap`` — the
+    number of consecutive bf16 micro-grad adds between fp32 folds (caps
+    the bf16 summation depth, so the carry error stays ~cap * 2^-9
+    relative per element)."""
+    for f in range(min(cap, accum_steps), 0, -1):
+        if accum_steps % f == 0:
+            return f
+    return 1
+
+
+def llama_decay_mask(model: Layer) -> Dict[str, bool]:
+    """Per-parameter AdamW decay mask for the Llama family: norm weights
+    and biases are exempt.  Shared by build_train_step and external
+    callers (bench.py's fused-optimizer flat state must group params by
+    the SAME mask the step applies)."""
+    return {n: not ("layernorm" in n or n.endswith("norm.weight")
+                    or n.endswith(".bias"))
+            for n, _ in model.named_parameters()}
+
+
 def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = None,
                      data_axes: Tuple[str, ...] = ("dp", "sharding"),
                      remat: bool = False, remat_policy=None,
-                     compute_dtype=jnp.bfloat16, accum_steps: int = 1):
+                     compute_dtype=jnp.bfloat16, accum_steps: int = 1,
+                     accum_dtype=None):
     """Build a single donated, jitted train step:
 
         step_fn(params, opt_state, step_no, lr, input_ids, labels)
@@ -436,13 +458,27 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
       recomputes the elementwise chain,
     - forward/backward math in ``compute_dtype`` (bf16 on the MXU),
       optimizer math fp32 (master weights in Adam state,
-      optimizer.py multi_precision).
+      optimizer.py multi_precision),
+    - ``accum_dtype`` picks the gradient-merge accumulator dtype for the
+      unmasked accum path.  None (default) resolves to bf16 when
+      compute_dtype is bf16 (the backward already emits bf16 grads; the
+      round-5 trace put the fp32 accumulator's read-modify-write at
+      ~173 ms/step of HBM traffic) and fp32 otherwise (exact parity for
+      fp32 test configs).  bf16 accumulation folds into an fp32 carry
+      every _accum_fold(accum_steps) micro-steps, bounding the bf16
+      summation depth; loss/grad parity vs the fp32 scheme is gated by
+      tests/test_grad_accum_bf16_carry.py at accum=32,
+    - ``opt_state`` built by ``optimizer.init_flat_state`` routes the
+      update through the fused multi-tensor ``apply_flat`` (one pass
+      over flattened param groups); per-param pytree state keeps the
+      legacy per-tensor ``apply``.
     """
     from ..autograd import no_grad
 
-    names = [n for n, _ in model.named_parameters()]
-    no_decay = {n for n in names if "layernorm" in n or n.endswith("norm.weight")
-                or n.endswith(".bias")}
+    decay_mask = llama_decay_mask(model)
+    if accum_dtype is None:
+        accum_dtype = (jnp.bfloat16 if compute_dtype == jnp.bfloat16
+                       else jnp.float32)
     batch_sharding = make_batch_shardings(mesh, data_axes) if mesh is not None \
         else None
 
@@ -491,6 +527,19 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
 
     grad_fn = jax.value_and_grad(loss_fn)
 
+    def apply_update(params, grads, opt_state, lr, step_no):
+        # flat (fused multi-tensor) state routes the single-pass AdamW;
+        # detection is structural so legacy per-param state keeps working
+        if hasattr(optimizer, "apply_flat") \
+                and getattr(optimizer, "state_is_flat", lambda s: False)(
+                    opt_state):
+            return optimizer.apply_flat(
+                params, grads, opt_state, lr, step_no + 1,
+                decay_mask=decay_mask)
+        return optimizer.apply(
+            params, grads, opt_state, lr, step_no + 1,
+            decay_mask=decay_mask)
+
     def step_fn(params, opt_state, step_no, lr, input_ids, labels,
                 attention_mask=None):
         if batch_sharding is not None:
@@ -500,9 +549,8 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
                 attention_mask = jax.lax.with_sharding_constraint(
                     attention_mask, batch_sharding)
         loss, grads = grad_fn(params, input_ids, labels, attention_mask)
-        new_params, new_opt_state = optimizer.apply(
-            params, grads, opt_state, lr, step_no + 1,
-            decay_mask={n: n not in no_decay for n in names})
+        new_params, new_opt_state = apply_update(params, grads, opt_state,
+                                                 lr, step_no)
         return loss, new_params, new_opt_state
 
     def accum_step_fn(params, opt_state, step_no, lr, input_ids, labels,
@@ -553,21 +601,61 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
 
         zero = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        if attention_mask is None:
+        # fold == 1 (accum_steps prime > cap) would be strictly worse
+        # than the fp32 accumulator — full fp32 carry traffic PLUS bf16
+        # quantization of every micro-grad — so it falls through
+        if attention_mask is None and accum_dtype != jnp.float32 \
+                and accum_steps > 1 and _accum_fold(accum_steps) > 1:
+            # bf16 micro-grad carry (round-7): the accumulator the scan
+            # reads-modifies-writes every micro-step is bf16 (half the
+            # HBM bytes of the fp32 scheme); an fp32 carry absorbs it
+            # every ``fold`` micro-steps so at most ``fold`` bf16 adds
+            # compound before a fold (fold <= 8 -> ~fold * 2^-9 relative
+            # carry error, gated by tests/test_grad_accum_bf16_carry.py).
+            # Traffic per micro-step drops from 2x fp32-bytes to
+            # 2x bf16-bytes + (2/fold)x fp32-bytes ≈ 5/8 at fold=8.
+            fold = _accum_fold(accum_steps)
+            ids_c = input_ids.reshape(
+                (accum_steps // fold, fold) + input_ids.shape[1:])
+            lab_c = labels.reshape(
+                (accum_steps // fold, fold) + labels.shape[1:])
+
+            def micro_lo(acc16, xs):
+                mids, mlabels = xs
+                loss, g = grad_fn(params, mids, mlabels, None)
+                acc16 = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(accum_dtype), acc16, g)
+                return acc16, loss
+
+            def fold_step(acc32, xs):
+                zero16 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, accum_dtype), params)
+                acc16, losses = jax.lax.scan(micro_lo, zero16, xs)
+                acc32 = jax.tree_util.tree_map(
+                    lambda c, a: c + a.astype(jnp.float32), acc32, acc16)
+                return acc32, losses
+
+            acc, losses = jax.lax.scan(fold_step, zero, (ids_c, lab_c))
+            grads = jax.tree_util.tree_map(lambda a: a / accum_steps, acc)
+            mean_loss = losses.mean()
+        elif attention_mask is None:
             acc, losses = jax.lax.scan(micro_step, zero,
                                        (input_ids, labels))
             grads = jax.tree_util.tree_map(lambda a: a / accum_steps, acc)
             mean_loss = losses.mean()
         else:
+            # masked accumulation stays fp32: token-weighted partial sums
+            # span the full accum window (wsum-scaled), so a bounded-depth
+            # bf16 carry has no clean fold point; the headline bench runs
+            # the unmasked path
             (acc, wsum), wlosses = jax.lax.scan(
                 micro_step_masked, (zero, jnp.zeros((), jnp.float32)),
                 (input_ids, labels, attention_mask))
             wsum = jnp.maximum(wsum, 1.0)  # guard only the TOTAL
             grads = jax.tree_util.tree_map(lambda a: a / wsum, acc)
             mean_loss = wlosses.sum() / wsum
-        new_params, new_opt_state = optimizer.apply(
-            params, grads, opt_state, lr, step_no + 1,
-            decay_mask={n: n not in no_decay for n in names})
+        new_params, new_opt_state = apply_update(params, grads, opt_state,
+                                                 lr, step_no)
         return mean_loss, new_params, new_opt_state
 
     fn = step_fn if accum_steps <= 1 else accum_step_fn

@@ -56,6 +56,8 @@ HYBRID_AXES = ("pp", "dp", "sharding", "sep", "mp")
 _LAYER_PREFIX = "model.layers."
 
 
+from ..common.jax_compat import axis_size as _axis_size
+
 def hybrid_mesh(devices, pp=1, dp=1, sharding=1, sep=1, mp=1) -> Mesh:
     """Build the 5-axis hybrid mesh (reference: topology.py:189 order
     pp->dp->sharding->sep->mp, outermost..innermost so mp rides the
@@ -337,10 +339,12 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         # only the last stage holds real outputs; broadcast across pp so
         # the replicated-out-spec read is valid on every rank
         is_last = (lax.axis_index(pp_axis)
-                   == lax.axis_size(pp_axis) - 1).astype(outs.dtype)
+                   == _axis_size(pp_axis) - 1).astype(outs.dtype)
         return _wire_in(_compat.psum(outs * is_last, pp_axis))
 
-    shmap = jax.shard_map(
+    from ..common.jax_compat import shard_map as _shard_map
+
+    shmap = _shard_map(
         pipeline_body, mesh=mesh, axis_names={pp_axis, sep_axis},
         in_specs=(P("pp"), P(None, None, sep_entry, None),
                   P(sep_entry, None), P(sep_entry, None)),
@@ -433,7 +437,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         hgrads = jax.tree_util.tree_map(_wire_in, hgrads)
         return loss, sgrads, hgrads, _wire_in(dxs)
 
-    shmap_sched = jax.shard_map(
+    shmap_sched = _shard_map(
         pipeline_body_sched, mesh=mesh,
         axis_names={pp_axis, sep_axis, "dp"},
         in_specs=(P("pp"), P(None, dp_entry, sep_entry, None),
@@ -570,7 +574,9 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                     donate_argnums=(0, 1))
 
     def step(params, opt_state, step_no, lr, input_ids, labels):
-        with jax.sharding.set_mesh(mesh):
+        from ..common.jax_compat import set_mesh as _set_mesh
+
+        with _set_mesh(mesh):
             return jstep(params, opt_state, step_no, lr, input_ids, labels)
 
     return step

@@ -275,6 +275,127 @@ class Adam(Optimizer):
         return new_master.astype(value.dtype), new_state
 
 
+    # ---- fused multi-tensor (flat) path — round-7 ----------------------
+    #
+    # The per-param ``apply`` emits one update chain per tensor; at the
+    # bench shape that is ~100 small fusions whose launch latency (not
+    # bandwidth) dominates the ~25 ms optimizer slice (BASELINE.md r5
+    # attribution).  The flat path groups float params by
+    # (decay?, dtype), keeps moment1/moment2/master as ONE flat fp32
+    # buffer per group, and runs the whole AdamW update as a single
+    # bandwidth-bound pass per group; XLA fuses the gather (concatenate)
+    # of grads and the scatter (slices) of new params into the same
+    # fusion, so no extra materialized copies ride along.  Grouping is
+    # recomputed from (sorted keys, dtypes, decay_mask) at trace time —
+    # all static — so the state carries no python metadata.
+    #
+    # Scope: the functional/jit path only (build_train_step detects a
+    # flat state via ``state_is_flat`` and calls ``apply_flat``).  The
+    # eager ``step()``, per-param regularizer overrides, and lr_ratio
+    # stay on the per-param path — ``apply_flat`` rejects those configs
+    # loudly instead of silently diverging.
+
+    def _flat_groups(self, params, decay_mask=None):
+        """Deterministic float-param grouping: list of dicts with keys
+        ``name/keys/shapes/sizes/dtype/decay`` (sorted, so init and
+        every subsequent apply agree)."""
+        by_group: Dict[Any, List[str]] = {}
+        for k in sorted(params):
+            v = params[k]
+            if not jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
+                continue
+            decay = True if decay_mask is None else bool(
+                decay_mask.get(k, True))
+            by_group.setdefault((decay, str(jnp.asarray(v).dtype)),
+                                []).append(k)
+        out = []
+        for (decay, dt), keys in sorted(by_group.items()):
+            shapes = [tuple(jnp.asarray(params[k]).shape) for k in keys]
+            sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+            out.append({"name": ("decay" if decay else "nodecay") + "|" + dt,
+                        "keys": keys, "shapes": shapes, "sizes": sizes,
+                        "dtype": dt, "decay": decay})
+        return out
+
+    def init_flat_state(self, params, decay_mask=None, master_from=None):
+        """Flat per-group state: {'__flat__': {group: {moment1, moment2
+        [, master]}}}.  ``master_from`` optionally seeds fp32 masters
+        from UNROUNDED source values (bench.py casts params to bf16 at
+        rest but wants exact fp32 masters)."""
+        st = {}
+        for g in self._flat_groups(params, decay_mask):
+            n = sum(g["sizes"])
+            gs = {"moment1": jnp.zeros((n,), jnp.float32),
+                  "moment2": jnp.zeros((n,), jnp.float32)}
+            if self._multi_precision and g["dtype"] != "float32":
+                src = master_from if master_from is not None else params
+                gs["master"] = jnp.concatenate(
+                    [jnp.asarray(src[k]).astype(jnp.float32).reshape(-1)
+                     for k in g["keys"]]) if g["keys"] else \
+                    jnp.zeros((0,), jnp.float32)
+            st[g["name"]] = gs
+        return {"__flat__": st}
+
+    @staticmethod
+    def state_is_flat(state) -> bool:
+        return isinstance(state, dict) and set(state) == {"__flat__"}
+
+    def apply_flat(self, params, grads, state, lr, step: int = 0,
+                   decay_mask: Optional[Dict[str, bool]] = None):
+        """Fused multi-tensor Adam/AdamW update over flat groups.
+        Returns (new_params, new_state) with new_state flat again."""
+        if not self.state_is_flat(state):
+            raise ValueError("apply_flat needs a state from "
+                             "init_flat_state (got per-param pytree)")
+        if self._regularizer is not None:
+            raise NotImplementedError(
+                "apply_flat: optimizer-level regularizer instances ride "
+                "the per-param apply; pass weight_decay as a float")
+        groups = self._flat_groups(params, decay_mask)
+        missing = [k for g in groups for k in g["keys"]
+                   if grads.get(k) is None]
+        if missing:
+            raise ValueError(
+                f"apply_flat: every grouped param needs a gradient "
+                f"(missing: {missing[:3]}...); frozen params belong on "
+                f"the per-param apply path")
+        new_params = dict(params)
+        new_flat = {}
+        for g in groups:
+            gs = state["__flat__"][g["name"]]
+            gflat = jnp.concatenate(
+                [jnp.asarray(grads[k]).astype(jnp.float32).reshape(-1)
+                 for k in g["keys"]])
+            master = gs.get("master")
+            if master is None:
+                master = jnp.concatenate(
+                    [jnp.asarray(params[k]).astype(jnp.float32)
+                     .reshape(-1) for k in g["keys"]])
+            wd = self._weight_decay if g["decay"] else 0.0
+            gg = gflat + wd * master if (wd and not self._decoupled) \
+                else gflat
+            m1 = self._beta1 * gs["moment1"] + (1 - self._beta1) * gg
+            m2 = self._beta2 * gs["moment2"] + (1 - self._beta2) \
+                * jnp.square(gg)
+            bc1 = 1 - self._beta1 ** step
+            bc2 = 1 - self._beta2 ** step
+            update = (m1 / bc1) / (jnp.sqrt(m2 / bc2) + self._eps)
+            if wd and self._decoupled:
+                update = update + wd * master
+            new_master = master - lr * update
+            ngs = {"moment1": m1, "moment2": m2}
+            if "master" in gs:
+                ngs["master"] = new_master
+            new_flat[g["name"]] = ngs
+            off = 0
+            out_dtype = jnp.dtype(g["dtype"])
+            for k, shape, size in zip(g["keys"], g["shapes"], g["sizes"]):
+                new_params[k] = new_master[off:off + size].reshape(
+                    shape).astype(out_dtype)
+                off += size
+        return new_params, {"__flat__": new_flat}
+
+
 class AdamW(Adam):
     """Decoupled weight decay (analog of python/paddle/optimizer/adamw.py:49)."""
 

@@ -27,6 +27,8 @@ from jax import lax
 from . import compat as _compat
 
 
+from ..common.jax_compat import axis_size as _axis_size
+
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
                    axis: str = "pp", num_microbatches: int | None = None,
                    squeeze_stage_dim: bool = True):
@@ -43,7 +45,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
     Returns [M, mb, ...] outputs (valid on the LAST stage; other devices
         hold zeros — callers usually ppermute/psum or read stage P-1).
     """
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     me = lax.axis_index(axis)
     m = x.shape[0] if num_microbatches is None else num_microbatches
     ticks = m + p - 1
@@ -172,7 +174,7 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable, sched,
     """
     from .schedules import BWD, BWDW, BWDX, FWD
 
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     me = lax.axis_index(axis)
     assert p == sched.p, f"schedule built for p={sched.p}, mesh has {p}"
     m, v = sched.m, sched.v

@@ -37,6 +37,8 @@ from jax import lax
 from . import compat as _compat
 
 
+from ..common.jax_compat import axis_size as _axis_size
+
 def _interpret():
     return jax.default_backend() == "cpu"
 
@@ -70,7 +72,7 @@ def _hop_branch(src, me):
 
 def _ring_forward_loop(q, k, v, axis, causal, scale):
     """Returns (o [b,s,h,d] float32, lse_global [b,h,s,1] float32)."""
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     me = lax.axis_index(axis)
     b, sl, h, d = q.shape
 
@@ -147,7 +149,7 @@ def _ring_bwd(axis, causal, scale, res, g):
                                               _to_bh)
 
     q, k, v, o, lse = res
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     me = lax.axis_index(axis)
     b, sl, h, d = q.shape
     kvh = k.shape[2]

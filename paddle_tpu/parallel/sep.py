@@ -25,6 +25,8 @@ from jax import lax
 from . import compat as _compat
 
 
+from ..common.jax_compat import axis_size as _axis_size
+
 def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = True,
                       scale: Optional[float] = None):
     """Attention for seq-sharded q/k/v inside a shard_map body.
@@ -32,7 +34,7 @@ def ulysses_attention(q, k, v, axis: str = "sep", causal: bool = True,
     q: [b, s_local, h, d]; k,v: [b, s_local, kvh, d].  Requires h and kvh
     divisible by the axis size.  Returns [b, s_local, h, d].
     """
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     b, sl, h, d = q.shape
     kvh = k.shape[2]
     if h % p or kvh % p:

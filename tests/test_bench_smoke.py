@@ -18,6 +18,10 @@ def test_bench_smoke_green():
     # each leg reports ok + optional error detail; assert them
     # individually so a regression names its leg
     for leg in ("serving_pipeline_parity", "varlen_auto_dispatch",
-                "paged_multipage_kernel", "int8_weight_serving"):
+                "paged_multipage_kernel", "int8_weight_serving",
+                # round-7 training-hot-path legs: accum scan (bf16
+                # carry) + fused flat AdamW vs full-batch legacy, and
+                # flash fwd+bwd (head-batched default) in interpret mode
+                "train_accum_fused_step", "flash_fwdbwd_interpret"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]

@@ -10,6 +10,7 @@ import pytest
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import Partial, Replicate, Shard
+from paddle_tpu.common.jax_compat import shard_map  # jax 0.4.x compat
 
 
 def test_process_mesh_basics():
@@ -96,7 +97,7 @@ def test_functional_collectives_shard_map():
     def ar(v):
         return F.all_reduce(v, axis="g")
 
-    out = jax.jit(jax.shard_map(ar, mesh=mesh, in_specs=(P("g"),),
+    out = jax.jit(shard_map(ar, mesh=mesh, in_specs=(P("g"),),
                                 out_specs=P("g")))(x)
     np.testing.assert_allclose(np.asarray(out), np.full((8,), 28.0))
 
@@ -105,7 +106,7 @@ def test_functional_collectives_shard_map():
 
     # all_gather output is typed axis-varying in jax's vma system even
     # though its value is replicated — check_vma=False asserts our intent
-    out = jax.jit(jax.shard_map(ag, mesh=mesh, in_specs=(P("g"),),
+    out = jax.jit(shard_map(ag, mesh=mesh, in_specs=(P("g"),),
                                 out_specs=P(None), check_vma=False))(x)
     np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
 
@@ -113,7 +114,7 @@ def test_functional_collectives_shard_map():
         return F.reduce_scatter(v, axis="g", scatter_dim=0)
 
     y = jnp.ones((8, 8))
-    out = jax.jit(jax.shard_map(rs, mesh=mesh, in_specs=(P(None, None),),
+    out = jax.jit(shard_map(rs, mesh=mesh, in_specs=(P(None, None),),
                                 out_specs=P("g", None)))(y)
     np.testing.assert_allclose(np.asarray(out), np.full((8, 8), 8.0))
 
@@ -123,21 +124,21 @@ def test_functional_collectives_shard_map():
     # each rank holds (8, 1); after a2a over split_dim=0/concat_dim=1 each
     # rank holds (1, 8) = its row of the global matrix transpose-of-chunks
     z = jnp.arange(64.0).reshape(8, 8)
-    out = jax.jit(jax.shard_map(a2a, mesh=mesh, in_specs=(P(None, "g"),),
+    out = jax.jit(shard_map(a2a, mesh=mesh, in_specs=(P(None, "g"),),
                                 out_specs=P("g", None)))(z)
     np.testing.assert_allclose(np.asarray(out), np.asarray(z))
 
     def bc(v):
         return F.broadcast(v, src=3, axis="g")
 
-    out = jax.jit(jax.shard_map(bc, mesh=mesh, in_specs=(P("g"),),
+    out = jax.jit(shard_map(bc, mesh=mesh, in_specs=(P("g"),),
                                 out_specs=P("g")))(x)
     np.testing.assert_allclose(np.asarray(out), np.full((8,), 3.0))
 
     def sh(v):
         return F.shift(v, offset=1, axis="g")
 
-    out = jax.jit(jax.shard_map(sh, mesh=mesh, in_specs=(P("g"),),
+    out = jax.jit(shard_map(sh, mesh=mesh, in_specs=(P("g"),),
                                 out_specs=P("g")))(x)
     # rank i sends to i+1 → output[i] = x[i-1]
     np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))

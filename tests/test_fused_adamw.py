@@ -1,0 +1,132 @@
+"""Fused multi-tensor AdamW (round-7 tentpole): apply_flat over
+(decay?, dtype) flat param groups must reproduce the per-param apply
+bit-for-bit-close, across mixed dtypes, decay masks, and multiple steps;
+build_train_step must route a flat opt_state through it."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer.optimizer import AdamW
+
+
+def _params(seed=0, with_bf16=True):
+    rng = np.random.default_rng(seed)
+    p = {
+        "layers.0.w": jnp.asarray(rng.standard_normal((16, 8)), jnp.float32),
+        "layers.0.norm.weight": jnp.ones((8,), jnp.float32),
+        "layers.1.w": jnp.asarray(rng.standard_normal((8, 8)), jnp.float32),
+        "head.bias": jnp.zeros((8,), jnp.float32),
+        "step_count": jnp.asarray(3, jnp.int32),   # non-float passthrough
+    }
+    if with_bf16:
+        p["layers.0.w"] = p["layers.0.w"].astype(jnp.bfloat16)
+        p["layers.1.w"] = p["layers.1.w"].astype(jnp.bfloat16)
+    return p
+
+
+def _grads(params, seed=1):
+    rng = np.random.default_rng(seed)
+    return {k: jnp.asarray(rng.standard_normal(v.shape), v.dtype)
+            for k, v in params.items()
+            if jnp.issubdtype(v.dtype, jnp.floating)}
+
+
+DECAY = {"layers.0.w": True, "layers.1.w": True,
+         "layers.0.norm.weight": False, "head.bias": False}
+
+
+def test_flat_matches_per_param_over_steps():
+    opt = AdamW(learning_rate=1e-3, weight_decay=0.05)
+    params = _params()
+    st_ref = opt.init_state({k: v for k, v in params.items()
+                             if jnp.issubdtype(v.dtype, jnp.floating)})
+    st_flat = opt.init_flat_state(params, decay_mask=DECAY)
+
+    p_ref = dict(params)
+    p_flat = dict(params)
+    for step in range(1, 4):
+        g = _grads(params, seed=step)
+        p_ref_f = {k: v for k, v in p_ref.items()
+                   if jnp.issubdtype(v.dtype, jnp.floating)}
+        p_ref_new, st_ref = opt.apply(p_ref_f, g, st_ref, 1e-3, step,
+                                      decay_mask=DECAY)
+        p_ref.update(p_ref_new)
+        p_flat, st_flat = opt.apply_flat(p_flat, g, st_flat, 1e-3, step,
+                                         decay_mask=DECAY)
+        for k in p_ref_new:
+            np.testing.assert_allclose(
+                np.asarray(p_flat[k], np.float32),
+                np.asarray(p_ref[k], np.float32),
+                rtol=1e-6, atol=1e-7, err_msg=f"{k} step {step}")
+    # non-float params pass through untouched
+    assert int(p_flat["step_count"]) == 3
+
+
+def test_flat_state_structure_and_masters():
+    opt = AdamW(learning_rate=1e-3, weight_decay=0.01)
+    params = _params()
+    st = opt.init_flat_state(params, decay_mask=DECAY)
+    assert AdamW.state_is_flat(st)
+    assert not AdamW.state_is_flat(opt.init_state(
+        {"w": jnp.zeros((2,), jnp.float32)}))
+    flat = st["__flat__"]
+    # bf16 decay group carries an fp32 master; fp32 groups do not
+    assert "master" in flat["decay|bfloat16"]
+    assert flat["decay|bfloat16"]["master"].dtype == jnp.float32
+    assert "master" not in flat["nodecay|float32"]
+    # master_from seeds masters from unrounded values
+    src = {"layers.0.w": jnp.full((16, 8), 1.0009765625, jnp.float32),
+           "layers.1.w": jnp.zeros((8, 8), jnp.float32)}
+    st2 = opt.init_flat_state(params, decay_mask=DECAY, master_from=src)
+    m = np.asarray(st2["__flat__"]["decay|bfloat16"]["master"])
+    assert np.any(m == np.float32(1.0009765625))
+
+
+def test_flat_missing_grad_rejected():
+    opt = AdamW(learning_rate=1e-3)
+    params = _params(with_bf16=False)
+    st = opt.init_flat_state(params, decay_mask=DECAY)
+    g = _grads(params)
+    g.pop("head.bias")
+    with pytest.raises(ValueError, match="gradient"):
+        opt.apply_flat(params, g, st, 1e-3, 1, decay_mask=DECAY)
+
+
+def test_train_step_routes_flat_state():
+    """build_train_step with a flat opt_state must run apply_flat and
+    match the legacy per-param step."""
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   build_train_step)
+    from paddle_tpu.models.llama import llama_decay_mask
+
+    paddle.seed(11)
+    cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=1, heads=2,
+                            kv_heads=1, inter=64, max_pos=64)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    params = model.functional_state()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    lab = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+
+    def deep(t):
+        return jax.tree_util.tree_map(jnp.copy, t)
+
+    step = build_train_step(model, opt, compute_dtype=jnp.float32)
+    l_ref, p_ref, _ = step(deep(params), opt.init_state(deep(params)),
+                           0, 1e-3, ids, lab)
+    mask = llama_decay_mask(model)
+    l_flat, p_flat, st_flat = step(
+        deep(params), opt.init_flat_state(deep(params), decay_mask=mask),
+        0, 1e-3, ids, lab)
+    np.testing.assert_allclose(float(l_flat), float(l_ref), rtol=1e-6)
+    assert AdamW.state_is_flat(st_flat)
+    for k in p_ref:
+        np.testing.assert_allclose(np.asarray(p_flat[k]),
+                                   np.asarray(p_ref[k]),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)

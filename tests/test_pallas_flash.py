@@ -458,13 +458,11 @@ def test_varlen_skip_fraction_beats_dense():
     assert frac >= 0.3, frac
 
 
-def test_head_batched_optin_parity(monkeypatch):
-    """The opt-in head-batched GQA kernels (PADDLE_TPU_FLASH_HEAD_BATCHED):
-    fwd+bwd parity with the default per-head path.  Kept opt-in — see the
-    routing note in flash_attention_raw (loop-embedding crashes the
-    current tunnel compile helper despite standalone-jit correctness)."""
-    import os
-
+def test_head_batched_default_parity(monkeypatch):
+    """The head-batched GQA kernels are the DEFAULT for unmasked dense
+    calls (round-7, post root-cause fix): fwd+bwd parity with the
+    per-head path, plus the PADDLE_TPU_FLASH_HEAD_BATCHED=0 kill switch
+    routing back to the per-head kernels."""
     import jax
 
     from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
@@ -479,9 +477,8 @@ def test_head_batched_optin_parity(monkeypatch):
         return jnp.sum(flash_attention_raw(q, k, v, causal=True)
                        .astype(jnp.float32) ** 2)
 
-    monkeypatch.delenv("PADDLE_TPU_FLASH_HEAD_BATCHED", raising=False)
-    base = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-    monkeypatch.setenv("PADDLE_TPU_FLASH_HEAD_BATCHED", "1")
+    # opt-OUT: env=0 must route the per-head kernels
+    monkeypatch.setenv("PADDLE_TPU_FLASH_HEAD_BATCHED", "0")
     from paddle_tpu.ops.pallas import flash_attention as FA
 
     calls = []
@@ -492,8 +489,13 @@ def test_head_batched_optin_parity(monkeypatch):
         return real(*a, **kw)
 
     monkeypatch.setattr(FA, "_flash_hb", spy)
+    base = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert not calls, "kill switch ignored: HB path taken under env=0"
+
+    # default (no env): HB path must be taken and match
+    monkeypatch.delenv("PADDLE_TPU_FLASH_HEAD_BATCHED", raising=False)
     hb = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-    assert calls, "HB path was not taken despite the opt-in env"
+    assert calls, "HB path was not taken by default"
     for a, b_ in zip(hb, base):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-4, atol=2e-5)

@@ -11,6 +11,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import paddle_tpu as paddle
 from paddle_tpu.ops.pallas.flash_attention import _attn_reference
+from paddle_tpu.common.jax_compat import shard_map  # jax 0.4.x compat
 
 
 def _mesh1d(n, name):
@@ -35,7 +36,7 @@ def test_ring_attention_exact(causal):
     spec = P(None, "sep", None, None)
     # check_vma=False: pallas_call in interpret mode mishandles vma typing
     # (jax suggests this workaround; compiled TPU path unaffected)
-    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+    out = jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec, check_vma=False))(q, k, v)
     ref = _attn_reference(q, k, v, causal, 1.0 / math.sqrt(d))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -56,7 +57,7 @@ def test_ring_attention_grad_exact(causal, kvh):
     v = jnp.asarray(rng.randn(b, s, kvh, d).astype(np.float32)) * 0.3
 
     spec = P(None, "sep", None, None)
-    ring = jax.shard_map(
+    ring = shard_map(
         lambda q, k, v: ring_flash_attention(q, k, v, axis="sep",
                                              causal=causal),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
@@ -93,7 +94,7 @@ def test_ulysses_attention_exact():
         return ulysses_attention(q, k, v, axis="sep", causal=True)
 
     spec = P(None, "sep", None, None)
-    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+    out = jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec, check_vma=False))(q, k, v)
     ref = _attn_reference(q, k, v, True, 1.0 / math.sqrt(d))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -127,7 +128,7 @@ def test_pipeline_apply_matches_sequential():
         is_last = (jax.lax.axis_index("pp") == P_STAGES - 1).astype(outs.dtype)
         return jax.lax.psum(outs * is_last, "pp")
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=({"w": P("pp", None, None)}, P(None)),
         out_specs=P(None)))(stacked, x)

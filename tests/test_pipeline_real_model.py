@@ -22,6 +22,7 @@ from paddle_tpu.models.llama_hybrid import _decoder_layer, _rope_tables
 from paddle_tpu.parallel.pipelining import (pipeline_train_step,
                                             stack_stage_params)
 from paddle_tpu.parallel.schedules import build_schedule
+from paddle_tpu.common.jax_compat import shard_map  # jax 0.4.x compat
 
 PP, M, MB, S = 4, 4, 2, 8
 
@@ -80,7 +81,7 @@ def test_decoder_layer_pipeline_parity(name):
         return pipeline_train_step(stage_fn, loss_fn, sched, sp, x, y,
                                    axis="pp")
 
-    loss, grads = jax.jit(jax.shard_map(
+    loss, grads = jax.jit(shard_map(
         body, mesh=_mesh(), in_specs=(pspec, P(None), P(None)),
         out_specs=(P(), pspec), check_vma=False))(stacked, x, y)
 

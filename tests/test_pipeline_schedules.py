@@ -14,6 +14,7 @@ from paddle_tpu.parallel.pipelining import (pipeline_train_step,
                                             stack_stage_params,
                                             stack_stage_params_interleaved)
 from paddle_tpu.parallel.schedules import build_schedule
+from paddle_tpu.common.jax_compat import shard_map  # jax 0.4.x compat
 
 PP = 4
 M = 8          # micro-batches
@@ -76,7 +77,7 @@ def _run_sched(name, v=1):
         return pipeline_train_step(_stage_fn, _loss_fn, sched, sp, x, y,
                                    axis="pp")
 
-    loss, grads = jax.jit(jax.shard_map(
+    loss, grads = jax.jit(shard_map(
         body, mesh=_mesh(), in_specs=(pspec, P(None), P(None)),
         out_specs=(P(), pspec), check_vma=False))(stacked, x, y)
 

@@ -1,0 +1,178 @@
+"""Tensor METHOD surface parity (round-7 satellite; VERDICT r5 put it at
+107/385 of the reference's tensor_method_func list).
+
+Companion of tests/test_namespace_parity.py, same contract: the sweep
+asserts every snapshotted method name resolves on Tensor, justified
+exclusions live in an exemption table with their decision records, and
+an exempted name that starts resolving fails the sweep (stale-exemption
+guard).  The name list is SNAPSHOTTED here (reference
+python/paddle/tensor/__init__.py tensor_method_func) so the test runs
+without the reference tree — resolution is asserted against this repo's
+Tensor, behavior against spot anchors below."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+# Snapshot of the reference tensor_method_func names this build wires
+# (the round-5 107 + the round-7 tranche: >=30 elementwise/reduction/
+# inplace additions).  Grouped as in ops/tensor_methods.py.
+_REQUIRED_METHODS = [
+    # ---- pre-round-7 core (spot sample of the 107) ----
+    "add", "subtract", "multiply", "divide", "pow", "matmul", "exp",
+    "log", "sqrt", "rsqrt", "square", "abs", "sign", "reciprocal",
+    "floor", "ceil", "round", "trunc", "sin", "cos", "tanh", "sigmoid",
+    "erf", "clip", "maximum", "minimum", "sum", "mean", "max", "min",
+    "prod", "std", "var", "median", "logsumexp", "all", "any", "argmax",
+    "argmin", "cumsum", "cumprod", "isnan", "isinf", "isfinite",
+    "reshape", "flatten", "squeeze", "unsqueeze", "transpose", "tile",
+    "expand", "flip", "roll", "gather", "scatter", "index_select",
+    "masked_fill", "sort", "argsort", "topk", "split", "chunk", "tril",
+    "triu", "where", "concat", "stack", "cast", "astype", "numpy",
+    "item", "tolist", "clone", "detach", "numel",
+    # ---- round-7 tranche: elementwise ----
+    "expm1", "atan2", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_not",
+    "bitwise_xor", "neg", "floor_divide", "mod", "remainder", "frac",
+    "deg2rad", "rad2deg", "hypot", "copysign", "gcd", "lcm", "logit",
+    "i0", "sinc", "heaviside", "fmax", "fmin", "logaddexp", "nextafter",
+    "ldexp", "lerp", "nan_to_num", "signbit", "sgn", "isreal",
+    # ---- round-7 tranche: reductions / scans ----
+    "nansum", "nanmean", "nanmedian", "amax", "amin", "count_nonzero",
+    "diff", "cummax", "cummin", "kthvalue", "mode", "quantile",
+    "nanquantile", "bincount", "histogram", "trace", "logcumsumexp",
+    # ---- round-7 tranche: indexing / selection ----
+    "nonzero", "masked_select", "take", "take_along_axis",
+    "put_along_axis", "index_add", "index_fill", "index_put",
+    "bucketize", "searchsorted", "unique", "unique_consecutive",
+    "masked_scatter", "index_sample",
+    # ---- round-7 tranche: linalg-flavoured ----
+    "outer", "inner", "cross", "cov", "corrcoef", "renorm", "tensordot",
+    # ---- round-7 tranche: in-place methods ----
+    "abs_", "add_", "subtract_", "multiply_", "divide_", "clip_",
+    "exp_", "sqrt_", "rsqrt_", "square_", "sin_", "cos_", "tan_",
+    "tanh_", "sigmoid_", "ceil_", "floor_", "round_", "trunc_", "frac_",
+    "reciprocal_", "neg_", "log_", "log2_", "log10_", "erf_", "expm1_",
+    "pow_", "remainder_", "mod_", "floor_divide_", "scale_", "zero_",
+    "fill_", "cast_", "lgamma_", "digamma_", "logical_not_",
+    "bitwise_not_", "where_", "flatten_", "reshape_", "squeeze_",
+    "unsqueeze_", "transpose_", "tril_", "triu_", "masked_fill_",
+]
+
+# Reference tensor_method_func names DELIBERATELY not provided, with the
+# decision record (same contract as test_namespace_parity's
+# _SUBMODULE_EXEMPT): an empty value would assert full parity.
+_METHOD_EXEMPT = {
+    "uniform_": "random FILL semantics need the op-level RNG key plumb "
+                "(bernoulli_/normal_ shipped; uniform_ tracked for the "
+                "next tranche)",
+    "coalesce": "sparse-COO method; sparse Tensors live in paddle.sparse "
+                "with their own classes here",
+    "rows": "SelectedRows carrier method — selected-rows is emulated at "
+            "the op layer (strings_selected_rows), not on dense Tensor",
+    "value": "SelectedRows carrier method (see rows)",
+    "set_string_list": "string-tensor plumbing: strings ride "
+                       "paddle_tpu.strings pseudo-tensors",
+}
+
+
+def test_required_methods_resolve():
+    missing = [n for n in _REQUIRED_METHODS if not hasattr(Tensor, n)]
+    assert not missing, (f"{len(missing)} Tensor methods missing: "
+                         f"{sorted(missing)}")
+
+
+def test_exemptions_not_stale():
+    stale = [n for n in _METHOD_EXEMPT if hasattr(Tensor, n)]
+    assert not stale, ("exempted methods now resolve — drop them from "
+                       "_METHOD_EXEMPT", stale)
+    overlap = set(_METHOD_EXEMPT) & set(_REQUIRED_METHODS)
+    assert not overlap, ("a name cannot be both required and exempt",
+                         overlap)
+
+
+def test_elementwise_method_values():
+    t = paddle.to_tensor(np.array([0.5, -1.5, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(t.expm1()._value),
+                               np.expm1([0.5, -1.5, 2.0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.neg()._value),
+                               [-0.5, 1.5, -2.0])
+    other = paddle.to_tensor(np.array([1.0, 1.0, 1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(t.atan2(other)._value),
+                               np.arctan2([0.5, -1.5, 2.0], [1, 1, 1]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.fmax(other)._value),
+                               [1.0, 1.0, 2.0])
+    i = paddle.to_tensor(np.array([4, 6], np.int64))
+    j = paddle.to_tensor(np.array([6, 4], np.int64))
+    np.testing.assert_array_equal(np.asarray(i.gcd(j)._value), [2, 2])
+
+
+def test_reduction_method_values():
+    t = paddle.to_tensor(np.array([[1.0, np.nan, 3.0],
+                                   [2.0, 4.0, np.nan]], np.float32))
+    np.testing.assert_allclose(np.asarray(t.nansum()._value), 10.0)
+    np.testing.assert_allclose(np.asarray(t.nanmean()._value), 2.5)
+    d = paddle.to_tensor(np.array([1.0, 4.0, 9.0], np.float32))
+    np.testing.assert_allclose(np.asarray(d.diff()._value), [3.0, 5.0])
+    c = paddle.to_tensor(np.array([0.0, 1.0, 0.0, 2.0], np.float32))
+    assert int(np.asarray(c.count_nonzero()._value)) == 2
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(np.asarray(m.amax()._value), 5.0)
+
+
+def test_inplace_methods_mutate_and_return_self():
+    t = paddle.to_tensor(np.array([1.0, -4.0], np.float32))
+    r = t.abs_()
+    assert r is t
+    np.testing.assert_allclose(np.asarray(t._value), [1.0, 4.0])
+    r = t.add_(paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+    assert r is t
+    np.testing.assert_allclose(np.asarray(t._value), [2.0, 5.0])
+    r = t.clip_(0.0, 3.0)
+    assert r is t
+    np.testing.assert_allclose(np.asarray(t._value), [2.0, 3.0])
+    r = t.zero_()
+    assert r is t
+    np.testing.assert_allclose(np.asarray(t._value), [0.0, 0.0])
+    r = t.fill_(7.0)
+    assert r is t
+    np.testing.assert_allclose(np.asarray(t._value), [7.0, 7.0])
+
+    # tape guard: in-place on a grad-requiring tensor under tape raises
+    g = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+    g.stop_gradient = False
+    with pytest.raises(RuntimeError):
+        g.exp_()
+
+
+def test_indexing_method_values():
+    t = paddle.to_tensor(np.array([[1.0, 9.0], [3.0, 4.0]], np.float32))
+    mask = paddle.to_tensor(np.array([[True, False], [False, True]]))
+    np.testing.assert_allclose(np.asarray(t.masked_select(mask)._value),
+                               [1.0, 4.0])
+    nz = np.asarray(paddle.to_tensor(
+        np.array([0.0, 5.0, 0.0, 2.0], np.float32)).nonzero()._value)
+    np.testing.assert_array_equal(nz.reshape(-1), [1, 3])
+    edges = paddle.to_tensor(np.array([2.0, 4.0, 6.0], np.float32))
+    x = paddle.to_tensor(np.array([1.0, 3.0, 7.0], np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(x.bucketize(edges)._value), [0, 1, 3])
+
+
+def test_method_count_tranche():
+    """The round-7 tranche satisfies the >=30-new-names floor (ISSUE 2
+    satellite) over the round-5 surface."""
+    new_names = [n for n in _REQUIRED_METHODS
+                 if n.endswith("_") or n in (
+                     "expm1", "atan2", "nansum", "nanmean", "nanmedian",
+                     "amax", "amin", "count_nonzero", "diff", "cummax",
+                     "cummin", "hypot", "copysign", "gcd", "lcm",
+                     "heaviside", "fmax", "fmin", "logaddexp",
+                     "nextafter", "ldexp", "lerp", "frac", "deg2rad",
+                     "rad2deg")]
+    wired = [n for n in new_names if hasattr(Tensor, n)]
+    assert len(wired) >= 30, len(wired)
