@@ -974,6 +974,21 @@ def profile():
     return out
 
 
+def doctor():
+    """bench.py --doctor — run the Graph Doctor (paddle_tpu.analysis)
+    over the benched steps: every seeded-bug fixture must trigger exactly
+    its finding code, the flagship entry points (build_train_step in
+    both accum regimes, llama fwd/bwd, the serving decode chunk) must
+    report zero findings, and every tracked exemption must still match a
+    live suppressed finding.  Writes DOCTOR.json; exits non-zero from
+    the CLI on any failure (see ANALYSIS.md for the finding codes)."""
+    from paddle_tpu.analysis import self_check
+
+    res = self_check()
+    res["doctor"] = True
+    return res
+
+
 def smoke():
     """CPU-safe tier-1 gate over the serving/varlen dispatch hot paths
     (round-6 satellite: dispatch-layer regressions must fail the suite,
@@ -1153,6 +1168,21 @@ def smoke():
     except Exception as e:  # noqa: BLE001
         legs["flash_fwdbwd_interpret"] = {"ok": False, "error": repr(e)}
 
+    # 7. graph doctor (round-8): the static-analysis gate itself —
+    #    seeded-bug fixtures all fire, flagship sweeps all clean, and
+    #    the exemption table is live (ISSUE 3 acceptance: a pass that
+    #    cannot detect is indistinguishable from one that never fires)
+    try:
+        from paddle_tpu.analysis import self_check
+
+        sc = self_check()
+        detail = {sect: {k: bool(v.get("ok"))
+                         for k, v in sc.get(sect, {}).items()}
+                  for sect in ("seeded", "clean", "exemptions")}
+        legs["doctor_self_check"] = {"ok": bool(sc["ok"]), **detail}
+    except Exception as e:  # noqa: BLE001
+        legs["doctor_self_check"] = {"ok": False, "error": repr(e)}
+
     # 4. weight-only int8 params through the serving engine, checked
     #    against the int8-weight ONE-SHOT generate on the same params
     #    (int8 KV there vs fp cache here can flip rare near-ties only)
@@ -1191,6 +1221,15 @@ if __name__ == "__main__":
     if "--smoke" in sys.argv:
         res = smoke()
         print(json.dumps(res))
+        sys.exit(0 if res["ok"] else 1)
+    if "--doctor" in sys.argv:
+        res = doctor()
+        try:
+            with open("DOCTOR.json", "w") as f:
+                json.dump(res, f, indent=1, default=str)
+        except OSError:
+            pass
+        print(json.dumps(res, default=str))
         sys.exit(0 if res["ok"] else 1)
     if "--profile" in sys.argv:
         res = profile()

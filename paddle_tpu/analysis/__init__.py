@@ -1,0 +1,38 @@
+"""paddle_tpu.analysis — the Graph Doctor.
+
+A jaxpr/HLO static-analysis pass framework that gates the hot paths:
+PRs 1-2 made the train and serving steps fast by hand; this package
+keeps them fast by construction.  ``check(fn, *args)`` walks the closed
+jaxpr (and compiled HLO where needed) of an entry point and returns a
+typed findings Report; the pass suite covers the regression classes that
+silently give back the won milliseconds or deadlock a pod:
+
+- collective_order  — COLL001/COLL002: mismatched collective sequences
+  between shard_map cond branches, malformed ppermutes;
+- dtype_promotion   — DT001/DT002/DT003: silent fp32/f64 upcasts inside
+  declared-bf16 compute regions (matmuls, f64 leaks, fp32 accumulation
+  carries);
+- donation          — DON001/DON002: undonated params/opt-state on jit
+  entry points (HBM double-residency), use-after-donate aliasing;
+- retrace_sentinel  — RT001/RT002: a call-driven wrapper counting
+  compilations per signature, flagging weak-type/static-arg churn;
+- hlo_post_checks   — HLO001/HLO002: involuntary-full-rematerialization
+  compile warnings, unexpected full-param all-gathers in stage-3 steps.
+
+See ANALYSIS.md for finding codes, the exemption workflow, and
+``bench.py --doctor`` / ``python -m paddle_tpu.analysis --self-check``.
+"""
+
+from .core import (AnalysisContext, AnalysisPass, PASS_REGISTRY, SkipPass,
+                   capture_stderr, check, register_pass, resolve_passes)
+from .exemptions import EXEMPTIONS, Exemption, apply_exemptions
+from .findings import AnalysisError, Finding, Report
+from .passes import RetraceSentinel, retrace_sentinel
+from .self_check import self_check
+
+__all__ = [
+    "AnalysisContext", "AnalysisError", "AnalysisPass", "EXEMPTIONS",
+    "Exemption", "Finding", "PASS_REGISTRY", "Report", "RetraceSentinel",
+    "SkipPass", "apply_exemptions", "capture_stderr", "check",
+    "register_pass", "resolve_passes", "retrace_sentinel", "self_check",
+]

@@ -1,0 +1,30 @@
+"""CLI: ``python -m paddle_tpu.analysis --self-check``.
+
+Runs every seeded-bug fixture (each pass must produce exactly its
+intended finding code), the clean flagship sweeps (zero findings), and
+the exemption-liveness check; prints a JSON report and exits non-zero on
+any failure.  ``--seeded-only`` skips the flagship sweeps (fast mode for
+pre-commit hooks).  ``bench.py --doctor`` is the companion that runs the
+suite over the BENCHED step configurations.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--self-check" not in argv and "--seeded-only" not in argv:
+        print(__doc__)
+        return 2
+    from .self_check import self_check
+
+    res = self_check(clean="--seeded-only" not in argv)
+    print(json.dumps(res, indent=1, default=str))
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

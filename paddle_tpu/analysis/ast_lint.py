@@ -1,0 +1,199 @@
+"""AST-level repo lint: host-library leaks into traced code.
+
+Two bug classes keep re-entering jit-adjacent code by muscle memory:
+
+- AST001 — ``np.*`` calls: numpy executes on HOST at trace time.  Inside
+  a traced function the result is silently baked in as a constant (wrong
+  once inputs change) or forces a device->host transfer; inside a Pallas
+  kernel it simply crashes.  Host-side precompute (rope tables, schedule
+  math) is legitimate — that is what the allowlist records, per function,
+  with the reviewer's reasoning kept in the file.
+- AST002 — python ``if``/``while`` on tracer-suspect expressions
+  (``jnp.*``/``lax.*`` calls or ``.any()/.all()/.item()`` in the test):
+  under jit these raise ConcretizationTypeError, and the "fix" people
+  reach for (``bool(...)`` + an isinstance guard) belongs behind an
+  allowlist entry, not scattered unreviewed.
+
+Scope: ``ops/pallas/``, ``models/``, ``parallel/`` — the traced/kernel
+layers (ISSUE 3 satellite).  Run as a tier-1 pytest
+(tests/test_ast_lint.py) against the explicit allowlist
+``ast_allowlist.txt``; unused allowlist entries fail the test too, so
+the list cannot rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+LINT_DIRS = ("ops/pallas", "models", "parallel")
+NUMPY_ROOTS = ("np", "numpy")
+TRACED_ROOTS = ("jnp", "lax")
+TRACER_METHODS = ("any", "all", "item")
+# jnp.* predicates that operate on DTYPES, not values — never a tracer
+# bool, so branching on them is fine
+HOST_SAFE_ATTRS = ("issubdtype", "dtype", "result_type", "promote_types")
+ALLOWLIST_FILE = os.path.join(os.path.dirname(__file__),
+                              "ast_allowlist.txt")
+
+
+def _attr_root(node) -> Optional[str]:
+    """Root Name of a dotted attribute chain: np.linalg.norm -> 'np'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.scope: List[str] = []
+        self.findings: List[Finding] = []
+
+    # -- scope tracking -----------------------------------------------------
+
+    def _qual(self) -> str:
+        return ".".join(self.scope) if self.scope else "<module>"
+
+    def visit_FunctionDef(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    # -- AST001: np.* calls -------------------------------------------------
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Attribute) \
+                and _attr_root(node.func) in NUMPY_ROOTS:
+            self.findings.append(Finding(
+                code="AST001", pass_name="ast_lint",
+                message=(f"host numpy call {_dotted(node.func)}() in "
+                         f"traced-layer code — runs at trace time (baked "
+                         f"constant / host sync; crash under Pallas); use "
+                         f"jnp, or allowlist this function as host-side "
+                         f"precompute"),
+                where=f"{self.rel}:{node.lineno} ({self._qual()})",
+                data={"function": self._qual(), "line": node.lineno}))
+        self.generic_visit(node)
+
+    # -- AST002: python branch on tracer-suspect test -----------------------
+
+    def _tracer_suspect(self, test) -> Optional[str]:
+        for sub in ast.walk(test):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute):
+                if sub.func.attr in HOST_SAFE_ATTRS:
+                    continue
+                if _attr_root(sub.func) in TRACED_ROOTS:
+                    return _dotted(sub.func)
+                if sub.func.attr in TRACER_METHODS and not sub.args:
+                    return f".{sub.func.attr}()"
+        return None
+
+    def _check_branch(self, node, kind: str):
+        sus = self._tracer_suspect(node.test)
+        if sus is not None:
+            self.findings.append(Finding(
+                code="AST002", pass_name="ast_lint",
+                message=(f"python `{kind}` on a tracer-suspect test "
+                         f"({sus}) — raises ConcretizationTypeError under "
+                         f"jit; use lax.cond/jnp.where, or allowlist if "
+                         f"the value is provably concrete here"),
+                where=f"{self.rel}:{node.lineno} ({self._qual()})",
+                data={"function": self._qual(), "line": node.lineno}))
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, rel: str) -> List[Finding]:
+    tree = ast.parse(source, filename=rel)
+    v = _Visitor(rel)
+    v.visit(tree)
+    return v.findings
+
+
+def load_allowlist(path: str = ALLOWLIST_FILE) -> List[Tuple[str, str, str]]:
+    """Entries are ``relpath::qualname::CODE`` (comments/# and blanks
+    skipped)."""
+    entries = []
+    if not os.path.exists(path):
+        return entries
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split("::")
+            if len(parts) != 3:
+                raise ValueError(f"malformed allowlist line: {line!r} "
+                                 f"(want relpath::qualname::CODE)")
+            entries.append((parts[0], parts[1], parts[2]))
+    return entries
+
+
+def _entry_matches(entry, finding: Finding) -> bool:
+    rel, qual, code = entry
+    if code != finding.code:
+        return False
+    where = finding.where or ""
+    return where.startswith(rel + ":") \
+        and finding.data.get("function") == qual
+
+
+def lint_repo(root: Optional[str] = None,
+              dirs: Sequence[str] = LINT_DIRS,
+              allowlist: Optional[Iterable[Tuple[str, str, str]]] = None):
+    """Lint the traced-layer dirs.  Returns (active_findings,
+    allowlisted_findings, unused_allowlist_entries)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    entries = list(load_allowlist() if allowlist is None else allowlist)
+    findings: List[Finding] = []
+    for d in dirs:
+        base = os.path.join(root, d)
+        for dirpath, _, names in sorted(os.walk(base)):
+            for name in sorted(names):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, root)
+                with open(path) as f:
+                    findings.extend(lint_source(f.read(), rel))
+    active, allowed, used = [], [], set()
+    for f in findings:
+        hit = next((e for e in entries if _entry_matches(e, f)), None)
+        if hit is None:
+            active.append(f)
+        else:
+            used.add(hit)
+            f.exemption_id = "::".join(hit)
+            allowed.append(f)
+    unused = [e for e in entries if e not in used]
+    return active, allowed, unused

@@ -1,0 +1,75 @@
+"""Tracked exemptions for the Graph Doctor.
+
+An exemption is an ACCEPTED finding: the pass still detects it, but the
+report moves it to ``report.suppressed`` instead of failing the gate.
+Every entry carries an id, the finding code it covers, a source-location
+match (passes attach jaxpr eqn provenance to findings), and a reason —
+so accepted fp32 regions / undonated buffers are design decisions with a
+paper trail, not silence.  ANALYSIS.md documents the workflow; the
+self-check (``python -m paddle_tpu.analysis --self-check``) asserts each
+entry still matches a live finding, so stale exemptions rot loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from .findings import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class Exemption:
+    id: str                      # stable handle, e.g. "EX-DT003-masked-accum"
+    code: str                    # finding code this entry covers
+    file_pattern: str            # substring of the finding's source file
+    reason: str
+    function: Optional[str] = None   # optional exact function-name match
+
+    def matches(self, finding: Finding) -> bool:
+        if finding.code != self.code:
+            return False
+        where = finding.where or ""
+        if self.file_pattern not in where:
+            return False
+        if self.function is not None:
+            fns = tuple(finding.data.get("stack_functions") or ())
+            fns += (finding.data.get("function"),)
+            if self.function not in fns:
+                return False
+        return True
+
+
+# The standing table.  Add entries here (never inline in call sites) so
+# ``git log`` on this file is the history of accepted hazards.
+EXEMPTIONS: Sequence[Exemption] = (
+    Exemption(
+        id="EX-DT003-masked-grad-accum",
+        code="DT003",
+        file_pattern="models/llama.py",
+        function="micro_step_masked",
+        reason=(
+            "token-weighted gradient merge keeps an fp32 accumulator by "
+            "design: micro-grads are scaled by per-micro token counts and "
+            "partial sums span the whole accum window, so there is no "
+            "bounded-depth fold point for a bf16 carry (the unmasked path "
+            "has one and uses it).  Accepted fp32 region; the headline "
+            "bench runs unmasked.  Design note: models/llama.py "
+            "micro_step_masked."),
+    ),
+)
+
+
+def apply_exemptions(findings, exemptions=None):
+    """Split findings into (active, suppressed) under the exemption table.
+    Suppressed findings get their ``exemption_id`` stamped."""
+    table = EXEMPTIONS if exemptions is None else exemptions
+    active, suppressed = [], []
+    for f in findings:
+        hit = next((e for e in table if e.matches(f)), None)
+        if hit is None:
+            active.append(f)
+        else:
+            f.exemption_id = hit.id
+            suppressed.append(f)
+    return active, suppressed

@@ -1,0 +1,89 @@
+"""Typed findings for the Graph Doctor pass framework.
+
+A Finding is one statically-detected hazard in a compiled program (or in
+repo source, for the AST lint): a stable CODE (grep-able, documented in
+ANALYSIS.md), a severity, a human message, and enough location breadcrumbs
+(source file/function from jaxpr eqn provenance, arg path for
+donation-level findings) that the report is actionable without re-running
+the pass under a debugger.
+
+A Report is what ``paddle_tpu.analysis.check`` returns: active findings,
+suppressed findings (matched by a tracked exemption — see exemptions.py),
+and which passes ran.  ``report.ok`` is the gate the tests and
+``bench.py --doctor`` assert on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str                       # stable id, e.g. "COLL001"
+    message: str
+    severity: str = "error"
+    pass_name: str = ""
+    where: Optional[str] = None     # "models/llama.py:585 (micro_step_masked)"
+    arg_path: Optional[str] = None  # for per-argument findings (donation)
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    exemption_id: Optional[str] = None   # set when suppressed
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity {self.severity!r} not in {SEVERITIES}")
+
+    def format(self) -> str:
+        loc = f" @ {self.where}" if self.where else ""
+        ap = f" [{self.arg_path}]" if self.arg_path else ""
+        ex = f" (exempt: {self.exemption_id})" if self.exemption_id else ""
+        return f"{self.code} {self.severity.upper()}{loc}{ap}: " \
+               f"{self.message}{ex}"
+
+
+@dataclasses.dataclass
+class Report:
+    target: str                                  # label of the checked fn
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    suppressed: List[Finding] = dataclasses.field(default_factory=list)
+    passes_run: Tuple[str, ...] = ()
+    skipped: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def codes(self) -> List[str]:
+        return [f.code for f in self.findings]
+
+    def by_code(self, code: str) -> List[Finding]:
+        return [f for f in self.findings if f.code == code]
+
+    def summary(self) -> str:
+        lines = [f"doctor report for {self.target}: "
+                 f"{len(self.findings)} finding(s), "
+                 f"{len(self.suppressed)} suppressed, "
+                 f"passes={','.join(self.passes_run) or '-'}"]
+        for f in self.findings:
+            lines.append("  " + f.format())
+        for f in self.suppressed:
+            lines.append("  (suppressed) " + f.format())
+        for name, why in self.skipped.items():
+            lines.append(f"  (skipped {name}: {why})")
+        return "\n".join(lines)
+
+    def raise_if_findings(self):
+        if self.findings:
+            raise AnalysisError(self)
+
+
+class AnalysisError(AssertionError):
+    """Raised by Report.raise_if_findings — an AssertionError so pytest
+    renders the full report text."""
+
+    def __init__(self, report: Report):
+        self.report = report
+        super().__init__(report.summary())

@@ -1,0 +1,249 @@
+"""Seeded-bug fixtures: one deliberately-planted hazard per pass.
+
+Every Graph Doctor pass must have a TRUE-POSITIVE proof, not just a
+clean-run test — a pass that never fires is indistinguishable from a
+pass that cannot fire.  Each fixture here builds a tiny program seeded
+with exactly one bug of the class its pass hunts, runs the pass in
+isolation (``exemptions=()`` so the standing table cannot mask a
+regression in the pass itself), and returns the Report.  The self-check
+(``python -m paddle_tpu.analysis --self-check``, the ``doctor_self_check``
+smoke leg, and tests/test_analysis_passes.py) assert each report contains
+its intended finding code and nothing else.
+
+Fixtures that need capabilities the environment lacks (a multi-device
+mesh on a bare single-CPU invocation) raise FixtureUnavailable, which
+callers record as a skip — never a silent pass.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core import check
+from .findings import Report
+from .passes.hlo_checks import scan_compile_warnings
+from .passes.retrace import retrace_sentinel
+
+
+class FixtureUnavailable(RuntimeError):
+    """The environment cannot host this fixture (e.g. needs >= 2 devices)."""
+
+
+def _mesh(min_devices: int = 1):
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < min_devices:
+        raise FixtureUnavailable(
+            f"needs >= {min_devices} devices, have {len(devs)} "
+            f"(run under XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    n = max(min_devices, 2) if len(devs) >= 2 else 1
+    return Mesh(np.asarray(devs[:n], dtype=object), ("x",))
+
+
+# ---------------------------------------------------------------------------
+# collective_order
+# ---------------------------------------------------------------------------
+
+
+def seeded_collective_order() -> Report:
+    """COLL001: a shard_map cond whose true branch psums and whose false
+    branch does not — ranks disagreeing on the predicate deadlock."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+
+    mesh = _mesh(1)
+
+    def body(v):
+        return jax.lax.cond(v.sum() > 0.0,
+                            lambda u: jax.lax.psum(u, "x"),
+                            lambda u: u, v)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))
+    x = jnp.ones((8 * mesh.shape["x"],), jnp.float32)
+    return check(fn, x, passes=["collective_order"], exemptions=(),
+                 target="seeded:COLL001")
+
+
+def seeded_ppermute_race() -> Report:
+    """COLL002: a ppermute with two sources targeting one destination."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+
+    mesh = _mesh(2)
+
+    def body(v):
+        return jax.lax.ppermute(v, "x", [(0, 1), (1, 1)])
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))
+    x = jnp.ones((2 * mesh.shape["x"],), jnp.float32)
+    return check(fn, x, passes=["collective_order"], exemptions=(),
+                 target="seeded:COLL002")
+
+
+# ---------------------------------------------------------------------------
+# dtype_promotion
+# ---------------------------------------------------------------------------
+
+
+def seeded_fp32_matmul() -> Report:
+    """DT001: a bf16 program whose second matmul silently upcasts."""
+
+    def bug(a, b):
+        h = a @ b                                     # bf16 — declares it
+        return (h.astype(jnp.float32)
+                @ b.astype(jnp.float32)).sum()        # the silent upcast
+
+    a = jnp.ones((128, 128), jnp.bfloat16)
+    return check(bug, a, a, passes=["dtype_promotion"], exemptions=(),
+                 target="seeded:DT001")
+
+
+def seeded_f64_leak() -> Report:
+    """DT002: an x64-enabled input drags float64 through the program."""
+    from jax.experimental import enable_x64
+
+    def bug(a):
+        return (a * np.float64(2.0)).sum()
+
+    with enable_x64():
+        return check(bug, np.ones((64, 64), np.float64),
+                     passes=["dtype_promotion"], exemptions=(),
+                     target="seeded:DT002")
+
+
+def seeded_fp32_carry() -> Report:
+    """DT003: a bf16 micro-step loop accumulating into a full-width fp32
+    carry — the exact HBM-traffic bug the round-7 bf16 grad carry fixed."""
+
+    def bug(w, xs):
+        def micro(acc, x):
+            g = x @ w                                  # bf16 compute
+            return acc + g.astype(jnp.float32), ()     # fp32 accumulate
+        acc, _ = jax.lax.scan(
+            micro, jnp.zeros((128, 128), jnp.float32), xs)
+        return acc
+
+    w = jnp.ones((128, 128), jnp.bfloat16)
+    xs = jnp.ones((4, 128, 128), jnp.bfloat16)
+    return check(bug, w, xs, passes=["dtype_promotion"], exemptions=(),
+                 target="seeded:DT003")
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+
+def seeded_undonated_state() -> Report:
+    """DON001: a param-sized pytree rides a jit entry without donation."""
+
+    @jax.jit
+    def bug(params, grads):
+        return {k: v - 1e-3 * grads[k] for k, v in params.items()}
+
+    params = {"w": jnp.ones((768, 768), jnp.float32)}
+    grads = {"w": jnp.ones((768, 768), jnp.float32)}
+    return check(bug, params, grads, passes=["donation"], exemptions=(),
+                 target="seeded:DON001")
+
+
+def seeded_use_after_donate() -> Report:
+    """DON002: one buffer passed to a donated AND a read position."""
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def bug(a, b):
+        return a * 2.0 + b
+
+    x = jnp.ones((128, 128), jnp.float32)   # small: below DON001's bar
+    return check(bug, x, x, passes=["donation"], exemptions=(),
+                 target="seeded:DON002")
+
+
+# ---------------------------------------------------------------------------
+# retrace_sentinel
+# ---------------------------------------------------------------------------
+
+
+def seeded_weak_type_churn() -> Report:
+    """RT001: alternating python-float and array lr retraces per flip."""
+    step = retrace_sentinel(jax.jit(lambda x, lr: x * lr),
+                            name="seeded:RT001")
+    x = jnp.ones((8,), jnp.float32)
+    step(x, 0.1)                       # weak f32 scalar
+    step(x, jnp.float32(0.1))          # strong f32 scalar — same but weak
+    return step.report()
+
+
+def seeded_signature_churn() -> Report:
+    """RT002: unbucketed lengths — every call is a fresh compile."""
+    step = retrace_sentinel(jax.jit(lambda x: x.sum()), max_signatures=3,
+                            name="seeded:RT002")
+    for n in (1, 2, 3, 4):
+        step(jnp.ones((n,), jnp.float32))
+    return step.report()
+
+
+# ---------------------------------------------------------------------------
+# hlo_post_checks
+# ---------------------------------------------------------------------------
+
+
+def seeded_involuntary_remat() -> Report:
+    """HLO001 over a captured-warning sample: the detector itself (the
+    compile-and-capture plumbing is exercised by the clean-run checks and
+    tests/test_no_involuntary_remat.py; XLA's fallback cannot be seeded
+    portably on one CPU device)."""
+    sample = (
+        "2026-08-03 12:00:00.000000: W external/xla/xla/service/spmd/"
+        "spmd_partitioner.cc:584] Involuntary full rematerialization. "
+        "The compiled was not able to go from sharding "
+        "{devices=[2,2]<=[4]} to {replicated} without doing a full "
+        "rematerialization of the tensor.\n")
+    findings = scan_compile_warnings(sample)
+    return Report(target="seeded:HLO001", findings=findings,
+                  passes_run=("hlo_post_checks",))
+
+
+def seeded_full_param_allgather() -> Report:
+    """HLO002: a stage-3-sharded param replicated wholesale inside the
+    step.  The threshold is the documented stage-3 gate: no all-gather
+    may exceed the largest per-layer parameter."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh(2)
+    p = jax.device_put(jnp.ones((1024, 64), jnp.float32),
+                       NamedSharding(mesh, P("x", None)))
+
+    @jax.jit
+    def bug(a):
+        full = jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P()))     # gathers the whole param
+        return full * 2.0
+
+    return check(
+        bug, p, passes=["hlo_post_checks"], exemptions=(),
+        target="seeded:HLO002",
+        options={"hlo_post_checks":
+                 {"max_allgather_bytes": 1024 * 64 * 4 // 2}})
+
+
+SEEDED = {
+    "COLL001": seeded_collective_order,
+    "COLL002": seeded_ppermute_race,
+    "DT001": seeded_fp32_matmul,
+    "DT002": seeded_f64_leak,
+    "DT003": seeded_fp32_carry,
+    "DON001": seeded_undonated_state,
+    "DON002": seeded_use_after_donate,
+    "RT001": seeded_weak_type_churn,
+    "RT002": seeded_signature_churn,
+    "HLO001": seeded_involuntary_remat,
+    "HLO002": seeded_full_param_allgather,
+}

@@ -1,0 +1,9 @@
+"""Graph Doctor passes.  Importing this package populates the pass
+registry (core.PASS_REGISTRY); each module self-registers via
+@register_pass."""
+
+from . import collective_order  # noqa: F401
+from . import donation  # noqa: F401
+from . import dtype_promotion  # noqa: F401
+from . import hlo_checks  # noqa: F401
+from .retrace import RetraceSentinel, retrace_sentinel  # noqa: F401

@@ -1,0 +1,137 @@
+"""COLL — collective-order checker for shard_map regions.
+
+SPMD programs deadlock (or silently corrupt) when ranks disagree on the
+next collective: the canonical source is a ``lax.cond`` inside a
+shard_map body whose branches issue DIFFERENT collective sequences over
+some mesh axis — ranks that take different branches then pair a psum
+with nothing (hang) or with the wrong collective (garbage).  The
+array-redistribution literature (arxiv 2112.01075) treats collective
+sequences as statically checkable artifacts; this pass does the same
+over our jaxprs.
+
+Codes:
+- COLL001: cond branches inside a shard_map body issue mismatched
+  collective sequences for a mesh axis (deadlock/race analog).
+- COLL002: a ppermute whose (source, dest) pairs repeat a source or a
+  destination — two sends racing into one receive buffer (or one rank
+  sending twice), malformed by the ppermute contract.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..core import (AnalysisContext, AnalysisPass, format_where,
+                    register_pass, sub_jaxprs, walk_eqns)
+from ..findings import Finding
+
+# communication primitives whose cross-rank ORDER matters.  pbroadcast /
+# pvary are replication-bookkeeping markers inserted by shard_map's
+# check_rep rewrite — no wire traffic, excluded on purpose.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_gather_invariant", "all_to_all", "psum_scatter", "reduce_scatter",
+    "pgather",
+})
+
+
+def _axes_of(eqn) -> Tuple[str, ...]:
+    ax = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if ax is None:
+        ax = ()
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    return tuple(str(a) for a in ax)
+
+
+def _routing_of(eqn):
+    """Pairing-relevant params beyond the axes: two branches both doing
+    a ppermute still deadlock if their perms differ (ranks consult
+    different send/recv tables)."""
+    if eqn.primitive.name == "ppermute":
+        # sorted: the pair LIST's order is not semantic — only the
+        # send/recv pairing itself is
+        return tuple(sorted(tuple(int(x) for x in p)
+                            for p in eqn.params.get("perm", ())))
+    if eqn.primitive.name == "all_to_all":
+        return (eqn.params.get("split_axis"),
+                eqn.params.get("concat_axis"))
+    return None
+
+
+def _collective_seq(jaxpr) -> List[Tuple[str, Tuple[str, ...], object]]:
+    """Program-order sequence of (primitive, axes, routing) collectives
+    in a jaxpr, including nested control flow (nested cond divergence is
+    reported at its own cond; for the parent comparison the full
+    flattened sequence is what a rank would execute)."""
+    seq = []
+    for eqn, _ in walk_eqns(jaxpr):
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            seq.append((eqn.primitive.name, _axes_of(eqn),
+                        _routing_of(eqn)))
+    return seq
+
+
+@register_pass
+class CollectiveOrderPass(AnalysisPass):
+    name = "collective_order"
+    codes = ("COLL001", "COLL002")
+    requires = "jaxpr"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for eqn, _stack in walk_eqns(ctx.jaxpr):
+            if eqn.primitive.name != "shard_map":
+                continue
+            for _, body in sub_jaxprs(eqn):
+                findings.extend(self._check_body(body))
+        return findings
+
+    # ---- per-region checks ------------------------------------------------
+
+    def _check_body(self, body) -> List[Finding]:
+        findings: List[Finding] = []
+        for eqn, _ in walk_eqns(body):
+            if eqn.primitive.name == "cond":
+                findings.extend(self._check_cond(eqn))
+            elif eqn.primitive.name == "ppermute":
+                findings.extend(self._check_ppermute(eqn))
+        return findings
+
+    def _check_cond(self, eqn) -> List[Finding]:
+        branches = [j for _, j in sub_jaxprs(eqn)]
+        seqs = [_collective_seq(b) for b in branches]
+        axes = sorted({a for s in seqs for _, ax, _ in s for a in ax})
+        findings = []
+        for axis in axes:
+            per_branch = [tuple((p, r) for p, ax, r in s if axis in ax)
+                          for s in seqs]
+            if len(set(per_branch)) > 1:
+                where, data = format_where(eqn)
+                findings.append(self.finding(
+                    "COLL001",
+                    f"cond branches inside shard_map issue mismatched "
+                    f"collective sequences over mesh axis {axis!r}: "
+                    + " vs ".join(str(list(s)) for s in per_branch)
+                    + " — ranks taking different branches will pair "
+                      "collectives incorrectly (deadlock/race)",
+                    where=where, data={**data, "axis": axis,
+                                       "sequences": per_branch}))
+        return findings
+
+    def _check_ppermute(self, eqn) -> List[Finding]:
+        perm = [tuple(int(x) for x in pair)
+                for pair in eqn.params.get("perm", ())]
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        findings = []
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            where, data = format_where(eqn)
+            findings.append(self.finding(
+                "COLL002",
+                f"ppermute perm {perm} repeats a "
+                f"{'source' if len(set(srcs)) != len(srcs) else 'destination'}"
+                f" — not a partial permutation (two transfers race into "
+                f"one buffer / one rank double-sends)",
+                where=where, data={**data, "perm": perm}))
+        return findings

@@ -1,0 +1,163 @@
+"""DON — donation analyzer.
+
+A jitted step that is handed params/optimizer-state without donating them
+holds TWO copies of the model in HBM for the duration of the step (input
+buffers stay live while outputs materialize) — at 8B-param scale that is
+the difference between fitting and OOM.  The flip side is use-after-
+donate: passing one buffer into two donated positions (or re-passing a
+donated buffer) hands XLA the same storage twice and the second read is
+garbage.
+
+Codes:
+- DON001: a large dynamic argument of a jit entry point is not donated
+  (double-residency).  Aggregated per top-level argument — "opt_state
+  (14.2 MB over 12 leaves) not donated", not 12 findings.  Arguments
+  that legitimately persist across calls (serving weights streamed every
+  chunk) are declared via ``options={"donation": {"persistent": (0,)}}``.
+- DON002: the same concrete buffer appears in more than one leaf of the
+  call with at least one occurrence donated — a use-after-donate hazard
+  XLA only reports at runtime, if at all.
+
+This pass needs the Lowered (donation metadata lives there, not in the
+jaxpr): plain un-jitted functions are skipped — there is no donation
+contract to audit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.tree_util as jtu
+
+from ..core import AnalysisContext, AnalysisPass, SkipPass, register_pass
+from ..findings import Finding
+
+
+def _resolve_path(root, path):
+    """Best-effort walk of a tree_flatten_with_path path into the concrete
+    (args, kwargs) structure; None when it cannot be resolved (static
+    positional args shift args_info indices)."""
+    obj = root
+    for key in path:
+        try:
+            if hasattr(key, "idx"):
+                obj = obj[key.idx]
+            elif hasattr(key, "key"):
+                obj = obj[key.key]
+            elif hasattr(key, "name"):
+                obj = getattr(obj, key.name)
+            else:
+                return None
+        except Exception:
+            return None
+    return obj
+
+
+def _top_label(path) -> str:
+    """Human label for the top-level argument a leaf belongs to:
+    "arg0", "arg2", or "kwarg 'kv_scales'"."""
+    if not path:
+        return "args"
+    first = path[0]
+    if hasattr(first, "idx") and first.idx == 0:
+        # inside the positional-args tuple: the next key is the argnum
+        if len(path) > 1 and hasattr(path[1], "idx"):
+            return f"arg{path[1].idx}"
+        return "args"
+    if len(path) > 1 and hasattr(path[1], "key"):
+        return f"kwarg {path[1].key!r}"
+    return jtu.keystr(path[:2])
+
+
+@register_pass
+class DonationPass(AnalysisPass):
+    name = "donation"
+    codes = ("DON001", "DON002")
+    requires = "lowered"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        if not ctx.is_jit_entry:
+            raise SkipPass("target is not a jit entry point — no donation "
+                           "contract to audit")
+        min_bytes = ctx.opt(self.name, "min_bytes", 1 << 20)
+        persistent = set(ctx.opt(self.name, "persistent", ()))
+        lowered = ctx.lowered
+        leaves = jtu.tree_flatten_with_path(lowered.args_info)[0]
+
+        findings: List[Finding] = []
+        findings.extend(self._undonated(leaves, min_bytes, persistent))
+        findings.extend(self._use_after_donate(ctx, leaves))
+        return findings
+
+    # ---- DON001 -----------------------------------------------------------
+
+    @staticmethod
+    def _leaf_bytes(info) -> int:
+        try:
+            size = 1
+            for d in info.shape:
+                size *= int(d)
+            return size * info.dtype.itemsize
+        except Exception:
+            return 0
+
+    def _undonated(self, leaves, min_bytes, persistent) -> List[Finding]:
+        per_arg: dict = {}
+        for path, info in leaves:
+            if getattr(info, "donated", False):
+                continue
+            argnum = path[1].idx if (len(path) > 1 and hasattr(path[0], "idx")
+                                     and path[0].idx == 0
+                                     and hasattr(path[1], "idx")) else None
+            if argnum in persistent:
+                continue
+            label = _top_label(path)
+            slot = per_arg.setdefault(label, {"bytes": 0, "leaves": 0,
+                                              "biggest": ("", 0)})
+            b = self._leaf_bytes(info)
+            slot["bytes"] += b
+            slot["leaves"] += 1
+            if b > slot["biggest"][1]:
+                slot["biggest"] = (jtu.keystr(path), b)
+        findings = []
+        for label, slot in sorted(per_arg.items()):
+            if slot["bytes"] < min_bytes:
+                continue
+            big_path, big_bytes = slot["biggest"]
+            findings.append(self.finding(
+                "DON001",
+                f"{label}: {slot['bytes'] / 1e6:.2f} MB across "
+                f"{slot['leaves']} leaf array(s) passed to a jit entry "
+                f"without donation — input and output copies are both "
+                f"HBM-resident for the step (largest leaf {big_path}, "
+                f"{big_bytes / 1e6:.2f} MB); donate it, or declare it "
+                f"persistent if it is reused across calls",
+                arg_path=label,
+                data={"bytes": slot["bytes"], "leaves": slot["leaves"]}))
+        return findings
+
+    # ---- DON002 -----------------------------------------------------------
+
+    def _use_after_donate(self, ctx, leaves) -> List[Finding]:
+        root = (ctx.args, ctx.kwargs)
+        by_buffer: dict = {}
+        for path, info in leaves:
+            val = _resolve_path(root, path)
+            if val is None or not hasattr(val, "shape") \
+                    or tuple(val.shape) != tuple(info.shape):
+                continue       # path misaligned (static positional args)
+            by_buffer.setdefault(id(val), []).append(
+                (jtu.keystr(path), bool(getattr(info, "donated", False))))
+        findings = []
+        for _, uses in by_buffer.items():
+            if len(uses) < 2 or not any(donated for _, donated in uses):
+                continue
+            paths = [p for p, _ in uses]
+            findings.append(self.finding(
+                "DON002",
+                f"the same buffer is passed in {len(uses)} argument "
+                f"positions {paths} with at least one donated — after "
+                f"donation the other alias reads freed storage "
+                f"(use-after-donate)",
+                arg_path=paths[0], data={"paths": paths}))
+        return findings

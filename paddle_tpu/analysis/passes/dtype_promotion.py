@@ -1,0 +1,174 @@
+"""DT — dtype-promotion audit.
+
+A declared-bf16 compute region (compute_dtype=bf16 train steps, bf16
+serving) wins its milliseconds from MXU-native matmuls and half-width HBM
+traffic; one silent ``astype(float32)`` in the wrong place gives them
+back without failing any numeric test.  This pass walks the jaxpr and
+flags the upcasts that matter:
+
+- DT001: a large matmul (dot_general) running in fp32/f64 inside a
+  declared-bf16 region — a silently-upcast MXU op (4-8x the bf16 cycle
+  cost on TPU).
+- DT002: any float64 value anywhere — f64 cannot exist unless x64 crept
+  in, and on TPU it software-emulates.
+- DT003: an INNERMOST accumulation loop (lax.scan) carrying a large fp32
+  buffer in a declared-bf16 region — the read-modify-write of that carry
+  is fp32-width HBM traffic every iteration (the class of cost the
+  round-7 bf16 grad-accum carry removed; the masked grad-accum branch is
+  the tracked exemption EX-DT003-masked-grad-accum).  Outer fold carries
+  are exempt by construction: a scan whose body contains another
+  large-carry scan is a fold loop, not the hot accumulation loop.
+
+The declared dtype comes from ``check(..., declared_dtype=...)`` or is
+inferred: if any matmul in the program runs in bf16/f16, the program
+declared low-precision compute and the audit applies.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..core import (AnalysisContext, AnalysisPass, aval_size, format_where,
+                    register_pass, walk_eqns)
+from ..findings import Finding
+
+LOW_PRECISION = ("bfloat16", "float16")
+
+
+def _dtype(v) -> str:
+    try:
+        return str(v.aval.dtype)
+    except Exception:
+        return ""
+
+
+def _infer_declared(jaxpr):
+    """The region's declared compute dtype: the lowest-precision dtype any
+    dot_general runs in (bf16 beats fp32 — one bf16 matmul means the
+    author opted into low-precision compute)."""
+    seen = set()
+    for eqn, _ in walk_eqns(jaxpr):
+        if eqn.primitive.name == "dot_general":
+            seen.update(_dtype(v) for v in eqn.invars)
+    for lp in LOW_PRECISION:
+        if lp in seen:
+            return lp
+    return None
+
+
+@register_pass
+class DtypePromotionPass(AnalysisPass):
+    name = "dtype_promotion"
+    codes = ("DT001", "DT002", "DT003")
+    requires = "jaxpr"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        min_elems = ctx.opt(self.name, "min_elements", 4096)
+        declared = ctx.declared_dtype
+        declared = str(jnp.dtype(declared)) if declared is not None \
+            else _infer_declared(ctx.jaxpr)
+        low_precision_region = declared in LOW_PRECISION
+
+        findings: List[Finding] = []
+        for eqn, stack in walk_eqns(ctx.jaxpr):
+            findings.extend(self._check_f64(eqn))
+            if not low_precision_region:
+                continue
+            if eqn.primitive.name == "dot_general":
+                findings.extend(self._check_dot(eqn, declared, min_elems))
+            elif eqn.primitive.name == "scan":
+                findings.extend(self._check_scan_carry(eqn, declared,
+                                                       min_elems))
+        return findings
+
+    # ---- DT002 ------------------------------------------------------------
+
+    def _check_f64(self, eqn) -> List[Finding]:
+        for v in eqn.outvars:
+            if _dtype(v) == "float64":
+                where, data = format_where(eqn)
+                return [self.finding(
+                    "DT002",
+                    f"float64 value produced by {eqn.primitive.name} "
+                    f"(shape {getattr(v.aval, 'shape', '?')}) — f64 "
+                    f"software-emulates on TPU; an x64-enabled input "
+                    f"leaked into the program",
+                    where=where, data=data)]
+        return []
+
+    # ---- DT001 ------------------------------------------------------------
+
+    def _check_dot(self, eqn, declared, min_elems) -> List[Finding]:
+        in_dtypes = [_dtype(v) for v in eqn.invars]
+        floats = [dt for dt in in_dtypes
+                  if dt in LOW_PRECISION + ("float32", "float64")]
+        if not floats:
+            return []          # int8/int32 dots (quantized) are fine
+        if not any(dt in ("float32", "float64") for dt in floats):
+            return []
+        size = max(aval_size(v.aval) for v in eqn.invars)
+        if size < min_elems:
+            return []          # small glue math may legitimately be fp32
+        # a MIXED bf16 x f32 dot is the sneakiest form: promotion upcasts
+        # the bf16 operand and the dot runs full-precision anyway (the
+        # rope-table bug produced exactly these across every layer)
+        mixed = any(dt in LOW_PRECISION for dt in floats)
+        where, data = format_where(eqn)
+        shapes = [tuple(v.aval.shape) for v in eqn.invars]
+        kind = (f"mixed-precision matmul {list(zip(shapes, in_dtypes))} — "
+                f"promotion upcasts the {declared} operand and the dot "
+                f"runs fp32" if mixed else
+                f"fp32 matmul {shapes} — a silent upcast is paying "
+                f"full-precision MXU cycles")
+        return [self.finding(
+            "DT001",
+            f"{kind} inside a declared-{declared} compute region; cast "
+            f"the operands to {declared} or add a tracked exemption",
+            where=where, data={**data, "shapes": shapes, "mixed": mixed})]
+
+    # ---- DT003 ------------------------------------------------------------
+
+    def _carry_avals(self, eqn):
+        body = eqn.params["jaxpr"].jaxpr
+        nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
+        return body, body.invars[nc:nc + nk], body.outvars[:nk]
+
+    def _has_large_carry(self, eqn, min_elems) -> bool:
+        _, carries, _ = self._carry_avals(eqn)
+        return any(aval_size(v.aval) >= min_elems for v in carries)
+
+    def _check_scan_carry(self, eqn, declared, min_elems) -> List[Finding]:
+        body, carries, carry_outs = self._carry_avals(eqn)
+        # innermost only: a body containing another large-carry scan is a
+        # fold loop around the real accumulation loop (the bf16-carry
+        # scheme's fp32 fold carry is absorbed once per fold, not per
+        # micro-step — that is the design, not the hazard)
+        for inner, _ in walk_eqns(body):
+            if inner.primitive.name == "scan" \
+                    and self._has_large_carry(inner, min_elems):
+                return []
+        hot = [(i, v) for i, v in enumerate(carries)
+               if _dtype(v) == "float32" and aval_size(v.aval) >= min_elems]
+        if not hot:
+            return []
+        total = sum(aval_size(v.aval) for _, v in hot) * 4
+        # provenance: the eqn that PRODUCES the largest fp32 carry inside
+        # the body (the accumulate op) names the function to exempt
+        idx = max(hot, key=lambda iv: aval_size(iv[1].aval))[0]
+        out_var = carry_outs[idx]
+        where, data = format_where(eqn)
+        for beqn in reversed(body.eqns):
+            if out_var in beqn.outvars:
+                where, data = format_where(beqn)
+                break
+        return [self.finding(
+            "DT003",
+            f"innermost scan carries {len(hot)} fp32 buffer(s) "
+            f"({total / 1e6:.2f} MB) in a declared-{declared} region — "
+            f"the carry's read-modify-write is full-width HBM traffic "
+            f"every micro-step; use a bounded-depth bf16 carry with fp32 "
+            f"folds, or add a tracked exemption",
+            where=where,
+            data={**data, "num_buffers": len(hot), "bytes": total})]

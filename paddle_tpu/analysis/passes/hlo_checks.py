@@ -1,0 +1,148 @@
+"""HLO — post-compile checks over XLA's output.
+
+Some regressions only exist after GSPMD runs: the partitioner falling
+back to "Involuntary full rematerialization" (replicating a tensor every
+step because no collective sequence reaches the target sharding — the
+round-4 embedding/CE-gather bug class), or a ZeRO-3 step whose parameters
+get all-gathered WHOLESALE instead of layer-by-layer (the memory win of
+sharding stage 3 silently gone).  This pass compiles the target (stderr
+captured at the fd level — the warnings come from C++) and checks both.
+
+Codes:
+- HLO001: the SPMD partitioner reported involuntary full
+  rematerialization while compiling (each hit replicates a tensor per
+  step on a real pod).  Tests that wrap their own compile+run
+  (tests/test_no_involuntary_remat.py) use ``core.capture_stderr`` +
+  ``scan_compile_warnings`` directly.
+- HLO002: an all-gather in the optimized HLO produces a result larger
+  than the biggest single argument leaf — for a stage-3/FSDP step that
+  is a full-param-set gather, not the expected per-layer one.  Threshold
+  overridable via ``options={"hlo_post_checks": {"max_allgather_bytes":
+  N}}``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+import jax.tree_util as jtu
+
+from ..core import AnalysisContext, AnalysisPass, register_pass
+from ..findings import Finding
+
+INVOLUNTARY_REMAT_RE = re.compile(
+    r"Involuntary full rematerialization[^\n]*")
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+
+def scan_compile_warnings(text: str) -> List[Finding]:
+    """HLO001 findings from captured compile-time stderr."""
+    return [Finding(
+        code="HLO001", pass_name="hlo_post_checks",
+        message=("SPMD partitioner fell back to involuntary full "
+                 "rematerialization (a per-step full replicate of the "
+                 "tensor on a real pod): " + hit[:300]),
+        data={"warning": hit[:300]})
+        for hit in INVOLUNTARY_REMAT_RE.findall(text)]
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+_AG_LINE_RE = re.compile(r"=\s*([^=]*?)all-gather(-start)?\(")
+
+
+def scan_allgather_sizes(hlo_text: str) -> List[Tuple[int, str]]:
+    """(result_bytes, line_snippet) for every all-gather in HLO text.
+    Matches the op on the RHS of the assignment (the LHS instruction NAME
+    also contains "all-gather"); -done ops are skipped so async gathers
+    count once.  An ``all-gather-start`` result tuple is (operands...,
+    results...) — only the second half are gather RESULTS, so counting
+    every tuple shape would inflate async gathers ~1.5x and false-trip
+    HLO002 on legitimate per-layer gathers (TPU emits the async form)."""
+    out = []
+    for line in hlo_text.splitlines():
+        if "all-gather" not in line:
+            continue
+        m = _AG_LINE_RE.search(line)
+        if m is None:
+            continue
+        shapes = _SHAPE_RE.findall(m.group(1))
+        if not shapes:
+            continue
+        if m.group(2) and len(shapes) >= 2:      # async -start form
+            shapes = shapes[len(shapes) // 2:]
+        total = sum(_shape_bytes(dt, dims) for dt, dims in shapes)
+        out.append((total, line.strip()[:200]))
+    return out
+
+
+@register_pass
+class HloPostChecksPass(AnalysisPass):
+    name = "hlo_post_checks"
+    codes = ("HLO000", "HLO001", "HLO002")
+    requires = "compiled"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        try:
+            _, stderr_text = ctx.compile()
+        except Exception as e:
+            # an ERROR finding, not a SkipPass: skips don't fail
+            # Report.ok, and a flagship step that cannot compile at all
+            # must gate bench --doctor / self-check red, not green
+            return [self.finding(
+                "HLO000",
+                f"target failed to XLA-compile — every post-compile "
+                f"check is moot and the step cannot run: {e!r}"[:500],
+                data={"error": repr(e)[:300]})]
+        findings = scan_compile_warnings(stderr_text)
+        findings.extend(self._check_allgathers(ctx))
+        return findings
+
+    def _max_arg_leaf_bytes(self, ctx) -> int:
+        biggest = 0
+        lowered = ctx.lowered
+        if lowered is None:
+            return 0
+        for _, info in jtu.tree_flatten_with_path(lowered.args_info)[0]:
+            try:
+                n = 1
+                for d in info.shape:
+                    n *= int(d)
+                biggest = max(biggest, n * info.dtype.itemsize)
+            except Exception:
+                continue
+        return biggest
+
+    def _check_allgathers(self, ctx) -> List[Finding]:
+        limit = ctx.opt(self.name, "max_allgather_bytes", None)
+        if limit is None:
+            limit = self._max_arg_leaf_bytes(ctx)
+        if not limit:
+            return []      # no sizing information — nothing to gate on
+        findings = []
+        for nbytes, snippet in scan_allgather_sizes(ctx.compiled_text):
+            if nbytes <= limit:
+                continue
+            findings.append(self.finding(
+                "HLO002",
+                f"all-gather result of {nbytes / 1e6:.2f} MB exceeds the "
+                f"largest single argument leaf ({limit / 1e6:.2f} MB) — "
+                f"a sharded (stage-3) step is gathering more than one "
+                f"parameter wholesale instead of per-layer: {snippet}",
+                data={"bytes": nbytes, "limit": int(limit),
+                      "hlo": snippet}))
+        return findings

@@ -1,0 +1,241 @@
+"""Graph Doctor self-check: the doctor proving it can still detect.
+
+Three layers, all required green:
+1. every seeded-bug fixture (fixtures.py) triggers EXACTLY its intended
+   finding code — true-positive coverage per pass;
+2. the clean flagship entry points (build_train_step unmasked-bf16 in
+   both accum regimes, llama fwd/bwd, the serving decode chunk) report
+   ZERO findings — false-positive coverage;
+3. every standing exemption entry still matches a live suppressed
+   finding — stale exemptions rot loudly (the masked grad-accum fp32
+   carry must still be detected AND suppressed by
+   EX-DT003-masked-grad-accum).
+
+Wired into ``python -m paddle_tpu.analysis --self-check``, the
+``doctor_self_check`` leg of ``bench.py --smoke``, and
+tests/test_analysis_passes.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# jaxpr/lowering-level passes (no XLA compile) — used for the fast clean
+# sweeps; the accum train step and the serving decode chunk also run the
+# compiled HLO checks.
+FAST_PASSES = ("collective_order", "dtype_promotion", "donation")
+ALL_PASSES = None
+
+# The sweeps run DEBUG-shaped models (~200 KB of params), far below the
+# donation pass's production default of 1 MB — at the default the gate
+# would be VACUOUS (deleting donate_argnums from build_train_step would
+# still pass).  Lower the bar to the debug param scale so the sweeps
+# actually verify the donation contracts; the liveness test
+# (tests/test_analysis_passes.py) asserts an undonated params dict of
+# this size trips DON001 at this threshold.
+DONATION_MIN_BYTES = 4 << 10
+
+
+def _flagship():
+    """Tiny flagship bundle shared by the clean sweeps (debug shapes —
+    the jaxprs have the same STRUCTURE as the bench config; only dims
+    shrink)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    state = paddle.get_rng_state()
+    paddle.seed(20260803)
+    cfg = LlamaConfig.debug(vocab=128, hidden=64, layers=2, heads=4,
+                            kv_heads=2, inter=128, max_pos=64)
+    model = LlamaForCausalLM(cfg)
+    paddle.set_rng_state(state)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    params = {k: jnp.asarray(v) for k, v in model.functional_state().items()}
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    return cfg, model, opt, params, ids, labels
+
+
+def _clean_targets():
+    """Yield (name, report) for the flagship clean sweeps."""
+    from .core import check
+    from paddle_tpu.models import build_train_step
+    from paddle_tpu.models.llama import llama_decay_mask
+
+    cfg, model, opt, params, ids, labels = _flagship()
+    mask_all = llama_decay_mask(model)
+
+    def deep(t):
+        return jax.tree_util.tree_map(jnp.copy, t)
+
+    # 1. single-batch bf16 step (fast passes — structure is a subset of
+    # the accum step checked in full below)
+    donation = {"donation": {"min_bytes": DONATION_MIN_BYTES}}
+    # declared_dtype is pinned, not inferred: a regression that upcasts
+    # EVERY matmul to fp32 also removes the bf16 dots the inference
+    # keys on, and the audit would silently stand down exactly when it
+    # is needed most (the sweeps KNOW compute_dtype=bf16)
+    step1 = build_train_step(model, opt, compute_dtype=jnp.bfloat16)
+    yield "build_train_step[bf16]", check(
+        step1, deep(params), opt.init_state(deep(params)), 0, 1e-4, ids,
+        labels, passes=list(FAST_PASSES), options=donation,
+        declared_dtype=jnp.bfloat16, target="build_train_step[bf16]")
+
+    # 2. grad-accum bf16-carry step with the fused flat optimizer — the
+    # headline training config; full pass suite incl. compiled HLO
+    step4 = build_train_step(model, opt, compute_dtype=jnp.bfloat16,
+                             accum_steps=4)
+    yield "build_train_step[bf16,accum4]", check(
+        step4, deep(params),
+        opt.init_flat_state(deep(params), decay_mask=mask_all), 0, 1e-4,
+        ids.reshape(4, 1, 16), labels.reshape(4, 1, 16),
+        passes=ALL_PASSES, options=donation,
+        declared_dtype=jnp.bfloat16,
+        target="build_train_step[bf16,accum4]")
+
+    # 3. llama forward/backward in isolation (no optimizer): params are
+    # read-only here, so they are declared persistent for the donation
+    # audit
+    from paddle_tpu.autograd import no_grad
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.llama import _gold_logit
+
+    def fwd_bwd(p, ids_, labels_):
+        def loss(pp):
+            cast = {k: (v.astype(jnp.bfloat16)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in pp.items()}
+            with no_grad():
+                logits = model.functional_call(cast, Tensor(ids_))
+            lv = logits._value
+            lse = jax.scipy.special.logsumexp(lv.astype(jnp.float32),
+                                              axis=-1)
+            return (lse - _gold_logit(lv, labels_)).mean()
+        return jax.value_and_grad(loss)(p)
+
+    yield "llama_fwd_bwd[bf16]", check(
+        jax.jit(fwd_bwd), params, ids, labels, passes=list(FAST_PASSES),
+        options={"donation": {"persistent": (0,),
+                              "min_bytes": DONATION_MIN_BYTES}},
+        declared_dtype=jnp.bfloat16, target="llama_fwd_bwd[bf16]")
+
+    # 4. serving decode chunk (paged pipelined engine) — full suite
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    eng = ContinuousBatchingEngine(cfg, params, max_slots=2, num_pages=9,
+                                   page_size=16, max_seq_len=64,
+                                   decode_chunk_steps=2)
+    fn, args, kwargs, options = eng.analysis_entry()
+    yield "serving_decode_chunk", check(
+        fn, *args, kwargs=kwargs, options=options, passes=ALL_PASSES,
+        target="serving_decode_chunk")
+
+
+def _probe_masked_grad_accum():
+    """Liveness probe for EX-DT003-masked-grad-accum: the masked accum
+    branch still carries its by-design fp32 buffer and the audit still
+    sees (and suppresses) it."""
+    from .core import check
+    from paddle_tpu.models import build_train_step
+
+    cfg, model, opt, params, ids, labels = _flagship()
+    stepm = build_train_step(model, opt, compute_dtype=jnp.bfloat16,
+                             accum_steps=4)
+    amask = np.ones((4, 1, 16), np.int32)
+    amask[:, :, -4:] = 0
+    return check(stepm, params, opt.init_state(params), 0, 1e-4,
+                 ids.reshape(4, 1, 16), labels.reshape(4, 1, 16), amask,
+                 passes=["dtype_promotion"], declared_dtype=jnp.bfloat16,
+                 target="build_train_step[bf16,accum4,masked]")
+
+
+# every standing exemption needs a probe that reproduces its finding —
+# an Exemption without one FAILS self-check (a suppression whose hazard
+# can no longer be demonstrated is either stale or untested)
+_LIVENESS_PROBES = {
+    "EX-DT003-masked-grad-accum": _probe_masked_grad_accum,
+}
+
+
+def _exemption_liveness() -> Dict[str, dict]:
+    """Each standing exemption must still match a live suppressed finding
+    in ITS OWN probe's report — one baked-in sweep cannot witness
+    exemptions added later for other passes/targets."""
+    from .exemptions import EXEMPTIONS
+
+    out = {}
+    for ex in EXEMPTIONS:
+        probe = _LIVENESS_PROBES.get(ex.id)
+        if probe is None:
+            out[ex.id] = {"ok": False,
+                          "error": f"no liveness probe registered for "
+                                   f"{ex.id} — add one to "
+                                   f"_LIVENESS_PROBES"}
+            continue
+        rep = probe()
+        hit = [f for f in rep.suppressed if f.exemption_id == ex.id]
+        out[ex.id] = {
+            "ok": bool(hit) and not rep.findings,
+            "matched": len(hit),
+            "unsuppressed": [f.format() for f in rep.findings],
+        }
+    return out
+
+
+def self_check(clean: bool = True) -> dict:
+    """Run the full self-check; returns a JSON-able dict with ``ok``."""
+    from .fixtures import SEEDED, FixtureUnavailable
+
+    seeded = {}
+    for code, fx in SEEDED.items():
+        try:
+            rep = fx()
+        except FixtureUnavailable as e:
+            seeded[code] = {"ok": True, "skipped": str(e)}
+            continue
+        except Exception as e:  # noqa: BLE001 - report, don't crash the CLI
+            seeded[code] = {"ok": False, "error": repr(e)}
+            continue
+        codes = set(rep.codes())
+        seeded[code] = {"ok": codes == {code},
+                        "codes": sorted(codes),
+                        "n": len(rep.findings)}
+
+    result = {"seeded": seeded}
+    if clean:
+        # a sweep blowing up (toolchain drift, engine construction) must
+        # degrade to a structured failure, not a raw traceback — the CLI
+        # contract is "JSON report + non-zero exit", and DOCTOR.json
+        # still gets written for the targets that did run
+        clean_out = {}
+        try:
+            for name, rep in _clean_targets():
+                clean_out[name] = {
+                    "ok": rep.ok,
+                    "findings": [f.format() for f in rep.findings],
+                    "suppressed": len(rep.suppressed),
+                    "skipped_passes": dict(rep.skipped)}
+        except Exception as e:  # noqa: BLE001
+            clean_out["_sweep_error"] = {"ok": False, "error": repr(e)}
+        result["clean"] = clean_out
+        try:
+            result["exemptions"] = _exemption_liveness()
+        except Exception as e:  # noqa: BLE001
+            result["exemptions"] = {"_liveness_error": {"ok": False,
+                                                        "error": repr(e)}}
+
+    def _all_ok(d):
+        return all(v.get("ok") for v in d.values()) if d else True
+
+    result["ok"] = all(_all_ok(result.get(k, {}))
+                       for k in ("seeded", "clean", "exemptions"))
+    result["backend"] = jax.default_backend()
+    result["num_devices"] = len(jax.devices())
+    return result

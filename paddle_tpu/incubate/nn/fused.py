@@ -78,7 +78,20 @@ def _rope_rotate_half(x):
 def _fused_rope_op(q, k=None, v=None, sin=None, cos=None, position_ids=None,
                    use_neox_rotary_style=True):
     """Rotary embedding; layout (batch, seq, heads, head_dim).
-    Reference: fused_rotary_position_embedding.py (incubate)."""
+    Reference: fused_rotary_position_embedding.py (incubate).
+
+    sin/cos are cast to q's dtype before the rotation: the rope tables
+    are precomputed fp32 buffers, and mixed-dtype multiply would PROMOTE
+    bf16 q/k to fp32 — from where the upcast propagates through
+    attention, the residual stream, and the whole backward (the Graph
+    Doctor's dtype audit flagged exactly this: DT001 fp32 matmuls across
+    every layer of a declared-bf16 train step; the serving path's
+    _apply_rope already cast at its call site).  bf16 rope phases are
+    standard practice — the angle tables quantize once, not per step."""
+    if sin is not None and q is not None:
+        sin = sin.astype(q.dtype)
+    if cos is not None and q is not None:
+        cos = cos.astype(q.dtype)
 
     def apply(x):
         if x is None:

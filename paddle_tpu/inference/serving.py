@@ -634,6 +634,42 @@ class ContinuousBatchingEngine:
             raise RuntimeError("serving loop did not drain")
         return sorted(self.finished, key=lambda f: f.rid)
 
+    # ---------------- graph-doctor entry ----------------
+
+    def analysis_entry(self):
+        """(fn, args, kwargs, options) for ``paddle_tpu.analysis.check``
+        over the compiled decode-chunk program — the serving hot path as
+        the doctor sees it (same static config, current pool/schedule
+        shapes).  ``options`` declares the donation contract: params and
+        the rope tables persist across chunks BY DESIGN (the weight
+        stream re-reads them every chunk; donating would force a
+        re-upload), while the page pools are donated through the program
+        (donate_argnums=(1, 2)) and the doctor verifies that stays true.
+
+            fn, args, kwargs, options = engine.analysis_entry()
+            report = paddle_tpu.analysis.check(
+                fn, *args, kwargs=kwargs, options=options)
+        """
+        dev_tok = (self._dev_tok if self._dev_tok is not None
+                   else jnp.zeros((self.max_slots,), jnp.int32))
+        fn = ContinuousBatchingEngine._decode_chunk_jit
+        args = (self.params, self.k_pages, self.v_pages,
+                jnp.asarray(self._pack_sched()), dev_tok,
+                self.cos_tab, self.sin_tab)
+        kwargs = dict(self_cfg_id=self.cfg_id, chunk=self.chunk,
+                      pages_per_step=self.pages_per_step,
+                      kv_scales=self.kv_scales)
+        # min_bytes sized to the page pools, not the 1MB production
+        # default: tiny test/debug engines must still FAIL the doctor if
+        # the pools stop being donated (a vacuous gate passes when the
+        # contract breaks)
+        pool_bytes = min(int(np.prod(k.shape)) * k.dtype.itemsize
+                         for k in self.k_pages)
+        options = {"donation": {"persistent": (0, 5, 6),
+                                "min_bytes": min(1 << 20,
+                                                 max(1, pool_bytes // 2))}}
+        return fn, args, kwargs, options
+
     # ---------------- bench helper ----------------
 
     def time_decode_chunk(self, chunk: int, reps: int = 3) -> float:

@@ -24,6 +24,23 @@ from ..nn.layer import Parameter
 from . import lr as lr_mod
 
 
+def _pin_lr_f32(lr):
+    """Guard the functional update paths against f64 lr creep (Graph
+    Doctor dtype audit, DT002 class): a STRONG float64 lr (np.float64,
+    an x64 jnp array) would promote the whole update chain — master
+    weights included — to double.  Python floats stay untouched: their
+    WEAK typing is what lets ``value - lr * grad`` preserve bf16/f16
+    param dtypes in optimizers whose update doesn't cast back (SGD,
+    Momentum); pinning those to strong f32 would itself be a silent
+    upcast of every non-fp32 param."""
+    dt = getattr(lr, "dtype", None)
+    if dt is None or str(dt) != "float64":
+        return lr
+    if getattr(lr, "weak_type", False):
+        return lr                     # weak f64 defers to the param dtype
+    return jnp.asarray(lr, jnp.float32)
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -84,6 +101,7 @@ class Optimizer:
         functional analog of ``param.regularizer`` on the eager path, same
         precedence: per-param beats the optimizer-level one).
         """
+        lr = _pin_lr_f32(lr)
         new_params, new_state = {}, {}
         for k, v in params.items():
             g = grads.get(k)
@@ -106,6 +124,16 @@ class Optimizer:
                     self._weight_decay = saved
             else:
                 nv, ns = self.update(v, g, state.get(k, self.init_param_state(v)), lr, step)
+            # param dtype is an INVARIANT of the functional step: an
+            # update whose arithmetic promoted (strong-f32 lr from
+            # build_train_step's signature pin x bf16 param in SGD-class
+            # `value - lr * grad`) must cast back, or the donated input
+            # mismatches the output dtype and every later step retrains
+            # in the promoted dtype (Adam already casts via its master;
+            # this enforces the same contract for every subclass)
+            dt = getattr(v, "dtype", None)
+            if dt is not None and getattr(nv, "dtype", dt) != dt:
+                nv = nv.astype(dt)
             new_params[k] = nv
             new_state[k] = ns
         return new_params, new_state
@@ -347,6 +375,7 @@ class Adam(Optimizer):
         if not self.state_is_flat(state):
             raise ValueError("apply_flat needs a state from "
                              "init_flat_state (got per-param pytree)")
+        lr = _pin_lr_f32(lr)   # same f64-creep guard as ``apply``
         if self._regularizer is not None:
             raise NotImplementedError(
                 "apply_flat: optimizer-level regularizer instances ride "

@@ -1,0 +1,65 @@
+"""Tier-1 repo lint (ISSUE 3 satellite): no host-numpy calls and no
+python branches on tracer-suspect values inside the traced/kernel layers
+(ops/pallas/, models/, parallel/) — except the explicitly-reviewed
+entries in paddle_tpu/analysis/ast_allowlist.txt, every one of which must
+still be LIVE (unused entries fail too, so the allowlist cannot rot)."""
+
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis.ast_lint import (lint_repo, lint_source,
+                                          load_allowlist)
+
+
+def test_repo_lint_is_clean_against_allowlist():
+    active, allowed, unused = lint_repo()
+    msg = "\n".join(f.format() for f in active)
+    assert not active, f"unallowlisted AST-lint findings:\n{msg}"
+    assert not unused, f"stale allowlist entries (remove them): {unused}"
+    # the allowlist is meaningful, not vestigial
+    assert allowed, "expected known host-precompute allowlist hits"
+
+
+def test_lint_flags_numpy_call_in_function():
+    src = textwrap.dedent("""
+        import numpy as np
+        def kernel(x):
+            return np.tanh(x)
+    """)
+    findings = lint_source(src, "ops/pallas/fake.py")
+    assert [f.code for f in findings] == ["AST001"]
+    assert findings[0].data["function"] == "kernel"
+
+
+def test_lint_flags_python_branch_on_tracer():
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+        def body(x):
+            if jnp.any(x > 0):
+                return x
+            while (x < 0).all():
+                x = x + 1
+            return -x
+    """)
+    codes = [f.code for f in lint_source(src, "models/fake.py")]
+    assert codes == ["AST002", "AST002"]
+
+
+def test_lint_allows_dtype_predicates_and_host_code():
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+        def convert(v):
+            if jnp.issubdtype(v.dtype, jnp.floating):   # dtype predicate
+                return v.astype(jnp.float32)
+            return v
+        PI = 3.14159  # module-level host math is not a call
+    """)
+    assert lint_source(src, "models/fake.py") == []
+
+
+def test_malformed_allowlist_line_raises(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text("models/foo.py::only_two_fields\n")
+    with pytest.raises(ValueError):
+        load_allowlist(str(p))

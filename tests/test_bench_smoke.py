@@ -22,6 +22,9 @@ def test_bench_smoke_green():
                 # round-7 training-hot-path legs: accum scan (bf16
                 # carry) + fused flat AdamW vs full-batch legacy, and
                 # flash fwd+bwd (head-batched default) in interpret mode
-                "train_accum_fused_step", "flash_fwdbwd_interpret"):
+                "train_accum_fused_step", "flash_fwdbwd_interpret",
+                # round-8: the Graph Doctor gate — seeded fixtures fire,
+                # flagship sweeps clean, exemption table live
+                "doctor_self_check"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]
