@@ -234,9 +234,86 @@ def seeded_full_param_allgather() -> Report:
                  {"max_allgather_bytes": 1024 * 64 * 4 // 2}})
 
 
+# ---------------------------------------------------------------------------
+# collective_budget
+# ---------------------------------------------------------------------------
+
+
+def seeded_collective_budget() -> Report:
+    """COMM001: a step whose compiled HLO carries TWO all-reduces against
+    a declared budget of one (the per-leaf-collective regression class
+    the bucketed overlap engine exists to prevent)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+
+    mesh = _mesh(2)
+
+    def body(a, b):
+        return jax.lax.psum(a, "x") + jax.lax.psum(b * 2.0, "x")
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("x"), P("x")),
+                   out_specs=P(), check_vma=False)
+    x = jnp.ones((2 * mesh.shape["x"], 8), jnp.float32)
+    return check(fn, x, x + 1.0, passes=["collective_budget"],
+                 exemptions=(), target="seeded:COMM001",
+                 options={"collective_budget":
+                          {"allreduce": {"count": 1}}})
+
+
+def seeded_unscheduled_collective() -> Report:
+    """COMM002: with an overlap engine declared active, a shard_map body
+    issues a bare psum whose call stack contains none of the engine's
+    region functions — traffic the engine never scheduled."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+
+    mesh = _mesh(1)
+
+    def rogue_reduce(v):
+        return jax.lax.psum(v, "x")
+
+    fn = shard_map(rogue_reduce, mesh=mesh, in_specs=(P("x"),),
+                   out_specs=P(), check_vma=False)
+    x = jnp.ones((4 * mesh.shape["x"],), jnp.float32)
+    return check(fn, x, passes=["collective_budget"], exemptions=(),
+                 target="seeded:COMM002",
+                 options={"collective_budget": {"overlap_active": True}})
+
+
+def seeded_ppermute_ring_order() -> Report:
+    """COMM003: a scanned pipeline ring whose perm mixes rotation steps
+    (+1, +1, +2, 0) — stage pairings drift across ticks."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+
+    mesh = _mesh(4)
+    n = mesh.shape["x"]
+    if n < 4:
+        raise FixtureUnavailable("non-uniform ring needs an axis of >= 4")
+
+    def body(v):
+        def tick(c, _):
+            return jax.lax.ppermute(
+                c, "x", [(0, 1), (1, 2), (2, 0), (3, 3)]), None
+        c, _ = jax.lax.scan(tick, v, None, length=2)
+        return c
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                   out_specs=P("x"), check_vma=False)
+    x = jnp.ones((2 * n,), jnp.float32)
+    return check(fn, x, passes=["collective_budget"], exemptions=(),
+                 target="seeded:COMM003")
+
+
 SEEDED = {
     "COLL001": seeded_collective_order,
     "COLL002": seeded_ppermute_race,
+    "COMM001": seeded_collective_budget,
+    "COMM002": seeded_unscheduled_collective,
+    "COMM003": seeded_ppermute_ring_order,
     "DT001": seeded_fp32_matmul,
     "DT002": seeded_f64_leak,
     "DT003": seeded_fp32_carry,

@@ -2,6 +2,7 @@
 registry (core.PASS_REGISTRY); each module self-registers via
 @register_pass."""
 
+from . import collective_budget  # noqa: F401
 from . import collective_order  # noqa: F401
 from . import donation  # noqa: F401
 from . import dtype_promotion  # noqa: F401

@@ -1,0 +1,234 @@
+"""COMM — collective count/size budget + overlap-schedule conformance.
+
+PR-4's overlap engine made the collective schedule an explicit,
+engineered artifact (parallel/overlap.py): so many gathers per layer,
+reduce-scatters bucketed, rings rotating uniformly.  This pass keeps it
+that way — a regression that reintroduces per-leaf collectives (9L
+reduce-scatters instead of L buckets), an accidental psum in an eager
+helper, or a malformed pipeline ring should fail the doctor, not
+surface as a step-time cliff one TPU session later.
+
+Codes:
+- COMM001: the compiled program's collective COUNT or BYTES exceed the
+  budget the entry point declared (``options={"collective_budget":
+  {"allreduce": {"count": n, "bytes": b}, "allgather": ..., ...}}``).
+  Counted from the compiled HLO text, so GSPMD-inserted collectives are
+  covered, not just manual ones; async pairs (``all-reduce-start`` /
+  ``-done``) count once.  No declared budget -> the pass SKIPS (a
+  budget is a per-entry-point contract, not a global default).
+- COMM002: a MANUAL collective issued outside an overlap-engine region
+  while the entry point declares an overlap engine active
+  (``{"overlap_active": True}``).  Region membership is provenance:
+  the collective's trace-time call stack must contain one of
+  parallel/overlap.py's region functions (or the entry's declared
+  ``overlap_region_functions`` additions) — collectives the engine did
+  not schedule defeat its bucketing/prefetch plan silently.
+- COMM003 (the ROADMAP-queued cross-stage ppermute-ring order check): a
+  ppermute inside a scan body (a pipeline tick loop / ring schedule)
+  whose perm is NOT a uniform rotation — mixed ring steps mean stage s
+  receives from a different relative neighbour than stage s' sends
+  toward, the cross-stage pairing bug that deadlocks a static pipeline
+  schedule.  (Repeated sources/destinations are COLL002's beat.)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from ..core import (AnalysisContext, AnalysisPass, SkipPass, format_where,
+                    register_pass, sub_jaxprs, walk_eqns)
+from ..findings import Finding
+
+# manual (jaxpr-level) wire-traffic primitives, from collective_order
+from .collective_order import COLLECTIVE_PRIMS
+
+# HLO op name -> budget key
+_HLO_KINDS = {
+    "all-reduce": "allreduce",
+    "all-gather": "allgather",
+    "reduce-scatter": "reducescatter",
+    "collective-permute": "collectivepermute",
+    "all-to-all": "alltoall",
+}
+
+# one collective instruction: everything before the op name on the line
+# is the RESULT type — a single array type or a tuple of them (variadic
+# all-reduce / async -start ops); operand types live inside the parens
+# and must not be tallied
+_HLO_LINE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?\S+\s*=\s*(?P<result>[^=]*?)\s*"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute"
+    r"|all-to-all)(?P<phase>-start|-done)?\(", re.M)
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+
+def scan_hlo_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Count + byte totals per collective kind from compiled HLO text.
+    Async pairs count at the ``-start`` (the ``-done`` is skipped);
+    tuple-shaped results (variadic all-reduce — e.g. fused flat-group
+    reductions — and the start ops' state tuples) tally EVERY element's
+    bytes, not just the last."""
+    out: Dict[str, Dict[str, int]] = {
+        k: {"count": 0, "bytes": 0} for k in _HLO_KINDS.values()}
+    for m in _HLO_LINE_RE.finditer(hlo_text):
+        if m.group("phase") == "-done":
+            continue
+        kind = _HLO_KINDS[m.group("op")]
+        nbytes = 0
+        for dtype, shape in _SHAPE_RE.findall(m.group("result")):
+            elems = 1
+            for d in shape.split(","):
+                if d.strip():
+                    elems *= int(d)
+            nbytes += elems * _DTYPE_BYTES.get(dtype, 4)
+        out[kind]["count"] += 1
+        out[kind]["bytes"] += nbytes
+    return out
+
+
+def _overlap_region_funcs(extra=()) -> frozenset:
+    from ...parallel.overlap import OVERLAP_REGION_FUNCS
+
+    return OVERLAP_REGION_FUNCS | frozenset(extra)
+
+
+def _ring_steps(perm, size: int) -> List[int]:
+    return [(int(d) - int(s)) % size for s, d in perm]
+
+
+def _shard_map_axis_sizes(eqn) -> Dict[str, int]:
+    mesh = eqn.params.get("mesh")
+    try:
+        return {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+    except Exception:
+        return {}
+
+
+@register_pass
+class CollectiveBudgetPass(AnalysisPass):
+    name = "collective_budget"
+    codes = ("COMM001", "COMM002", "COMM003")
+    # the budget needs the compiled HLO, but the pass only compiles when
+    # a budget is actually declared (COMM002/COMM003 are jaxpr-level)
+    requires = "jaxpr"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        opts = ctx.options.get(self.name, {}) if ctx.options else {}
+        budget = {k: v for k, v in opts.items()
+                  if k in set(_HLO_KINDS.values())}
+        overlap_active = bool(opts.get("overlap_active"))
+        extra_funcs = tuple(opts.get("overlap_region_functions", ()))
+        if not budget and not overlap_active:
+            # COMM003 still applies (it needs no declaration), but a
+            # target with no shard_map region has nothing to check
+            if not self._has_shard_map(ctx):
+                raise SkipPass(
+                    "no collective budget declared, no overlap engine "
+                    "active, and no shard_map region to ring-check")
+        findings: List[Finding] = []
+        if budget:
+            findings.extend(self._check_budget(ctx, budget))
+        if overlap_active:
+            findings.extend(self._check_overlap_regions(ctx, extra_funcs))
+        findings.extend(self._check_ring_order(ctx))
+        return findings
+
+    # ---- COMM001 ----------------------------------------------------------
+
+    def _check_budget(self, ctx, budget) -> List[Finding]:
+        counts = scan_hlo_collectives(ctx.compiled_text)
+        findings = []
+        for kind, lim in sorted(budget.items()):
+            got = counts.get(kind, {"count": 0, "bytes": 0})
+            for dim in ("count", "bytes"):
+                if dim in lim and got[dim] > lim[dim]:
+                    unit = "" if dim == "count" else " bytes"
+                    findings.append(self.finding(
+                        "COMM001",
+                        f"{kind}: {got[dim]}{unit} per step exceeds the "
+                        f"declared budget of {lim[dim]}{unit} "
+                        f"(full tally: {got['count']} ops, "
+                        f"{got['bytes']} bytes) — the collective "
+                        f"schedule regressed past this entry point's "
+                        f"contract",
+                        data={"kind": kind, "dim": dim,
+                              "measured": got, "budget": dict(lim)}))
+        return findings
+
+    # ---- COMM002 ----------------------------------------------------------
+
+    def _has_shard_map(self, ctx) -> bool:
+        return any(eqn.primitive.name == "shard_map"
+                   for eqn, _ in walk_eqns(ctx.jaxpr))
+
+    def _check_overlap_regions(self, ctx, extra_funcs) -> List[Finding]:
+        region = _overlap_region_funcs(extra_funcs)
+        findings = []
+        for eqn, stack in walk_eqns(ctx.jaxpr):
+            if eqn.primitive.name not in COLLECTIVE_PRIMS:
+                continue
+            if not any(e.primitive.name == "shard_map" for e in stack):
+                continue          # auto-land; GSPMD's problem, not ours
+            where, data = format_where(eqn)
+            fns = set(data.get("stack_functions") or ())
+            if fns & region:
+                continue
+            findings.append(self.finding(
+                "COMM002",
+                f"{eqn.primitive.name} issued outside an overlap-engine "
+                f"region while an overlap engine is active — collectives "
+                f"the engine did not schedule run serialized against its "
+                f"prefetch/bucket plan (stack: "
+                f"{sorted(fns) or ['<no provenance>']})",
+                where=where, data=data))
+        return findings
+
+    # ---- COMM003 ----------------------------------------------------------
+
+    def _check_ring_order(self, ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        for eqn, stack in walk_eqns(ctx.jaxpr):
+            if eqn.primitive.name != "ppermute":
+                continue
+            shard_maps = [e for e in stack
+                          if e.primitive.name == "shard_map"]
+            if not shard_maps:
+                continue
+            if not any(e.primitive.name == "scan" for e in stack):
+                continue          # one-shot permute, not a ring schedule
+            perm = [tuple(int(v) for v in p)
+                    for p in eqn.params.get("perm", ())]
+            if len(perm) < 2:
+                continue
+            axes = eqn.params.get("axis_name", ())
+            axes = axes if isinstance(axes, (tuple, list)) else (axes,)
+            sizes = _shard_map_axis_sizes(shard_maps[-1])
+            size = sizes.get(str(axes[0])) if axes else None
+            if not size:
+                # axis size unresolvable (jax-internal param drift /
+                # abstract mesh): without the modulus the wrap-around
+                # pair (n-1 -> 0) of a CORRECT +1 ring reads as a
+                # different step — judging unnormalized deltas would
+                # false-positive every valid schedule, so skip this eqn
+                continue
+            steps = set(_ring_steps(perm, size))
+            if len(steps) > 1:
+                where, data = format_where(eqn)
+                findings.append(self.finding(
+                    "COMM003",
+                    f"ppermute ring inside a scanned pipeline schedule "
+                    f"mixes rotation steps {sorted(steps)} (perm "
+                    f"{perm}): stages would pair sends with the wrong "
+                    f"relative neighbour across ticks — a static ring "
+                    f"must rotate uniformly",
+                    where=where, data={**data, "perm": perm,
+                                       "steps": sorted(steps)}))
+        return findings

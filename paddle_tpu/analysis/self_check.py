@@ -89,16 +89,32 @@ def _clean_targets():
         declared_dtype=jnp.bfloat16, target="build_train_step[bf16]")
 
     # 2. grad-accum bf16-carry step with the fused flat optimizer — the
-    # headline training config; full pass suite incl. compiled HLO
+    # headline training config; full pass suite incl. compiled HLO.
+    # The collective budget here is the single-chip contract: ZERO
+    # collectives of any kind (an accidental psum in an eager helper
+    # fails the doctor, not the next TPU session).
+    zero_budget = {k: {"count": 0} for k in
+                   ("allreduce", "allgather", "reducescatter",
+                    "collectivepermute", "alltoall")}
     step4 = build_train_step(model, opt, compute_dtype=jnp.bfloat16,
                              accum_steps=4)
     yield "build_train_step[bf16,accum4]", check(
         step4, deep(params),
         opt.init_flat_state(deep(params), decay_mask=mask_all), 0, 1e-4,
         ids.reshape(4, 1, 16), labels.reshape(4, 1, 16),
-        passes=ALL_PASSES, options=donation,
+        passes=ALL_PASSES,
+        options={**donation, "collective_budget": zero_budget},
         declared_dtype=jnp.bfloat16,
         target="build_train_step[bf16,accum4]")
+
+    # 2b. the overlap-engine train step on the 8-virtual-device hybrid
+    # mesh (dp2 x sharding2 x mp2): the engine's collective schedule
+    # must stay within its declared per-step budget AND every manual
+    # collective must be engine-attributed (COMM002) — self-skips on
+    # hosts without the virtual mesh
+    if len(jax.devices()) >= 8:
+        for name, rep in _overlap_target():
+            yield name, rep
 
     # 3. llama forward/backward in isolation (no optimizer): params are
     # read-only here, so they are declared persistent for the donation
@@ -136,6 +152,46 @@ def _clean_targets():
     yield "serving_decode_chunk", check(
         fn, *args, kwargs=kwargs, options=options, passes=ALL_PASSES,
         target="serving_decode_chunk")
+
+
+def _overlap_target():
+    """Clean sweep over the communication-overlap engine's train step
+    (parallel/overlap.py via build_train_step(overlap=...)): donation
+    (the double-buffered gather carry must not defeat DON001's
+    contract), collective order, and the collective budget with
+    overlap_active — run on the dp2 x sharding2 x mp2 virtual mesh."""
+    from jax.sharding import Mesh
+
+    from .core import check
+    from paddle_tpu.models import build_train_step
+    from paddle_tpu.models.llama import apply_llama_sharding
+    from paddle_tpu.parallel.overlap import OverlapConfig
+
+    cfg, model, opt, params, ids, labels = _flagship()
+    mesh = Mesh(np.asarray(jax.devices()[:8], dtype=object).reshape(
+        2, 2, 2), ("dp", "sharding", "mp"))
+    apply_llama_sharding(model, mesh)
+    step = build_train_step(model, opt, mesh=mesh,
+                            compute_dtype=jnp.bfloat16,
+                            overlap=OverlapConfig())
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+    # per-step budget for the L=2 debug stack on this mesh, set snugly
+    # above the engine's measured schedule (fwd gathers + bwd
+    # reduce-scatters + TP/batch reductions + boundary reshards); a
+    # per-leaf-collective regression (9 leaves x L x fwd/bwd) blows
+    # straight through it
+    budget = {"overlap_active": True,
+              "allreduce": {"count": 48},
+              "allgather": {"count": 24},
+              "reducescatter": {"count": 12}}
+    yield "overlap_train_step[dp2,sharding2,mp2]", check(
+        step, params, opt.init_state(params), 0, 1e-4, ids, labels,
+        passes=["collective_budget", "collective_order", "donation"],
+        options={"donation": {"min_bytes": DONATION_MIN_BYTES},
+                 "collective_budget": budget},
+        declared_dtype=jnp.bfloat16,
+        target="overlap_train_step[dp2,sharding2,mp2]")
 
 
 def _probe_masked_grad_accum():

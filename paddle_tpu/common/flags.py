@@ -448,6 +448,35 @@ define_flag("FLAGS_sync_nccl_allreduce", True,
             "(XLA dispatch is async; the wait is block_until_ready, "
             "the NCCL-stream-sync analog).")
 
+# ---- round-9 wired additions: the communication-overlap compiler knobs.
+# The overlap engine (parallel/overlap.py) structures programs so
+# gathers/reduce-scatters CAN hide under compute; whether they DO is the
+# XLA scheduler's call — these flags push the latency-hiding scheduler
+# and async-collective-fusion switches to the compiler
+# (device.xla_overlap_flags / device.apply_xla_overlap_flags merge them
+# into XLA_FLAGS before backend init; tests/test_overlap.py proves the
+# plumbing reaches the compiler's option parser).
+define_flag("FLAGS_tpu_latency_hiding_scheduler", True,
+            "Enable XLA's latency-hiding scheduler "
+            "(--xla_tpu_enable_latency_hiding_scheduler): reorders "
+            "independent collectives ahead of compute so the overlap "
+            "engine's layer-ahead gathers actually overlap (wired: "
+            "device.xla_overlap_flags).")
+define_flag("FLAGS_tpu_async_collective_fusion", True,
+            "Enable async collective fusion "
+            "(--xla_tpu_enable_async_collective_fusion): splits "
+            "collectives into start/done pairs XLA can schedule compute "
+            "between (wired: device.xla_overlap_flags).")
+define_flag("FLAGS_tpu_async_all_gather", True,
+            "Async all-gather lowering (--xla_enable_async_all_gather) "
+            "— the ZeRO-3 prefetch gather rides this (wired: "
+            "device.xla_overlap_flags).")
+define_flag("FLAGS_tpu_async_collective_permute", True,
+            "Async collective-permute lowering "
+            "(--xla_enable_async_collective_permute) — the "
+            "collective-matmul ppermute ring rides this (wired: "
+            "device.xla_overlap_flags).")
+
 
 # ---- exemption record: reference flags with NO TPU/XLA analog --------
 # Every name in paddle/common/flags.cc is either WIRED above (same

@@ -141,3 +141,85 @@ def is_compiled_with_tpu() -> bool:
         return any(d.platform in ("tpu", "axon") for d in jax.devices())
     except Exception:
         return False
+
+
+# ---------------------------------------------------------------------------
+# XLA communication-overlap compiler knobs (round-9)
+#
+# The overlap engine (parallel/overlap.py) makes gathers/reduce-scatters
+# SCHEDULABLE under compute; these flags tell XLA's scheduler to actually
+# do it.  xla_tpu_* switches live in the TPU compiler's flag registry
+# (reachable via XLA_FLAGS before backend init, not via per-compile
+# DebugOptions on other backends), so the wiring is env-merge first,
+# per-compile options where the backend accepts them.
+# ---------------------------------------------------------------------------
+
+# FLAGS_* registry name -> XLA flag name (bool-valued)
+XLA_OVERLAP_FLAG_SPECS = {
+    "FLAGS_tpu_latency_hiding_scheduler":
+        "xla_tpu_enable_latency_hiding_scheduler",
+    "FLAGS_tpu_async_collective_fusion":
+        "xla_tpu_enable_async_collective_fusion",
+    "FLAGS_tpu_async_all_gather": "xla_enable_async_all_gather",
+    "FLAGS_tpu_async_collective_permute":
+        "xla_enable_async_collective_permute",
+}
+
+
+def xla_overlap_flags() -> list:
+    """The overlap-scheduling XLA flags as ``--name=true/false`` strings,
+    reflecting the CURRENT FLAGS_* registry values."""
+    from ..common import flags as _flags
+
+    vals = _flags.get_flags(list(XLA_OVERLAP_FLAG_SPECS))
+    return [f"--{xla}={'true' if vals[name] else 'false'}"
+            for name, xla in XLA_OVERLAP_FLAG_SPECS.items()]
+
+
+def apply_xla_overlap_flags(env=None) -> str:
+    """Merge the overlap flags into ``env['XLA_FLAGS']`` (default
+    ``os.environ``), REPLACING any stale occurrence of the same flag and
+    preserving unrelated flags.  Returns the merged string.  Must run
+    before the first jax backend instantiation to take effect — the
+    launcher path (distributed/launch) is the intended call site; late
+    calls still merge (harmless) so tests can exercise the plumbing on
+    a live backend."""
+    import os
+
+    env = os.environ if env is None else env
+    ours = {f.split("=", 1)[0]: f for f in xla_overlap_flags()}
+    kept = [tok for tok in env.get("XLA_FLAGS", "").split()
+            if tok.split("=", 1)[0] not in ours]
+    merged = " ".join(kept + list(ours.values()))
+    env["XLA_FLAGS"] = merged
+    return merged
+
+
+def overlap_compiler_options() -> dict:
+    """Per-compile DebugOptions overrides for backends whose option
+    parser carries the overlap switches (TPU).  CPU/GPU builds reject
+    unknown xla_tpu_* names at compile time — the doctor-grade behavior
+    (options are PARSED, never silently dropped) that
+    tests/test_overlap.py pins — so this returns {} off-TPU."""
+    if not is_compiled_with_tpu():
+        return {}
+    from ..common import flags as _flags
+
+    vals = _flags.get_flags(list(XLA_OVERLAP_FLAG_SPECS))
+    return {xla: bool(vals[name])
+            for name, xla in XLA_OVERLAP_FLAG_SPECS.items()}
+
+
+def compile_with_overlap_options(fn, *args, extra_options=None,
+                                 **kwargs):
+    """Lower + compile a jittable with the overlap compiler options (and
+    ``extra_options``) applied — the per-entry-point alternative to the
+    global XLA_FLAGS merge.  Returns the compiled executable."""
+    opts = dict(overlap_compiler_options())
+    if extra_options:
+        opts.update(extra_options)
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    lowered = jitted.lower(*args, **kwargs)
+    if not opts:
+        return lowered.compile()
+    return lowered.compile(compiler_options=opts)

@@ -2,8 +2,10 @@
 (analog of python/paddle/device/__init__.py)."""
 
 from ..core.device import (
-    CPUPlace, Place, TPUPlace, current_place, device_count, get_device,
-    is_compiled_with_tpu, set_device,
+    CPUPlace, Place, TPUPlace, XLA_OVERLAP_FLAG_SPECS,
+    apply_xla_overlap_flags, compile_with_overlap_options, current_place,
+    device_count, get_device, is_compiled_with_tpu,
+    overlap_compiler_options, set_device, xla_overlap_flags,
 )
 from .custom import (custom_devices, get_all_custom_device_type,
                      is_compiled_with_custom_device, register_custom_device,

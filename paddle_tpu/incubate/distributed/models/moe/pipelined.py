@@ -83,9 +83,16 @@ def pipelined_moe_forward(params: Dict[str, Any], x, mesh: Mesh,
     from .....common.jax_compat import set_mesh as _set_mesh, \
         shard_map as _shard_map
 
+    # FULL-manual region (round-9): every mesh axis is named, so the
+    # jax-0.4.x SPMD partitioner never sees a partial-manual shard_map
+    # (the PartitionId lowering it rejects).  The expert stacks keep
+    # their Shard(ep)/Shard(mp) AT-REST placement; the P("pp") in_specs
+    # gather them over ep/mp at the region boundary and the block
+    # compute runs expert-replicated inside — the parity-friendly
+    # setting this harness targets (capacity = full batch, no drops).
     with _set_mesh(mesh):
         return jax.jit(_shard_map(
-            body, mesh=mesh, axis_names={"pp"},
+            body, mesh=mesh, axis_names=set(mesh.axis_names),
             in_specs=(P("pp"), P(None)), out_specs=P(None),
             check_vma=False))(params, x)
 

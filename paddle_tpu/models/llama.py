@@ -437,11 +437,98 @@ def llama_decay_mask(model: Layer) -> Dict[str, bool]:
             for n, _ in model.named_parameters()}
 
 
+def _ce_loss(lv, labels, attn_mask, batch_sharding, mesh):
+    """Streaming CE: lse + label-logit pick, fp32 accumulation over bf16
+    logits — never materializes a full fp32 log_softmax copy
+    ([tokens, vocab] fp32 is >1GB at bench shapes; the cast and the
+    extra read/write were pure HBM burn)."""
+    if batch_sharding is not None:
+        lv = jax.lax.with_sharding_constraint(
+            lv, NamedSharding(mesh, P(batch_sharding.spec[0])))
+    lse = jax.scipy.special.logsumexp(lv.astype(jnp.float32), axis=-1)
+    nll = lse - _gold_logit(lv, labels)
+    if attn_mask is None:
+        return nll.mean()
+    w = (attn_mask > 0).astype(jnp.float32)
+    return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+_LAYER_PREFIX = "model.layers."
+
+
+def _build_overlap_forward(model: LlamaForCausalLM, mesh: Mesh, overlap,
+                           data_axes: Tuple[str, ...], compute_dtype,
+                           remat: bool, remat_policy):
+    """Build the overlap-engine forward: cast params dict -> logits.
+
+    The decoder stack runs inside parallel/overlap.py's FULL-manual
+    shard_map region (layer-ahead ZeRO-3 prefetch, bucketed grad RS,
+    collective matmul, hierarchical collectives); embedding, final norm,
+    LM head and the loss stay in GSPMD-land.  Per-layer params are
+    stacked [L, ...] at trace time — a bf16 relayout that fuses with the
+    compute-dtype cast already paid every step."""
+    from ..parallel.overlap import build_overlap_stack
+
+    cfg = model.cfg
+    L = cfg.num_hidden_layers
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    for name, p in model.named_parameters():
+        if name.startswith(_LAYER_PREFIX + "0."):
+            shapes[name[len(_LAYER_PREFIX) + 2:]] = tuple(p.shape)
+
+    def spec_for(suffix):
+        return _filter_spec_to_mesh(plan_spec_for(suffix), mesh)
+
+    stack_fwd = build_overlap_stack(
+        cfg, mesh, shapes, spec_for, overlap, batch_axes=data_axes,
+        remat=remat, remat_policy=remat_policy,
+        compute_dtype=compute_dtype)
+    cos_full, sin_full = _rope_tables(cfg.head_dim,
+                                      cfg.max_position_embeddings,
+                                      cfg.rope_theta)
+    axes = tuple(a for a in data_axes
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+    batch_entry = axes if len(axes) > 1 else (axes[0] if axes else None)
+    from ..incubate.nn.fused import _fused_rms_norm_op
+
+    rms_raw = _fused_rms_norm_op.raw_fn
+
+    def fwd(cast: Dict[str, Any], input_ids, attn_mask=None):
+        stacked = {
+            sfx: jnp.stack([cast[f"{_LAYER_PREFIX}{i}.{sfx}"]
+                            for i in range(L)])
+            for sfx in shapes}
+        s = input_ids.shape[-1]
+        # mode="clip": ids are in-range by construction; the bounds-check
+        # pred ops are extra reshard candidates for GSPMD (same rationale
+        # as llama_hybrid)
+        x = jnp.take(cast["model.embed_tokens.weight"], input_ids, axis=0,
+                     mode="clip")
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(batch_entry, None, None)))
+        cos = cos_full[:s].astype(compute_dtype)
+        sin = sin_full[:s].astype(compute_dtype)
+        seg = None
+        if attn_mask is not None:
+            seg = attn_mask.astype(jnp.int32)
+        h = stack_fwd(stacked, x, cos, sin, seg)
+        h = rms_raw(h, cast["model.norm.weight"],
+                    epsilon=cfg.rms_norm_eps)
+        if cfg.tie_word_embeddings:
+            logits = h @ cast["model.embed_tokens.weight"].T
+        else:
+            logits = h @ cast["lm_head.weight"]
+        return logits
+
+    fwd.stack_fwd = stack_fwd
+    return fwd
+
+
 def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = None,
                      data_axes: Tuple[str, ...] = ("dp", "sharding"),
                      remat: bool = False, remat_policy=None,
                      compute_dtype=jnp.bfloat16, accum_steps: int = 1,
-                     accum_dtype=None):
+                     accum_dtype=None, overlap=None):
     """Build a single donated, jitted train step:
 
         step_fn(params, opt_state, step_no, lr, input_ids, labels)
@@ -471,7 +558,16 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
     - ``opt_state`` built by ``optimizer.init_flat_state`` routes the
       update through the fused multi-tensor ``apply_flat`` (one pass
       over flattened param groups); per-param pytree state keeps the
-      legacy per-tensor ``apply``.
+      legacy per-tensor ``apply``,
+    - ``overlap`` (an ``parallel.overlap.OverlapConfig``; needs ``mesh``)
+      routes the decoder stack through the communication-overlap engine:
+      a FULL-manual shard_map region with layer-ahead ZeRO-3 gather
+      prefetch, bucketed grad reduce-scatter, ppermute-ring collective
+      matmul for the mp projections, and hierarchical ICI/DCN
+      collectives on multislice meshes (parallel/overlap.py).  Embedding,
+      final norm, LM head and the loss stay in plain GSPMD-land;
+      ``overlap=None`` keeps the flat GSPMD program (the fallback every
+      overlap lever compares against).
     """
     from ..autograd import no_grad
 
@@ -481,11 +577,21 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
                        else jnp.float32)
     batch_sharding = make_batch_shardings(mesh, data_axes) if mesh is not None \
         else None
+    ov_forward = None
+    if overlap is not None:
+        if mesh is None:
+            raise ValueError("overlap=OverlapConfig(...) needs a mesh")
+        ov_forward = _build_overlap_forward(model, mesh, overlap,
+                                            data_axes, compute_dtype,
+                                            remat, remat_policy)
 
     def loss_fn(params: Dict[str, Any], input_ids, labels, attn_mask=None):
         cast = {k: (v.astype(compute_dtype)
                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
                 for k, v in params.items()}
+        if ov_forward is not None:
+            lv = ov_forward(cast, input_ids, attn_mask)
+            return _ce_loss(lv, labels, attn_mask, batch_sharding, mesh)
         # set the remat flag only for the duration of THIS trace: jit
         # traces lazily, so a build-time flag would leak across steps
         # built with different remat settings (and into eager inference)
@@ -510,20 +616,8 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
             model.model.remat = saved_remat
             model.model.remat_policy = saved_policy
             model.model.act_sharding = saved_act
-        lv = logits._value
-        if batch_sharding is not None:
-            lv = jax.lax.with_sharding_constraint(
-                lv, NamedSharding(mesh, P(batch_sharding.spec[0])))
-        # streaming CE: lse + label-logit gather, fp32 accumulation over
-        # bf16 logits — never materializes a full fp32 log_softmax copy
-        # ([tokens, vocab] fp32 is >1GB at bench shapes; the cast and the
-        # extra read/write were pure HBM burn)
-        lse = jax.scipy.special.logsumexp(lv.astype(jnp.float32), axis=-1)
-        nll = lse - _gold_logit(lv, labels)
-        if attn_mask is None:
-            return nll.mean()
-        w = (attn_mask > 0).astype(jnp.float32)
-        return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
+        return _ce_loss(logits._value, labels, attn_mask, batch_sharding,
+                        mesh)
 
     grad_fn = jax.value_and_grad(loss_fn)
 

@@ -214,7 +214,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                             schedule: str = "gpipe",
                             virtual_chunks: int = 1,
                             data_axes: Tuple[str, ...] = ("dp", "sharding"),
-                            cpu_bf16: str = "promote"):
+                            cpu_bf16: str = "promote",
+                            overlap=None):
     """Build the fully-composed hybrid train step:
 
         step(params, opt_state, step_no, lr, input_ids, labels)
@@ -238,7 +239,21 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
       dx/dw split filling bubbles), grads computed in-schedule, and the
       embedding/LM-head outside the pipeline get their gradients through
       the executor's x-grad / loss-params channels.
+
+    Round-9: both region bodies are FULL-manual (every mesh axis in
+    ``axis_names``) — 'sharding' is handled by the overlap engine's
+    explicit ZeRO-3 bucket gathers (per-layer with prefetch on the
+    gpipe path; once per step at region entry on the schedule-explicit
+    path, whose divergent per-rank branches cannot host per-layer
+    collectives) and 'mp' by the TP-manual decoder layer
+    (parallel/overlap.decoder_layer_tp, collective-matmul dispatcher
+    included).  This retires the jax-0.4.x partial-manual shard_map gap:
+    no auto axis of degree > 1 remains inside either region, so the
+    PartitionId lowering the 0.4.37 SPMD partitioner rejects is never
+    emitted.  ``overlap`` (an overlap.OverlapConfig) tunes the engine;
+    None uses the defaults.
     """
+    from ..parallel import overlap as _ov
     pp_axis, sep_axis = "pp", "sep"
     for ax in HYBRID_AXES:
         if ax not in mesh.axis_names:
@@ -299,9 +314,45 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
 
     batch_axes = tuple(a for a in data_axes
                        if a in mesh.axis_names and mesh.shape[a] > 1)
-    batch_entry = (batch_axes if len(batch_axes) > 1
-                   else (batch_axes[0] if batch_axes else None))
     sep_entry = sep_axis if sep > 1 else None
+
+    # ---- round-9 full-manual machinery (parallel/overlap.py) ----
+    oc = overlap if overlap is not None else _ov.OverlapConfig()
+    sh_deg = int(mesh.shape["sharding"])
+    mp_deg = int(mesh.shape["mp"])
+    sh_ax = "sharding" if sh_deg > 1 else None
+    mp_ax = "mp" if mp_deg > 1 else None
+    hier = oc.resolve_hier(mesh, sh_ax)
+    shapes = _ov.llama_layer_shapes(cfg)
+    layout = _ov.plan_layer_layout(
+        shapes, mesh, lambda sfx: _filter_spec_to_mesh(
+            plan_spec_for(sfx), mesh))
+    suffix_order = sorted(shapes)
+    manual_axes = set(HYBRID_AXES)
+    if sep > 1:
+        def _sep_gqa(q, k, v):
+            """With mp-manual head splitting the LOCAL kv-head count can
+            drop below the sep degree; repeating kv heads up to the q
+            grouping is exact GQA semantics (each q head keeps its own
+            kv group) and restores ulysses' head-divisibility."""
+            if k.shape[2] % sep:
+                rep = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            return q, k, v
+
+        if sep_attn == "ring":
+            def attn_fn(q, k, v):
+                q, k, v = _sep_gqa(q, k, v)
+                return ring_flash_attention(q, k, v, axis=sep_axis,
+                                            causal=True)
+        else:
+            def attn_fn(q, k, v):
+                q, k, v = _sep_gqa(q, k, v)
+                return ulysses_attention(q, k, v, axis=sep_axis,
+                                         causal=True)
+    else:
+        attn_fn = None
 
     def _split(params):
         stacked = {k[len(_LAYER_PREFIX):]: v for k, v in params.items()
@@ -314,41 +365,99 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                                       cfg.max_position_embeddings,
                                       cfg.rope_theta)
 
-    def _make_layer_step(cos, sin):
-        def layer_step(h, lp):
-            return _decoder_layer(lp, h, cos, sin, cfg,
-                                  sep_axis if sep > 1 else None,
-                                  sep_attn), None
-
-        return jax.checkpoint(layer_step) if remat else layer_step
-
-    def pipeline_body(stacked, x, cos, sin):
-        """Manual region over {pp, sep}.  stacked leaves: [L/pp, ...]
-        (auto-sharded over sharding/mp on trailing dims); x: [m, mb,
-        s_local, hidden]; cos/sin: [s_local, head_dim]."""
-        stacked = jax.tree_util.tree_map(_wire_body, stacked)
-        x, cos, sin = _wire_body(x), _wire_body(cos), _wire_body(sin)
-        layer_step = _make_layer_step(cos, sin)
-
-        def stage_fn(stage_params, act):
-            act, _ = lax.scan(layer_step, act, stage_params)
-            return act
-
-        outs = pipeline_apply(stage_fn, stacked, x, axis=pp_axis,
-                              squeeze_stage_dim=False)
-        # only the last stage holds real outputs; broadcast across pp so
-        # the replicated-out-spec read is valid on every rank
-        is_last = (lax.axis_index(pp_axis)
-                   == _axis_size(pp_axis) - 1).astype(outs.dtype)
-        return _wire_in(_compat.psum(outs * is_last, pp_axis))
-
     from ..common.jax_compat import shard_map as _shard_map
 
-    shmap = _shard_map(
-        pipeline_body, mesh=mesh, axis_names={pp_axis, sep_axis},
-        in_specs=(P("pp"), P(None, None, sep_entry, None),
-                  P(sep_entry, None), P(sep_entry, None)),
-        out_specs=P(None, None, sep_entry, None), check_vma=False)
+    stacked_in_specs = {
+        sfx: _ov.leaf_partition_spec(layout[sfx], lead="pp")
+        for sfx in suffix_order}
+
+    _gpipe_cache: Dict[Tuple[str, ...], Any] = {}
+
+    def _gpipe_shmap(batch_axes_used: Tuple[str, ...]):
+        """Full-manual GPipe region for one batch-axes choice (the
+        micro-batch dim must tile EXACTLY over manual axes, so the axes
+        actually used depend on the call's shapes — cached per choice).
+        """
+        if batch_axes_used in _gpipe_cache:
+            return _gpipe_cache[batch_axes_used]
+        batch_entry = (batch_axes_used if len(batch_axes_used) > 1 else
+                       (batch_axes_used[0] if batch_axes_used else None))
+        seq_axes = (sep_axis,) if sep > 1 else ()
+        # gather-bucket backward: reduce-scatter folds the 'sharding'
+        # sum; the remaining batch-partial axes psum the residue
+        gather_psum = tuple(a for a in batch_axes_used
+                            if a != "sharding") + seq_axes
+        # replicated (non-gathered) leaves are batch-partial over EVERY
+        # batch/seq axis
+        sync_axes = tuple(batch_axes_used) + seq_axes
+        grad_mode = "scatter" if "sharding" in batch_axes_used else "slice"
+        itemsize = jnp.dtype(jnp.float32 if fp32_wire
+                             else compute_dtype).itemsize
+        buckets = _ov.plan_buckets(layout, suffix_order, sh_deg, mp_deg,
+                                   oc.bucket_bytes, itemsize)
+        in_bucket = {s for b in buckets for s in b}
+        sync_sfx = [s for s in suffix_order if s not in in_bucket]
+        gather_fns = [_ov.make_bucket_gather(sh_ax, hier, gather_psum,
+                                             grad_mode)
+                      for _ in buckets]
+        sync_fn = _ov.make_grad_sync(sync_axes)
+        # x is replicated over pp (only stage 0 consumes it; the other
+        # ranks' cotangents are zero) and over mp (column-parallel
+        # backward emits PARTIAL x-cotangents per mp rank)
+        x_sync = _ov.make_grad_sync(tuple(
+            a for a, d in ((pp_axis, pp), ("mp", mp_deg)) if d > 1))
+
+        def pipeline_body(stacked, x, cos, sin):
+            """FULL-manual region over all five axes.  stacked leaves:
+            [L/pp, *zero3/tp-local]; x: [m, mb_local, s_local, hidden];
+            cos/sin: [s_local, head_dim]."""
+            stacked = jax.tree_util.tree_map(_wire_body, stacked)
+            x, cos, sin = _wire_body(x), _wire_body(cos), _wire_body(sin)
+            x = x_sync(x)
+
+            def layer_fn(lp, act):
+                return _ov.decoder_layer_tp(lp, act, cos, sin, cfg,
+                                            mp_ax, oc, attn_fn=attn_fn)
+
+            def stage_fn(stage_params, act):
+                xs_buckets = [_ov._pack_bucket(stage_params, b)
+                              for b in buckets]
+                if sync_sfx:
+                    xs_sync = _ov._pack_bucket(stage_params, sync_sfx)
+                else:
+                    Lloc = next(iter(stage_params.values())).shape[0]
+                    xs_sync = jnp.zeros((Lloc, 0), x.dtype)
+                return _ov.gathered_layer_scan(
+                    layer_fn, xs_buckets, xs_sync, act, buckets,
+                    sync_sfx, layout, sh_deg, mp_deg, gather_fns,
+                    sync_fn, oc, remat=remat)
+
+            outs = pipeline_apply(stage_fn, stacked, x, axis=pp_axis,
+                                  squeeze_stage_dim=False)
+            # only the last stage holds real outputs; broadcast across
+            # pp so every rank returns the valid batch shard
+            is_last = (lax.axis_index(pp_axis)
+                       == _axis_size(pp_axis) - 1).astype(outs.dtype)
+            return _wire_in(_compat.psum(outs * is_last, pp_axis))
+
+        sm = _shard_map(
+            pipeline_body, mesh=mesh, axis_names=manual_axes,
+            in_specs=(stacked_in_specs,
+                      P(None, batch_entry, sep_entry, None),
+                      P(sep_entry, None), P(sep_entry, None)),
+            out_specs=P(None, batch_entry, sep_entry, None),
+            check_vma=False)
+        _gpipe_cache[batch_axes_used] = (sm, batch_entry)
+        return sm, batch_entry
+
+    def _pick_batch_axes(mb: int) -> Tuple[str, ...]:
+        """Largest data_axes prefix whose degree product tiles mb
+        exactly (manual in_specs demand exact tiling; 'sharding' drops
+        first and falls back to a weights-only axis)."""
+        used = batch_axes
+        while used and mb % int(np.prod([mesh.shape[a] for a in used])):
+            used = used[:-1]
+        return used
 
     # ---- schedule-explicit runtime (1F1B / ZBH1 / FThenB) ----
     sched = None
@@ -394,20 +503,40 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
 
     dpd = mesh.shape["dp"]
     dp_entry = "dp" if dpd > 1 else None
+    chunk_specs = {
+        sfx: P("pp", None,
+               *tuple(_ov.leaf_partition_spec(layout[sfx]))[1:])
+        for sfx in suffix_order}
 
     def pipeline_body_sched(chunked, x, y, cos, sin, head_params):
-        """chunked leaves arrive [v, L/(pp*v), ...] per rank (v=1 for
-        1F1B/ZBH1; VPP device-major chunks otherwise); x [m, mb_local,
-        s_local, h] (mb split over manual dp); y [m, mb_local, s_local];
-        head_params = final norm + LM head (grads via the executor's
-        loss-params channel)."""
+        """chunked leaves arrive [v, L/(pp*v), *zero3/tp-local] per rank
+        (v=1 for 1F1B/ZBH1; VPP device-major chunks otherwise); x
+        [m, mb_local, s_local, h] (mb split over manual dp); y
+        [m, mb_local, s_local]; head_params = final norm + LM head
+        (replicated in-region; grads via the loss-params channel).
+
+        FULL-manual: the sharded chunk leaves are bucket-gathered over
+        'sharding' ONCE at region entry (the executor's per-rank
+        lax.switch branches cannot host per-layer collectives — the
+        per-layer prefetch lives on the gpipe path), mp runs TP-manual
+        inside the stages, and the executor's grads are sliced back to
+        each rank's shard at region exit (batch does not ride 'sharding'
+        here, so every rank computes the identical full gradient)."""
         chunked = jax.tree_util.tree_map(_wire_body, chunked)
         head_params = jax.tree_util.tree_map(_wire_body, head_params)
         x, cos, sin = _wire_body(x), _wire_body(cos), _wire_body(sin)
-        layer_step = _make_layer_step(cos, sin)
+        chunked_full = _ov.gather_tree_over_sharding(
+            chunked, layout, lead_ndim=2, sh=sh_deg, mp=mp_deg,
+            axis=sh_ax, hier=hier, bucket_bytes=oc.bucket_bytes)
+
+        def layer_step(h, lp):
+            return _ov.decoder_layer_tp(lp, h, cos, sin, cfg, mp_ax,
+                                        oc, attn_fn=attn_fn), None
+
+        wrapped_step = jax.checkpoint(layer_step) if remat else layer_step
 
         def stage_fn(chunk, act):
-            act, _ = lax.scan(layer_step, act, chunk)
+            act, _ = lax.scan(wrapped_step, act, chunk)
             return act
 
         def loss_fn(lp, act, y_mb):
@@ -421,7 +550,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             return (lse - gold).mean() / (sep * dpd)
 
         loss, sgrads, hgrads, dxs = pipeline_train_step(
-            stage_fn, loss_fn, sched, chunked, x, y, axis=pp_axis,
+            stage_fn, loss_fn, sched, chunked_full, x, y, axis=pp_axis,
             loss_params=head_params, want_x_grad=True)
         reduce_axes = tuple(ax for ax, deg in ((sep_axis, sep),
                                                ("dp", dpd)) if deg > 1)
@@ -433,17 +562,27 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                 lambda a: _compat.psum(a, reduce_axes), sgrads)
             hgrads = jax.tree_util.tree_map(
                 lambda a: _compat.psum(a, reduce_axes), hgrads)
+        # executor grads are w.r.t. the GATHERED chunk; keep this rank's
+        # shard (identical full grads across 'sharding' — see docstring)
+        sgrads = _ov.slice_tree_own_shard(sgrads, layout, lead_ndim=2,
+                                          sh=sh_deg, axis=sh_ax)
+        if mp_deg > 1:
+            # column-parallel backward leaves the stage-0 input grads
+            # PARTIAL per mp rank; stage ranks other than stage 0 hold
+            # zeros, so the pp psum both completes and broadcasts them
+            dxs = _compat.psum(dxs, "mp")
+        if pp > 1:
+            dxs = _compat.psum(dxs, pp_axis)
         sgrads = jax.tree_util.tree_map(_wire_in, sgrads)
         hgrads = jax.tree_util.tree_map(_wire_in, hgrads)
         return loss, sgrads, hgrads, _wire_in(dxs)
 
     shmap_sched = _shard_map(
-        pipeline_body_sched, mesh=mesh,
-        axis_names={pp_axis, sep_axis, "dp"},
-        in_specs=(P("pp"), P(None, dp_entry, sep_entry, None),
+        pipeline_body_sched, mesh=mesh, axis_names=manual_axes,
+        in_specs=(chunk_specs, P(None, dp_entry, sep_entry, None),
                   P(None, dp_entry, sep_entry),
                   P(sep_entry, None), P(sep_entry, None), P()),
-        out_specs=(P(), P("pp"), P(),
+        out_specs=(P(), chunk_specs, P(),
                    P(None, dp_entry, sep_entry, None)),
         check_vma=False) if sched is not None else None
 
@@ -452,6 +591,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         outer, stacked = _split(cast)
         B, S = input_ids.shape
         mb = B // m
+        shmap, batch_entry = _gpipe_shmap(_pick_batch_axes(mb))
         ids = input_ids.reshape(m, mb, S)
         # mode="clip": token ids are in-range by construction; the default
         # fill mode's bounds-check pred ops are extra reshard candidates
@@ -503,8 +643,10 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             decay_mask={n: n not in no_decay for n in names})
 
     def step_fn(params, opt_state, step_no, lr, input_ids, labels):
-        if batch_entry is not None or sep_entry is not None:
-            bs = NamedSharding(mesh, P(batch_entry, sep_entry))
+        outer_batch = (batch_axes if len(batch_axes) > 1
+                       else (batch_axes[0] if batch_axes else None))
+        if outer_batch is not None or sep_entry is not None:
+            bs = NamedSharding(mesh, P(outer_batch, sep_entry))
             input_ids = lax.with_sharding_constraint(input_ids, bs)
             labels = lax.with_sharding_constraint(labels, bs)
         loss, grads = grad_fn(params, input_ids, labels)

@@ -155,6 +155,20 @@ def _install():
         # linalg-flavoured methods the reference also patches on
         "outer", "inner", "cross", "cov", "corrcoef", "renorm",
         "tensordot",
+        # ---- round-9 tranche: view/split/scatter/cum families ----
+        # shape views & splits
+        "vsplit", "hsplit", "dsplit", "tensor_split", "unflatten",
+        "as_strided", "view", "view_as", "unfold", "moveaxis",
+        "repeat_interleave", "rot90",
+        # diagonal / scatter-by-position
+        "diag", "diagflat", "diag_embed", "diagonal_scatter",
+        "select_scatter", "slice_scatter", "scatter_nd_add",
+        # sampling / special / integration
+        "multinomial", "polygamma", "combinations", "vander",
+        "trapezoid", "cumulative_trapezoid", "histogram_bin_edges",
+        # elementwise tail
+        "addmm", "bitwise_left_shift", "bitwise_right_shift",
+        "reduce_as", "isposinf", "isneginf", "cdist",
     ]
 
     def mk_top(opname):
@@ -186,6 +200,10 @@ def _install():
         "logical_not_", "bitwise_not_", "where_", "flatten_",
         "reshape_", "squeeze_", "unsqueeze_", "transpose_", "tril_",
         "triu_", "masked_fill_",
+        # round-9 tranche: scan/scatter/random-fill in-place forms
+        "cumsum_", "cumprod_", "index_fill_", "index_put_",
+        "masked_scatter_", "scatter_", "bernoulli_", "normal_",
+        "log_normal_", "geometric_",
     ]
     def mk_in(opname):
         def method(self, *args, **kwargs):

@@ -12,3 +12,4 @@ SURVEY.md §2.7.
 from .ring_attention import ring_flash_attention
 from .sep import ulysses_attention
 from .pipelining import pipeline_apply
+from .overlap import OverlapConfig

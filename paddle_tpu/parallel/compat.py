@@ -46,3 +46,29 @@ def all_to_all(x, axis_name, split_axis, concat_axis, *, tiled=False):
                               tiled=tiled).astype(jnp.bfloat16)
     return lax.all_to_all(x, axis_name, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=tiled)
+
+
+def psum_scatter(x, axis_name, *, scatter_dimension=0, tiled=True,
+                 axis_index_groups=None):
+    """Reduce-scatter (the ZeRO grad primitive).  Like psum, it is a
+    REDUCTION, so the bf16 XLA:CPU crash applies — promote on cpu."""
+    if _promote(x):
+        return lax.psum_scatter(
+            x.astype(jnp.float32), axis_name,
+            scatter_dimension=scatter_dimension, tiled=tiled,
+            axis_index_groups=axis_index_groups).astype(jnp.bfloat16)
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension,
+                            tiled=tiled,
+                            axis_index_groups=axis_index_groups)
+
+
+def all_gather(x, axis_name, *, axis=0, tiled=True,
+               axis_index_groups=None):
+    """All-gather is pure data movement (no reduction region for
+    XLA:CPU's AllReducePromotion to miscompile), so no dtype promotion
+    is needed on any backend — kept here so every manual collective the
+    overlap engine issues routes through ONE module (the Graph Doctor's
+    COMM002 overlap-region attribution keys on provenance)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled,
+                          axis_index_groups=axis_index_groups)

@@ -25,6 +25,11 @@ def test_bench_smoke_green():
                 "train_accum_fused_step", "flash_fwdbwd_interpret",
                 # round-8: the Graph Doctor gate — seeded fixtures fire,
                 # flagship sweeps clean, exemption table live
-                "doctor_self_check"):
+                "doctor_self_check",
+                # round-9: overlap engine vs flat GSPMD parity on the
+                # dp2 x sharding2 x mp2 virtual mesh, and the
+                # collective_budget pass (COMM fixtures + the flagship
+                # zero-collective budget)
+                "overlap_parity", "collective_budget_doctor"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]

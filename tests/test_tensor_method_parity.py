@@ -62,6 +62,25 @@ _REQUIRED_METHODS = [
     "unsqueeze_", "transpose_", "tril_", "triu_", "masked_fill_",
 ]
 
+# names added by the round-9 tranche (view/split/scatter/cum families +
+# in-place forms) — single source of truth: appended into
+# _REQUIRED_METHODS below AND counted against the >=40 floor by
+# test_method_count_tranche_round9
+_ROUND9_TRANCHE = [
+    "vsplit", "hsplit", "dsplit", "tensor_split", "unflatten",
+    "as_strided", "view", "view_as", "unfold", "moveaxis",
+    "repeat_interleave", "rot90", "diag", "diagflat", "diag_embed",
+    "diagonal_scatter", "select_scatter", "slice_scatter",
+    "scatter_nd_add", "multinomial", "polygamma", "combinations",
+    "vander", "trapezoid", "cumulative_trapezoid",
+    "histogram_bin_edges", "addmm", "bitwise_left_shift",
+    "bitwise_right_shift", "reduce_as", "isposinf", "isneginf", "cdist",
+    "cumsum_", "cumprod_", "index_fill_", "index_put_",
+    "masked_scatter_", "scatter_", "bernoulli_", "normal_",
+    "log_normal_", "geometric_",
+]
+_REQUIRED_METHODS += _ROUND9_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -176,3 +195,40 @@ def test_method_count_tranche():
                      "rad2deg")]
     wired = [n for n in new_names if hasattr(Tensor, n)]
     assert len(wired) >= 30, len(wired)
+
+
+def test_method_count_tranche_round9():
+    """The round-9 tranche satisfies the ~40-new-names floor (ISSUE 4
+    satellite: view/split/scatter/cum families + their in-place forms)
+    over the round-7 surface."""
+    wired = [n for n in _ROUND9_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 40, (len(wired),
+                              sorted(set(_ROUND9_TRANCHE) - set(wired)))
+
+
+def test_round9_view_split_method_values():
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_array_equal(
+        np.asarray(t.moveaxis(0, 1)._value).shape, (3, 2))
+    np.testing.assert_allclose(np.asarray(t.view([3, 2])._value),
+                               np.arange(6, dtype=np.float32)
+                               .reshape(3, 2))
+    parts = t.vsplit(2)
+    assert [tuple(np.asarray(p_._value).shape) for p_ in parts] \
+        == [(1, 3), (1, 3)]
+    v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(v.diag()._value),
+                               np.diag([1.0, 2.0]))
+    r = paddle.to_tensor(np.array([1, 2], np.int64)).repeat_interleave(2)
+    np.testing.assert_array_equal(np.asarray(r._value), [1, 1, 2, 2])
+
+
+def test_round9_inplace_scan_methods():
+    v = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    r = v.cumsum_()
+    assert r is v
+    np.testing.assert_allclose(np.asarray(v._value), [1.0, 3.0, 6.0])
+    w = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    r = w.cumprod_(0)
+    assert r is w
+    np.testing.assert_allclose(np.asarray(w._value), [1.0, 2.0, 6.0])
