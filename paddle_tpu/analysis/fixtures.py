@@ -308,6 +308,89 @@ def seeded_ppermute_ring_order() -> Report:
                  target="seeded:COMM003")
 
 
+# ---------------------------------------------------------------------------
+# memory_budget
+# ---------------------------------------------------------------------------
+
+
+def seeded_peak_over_budget() -> Report:
+    """MEM001: a step whose compiled peak (arguments alone, here) blows
+    through a deliberately tiny declared HBM budget."""
+
+    @jax.jit
+    def bug(a, b):
+        return (a @ b).sum()
+
+    a = jnp.ones((512, 512), jnp.float32)          # 1 MB per operand
+    return check(bug, a, a, passes=["memory_budget"], exemptions=(),
+                 target="seeded:MEM001",
+                 options={"memory_budget": {"hbm_bytes": 64 << 10}})
+
+
+def seeded_host_round_trip() -> Report:
+    """MEM002: a whole buffer round-tripped host↔device in one
+    monolithic pair of transfers against a streaming budget sized for
+    half of it — the accidental full-state movement the size-capped
+    bucket engine exists to prevent."""
+    from ..common.jax_compat import transfer_to_memory_kind
+    from ..core.device import default_memory_kind, host_memory_kind
+
+    kind = host_memory_kind()
+    if kind is None or transfer_to_memory_kind(kind) is None:
+        raise FixtureUnavailable(
+            "toolchain/backend exposes no host memory kind to transfer "
+            "to (very old jax)")
+    from ..common.jax_compat import device_put_memory_kind
+
+    @jax.jit
+    def bug(a):
+        h = device_put_memory_kind(a, kind)                 # all out...
+        back = device_put_memory_kind(h, default_memory_kind())
+        return back * 2.0                                   # ...all back
+
+    a = jnp.ones((512, 512), jnp.float32)          # 1 MB each direction
+    return check(bug, a, passes=["memory_budget"], exemptions=(),
+                 target="seeded:MEM002",
+                 options={"memory_budget":
+                          {"host_transfer_bytes": 1 << 20}})
+
+
+def seeded_while_peeling() -> Report:
+    """HLO003 over a captured-HLO sample: a scanned body's all-gather
+    duplicated TWICE into the hosting computation (XLA's peel+unroll
+    cannot be forced portably on one CPU device, so — like HLO001 — the
+    fixture proves the detector; the compile-and-scan plumbing rides
+    the clean flagship sweeps)."""
+    from .passes.hlo_checks import scan_while_peeling
+
+    sample = """\
+HloModule peeled_layer_stack
+
+%body.7 (p.1: (f32[128,8], u32[])) -> (f32[128,8], u32[]) {
+  %p.1 = (f32[128,8], u32[]) parameter(0)
+  %x.1 = f32[128,8] get-tuple-element(%p.1), index=0
+  %ag.1 = f32[256,8] all-gather(%x.1), replica_groups={}, dimensions={0}
+  %r.1 = f32[128,8] slice(%ag.1), slice={[0:128], [0:8]}
+}
+
+%cond.7 (c.1: (f32[128,8], u32[])) -> pred[] {
+  %c.1 = (f32[128,8], u32[]) parameter(0)
+}
+
+ENTRY %main.42 (a.1: f32[128,8]) -> f32[128,8] {
+  %a.1 = f32[128,8] parameter(0)
+  %ag.peel0 = f32[256,8] all-gather(%a.1), replica_groups={}, dimensions={0}
+  %ag.peel1 = f32[256,8] all-gather(%a.1), replica_groups={}, dimensions={0}
+  %t.1 = (f32[128,8], u32[]) tuple(%a.1)
+  %w.1 = (f32[128,8], u32[]) while(%t.1), condition=%cond.7, body=%body.7
+  %out.1 = f32[128,8] get-tuple-element(%w.1), index=0
+}
+"""
+    findings = scan_while_peeling(sample)
+    return Report(target="seeded:HLO003", findings=findings,
+                  passes_run=("hlo_post_checks",))
+
+
 SEEDED = {
     "COLL001": seeded_collective_order,
     "COLL002": seeded_ppermute_race,
@@ -323,4 +406,7 @@ SEEDED = {
     "RT002": seeded_signature_churn,
     "HLO001": seeded_involuntary_remat,
     "HLO002": seeded_full_param_allgather,
+    "HLO003": seeded_while_peeling,
+    "MEM001": seeded_peak_over_budget,
+    "MEM002": seeded_host_round_trip,
 }

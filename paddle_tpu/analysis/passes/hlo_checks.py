@@ -19,12 +19,24 @@ Codes:
   is a full-param-set gather, not the expected per-layer one.  Threshold
   overridable via ``options={"hlo_post_checks": {"max_allgather_bytes":
   N}}``.
+- HLO003 (the ROADMAP round-8-queued while-loop peeling detector): a
+  collective issued inside a ``while`` body (a scanned decoder stack)
+  appears MORE THAN ``max_peeled_copies`` times (default 1) with the
+  identical (op, result-type) signature in the computation hosting the
+  while — XLA peeled/unrolled the scanned layer body, duplicating its
+  collectives outside the loop.  Each duplicated collective is compiled
+  code and schedule the overlap engine never planned (and on a pod it
+  re-serializes the prefetch schedule).  The ONE allowed copy is the
+  engine's own double-buffered prologue (layer 0's gather is issued
+  before the scan by design — gathered_layer_scan); override via
+  ``options={"hlo_post_checks": {"max_peeled_copies": N}}``.
 """
 
 from __future__ import annotations
 
 import re
-from typing import List, Tuple
+from collections import Counter, defaultdict
+from typing import Dict, List, Tuple
 
 import jax.tree_util as jtu
 
@@ -90,10 +102,71 @@ def scan_allgather_sizes(hlo_text: str) -> List[Tuple[int, str]]:
     return out
 
 
+# one collective instruction's (op, result-type) signature — the RHS
+# before the op name is the result type; whitespace-normalized so the
+# same collective formats identically inside and outside the loop body
+_COLL_SIG_RE = re.compile(
+    r"=\s*(?P<result>[^=]*?)\s*"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute"
+    r"|all-to-all)(?P<phase>-start|-done)?\(")
+
+_WHILE_BODY_RE = re.compile(r"\bbody=\s*%?([\w.\-]+)")
+
+
+def scan_while_peeling(hlo_text: str, max_peeled_copies: int = 1
+                       ) -> List[Finding]:
+    """HLO003 findings from compiled HLO text: collectives of a while
+    body duplicated (beyond the allowed prologue copy) into the
+    computation hosting the while.  Computation headers sit at column 0
+    and end with '{' in XLA's text dump; instructions are indented."""
+    colls: Dict[str, List[Tuple[str, str]]] = defaultdict(list)
+    whiles: List[Tuple[str, str]] = []       # (parent_comp, body_comp)
+    comp = None
+    for raw in hlo_text.splitlines():
+        if raw and not raw[0].isspace() and raw.rstrip().endswith("{"):
+            m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)", raw.strip())
+            comp = m.group(1) if m else None
+            continue
+        if comp is None:
+            continue
+        m = _COLL_SIG_RE.search(raw)
+        if m and m.group("phase") != "-done":
+            colls[comp].append((m.group("op"),
+                                re.sub(r"\s+", "", m.group("result"))))
+        if "while(" in raw:
+            mb = _WHILE_BODY_RE.search(raw)
+            if mb:
+                whiles.append((comp, mb.group(1)))
+    findings: List[Finding] = []
+    for parent, body in whiles:
+        body_sigs = colls.get(body, [])
+        if not body_sigs:
+            continue
+        parent_counts = Counter(colls.get(parent, []))
+        for sig in sorted(set(body_sigs)):
+            copies = parent_counts.get(sig, 0)
+            if copies <= max_peeled_copies:
+                continue
+            findings.append(Finding(
+                code="HLO003", pass_name="hlo_post_checks",
+                message=(
+                    f"while body {body!r} issues a {sig[0]} "
+                    f"({sig[1]}) that appears {copies}x outside the "
+                    f"loop in {parent!r} (allowed prologue copies: "
+                    f"{max_peeled_copies}) — XLA peeled/unrolled the "
+                    f"scanned layer body, duplicating its collectives "
+                    f"into straight-line code the overlap schedule "
+                    f"never planned"),
+                data={"body": body, "parent": parent, "op": sig[0],
+                      "result": sig[1][:200], "copies": copies,
+                      "allowed": max_peeled_copies}))
+    return findings
+
+
 @register_pass
 class HloPostChecksPass(AnalysisPass):
     name = "hlo_post_checks"
-    codes = ("HLO000", "HLO001", "HLO002")
+    codes = ("HLO000", "HLO001", "HLO002", "HLO003")
     requires = "compiled"
 
     def run(self, ctx: AnalysisContext) -> List[Finding]:
@@ -110,6 +183,9 @@ class HloPostChecksPass(AnalysisPass):
                 data={"error": repr(e)[:300]})]
         findings = scan_compile_warnings(stderr_text)
         findings.extend(self._check_allgathers(ctx))
+        findings.extend(scan_while_peeling(
+            ctx.compiled_text,
+            ctx.opt(self.name, "max_peeled_copies", 1)))
         return findings
 
     def _max_arg_leaf_bytes(self, ctx) -> int:

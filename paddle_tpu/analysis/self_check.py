@@ -40,6 +40,45 @@ ALL_PASSES = None
 # this size trips DON001 at this threshold.
 DONATION_MIN_BYTES = 4 << 10
 
+# Round-10 capacity contracts for the DEBUG-shaped flagship (see the
+# step-2 comment below and BASELINE.md round-10): peak ~2.24 MB ->
+# budget 3 MB; the memory-engine step streams the two fp32 moment
+# groups (~1 MB each) in and out once per step (~4.2 MB of memory-kind
+# transfers) -> streaming budget 6 MB.  Snug on purpose: one extra
+# full-group round trip (+2 MB) or an un-donated params copy (+1 MB)
+# fails the doctor.
+FLAGSHIP_HBM_BUDGET = 3 << 20
+FLAGSHIP_STREAM_BUDGET = 6 << 20
+
+
+def _memory_target(donation_opts):
+    """The memory-engine flagship sweep: MemoryConfig(names, host) —
+    named-saveable remat + host-offloaded bucket-streamed AdamW — under
+    the peak + streaming budgets, donation, and the dtype audit."""
+    from .core import check
+    from paddle_tpu.models import build_train_step
+    from paddle_tpu.models.llama import llama_decay_mask
+    from paddle_tpu.parallel.memory import (MemoryConfig,
+                                            init_offloaded_state)
+
+    cfg, model, opt, params, ids, labels = _flagship()
+    mask_all = llama_decay_mask(model)
+    mc = MemoryConfig(remat="names", optimizer_residency="host",
+                      stream_bucket_bytes=256 << 10)
+    step = build_train_step(model, opt, compute_dtype=jnp.bfloat16,
+                            memory=mc)
+    st = init_offloaded_state(opt, params, decay_mask=mask_all,
+                              bucket_bytes=mc.stream_bucket_bytes)
+    return check(
+        step, params, st, 0, 1e-4, ids, labels,
+        passes=["dtype_promotion", "donation", "memory_budget"],
+        options={**donation_opts,
+                 "memory_budget":
+                     {"hbm_bytes": FLAGSHIP_HBM_BUDGET,
+                      "host_transfer_bytes": FLAGSHIP_STREAM_BUDGET}},
+        declared_dtype=jnp.bfloat16,
+        target="memory_train_step[names,host]")
+
 
 def _flagship():
     """Tiny flagship bundle shared by the clean sweeps (debug shapes —
@@ -92,7 +131,12 @@ def _clean_targets():
     # headline training config; full pass suite incl. compiled HLO.
     # The collective budget here is the single-chip contract: ZERO
     # collectives of any kind (an accidental psum in an eager helper
-    # fails the doctor, not the next TPU session).
+    # fails the doctor, not the next TPU session).  Round-10 adds the
+    # capacity contract: the debug-shaped flagship compiles to ~2.24 MB
+    # peak (arguments + outputs + temporaries − donation aliasing);
+    # the declared FLAGSHIP_HBM_BUDGET pins it with ~0.8 MB headroom,
+    # so an un-donated params copy (+1 MB) or a materialized fp32
+    # logits buffer fails MEM001 here, not a TPU session.
     zero_budget = {k: {"count": 0} for k in
                    ("allreduce", "allgather", "reducescatter",
                     "collectivepermute", "alltoall")}
@@ -103,9 +147,18 @@ def _clean_targets():
         opt.init_flat_state(deep(params), decay_mask=mask_all), 0, 1e-4,
         ids.reshape(4, 1, 16), labels.reshape(4, 1, 16),
         passes=ALL_PASSES,
-        options={**donation, "collective_budget": zero_budget},
+        options={**donation, "collective_budget": zero_budget,
+                 "memory_budget": {"hbm_bytes": FLAGSHIP_HBM_BUDGET}},
         declared_dtype=jnp.bfloat16,
         target="build_train_step[bf16,accum4]")
+
+    # 2a. the HBM memory engine's train step (round-10): named-policy
+    # remat + host-offloaded bucket-streamed AdamW, audited under BOTH
+    # capacity contracts — the peak budget and the streaming budget
+    # (a regression to monolithic full-state round trips fails MEM002)
+    # — plus donation (host-resident state must still donate cleanly)
+    # and the dtype audit
+    yield "memory_train_step[names,host]", _memory_target(donation)
 
     # 2b. the overlap-engine train step on the 8-virtual-device hybrid
     # mesh (dp2 x sharding2 x mp2): the engine's collective schedule

@@ -62,3 +62,76 @@ def set_mesh(mesh):
     if sm is not None:
         return sm(mesh)
     return mesh
+
+
+# ---------------------------------------------------------------------------
+# memory-kind shims (round-10): the HBM memory engine places optimizer /
+# activation state in ``pinned_host`` and streams it back per bucket.
+# The public surface moved across versions — jax.sharding exposes
+# TransferToMemoryKind on newer toolchains, 0.4.x keeps it in
+# jax._src.sharding_impls; Device.addressable_memories / memory kinds on
+# shardings may be absent entirely on old CPU wheels.  Every helper here
+# degrades to "no memory kinds" (None / identity) instead of raising, so
+# the offload lattice falls back to device residency with the SAME code
+# path (the residency contract stays exercised on CPU).
+# ---------------------------------------------------------------------------
+
+
+def transfer_to_memory_kind(kind):
+    """TransferToMemoryKind(kind) where the class exists (public home
+    first, 0.4.x private home second); None when the toolchain has no
+    memory-kind transfer support — callers must then skip the transfer
+    (identity), not crash."""
+    if kind is None:
+        return None
+    cls = getattr(jax.sharding, "TransferToMemoryKind", None)
+    if cls is None:
+        try:
+            from jax._src.sharding_impls import (
+                TransferToMemoryKind as cls)
+        except ImportError:
+            return None
+    return cls(kind)
+
+
+def device_memory_kinds(device=None):
+    """Memory kinds addressable by ``device`` (default: first device),
+    default kind FIRST.  () when the toolchain/backend exposes no memory
+    spaces (very old jax, exotic plugins)."""
+    try:
+        d = device if device is not None else jax.devices()[0]
+        default = d.default_memory().kind
+        kinds = [m.kind for m in d.addressable_memories()]
+    except Exception:
+        return ()
+    return tuple([default] + [k for k in kinds if k != default])
+
+
+def sharding_with_memory_kind(sharding, kind):
+    """``sharding.with_memory_kind(kind)``; the original sharding when
+    kind is None or the toolchain predates memory-kind shardings."""
+    if kind is None:
+        return sharding
+    fn = getattr(sharding, "with_memory_kind", None)
+    if fn is None:
+        return sharding
+    return fn(kind)
+
+
+def device_put_memory_kind(x, kind):
+    """Transfer ``x`` to memory space ``kind`` (the streaming primitive
+    of the offload engine).  Under a trace it uses TransferToMemoryKind
+    (the only form jit accepts); on concrete arrays it derives a
+    concrete sharding via with_memory_kind (the only form EAGER
+    device_put accepts).  Identity when the toolchain has no memory
+    kinds or ``kind`` is None — the bucket loop still runs, only the
+    residency change is elided."""
+    t = transfer_to_memory_kind(kind)
+    if t is None:
+        return x
+    if isinstance(x, jax.core.Tracer):
+        return jax.device_put(x, t)
+    sh = getattr(x, "sharding", None)
+    if sh is None or getattr(sh, "memory_kind", None) == kind:
+        return x
+    return jax.device_put(x, sharding_with_memory_kind(sh, kind))

@@ -144,6 +144,60 @@ def is_compiled_with_tpu() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# memory-kind capability probe (round-10)
+#
+# The HBM memory engine (parallel/memory.py) parks optimizer state and
+# activation saveables in host memory and streams them back per bucket.
+# Whether a distinct host memory space EXISTS is a backend property: TPU
+# exposes {"device", "pinned_host"}, the CPU backend only
+# {"unpinned_host"} (host == device, transfers alias), and very old jax
+# wheels expose nothing.  These probes are the single source of truth the
+# engine keys its fallbacks on.
+# ---------------------------------------------------------------------------
+
+
+def memory_kinds() -> tuple:
+    """Memory kinds of the current default device, default kind first
+    (() when the toolchain exposes no memory spaces)."""
+    from ..common import jax_compat as _jc
+
+    return _jc.device_memory_kinds()
+
+
+def default_memory_kind():
+    """The device's default (compute-resident) memory kind, or None."""
+    kinds = memory_kinds()
+    return kinds[0] if kinds else None
+
+
+def supports_memory_kind(kind: str) -> bool:
+    return kind in memory_kinds()
+
+
+def host_memory_kind():
+    """The memory kind the offload engine should stream state TO:
+    ``pinned_host`` where it exists (TPU), else the backend's host-side
+    default when that IS the default memory (CPU: ``unpinned_host`` —
+    transfers become traced aliases, so the residency contract and the
+    MEM002 transfer audit still see them), else None (no offload
+    support; callers keep device residency)."""
+    kinds = memory_kinds()
+    if "pinned_host" in kinds:
+        return "pinned_host"
+    if kinds and "host" in kinds[0]:
+        return kinds[0]
+    return None
+
+
+def host_offload_distinct() -> bool:
+    """True when host offload actually MOVES bytes off the compute
+    memory (a distinct pinned_host space exists).  False on CPU, where
+    the fallback kind aliases device memory — capacity numbers are then
+    structural only."""
+    return "pinned_host" in memory_kinds()
+
+
+# ---------------------------------------------------------------------------
 # XLA communication-overlap compiler knobs (round-9)
 #
 # The overlap engine (parallel/overlap.py) makes gathers/reduce-scatters

@@ -4,8 +4,10 @@
 from ..core.device import (
     CPUPlace, Place, TPUPlace, XLA_OVERLAP_FLAG_SPECS,
     apply_xla_overlap_flags, compile_with_overlap_options, current_place,
-    device_count, get_device, is_compiled_with_tpu,
-    overlap_compiler_options, set_device, xla_overlap_flags,
+    default_memory_kind, device_count, get_device, host_memory_kind,
+    host_offload_distinct, is_compiled_with_tpu, memory_kinds,
+    overlap_compiler_options, set_device, supports_memory_kind,
+    xla_overlap_flags,
 )
 from .custom import (custom_devices, get_all_custom_device_type,
                      is_compiled_with_custom_device, register_custom_device,

@@ -73,6 +73,9 @@ _INPLACE_BASES = [
     "bitwise_left_shift", "bitwise_right_shift", "gammainc", "gammaincc",
     "gammaln", "gcd", "i0", "lcm", "ldexp", "logit", "masked_scatter",
     "multigammaln", "polygamma", "renorm", "sinc",
+    # round-10 tranche (sorting/searching/linalg method satellite):
+    # in-place forms the reference also patches onto Tensor
+    "lerp", "put_along_axis",
     # round-7 tranche (tensor-method satellite: these also bind onto
     # Tensor as `t.<base>_()` methods in ops/tensor_methods.py)
     "add", "subtract", "clip", "exp", "sqrt", "rsqrt", "sigmoid",

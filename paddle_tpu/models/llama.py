@@ -160,6 +160,22 @@ class LlamaMLP(Layer):
         return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
+def _tag_saveable(t: Tensor, name: str) -> Tensor:
+    """checkpoint_name the residual-stream block outputs (the HBM memory
+    engine's named saveables — parallel/memory.SAVEABLE_NAMES): the
+    ``names``/``offload`` remat policies key on exactly these tags.
+    Skipped under an active eager tape — re-wrapping the value would
+    sever the Tensor's grad history, and policies only ever see tags
+    through the jitted functional path anyway."""
+    from ..autograd import is_grad_enabled
+
+    if is_grad_enabled():
+        return t
+    from ..parallel.memory import tag_saveable
+
+    return Tensor(tag_saveable(t._value, name))
+
+
 class LlamaDecoderLayer(Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -170,11 +186,12 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, x, cos, sin, attn_mask=None,
                 startend_row_indices=None):
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin,
-                               attn_mask=attn_mask,
-                               startend_row_indices=startend_row_indices)
-        x = x + self.mlp(self.post_attention_layernorm(x))
-        return x
+        attn = self.self_attn(self.input_layernorm(x), cos, sin,
+                              attn_mask=attn_mask,
+                              startend_row_indices=startend_row_indices)
+        x = x + _tag_saveable(attn, "decoder_attn_out")
+        mlp = self.mlp(self.post_attention_layernorm(x))
+        return x + _tag_saveable(mlp, "decoder_mlp_out")
 
 
 class LlamaModel(Layer):
@@ -528,7 +545,7 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
                      data_axes: Tuple[str, ...] = ("dp", "sharding"),
                      remat: bool = False, remat_policy=None,
                      compute_dtype=jnp.bfloat16, accum_steps: int = 1,
-                     accum_dtype=None, overlap=None):
+                     accum_dtype=None, overlap=None, memory=None):
     """Build a single donated, jitted train step:
 
         step_fn(params, opt_state, step_no, lr, input_ids, labels)
@@ -567,10 +584,25 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
       collectives on multislice meshes (parallel/overlap.py).  Embedding,
       final norm, LM head and the loss stay in plain GSPMD-land;
       ``overlap=None`` keeps the flat GSPMD program (the fallback every
-      overlap lever compares against).
+      overlap lever compares against),
+    - ``memory`` (a ``parallel.memory.MemoryConfig``) drives the HBM
+      memory engine: its NAMED remat policy (``none | dots | names |
+      offload | full`` over the checkpoint_name-tagged decoder
+      saveables) replaces the binary ``remat``/``remat_policy`` pair on
+      BOTH the GSPMD and overlap paths, and
+      ``optimizer_residency='host'`` routes the update through the
+      bucket-streamed ``apply_flat_offloaded`` when ``opt_state`` was
+      built by ``parallel.memory.init_offloaded_state`` (detection is
+      structural, like the flat state).
     """
     from ..autograd import no_grad
+    from ..parallel import memory as _memory
 
+    if memory is not None:
+        # the named policy owns the remat decision end to end — a
+        # caller mixing memory= with the legacy binary flag would get
+        # whichever traced last, so resolve once, here
+        remat, remat_policy = memory.resolve_remat()
     decay_mask = llama_decay_mask(model)
     if accum_dtype is None:
         accum_dtype = (jnp.bfloat16 if compute_dtype == jnp.bfloat16
@@ -621,15 +653,32 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
 
     grad_fn = jax.value_and_grad(loss_fn)
 
+    # flat-buffer layout pin for the fused optimizer paths on a mesh:
+    # shards the bandwidth-bound update chain across every device (the
+    # 2004.13336 cross-replica weight-update sharding) AND guards the
+    # concat→update→slice chain against the GSPMD mis-lowering the
+    # round-10 parity tests caught (see Adam.apply_flat)
+    flat_sharding = None
+    if mesh is not None:
+        flat_axes = tuple(a for a in mesh.axis_names if mesh.shape[a] > 1)
+        flat_sharding = NamedSharding(
+            mesh, P(flat_axes if flat_axes else None))
+
     def apply_update(params, grads, opt_state, lr, step_no):
-        # flat (fused multi-tensor) state routes the single-pass AdamW;
-        # detection is structural so legacy per-param state keeps working
+        # host-offloaded bucketed state (parallel/memory.py) routes the
+        # streamed fused AdamW; flat (fused multi-tensor) state the
+        # single-pass device-resident one — detection is structural in
+        # both cases so legacy per-param state keeps working
+        if _memory.state_is_offloaded(opt_state):
+            return _memory.apply_flat_offloaded(
+                optimizer, params, grads, opt_state, lr, step_no + 1,
+                decay_mask=decay_mask, flat_sharding=flat_sharding)
         if hasattr(optimizer, "apply_flat") \
                 and getattr(optimizer, "state_is_flat", lambda s: False)(
                     opt_state):
             return optimizer.apply_flat(
                 params, grads, opt_state, lr, step_no + 1,
-                decay_mask=decay_mask)
+                decay_mask=decay_mask, flat_sharding=flat_sharding)
         return optimizer.apply(
             params, grads, opt_state, lr, step_no + 1,
             decay_mask=decay_mask)

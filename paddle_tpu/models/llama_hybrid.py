@@ -194,12 +194,20 @@ def _decoder_layer(lp: Dict[str, Any], x, cos, sin, cfg: LlamaConfig,
     else:
         attn = ulysses_attention(q, k, v, axis=sep_axis, causal=True)
     attn = attn.astype(x.dtype).reshape(b, sl, nh * hd)
-    x = x + attn @ lp["self_attn.o_proj.weight"]
+    # residual-stream saveable tags (parallel/memory.SAVEABLE_NAMES):
+    # the named remat policies select/offload these on the hybrid path
+    # exactly as on the GSPMD and overlap stacks
+    from ..parallel.memory import tag_saveable
+
+    x = x + tag_saveable(attn @ lp["self_attn.o_proj.weight"],
+                         "decoder_attn_out")
     h2 = _rms_norm(x, lp["post_attention_layernorm.weight"],
                    cfg.rms_norm_eps)
     gate = h2 @ lp["mlp.gate_proj.weight"]
     up = h2 @ lp["mlp.up_proj.weight"]
-    return x + (jax.nn.silu(gate) * up) @ lp["mlp.down_proj.weight"]
+    return x + tag_saveable((jax.nn.silu(gate) * up)
+                            @ lp["mlp.down_proj.weight"],
+                            "decoder_mlp_out")
 
 
 # --------------------------------------------------------------------------
@@ -209,7 +217,7 @@ def _decoder_layer(lp: Dict[str, Any], x, cos, sin, cfg: LlamaConfig,
 def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                             num_microbatches: int = 1,
                             compute_dtype=jnp.bfloat16,
-                            remat: bool = False,
+                            remat=False,
                             sep_attn: str = "ulysses",
                             schedule: str = "gpipe",
                             virtual_chunks: int = 1,
@@ -252,8 +260,21 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
     PartitionId lowering the 0.4.37 SPMD partitioner rejects is never
     emitted.  ``overlap`` (an overlap.OverlapConfig) tunes the engine;
     None uses the defaults.
+
+    Round-10: ``remat`` also accepts a NAMED policy string (``none |
+    dots | names | offload | full``) or a ``parallel.memory.
+    MemoryConfig`` — resolved through the HBM memory engine's single
+    translation point, so the hybrid stack honors the same
+    checkpoint_name-tagged saveable set as the GSPMD/overlap paths.
     """
     from ..parallel import overlap as _ov
+    from ..parallel.memory import MemoryConfig as _MemCfg
+
+    remat_policy = None
+    if isinstance(remat, _MemCfg):
+        remat, remat_policy = remat.resolve_remat()
+    elif isinstance(remat, str):
+        remat, remat_policy = _MemCfg(remat=remat).resolve_remat()
     pp_axis, sep_axis = "pp", "sep"
     for ax in HYBRID_AXES:
         if ax not in mesh.axis_names:
@@ -430,7 +451,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                 return _ov.gathered_layer_scan(
                     layer_fn, xs_buckets, xs_sync, act, buckets,
                     sync_sfx, layout, sh_deg, mp_deg, gather_fns,
-                    sync_fn, oc, remat=remat)
+                    sync_fn, oc, remat=remat,
+                    remat_policy=remat_policy)
 
             outs = pipeline_apply(stage_fn, stacked, x, axis=pp_axis,
                                   squeeze_stage_dim=False)
@@ -533,7 +555,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             return _ov.decoder_layer_tp(lp, h, cos, sin, cfg, mp_ax,
                                         oc, attn_fn=attn_fn), None
 
-        wrapped_step = jax.checkpoint(layer_step) if remat else layer_step
+        wrapped_step = jax.checkpoint(layer_step, policy=remat_policy) \
+            if remat else layer_step
 
         def stage_fn(chunk, act):
             act, _ = lax.scan(wrapped_step, act, chunk)

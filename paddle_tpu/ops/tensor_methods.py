@@ -169,6 +169,21 @@ def _install():
         # elementwise tail
         "addmm", "bitwise_left_shift", "bitwise_right_shift",
         "reduce_as", "isposinf", "isneginf", "cdist",
+        # ---- round-10 tranche: sorting/searching/linalg families ----
+        # (the sort/search core — argsort/sort/topk/kthvalue/median/
+        # mode/bucketize/searchsorted — and the matmul/mm/bmm/dot/
+        # outer/cross/norm method forms shipped in earlier tranches;
+        # this tranche closes the decomposition/solve surface the
+        # reference also patches onto Tensor)
+        "mv", "multi_dot", "solve", "lstsq", "cholesky_solve",
+        "triangular_solve", "lu", "lu_unpack", "eig", "eigvals",
+        "eigvalsh", "svd", "svd_lowrank", "pinv", "qr", "matrix_rank",
+        "slogdet", "det", "cond", "householder_product", "matrix_exp",
+        "ormqr", "pdist", "cartesian_prod", "histogramdd", "isin",
+        # dtype/complex introspection method forms
+        "is_complex", "is_floating_point", "is_integer", "real",
+        "imag", "conj", "angle", "as_real", "as_complex", "rank",
+        "shard_index",
     ]
 
     def mk_top(opname):
@@ -204,6 +219,9 @@ def _install():
         "cumsum_", "cumprod_", "index_fill_", "index_put_",
         "masked_scatter_", "scatter_", "bernoulli_", "normal_",
         "log_normal_", "geometric_",
+        # round-10 tranche: in-place forms in the sorting/searching/
+        # linalg families where the reference defines them
+        "index_add_", "put_along_axis_", "lerp_", "renorm_",
     ]
     def mk_in(opname):
         def method(self, *args, **kwargs):

@@ -368,14 +368,52 @@ class Adam(Optimizer):
     def state_is_flat(state) -> bool:
         return isinstance(state, dict) and set(state) == {"__flat__"}
 
+    def _flat_group_update(self, gflat, m1, m2, master, lr, step,
+                           decay: bool):
+        """The elementwise AdamW update over one flat group (or any
+        contiguous SLICE of one — the math is elementwise, so the
+        host-offload engine's size-capped bucket streaming
+        (parallel/memory.py apply_flat_offloaded) reuses this verbatim
+        and stays bit-equal with the device-resident apply_flat).
+        Returns (new_master, new_m1, new_m2)."""
+        wd = self._weight_decay if decay else 0.0
+        gg = gflat + wd * master if (wd and not self._decoupled) \
+            else gflat
+        nm1 = self._beta1 * m1 + (1 - self._beta1) * gg
+        nm2 = self._beta2 * m2 + (1 - self._beta2) * jnp.square(gg)
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        update = (nm1 / bc1) / (jnp.sqrt(nm2 / bc2) + self._eps)
+        if wd and self._decoupled:
+            update = update + wd * master
+        return master - lr * update, nm1, nm2
+
     def apply_flat(self, params, grads, state, lr, step: int = 0,
-                   decay_mask: Optional[Dict[str, bool]] = None):
+                   decay_mask: Optional[Dict[str, bool]] = None,
+                   flat_sharding=None):
         """Fused multi-tensor Adam/AdamW update over flat groups.
-        Returns (new_params, new_state) with new_state flat again."""
+        Returns (new_params, new_state) with new_state flat again.
+
+        ``flat_sharding`` (a NamedSharding over the flat 1-D buffers)
+        MUST be passed when params are mesh-sharded: it pins the
+        concat→update→slice chain's layout, (a) sharding the
+        bandwidth-bound update across every device — the cross-replica
+        weight-update sharding of arxiv 2004.13336 — and (b) keeping
+        GSPMD's propagation from choosing the invalid partition that
+        mis-lowers this chain on the 0.4.x CPU toolchain (found by the
+        round-10 memory-engine parity tests: concat of two sharded
+        leaves + elementwise chain + slice-back returns wrong VALUES
+        without the constraint; build_train_step supplies it whenever a
+        mesh is present)."""
         if not self.state_is_flat(state):
             raise ValueError("apply_flat needs a state from "
                              "init_flat_state (got per-param pytree)")
         lr = _pin_lr_f32(lr)   # same f64-creep guard as ``apply``
+
+        def _pin_flat(x):
+            if flat_sharding is None:
+                return x
+            return jax.lax.with_sharding_constraint(x, flat_sharding)
         if self._regularizer is not None:
             raise NotImplementedError(
                 "apply_flat: optimizer-level regularizer instances ride "
@@ -392,26 +430,18 @@ class Adam(Optimizer):
         new_flat = {}
         for g in groups:
             gs = state["__flat__"][g["name"]]
-            gflat = jnp.concatenate(
+            gflat = _pin_flat(jnp.concatenate(
                 [jnp.asarray(grads[k]).astype(jnp.float32).reshape(-1)
-                 for k in g["keys"]])
+                 for k in g["keys"]]))
             master = gs.get("master")
             if master is None:
                 master = jnp.concatenate(
                     [jnp.asarray(params[k]).astype(jnp.float32)
                      .reshape(-1) for k in g["keys"]])
-            wd = self._weight_decay if g["decay"] else 0.0
-            gg = gflat + wd * master if (wd and not self._decoupled) \
-                else gflat
-            m1 = self._beta1 * gs["moment1"] + (1 - self._beta1) * gg
-            m2 = self._beta2 * gs["moment2"] + (1 - self._beta2) \
-                * jnp.square(gg)
-            bc1 = 1 - self._beta1 ** step
-            bc2 = 1 - self._beta2 ** step
-            update = (m1 / bc1) / (jnp.sqrt(m2 / bc2) + self._eps)
-            if wd and self._decoupled:
-                update = update + wd * master
-            new_master = master - lr * update
+            master = _pin_flat(master)
+            new_master, m1, m2 = self._flat_group_update(
+                gflat, _pin_flat(gs["moment1"]), _pin_flat(gs["moment2"]),
+                master, lr, step, g["decay"])
             ngs = {"moment1": m1, "moment2": m2}
             if "master" in gs:
                 ngs["master"] = new_master

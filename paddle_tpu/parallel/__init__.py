@@ -13,3 +13,4 @@ from .ring_attention import ring_flash_attention
 from .sep import ulysses_attention
 from .pipelining import pipeline_apply
 from .overlap import OverlapConfig
+from .memory import MemoryConfig, tune_memory_config

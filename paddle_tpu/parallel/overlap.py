@@ -588,13 +588,24 @@ def decoder_layer_tp(lp: Dict[str, Any], x, cos, sin, cfg,
         else:
             attn = flash_attention_raw(q, k, v, causal=True)
     attn = attn.astype(x.dtype).reshape(b, sl, nh_l * hd)
-    x = x + tp_row_matmul(attn, lp["self_attn.o_proj.weight"], mp_axis, oc)
+    # checkpoint_name tags on the residual-stream block outputs: the HBM
+    # memory engine's NAMED remat policies (parallel/memory.py
+    # SAVEABLE_NAMES) select/offload exactly these under the remat scan
+    from .memory import tag_saveable
+
+    attn_out = tag_saveable(
+        tp_row_matmul(attn, lp["self_attn.o_proj.weight"], mp_axis, oc),
+        "decoder_attn_out")
+    x = x + attn_out
     h2 = rms(x, lp["post_attention_layernorm.weight"],
              epsilon=cfg.rms_norm_eps)
     gate = h2 @ lp["mlp.gate_proj.weight"]
     up = h2 @ lp["mlp.up_proj.weight"]
-    return x + tp_row_matmul(jax.nn.silu(gate) * up,
-                             lp["mlp.down_proj.weight"], mp_axis, oc)
+    mlp_out = tag_saveable(
+        tp_row_matmul(jax.nn.silu(gate) * up,
+                      lp["mlp.down_proj.weight"], mp_axis, oc),
+        "decoder_mlp_out")
+    return x + mlp_out
 
 
 # ---------------------------------------------------------------------------

@@ -30,6 +30,11 @@ def test_bench_smoke_green():
                 # dp2 x sharding2 x mp2 virtual mesh, and the
                 # collective_budget pass (COMM fixtures + the flagship
                 # zero-collective budget)
-                "overlap_parity", "collective_budget_doctor"):
+                "overlap_parity", "collective_budget_doctor",
+                # round-10: HBM memory engine — named-policy remat +
+                # host-offloaded streamed AdamW parity + autotune, and
+                # the memory_budget pass (MEM/HLO003 fixtures + the
+                # flagship peak-HBM budget pin)
+                "memory_parity", "memory_budget_doctor"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]

@@ -140,3 +140,82 @@ def test_set_mesh_old_api_returns_mesh_as_context(monkeypatch):
     monkeypatch.delattr(jax.sharding, "set_mesh", raising=False)
     sentinel = object()
     assert jax_compat.set_mesh(sentinel) is sentinel
+
+
+# ---------------------------------------------------------------------------
+# memory-kind shims (round-10: the HBM memory engine's offload lattice)
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_to_memory_kind_public_home(monkeypatch):
+    cls = type("FakeTTK", (), {"__init__":
+                               lambda self, k: setattr(self, "kind", k)})
+    monkeypatch.setattr(jax.sharding, "TransferToMemoryKind", cls,
+                        raising=False)
+    t = jax_compat.transfer_to_memory_kind("pinned_host")
+    assert isinstance(t, cls) and t.kind == "pinned_host"
+
+
+def test_transfer_to_memory_kind_private_fallback(monkeypatch):
+    """Without the public name the 0.4.x private home resolves (the
+    container toolchain's real path)."""
+    monkeypatch.delattr(jax.sharding, "TransferToMemoryKind",
+                        raising=False)
+    t = jax_compat.transfer_to_memory_kind("unpinned_host")
+    assert t is not None and t.memory_kind == "unpinned_host"
+    assert jax_compat.transfer_to_memory_kind(None) is None
+
+
+def test_device_memory_kinds_probe_and_degradation(monkeypatch):
+    kinds = jax_compat.device_memory_kinds()
+    # the container backend reports its default kind first
+    assert kinds and kinds[0] == jax.devices()[0].default_memory().kind
+    # a device without the memories API degrades to () — never raises
+    broken = types.SimpleNamespace()
+    assert jax_compat.device_memory_kinds(broken) == ()
+
+
+def test_sharding_with_memory_kind_paths():
+    x = jnp.ones((4,))
+    sh = x.sharding
+    out = jax_compat.sharding_with_memory_kind(sh, None)
+    assert out is sh                       # None kind: untouched
+    legacy = types.SimpleNamespace()       # pre-memory-kind sharding
+    assert jax_compat.sharding_with_memory_kind(legacy, "pinned_host") \
+        is legacy
+    moved = jax_compat.sharding_with_memory_kind(sh, "unpinned_host")
+    assert moved.memory_kind == "unpinned_host"
+
+
+def test_device_put_memory_kind_eager_and_jit():
+    """Both execution modes on one toolchain: eager uses a concrete
+    sharding, traced uses TransferToMemoryKind — same values out."""
+    from paddle_tpu.core.device import host_memory_kind
+
+    kind = host_memory_kind()
+    if kind is None:
+        pytest.skip("no host memory kind on this toolchain")
+    x = jnp.arange(8, dtype=jnp.float32)
+    eager = jax_compat.device_put_memory_kind(x, kind)
+    np.testing.assert_array_equal(np.asarray(eager), np.asarray(x))
+    jitted = jax.jit(
+        lambda v: jax_compat.device_put_memory_kind(v, kind) * 2.0)(x)
+    np.testing.assert_array_equal(np.asarray(jitted),
+                                  2 * np.asarray(x))
+    # no-kind toolchain degrades to identity on both paths
+    assert jax_compat.device_put_memory_kind(x, None) is x
+
+
+def test_device_probe_surface():
+    from paddle_tpu.core import device as D
+
+    kinds = D.memory_kinds()
+    assert D.default_memory_kind() == (kinds[0] if kinds else None)
+    for k in kinds:
+        assert D.supports_memory_kind(k)
+    assert not D.supports_memory_kind("no_such_memory_space")
+    # CPU backend: the fallback host kind IS the default memory, so
+    # offload is structural (not distinct); TPU would report distinct
+    if jax.default_backend() == "cpu":
+        assert D.host_memory_kind() == "unpinned_host"
+        assert D.host_offload_distinct() is False

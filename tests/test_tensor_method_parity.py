@@ -81,6 +81,23 @@ _ROUND9_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND9_TRANCHE
 
+# names added by the round-10 tranche (sorting/searching/linalg
+# families: the decomposition/solve surface + dtype/complex
+# introspection method forms + the in-place variants the reference
+# defines there) — appended into _REQUIRED_METHODS AND counted against
+# the ~40 floor by test_method_count_tranche_round10
+_ROUND10_TRANCHE = [
+    "mv", "multi_dot", "solve", "lstsq", "cholesky_solve",
+    "triangular_solve", "lu", "lu_unpack", "eig", "eigvals", "eigvalsh",
+    "svd", "svd_lowrank", "pinv", "qr", "matrix_rank", "slogdet", "det",
+    "cond", "householder_product", "matrix_exp", "ormqr", "pdist",
+    "cartesian_prod", "histogramdd", "isin",
+    "is_complex", "is_floating_point", "is_integer", "real", "imag",
+    "conj", "angle", "as_real", "as_complex", "rank", "shard_index",
+    "index_add_", "put_along_axis_", "lerp_", "renorm_",
+]
+_REQUIRED_METHODS += _ROUND10_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -221,6 +238,45 @@ def test_round9_view_split_method_values():
                                np.diag([1.0, 2.0]))
     r = paddle.to_tensor(np.array([1, 2], np.int64)).repeat_interleave(2)
     np.testing.assert_array_equal(np.asarray(r._value), [1, 1, 2, 2])
+
+
+def test_method_count_tranche_round10():
+    """The round-10 tranche satisfies the ~40-new-names floor (ISSUE 5
+    satellite: sorting/searching/linalg families + their in-place
+    variants) over the round-9 surface."""
+    wired = [n for n in _ROUND10_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 40, (len(wired),
+                              sorted(set(_ROUND10_TRANCHE) - set(wired)))
+
+
+def test_round10_linalg_method_values():
+    m = paddle.to_tensor(np.array([[2.0, 0.0], [0.0, 4.0]], np.float32))
+    np.testing.assert_allclose(float(np.asarray(m.det()._value)), 8.0)
+    v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(m.mv(v)._value), [2.0, 8.0])
+    sld = np.asarray(m.slogdet()._value)   # paddle packs [sign, logdet]
+    np.testing.assert_allclose(sld.reshape(-1),
+                               [1.0, np.log(8.0)], rtol=1e-6)
+    b = paddle.to_tensor(np.array([2.0, 8.0], np.float32))
+    np.testing.assert_allclose(np.asarray(m.solve(b)._value),
+                               [1.0, 2.0], rtol=1e-5)
+    assert m.is_floating_point()
+    assert not m.is_complex()
+    assert int(np.asarray(m.rank()._value)) == 2
+
+
+def test_round10_inplace_method_values():
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    r = a.lerp_(b, 0.5)
+    assert r is a
+    np.testing.assert_allclose(np.asarray(a._value), [2.0, 3.0])
+    x = paddle.to_tensor(np.zeros((3,), np.float32))
+    idx = paddle.to_tensor(np.array([0, 2], np.int64))
+    src = paddle.to_tensor(np.array([1.0, 5.0], np.float32))
+    r = x.index_add_(idx, 0, src)
+    assert r is x
+    np.testing.assert_allclose(np.asarray(x._value), [1.0, 0.0, 5.0])
 
 
 def test_round9_inplace_scan_methods():
