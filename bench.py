@@ -1211,6 +1211,134 @@ def _profile_overlap_levers():
     return out
 
 
+def serving_trace(smoke: bool = False, seed: int = 0):
+    """Open-loop serving bench over the round-11 unified plane
+    (bench.py --serving-trace -> SERVING_r01.json).
+
+    Synthetic arrival trace: Poisson arrivals, lognormal prompt
+    lengths, a configurable fraction of requests sharing one system
+    prompt (chat-shaped traffic — the prefix cache's beat).  The trace
+    drives ``engine.step()`` open-loop (arrivals keyed to WALL time, so
+    a slow engine accumulates queue depth instead of slowing the
+    offered load) through the unified engine with the radix prefix
+    cache and speculative decoding enabled, and reports:
+
+    - tokens/s/chip at the achieved fill,
+    - p50/p99 per-token latency (each engine step's wall time
+      attributed to the tokens it emitted),
+    - p50/p99 time-to-first-token from arrival,
+    - mean speculative accepted length per verify window,
+    - prefix-cache hit/eviction counters + prefill-token savings.
+
+    CPU sessions run the kernels in interpret mode — absolute numbers
+    are structural; the TPU confirmation ride the BASELINE.md round-11
+    checklist.  The draft is the ORACLE self-draft (the target's own
+    params): it pins the acceptance plumbing at its upper bound; a
+    distilled drafter only changes the acceptance rate, not the
+    schedule."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    rng = np.random.default_rng(seed)
+    paddle.seed(29)
+    cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
+                            kv_heads=2, inter=64, max_pos=256)
+    model = LlamaForCausalLM(cfg)
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+
+    n_req = 6 if smoke else 24
+    rate = 40.0                      # requests/s offered (open loop)
+    shared_ratio = 0.5               # chat traffic: half share a system
+    max_new = 4 if smoke else 8      # prompt
+    sys_prompt = rng.integers(1, cfg.vocab_size, (24,)).astype(np.int32)
+
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_req))
+    plens = np.clip(rng.lognormal(2.2, 0.6, n_req), 4,
+                    96).astype(int)
+    reqs = []
+    for i in range(n_req):
+        body = rng.integers(1, cfg.vocab_size,
+                            (int(plens[i]),)).astype(np.int32)
+        # deterministic round-robin shared assignment (NOT sampled):
+        # the queued tail of the trace must contain shared-prefix
+        # requests so the hits>0 gate is structural, not seed luck —
+        # a sampled tail can be all-private and the leg would flake
+        if (i * shared_ratio) % 1.0 < shared_ratio:
+            body = np.concatenate([sys_prompt, body])
+        reqs.append((float(arrivals[i]), body))
+
+    eng = ContinuousBatchingEngine(
+        cfg, params, max_slots=4, num_pages=65, page_size=16,
+        max_seq_len=160, prefill_token_budget=16,
+        enable_prefix_cache=True, draft_params=params,
+        speculative_k=2)
+
+    t0 = time.perf_counter()
+    pending = list(reqs)
+    arrival_of = {}
+    first_tok_at = {}
+    step_tok_lat = []                # per-token latency samples
+    while pending or eng.queue or eng.active.any():
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            arr, prompt = pending.pop(0)
+            rid = eng.add_request(prompt, max_new_tokens=max_new,
+                                  arrival=arr)
+            arrival_of[rid] = arr
+        ts = time.perf_counter()
+        produced = eng.step()
+        dt = time.perf_counter() - ts
+        if produced:
+            step_tok_lat.extend([dt / produced] * produced)
+        now = time.perf_counter() - t0
+        for rid in list(eng.out_tokens) + [f.rid for f in eng.finished]:
+            first_tok_at.setdefault(rid, now)
+        if not pending and not eng.queue and not eng.active.any():
+            break
+        if not produced and pending and not eng.active.any() \
+                and not eng.queue:
+            time.sleep(max(0.0, pending[0][0] - now))
+    elapsed = time.perf_counter() - t0
+    done = sorted(eng.finished, key=lambda f: f.rid)
+    stats = eng.serving_stats()
+    eng.shutdown()
+
+    lat = np.asarray(step_tok_lat) if step_tok_lat else np.zeros(1)
+    cache = stats.get("prefix_cache", {})
+    saved = sum(v["cached_tokens"] for v in stats["prefill"].values())
+    res = {
+        "ok": (len(done) == n_req
+               and stats.get("mean_accepted_len", 0.0) > 1.0
+               and cache.get("hits", 0) > 0),
+        "backend": jax.default_backend(),
+        "interpret_mode": jax.default_backend() == "cpu",
+        "requests": len(done),
+        "generated_tokens": int(sum(len(f.tokens) for f in done)),
+        "elapsed_s": elapsed,
+        "tokens_per_s_per_chip": (sum(len(f.tokens) for f in done)
+                                  / elapsed / max(1, len(jax.devices()))),
+        "per_token_latency_p50_ms": float(np.percentile(lat, 50) * 1e3),
+        "per_token_latency_p99_ms": float(np.percentile(lat, 99) * 1e3),
+        "ttft_p50_s": float(np.percentile(
+            [first_tok_at[r] - arrival_of[r] for r in arrival_of], 50)),
+        "ttft_p99_s": float(np.percentile(
+            [first_tok_at[r] - arrival_of[r] for r in arrival_of], 99)),
+        "mean_accepted_len": float(stats.get("mean_accepted_len", 0.0)),
+        "prefix_cache": cache,
+        "prefill_tokens_saved": int(saved),
+        "trace": {"n_requests": n_req, "poisson_rate": rate,
+                  "prompt_lognormal": [2.2, 0.6],
+                  "shared_prompt_ratio": shared_ratio,
+                  "max_new_tokens": max_new, "seed": seed},
+    }
+    return res
+
+
 def doctor():
     """bench.py --doctor — run the Graph Doctor (paddle_tpu.analysis)
     over the benched steps: every seeded-bug fixture must trigger exactly
@@ -1482,6 +1610,20 @@ def smoke():
     except Exception as e:  # noqa: BLE001
         legs["memory_budget_doctor"] = {"ok": False, "error": repr(e)}
 
+    # 12. round-11 serving plane: the open-loop arrival trace through
+    #     the unified engine (radix prefix cache + chunked prefill +
+    #     speculative decode) — ok requires every request completed,
+    #     mean accepted length > 1 AND at least one prefix-cache hit
+    try:
+        tr = serving_trace(smoke=True)
+        legs["serving_trace"] = {
+            "ok": bool(tr["ok"]),
+            "mean_accepted_len": tr["mean_accepted_len"],
+            "prefix_cache_hits": tr["prefix_cache"].get("hits", 0),
+            "prefill_tokens_saved": tr["prefill_tokens_saved"]}
+    except Exception as e:  # noqa: BLE001
+        legs["serving_trace"] = {"ok": False, "error": repr(e)}
+
     return {"smoke": True,
             "backend": jax.default_backend(),
             "ok": all(leg.get("ok") for leg in legs.values()),
@@ -1708,6 +1850,15 @@ if __name__ == "__main__":
         res = doctor()
         try:
             with open("DOCTOR.json", "w") as f:
+                json.dump(res, f, indent=1, default=str)
+        except OSError:
+            pass
+        print(json.dumps(res, default=str))
+        sys.exit(0 if res["ok"] else 1)
+    if "--serving-trace" in sys.argv:
+        res = serving_trace(smoke="--smoke-trace" in sys.argv)
+        try:
+            with open("SERVING_r01.json", "w") as f:
                 json.dump(res, f, indent=1, default=str)
         except OSError:
             pass

@@ -355,6 +355,35 @@ def seeded_host_round_trip() -> Report:
                           {"host_transfer_bytes": 1 << 20}})
 
 
+def seeded_prefill_chunk_over_budget() -> Report:
+    """MEM001 on the SERVING entry: a unified ragged serving step whose
+    prefill chunk (prefill_token_budget=48) blows through an HBM budget
+    declared for the decode-sized launch (1 MB fits the chunk-8 step at
+    ~0.97 MB; chunk-48 compiles to ~1.13 MB) — the round-11 overrun the
+    serving budget pin exists to catch: bumping the token budget must
+    re-justify the declared budget, not silently grow the hot path."""
+    import paddle_tpu as paddle
+    from ..inference.serving import ContinuousBatchingEngine
+    from ..models import LlamaConfig, LlamaForCausalLM
+
+    state = paddle.get_rng_state()
+    paddle.seed(20260803)
+    cfg = LlamaConfig.debug(vocab=128, hidden=64, layers=2, heads=4,
+                            kv_heads=2, inter=128, max_pos=64)
+    model = LlamaForCausalLM(cfg)
+    paddle.set_rng_state(state)
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+    eng = ContinuousBatchingEngine(cfg, params, max_slots=2,
+                                   num_pages=17, page_size=16,
+                                   max_seq_len=64,
+                                   prefill_token_budget=48)
+    fn, args, kwargs, _ = eng.analysis_entry()
+    return check(fn, *args, kwargs=kwargs, passes=["memory_budget"],
+                 exemptions=(), target="seeded:MEM001[prefill_chunk]",
+                 options={"memory_budget": {"hbm_bytes": 1 << 20}})
+
+
 def seeded_while_peeling() -> Report:
     """HLO003 over a captured-HLO sample: a scanned body's all-gather
     duplicated TWICE into the hosting computation (XLA's peel+unroll
@@ -408,5 +437,9 @@ SEEDED = {
     "HLO002": seeded_full_param_allgather,
     "HLO003": seeded_while_peeling,
     "MEM001": seeded_peak_over_budget,
+    # a second MEM001 proof on the round-11 serving entry — registry
+    # keys carry a [variant] suffix; consumers expect the BARE code
+    # before the bracket
+    "MEM001[prefill_chunk]": seeded_prefill_chunk_over_budget,
     "MEM002": seeded_host_round_trip,
 }

@@ -50,6 +50,16 @@ DONATION_MIN_BYTES = 4 << 10
 FLAGSHIP_HBM_BUDGET = 3 << 20
 FLAGSHIP_STREAM_BUDGET = 6 << 20
 
+# Round-11 capacity contract for the debug-shaped UNIFIED serving step
+# (radix prefix cache + chunked prefill + speculative verify in one
+# ragged launch): the self-check engine (2 slots, 9 pages, chunk 8)
+# compiles to ~0.72 MB peak; 1 MB pins it with ~0.28 MB headroom — a
+# materialized fp32 logits buffer over the packed rows or an un-donated
+# pool copy fails MEM001 here, and the seeded MEM001[prefill_chunk]
+# fixture proves a prefill_token_budget bump (48 -> ~1.13 MB) blows
+# this same decode-sized contract.
+SERVING_HBM_BUDGET = 1 << 20
+
 
 def _memory_target(donation_opts):
     """The memory-engine flagship sweep: MemoryConfig(names, host) —
@@ -206,6 +216,25 @@ def _clean_targets():
         fn, *args, kwargs=kwargs, options=options, passes=ALL_PASSES,
         target="serving_decode_chunk")
 
+    # 4a. round-11 unified serving step (chunked prefill + speculative
+    # verify rows mixed into the decode launch) — gated like the
+    # training flagship: ZERO collectives on the single-chip serving
+    # path (COMM001) and the pinned peak-HBM contract (MEM001), plus
+    # the full pass suite over the ragged program
+    ueng = ContinuousBatchingEngine(cfg, params, max_slots=2,
+                                    num_pages=9, page_size=16,
+                                    max_seq_len=64,
+                                    prefill_token_budget=8)
+    ufn, uargs, ukwargs, uoptions = ueng.analysis_entry()
+    zero_budget = {k: {"count": 0} for k in
+                   ("allreduce", "allgather", "reducescatter",
+                    "collectivepermute", "alltoall")}
+    yield "serving_unified_step", check(
+        ufn, *uargs, kwargs=ukwargs, passes=ALL_PASSES,
+        options={**uoptions, "collective_budget": zero_budget,
+                 "memory_budget": {"hbm_bytes": SERVING_HBM_BUDGET}},
+        target="serving_unified_step")
+
 
 def _overlap_target():
     """Clean sweep over the communication-overlap engine's train step
@@ -313,7 +342,11 @@ def self_check(clean: bool = True) -> dict:
             seeded[code] = {"ok": False, "error": repr(e)}
             continue
         codes = set(rep.codes())
-        seeded[code] = {"ok": codes == {code},
+        # registry keys may carry a "[variant]" suffix (two proofs of
+        # one code on different entry points); the report must contain
+        # the BARE code exactly
+        expect = code.split("[", 1)[0]
+        seeded[code] = {"ok": codes == {expect},
                         "codes": sorted(codes),
                         "n": len(rep.findings)}
 

@@ -80,6 +80,11 @@ _INPLACE_BASES = [
     # Tensor as `t.<base>_()` methods in ops/tensor_methods.py)
     "add", "subtract", "clip", "exp", "sqrt", "rsqrt", "sigmoid",
     "ceil", "floor", "round", "reciprocal", "scale",
+    # round-11 tranche: the inverse-trig/hyperbolic family, the special
+    # functions, and the comparison/logical in-place forms the
+    # reference defines (completes each family already partly wired)
+    "asin", "cosh", "asinh", "acosh", "atanh", "log1p", "erfinv",
+    "not_equal", "logical_xor",
 ]
 
 

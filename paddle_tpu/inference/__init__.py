@@ -335,5 +335,8 @@ def create_predictor(config_or_layer, layer=None):
 
 
 # continuous-batching serving engine (round-5; reference capability:
-# the serving loop around block_multihead_attention)
-from .serving import ContinuousBatchingEngine, PageAllocator  # noqa: E402
+# the serving loop around block_multihead_attention).  Round-11 adds
+# the unified serving plane: radix prefix cache + chunked prefill mixed
+# into the decode step + speculative decoding.
+from .serving import (ContinuousBatchingEngine, PageAllocator,  # noqa: E402
+                      PrefixCache)

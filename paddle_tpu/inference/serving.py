@@ -71,9 +71,10 @@ llama-8B serving leg).
 from __future__ import annotations
 
 import dataclasses
+import heapq
 from collections import deque
 from functools import partial
-from typing import Any, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 import jax
@@ -87,6 +88,9 @@ class Request:
     prompt: np.ndarray                  # [S] int32
     max_new_tokens: int
     arrival: float = 0.0
+    temperature: float = 0.0            # 0 = greedy
+    seed: int = 0                       # per-request sampling stream
+    rng: Any = None                     # np.random.Generator at admission
 
 
 @dataclasses.dataclass
@@ -126,6 +130,17 @@ def tune_page_size(b, kvh, d, capacity, dtype=jnp.bfloat16,
         key, [p for p in candidates if capacity % p == 0], measure)
 
 
+def _softmax_np(logits: np.ndarray, temperature: float) -> np.ndarray:
+    """Host-side fp64 softmax over one row of returned logits —
+    deterministic (no device reduction-order variance), so a warm
+    prefix-cache request replays the cold request's sampling stream
+    bit-for-bit given the same seed."""
+    x = logits.astype(np.float64) / max(float(temperature), 1e-6)
+    x = x - x.max()
+    e = np.exp(x)
+    return e / e.sum()
+
+
 def _round_int8(x):
     """Round-half-away-from-zero to int8 range (the reference's
     quant_round_type=1; shared by calibration-time and decode-time
@@ -135,22 +150,231 @@ def _round_int8(x):
 
 
 class PageAllocator:
-    """Host-side physical-page free list (reuse is LIFO so hot pages stay
-    cache/TLB friendly)."""
+    """Host-side physical-page free list with EXPLICIT refcounts (reuse
+    is LIFO so hot pages stay cache/TLB friendly).
+
+    Round-11: pages are shared copy-on-write between the prefix-cache
+    trie and any number of live requests, so ownership is counted —
+    ``alloc`` hands out a page at refcount 1, every additional sharer
+    ``acquire``\\ s it, and ``release`` only returns it to the free list
+    when the count reaches zero.  The invariant ``available + live ==
+    num_pages`` is checkable at any point (``assert_balanced``) and is
+    exercised at engine teardown in tests, so a COW bug (double release,
+    leaked ref) surfaces as a hard failure instead of silent pool
+    exhaustion."""
 
     def __init__(self, num_pages: int):
         self.free: List[int] = list(range(num_pages - 1, -1, -1))
         self.total = num_pages
+        self.refs: List[int] = [0] * num_pages
 
     def alloc(self) -> Optional[int]:
-        return self.free.pop() if self.free else None
+        if not self.free:
+            return None
+        p = self.free.pop()
+        self.refs[p] = 1
+        return p
+
+    def acquire(self, page: int) -> int:
+        """Add a reference to an already-live page (prefix sharing)."""
+        if self.refs[page] <= 0:
+            raise AssertionError(
+                f"acquire of dead page {page} (refcount "
+                f"{self.refs[page]}) — prefix-cache/table corruption")
+        self.refs[page] += 1
+        return page
 
     def release(self, pages) -> None:
-        self.free.extend(reversed(list(pages)))
+        """Drop one reference per page; a page returns to the free list
+        only when its last reference is gone."""
+        for p in reversed(list(pages)):
+            p = int(p)
+            if self.refs[p] <= 0:
+                raise AssertionError(
+                    f"release of free page {p} — double release")
+            self.refs[p] -= 1
+            if self.refs[p] == 0:
+                self.free.append(p)
 
     @property
     def available(self) -> int:
         return len(self.free)
+
+    @property
+    def live(self) -> int:
+        return sum(1 for r in self.refs if r > 0)
+
+    def assert_balanced(self) -> None:
+        """The leak-check assertion: every page is exactly one of free
+        or live, and free pages carry no references."""
+        if self.available + self.live != self.total:
+            raise AssertionError(
+                f"page pool out of balance: available={self.available} "
+                f"+ live={self.live} != total={self.total}")
+        bad = [p for p in self.free if self.refs[p] != 0]
+        if bad:
+            raise AssertionError(f"free pages with live refs: {bad}")
+
+
+class _TrieNode:
+    """One committed full page of tokens in the prefix cache."""
+
+    __slots__ = ("children", "key", "page", "parent", "tick")
+
+    def __init__(self, key=None, page=None, parent=None):
+        self.children: Dict[tuple, "_TrieNode"] = {}
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.tick = 0
+
+
+class PrefixCache:
+    """Radix/trie prefix cache over the engine's page pools.
+
+    Keys are page-granular token chunks (``page_size`` tokens per edge),
+    values are PHYSICAL page ids in the per-layer pools.  A node exists
+    only for pages whose prompt tokens were fully committed by a
+    completed prefill, and the trie holds its own allocator reference on
+    each node's page — so cached prefixes survive the requests that
+    produced them, and ``lookup`` can hand the same physical pages to a
+    new request copy-on-write (the new request only ever WRITES at
+    positions at or past its private suffix, so shared pages are
+    read-only by construction; the last partial prompt page is always
+    private because only full pages are keyed, and at least one suffix
+    token is always left to prefill so the hit request still produces
+    first-token logits).
+
+    Eviction is LRU over refcount-0 leaves (allocator refcount 1 = the
+    trie's own reference, no live request) under pool pressure — interior
+    nodes become leaves as their children evict, so a cold chain drains
+    bottom-up."""
+
+    def __init__(self, page_size: int, alloc: PageAllocator):
+        self.page_size = int(page_size)
+        self.alloc = alloc
+        self.root = _TrieNode()
+        self._tick = 0
+        self.hits = 0
+        self.lookups = 0
+        self.hit_tokens = 0
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+
+    def _chunks(self, tokens, npages: int):
+        ps = self.page_size
+        return [tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+                for i in range(npages)]
+
+    def lookup(self, prompt):
+        """Walk the trie with the prompt's full pages; returns
+        ``(pages, matched_tokens)`` with one allocator ref acquired per
+        returned page (the caller owns them like alloc'd pages).  At
+        most ``(len(prompt) - 1) // page_size`` pages match, so the
+        suffix containing the last prompt token — whose logits seed
+        generation — is always prefilled privately.
+
+        Hit STATS are committed separately (``record_hit``) by the
+        engine once the request is actually admitted — a lookup whose
+        admission aborts on pool pressure releases its refs and must
+        not count as a served hit."""
+        self.lookups += 1
+        self._tick += 1
+        limit = max(0, (len(prompt) - 1) // self.page_size)
+        node = self.root
+        pages: List[int] = []
+        for key in self._chunks(prompt, limit):
+            child = node.children.get(key)
+            if child is None:
+                break
+            self.alloc.acquire(child.page)
+            pages.append(child.page)
+            child.tick = self._tick
+            node = child
+        return pages, len(pages) * self.page_size
+
+    def record_hit(self, matched_tokens: int) -> None:
+        if matched_tokens > 0:
+            self.hits += 1
+            self.hit_tokens += matched_tokens
+
+    def insert(self, prompt, pages) -> int:
+        """Commit a completed prefill's FULL prompt pages.  New nodes
+        acquire a trie reference on their page; existing nodes are left
+        untouched (a concurrent prefill of the same prefix keeps its
+        private copy, which simply frees when that request finishes).
+        Returns the number of newly committed pages."""
+        self._tick += 1
+        n = min(len(prompt) // self.page_size, len(pages))
+        node = self.root
+        added = 0
+        for i, key in enumerate(self._chunks(prompt, n)):
+            child = node.children.get(key)
+            if child is None:
+                child = _TrieNode(key, self.alloc.acquire(int(pages[i])),
+                                  node)
+                node.children[key] = child
+                self.inserted_pages += 1
+                added += 1
+            child.tick = self._tick
+            node = child
+        return added
+
+    def _nodes(self):
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    def evict(self, pages_needed: int) -> int:
+        """LRU-evict refcount-0 leaves (trie-only pages) until
+        ``pages_needed`` pages were freed or nothing evictable is left.
+        Returns pages actually freed.
+
+        One traversal collects the evictable leaves into a tick-ordered
+        heap; a parent that becomes an evictable leaf when its last
+        child is freed is pushed then — O(nodes + m log m) for m freed
+        pages instead of re-walking the trie per page.  Ticks are
+        stable within the call (no lookup/insert runs concurrently)."""
+        freed = 0
+        seq = 0                      # tie-break: heap never compares nodes
+        heap = []
+        for n in self._nodes():
+            if not n.children and self.alloc.refs[n.page] == 1:
+                heap.append((n.tick, seq, n))
+                seq += 1
+        heapq.heapify(heap)
+        while freed < pages_needed and heap:
+            _, _, victim = heapq.heappop(heap)
+            parent = victim.parent
+            del parent.children[victim.key]
+            self.alloc.release([victim.page])
+            self.evicted_pages += 1
+            freed += 1
+            if (parent is not self.root and not parent.children
+                    and self.alloc.refs[parent.page] == 1):
+                heap_entry = (parent.tick, seq, parent)
+                seq += 1
+                heapq.heappush(heap, heap_entry)
+        return freed
+
+    def clear(self) -> None:
+        """Drop every trie reference (engine teardown)."""
+        for n in list(self._nodes()):
+            self.alloc.release([n.page])
+        self.root = _TrieNode()
+
+    @property
+    def cached_pages(self) -> int:
+        return sum(1 for _ in self._nodes())
+
+    def stats(self) -> Dict[str, int]:
+        return {"lookups": self.lookups, "hits": self.hits,
+                "hit_tokens": self.hit_tokens,
+                "cached_pages": self.cached_pages,
+                "inserted_pages": self.inserted_pages,
+                "evicted_pages": self.evicted_pages}
 
 
 class ContinuousBatchingEngine:
@@ -165,7 +389,11 @@ class ContinuousBatchingEngine:
                  num_pages: int = 64, page_size="auto",
                  max_seq_len: Optional[int] = None,
                  decode_chunk_steps: int = 8, eos_id: int = -1,
-                 cache_dtype=None, pages_per_step="auto"):
+                 cache_dtype=None, pages_per_step="auto",
+                 prefill_token_budget: Optional[int] = None,
+                 enable_prefix_cache: bool = False,
+                 draft_params=None, draft_cfg=None,
+                 speculative_k: int = 0):
         from ..models.generation import _CFGS, register_config
         from ..ops.pallas.decode_attention import tune_pages_per_step
 
@@ -246,6 +474,74 @@ class ContinuousBatchingEngine:
         # decoder = cached tokens of decoding slots, this_time = tokens
         # processed this step)
         self.last_report: Dict[str, np.ndarray] = {}
+
+        # ---- round-11 unified serving plane (ragged prefill+decode) ----
+        self.prefill_budget = (0 if prefill_token_budget is None
+                               else int(prefill_token_budget))
+        self.unified = self.prefill_budget > 0
+        self.spec_k = int(speculative_k)
+        if self.spec_k and draft_params is None:
+            raise ValueError("speculative_k > 0 needs draft_params "
+                             "(the small proposer model)")
+        if draft_params is not None and not self.spec_k:
+            raise ValueError(
+                "draft_params without speculative_k >= 1: the draft "
+                "would mirror every step without ever proposing")
+        if (self.spec_k or draft_params is not None) and not self.unified:
+            raise ValueError(
+                "speculative decoding requires the unified engine "
+                "(prefill_token_budget > 0): the verify step IS a "
+                "q_len=k+1 ragged chunk of the unified step")
+        if enable_prefix_cache and not self.unified:
+            raise ValueError(
+                "the prefix cache requires the unified engine "
+                "(prefill_token_budget > 0): cache hits enter decode "
+                "mid-prompt, which only the ragged step can serve")
+        if self.unified and self.cache_dtype == jnp.int8:
+            raise ValueError(
+                "int8 KV cache rides the legacy chunked path for now "
+                "(unified-plane calibration is a follow-up)")
+        self.prefix_cache = (PrefixCache(self.page_size, self.alloc)
+                             if enable_prefix_cache else None)
+        # static packed-row capacity of one unified launch: one decode
+        # row per slot (k+1 under speculation) + the prefill chunk
+        self.rows_cap = self.max_slots * (1 + self.spec_k) \
+            + self.prefill_budget
+        self.pending_prompt: Dict[int, np.ndarray] = {}
+        self.prefill_order: List[int] = []       # FIFO over mid-prefill slots
+        self.req_info: Dict[int, Request] = {}   # slot -> live request
+        # per-rid prefill accounting (the FLOPs-skip contract: warm
+        # requests must show prefilled == prompt_len - cached; run-scoped
+        # by design — bench/tests sum it over the whole trace)
+        self.prefill_stats: Dict[int, Dict[str, int]] = {}
+        # spec telemetry: one entry per verify window, bounded so a
+        # long-running server doesn't grow it without limit
+        self.accepted_lengths: Deque[int] = deque(maxlen=65536)
+        self.draft = None
+        if draft_params is not None:
+            dcfg = draft_cfg if draft_cfg is not None else cfg
+            did = register_config(dcfg)
+            _, dcos, dsin = _CFGS[did]
+            ddt = next(iter(v for k, v in draft_params.items()
+                            if not k.endswith("._scale"))).dtype
+            if not jnp.issubdtype(ddt, jnp.floating):
+                ddt = jnp.bfloat16
+            dkvh, dd = dcfg.num_key_value_heads, dcfg.head_dim
+            dL = dcfg.num_hidden_layers
+            # draft pools mirror the target's page GEOMETRY (same ids,
+            # same tables) so the one page table serves both models;
+            # shared prefix pages are therefore shared for the draft
+            # too (the donor's draft prefill wrote them)
+            self.draft = {
+                "cfg": dcfg, "params": draft_params, "cfg_id": did,
+                "cos_tab": dcos, "sin_tab": dsin,
+                "k_pages": tuple(jnp.zeros(
+                    (self.num_pages, dkvh, self.page_size, dd), ddt)
+                    for _ in range(dL)),
+                "v_pages": tuple(jnp.zeros(
+                    (self.num_pages, dkvh, self.page_size, dd), ddt)
+                    for _ in range(dL)),
+            }
 
     # ---------------- device programs ----------------
 
@@ -418,10 +714,106 @@ class ContinuousBatchingEngine:
         return _round_int8(x.astype(jnp.float32)
                            * scale[:, None, :, None])
 
+    @partial(jax.jit, static_argnames=("self_cfg_id", "pages_per_step",
+                                       "with_head"),
+             donate_argnums=(1, 2))
+    def _unified_step_jit(params, k_pages, v_pages, rows, tables,
+                          cos_tab, sin_tab, self_cfg_id, pages_per_step,
+                          kv_scales=None, with_head=True):
+        """ONE ragged engine step: a packed batch of tokens from many
+        sequences — decode slots (one row each), prefill chunks (one row
+        per prompt token) and speculative verify windows (k+1 rows) —
+        through a single forward, with attention served by the ragged
+        paged kernel (per-row page-table indirection + causal
+        visibility).  This is the unified prefill/decode formulation of
+        the Ragged Paged Attention paper: decode latency is bounded by
+        the launch, not by any co-scheduled prompt's length.
+
+        ``rows`` is the packed host schedule, ONE int32 [rows_cap, 5]
+        upload per launch: columns (input token, physical page to write
+        this token's K/V, in-page offset, causal visibility = absolute
+        position + 1, page-table row / slot).  Padding rows carry
+        slot -1 / visibility 0 and scatter into the trash page.
+        ``tables`` [slots, pages_per_seq] feeds the kernel's
+        scalar-prefetch index maps.  Returns the updated (donated) page
+        pools and fp32 logits for EVERY row — sampling is host-side
+        (greedy argmax, temperature, and speculative accept/reject all
+        read the same array)."""
+        from ..models.generation import (_CFGS, _Weights, _apply_rope,
+                                         _rms_norm)
+        from ..ops.pallas.decode_attention import ragged_paged_decode_raw
+
+        cfg, _, _ = _CFGS[self_cfg_id]
+        w = _Weights(cfg, params)
+        L = cfg.num_hidden_layers
+        h, kvh, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        T = rows.shape[0]
+        tok = rows[:, 0]
+        phys = rows[:, 1]
+        off = rows[:, 2]
+        lens = rows[:, 3]
+        slot = rows[:, 4]
+        x = w.embed(tok)                              # [T, hidden]
+        pos = jnp.maximum(lens - 1, 0)
+        cos = jnp.take(cos_tab, pos, axis=0)[:, None, :].astype(x.dtype)
+        sin = jnp.take(sin_tab, pos, axis=0)[:, None, :].astype(x.dtype)
+        new_k, new_v = list(k_pages), list(v_pages)
+        rep_ = h // kvh
+        for i in range(L):
+            xin = _rms_norm(x, w.layer(i, "input_layernorm.weight"),
+                            cfg.rms_norm_eps)
+            q = (xin @ w.layer(i, "self_attn.q_proj.weight")
+                 ).reshape(T, h, d)
+            k = (xin @ w.layer(i, "self_attn.k_proj.weight")
+                 ).reshape(T, kvh, d)
+            v = (xin @ w.layer(i, "self_attn.v_proj.weight")
+                 ).reshape(T, kvh, d)
+            q, k = _apply_rope(q, k, cos, sin)
+            kw_, vw_, qd = k, v, q
+            if new_k[i].dtype == jnp.int8:
+                kw_ = _round_int8(kw_.astype(jnp.float32)
+                                  * kv_scales["kq"][i][None, :, None])
+                vw_ = _round_int8(vw_.astype(jnp.float32)
+                                  * kv_scales["vq"][i][None, :, None])
+                kdq = jnp.repeat(kv_scales["kdq"][i], rep_)
+                qd = (qd.astype(jnp.float32)
+                      * kdq[None, :, None]).astype(q.dtype)
+            # scatter ALL rows' K/V first (a chunk row must see its
+            # in-chunk predecessors), then one ragged kernel launch
+            kp = new_k[i].at[phys, :, off, :].set(
+                kw_.astype(new_k[i].dtype))
+            vp = new_v[i].at[phys, :, off, :].set(
+                vw_.astype(new_v[i].dtype))
+            new_k[i], new_v[i] = kp, vp
+            ctx = ragged_paged_decode_raw(qd, kp, vp, lens, slot, tables,
+                                          scale=d ** -0.5,
+                                          pages_per_step=pages_per_step)
+            if kp.dtype == jnp.int8:
+                vdq = jnp.repeat(kv_scales["vdq"][i], rep_)
+                ctx = ctx.astype(jnp.float32) * vdq[None, :, None]
+            x = x + (ctx.reshape(T, h * d).astype(x.dtype)
+                     @ w.layer(i, "self_attn.o_proj.weight"))
+            xm = _rms_norm(x, w.layer(i, "post_attention_layernorm"
+                                         ".weight"), cfg.rms_norm_eps)
+            gate = xm @ w.layer(i, "mlp.gate_proj.weight")
+            up = xm @ w.layer(i, "mlp.up_proj.weight")
+            x = x + (jax.nn.silu(gate) * up) @ w.layer(
+                i, "mlp.down_proj.weight")
+        if not with_head:
+            # draft cache-mirror launches only need the K/V scatter side
+            # effect: skip the [T, hidden] x [hidden, vocab] head matmul
+            # and the fp32 logits allocation entirely
+            return tuple(new_k), tuple(new_v), None
+        x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
+        logits = w.head(x).astype(jnp.float32)        # [T, vocab]
+        return tuple(new_k), tuple(new_v), logits
+
     # ---------------- host scheduler ----------------
 
     def add_request(self, prompt, max_new_tokens: int = 32, rid=None,
-                    arrival: float = 0.0):
+                    arrival: float = 0.0, temperature: float = 0.0,
+                    seed: int = 0):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
@@ -434,11 +826,16 @@ class ContinuousBatchingEngine:
                 f"{self._pages_needed(len(prompt) + max_new_tokens)} pages "
                 f"but the pool only has {self.alloc.total} — it could "
                 f"never be admitted (head-of-line livelock)")
+        if temperature > 0 and not self.unified:
+            raise ValueError("temperature sampling requires the unified "
+                             "engine (host-side sampling from returned "
+                             "logits); the legacy chunked path is "
+                             "greedy-only")
         if rid is None:
             rid = self._next_rid
             self._next_rid += 1
         self.queue.append(Request(int(rid), prompt, int(max_new_tokens),
-                                  arrival))
+                                  arrival, float(temperature), int(seed)))
         return rid
 
     def _pages_needed(self, tokens: int) -> int:
@@ -522,6 +919,361 @@ class ContinuousBatchingEngine:
         self.slot_rid[slot] = -1
         self._dirty[slot] = True
         self._pending[slot] = 0
+        # unified-plane slot state (no-ops on the legacy path)
+        self.pending_prompt.pop(slot, None)
+        if slot in self.prefill_order:
+            self.prefill_order.remove(slot)
+        self.req_info.pop(slot, None)
+
+    # ---------------- unified serving plane (round 11) ----------------
+    #
+    # One ragged launch per engine step serves THREE request phases at
+    # once: decode slots (one row each), prompt-prefill chunks (up to
+    # ``prefill_token_budget`` rows, split across one or more admitted
+    # requests), and speculative verify windows (k+1 rows per slot).
+    # Admission walks the radix prefix cache first, so chat-shaped
+    # traffic with a shared system prompt maps the shared full pages
+    # copy-on-write and prefills only its private suffix.
+
+    def _phys(self, slot: int, pos: int) -> int:
+        """Physical page holding ``pos`` of ``slot``'s sequence (pages
+        are reserved through prompt+max_new at admission, so a write
+        position past the table is a scheduler bug, not pool pressure)."""
+        page = int(self.tables[slot, pos // self.page_size])
+        if page < 0:
+            raise AssertionError(
+                f"slot {slot} writing position {pos} past its reserved "
+                f"pages — admission under-reserved")
+        return page
+
+    def _admit_unified(self) -> List[tuple]:
+        """Admit queued prompts into free slots.  Unlike the legacy
+        path, NO prefill runs here — the prompt enters the pending
+        queue and is consumed ``prefill_token_budget`` tokens per step
+        by the unified launch, so a long prompt never stalls in-flight
+        decode slots.  Prefix-cache hits map the shared full pages into
+        the new table (copy-on-write: the request only ever writes at
+        or past its private suffix) and skip their prefill entirely."""
+        admitted = []
+        free_slots = [s for s in range(self.max_slots)
+                      if not self.active[s]]
+        si = 0
+        while self.queue and si < len(free_slots):
+            req = self.queue[0]
+            plen = len(req.prompt)
+            need = self._pages_needed(plen + req.max_new_tokens)
+            shared: List[int] = []
+            matched = 0
+            if self.prefix_cache is not None:
+                shared, matched = self.prefix_cache.lookup(req.prompt)
+            need_new = need - len(shared)
+            if need_new > self.alloc.available \
+                    and self.prefix_cache is not None:
+                self.prefix_cache.evict(need_new - self.alloc.available)
+            if need_new > self.alloc.available:
+                if shared:          # aborted hit: hand the refs back
+                    self.alloc.release(shared)
+                break               # head-of-line waits for pages
+            self.queue.popleft()
+            slot = free_slots[si]
+            si += 1
+            pages = list(shared) \
+                + [self.alloc.alloc() for _ in range(need_new)]
+            self.slot_pages[slot] = pages
+            self.tables[slot] = -1
+            self.tables[slot, :need] = pages
+            self.active[slot] = True
+            self.seq_lens[slot] = matched
+            self.cur_tok[slot] = 0
+            self.budget[slot] = req.max_new_tokens
+            self.slot_rid[slot] = req.rid
+            self.pending_prompt[slot] = np.asarray(req.prompt[matched:],
+                                                   np.int32)
+            self.prefill_order.append(slot)
+            req.rng = np.random.default_rng(req.seed)
+            self.req_info[slot] = req
+            self.prompt_lens[req.rid] = plen
+            self.prefill_stats[req.rid] = {
+                "prompt_len": plen, "cached_tokens": matched,
+                "prefilled": 0}
+            if self.prefix_cache is not None:
+                self.prefix_cache.record_hit(matched)
+            admitted.append((slot, plen))
+        return admitted
+
+    def _sample_row(self, logits_row: np.ndarray, req: Request) -> int:
+        """Sample the next token from one returned logits row: greedy
+        argmax (bit-compatible with the device argmax the legacy path
+        used — same fp32 values, same first-max tie-break) or host-side
+        temperature sampling from the request's seeded stream."""
+        if req.temperature <= 0:
+            return int(np.argmax(logits_row))
+        p = _softmax_np(logits_row, req.temperature)
+        return int(req.rng.choice(len(p), p=p))
+
+    def _draft_launch(self, rows_np: np.ndarray, need_logits: bool = True):
+        """One draft-model launch over a packed row schedule; returns
+        host logits.  The draft pools mirror the target's page geometry
+        so the SAME rows/tables drive both models.  ``need_logits=False``
+        (the cache-mirror call) compiles a head-less variant of the step
+        (no vocab projection, no logits buffer) and skips the
+        device-to-host copy — the mirror only needs the K/V scatter."""
+        d = self.draft
+        d["k_pages"], d["v_pages"], logits = \
+            ContinuousBatchingEngine._unified_step_jit(
+                d["params"], d["k_pages"], d["v_pages"],
+                jnp.asarray(rows_np), jnp.asarray(self.tables),
+                d["cos_tab"], d["sin_tab"], self_cfg_id=d["cfg_id"],
+                pages_per_step=self.pages_per_step,
+                with_head=need_logits)
+        return np.asarray(logits) if need_logits else None
+
+    def _propose(self, decoding: List[int]) -> Dict[int, tuple]:
+        """Draft-model proposals: up to ``spec_k`` tokens per decoding
+        slot, one batched draft launch per proposal depth (the draft's
+        K/V for each proposed token is scattered by its own launch, so
+        proposal j+1 attends proposal j).  Returns
+        slot -> (draft_tokens, draft_prob_rows) — prob rows are None
+        under greedy (exact prefix-match acceptance needs no q)."""
+        props: Dict[int, tuple] = {}
+        keff: Dict[int, int] = {}
+        for s in decoding:
+            cap = len(self.slot_pages[s]) * self.page_size
+            keff[s] = max(0, min(self.spec_k,
+                                 int(self.budget[s]) - 1,
+                                 cap - int(self.seq_lens[s]) - 1))
+            props[s] = ([], [])
+        for j in range(max(keff.values(), default=0)):
+            rows = np.zeros((self.max_slots, 5), np.int32)
+            rows[:, 1] = self.trash_page
+            rows[:, 4] = -1
+            live = []
+            for s in decoding:
+                if keff[s] <= j:
+                    continue
+                tok = (int(self.cur_tok[s]) if j == 0
+                       else props[s][0][j - 1])
+                p = int(self.seq_lens[s]) + j
+                rows[s] = (tok, self._phys(s, p), p % self.page_size,
+                           p + 1, s)
+                live.append(s)
+            if not live:
+                break
+            logits = self._draft_launch(rows)
+            for s in live:
+                req = self.req_info[s]
+                if req.temperature <= 0:
+                    props[s][0].append(int(np.argmax(logits[s])))
+                    props[s][1].append(None)
+                else:
+                    q = _softmax_np(logits[s], req.temperature)
+                    props[s][0].append(int(req.rng.choice(len(q), p=q)))
+                    props[s][1].append(q)
+        return props
+
+    def _commit_window(self, slot: int, start: int, n: int,
+                       logits: np.ndarray, prop) -> List[int]:
+        """Accept/reject one slot's verify window (rows ``start`` ..
+        ``start+n-1``; window inputs were [cur_tok, d_1..d_{n-1}]) and
+        commit the emitted tokens.  Greedy targets use exact
+        prefix-match acceptance; temperature>0 uses standard rejection
+        sampling (accept d with prob min(1, p(d)/q(d)), resample the
+        first rejection from max(p-q, 0)).  n == 1 (no draft tokens)
+        degenerates to plain decode.  Returns the emitted tokens."""
+        req = self.req_info[slot]
+        rid = int(self.slot_rid[slot])
+        drafts = prop[0] if prop else []
+        qrows = prop[1] if prop else []
+        emitted: List[int] = []
+        if req.temperature <= 0:
+            for j in range(n - 1):
+                t = int(np.argmax(logits[start + j]))
+                emitted.append(t)
+                if drafts[j] != t:
+                    break
+            else:
+                emitted.append(int(np.argmax(logits[start + n - 1])))
+        else:
+            rng = req.rng
+            for j in range(n - 1):
+                p = _softmax_np(logits[start + j], req.temperature)
+                d = drafts[j]
+                q = qrows[j]
+                if rng.random() < min(1.0, p[d] / max(q[d], 1e-30)):
+                    emitted.append(d)
+                else:
+                    resid = np.maximum(p - q, 0.0)
+                    tot = resid.sum()
+                    tok = (int(np.argmax(p)) if tot <= 0
+                           else int(rng.choice(len(p), p=resid / tot)))
+                    emitted.append(tok)
+                    break
+            else:
+                p = _softmax_np(logits[start + n - 1], req.temperature)
+                emitted.append(int(rng.choice(len(p), p=p)))
+        if n > 1:
+            self.accepted_lengths.append(len(emitted))
+        take: List[int] = []
+        for t in emitted:
+            take.append(t)
+            if t == self.eos_id:
+                break
+        for t in take:
+            self.out_tokens[rid].append(t)
+        # window rows committed K/V for positions len..len+len(take)-1
+        # (inputs cur_tok, d_1..); positions past the accepted prefix
+        # hold rejected-draft garbage ABOVE the new length — invisible
+        # (visibility is bounded by lens) and overwritten by later steps
+        self.seq_lens[slot] += len(take)
+        self.cur_tok[slot] = take[-1]
+        self.budget[slot] -= len(take)
+        if self.budget[slot] <= 0 or take[-1] == self.eos_id:
+            self._finish(slot)
+        return take
+
+    def _step_unified(self) -> int:
+        """One unified engine step: admit, propose (draft), pack ONE
+        ragged row schedule — a decode/verify window per decoding slot
+        plus up to ``prefill_token_budget`` prompt tokens — launch the
+        target once, sample host-side, commit.  Decode slots emit at
+        least one token EVERY step regardless of any co-scheduled
+        prompt's length: that is the latency contract chunked prefill
+        exists for."""
+        admitted = self._admit_unified()
+        enc = np.zeros(self.max_slots, np.int32)
+        this_dec = np.zeros(self.max_slots, np.int32)
+
+        decoding = [s for s in range(self.max_slots)
+                    if self.active[s] and s not in self.pending_prompt]
+        props = {}
+        if self.draft is not None and self.spec_k > 0 and decoding:
+            props = self._propose(decoding)
+
+        rows = np.zeros((self.rows_cap, 5), np.int32)
+        rows[:, 1] = self.trash_page
+        rows[:, 4] = -1
+        r = 0
+        metas = []
+        for s in decoding:
+            base = int(self.seq_lens[s])
+            window = [int(self.cur_tok[s])] \
+                + list(props.get(s, ([], []))[0])
+            start = r
+            for j, t in enumerate(window):
+                p = base + j
+                rows[r] = (t, self._phys(s, p), p % self.page_size,
+                           p + 1, s)
+                r += 1
+            metas.append(("verify", s, start, len(window)))
+        left = self.prefill_budget
+        for s in list(self.prefill_order):
+            if left <= 0:
+                break
+            pend = self.pending_prompt[s]
+            chunk = min(len(pend), left)
+            base = int(self.seq_lens[s])
+            start = r
+            for j in range(chunk):
+                p = base + j
+                rows[r] = (int(pend[j]), self._phys(s, p),
+                           p % self.page_size, p + 1, s)
+                r += 1
+            left -= chunk
+            enc[s] = chunk
+            metas.append(("prefill", s, start, chunk))
+        if r == 0:
+            self.last_report = {
+                "seq_lens_encoder": enc,
+                "seq_lens_decoder": np.zeros(self.max_slots, np.int32),
+                "seq_lens_this_time": enc + this_dec,
+            }
+            return 0
+
+        dec = np.where(self.active, self.seq_lens, 0).astype(np.int32)
+        rows_j = jnp.asarray(rows)
+        self.k_pages, self.v_pages, logits = \
+            ContinuousBatchingEngine._unified_step_jit(
+                self.params, self.k_pages, self.v_pages, rows_j,
+                jnp.asarray(self.tables), self.cos_tab, self.sin_tab,
+                self_cfg_id=self.cfg_id,
+                pages_per_step=self.pages_per_step)
+        if self.draft is not None:
+            # mirror the SAME rows through the draft: its paged cache
+            # tracks the target's committed stream (prefill chunks
+            # included), so the next proposal round starts in sync —
+            # rejected-draft positions land above the rolled-back
+            # length, exactly like the target's own window writes
+            self._draft_launch(rows, need_logits=False)
+        logits = np.asarray(logits)
+
+        produced = 0
+        for kind, s, start, n in metas:
+            rid = int(self.slot_rid[s])
+            if kind == "verify":
+                take = self._commit_window(s, start, n, logits,
+                                           props.get(s))
+                this_dec[s] = len(take)
+                produced += len(take)
+                continue
+            # prefill chunk: commit the scattered prompt K/V
+            req = self.req_info[s]
+            self.seq_lens[s] += n
+            self.prefill_stats[rid]["prefilled"] += n
+            pend = self.pending_prompt[s]
+            if n < len(pend):
+                self.pending_prompt[s] = pend[n:]
+                continue
+            # prompt complete: the chunk's last row carries the
+            # first-token logits; commit full pages to the prefix cache
+            del self.pending_prompt[s]
+            self.prefill_order.remove(s)
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(req.prompt, self.slot_pages[s])
+            tok = self._sample_row(logits[start + n - 1], req)
+            self.cur_tok[s] = tok
+            self.out_tokens[rid] = [tok]
+            self.budget[s] = req.max_new_tokens - 1
+            this_dec[s] += 1
+            produced += 1
+            if tok == self.eos_id or self.budget[s] <= 0:
+                self._finish(s)
+        self.last_report = {
+            "seq_lens_encoder": enc,
+            "seq_lens_decoder": dec,
+            "seq_lens_this_time": enc + this_dec,
+        }
+        return produced
+
+    def shutdown(self) -> None:
+        """Engine teardown: drop the prefix cache's page references and
+        run the allocator leak check — a COW refcount bug (double
+        release, leaked trie ref) fails HERE, not as silent pool
+        exhaustion three requests later."""
+        if self.active.any() or self.queue:
+            raise AssertionError(
+                "shutdown with live requests — drain via run() first")
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+        self.alloc.assert_balanced()
+        if self.alloc.available != self.alloc.total:
+            raise AssertionError(
+                f"page leak at teardown: {self.alloc.total - self.alloc.available} "
+                f"pages still referenced")
+
+    def serving_stats(self) -> Dict[str, Any]:
+        """Serving-plane telemetry: prefix-cache counters, per-request
+        prefill accounting (the FLOPs-skip contract) and speculative
+        accepted-length distribution."""
+        out: Dict[str, Any] = {
+            "prefill": dict(self.prefill_stats),
+            "accepted_lengths": list(self.accepted_lengths),
+        }
+        if self.accepted_lengths:
+            out["mean_accepted_len"] = float(
+                np.mean(self.accepted_lengths))
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out
 
     def _pack_sched(self) -> np.ndarray:
         P = self.pages_per_seq
@@ -603,9 +1355,13 @@ class ContinuousBatchingEngine:
         return produced, this_time
 
     def step(self):
-        """One scheduler iteration: admit, launch the next decode chunk,
-        harvest the previous one.  Returns the number of tokens
-        consumed this iteration (0 while the pipeline fills)."""
+        """One scheduler iteration.  Unified engines run the ragged
+        admit/propose/launch/commit step; legacy engines admit, launch
+        the next decode chunk and harvest the previous one.  Returns
+        the number of tokens consumed this iteration (legacy: 0 while
+        the pipeline fills)."""
+        if self.unified:
+            return self._step_unified()
         admitted = self._admit()
         enc = np.zeros(self.max_slots, np.int32)
         for s, plen in admitted:
@@ -650,6 +1406,8 @@ class ContinuousBatchingEngine:
             report = paddle_tpu.analysis.check(
                 fn, *args, kwargs=kwargs, options=options)
         """
+        if self.unified:
+            return self._unified_analysis_entry()
         dev_tok = (self._dev_tok if self._dev_tok is not None
                    else jnp.zeros((self.max_slots,), jnp.int32))
         fn = ContinuousBatchingEngine._decode_chunk_jit
@@ -663,6 +1421,30 @@ class ContinuousBatchingEngine:
         # default: tiny test/debug engines must still FAIL the doctor if
         # the pools stop being donated (a vacuous gate passes when the
         # contract breaks)
+        pool_bytes = min(int(np.prod(k.shape)) * k.dtype.itemsize
+                         for k in self.k_pages)
+        options = {"donation": {"persistent": (0, 5, 6),
+                                "min_bytes": min(1 << 20,
+                                                 max(1, pool_bytes // 2))}}
+        return fn, args, kwargs, options
+
+    def _unified_analysis_entry(self):
+        """Doctor entry for the unified ragged step: the SAME jit the
+        scheduler launches, at its static row capacity (decode rows +
+        spec windows + a full prefill chunk) — the serving hot path of
+        the round-11 plane.  Argument indices match the legacy entry:
+        params/rope tables persistent, page pools donated; the packed
+        row schedule and page table are per-step uploads (small int32,
+        below the donation floor by construction)."""
+        rows = np.zeros((self.rows_cap, 5), np.int32)
+        rows[:, 1] = self.trash_page
+        rows[:, 4] = -1
+        fn = ContinuousBatchingEngine._unified_step_jit
+        args = (self.params, self.k_pages, self.v_pages,
+                jnp.asarray(rows), jnp.asarray(self.tables),
+                self.cos_tab, self.sin_tab)
+        kwargs = dict(self_cfg_id=self.cfg_id,
+                      pages_per_step=self.pages_per_step)
         pool_bytes = min(int(np.prod(k.shape)) * k.dtype.itemsize
                          for k in self.k_pages)
         options = {"donation": {"persistent": (0, 5, 6),

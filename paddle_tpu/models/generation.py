@@ -144,6 +144,34 @@ def quantize_params_int8(params, keep=("norm", "layernorm")):
     return out
 
 
+def self_draft_params(cfg, params, num_layers: int):
+    """Layer-truncated self-speculative draft: reuse the target's first
+    ``num_layers`` decoder layers plus its embeddings / final norm /
+    head as the proposer model (no separate distilled checkpoint
+    needed — the early layers of the same network are a classic cheap
+    drafter).  Returns ``(draft_cfg, draft_params)`` ready for
+    ``ContinuousBatchingEngine(draft_params=..., draft_cfg=...)``.
+
+    Weight-only int8 dicts pass through unchanged: the ``._scale``
+    siblings of kept layers ride along, so an int8 target drafts with
+    int8 weights too (compose with ``quantize_params_int8`` in either
+    order)."""
+    import dataclasses
+
+    n = int(num_layers)
+    if not 0 < n <= cfg.num_hidden_layers:
+        raise ValueError(
+            f"draft depth {n} outside (0, {cfg.num_hidden_layers}]")
+    dcfg = dataclasses.replace(cfg, num_hidden_layers=n)
+    dparams = {}
+    for k, v in params.items():
+        if k.startswith("model.layers."):
+            if int(k.split(".")[2]) >= n:
+                continue
+        dparams[k] = v
+    return dcfg, dparams
+
+
 def _block(w: _Weights, i, x, cos, sin, mask, k_all=None, v_all=None,
            cache_pos=None):
     """One decoder layer. x [b, s, hdim]; without a cache (prefill) it

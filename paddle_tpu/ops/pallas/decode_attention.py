@@ -174,7 +174,8 @@ def flash_decode_raw(q, k_cache, v_cache, seq_lens, scale=None,
     return out[:, :, :rep].reshape(b, h, d)
 
 
-def _paged_decode_kernel(*refs, page: int, pp: int, scale: float):
+def _paged_decode_kernel(*refs, page: int, pp: int, scale: float,
+                         nsp: int = 2):
     """Paged online-softmax decode body iterating ``pp`` physical pages
     per grid step.  The per-page k/v refs were DMA'd independently by
     ``pp`` scalar-prefetch index maps (ragged page iteration fused into
@@ -183,11 +184,17 @@ def _paged_decode_kernel(*refs, page: int, pp: int, scale: float):
     blocks are tiny, so per-grid-step overhead dominates — folding pp
     pages into one step recovers the dense kernel's ~512-token window
     (measured r4/r5: 64-128 token pages paid ~3x the dense kernel's
-    grid overhead)."""
+    grid overhead).
+
+    ``nsp`` is the number of scalar-prefetch operands ahead of q: 2 for
+    the per-sequence layout (seq_lens, tables), 3 for the ragged
+    per-row layout (row_lens, row_slot, tables) — the body itself only
+    ever reads refs[0] (the per-grid-row visibility length), so both
+    layouts share it."""
     seq_ref = refs[0]
-    q_ref = refs[2]
-    k_refs = refs[3:3 + pp]
-    v_refs = refs[3 + pp:3 + 2 * pp]
+    q_ref = refs[nsp]
+    k_refs = refs[nsp + 1:nsp + 1 + pp]
+    v_refs = refs[nsp + 1 + pp:nsp + 1 + 2 * pp]
     o_ref, m_scr, l_scr, acc_scr = refs[-4:]
     bi = pl.program_id(0)
     gi = pl.program_id(1)
@@ -378,6 +385,107 @@ def paged_decode_raw(q, key_cache, value_cache, seq_lens, block_tables,
     return out[:, :, :rep].reshape(b, h, d)
 
 
+def ragged_paged_decode_raw(q, key_cache, value_cache, row_lens, row_slot,
+                            block_tables, scale=None, interpret=None,
+                            pages_per_step="auto"):
+    """Ragged paged flash attention: the serving plane's unified
+    prefill+decode step (the Ragged Paged Attention kernel shape,
+    PAPERS.md 2604.15464), built as a per-ROW generalization of
+    ``paged_decode_raw``'s scalar-prefetch index maps.
+
+    q [T, h, d] is a PACKED array of query tokens from MANY sequences in
+    one launch: decode slots contribute one row each (q_len=1), prefill
+    chunks contribute a row per prompt token (q_len=chunk), speculative
+    verify contributes q_len=k+1 rows.  Per row:
+
+    - ``row_slot`` [T] int32 — which sequence (page-table row) the token
+      belongs to (<0 = padding row, output forced to zero);
+    - ``row_lens`` [T] int32 — causal visibility: row r attends cache
+      positions < row_lens[r] of its sequence (for a token at absolute
+      position p this is p+1, so a prefill chunk's rows each see the
+      shared prefix plus the chunk tokens at or before themselves —
+      their K/V must already be scattered into the pages, exactly like
+      the decode contract);
+    - ``block_tables`` [slots, max_pages] int32 physical page ids.
+
+    The page indirection happens in the index maps: grid step (r, g)
+    DMAs ``pages_per_step`` physical pages of row r's sequence via
+    ``tab_ref[row_slot[r], ...]`` — the same clamp-to-last-valid-page
+    trick bounds both HBM traffic and compute by each ROW's visibility,
+    so a decode row costs one tiny step regardless of how many prefill
+    rows share the launch (the property that makes mixing chunked
+    prefill into the decode batch latency-safe).  Per-row grid steps
+    keep the decode rows' cost identical to ``paged_decode_raw``;
+    prefill rows pay one grid trip per row (the RPA paper's fused
+    multi-row q tiles are the TPU follow-on once chunk shapes are
+    pinned)."""
+    T, h, d = q.shape
+    kvh, page = key_cache.shape[1], key_cache.shape[2]
+    if h % kvh != 0:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {kvh}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    rep = h // kvh
+    rp = -(-rep // 8) * 8
+    max_pages = block_tables.shape[1]
+    if pages_per_step == "auto":
+        pages_per_step = default_pages_per_step(
+            page, kvh, d, max_pages, jnp.dtype(key_cache.dtype).itemsize)
+    pp = max(1, min(int(pages_per_step), max_pages))
+    ng = -(-max_pages // pp)
+
+    qg = q.reshape(T, kvh, rep, d)
+    if rp != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rp - rep), (0, 0)))
+    lens = row_lens.astype(jnp.int32)
+    # padding rows (slot < 0) clamp to table row 0 with visibility 0:
+    # their DMA still lands somewhere valid, their output is forced to 0
+    lens = jnp.where(row_slot < 0, 0, lens)
+    slots = jnp.maximum(row_slot.astype(jnp.int32), 0)
+    tables = block_tables.astype(jnp.int32)
+
+    def kv_map(j):
+        def _map(ri, gi, lens_ref, slot_ref, tab_ref):
+            # clamp to the row's last VISIBLE page: grid steps past it
+            # revisit the same page and Mosaic elides the DMA, so a
+            # decode row never streams a prefill row's page span
+            last = jnp.maximum((lens_ref[ri] + page - 1) // page - 1, 0)
+            last = jnp.minimum(last, max_pages - 1)
+            phys = tab_ref[slot_ref[ri], jnp.minimum(gi * pp + j, last)]
+            return (jnp.maximum(phys, 0), 0, 0, 0)
+        return _map
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T, ng),
+        in_specs=(
+            [pl.BlockSpec((1, kvh, rp, d),
+                          lambda ri, gi, l, s, t: (ri, 0, 0, 0))]
+            + [pl.BlockSpec((1, kvh, page, d), kv_map(j)) for j in range(pp)]
+            + [pl.BlockSpec((1, kvh, page, d), kv_map(j)) for j in range(pp)]
+        ),
+        out_specs=pl.BlockSpec((1, kvh, rp, d),
+                               lambda ri, gi, l, s, t: (ri, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kvh, rp, 128), jnp.float32),
+            pltpu.VMEM((kvh, rp, 128), jnp.float32),
+            pltpu.VMEM((kvh, rp, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page=page, pp=pp,
+                          scale=float(scale), nsp=3),
+        grid_spec=grid_spec,
+        out_shape=_sds((T, kvh, rp, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(lens, slots, tables, qg, *([key_cache] * pp), *([value_cache] * pp))
+    return out[:, :, :rep].reshape(T, h, d)
+
+
 # framework op registration (forward-only inference ops)
 from ..registry import register  # noqa: E402
 
@@ -394,3 +502,12 @@ def paged_flash_decoding_op(q, key_cache, value_cache, seq_lens,
     return paged_decode_raw(q, key_cache, value_cache, seq_lens,
                             block_tables, scale=scale,
                             pages_per_step=pages_per_step)
+
+
+@register("ragged_paged_flash_decoding", amp="white")
+def ragged_paged_flash_decoding_op(q, key_cache, value_cache, row_lens,
+                                   row_slot, block_tables, scale=None,
+                                   pages_per_step="auto"):
+    return ragged_paged_decode_raw(q, key_cache, value_cache, row_lens,
+                                   row_slot, block_tables, scale=scale,
+                                   pages_per_step=pages_per_step)

@@ -184,6 +184,12 @@ def _install():
         "is_complex", "is_floating_point", "is_integer", "real",
         "imag", "conj", "angle", "as_real", "as_complex", "rank",
         "shard_index",
+        # ---- round-11 tranche: inverse-hyperbolic + special-function
+        # method forms (their in-place partners ride inplace_methods
+        # below; the comparison/logical in-place family closes there
+        # too)
+        "asinh", "acosh", "atanh", "i0e", "i1", "i1e", "gammaln",
+        "gammainc", "gammaincc", "multigammaln", "swapaxes", "frexp",
     ]
 
     def mk_top(opname):
@@ -222,6 +228,16 @@ def _install():
         # round-10 tranche: in-place forms in the sorting/searching/
         # linalg families where the reference defines them
         "index_add_", "put_along_axis_", "lerp_", "renorm_",
+        # round-11 tranche: inverse-trig/hyperbolic + special-function
+        # in-place forms, and the comparison/logical in-place family
+        "asin_", "acos_", "atan_", "sinh_", "cosh_", "asinh_",
+        "acosh_", "atanh_", "log1p_", "erfinv_", "logit_", "i0_",
+        "hypot_", "nan_to_num_", "gcd_", "lcm_", "ldexp_", "copysign_",
+        "equal_", "not_equal_", "greater_than_", "less_than_",
+        "greater_equal_", "less_equal_", "logical_and_", "logical_or_",
+        "logical_xor_", "bitwise_and_", "bitwise_or_", "bitwise_xor_",
+        "bitwise_left_shift_", "bitwise_right_shift_", "gammaln_",
+        "gammainc_", "gammaincc_", "multigammaln_",
     ]
     def mk_in(opname):
         def method(self, *args, **kwargs):

@@ -23,6 +23,17 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-2 marker: multi-process gang tests (launcher + TCPStore
+    # rendezvous of jax-importing workers).  On throttled-CPU containers
+    # the simultaneous worker imports routinely blow the 60s rendezvous
+    # barrier, so these are excluded from the tier-1 sweep
+    # (-m 'not slow', see ROADMAP.md) and run explicitly via -m slow.
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process gang integration tests (tier-2; -m slow)")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

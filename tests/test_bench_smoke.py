@@ -35,6 +35,11 @@ def test_bench_smoke_green():
                 # host-offloaded streamed AdamW parity + autotune, and
                 # the memory_budget pass (MEM/HLO003 fixtures + the
                 # flagship peak-HBM budget pin)
-                "memory_parity", "memory_budget_doctor"):
+                "memory_parity", "memory_budget_doctor",
+                # round-11: the production serving plane — open-loop
+                # Poisson trace through the unified engine with prefix
+                # cache + chunked prefill + speculative decode (hits>0,
+                # mean accepted length > 1, all requests complete)
+                "serving_trace"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]

@@ -48,6 +48,7 @@ class TestBert:
         names = [n for n, _ in m.named_parameters()]
         assert not any("decoder" in n for n in names)
 
+    @pytest.mark.slow  # heavy breadth sweep: tier-2 (tier-1 870s budget)
     def test_dp_train_step_loss_decreases(self, cpu_mesh8):
         from jax.sharding import Mesh
 
@@ -116,6 +117,7 @@ class TestPPYOLOE:
         assert np.isfinite(float(loss))
         assert set(parts) == {"cls", "box", "dfl"}
 
+    @pytest.mark.slow  # heavy breadth sweep: tier-2 (tier-1 870s budget)
     def test_training_decreases_loss(self):
         cfg, net = self._setup()
         net.train()

@@ -69,6 +69,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow  # gang rendezvous: tier-2 on throttled CPU
 def test_two_process_p2p_and_object_collectives(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)

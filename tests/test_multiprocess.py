@@ -71,6 +71,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow  # gang rendezvous: tier-2 on throttled CPU
 def test_two_process_collective_and_checkpoint(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
@@ -110,6 +111,7 @@ def test_two_process_collective_and_checkpoint(tmp_path):
     assert sd["step"] == 7
 
 
+@pytest.mark.slow  # gang rendezvous: tier-2 on throttled CPU
 def test_two_node_launcher_rendezvous(tmp_path):
     """Two launcher processes (simulated nodes) rendezvous through the
     master TCPStore and agree on one 4-endpoint world (reference master

@@ -90,6 +90,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow  # gang rendezvous: tier-2 on throttled CPU
 def test_multicontroller_hybrid_flagship(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)

@@ -144,6 +144,7 @@ def test_to_dtype():
     assert str(m.weight.dtype) == "bfloat16"
 
 
+@pytest.mark.slow  # heavy breadth sweep: tier-2 (tier-1 870s budget)
 def test_vision_model_zoo_forward():
     """New model families (VERDICT r1 item 10): small-input forwards."""
     from paddle_tpu.vision import models as M
@@ -167,6 +168,7 @@ def test_vision_model_zoo_forward():
         assert tuple(out.shape) == (1, 3), ctor.__name__
 
 
+@pytest.mark.slow  # heavy breadth sweep: tier-2 (tier-1 870s budget)
 def test_vision_models_squeeze_shuffle_google():
     from paddle_tpu.vision import models as M
 

@@ -58,6 +58,7 @@ def _layer_params(cfg, rng):
 
 
 @pytest.mark.parametrize("name", ["1F1B", "ZBH1"])
+@pytest.mark.slow  # heavy breadth sweep: tier-2 (tier-1 870s budget)
 def test_decoder_layer_pipeline_parity(name):
     cfg = _cfg()
     rng = np.random.RandomState(0)

@@ -11,6 +11,8 @@ import numpy as np
 
 from paddle_tpu.distributed.ps import SparseTable
 
+import pytest
+
 
 class TestSparseTable:
     def test_lazy_init_and_sgd(self):
@@ -103,6 +105,7 @@ def _run_gang(tmp_path, script_body, nproc=3):
     return r, logs
 
 
+@pytest.mark.slow  # gang rendezvous: tier-2 on throttled CPU
 def test_ps_gang(tmp_path):
     r, logs = _run_gang(tmp_path, WORKER)
     assert r.returncode == 0, (r.stdout, r.stderr, logs)
@@ -142,6 +145,7 @@ else:
 """
 
 
+@pytest.mark.slow  # gang rendezvous: tier-2 on throttled CPU
 def test_fleet_ps_mode(tmp_path):
     r, logs = _run_gang(tmp_path, FLEET_WORKER)
     assert r.returncode == 0, (r.stdout, r.stderr, logs)

@@ -7,6 +7,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 WORKER = """
 import operator
 import os
@@ -54,6 +56,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow  # gang rendezvous: tier-2 on throttled CPU
 def test_rpc_three_workers(tmp_path):
     script = tmp_path / "rpc_worker.py"
     script.write_text(WORKER)

@@ -9,9 +9,9 @@ import jax.numpy as jnp
 import pytest
 
 from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
-                                          PageAllocator)
+                                          PageAllocator, PrefixCache)
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-from paddle_tpu.models.generation import generate
+from paddle_tpu.models.generation import generate, self_draft_params
 
 
 @pytest.fixture(scope="module")
@@ -231,3 +231,351 @@ def test_serving_pipeline_overlaps_chunks(tiny_model):
     assert produced1 == 4 and len(eng._inflight) == 1
     eng.run()
     assert not eng._inflight and not eng.active.any()
+
+
+# =====================================================================
+# Round-11 unified serving plane: refcounted pages, radix prefix cache,
+# chunked prefill mixed into the decode step, speculative decoding.
+# =====================================================================
+
+
+def _unified(cfg, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("num_pages", 33)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_token_budget", 16)
+    return ContinuousBatchingEngine(cfg, params, **kw)
+
+
+def test_page_allocator_refcounts():
+    """Explicit acquire/release refcounting + the leak-check invariant
+    (available + live == total); double release and dead-page acquire
+    are hard failures."""
+    a = PageAllocator(4)
+    p = a.alloc()
+    a.assert_balanced()
+    a.acquire(p)                       # second owner
+    a.release([p])                     # first owner gone
+    assert a.refs[p] == 1 and p not in a.free
+    a.assert_balanced()
+    a.release([p])                     # last owner: back to the pool
+    assert a.refs[p] == 0 and a.available == 4
+    a.assert_balanced()
+    with pytest.raises(AssertionError):
+        a.release([p])                 # double release
+    with pytest.raises(AssertionError):
+        a.acquire(p)                   # acquire of a free page
+
+
+def test_unified_matches_oneshot_generate(tiny_model):
+    """The ragged unified step (chunked prefill + paged-kernel decode)
+    reproduces one-shot generate() greedy output exactly — and the
+    teardown leak check passes."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 9, 21)]
+    eng = _unified(cfg, params, max_slots=3, prefill_token_budget=8)
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=6)
+    done = eng.run()
+    assert len(done) == len(prompts)
+    for i, p in enumerate(prompts):
+        ref = generate(model, p[None], max_new_tokens=6, do_sample=False)
+        ref_new = np.asarray(ref._value if hasattr(ref, "_value") else ref
+                             )[0, len(p):]
+        np.testing.assert_array_equal(
+            done[i].tokens, ref_new[:len(done[i].tokens)],
+            err_msg=f"request {i} diverged under the unified step")
+    eng.shutdown()                     # allocator leak check
+
+
+def test_prefix_cache_hit_bit_identical_greedy(tiny_model):
+    """A warm request sharing a system prompt produces BIT-IDENTICAL
+    greedy output to the cold engine, and its prefill-token accounting
+    shows it skipped >= the shared full pages' worth of prefill."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(12)
+    sys_p = rng.integers(1, cfg.vocab_size, (37,)).astype(np.int32)
+    pa = np.concatenate([sys_p, rng.integers(1, cfg.vocab_size, (6,))
+                         .astype(np.int32)])
+    pb = np.concatenate([sys_p, rng.integers(1, cfg.vocab_size, (9,))
+                         .astype(np.int32)])
+
+    cold = _unified(cfg, params)
+    cold.add_request(pa, max_new_tokens=8)
+    cold.add_request(pb, max_new_tokens=8)
+    cold_out = {f.rid: f.tokens for f in cold.run()}
+    cold.shutdown()
+
+    warm = _unified(cfg, params, enable_prefix_cache=True)
+    ra = warm.add_request(pa, max_new_tokens=8)
+    out_a = {f.rid: f.tokens for f in warm.run()}
+    rb = warm.add_request(pb, max_new_tokens=8)
+    out_b = {f.rid: f.tokens for f in warm.run()}
+    np.testing.assert_array_equal(cold_out[0], out_a[ra])
+    np.testing.assert_array_equal(cold_out[1], out_b[rb])
+
+    st = warm.serving_stats()
+    # pb shares 37 sys tokens with pa -> 2 committed full pages (32
+    # tokens) matched; the FLOPs-skip contract: prefilled counts ONLY
+    # the private suffix
+    assert st["prefix_cache"]["hits"] == 1
+    assert st["prefill"][rb]["cached_tokens"] == 32
+    assert st["prefill"][rb]["prefilled"] == len(pb) - 32
+    assert st["prefill"][ra]["prefilled"] == len(pa)
+    warm.shutdown()
+
+
+def test_prefix_cache_hit_bit_identical_seeded_temperature(tiny_model):
+    """Warm/cold parity must also hold for temperature sampling with a
+    fixed seed: host-side fp64 sampling from returned logits replays the
+    identical stream when the prefix comes from the cache."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(13)
+    sys_p = rng.integers(1, cfg.vocab_size, (20,)).astype(np.int32)
+    p = np.concatenate([sys_p, rng.integers(1, cfg.vocab_size, (5,))
+                        .astype(np.int32)])
+
+    cold = _unified(cfg, params)
+    cold.add_request(p, max_new_tokens=8, temperature=0.8, seed=42)
+    cold_toks = cold.run()[0].tokens
+    cold.shutdown()
+
+    warm = _unified(cfg, params, enable_prefix_cache=True)
+    warm.add_request(p, max_new_tokens=8, temperature=0.8, seed=42)
+    warm.run()                          # populates the trie
+    r2 = warm.add_request(p, max_new_tokens=8, temperature=0.8, seed=42)
+    warm_toks = {f.rid: f.tokens for f in warm.run()}[r2]
+    assert warm.serving_stats()["prefill"][r2]["cached_tokens"] > 0
+    np.testing.assert_array_equal(cold_toks, warm_toks)
+    warm.shutdown()
+
+
+def test_prefix_cache_cow_isolation(tiny_model):
+    """Two live requests share prefix pages copy-on-write while their
+    suffixes diverge — and a THIRD request re-reading the shared prefix
+    afterwards still sees uncorrupted pages (greedy output equals the
+    cold engine's for all three)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(14)
+    sys_p = rng.integers(1, cfg.vocab_size, (33,)).astype(np.int32)
+    reqs = [np.concatenate([sys_p,
+                            rng.integers(1, cfg.vocab_size, (n,))
+                            .astype(np.int32)])
+            for n in (4, 7, 5)]
+
+    cold = _unified(cfg, params, max_slots=3)
+    for q in reqs:
+        cold.add_request(q, max_new_tokens=6)
+    cold_out = {f.rid: f.tokens for f in cold.run()}
+    cold.shutdown()
+
+    warm = _unified(cfg, params, max_slots=3, enable_prefix_cache=True,
+                    prefill_token_budget=8)
+    r0 = warm.add_request(reqs[0], max_new_tokens=6)
+    warm.run()
+    # both warm requests decode CONCURRENTLY off the same prefix pages
+    r1 = warm.add_request(reqs[1], max_new_tokens=6)
+    r2 = warm.add_request(reqs[2], max_new_tokens=6)
+    out = {f.rid: f.tokens for f in warm.run()}
+    np.testing.assert_array_equal(cold_out[0], warm.finished[0].tokens)
+    np.testing.assert_array_equal(cold_out[1], out[r1])
+    np.testing.assert_array_equal(cold_out[2], out[r2])
+    st = warm.serving_stats()
+    assert st["prefill"][r1]["cached_tokens"] == 32
+    assert st["prefill"][r2]["cached_tokens"] == 32
+    warm.shutdown()
+
+
+def test_prefix_cache_eviction_under_pressure(tiny_model):
+    """With the pool mostly held by refcount-0 trie pages, a new
+    request that needs them is still admitted: LRU eviction frees the
+    cold chain bottom-up, and the teardown balance still holds."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(15)
+    # pool: 8 usable pages; each 40+8-token request spans 3 pages and
+    # commits 2 full prompt pages into the trie
+    eng = _unified(cfg, params, num_pages=9, max_slots=1,
+                   enable_prefix_cache=True)
+    p1 = rng.integers(1, cfg.vocab_size, (40,)).astype(np.int32)
+    p2 = rng.integers(1, cfg.vocab_size, (40,)).astype(np.int32)
+    eng.add_request(p1, max_new_tokens=8)
+    eng.run()
+    eng.add_request(p2, max_new_tokens=8)
+    eng.run()
+    assert eng.prefix_cache.cached_pages == 4        # 2 prompts x 2
+    # 4 trie pages + 8-page pool: a 3rd distinct request needs 3 pages
+    # but only 4 are free -> fits; a 4th forces eviction of the LRU
+    # chain (p1's pages, colder than p2's)
+    p3 = rng.integers(1, cfg.vocab_size, (60,)).astype(np.int32)
+    eng.add_request(p3, max_new_tokens=8)            # needs 5 pages
+    done = eng.run()
+    assert len(done) == 3
+    assert eng.prefix_cache.evicted_pages >= 1
+    stats = eng.serving_stats()["prefix_cache"]
+    assert stats["evicted_pages"] == eng.prefix_cache.evicted_pages
+    eng.shutdown()
+
+
+def test_chunked_prefill_decode_latency_bound(tiny_model):
+    """The chunked-prefill latency contract: a LONG prompt admitted
+    mid-decode never stalls the running slot — the decode slot emits
+    >= 1 token on EVERY engine step while the prompt trickles through
+    at prefill_token_budget tokens per step."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(16)
+    eng = _unified(cfg, params, prefill_token_budget=16)
+    eng.add_request(rng.integers(1, cfg.vocab_size, (8,))
+                    .astype(np.int32), max_new_tokens=20)
+    eng.step()                          # prefill (8 <= 16: one chunk)
+    long_p = rng.integers(1, cfg.vocab_size, (60,)).astype(np.int32)
+    eng.add_request(long_p, max_new_tokens=4)
+    prefill_steps = 0
+    while eng.active[0]:
+        before = len(eng.out_tokens[0])
+        eng.step()
+        rep = eng.last_report
+        if eng.active[0] or int(eng.slot_rid[0]) != 0:
+            after = len(eng.out_tokens[0]) if 0 in eng.out_tokens else 21
+        else:
+            after = 21                  # finished this step: it emitted
+        assert after > before, \
+            "decode slot starved by a co-scheduled long prompt"
+        assert rep["seq_lens_encoder"].sum() <= 16   # chunk bound
+        if rep["seq_lens_encoder"].sum() > 0:
+            prefill_steps += 1
+    assert prefill_steps >= 4           # 60 tokens / 16-token chunks
+    done = sorted(eng.run(), key=lambda f: f.rid)
+    assert len(done[0].tokens) == 20 and len(done[1].tokens) == 4
+    eng.shutdown()
+
+
+def test_chunked_prefill_splits_across_requests(tiny_model):
+    """One step's prefill chunk packs tokens from MORE than one admitted
+    request when the budget allows (ragged multi-request chunk)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(17)
+    eng = _unified(cfg, params, max_slots=3, prefill_token_budget=24)
+    eng.add_request(rng.integers(1, cfg.vocab_size, (10,))
+                    .astype(np.int32), max_new_tokens=4)
+    eng.add_request(rng.integers(1, cfg.vocab_size, (30,))
+                    .astype(np.int32), max_new_tokens=4)
+    eng.step()
+    rep = eng.last_report
+    assert (rep["seq_lens_encoder"] > 0).sum() == 2   # both prefilled
+    assert rep["seq_lens_encoder"].sum() == 24        # budget exhausted
+    done = eng.run()
+    assert len(done) == 2
+    eng.shutdown()
+
+
+def test_speculative_greedy_exact_match(tiny_model):
+    """Speculative decoding with a greedy target emits EXACTLY the
+    non-speculative greedy stream across accept/reject boundaries —
+    with a layer-truncated self-draft (imperfect proposer: both
+    accepts and rejects occur) and with an oracle draft (all-accept)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(18)
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (7, 26)]
+
+    base = _unified(cfg, params)
+    for p in prompts:
+        base.add_request(p, max_new_tokens=10)
+    want = {f.rid: f.tokens for f in base.run()}
+    base.shutdown()
+
+    dcfg, dparams = self_draft_params(cfg, params, 1)
+    for draft_cfg, draft_params in ((dcfg, dparams), (None, params)):
+        eng = _unified(cfg, params, draft_params=draft_params,
+                       draft_cfg=draft_cfg, speculative_k=3)
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=10)
+        got = {f.rid: f.tokens for f in eng.run()}
+        for r in want:
+            np.testing.assert_array_equal(
+                want[r], got[r],
+                err_msg=f"speculative stream diverged (draft="
+                        f"{'self' if draft_cfg else 'oracle'})")
+        assert eng.accepted_lengths, "no verify windows recorded"
+        if draft_cfg is None:           # oracle: every draft accepted
+            assert np.mean(eng.accepted_lengths) > 1
+        eng.shutdown()
+
+
+def test_speculative_temperature_runs_and_drains(tiny_model):
+    """Rejection-sampling speculative decode (temperature > 0) produces
+    full-length output and balanced teardown; and the SAME seed gives
+    the same stream twice (host sampling is deterministic)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(19)
+    p = rng.integers(1, cfg.vocab_size, (12,)).astype(np.int32)
+    dcfg, dparams = self_draft_params(cfg, params, 1)
+    outs = []
+    for _ in range(2):
+        eng = _unified(cfg, params, draft_params=dparams, draft_cfg=dcfg,
+                       speculative_k=2)
+        eng.add_request(p, max_new_tokens=10, temperature=0.9, seed=7)
+        outs.append(eng.run()[0].tokens)
+        eng.shutdown()
+    assert len(outs[0]) == 10
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_unified_guard_rails(tiny_model):
+    """Config invariants: spec/prefix-cache/temperature need the unified
+    engine; speculative_k needs draft params; draft depth is bounded."""
+    cfg, model, params = tiny_model
+    with pytest.raises(ValueError, match="unified"):
+        _engine(cfg, params, enable_prefix_cache=True)
+    with pytest.raises(ValueError, match="unified"):
+        _engine(cfg, params, draft_params=params, speculative_k=2)
+    with pytest.raises(ValueError, match="draft_params"):
+        _unified(cfg, params, speculative_k=2)
+    with pytest.raises(ValueError, match="speculative_k"):
+        _unified(cfg, params, draft_params=params)  # a draft that never proposes
+    eng = _engine(cfg, params)
+    with pytest.raises(ValueError, match="temperature"):
+        eng.add_request(np.arange(1, 5, dtype=np.int32),
+                        max_new_tokens=4, temperature=0.5)
+    with pytest.raises(ValueError):
+        self_draft_params(cfg, params, cfg.num_hidden_layers + 1)
+
+
+def test_unified_int8_weights(tiny_model):
+    """Weight-only int8 params ride the unified plane (dequant at the
+    consumer dots, same scheduler): the run drains and mostly agrees
+    with the fp engine (int8 may flip rare near-ties)."""
+    from paddle_tpu.models.generation import quantize_params_int8
+
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(20)
+    p = rng.integers(1, cfg.vocab_size, (9,)).astype(np.int32)
+    fp = _unified(cfg, params)
+    fp.add_request(p, max_new_tokens=8)
+    want = fp.run()[0].tokens
+    fp.shutdown()
+    q8 = quantize_params_int8(params)
+    eng = _unified(cfg, q8)
+    eng.add_request(p, max_new_tokens=8)
+    got = eng.run()[0].tokens
+    eng.shutdown()
+    assert len(got) == 8
+    assert (np.asarray(want) == np.asarray(got)).mean() > 0.5
+
+
+def test_unified_teardown_catches_leaks(tiny_model):
+    """A seeded COW bug — an extra allocator reference that is never
+    released — fails the teardown leak check loudly."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(21)
+    eng = _unified(cfg, params)
+    eng.add_request(rng.integers(1, cfg.vocab_size, (5,))
+                    .astype(np.int32), max_new_tokens=4)
+    eng.run()
+    leaked = eng.alloc.alloc()          # simulated lost reference
+    assert leaked is not None
+    with pytest.raises(AssertionError, match="leak"):
+        eng.shutdown()

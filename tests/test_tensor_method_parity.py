@@ -98,6 +98,24 @@ _ROUND10_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND10_TRANCHE
 
+# names added by the round-11 tranche (inverse-trig/hyperbolic +
+# special-function method forms with their in-place partners, and the
+# comparison/logical in-place family) — appended into _REQUIRED_METHODS
+# AND counted against the ~30 floor by test_method_count_tranche_round11
+_ROUND11_TRANCHE = [
+    "asinh", "acosh", "atanh", "i0e", "i1", "i1e", "gammaln",
+    "gammainc", "gammaincc", "multigammaln", "swapaxes", "frexp",
+    "asin_", "acos_", "atan_", "sinh_", "cosh_", "asinh_", "acosh_",
+    "atanh_", "log1p_", "erfinv_", "logit_", "i0_", "hypot_",
+    "nan_to_num_", "gcd_", "lcm_", "ldexp_", "copysign_", "equal_",
+    "not_equal_", "greater_than_", "less_than_", "greater_equal_",
+    "less_equal_", "logical_and_", "logical_or_", "logical_xor_",
+    "bitwise_and_", "bitwise_or_", "bitwise_xor_",
+    "bitwise_left_shift_", "bitwise_right_shift_", "gammaln_",
+    "gammainc_", "gammaincc_", "multigammaln_",
+]
+_REQUIRED_METHODS += _ROUND11_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -277,6 +295,50 @@ def test_round10_inplace_method_values():
     r = x.index_add_(idx, 0, src)
     assert r is x
     np.testing.assert_allclose(np.asarray(x._value), [1.0, 0.0, 5.0])
+
+
+def test_method_count_tranche_round11():
+    """The round-11 tranche satisfies the ~30-new-names floor (ISSUE 6
+    satellite: inverse-trig/hyperbolic + special-function families and
+    the comparison/logical in-place forms) over the round-10 surface."""
+    wired = [n for n in _ROUND11_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 30, (len(wired),
+                              sorted(set(_ROUND11_TRANCHE) - set(wired)))
+
+
+def test_round11_special_method_values():
+    t = paddle.to_tensor(np.array([0.5, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(t.asinh()._value),
+                               np.arcsinh([0.5, 2.0]), rtol=1e-6)
+    h = paddle.to_tensor(np.array([1.5, 3.0], np.float32))
+    np.testing.assert_allclose(np.asarray(h.acosh()._value),
+                               np.arccosh([1.5, 3.0]), rtol=1e-6)
+    import scipy.special as sp
+    g = paddle.to_tensor(np.array([2.5, 4.0], np.float32))
+    np.testing.assert_allclose(np.asarray(g.gammaln()._value),
+                               sp.gammaln([2.5, 4.0]), rtol=1e-5)
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert np.asarray(m.swapaxes(0, 1)._value).shape == (3, 2)
+
+
+def test_round11_inplace_method_values():
+    a = paddle.to_tensor(np.array([0.25, 0.5], np.float32))
+    r = a.asin_()
+    assert r is a
+    np.testing.assert_allclose(np.asarray(a._value),
+                               np.arcsin([0.25, 0.5]), rtol=1e-6)
+    b = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    c = paddle.to_tensor(np.array([2.0, 2.0], np.float32))
+    r = b.less_than_(c)
+    assert r is b
+    # comparison in-place: result written back into b's buffer with
+    # its dtype preserved (reference keeps the input dtype)
+    np.testing.assert_allclose(np.asarray(b._value), [1.0, 0.0])
+    x = paddle.to_tensor(np.array([3, 10], np.int32))
+    y = paddle.to_tensor(np.array([6, 4], np.int32))
+    r = x.gcd_(y)
+    assert r is x
+    np.testing.assert_array_equal(np.asarray(x._value), [3, 2])
 
 
 def test_round9_inplace_scan_methods():

@@ -14,6 +14,7 @@ def _fwd(net, hw=64, cin=3):
     return net(x)
 
 
+@pytest.mark.slow  # heavy breadth sweep: tier-2 (tier-1 870s budget)
 class TestVisionBreadth:
     def test_resnext_shapes_and_params(self):
         net = M.resnext50_32x4d(num_classes=10)
