@@ -13,6 +13,7 @@ tiny config keeps runtime sane (numbers then only track relative progress).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -1624,10 +1625,111 @@ def smoke():
     except Exception as e:  # noqa: BLE001
         legs["serving_trace"] = {"ok": False, "error": repr(e)}
 
+    # 13. round-12 reshard engine: A→B→A redistribution across a shrink
+    #     pair must be bit-equal with bounded per-step transients, and
+    #     the doctor's MEM001 budget must pass on the worst step
+    try:
+        legs["reshard_parity"] = _smoke_reshard_parity()
+    except Exception as e:  # noqa: BLE001
+        legs["reshard_parity"] = {"ok": False, "error": repr(e)}
+
+    # 14. round-12 elastic recovery: a fault-injected worker kill mid-run
+    #     must resume from the last complete checkpoint within the
+    #     checkpoint_every replay budget and land loss-parity with an
+    #     uninterrupted run
+    try:
+        legs["elastic_recovery"] = _smoke_elastic_recovery()
+    except Exception as e:  # noqa: BLE001
+        legs["elastic_recovery"] = {"ok": False, "error": repr(e)}
+
     return {"smoke": True,
             "backend": jax.default_backend(),
             "ok": all(leg.get("ok") for leg in legs.values()),
             **legs}
+
+
+def _smoke_reshard_parity():
+    """Round-12 reshard-engine gate: a dp×mp → shrunk dp×sharding →
+    back round trip over a small param dict must be BIT-equal, keep
+    every step's transient under the declared cap, and sweep the
+    doctor's MEM001 budget clean on the worst step."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel.reshard import (check_reshard_budget,
+                                             plan_reshard, reshard)
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return {"ok": True,
+                "skipped": f"needs 8 devices (have {len(devs)}); the "
+                           f"tier-1 suite runs this leg on the virtual "
+                           f"CPU mesh"}
+    mesh_a = Mesh(np.asarray(devs[:8], dtype=object).reshape(4, 2),
+                  ("dp", "mp"))
+    mesh_b = Mesh(np.asarray(devs[:4], dtype=object).reshape(2, 2),
+                  ("dp", "sharding"))
+    rng = np.random.default_rng(12)
+    host = {"w_big": rng.standard_normal((256, 32)).astype(np.float32),
+            "w_tp": rng.standard_normal((32, 32)).astype(np.float32),
+            "b": rng.standard_normal((32,)).astype(np.float32)}
+    specs_a = {"w_big": P("dp", None), "w_tp": P(None, "mp"), "b": P()}
+    specs_b = {"w_big": P(("dp", "sharding"), None),
+               "w_tp": P("sharding", None), "b": P()}
+    state = {k: jax.device_put(v, NamedSharding(mesh_a, specs_a[k]))
+             for k, v in host.items()}
+
+    cap = 16 << 10
+    out_b, plan_ab = reshard(state, mesh_b, specs_b,
+                             max_transient_bytes=cap)
+    back, plan_ba = reshard(out_b, mesh_a, specs_a,
+                            max_transient_bytes=cap)
+    bit_equal = all(np.array_equal(np.asarray(back[k]), host[k])
+                    and np.array_equal(np.asarray(out_b[k]), host[k])
+                    for k in host)
+    bounded = (plan_ab.max_step_transient <= cap
+               and plan_ba.max_step_transient <= cap)
+    rep = check_reshard_budget(plan_ab, state, exemptions=())
+    return {"ok": bool(bit_equal and bounded and rep.ok),
+            "bit_equal": bool(bit_equal),
+            "bounded": bool(bounded),
+            "doctor_ok": bool(rep.ok),
+            "moved_bytes": int(plan_ab.moved_bytes),
+            "max_step_transient": int(plan_ab.max_step_transient),
+            "steps": len(plan_ab.steps)}
+
+
+def _smoke_elastic_recovery():
+    """Round-12 elastic-recovery gate: kill a worker mid-run through the
+    fault-injection harness; the resilient loop must recover within the
+    checkpoint_every replay budget and reproduce the uninterrupted loss
+    trajectory exactly."""
+    import sys as _sys
+    import tempfile
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in _sys.path:
+        _sys.path.insert(0, tests_dir)
+    from fault_injection import FaultEvent, run_toy_loop
+
+    with tempfile.TemporaryDirectory() as dref, \
+            tempfile.TemporaryDirectory() as dres:
+        ref, _ = run_toy_loop(dref, 10, checkpoint_every=4)
+        res, cluster = run_toy_loop(
+            dres, 10, checkpoint_every=4,
+            faults=[FaultEvent(step=6, kind="kill")])
+    if len(res.recoveries) != 1:
+        return {"ok": False, "error": f"recoveries={res.recoveries}"}
+    rec = res.recoveries[0]
+    replay_ok = rec.steps_replayed <= 4      # checkpoint_every budget
+    parity = (set(res.losses) == set(ref.losses)
+              and all(res.losses[s] == ref.losses[s] for s in ref.losses))
+    return {"ok": bool(res.final_step == 10 and replay_ok and parity),
+            "fault": rec.fault,
+            "resume_step": rec.resume_step,
+            "steps_replayed": rec.steps_replayed,
+            "loss_parity": bool(parity)}
 
 
 def _smoke_overlap_parity():

@@ -384,6 +384,28 @@ def seeded_prefill_chunk_over_budget() -> Report:
                  options={"memory_budget": {"hbm_bytes": 1 << 20}})
 
 
+def seeded_reshard_over_budget() -> Report:
+    """MEM001 on the round-12 reshard entry: an UNBOUNDED reshard plan
+    (``max_transient_bytes=None`` — one step, whole leaves, the layout a
+    hand-rolled device_put loop degenerates to) moves a 1 MB replicated
+    leaf through a redistribution entry whose declared transient budget
+    is 64 KB — the overrun the size-capped planner exists to prevent,
+    and the budget pin that keeps it honest when someone bypasses the
+    cap."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.reshard import check_reshard_budget, plan_reshard
+
+    mesh = _mesh(1)
+    tree = {"w": jax.device_put(jnp.ones((512, 512), jnp.float32),
+                                NamedSharding(mesh, P()))}
+    plan = plan_reshard(tree, mesh, {"w": P("x", None)},
+                        max_transient_bytes=None)
+    return check_reshard_budget(plan, tree, budget_bytes=64 << 10,
+                                exemptions=(),
+                                target="seeded:MEM001[reshard_plan]")
+
+
 def seeded_while_peeling() -> Report:
     """HLO003 over a captured-HLO sample: a scanned body's all-gather
     duplicated TWICE into the hosting computation (XLA's peel+unroll
@@ -441,5 +463,8 @@ SEEDED = {
     # keys carry a [variant] suffix; consumers expect the BARE code
     # before the bracket
     "MEM001[prefill_chunk]": seeded_prefill_chunk_over_budget,
+    # a third on the round-12 reshard entry: an unbounded redistribution
+    # plan overruns its declared transient budget
+    "MEM001[reshard_plan]": seeded_reshard_over_budget,
     "MEM002": seeded_host_round_trip,
 }

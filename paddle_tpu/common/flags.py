@@ -164,6 +164,13 @@ define_flag("FLAGS_host_trace_level", 1, "Host profiler verbosity level.")
 define_flag("FLAGS_enable_async_trace", False, "Enable async dispatch tracing.")
 define_flag("FLAGS_tensor_operants_mode", "eager", "eager|static tensor operants mode.")
 define_flag("FLAGS_comm_timeout_s", 1800, "Collective timeout (watchdog) in seconds.")
+define_flag("FLAGS_store_barrier_timeout_s", 0.0,
+            "Override for every TCPStore connect/barrier timeout (round-12 "
+            "elastic satellite): 0 keeps each call site's default; set "
+            "e.g. FLAGS_store_barrier_timeout_s=300 in the env to stretch "
+            "the gang-rendezvous windows on throttled-CPU containers. "
+            "Waits retry in slices with jittered exponential backoff "
+            "(wired: distributed/store.py resolve_store_timeout).")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "Allocator strategy name (compat).")
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "Compat only; XLA manages HBM.")
 define_flag("FLAGS_log_memory_stats", False, "Log live/peak memory stats per step.")

@@ -22,6 +22,9 @@ from .topology import (AxisGroup, CommunicateTopology, HybridCommunicateGroup,
                        set_hybrid_communicate_group)
 from . import functional
 from .functional import ReduceOp
+from .resilience import (LocalCluster, Preemption, ResilienceConfig,
+                         ResilienceExhausted, StepHang, WorkerLost,
+                         resilient_train_loop)
 from .collective import (Group, all_gather, all_reduce, alltoall, barrier,
                          broadcast, destroy_process_group, get_group,
                          is_initialized, new_group, reduce_scatter, scatter,
@@ -30,7 +33,8 @@ from . import auto_parallel
 from . import fleet
 from . import checkpoint
 from . import ps
-from .checkpoint import load_state_dict, save_state_dict
+from .checkpoint import (CheckpointCorruptError, CheckpointManager,
+                         load_state_dict, save_state_dict)
 from .spawn import spawn
 from .auto_parallel import (DistModel, ShardingStage1, ShardingStage2,
                             moe_global_mesh_tensor, moe_sub_mesh_tensors,

@@ -1,2 +1,4 @@
-from .save_state_dict import save_state_dict
-from .load_state_dict import load_state_dict
+from .save_state_dict import save_state_dict, wait_save
+from .load_state_dict import (CheckpointCorruptError, load_state_dict,
+                              read_manifest, restore_arrays)
+from .manager import CheckpointManager

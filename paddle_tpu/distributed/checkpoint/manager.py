@@ -1,0 +1,135 @@
+"""Checkpoint retention + degrade-to-previous (round-12).
+
+``CheckpointManager`` owns a directory of per-step checkpoints
+(``step_00000042/``), each written atomically by ``save_state_dict``
+(manifest last = commit record).  Restore walks newest→oldest, verifies
+each candidate against its manifest, and DEGRADES to the previous
+complete checkpoint on any corruption — a preempted or bit-rotted save
+costs replayed steps, never the job.
+
+Cross-topology restore is first-class: ``restore_latest`` takes the
+DESTINATION mesh + per-leaf PartitionSpecs and routes the verified host
+values through the portable reshard planner (parallel/reshard.py), so a
+checkpoint written on mesh A restores onto mesh B in size-capped
+steps.  This is the persistence half of the elastic training driver
+(distributed/resilience.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from .load_state_dict import (CheckpointCorruptError, read_manifest,
+                              restore_arrays)
+from .save_state_dict import save_state_dict, wait_save
+
+logger = logging.getLogger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+
+class CheckpointManager:
+    """Per-step checkpoint dirs with retention and verified restore."""
+
+    def __init__(self, root: str, keep: int = 2):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.root = os.path.abspath(root)
+        self.keep = keep
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- bookkeeping -------------------------------------------------------
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{step:08d}")
+
+    def complete_steps(self) -> List[int]:
+        """Steps with a committed (manifest-bearing) checkpoint,
+        ascending.  Directories without a manifest are torn writes."""
+        out = []
+        for name in os.listdir(self.root):
+            m = _STEP_RE.match(name)
+            if not m:
+                continue
+            try:
+                if read_manifest(os.path.join(self.root, name)) is not None:
+                    out.append(int(m.group(1)))
+            except CheckpointCorruptError:
+                continue            # unreadable manifest = incomplete
+        return sorted(out)
+
+    def latest_complete(self) -> Optional[int]:
+        steps = self.complete_steps()
+        return steps[-1] if steps else None
+
+    # -- write -------------------------------------------------------------
+    def save(self, state: Dict[str, Any], step: int,
+             async_save: bool = False) -> str:
+        """Checkpoint ``state`` as ``step``; prunes beyond the retention
+        window but ALWAYS leaves at least ``keep`` complete checkpoints
+        (the degrade target must survive its successor's save)."""
+        path = self.step_path(step)
+        save_state_dict(state, path, async_save=async_save)
+        if not async_save:
+            self.prune()
+        return path
+
+    def prune(self) -> None:
+        steps = self.complete_steps()
+        for s in steps[:-self.keep]:
+            shutil.rmtree(self.step_path(s), ignore_errors=True)
+        # torn temp dirs from preempted writers are dead weight
+        for name in os.listdir(self.root):
+            m = _STEP_RE.match(name)
+            if m and int(m.group(1)) not in steps \
+                    and int(m.group(1)) < (steps[-1] if steps else 0):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    def drain(self) -> None:
+        """Join any in-flight async save (the 'drain' stage of fault
+        recovery), then prune."""
+        wait_save()
+        self.prune()
+
+    # -- read --------------------------------------------------------------
+    _UNSET = object()   # "caller said nothing" ≠ None (None = unbounded)
+
+    def restore_latest(self, dst_mesh=None, dst_specs=None, *,
+                       max_transient_bytes=_UNSET,
+                       verify: bool = True
+                       ) -> Tuple[Optional[Dict[str, Any]], int, List[int]]:
+        """(state, step, degraded): the newest checkpoint that restores
+        AND verifies, resharded onto ``dst_mesh``/``dst_specs`` when
+        given (host values otherwise).  ``max_transient_bytes`` follows
+        the planner's convention exactly — omitted → the planner's
+        default cap, an int → that cap, None → unbounded — so one
+        config value means the same thing on every recovery path.
+        ``degraded`` lists the corrupt steps that were skipped on the
+        way down; (None, 0, degraded) when nothing restorable remains."""
+        from ...parallel.reshard import plan_reshard
+
+        degraded: List[int] = []
+        for step in reversed(self.complete_steps()):
+            try:
+                values = restore_arrays(self.step_path(step), verify=verify)
+            except CheckpointCorruptError as e:
+                logger.warning(
+                    "[checkpoint] step %d is corrupt (%s); degrading to "
+                    "the previous complete checkpoint", step, e)
+                degraded.append(step)
+                continue
+            if dst_mesh is not None:
+                kw = {}
+                if max_transient_bytes is not self._UNSET:
+                    kw["max_transient_bytes"] = max_transient_bytes
+                values = plan_reshard(values, dst_mesh, dst_specs,
+                                      **kw).execute(values)
+            return values, step, degraded
+        return None, 0, degraded

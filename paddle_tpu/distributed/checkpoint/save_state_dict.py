@@ -10,18 +10,36 @@ equivalent: Orbax-style sharded async checkpoint") — it writes per-shard
 tensorstore arrays with the sharding recorded, dedupes replicas across
 hosts, and supports async commit.  This wrapper adapts the reference API
 (state dicts of paddle Tensors, directory path) onto it.
+
+Round-12 (elastic resilience): every save is ATOMIC — the orbax tree is
+written to a temp dir and renamed into place, then ``manifest.json``
+(itself written temp+fsync+rename) commits the checkpoint.  The manifest
+carries per-leaf crc32 checksums plus the SOURCE sharding spec (mesh
+axis names/shape and per-leaf PartitionSpec), which is what lets a
+checkpoint written on an N-host dp×sharding×tp mesh restore onto a
+different mesh shape through the reshard planner
+(parallel/reshard.py) — and what lets the loader detect corruption and
+degrade to the previous complete checkpoint instead of crashing.
+A directory without a manifest is, by definition, incomplete.
 """
 
 from __future__ import annotations
 
 import atexit
+import json
 import os
+import shutil
 import threading
+import zlib
 from typing import Any, Dict, Optional
 
+import numpy as np
 import jax
 
 from ...core.tensor import Tensor
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_FORMAT = 1
 
 # one condition variable guards the in-flight table; writers to a path wait
 # until no save for that path is in flight, then claim the slot.  Entries
@@ -46,6 +64,82 @@ def _to_arrays(state_dict: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# manifest: checksums + source sharding spec
+# ---------------------------------------------------------------------------
+
+
+def _spec_to_json(sharding) -> Optional[Dict[str, Any]]:
+    """Serialize a NamedSharding as {mesh:{axis_names,shape}, spec:[...]}
+    (spec entries: None | axis | [axes]); None for unsharded values."""
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    if mesh is None or spec is None:
+        return None
+    entries = []
+    for e in tuple(spec):
+        if e is None:
+            entries.append(None)
+        elif isinstance(e, tuple):
+            entries.append(list(e))
+        else:
+            entries.append(str(e))
+    return {"mesh": {"axis_names": [str(a) for a in mesh.axis_names],
+                     "shape": [int(mesh.shape[a]) for a in mesh.axis_names]},
+            "spec": entries}
+
+
+def leaf_checksum(value) -> int:
+    """crc32 over the leaf's host bytes (shape/dtype are recorded
+    separately, so a crc match + shape/dtype match pins the value)."""
+    arr = np.ascontiguousarray(np.asarray(value))
+    return zlib.crc32(arr.tobytes()) & 0xFFFFFFFF
+
+
+def _manifest_leaves(tree: Dict[str, Any], prefix: str = "") -> list:
+    out = []
+    for k, v in tree.items():
+        path = prefix + str(k)
+        if isinstance(v, dict):
+            out.extend(_manifest_leaves(v, path + "."))
+            continue
+        entry: Dict[str, Any] = {"path": path}
+        if hasattr(v, "dtype") or isinstance(v, (int, float)):
+            entry.update(shape=[int(s) for s in np.shape(v)],
+                         dtype=str(getattr(v, "dtype",
+                                           np.asarray(v).dtype)))
+            # checksums need the host bytes: only possible (and only
+            # cheap) when every shard is addressable from this process.
+            # A multi-host array records shape/dtype/spec but no crc —
+            # the loader's verify skips crc-less entries instead of a
+            # save-path RuntimeError on the non-addressable gather
+            if getattr(v, "is_fully_addressable", True):
+                entry["crc32"] = leaf_checksum(v)
+            sharding = getattr(v, "sharding", None)
+            src = _spec_to_json(sharding) if sharding is not None else None
+            if src is not None:
+                entry["src"] = src
+        else:
+            entry["opaque"] = True       # non-numeric leaf: no checksum
+        out.append(entry)
+    return out
+
+
+def build_manifest(tree: Dict[str, Any]) -> Dict[str, Any]:
+    return {"format": MANIFEST_FORMAT,
+            "device_count": jax.device_count(),
+            "leaves": _manifest_leaves(tree)}
+
+
+def write_manifest(path: str, manifest: Dict[str, Any]) -> None:
+    """temp + fsync + rename: the manifest is the checkpoint's commit
+    record, so it must never exist half-written."""
+    from ...framework.io import atomic_write
+
+    with atomic_write(os.path.join(path, MANIFEST_NAME)) as f:
+        f.write(json.dumps(manifest, indent=1).encode())
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False) -> None:
@@ -57,6 +151,10 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     saves to the SAME path are serialized: a new save (sync or async)
     first joins any in-flight async save of that path, so two writers
     never race on one Orbax directory.
+
+    The write is atomic (temp dir + rename, manifest last): a reader
+    either sees the previous complete checkpoint or the new one, never
+    a torn state — a preempted writer leaves only a stale temp dir.
     """
     import orbax.checkpoint as ocp
 
@@ -67,7 +165,30 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     ckptr = ocp.PyTreeCheckpointer()
 
     def _do():
-        ckptr.save(os.path.join(path, "state"), tree, force=True)
+        # checksums + source shardings captured on the write thread,
+        # BEFORE the rename commits anything
+        manifest = build_manifest(tree)
+        final = os.path.join(path, "state")
+        tmp = os.path.join(path, f".state.tmp.{os.getpid()}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        try:
+            ckptr.save(tmp, tree, force=True)
+            if os.path.exists(final):
+                # overwrite (per-step dirs make this the exception):
+                # DECOMMIT first — remove the manifest BEFORE touching
+                # the old tree, so a crash mid-swap leaves the dir
+                # visibly incomplete (no manifest → readers degrade to
+                # the previous step), never complete-but-corrupt
+                mpath = os.path.join(path, MANIFEST_NAME)
+                if os.path.exists(mpath):
+                    os.remove(mpath)
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+            write_manifest(path, manifest)
+        finally:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp, ignore_errors=True)
 
     with _cv:
         while path in _inflight:

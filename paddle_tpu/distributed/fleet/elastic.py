@@ -66,6 +66,15 @@ class ElasticManager:
     def elastic_enabled(self) -> bool:
         return self.max_nodes > self.min_nodes or self.max_restart > 0
 
+    def register_failure(self) -> bool:
+        """In-process fault bookkeeping (round-12 resilience driver:
+        faults arrive as exceptions, not exit codes): one fault consumes
+        one gang restart; False when the budget is exhausted."""
+        if self.restart_count >= self.max_restart:
+            return False
+        self.restart_count += 1
+        return True
+
     def decide(self, exit_codes: Sequence[Optional[int]]) -> ElasticStatus:
         """Decide from a poll of worker exit codes (None = still running)."""
         if any(c is not None and c != 0 for c in exit_codes):

@@ -1,7 +1,13 @@
 """paddle.distributed.io (reference python/paddle/distributed/io.py):
 persistable-variable save/load for distributed programs — here the
 sharded checkpoint API IS the implementation (checkpoint/save_state_dict
-reshard-on-load covers the reference's use cases)."""
+reshard-on-load covers the reference's use cases).
+
+Round-12 atomicity audit: this module writes no files itself — both
+entry points delegate to checkpoint/save_state_dict (temp-dir + rename,
+manifest-committed) and framework/io.py's pickle saver (atomic_write),
+so every save path reachable from here is write-temp + fsync + rename;
+a preempted saver can no longer tear a previously-good checkpoint."""
 
 from __future__ import annotations
 
@@ -9,10 +15,11 @@ from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 
 
 def save_persistables(executor=None, dirname=None, main_program=None,
-                      filename=None, **kw):
+                      filename=None, async_save: bool = False, **kw):
     """Reference io.save_persistables: static-graph persistables dump.
     The dynamic analog: save the program's state dict (callers pass a
-    Layer or a state dict via main_program)."""
+    Layer or a state dict via main_program).  ``async_save`` dispatches
+    the (atomic) write off-thread; ``checkpoint.wait_save()`` joins."""
     state = main_program
     if hasattr(state, "state_dict"):
         state = state.state_dict()
@@ -21,7 +28,7 @@ def save_persistables(executor=None, dirname=None, main_program=None,
             "save_persistables: pass a Layer or state dict as "
             "main_program (static Programs are replaced by jit.to_static "
             "— SURVEY.md §3.4)")
-    save_state_dict(state, dirname)
+    save_state_dict(state, dirname, async_save=async_save)
 
 
 def load_persistables(executor=None, dirname=None, main_program=None,

@@ -230,6 +230,10 @@ def launch(args=None) -> int:
         this_host = os.environ.get("PADDLE_NODE_IP", mhost)
         node_base = base_port + args.rank * nproc  # distinct on one host
         local_eps = [f"{this_host}:{node_base + i}" for i in range(nproc)]
+        # the 120s windows are defaults: FLAGS_store_barrier_timeout_s
+        # overrides both (round-12 satellite — throttled-CPU containers
+        # stretch the gang-import rendezvous via env, with jittered
+        # backoff retries inside the store instead of one long wait)
         rdv_store = TCPStore(mhost, int(mport), is_master=(args.rank == 0),
                              world_size=nnodes, timeout=120)
         rdv_store.set(f"launch/node/{args.rank}", ",".join(local_eps))

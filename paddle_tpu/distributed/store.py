@@ -16,14 +16,63 @@ from __future__ import annotations
 
 import ctypes
 import os
+import random
 import subprocess
 import threading
+import time
 from typing import List, Optional, Union
 
 _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
 _OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_DEL, _OP_NUM_KEYS = 1, 2, 3, 4, 5, 6
+
+# retry shaping for the connect/barrier paths: bounded exponential
+# backoff with jitter so a whole gang re-trying a flaky master does not
+# reconnect in lockstep (thundering herd on throttled-CPU containers)
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_MAX_S = 2.0
+_BACKOFF_JITTER = 0.25
+
+
+def resolve_store_timeout(default: float) -> float:
+    """Effective rendezvous/barrier timeout: the
+    ``FLAGS_store_barrier_timeout_s`` flag (env-settable,
+    ``FLAGS_store_barrier_timeout_s=300``) when set > 0, else the
+    caller's default — gang tests on throttled containers can stretch
+    the hard-coded windows without touching call sites, and the default
+    behavior is unchanged when the flag is unset."""
+    from ..common import flags as _flags
+
+    try:
+        override = float(_flags.get_flag("FLAGS_store_barrier_timeout_s"))
+    except KeyError:
+        return float(default)
+    return override if override > 0 else float(default)
+
+
+def jittered_backoff(attempt: int, *, base: float = _BACKOFF_BASE_S,
+                     max_s: float = _BACKOFF_MAX_S,
+                     jitter: float = _BACKOFF_JITTER,
+                     rand=None) -> float:
+    """THE backoff formula (one home): ``min(base·2^attempt, max)``
+    ±``jitter``.  Shared by the store's connect/barrier retries and the
+    resilience driver's re-rendezvous loop — tune the shape here and
+    every gang retry path moves together."""
+    raw = min(base * (2.0 ** attempt), max_s)
+    if jitter:
+        raw *= 1.0 + jitter * (2.0 * (rand or random.random)() - 1.0)
+    return max(0.0, raw)
+
+
+def _backoff_sleep(attempt: int, deadline: float) -> bool:
+    """Sleep the attempt's backoff (jittered, capped, never past the
+    deadline); False when the deadline has already passed."""
+    now = time.monotonic()
+    if now >= deadline:
+        return False
+    time.sleep(min(jittered_backoff(attempt), deadline - now))
+    return True
 
 
 def _csrc_dir() -> str:
@@ -82,6 +131,7 @@ class TCPStore:
             from ..common import flags as _flags
 
             timeout = float(_flags.get_flag("FLAGS_get_host_by_name_time"))
+        timeout = resolve_store_timeout(timeout)
         lib = _load_lib()
         self._lib = lib
         self._server = None
@@ -94,12 +144,25 @@ class TCPStore:
             port = lib.ts_server_port(self._server)
         self.host = host
         self.port = port
-        self._client = lib.ts_client_connect(
-            host.encode(), port, int(timeout * 1000))
-        if not self._client:
-            if self._server:
-                lib.ts_server_stop(self._server)
-            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+        # connect with retry: the native connect's own wait covers a
+        # slow-to-accept master, the outer backoff loop covers refused
+        # connections (master not yet LISTENING — the common case when a
+        # gang of workers races its rank-0 through module imports)
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        while True:
+            remaining = max(0.05, deadline - time.monotonic())
+            self._client = lib.ts_client_connect(
+                host.encode(), port, int(remaining * 1000))
+            if self._client:
+                break
+            attempt += 1
+            if not _backoff_sleep(attempt - 1, deadline):
+                if self._server:
+                    lib.ts_server_stop(self._server)
+                raise RuntimeError(
+                    f"TCPStore: cannot connect {host}:{port} within "
+                    f"{timeout:.1f}s ({attempt} attempts)")
 
     # -- core ops ----------------------------------------------------------
     def _req(self, op: int, key: str, val: bytes = b"",
@@ -151,11 +214,35 @@ class TCPStore:
 
     # -- composite ---------------------------------------------------------
     def barrier(self, name: str = "barrier", timeout: float = 30.0):
-        """All world_size participants rendezvous (ADD + WAIT loop)."""
+        """All world_size participants rendezvous (ADD + WAIT loop).
+
+        The effective timeout is flag-overridable
+        (``FLAGS_store_barrier_timeout_s``; see resolve_store_timeout) —
+        gang tests on throttled-CPU containers stretch the window via
+        env instead of editing every call site — and the wait itself is
+        sliced into short server-side WAITs with jittered exponential
+        backoff between slices, so one lost reply never burns the whole
+        budget and a re-rendezvousing gang doesn't hammer the master in
+        lockstep."""
+        timeout = resolve_store_timeout(timeout)
         n = self.add(f"__{name}__count", 1)
         if n >= self.world_size:
             self.set(f"__{name}__done", b"1")
-        self.wait([f"__{name}__done"], timeout=timeout)
+        key = f"__{name}__done"
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        while True:
+            slice_s = min(max(0.05, deadline - time.monotonic()),
+                          _BACKOFF_BASE_S * (2.0 ** attempt) * 20)
+            try:
+                self.wait([key], timeout=slice_s)
+                return
+            except TimeoutError:
+                attempt += 1
+                if not _backoff_sleep(attempt - 1, deadline):
+                    raise TimeoutError(
+                        f"TCPStore.barrier({name!r}) timed out after "
+                        f"{timeout:.1f}s ({attempt} wait slices)")
 
     def close(self):
         if getattr(self, "_client", None):

@@ -33,7 +33,12 @@ _SEQ_LOCK = threading.Lock()
 
 @dataclass
 class CommTask:
-    """One in-flight collective (or watched step)."""
+    """One in-flight collective (or watched step).
+
+    ``done`` and ``timed_out`` are MUTUALLY EXCLUSIVE terminal states:
+    the transition is made under the manager's lock (single writer), so
+    a completion racing the scanner can never yield a task that is both
+    finished and flagged hung (the PR-6 handler/flag race family)."""
 
     name: str
     group_desc: str = ""
@@ -114,8 +119,17 @@ class CommTaskManager:
         return task
 
     def complete(self, task: CommTask):
-        task.done = True
+        """Mark a task finished.  Terminal-state transition is decided
+        under the lock: if the scanner already flagged the task as
+        timed out, completion is a no-op (the handler/abort decision
+        stands — late results from a hung collective are suspect); a
+        completed task can likewise never be flagged afterwards because
+        the scanner only considers tasks still in the table and
+        re-checks ``done`` under the same lock."""
         with self._lock:
+            if task.timed_out:
+                return
+            task.done = True
             self._tasks.pop(task.seq, None)
 
     def _loop(self):
@@ -124,6 +138,9 @@ class CommTaskManager:
             expired = []
             with self._lock:
                 for seq, t in list(self._tasks.items()):
+                    if t.done:          # completed between scans
+                        del self._tasks[seq]
+                        continue
                     if t.timeout_s > 0 and now - t.start_time > t.timeout_s:
                         t.timed_out = True
                         expired.append(t)

@@ -4,10 +4,19 @@ Analog of python/paddle/framework/io.py:773 (save) / :1020 (load): pickles
 nested state dicts with tensors converted to numpy; reload wraps back into
 Tensors. Distributed sharded checkpointing lives in
 paddle_tpu.distributed.checkpoint.
+
+Round-12 atomicity audit: every single-host save path writes
+temp + fsync + rename (``atomic_write``), so a preemption mid-save can
+never leave a torn file where a previous good checkpoint stood — the
+failure mode the elastic resilience loop (distributed/resilience.py)
+must survive.  The distributed savers (checkpoint/save_state_dict.py,
+distributed/io.py which delegates to it) share the same helper for
+their manifests.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 from typing import Any
@@ -15,6 +24,28 @@ from typing import Any
 import numpy as np
 
 from ..core.tensor import Tensor
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, suffix: str = ".tmp"):
+    """Write-temp + fsync + rename.  Yields a binary file object for
+    ``<path><suffix>.<pid>``; on clean exit the temp is fsync'd and
+    renamed over ``path`` (atomic on POSIX), on error it is removed and
+    ``path`` is left untouched."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}{suffix}.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
 
 
 def _to_storable(obj: Any):
@@ -43,10 +74,8 @@ def _from_storable(obj: Any, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    # atomic: a crash mid-pickle must not clobber an existing good file
+    with atomic_write(path) as f:
         pickle.dump(_to_storable(obj), f, protocol=protocol)
 
 

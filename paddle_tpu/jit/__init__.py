@@ -381,7 +381,11 @@ def save(layer, path, input_spec=None, **configs):
         finally:
             if was_training and hasattr(layer, "train"):
                 layer.train()
-    with open(path + ".pdmodel", "wb") as f:
+    # atomic (round-12 audit): a preempted save must not tear an
+    # existing .pdmodel artifact
+    from ..framework.io import atomic_write
+
+    with atomic_write(path + ".pdmodel") as f:
         pickle.dump(payload, f)
 
 

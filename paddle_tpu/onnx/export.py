@@ -633,6 +633,10 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
                     b.initializers)
     blob = proto.model(g, opset_version=opset_version)
     out_path = path if path.endswith(".onnx") else path + ".onnx"
-    with open(out_path, "wb") as f:
+    # atomic (round-12 audit): export over an existing artifact must be
+    # all-or-nothing
+    from ..framework.io import atomic_write
+
+    with atomic_write(out_path) as f:
         f.write(blob)
     return out_path

@@ -14,3 +14,5 @@ from .sep import ulysses_attention
 from .pipelining import pipeline_apply
 from .overlap import OverlapConfig
 from .memory import MemoryConfig, tune_memory_config
+from .reshard import (ReshardPlan, check_reshard_budget, plan_reshard,
+                      reshard)

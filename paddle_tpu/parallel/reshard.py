@@ -1,0 +1,491 @@
+"""Portable reshard engine (round-12 tentpole).
+
+Takes a pytree sharded for mesh A and produces the SAME values sharded
+for mesh B through a planned sequence of size-capped redistribution
+steps — the memory-efficient array-redistribution discipline (PAPERS.md
+2112.01075): never materialize more transient state than a declared cap,
+no matter how large the pytree, by (a) bucketing leaves into steps with
+the overlap engine's one bucketing rule (``overlap.split_by_bytes``) and
+(b) chunking any leaf whose own transit would blow the cap along a
+shard-compatible axis.
+
+Three routes per leaf, chosen by the planner:
+
+- ``noop``   — already laid out for mesh B (or a non-array scalar);
+- ``device`` — meshes A and B address the SAME device set (a live
+  re-partitioning, e.g. dp→tp): the step is a jittable identity with
+  destination ``out_shardings`` — XLA emits the all-gather/slice/
+  all-to-all sequence, and the Graph Doctor's ``memory_budget`` pass
+  (MEM001) can price it (``check_reshard_budget``);
+- ``host``   — device sets differ (elastic shrink/grow, checkpoint
+  restore from host arrays): each chunk is gathered to host and
+  ``device_put`` into its mesh-B sharding — the bounded staging buffer
+  IS the chunk.
+
+DCN awareness rides ``distributed.topology`` slice detection: a leaf
+redistributed over a slice-spanning mesh-B axis is accounted under
+``plan.dcn_bytes`` (the slow-wire volume the BASELINE round-12 entry
+predicts against).
+
+The same primitives back cross-topology checkpoint restore
+(distributed/checkpoint) and the elastic training driver
+(distributed/resilience) — and are deliberately the ones a future
+serving-replica autoscale will reuse for weight delivery.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .overlap import split_by_bytes
+
+# default per-step transient cap: two copies (transit + destination) of
+# at most this many bytes are ever live beyond the source/destination
+# residency itself
+DEFAULT_TRANSIENT_BYTES = 64 << 20
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> (path, leaf) plumbing
+# ---------------------------------------------------------------------------
+
+
+def _key_str(k) -> str:
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def path_leaves(tree) -> Tuple[List[Tuple[str, Any]], Any]:
+    """Flatten ``tree`` to dotted-path leaves (state-dict convention:
+    ``{"a": {"b": x}}`` → ``[("a.b", x)]``) plus the treedef."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(".".join(_key_str(k) for k in kp), v) for kp, v in flat], treedef
+
+
+def _resolve_spec(specs, path: str, leaf) -> P:
+    """One destination PartitionSpec for ``path``: ``specs`` is a dict of
+    dotted paths (missing → replicated), a callable ``(path, leaf) → P``,
+    a single P applied to every leaf, or None (replicate everything)."""
+    if specs is None:
+        return P()
+    if isinstance(specs, P):
+        return specs
+    if isinstance(specs, dict):
+        got = specs.get(path)
+        return got if got is not None else P()
+    if callable(specs):
+        got = specs(path, leaf)
+        return got if got is not None else P()
+    raise TypeError(f"dst_specs must be dict/callable/PartitionSpec/None, "
+                    f"got {type(specs)}")
+
+
+def _axis_product(entry, mesh: Mesh) -> int:
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    return int(np.prod([mesh.shape[a] for a in axes]))
+
+
+def fit_spec(spec: P, mesh: Mesh, shape: Sequence[int]) -> P:
+    """Drop spec entries whose axes are absent/trivial on ``mesh`` or do
+    not divide the dim (the apply_llama_sharding fallback rule): a spec
+    written for mesh A must degrade to a VALID mesh-B placement, never
+    an error — replication is always correct."""
+    names = set(mesh.axis_names)
+    entries = list(tuple(spec))[:len(shape)]
+    entries += [None] * (len(shape) - len(entries))
+    out: List[Any] = []
+    for i, e in enumerate(entries):
+        if e is None:
+            out.append(None)
+            continue
+        axes = e if isinstance(e, tuple) else (e,)
+        kept = tuple(a for a in axes if a in names and mesh.shape[a] > 1)
+        if not kept or shape[i] % _axis_product(kept, mesh) != 0:
+            out.append(None)
+            continue
+        out.append(kept if len(kept) > 1 else kept[0])
+    return P(*out)
+
+
+# ---------------------------------------------------------------------------
+# plan data model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LeafPlan:
+    """Redistribution recipe for ONE leaf."""
+
+    path: str
+    shape: Tuple[int, ...]
+    dtype: Any
+    dst_spec: P
+    route: str                       # "noop" | "device" | "host"
+    chunk_axis: Optional[int]        # None = whole-leaf move
+    chunks: List[Tuple[int, int]]    # [start, stop) spans on chunk_axis
+    nbytes: int
+    transient_bytes: int             # peak transit for this leaf's worst chunk
+    dcn: bool = False                # crosses a slice-spanning dst axis
+
+    @property
+    def moved(self) -> bool:
+        return self.route != "noop"
+
+
+@dataclass
+class ReshardStep:
+    """One bounded step: the leaves moved together; their summed worst-
+    chunk transit is the step's transient footprint."""
+
+    leaves: List[LeafPlan]
+    transient_bytes: int
+
+
+class ReshardPlan:
+    """The full planned redistribution; ``execute`` applies it."""
+
+    def __init__(self, dst_mesh: Mesh, steps: List[ReshardStep],
+                 leaf_plans: List[LeafPlan],
+                 transient_budget: Optional[int]):
+        self.dst_mesh = dst_mesh
+        self.steps = steps
+        self.leaf_plans = leaf_plans
+        self.transient_budget = transient_budget
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def total_bytes(self) -> int:
+        return sum(lp.nbytes for lp in self.leaf_plans)
+
+    @property
+    def moved_bytes(self) -> int:
+        return sum(lp.nbytes for lp in self.leaf_plans if lp.moved)
+
+    @property
+    def dcn_bytes(self) -> int:
+        return sum(lp.nbytes for lp in self.leaf_plans if lp.dcn)
+
+    @property
+    def max_step_transient(self) -> int:
+        return max((s.transient_bytes for s in self.steps), default=0)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "leaves": len(self.leaf_plans),
+            "moved": sum(1 for lp in self.leaf_plans if lp.moved),
+            "steps": len(self.steps),
+            "total_bytes": self.total_bytes,
+            "moved_bytes": self.moved_bytes,
+            "dcn_bytes": self.dcn_bytes,
+            "max_step_transient": self.max_step_transient,
+            "transient_budget": self.transient_budget,
+            "dst_mesh": {"axis_names": list(self.dst_mesh.axis_names),
+                         "shape": [int(self.dst_mesh.shape[a])
+                                   for a in self.dst_mesh.axis_names]},
+        }
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, tree):
+        """Apply the plan to ``tree`` (same structure/shapes it was
+        planned for) → the same VALUES sharded for the destination mesh.
+        Pure data movement: bit-equal by construction."""
+        flat, treedef = path_leaves(tree)
+        by_path = {lp.path: lp for lp in self.leaf_plans}
+        out = []
+        for path, val in flat:
+            lp = by_path.get(path)
+            if lp is None:
+                raise KeyError(f"leaf {path!r} was not in the planned tree")
+            out.append(_execute_leaf(lp, val, self.dst_mesh))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def __repr__(self):
+        s = self.summary()
+        return (f"ReshardPlan(leaves={s['leaves']}, moved={s['moved']}, "
+                f"steps={s['steps']}, moved_bytes={s['moved_bytes']}, "
+                f"max_step_transient={s['max_step_transient']})")
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+
+def _leaf_sharding(val):
+    if isinstance(val, jax.Array):
+        return getattr(val, "sharding", None)
+    return None
+
+
+def _spec_entry(spec: P, i: int):
+    t = tuple(spec)
+    return t[i] if i < len(t) else None
+
+
+def _choose_chunk_axis(shape: Sequence[int], dst_spec: P, mesh: Mesh,
+                       want: int) -> Optional[Tuple[int, int]]:
+    """(axis, unit) to chunk along, or None when unchunkable.  ``unit``
+    is the granule chunk boundaries must respect so every chunk stays
+    divisible by the destination sharding on that axis (1 for unsharded
+    axes).  Preference order: an axis with at least ``want`` granules
+    (can actually honor the cap), destination-unsharded over sharded
+    (chunks need no granule alignment), then the most granules."""
+    best = None
+    for i, n in enumerate(shape):
+        e = _spec_entry(dst_spec, i)
+        unit = 1 if e is None else _axis_product(e, mesh)
+        granules = n // unit
+        if granules <= 1:
+            continue
+        key = (granules >= want, e is None, granules)
+        if best is None or key > best[0]:
+            best = (key, i, unit)
+    return (best[1], best[2]) if best else None
+
+
+def _chunk_spans(n: int, unit: int, want: int) -> List[Tuple[int, int]]:
+    """Split [0, n) into ≤``want`` spans with boundaries at multiples of
+    ``unit`` (even-ish via array_split over granules)."""
+    granules = n // unit
+    k = max(1, min(want, granules))
+    sizes = [len(part) for part in np.array_split(np.arange(granules), k)]
+    spans, start = [], 0
+    for s in sizes:
+        stop = start + s * unit
+        spans.append((start, stop))
+        start = stop
+    spans[-1] = (spans[-1][0], n)      # absorb any non-granular tail
+    return spans
+
+
+def plan_reshard(tree, dst_mesh: Mesh, dst_specs=None, *,
+                 max_transient_bytes: Optional[int] = DEFAULT_TRANSIENT_BYTES,
+                 slice_map: Optional[Dict[str, Sequence[int]]] = None
+                 ) -> ReshardPlan:
+    """Plan the redistribution of ``tree`` onto ``dst_mesh`` laid out per
+    ``dst_specs`` (see ``_resolve_spec`` for accepted forms; specs are
+    ``fit_spec``-degraded so a mesh-A plan never errors on mesh B).
+
+    ``max_transient_bytes`` caps each step's transit footprint (2 copies
+    of the data in flight: the gathered/staged chunk + its resharded
+    destination).  ``None`` disables bounding — one step, whole leaves —
+    which is exactly the shape the seeded MEM001[reshard_plan] doctor
+    fixture proves catchable.  ``slice_map`` (axis → slice index per
+    position) feeds the topology slice detector for DCN accounting on
+    hosts that expose no slice topology (tests, CPU dryruns).
+    """
+    from ..distributed import topology as topo
+
+    dst_ids = topo.mesh_device_ids(dst_mesh)
+    slice_map = slice_map or {}
+    dcn_axes = {a for a in dst_mesh.axis_names
+                if topo.mesh_spans_slices(dst_mesh, a, slice_map.get(a))}
+
+    flat, _ = path_leaves(tree)
+    cap = max_transient_bytes
+    leaf_plans: List[LeafPlan] = []
+    for path, val in flat:
+        if not isinstance(val, (jax.Array, np.ndarray)):
+            # python scalars / opaque leaves ride along untouched
+            leaf_plans.append(LeafPlan(
+                path=path, shape=(), dtype=None, dst_spec=P(),
+                route="noop", chunk_axis=None, chunks=[(0, 0)], nbytes=0,
+                transient_bytes=0))
+            continue
+        arr = val
+        shape = tuple(int(s) for s in arr.shape)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * arr.dtype.itemsize \
+            if shape else arr.dtype.itemsize
+        spec = fit_spec(_resolve_spec(dst_specs, path, arr), dst_mesh, shape)
+        dst_sharding = NamedSharding(dst_mesh, spec)
+
+        src_sharding = _leaf_sharding(val)
+        if src_sharding is not None:
+            try:
+                same = src_sharding.is_equivalent_to(dst_sharding, len(shape))
+            except Exception:  # noqa: BLE001 — cross-backend conservative
+                same = src_sharding == dst_sharding
+            if same:
+                leaf_plans.append(LeafPlan(
+                    path=path, shape=shape, dtype=arr.dtype, dst_spec=spec,
+                    route="noop", chunk_axis=None, chunks=[(0, 0)],
+                    nbytes=nbytes, transient_bytes=0))
+                continue
+            src_ids = frozenset(d.id for d in src_sharding.device_set)
+            route = "device" if src_ids == dst_ids else "host"
+        else:
+            route = "host"              # host arrays stage straight in
+
+        chunk_axis, chunks = None, [(0, shape[0] if shape else 1)]
+        transit = 2 * nbytes
+        if cap is not None and transit > cap and shape:
+            want = math.ceil(transit / cap)
+            picked = _choose_chunk_axis(shape, spec, dst_mesh, want)
+            if picked is not None:
+                chunk_axis, unit = picked
+                chunks = _chunk_spans(shape[chunk_axis], unit, want)
+                row = nbytes // shape[chunk_axis]
+                transit = 2 * max((b - a) for a, b in chunks) * row
+            # unchunkable leaf: plan proceeds, its step carries the
+            # overrun — check_reshard_budget is how it gets caught
+        dcn = bool(dcn_axes) and any(
+            (set(e if isinstance(e, tuple) else (e,)) & dcn_axes)
+            for e in tuple(spec) if e is not None)
+        leaf_plans.append(LeafPlan(
+            path=path, shape=shape, dtype=arr.dtype, dst_spec=spec,
+            route=route, chunk_axis=chunk_axis, chunks=chunks,
+            nbytes=nbytes, transient_bytes=transit, dcn=dcn))
+
+    # bucket moved leaves into steps with the overlap engine's single
+    # bucketing rule: the cap splits, never reorders; an over-cap leaf
+    # gets its own step
+    moved = [lp for lp in leaf_plans if lp.moved]
+    by_path = {lp.path: lp for lp in moved}
+    if cap is None:
+        groups = [[lp.path for lp in moved]] if moved else []
+    else:
+        groups = split_by_bytes([lp.path for lp in moved],
+                                lambda p: by_path[p].transient_bytes, cap)
+    steps = [ReshardStep(
+        leaves=[by_path[p] for p in g],
+        transient_bytes=sum(by_path[p].transient_bytes for p in g))
+        for g in groups]
+    return ReshardPlan(dst_mesh, steps, leaf_plans, cap)
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+
+def _slice_on(val, axis: int, a: int, b: int):
+    idx = tuple(slice(a, b) if i == axis else slice(None)
+                for i in range(np.ndim(val)))
+    return val[idx]
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
+def _chunk_update(dst, piece, axis, start):
+    """Write one staged chunk into the destination IN PLACE (donated):
+    XLA aliases the output onto ``dst``'s buffer, so streaming N chunks
+    keeps exactly one destination copy + one chunk live — the bounded-
+    transient property the plan accounts for."""
+    starts = [jnp.int32(0)] * dst.ndim
+    starts[axis] = jnp.int32(start)
+    return jax.lax.dynamic_update_slice(dst, piece.astype(dst.dtype),
+                                        tuple(starts))
+
+
+def _execute_leaf(lp: LeafPlan, val, dst_mesh: Mesh):
+    if not lp.moved:
+        return val
+    sh = NamedSharding(dst_mesh, lp.dst_spec)
+    if lp.chunk_axis is None:
+        src = np.asarray(val) if lp.route == "host" else val
+        return jax.device_put(src, sh)
+    # streamed chunk loop: destination residency + ONE chunk in flight
+    # (staging buffer + its placed copy = the 2×chunk the plan prices);
+    # never the all-chunks-then-concatenate shape, whose transient would
+    # be ~2× the LEAF no matter the cap.  The destination is allocated
+    # SHARDED from birth (jit out_shardings) — an eager jnp.zeros would
+    # materialize the whole leaf on the default device first, the exact
+    # overrun the chunking exists to avoid
+    dst = jax.jit(functools.partial(jnp.zeros, lp.shape, lp.dtype),
+                  out_shardings=sh)()
+    for a, b in lp.chunks:
+        piece = _slice_on(val, lp.chunk_axis, a, b)
+        if lp.route == "host":
+            piece = np.asarray(piece)     # the bounded staging buffer
+        piece = jax.device_put(piece, sh)
+        dst = _chunk_update(dst, piece, lp.chunk_axis, a)
+    return dst
+
+
+def reshard(tree, dst_mesh: Mesh, dst_specs=None, *,
+            max_transient_bytes: Optional[int] = DEFAULT_TRANSIENT_BYTES,
+            slice_map: Optional[Dict[str, Sequence[int]]] = None):
+    """plan + execute in one call; returns (new_tree, plan)."""
+    plan = plan_reshard(tree, dst_mesh, dst_specs,
+                        max_transient_bytes=max_transient_bytes,
+                        slice_map=slice_map)
+    return plan.execute(tree), plan
+
+
+# ---------------------------------------------------------------------------
+# Graph Doctor entry: price a plan step's transient residency
+# ---------------------------------------------------------------------------
+
+
+def reshard_step_entry(plan: ReshardPlan, step: ReshardStep, tree):
+    """(fn, args) for the doctor: a jitted identity whose outputs carry
+    the destination shardings of every moved leaf's FIRST chunk — the
+    compiled program is the redistribution XLA would run for that step,
+    and its ``memory_analysis`` peak is the step's transient footprint.
+    Returns None when the step moves nothing."""
+    flat, _ = path_leaves(tree)
+    values = dict(flat)
+    args, shardings = [], []
+    for lp in step.leaves:
+        if not lp.moved:
+            continue
+        val = values[lp.path]
+        if lp.chunk_axis is not None:
+            a, b = lp.chunks[0]
+            val = _slice_on(val, lp.chunk_axis, a, b)
+        if lp.route == "host" or not isinstance(val, jax.Array):
+            val = np.asarray(val)
+        args.append(val)
+        shardings.append(NamedSharding(plan.dst_mesh, lp.dst_spec))
+    if not args:
+        return None
+
+    fn = jax.jit(lambda *xs: tuple(xs), out_shardings=tuple(shardings))
+    return fn, tuple(args)
+
+
+def check_reshard_budget(plan: ReshardPlan, tree, *,
+                         budget_bytes: Optional[int] = None,
+                         step_index: Optional[int] = None,
+                         exemptions=None, target: Optional[str] = None):
+    """Run the Graph Doctor ``memory_budget`` pass (MEM001 family) over
+    one plan step's redistribution entry.  ``budget_bytes`` defaults to
+    the plan's declared transient cap; ``step_index`` defaults to the
+    worst (largest-transient) step.  Returns the findings Report — an
+    unbounded plan against a real budget fires MEM001, a bounded plan
+    sweeps clean."""
+    from ..analysis import check
+    from ..analysis.findings import Report
+
+    if budget_bytes is None:
+        if plan.transient_budget is None:
+            raise ValueError(
+                "plan has no transient budget and none was declared — "
+                "pass budget_bytes explicitly")
+        budget_bytes = plan.transient_budget
+    if not plan.steps:
+        return Report(target=target or "reshard_plan[empty]", findings=(),
+                      passes_run=("memory_budget",))
+    if step_index is None:
+        step_index = max(range(len(plan.steps)),
+                         key=lambda i: plan.steps[i].transient_bytes)
+    step = plan.steps[step_index]
+    entry = reshard_step_entry(plan, step, tree)
+    if entry is None:
+        return Report(target=target or f"reshard_step[{step_index}]",
+                      findings=(), passes_run=("memory_budget",))
+    fn, args = entry
+    kw = {} if exemptions is None else {"exemptions": exemptions}
+    return check(fn, *args, passes=["memory_budget"],
+                 target=target or f"reshard_step[{step_index}]",
+                 options={"memory_budget": {"hbm_bytes": int(budget_bytes)}},
+                 **kw)

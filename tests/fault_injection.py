@@ -1,0 +1,182 @@
+"""Fault-injection harness for the elastic resilience engine (round-12).
+
+Drives ``paddle_tpu.distributed.resilience.resilient_train_loop`` end to
+end in ONE process on the fake 8-device CPU mesh: ``FakeCluster`` is a
+``ClusterView`` whose schedule kills/hangs/slows workers and flips the
+simulated device count at controlled step boundaries — the tier-1 stand-
+in for a preemptible fleet.  Used by tests/test_resilience.py and the
+``elastic_recovery`` bench smoke leg (bench.py imports this module by
+path), so keep it import-light: no pytest at module scope.
+
+Fault kinds (``FaultEvent.kind``):
+
+- ``kill``    — a gang member dies mid-step: in-memory state is LOST;
+  recovery must reuse the last complete checkpoint (WorkerLost).
+- ``preempt`` — advance notice: state intact, drain-checkpoint + live
+  reshard (Preemption).
+- ``scale``   — capacity change to ``device_count`` devices, delivered
+  as a graceful preemption (the fleet's scale notice): the loop must
+  re-derive the mesh and reshard onto it.
+- ``hang``    — the step stalls for ``stall_s`` INSIDE the watchdog
+  window; with ``stall_s`` past the step timeout the watchdog flags it
+  and the driver raises StepHang (state suspect → checkpoint reuse).
+- ``slow``    — same stall mechanics but meant to stay UNDER the step
+  timeout: training must ride through with NO recovery event.
+
+Each event fires exactly once (consumed at its step boundary), so the
+post-recovery replay of the same step proceeds cleanly — matching the
+real world, where the preempted VM does not come back to re-preempt the
+same global step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.resilience import (ClusterView, Preemption,
+                                               RendezvousTimeout,
+                                               WorkerLost)
+
+
+@dataclass
+class FaultEvent:
+    step: int
+    kind: str                    # kill | preempt | scale | hang | slow
+    device_count: Optional[int] = None   # for scale
+    stall_s: float = 0.0                 # for hang/slow
+
+
+class FakeCluster(ClusterView):
+    """Scripted fleet: a schedule of FaultEvents over a virtual device
+    count, plus an optional number of rendezvous attempts that must fail
+    (exercises the retry/backoff path)."""
+
+    def __init__(self, device_count: Optional[int] = None,
+                 faults: List[FaultEvent] = (),
+                 rendezvous_failures: int = 0):
+        avail = len(jax.devices())
+        self.device_count = device_count or avail
+        assert self.device_count <= avail, "FakeCluster needs real devices"
+        self._faults: Dict[int, List[FaultEvent]] = {}
+        for ev in faults:
+            self._faults.setdefault(ev.step, []).append(ev)
+        self._rendezvous_failures = rendezvous_failures
+        self.rendezvous_log: List[int] = []   # generation per attempt
+        self.fired: List[FaultEvent] = []
+
+    # -- ClusterView -------------------------------------------------------
+    def devices(self):
+        return list(jax.devices())[:self.device_count]
+
+    def before_step(self, step: int) -> float:
+        stall = 0.0
+        for ev in self._faults.pop(step, []):
+            self.fired.append(ev)
+            if ev.kind == "kill":
+                raise WorkerLost(f"injected kill at step {step}")
+            if ev.kind == "preempt":
+                raise Preemption(f"injected preemption at step {step}")
+            if ev.kind == "scale":
+                assert ev.device_count, "scale event needs device_count"
+                self.device_count = ev.device_count
+                raise Preemption(
+                    f"injected scale to {ev.device_count} devices at "
+                    f"step {step}")
+            if ev.kind in ("hang", "slow"):
+                stall += ev.stall_s
+                continue
+            raise AssertionError(f"unknown fault kind {ev.kind!r}")
+        return stall
+
+    def rendezvous(self, generation: int, timeout_s: float) -> None:
+        self.rendezvous_log.append(generation)
+        if self._rendezvous_failures > 0:
+            self._rendezvous_failures -= 1
+            raise RendezvousTimeout(
+                f"injected rendezvous failure (gen {generation})")
+
+
+# ---------------------------------------------------------------------------
+# a deterministic toy training problem, sized for tier-1
+# ---------------------------------------------------------------------------
+#
+# SGD on sum((w - target)^2): elementwise math (bit-identical under any
+# sharding), a scalar loss, and a closed trajectory — so loss-parity
+# after recovery is an EXACT assertion, not a tolerance.
+
+
+def toy_mesh_builder(devices):
+    """1-D dp mesh over however many devices the fleet has; params
+    sharded on dim 0 (divisibility-checked by the planner's fit_spec)."""
+    n = max(1, len(devices))
+    mesh = Mesh(np.asarray(devices[:n], dtype=object).reshape(n), ("dp",))
+    specs = {"w": P("dp"), "opt.m": P("dp")}
+    return mesh, specs
+
+
+def toy_init(mesh, specs):
+    w = jnp.arange(64 * 4, dtype=jnp.float32).reshape(64, 4) / 100.0
+    m = jnp.zeros((64, 4), jnp.float32)
+    state = {"w": w, "opt": {"m": m}, "lr": 0.05}
+    from paddle_tpu.parallel.reshard import plan_reshard
+
+    return plan_reshard(state, mesh, specs).execute(state)
+
+
+def toy_target(step: int) -> np.ndarray:
+    rng = np.random.RandomState(1000 + step)
+    return rng.rand(64, 4).astype(np.float32)
+
+
+def toy_step_builder(mesh, specs):
+    lr_mom = 0.9
+
+    @jax.jit
+    def _step(w, m, lr, target):
+        grad = 2.0 * (w - target)
+        m = lr_mom * m + grad
+        w = w - lr * m
+        loss = jnp.sum((w - target) ** 2)
+        return loss, w, m
+
+    def step_fn(state, batch):
+        target = jax.device_put(
+            batch, NamedSharding(mesh, P(*specs["w"])))
+        loss, w, m = _step(state["w"], state["opt"]["m"],
+                           jnp.float32(state["lr"]), target)
+        return loss, {"w": w, "opt": {"m": m}, "lr": state["lr"]}
+
+    return step_fn
+
+
+def run_toy_loop(tmpdir: str, num_steps: int = 12, *,
+                 faults: List[FaultEvent] = (),
+                 device_count: Optional[int] = None,
+                 rendezvous_failures: int = 0,
+                 checkpoint_every: int = 4,
+                 step_timeout_s: float = 0.0,
+                 max_restarts: int = 3,
+                 sleep=None,
+                 seed: int = 0):
+    """One resilient run over the toy problem; returns (result, cluster)."""
+    from paddle_tpu.distributed.resilience import (ResilienceConfig,
+                                                   resilient_train_loop)
+
+    cluster = FakeCluster(device_count=device_count, faults=list(faults),
+                          rendezvous_failures=rendezvous_failures)
+    cfg = ResilienceConfig(
+        checkpoint_dir=tmpdir, checkpoint_every=checkpoint_every,
+        max_restarts=max_restarts, step_timeout_s=step_timeout_s,
+        backoff_base_s=0.01, backoff_max_s=0.05, seed=seed)
+    kw = {} if sleep is None else {"sleep": sleep}
+    res = resilient_train_loop(
+        mesh_builder=toy_mesh_builder, init_fn=toy_init,
+        step_builder=toy_step_builder, data_fn=toy_target,
+        num_steps=num_steps, config=cfg, cluster=cluster, **kw)
+    return res, cluster

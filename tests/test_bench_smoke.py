@@ -40,6 +40,11 @@ def test_bench_smoke_green():
                 # Poisson trace through the unified engine with prefix
                 # cache + chunked prefill + speculative decode (hits>0,
                 # mean accepted length > 1, all requests complete)
-                "serving_trace"):
+                "serving_trace",
+                # round-12: elastic resilience — reshard-engine A→B→A
+                # bit-parity under a bounded transient cap + MEM001
+                # budget, and a fault-injected kill recovering to a
+                # loss-parity resume within the replay budget
+                "reshard_parity", "elastic_recovery"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]

@@ -127,6 +127,77 @@ def test_launcher_restart_budget_exhausted(tmp_path):
     assert "elastic gang restart 1/1" in r.stderr
 
 
+def test_watchdog_poll_vs_timeout_race_hammer():
+    """Round-12 regression for the PR-6 handler/flag race family: many
+    tasks with tiny timeouts completed concurrently from several threads
+    while the scanner expires them.  The lock-arbitrated transition must
+    leave every task in EXACTLY ONE terminal state, with handlers fired
+    exactly for the timed-out set."""
+    import threading
+
+    mgr = CommTaskManager(scan_interval=0.002)
+    fired = []
+    fired_lock = threading.Lock()
+
+    def handler(t):
+        with fired_lock:
+            fired.append(t.seq)
+
+    mgr.add_handler(handler)
+    tasks = []
+    tasks_lock = threading.Lock()
+    # per-task hold times straddle the 15ms timeout: ~instant completes
+    # (scanner loses), well-past holds (scanner wins), and boundary
+    # holds that genuinely race the expiry scan
+    holds = [0.0, 0.03, 0.015]
+
+    def worker(wid):
+        for i in range(30):
+            t = mgr.register(f"op{wid}_{i}", timeout_s=0.015)
+            with tasks_lock:
+                tasks.append(t)
+            time.sleep(holds[(wid + i) % len(holds)])
+            mgr.complete(t)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    deadline = time.monotonic() + 2.0
+    while mgr._tasks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.05)       # let in-flight handler batches finish
+    assert not mgr._tasks  # table drains either way
+    for t in tasks:
+        assert t.done != t.timed_out, \
+            f"task {t.seq} done={t.done} timed_out={t.timed_out}"
+    timed_out_seqs = {t.seq for t in tasks if t.timed_out}
+    assert {t.seq for t in mgr.timed_out} == timed_out_seqs
+    with fired_lock:
+        assert sorted(fired) == sorted(timed_out_seqs)
+    # the race hits both ways in a meaningful hammer: some completed,
+    # some expired (sanity that the schedule actually straddled — the
+    # 0ms holds beat the 15ms timeout, the 30ms holds lose to it)
+    assert any(t.done for t in tasks)
+    assert any(t.timed_out for t in tasks)
+    mgr.shutdown()
+
+
+def test_watchdog_complete_after_timeout_is_noop():
+    """The scanner won: a late complete() must not un-flag the task
+    (late results from a hung collective are suspect)."""
+    mgr = CommTaskManager(scan_interval=0.01)
+    task = mgr.register("hung_op", timeout_s=0.03)
+    deadline = time.monotonic() + 2.0
+    while not task.timed_out and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert task.timed_out
+    mgr.complete(task)
+    assert task.timed_out and not task.done
+    mgr.shutdown()
+
+
 def test_watchdog_disabled_fast_path():
     mgr = CommTaskManager(scan_interval=0.02)
     task = mgr.register("noop", timeout_s=0)

@@ -95,3 +95,57 @@ def test_barrier_across_processes(master):
         assert p.returncode == 0
         assert "BARRIER_OK" in out
     me.close()
+
+
+# ---------------------------------------------------------------------------
+# round-12 satellite: configurable rendezvous timeout + backoff/jitter
+# ---------------------------------------------------------------------------
+
+
+def test_barrier_timeout_flag_override(master):
+    """FLAGS_store_barrier_timeout_s overrides every call site's
+    explicit window (the gang-rendezvous knob); unset (0) keeps the
+    caller's default."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.store import resolve_store_timeout
+
+    assert resolve_store_timeout(120.0) == 120.0   # default unchanged
+    paddle.set_flags({"FLAGS_store_barrier_timeout_s": 0.4})
+    try:
+        assert resolve_store_timeout(120.0) == 0.4
+        c = TCPStore(port=master.port, world_size=2)  # never assembles
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="barrier"):
+            c.barrier("lonely", timeout=120.0)         # flag wins
+        elapsed = time.monotonic() - t0
+        assert 0.3 <= elapsed < 5.0, elapsed
+        c.close()
+    finally:
+        paddle.set_flags({"FLAGS_store_barrier_timeout_s": 0.0})
+
+
+def test_barrier_succeeds_across_wait_slices(master):
+    """The sliced wait-with-backoff must still succeed when the last
+    participant arrives AFTER several slices have expired."""
+    c = TCPStore(port=master.port, world_size=2)
+
+    def late_joiner():
+        time.sleep(0.6)
+        c2 = TCPStore(port=master.port, world_size=2)
+        c2.barrier("late_gang", timeout=10.0)
+        c2.close()
+
+    th = threading.Thread(target=late_joiner)
+    th.start()
+    c.barrier("late_gang", timeout=10.0)
+    th.join()
+    c.close()
+
+
+def test_connect_retries_until_deadline_then_fails():
+    """Connecting to a dead port burns the (short) budget through
+    jittered retries instead of hanging."""
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="cannot connect"):
+        TCPStore(host="127.0.0.1", port=1, world_size=1, timeout=0.5)
+    assert time.monotonic() - t0 < 10.0
