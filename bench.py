@@ -1340,6 +1340,133 @@ def serving_trace(smoke: bool = False, seed: int = 0):
     return res
 
 
+def _ensure_tests_path():
+    """Make tests/fault_injection.py importable (the fault-injection
+    harness doubles as the bench's scripted-trace driver)."""
+    import sys as _sys
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in _sys.path:
+        _sys.path.insert(0, tests_dir)
+
+
+def serving_fleet_trace(smoke: bool = False, seed: int = 0):
+    """Multi-replica serving-resilience bench (round-13): a scripted
+    fault trace — a replica KILL mid-decode, a watchdog-flagged HANG,
+    and a sustained overload burst — through the FleetRouter over
+    FakeReplicas (bench.py --serving-fleet-trace ->
+    SERVING_FLEET_r01.json).
+
+    Records what the round-13 BASELINE entry predicts against:
+
+    - recovery time per fault (ticks from death to the replacement
+      SERVING, wall seconds including weight delivery through the
+      cached reshard plan),
+    - shed rate (stage-3 rejections / offered) during the burst, with
+      the ladder-engagement order,
+    - p50/p99 per-token latency UNDER FAULT,
+    - the zero-loss + bit-parity gates: every ACCEPTED request
+      completes with greedy tokens identical to one-shot generate().
+
+    CPU sessions run the kernels in interpret mode — absolute latency
+    is structural; recovery tick counts and the loss/parity gates are
+    exact."""
+    import jax
+
+    _ensure_tests_path()
+    from fault_injection import (OverloadBurst, ReplicaFaultEvent,
+                                 build_serving_fleet, run_fleet_trace,
+                                 toy_llama)
+    from paddle_tpu.inference.fleet import RouterConfig
+    from paddle_tpu.models.generation import generate
+
+    cfg, model, params = toy_llama()
+    rng = np.random.default_rng(seed)
+    n_req = 5 if smoke else 12
+    max_new = 4 if smoke else 6
+    sysp = rng.integers(1, cfg.vocab_size, (16,)).astype(np.int32)
+    requests = []
+    for i in range(n_req):
+        n = int(np.clip(rng.lognormal(2.0, 0.5), 4, 24))
+        body = rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+        prompt = np.concatenate([sysp, body]) if i % 2 == 0 else body
+        # named requests land on ticks 0-1, BEFORE the ladder can reach
+        # the reject stage — the burst is what gets shed
+        requests.append((i % 2, prompt, max_new))
+    # the heartbeat timeout needs real headroom over a LOADED interpret-
+    # mode step (~70 ms p99 on throttled CPU): 0.5 s never false-flags,
+    # the scripted 1.2 s stall always does
+    scripts = {0: [ReplicaFaultEvent(step=3, kind="kill")],
+               1: [ReplicaFaultEvent(step=6, kind="hang", stall_s=1.2)]}
+    router, rs = build_serving_fleet(
+        cfg, params, target=2, step_timeout_s=0.5, scripts=scripts,
+        router_cfg=RouterConfig(admission_token_cap=48))
+    bursts = [OverloadBurst(tick=2, n_requests=5,
+                            duration=5 if smoke else 8,
+                            prompt_len=20, max_new_tokens=4)]
+
+    t0 = time.perf_counter()
+    res = run_fleet_trace(router, requests, bursts=bursts, seed=seed)
+    elapsed = time.perf_counter() - t0
+    out = router.results()
+    lost = [rid for rid in res["rids"] if rid not in out]
+    parity = True
+    for rid, prompt, mnew in res["submitted"]:
+        if rid not in out:
+            continue
+        ref = generate(model, prompt[None], max_new_tokens=mnew,
+                       do_sample=False)
+        ref_new = np.asarray(ref._value if hasattr(ref, "_value")
+                             else ref)[0, len(prompt):]
+        parity &= (len(out[rid]) == mnew
+                   and np.array_equal(out[rid], ref_new))
+    stats = router.stats()
+    lat = np.asarray(res["per_token_lat"]) if res["per_token_lat"] \
+        else np.zeros(1)
+    ladder_ups = [(ev["from"], ev["to"]) for ev in stats["ladder_log"]
+                  if ev["to"] > ev["from"]]
+    faults = sorted(ev["fault"] for ev in stats["recoveries"])
+    # a recovery event with no replacement is a MISSED recovery, not a
+    # 0-tick one — it fails the gate and is reported separately
+    unrecovered = [ev for ev in stats["recoveries"]
+                   if ev["replacement_id"] is None]
+    recovered_ticks = [ev["recovery_ticks"] for ev in stats["recoveries"]
+                       if ev["recovery_ticks"] is not None]
+    delivery = rs.check_delivery_budget()
+    ok = (not lost and parity
+          and faults == ["ReplicaHung", "ReplicaKilled"]
+          and not unrecovered
+          and res["rejected"] > 0
+          and ladder_ups[:3] == [(0, 1), (1, 2), (2, 3)]
+          and delivery.ok)
+    return {
+        "ok": bool(ok),
+        "backend": jax.default_backend(),
+        "interpret_mode": jax.default_backend() == "cpu",
+        "accepted": len(res["rids"]),
+        "completed": len(out),
+        "lost": len(lost),
+        "bit_identical": bool(parity),
+        "rejected": res["rejected"],
+        "shed_rate": stats["shed_rate"],
+        "ladder_ups": ladder_ups,
+        "recoveries": stats["recoveries"],
+        "unrecovered": len(unrecovered),
+        "recovery_ticks_max": max(recovered_ticks, default=0),
+        "per_token_latency_p50_ms": float(np.percentile(lat, 50) * 1e3),
+        "per_token_latency_p99_ms": float(np.percentile(lat, 99) * 1e3),
+        "elapsed_s": elapsed,
+        "ticks": res["ticks"],
+        "delivery": {"plans_built": rs.telemetry["plans_built"],
+                     "deliveries": rs.telemetry["deliveries"],
+                     "moved_bytes": int(rs.delivery_plan().moved_bytes),
+                     "doctor_ok": bool(delivery.ok)},
+        "trace": {"n_requests": n_req, "burst": "5/tick",
+                  "max_new_tokens": max_new, "seed": seed},
+    }
+
+
 def doctor():
     """bench.py --doctor — run the Graph Doctor (paddle_tpu.analysis)
     over the benched steps: every seeded-bug fixture must trigger exactly
@@ -1642,6 +1769,18 @@ def smoke():
     except Exception as e:  # noqa: BLE001
         legs["elastic_recovery"] = {"ok": False, "error": repr(e)}
 
+    # 15+16. round-13 serving resilience, ONE shared scripted run, two
+    #     gates: a mid-decode replica kill loses zero requests with
+    #     bit-identical greedy streams (router_parity), and the
+    #     replacement arrives through the cached MEM001-budgeted
+    #     delivery plan within one router tick (replica_recovery)
+    try:
+        legs["router_parity"], legs["replica_recovery"] = \
+            _smoke_fleet_legs()
+    except Exception as e:  # noqa: BLE001
+        legs["router_parity"] = {"ok": False, "error": repr(e)}
+        legs["replica_recovery"] = {"ok": False, "error": repr(e)}
+
     return {"smoke": True,
             "backend": jax.default_backend(),
             "ok": all(leg.get("ok") for leg in legs.values()),
@@ -1704,13 +1843,9 @@ def _smoke_elastic_recovery():
     fault-injection harness; the resilient loop must recover within the
     checkpoint_every replay budget and reproduce the uninterrupted loss
     trajectory exactly."""
-    import sys as _sys
     import tempfile
 
-    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "tests")
-    if tests_dir not in _sys.path:
-        _sys.path.insert(0, tests_dir)
+    _ensure_tests_path()
     from fault_injection import FaultEvent, run_toy_loop
 
     with tempfile.TemporaryDirectory() as dref, \
@@ -1730,6 +1865,64 @@ def _smoke_elastic_recovery():
             "resume_step": rec.resume_step,
             "steps_replayed": rec.steps_replayed,
             "loss_parity": bool(parity)}
+
+
+def _smoke_fleet_legs():
+    """ONE scripted fleet run feeding BOTH round-13 smoke gates (the
+    fleet spawn + jit warmup is the leg's dominant cost, so the two
+    gates share it): a mid-decode replica KILL must lose zero requests
+    with every greedy stream bit-identical to one-shot generate()
+    (router_parity), and the replacement must arrive through the
+    CACHED weight-delivery plan — plan once per topology, stream per
+    replica — under the doctor's MEM001 budget, within one router tick
+    (replica_recovery)."""
+    _ensure_tests_path()
+    from fault_injection import (ReplicaFaultEvent, build_serving_fleet,
+                                 toy_llama)
+    from paddle_tpu.models.generation import generate
+
+    cfg, model, params = toy_llama()
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 9, 14, 7)]
+    router, rs = build_serving_fleet(
+        cfg, params, target=2,
+        scripts={0: [ReplicaFaultEvent(step=2, kind="kill")]})
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    out = router.run()
+    lost = [r for r in rids if r not in out]
+    parity = True
+    for rid, p in zip(rids, prompts):
+        if rid not in out:
+            continue
+        ref = generate(model, p[None], max_new_tokens=6, do_sample=False)
+        ref_new = np.asarray(ref._value if hasattr(ref, "_value")
+                             else ref)[0, len(p):]
+        parity &= (len(out[rid]) == 6
+                   and np.array_equal(out[rid], ref_new))
+    faults = [ev.fault for ev in router.telemetry["recoveries"]]
+    recs = router.telemetry["recoveries"]
+    router_parity = {
+        "ok": bool(not lost and parity and faults == ["ReplicaKilled"]),
+        "lost": len(lost), "bit_identical": bool(parity),
+        "migrations": router.telemetry["migrations"],
+        "recoveries": faults}
+    delivery = rs.check_delivery_budget()
+    ok = (rs.telemetry["plans_built"] == 1
+          and rs.telemetry["deliveries"] == 3   # 2 initial + replacement
+          and len(recs) == 1
+          and recs[0].replacement_id is not None
+          and (recs[0].recovery_ticks or 0) <= 1
+          and delivery.ok
+          and len(rs.serving()) == 2)
+    replica_recovery = {
+        "ok": bool(ok),
+        "plans_built": rs.telemetry["plans_built"],
+        "deliveries": rs.telemetry["deliveries"],
+        "recovery_ticks": recs[0].recovery_ticks if recs else None,
+        "delivery_doctor_ok": bool(delivery.ok),
+        "completed": len(out)}
+    return router_parity, replica_recovery
 
 
 def _smoke_overlap_parity():
@@ -1961,6 +2154,15 @@ if __name__ == "__main__":
         res = serving_trace(smoke="--smoke-trace" in sys.argv)
         try:
             with open("SERVING_r01.json", "w") as f:
+                json.dump(res, f, indent=1, default=str)
+        except OSError:
+            pass
+        print(json.dumps(res, default=str))
+        sys.exit(0 if res["ok"] else 1)
+    if "--serving-fleet-trace" in sys.argv:
+        res = serving_fleet_trace(smoke="--smoke-trace" in sys.argv)
+        try:
+            with open("SERVING_FLEET_r01.json", "w") as f:
                 json.dump(res, f, indent=1, default=str)
         except OSError:
             pass

@@ -203,6 +203,11 @@ mod_ = globals()["remainder_"]     # reference: mod_ == remainder_
 floor_mod_ = globals()["remainder_"]
 from .frontend_compat import (bernoulli_, cast_, fill_, geometric_,  # noqa: F401,E402
                               normal_, zero_)
+# round-13 tranche: the remaining sampling fills (uniform_ closes the
+# standing exemption) + the diagonal-fill family
+from .frontend_compat import (exponential_, fill_diagonal_,  # noqa: F401,E402
+                              fill_diagonal_tensor,
+                              fill_diagonal_tensor_, uniform_)
 del _mk_inplace
 
 # snapshot the framework-shipped op set (custom ops registered by user

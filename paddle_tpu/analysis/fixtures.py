@@ -406,6 +406,24 @@ def seeded_reshard_over_budget() -> Report:
                                 target="seeded:MEM001[reshard_plan]")
 
 
+def seeded_replica_delivery_over_budget() -> Report:
+    """MEM001 on the round-13 replica weight-delivery entry: an
+    UNBOUNDED delivery plan (``max_transient_bytes=None`` — whole
+    leaves in one step, the shape an ad-hoc per-replica device_put
+    sweep degenerates to) streams a 1 MB host weight tree against a
+    64 KB declared budget.  ``ReplicaSet.spawn`` always streams through
+    the size-capped cached plan; this proves the budget pin fires when
+    someone bypasses the cap."""
+    from ..inference.fleet import FleetConfig, ReplicaSet
+
+    host = {"w": np.ones((512, 512), np.float32)}     # 1 MB, host-side
+    rs = ReplicaSet(host, engine_factory=lambda p: None,
+                    config=FleetConfig(max_transient_bytes=None))
+    return rs.check_delivery_budget(
+        budget_bytes=64 << 10, exemptions=(),
+        target="seeded:MEM001[replica_delivery]")
+
+
 def seeded_while_peeling() -> Report:
     """HLO003 over a captured-HLO sample: a scanned body's all-gather
     duplicated TWICE into the hosting computation (XLA's peel+unroll
@@ -466,5 +484,8 @@ SEEDED = {
     # a third on the round-12 reshard entry: an unbounded redistribution
     # plan overruns its declared transient budget
     "MEM001[reshard_plan]": seeded_reshard_over_budget,
+    # a fourth on the round-13 replica weight-delivery entry: an
+    # unbounded fleet delivery plan overruns its declared budget
+    "MEM001[replica_delivery]": seeded_replica_delivery_over_budget,
     "MEM002": seeded_host_round_trip,
 }

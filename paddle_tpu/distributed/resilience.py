@@ -85,6 +85,53 @@ class ResilienceExhausted(RuntimeError):
     """Restart or rendezvous budget spent; the job fails for real."""
 
 
+# -- serving-side taxonomy (round-13: the fleet manager's fault model) --
+#
+# Same state_intact contract as the training faults, but the unit of
+# failure is a serving REPLICA and the recovery currency is in-flight
+# REQUESTS (re-enqueued on survivors and replayed from their committed
+# prefix) instead of optimizer state.
+
+
+class ReplicaFault(FaultError):
+    """Base of recoverable serving-replica faults (inference/fleet.py
+    catches these per replica step and migrates the replica's in-flight
+    requests to survivors)."""
+
+
+class ReplicaKilled(ReplicaFault):
+    """The replica died mid-decode: its KV pages and any tokens emitted
+    since the router's last harvest are gone."""
+
+
+class ReplicaPreempted(ReplicaFault):
+    """Advance notice (maintenance, spot reclaim): the replica is going
+    away but its committed output is trustworthy — migration inside the
+    grace window loses nothing."""
+
+    state_intact = True
+
+
+class ReplicaHung(ReplicaFault):
+    """The watchdog flagged the replica's step: results of the flagged
+    step are suspect and must not be committed."""
+
+
+@dataclass
+class ServingRecoveryEvent:
+    """One replica death + replacement, as the router's telemetry
+    records it (the serving analog of RecoveryEvent)."""
+
+    replica_id: int
+    fault: str
+    died_at_tick: int
+    migrated_requests: int
+    replacement_id: Optional[int] = None
+    serving_at_tick: Optional[int] = None
+    recovery_ticks: Optional[int] = None   # death -> replacement SERVING
+    wall_s: Optional[float] = None
+
+
 # ---------------------------------------------------------------------------
 # configuration + cluster views
 # ---------------------------------------------------------------------------

@@ -428,6 +428,91 @@ def log_normal_(x, mean=1.0, std=2.0):
     return _wrap(s)
 
 
+def uniform_(x, min=-1.0, max=1.0, seed=0):  # noqa: A002 — reference names
+    """Fill x in place with U[min, max) samples (reference
+    paddle.Tensor.uniform_ — the round-13 tranche closes the standing
+    exemption).  ``seed=0`` consumes the framework RNG stream like the
+    other sampling fills; a NONZERO seed is the reference's fixed
+    deterministic stream (same seed → same fill, every call)."""
+    import jax
+
+    from .ops.random import _key as _next_key
+
+    v = _val(x)
+    key = jax.random.PRNGKey(seed) if seed else _next_key()
+    s = jax.random.uniform(key, v.shape, jnp.float32,
+                           minval=min, maxval=max)
+    return _fill_inplace(x, s)
+
+
+def exponential_(x, lam=1.0):
+    """Fill x in place with Exponential(lam) samples (reference
+    paddle.Tensor.exponential_)."""
+    import jax
+
+    from .ops.random import _key as _next_key
+
+    v = _val(x)
+    u = jax.random.uniform(_next_key(), v.shape, jnp.float32,
+                           minval=1e-7, maxval=1.0)
+    return _fill_inplace(x, -jnp.log(u) / lam)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False):
+    """Set x's diagonal in place (reference paddle.Tensor.
+    fill_diagonal_): numpy fill_diagonal semantics for square/ND
+    inputs (incl. ``wrap`` for tall 2-d), plus the reference's
+    ``offset`` for 2-d.  Unsupported combinations raise instead of
+    silently filling the wrong diagonal."""
+    _guard_inplace_fill(x, "fill_diagonal_")
+    v = _val(x)
+    arr = np.array(v)
+    if offset != 0:
+        if arr.ndim != 2:
+            raise NotImplementedError(
+                "fill_diagonal_: offset != 0 is only defined for 2-d "
+                "inputs (the reference's contract)")
+        if wrap:
+            raise NotImplementedError(
+                "fill_diagonal_: wrap=True with offset != 0 is not "
+                "supported")
+        h, w = arr.shape
+        i = np.arange(max(0, -offset), max(0, min(h, w - offset)))
+        arr[i, i + offset] = value
+    else:
+        np.fill_diagonal(arr, value, wrap=wrap)
+    return _fill_inplace(x, jnp.asarray(arr))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """Out-of-place diagonal fill FROM A TENSOR (reference
+    paddle.Tensor.fill_diagonal_tensor): the (dim1, dim2) diagonal at
+    ``offset`` takes y's values; everything else is x."""
+    v = _val(x)
+    yv = np.asarray(_val(y))
+    arr = np.array(v)
+    if not (arr.ndim == 2 and (dim1, dim2) == (0, 1)):
+        raise NotImplementedError(
+            "fill_diagonal_tensor: only 2-d x with dim1=0, dim2=1 is "
+            "implemented (the reference's common path)")
+    h, w = arr.shape
+    i = np.arange(max(0, -offset), max(0, min(h, w - offset)))
+    if yv.size != len(i):
+        raise ValueError(
+            f"fill_diagonal_tensor: y has {yv.size} elements but the "
+            f"target diagonal holds {len(i)} (shape {arr.shape}, "
+            f"offset {offset})")
+    arr[i, i + offset] = yv.reshape(-1)
+    return _wrap(jnp.asarray(arr).astype(v.dtype))
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1):
+    """In-place partner of ``fill_diagonal_tensor``."""
+    _guard_inplace_fill(x, "fill_diagonal_tensor_")
+    out = fill_diagonal_tensor(x, y, offset=offset, dim1=dim1, dim2=dim2)
+    return _fill_inplace(x, _val(out))
+
+
 def create_parameter(shape, dtype="float32", name=None, attr=None,
                      is_bias=False, default_initializer=None):
     """Standalone trainable parameter (reference paddle.create_parameter):

@@ -340,3 +340,7 @@ def create_predictor(config_or_layer, layer=None):
 # into the decode step + speculative decoding.
 from .serving import (ContinuousBatchingEngine, PageAllocator,  # noqa: E402
                       PrefixCache)
+# round-13 serving resilience plane: replica fleet manager + SLO-aware
+# router + request-level fault tolerance
+from .fleet import (FleetConfig, FleetRouter, OverloadRejected,  # noqa: E402
+                    Replica, ReplicaSet, RouterConfig)

@@ -66,6 +66,21 @@ quantize_params_int8 (int8 matrices + per-out-channel scales) run
 through the same compiled programs — dequant fuses into the consumer
 dots, so an 8B-shaped model's weight stream halves (the bench.py
 llama-8B serving leg).
+
+Round 13 (the serving resilience plane, inference/fleet.py):
+
+- int8 KV cache on the UNIFIED path — the first admission runs the
+  legacy chunked path's calibration pass (absmax per (layer, kv head),
+  2x headroom, frozen) and the ragged step quantizes every scattered
+  K/V row with those scales;
+- device-side gather of the CONSUMED logit rows (every verify-window
+  row + each prefill chunk's final row) before the final norm/head:
+  the vocab projection, fp32 logits buffer and device->host transfer
+  are sized to ``gather_cap``, not ``rows_cap``;
+- ``cancel(rid)`` withdraws a request with no Finished record (the
+  router's migration/retry primitive) and ``throttle()`` exposes the
+  runtime shed knobs (speculative_k, prefill_token_budget) under the
+  constructor's static compiled shapes.
 """
 
 from __future__ import annotations
@@ -497,16 +512,23 @@ class ContinuousBatchingEngine:
                 "the prefix cache requires the unified engine "
                 "(prefill_token_budget > 0): cache hits enter decode "
                 "mid-prompt, which only the ragged step can serve")
-        if self.unified and self.cache_dtype == jnp.int8:
-            raise ValueError(
-                "int8 KV cache rides the legacy chunked path for now "
-                "(unified-plane calibration is a follow-up)")
         self.prefix_cache = (PrefixCache(self.page_size, self.alloc)
                              if enable_prefix_cache else None)
         # static packed-row capacity of one unified launch: one decode
         # row per slot (k+1 under speculation) + the prefill chunk
         self.rows_cap = self.max_slots * (1 + self.spec_k) \
             + self.prefill_budget
+        # static capacity of the CONSUMED-row gather (round-13): every
+        # verify-window row + at most one chunk-final row per slot —
+        # the head matmul, fp32 logits buffer and host transfer are
+        # sized to this, not to rows_cap (a long prefill chunk's
+        # intermediate rows never reach the host)
+        self.gather_cap = self.max_slots * (1 + self.spec_k) \
+            + self.max_slots
+        # runtime degradation floors: throttle() may shed work but
+        # never grow past the constructor's static shapes
+        self._init_spec_k = self.spec_k
+        self._init_prefill_budget = self.prefill_budget
         self.pending_prompt: Dict[int, np.ndarray] = {}
         self.prefill_order: List[int] = []       # FIFO over mid-prefill slots
         self.req_info: Dict[int, Request] = {}   # slot -> live request
@@ -719,7 +741,7 @@ class ContinuousBatchingEngine:
              donate_argnums=(1, 2))
     def _unified_step_jit(params, k_pages, v_pages, rows, tables,
                           cos_tab, sin_tab, self_cfg_id, pages_per_step,
-                          kv_scales=None, with_head=True):
+                          kv_scales=None, with_head=True, gather=None):
         """ONE ragged engine step: a packed batch of tokens from many
         sequences — decode slots (one row each), prefill chunks (one row
         per prompt token) and speculative verify windows (k+1 rows) —
@@ -805,8 +827,17 @@ class ContinuousBatchingEngine:
             # effect: skip the [T, hidden] x [hidden, vocab] head matmul
             # and the fp32 logits allocation entirely
             return tuple(new_k), tuple(new_v), None
+        if gather is not None:
+            # device-side gather of the CONSUMED rows (every verify-
+            # window row + each prefill chunk's final row) BEFORE the
+            # final norm/vocab projection: the head matmul, the fp32
+            # logits buffer and the device->host copy shrink from
+            # rows_cap to gather_cap — a prefill chunk's intermediate
+            # rows exist only for their K/V scatter and never produce
+            # (or transfer) logits
+            x = jnp.take(x, gather, axis=0)
         x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
-        logits = w.head(x).astype(jnp.float32)        # [T, vocab]
+        logits = w.head(x).astype(jnp.float32)        # [G, vocab]
         return tuple(new_k), tuple(new_v), logits
 
     # ---------------- host scheduler ----------------
@@ -831,6 +862,13 @@ class ContinuousBatchingEngine:
                              "engine (host-side sampling from returned "
                              "logits); the legacy chunked path is "
                              "greedy-only")
+        if (self.unified and self.cache_dtype == jnp.int8
+                and self.kv_scales is None):
+            # calibrate on the FIRST real prompt at SUBMISSION time —
+            # outside any caller's step/heartbeat window, so the
+            # calibration prefill's jit compile can never be mistaken
+            # for a hung serving step (inference/fleet.py's watchdog)
+            self._calibrate_int8_unified(prompt)
         if rid is None:
             rid = self._next_rid
             self._next_rid += 1
@@ -872,12 +910,7 @@ class ContinuousBatchingEngine:
             if self.cache_dtype == jnp.int8 and self.kv_scales is None:
                 # calibrate once: absmax per (layer, kv head) over the
                 # first prompt's real tokens, 2x headroom
-                kabs = jnp.max(jnp.abs(ks[:, :s].astype(jnp.float32)),
-                               axis=(1, 3)) * 2.0 + 1e-6     # [L, kvh]
-                vabs = jnp.max(jnp.abs(vs[:, :s].astype(jnp.float32)),
-                               axis=(1, 3)) * 2.0 + 1e-6
-                self.kv_scales = {"kq": 127.0 / kabs, "kdq": kabs / 127.0,
-                                  "vq": 127.0 / vabs, "vdq": vabs / 127.0}
+                self.kv_scales = self._kv_calibration_scales(ks, vs, s)
             if self.cache_dtype == jnp.int8:
                 ks = self._quant(ks, self.kv_scales["kq"])
                 vs = self._quant(vs, self.kv_scales["vq"])
@@ -906,12 +939,10 @@ class ContinuousBatchingEngine:
                 self._finish(slot)
         return admitted
 
-    def _finish(self, slot: int):
-        rid = int(self.slot_rid[slot])
-        self.finished.append(Finished(rid,
-                                      np.asarray(self.out_tokens.pop(rid),
-                                                 np.int32),
-                                      self.prompt_lens.pop(rid)))
+    def _release_slot(self, slot: int):
+        """Return a slot's pages and clear its host state — the shared
+        tail of normal completion (``_finish``) and withdrawal
+        (``cancel``)."""
         self.alloc.release(self.slot_pages.pop(slot))
         self.active[slot] = False
         self.tables[slot] = -1
@@ -924,6 +955,87 @@ class ContinuousBatchingEngine:
         if slot in self.prefill_order:
             self.prefill_order.remove(slot)
         self.req_info.pop(slot, None)
+
+    def _finish(self, slot: int):
+        rid = int(self.slot_rid[slot])
+        self.finished.append(Finished(rid,
+                                      np.asarray(self.out_tokens.pop(rid),
+                                                 np.int32),
+                                      self.prompt_lens.pop(rid)))
+        self._release_slot(slot)
+
+    def cancel(self, rid: int) -> bool:
+        """Withdraw a request WITHOUT recording a ``Finished`` entry —
+        the fleet router's migration/retry path (the request replays
+        elsewhere from its committed prefix, so completing it here would
+        double-count it).  Queued requests leave the queue; an active
+        request's slot releases its pages (prefix-cache refs on shared
+        pages are the trie's own and survive).  On the legacy pipelined
+        path a canceled slot's stale in-flight chunk is dropped at
+        harvest by the existing rid match.  Returns True when the rid
+        was found."""
+        for i, req in enumerate(self.queue):
+            if req.rid == rid:
+                del self.queue[i]
+                return True
+        hit = np.nonzero(self.slot_rid == rid)[0]
+        if len(hit):
+            slot = int(hit[0])
+            self.out_tokens.pop(rid, None)
+            self.prompt_lens.pop(rid, None)
+            self._release_slot(slot)
+            return True
+        return False
+
+    def throttle(self, *, speculative_k=None, prefill_token_budget=None):
+        """Runtime degradation knobs (the router's shed ladder).  Both
+        only REDUCE work relative to the constructor's static shapes —
+        ``rows_cap``/``gather_cap`` keep the spawn-time capacity, so a
+        throttled engine reuses the compiled step (fewer live rows, no
+        retrace) and can be restored to full service later."""
+        if speculative_k is not None:
+            k = int(speculative_k)
+            if not 0 <= k <= self._init_spec_k:
+                raise ValueError(
+                    f"speculative_k {k} outside [0, {self._init_spec_k}] "
+                    f"(the constructor's static verify-window capacity)")
+            self.spec_k = k
+        if prefill_token_budget is not None:
+            b = int(prefill_token_budget)
+            if not 1 <= b <= self._init_prefill_budget:
+                raise ValueError(
+                    f"prefill_token_budget {b} outside "
+                    f"[1, {self._init_prefill_budget}] (the constructor's "
+                    f"static chunk capacity)")
+            self.prefill_budget = b
+
+    @staticmethod
+    def _kv_calibration_scales(ks, vs, s: int):
+        """THE int8 K/V scale rule (one home for legacy + unified):
+        absmax per (layer, kv head) over the first ``s`` real tokens,
+        2x headroom, frozen quant/dequant pairs."""
+        kabs = jnp.max(jnp.abs(ks[:, :s].astype(jnp.float32)),
+                       axis=(1, 3)) * 2.0 + 1e-6          # [L, kvh]
+        vabs = jnp.max(jnp.abs(vs[:, :s].astype(jnp.float32)),
+                       axis=(1, 3)) * 2.0 + 1e-6
+        return {"kq": 127.0 / kabs, "kdq": kabs / 127.0,
+                "vq": 127.0 / vabs, "vdq": vabs / 127.0}
+
+    def _calibrate_int8_unified(self, prompt) -> None:
+        """One-shot K/V scale calibration for the unified plane: run the
+        legacy full prefill over the FIRST admitted prompt, apply the
+        shared scale rule, then DISCARD that prefill's K/V: the unified
+        step re-prefills the prompt through its own quantized ragged
+        scatter, so the cache holds one self-consistent int8 stream."""
+        s = len(prompt)
+        bucket = max(16, 1 << (s - 1).bit_length())
+        ids = np.zeros(bucket, np.int32)
+        ids[:s] = prompt
+        _, ks, vs = ContinuousBatchingEngine._prefill_jit(
+            self.params, jnp.asarray(ids), jnp.asarray(s, jnp.int32),
+            self.cos_tab, self.sin_tab, self_cfg_id=self.cfg_id,
+            bucket=bucket)
+        self.kv_scales = self._kv_calibration_scales(ks, vs, s)
 
     # ---------------- unified serving plane (round 11) ----------------
     #
@@ -955,6 +1067,11 @@ class ContinuousBatchingEngine:
         the new table (copy-on-write: the request only ever writes at
         or past its private suffix) and skip their prefill entirely."""
         admitted = []
+        if (self.cache_dtype == jnp.int8 and self.kv_scales is None
+                and self.queue):
+            # normally already calibrated at add_request; kept as a
+            # safety net for scales dropped after submission
+            self._calibrate_int8_unified(self.queue[0].prompt)
         free_slots = [s for s in range(self.max_slots)
                       if not self.active[s]]
         si = 0
@@ -1152,19 +1269,25 @@ class ContinuousBatchingEngine:
         rows = np.zeros((self.rows_cap, 5), np.int32)
         rows[:, 1] = self.trash_page
         rows[:, 4] = -1
+        # consumed-row gather schedule: metas carry GATHERED offsets, so
+        # the commit loop below indexes the gathered logits directly
+        gather = np.zeros(self.gather_cap, np.int32)
+        g = 0
         r = 0
         metas = []
         for s in decoding:
             base = int(self.seq_lens[s])
             window = [int(self.cur_tok[s])] \
                 + list(props.get(s, ([], []))[0])
-            start = r
+            gstart = g
             for j, t in enumerate(window):
                 p = base + j
                 rows[r] = (t, self._phys(s, p), p % self.page_size,
                            p + 1, s)
+                gather[g] = r
+                g += 1
                 r += 1
-            metas.append(("verify", s, start, len(window)))
+            metas.append(("verify", s, gstart, len(window)))
         left = self.prefill_budget
         for s in list(self.prefill_order):
             if left <= 0:
@@ -1172,7 +1295,6 @@ class ContinuousBatchingEngine:
             pend = self.pending_prompt[s]
             chunk = min(len(pend), left)
             base = int(self.seq_lens[s])
-            start = r
             for j in range(chunk):
                 p = base + j
                 rows[r] = (int(pend[j]), self._phys(s, p),
@@ -1180,7 +1302,11 @@ class ContinuousBatchingEngine:
                 r += 1
             left -= chunk
             enc[s] = chunk
-            metas.append(("prefill", s, start, chunk))
+            # only the chunk's FINAL row can seed generation — it is
+            # the one prefill row the gather hands to the host
+            gather[g] = r - 1
+            metas.append(("prefill", s, g, chunk))
+            g += 1
         if r == 0:
             self.last_report = {
                 "seq_lens_encoder": enc,
@@ -1196,7 +1322,8 @@ class ContinuousBatchingEngine:
                 self.params, self.k_pages, self.v_pages, rows_j,
                 jnp.asarray(self.tables), self.cos_tab, self.sin_tab,
                 self_cfg_id=self.cfg_id,
-                pages_per_step=self.pages_per_step)
+                pages_per_step=self.pages_per_step,
+                kv_scales=self.kv_scales, gather=jnp.asarray(gather))
         if self.draft is not None:
             # mirror the SAME rows through the draft: its paged cache
             # tracks the target's committed stream (prefill chunks
@@ -1207,10 +1334,10 @@ class ContinuousBatchingEngine:
         logits = np.asarray(logits)
 
         produced = 0
-        for kind, s, start, n in metas:
+        for kind, s, gstart, n in metas:
             rid = int(self.slot_rid[s])
             if kind == "verify":
-                take = self._commit_window(s, start, n, logits,
+                take = self._commit_window(s, gstart, n, logits,
                                            props.get(s))
                 this_dec[s] = len(take)
                 produced += len(take)
@@ -1223,13 +1350,14 @@ class ContinuousBatchingEngine:
             if n < len(pend):
                 self.pending_prompt[s] = pend[n:]
                 continue
-            # prompt complete: the chunk's last row carries the
-            # first-token logits; commit full pages to the prefix cache
+            # prompt complete: the chunk's final row (gathered at
+            # ``gstart``) carries the first-token logits; commit full
+            # pages to the prefix cache
             del self.pending_prompt[s]
             self.prefill_order.remove(s)
             if self.prefix_cache is not None:
                 self.prefix_cache.insert(req.prompt, self.slot_pages[s])
-            tok = self._sample_row(logits[start + n - 1], req)
+            tok = self._sample_row(logits[gstart], req)
             self.cur_tok[s] = tok
             self.out_tokens[rid] = [tok]
             self.budget[s] = req.max_new_tokens - 1
@@ -1439,12 +1567,23 @@ class ContinuousBatchingEngine:
         rows = np.zeros((self.rows_cap, 5), np.int32)
         rows[:, 1] = self.trash_page
         rows[:, 4] = -1
+        kv_scales = self.kv_scales
+        if kv_scales is None and self.cache_dtype == jnp.int8:
+            # doctor sweep BEFORE the first admission calibrated: unit
+            # placeholder scales with the post-calibration pytree shape,
+            # so the priced program is the one real traffic runs
+            ones = jnp.ones((self.cfg.num_hidden_layers,
+                             self.cfg.num_key_value_heads), jnp.float32)
+            kv_scales = {"kq": ones, "kdq": ones,
+                         "vq": ones, "vdq": ones}
         fn = ContinuousBatchingEngine._unified_step_jit
         args = (self.params, self.k_pages, self.v_pages,
                 jnp.asarray(rows), jnp.asarray(self.tables),
                 self.cos_tab, self.sin_tab)
         kwargs = dict(self_cfg_id=self.cfg_id,
-                      pages_per_step=self.pages_per_step)
+                      pages_per_step=self.pages_per_step,
+                      kv_scales=kv_scales,
+                      gather=jnp.zeros(self.gather_cap, jnp.int32))
         pool_bytes = min(int(np.prod(k.shape)) * k.dtype.itemsize
                          for k in self.k_pages)
         options = {"donation": {"persistent": (0, 5, 6),

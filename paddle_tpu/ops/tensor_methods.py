@@ -125,6 +125,46 @@ def _install():
     T.scale = _scale
     T.numpy_ = T.numpy
 
+    # ---- round-13 tranche: introspection + apply (reference
+    # tensor_patch_methods: dim/ndimension/element_size and the
+    # python-callable apply pair) ----
+    def _dim(self):
+        """Rank of the tensor (reference paddle.Tensor.dim)."""
+        return int(jnp.ndim(self._value))
+
+    def _element_size(self):
+        """Bytes per element (reference paddle.Tensor.element_size)."""
+        return int(jnp.dtype(self.dtype).itemsize)
+
+    def _apply(self, func):
+        """Return ``func(self)`` as a Tensor (reference
+        paddle.Tensor.apply; like the reference, only allowed on
+        tensors outside the autograd tape)."""
+        if not self.stop_gradient:
+            raise RuntimeError(
+                "apply() can only be used on tensors that do not "
+                "require grad (reference contract)")
+        out = func(self)
+        return out if isinstance(out, Tensor) else Tensor(jnp.asarray(out))
+
+    def _apply_(self, func):
+        """In-place partner of ``apply``: rebinds self's buffer to
+        func's result and returns self."""
+        out = _apply(self, func)
+        self._value = jnp.asarray(
+            out._value if isinstance(out, Tensor) else out
+        ).astype(self._value.dtype)
+        return self
+
+    if not hasattr(T, "dim"):
+        T.dim = _dim
+        T.ndimension = _dim
+    if not hasattr(T, "element_size"):
+        T.element_size = _element_size
+    if not hasattr(T, "apply"):
+        T.apply = _apply
+        T.apply_ = _apply_
+
     # ---- round-7 tranche: elementwise / reduction / indexing methods
     # (VERDICT r5 put the Tensor METHOD surface at 107/385 of the
     # reference's tensor_method_func).  These delegate to the TOP-LEVEL
@@ -190,6 +230,17 @@ def _install():
         # too)
         "asinh", "acosh", "atanh", "i0e", "i1", "i1e", "gammaln",
         "gammainc", "gammaincc", "multigammaln", "swapaxes", "frexp",
+        # ---- round-13 tranche: manipulation/structural methods the
+        # reference also patches (atleast/unstack/pad family), the
+        # remaining linalg method forms, elementwise/compare tail and
+        # the sampling method forms; in-place partners ride
+        # inplace_methods below
+        "atleast_1d", "atleast_2d", "atleast_3d", "unstack", "crop",
+        "pad", "reverse", "increment", "multiplex", "slice",
+        "strided_slice", "one_hot", "eigh", "cholesky_inverse",
+        "matrix_norm", "vector_norm", "pca_lowrank", "floor_mod",
+        "rint", "equal_all", "is_empty", "bernoulli", "poisson",
+        "fill_diagonal_tensor",
     ]
 
     def mk_top(opname):
@@ -238,6 +289,12 @@ def _install():
         "logical_xor_", "bitwise_and_", "bitwise_or_", "bitwise_xor_",
         "bitwise_left_shift_", "bitwise_right_shift_", "gammaln_",
         "gammainc_", "gammaincc_", "multigammaln_",
+        # round-13 tranche: the remaining in-place forms — sampling
+        # fills (uniform_ closes the standing exemption), the diagonal
+        # fills, and the transform partners whose bases shipped earlier
+        "uniform_", "exponential_", "cauchy_", "fill_diagonal_",
+        "fill_diagonal_tensor_", "addmm_", "floor_mod_", "sinc_",
+        "polygamma_", "t_",
     ]
     def mk_in(opname):
         def method(self, *args, **kwargs):

@@ -1,12 +1,19 @@
-"""Fault-injection harness for the elastic resilience engine (round-12).
+"""Fault-injection harness for the elastic resilience engine (round-12)
+and the serving resilience plane (round-13).
 
 Drives ``paddle_tpu.distributed.resilience.resilient_train_loop`` end to
 end in ONE process on the fake 8-device CPU mesh: ``FakeCluster`` is a
 ``ClusterView`` whose schedule kills/hangs/slows workers and flips the
 simulated device count at controlled step boundaries — the tier-1 stand-
-in for a preemptible fleet.  Used by tests/test_resilience.py and the
-``elastic_recovery`` bench smoke leg (bench.py imports this module by
-path), so keep it import-light: no pytest at module scope.
+in for a preemptible fleet.  Round-13 adds the SERVING side:
+``FakeReplica`` is a fleet ``Replica`` whose scripted schedule
+kills/preempts/hangs/slows its engine step at controlled replica-step
+boundaries, and ``OverloadBurst`` + ``run_fleet_trace`` drive scripted
+traffic storms through the ``FleetRouter``.  Used by
+tests/test_resilience.py, tests/test_serving_fleet.py and the
+``elastic_recovery``/``router_parity``/``replica_recovery`` bench smoke
+legs (bench.py imports this module by path), so keep it import-light:
+no pytest at module scope.
 
 Fault kinds (``FaultEvent.kind``):
 
@@ -180,3 +187,188 @@ def run_toy_loop(tmpdir: str, num_steps: int = 12, *,
         step_builder=toy_step_builder, data_fn=toy_target,
         num_steps=num_steps, config=cfg, cluster=cluster, **kw)
     return res, cluster
+
+
+# ===========================================================================
+# Round-13: serving-side fault injection (FakeReplica + overload bursts)
+# ===========================================================================
+#
+# The serving analog of FakeCluster: a fleet Replica whose scripted
+# schedule fires at its OWN step boundaries.  ``kill`` raises BEFORE the
+# engine step (tokens since the router's last harvest are lost — the
+# router must replay them from its committed prefix), ``preempt`` is the
+# graceful advance notice, ``hang``/``slow`` stall INSIDE the watchdog
+# window (a hang past step_timeout_s gets flagged by the scanner and the
+# replica raises ReplicaHung; a slow stall under it must ride through
+# with no recovery event).  Events are consumed exactly once.
+
+import time as _time
+
+from paddle_tpu.distributed.resilience import (ReplicaKilled,
+                                               ReplicaPreempted)
+from paddle_tpu.inference.fleet import (FleetConfig, FleetRouter,
+                                        OverloadRejected, Replica,
+                                        ReplicaSet, RouterConfig)
+
+
+@dataclass
+class ReplicaFaultEvent:
+    step: int                    # the replica's OWN completed-step count
+    kind: str                    # kill | preempt | hang | slow
+    stall_s: float = 0.0         # for hang/slow
+
+
+@dataclass
+class OverloadBurst:
+    """A scripted traffic storm: ``n_requests`` uniform requests
+    submitted per router tick for ``duration`` consecutive ticks —
+    enough sustained pressure to walk the degradation ladder through
+    its stages (a single-tick spike only fills the queue once)."""
+
+    tick: int
+    n_requests: int
+    duration: int = 1
+    prompt_len: int = 24
+    max_new_tokens: int = 4
+
+
+class FakeReplica(Replica):
+    """Scripted fleet replica (see module docstring)."""
+
+    def __init__(self, replica_id, engine_factory, step_timeout_s=0.0,
+                 script=(), sleep=_time.sleep):
+        super().__init__(replica_id, engine_factory,
+                         step_timeout_s=step_timeout_s)
+        self._script: Dict[int, List[ReplicaFaultEvent]] = {}
+        for ev in script:
+            self._script.setdefault(ev.step, []).append(ev)
+        self._sleep = sleep
+        self.fired: List[ReplicaFaultEvent] = []
+
+    def _engine_step(self):
+        stall = 0.0
+        for ev in self._script.pop(self.steps, []):
+            self.fired.append(ev)
+            if ev.kind == "kill":
+                raise ReplicaKilled(
+                    f"injected kill at replica step {self.steps}")
+            if ev.kind == "preempt":
+                raise ReplicaPreempted(
+                    f"injected preemption at replica step {self.steps}")
+            if ev.kind in ("hang", "slow"):
+                stall += ev.stall_s
+                continue
+            raise AssertionError(f"unknown replica fault kind {ev.kind!r}")
+        if stall:
+            # the stall sits INSIDE the comm_watch window Replica.step
+            # opened — exactly where the watchdog scanner looks
+            self._sleep(stall)
+        return self.engine.step()
+
+
+def toy_llama(seed: int = 20240806):
+    """The tiny deterministic llama the serving tests share (explicit
+    seed save/restore — the module-fixture flake rule): returns
+    (cfg, model, HOST params) — host numpy weights so replica delivery
+    actually moves bytes through the reshard plan."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    state = paddle.get_rng_state()
+    paddle.seed(seed)
+    cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
+                            kv_heads=2, inter=64, max_pos=128)
+    model = LlamaForCausalLM(cfg)
+    params = {k: np.asarray(v) for k, v in model.functional_state().items()}
+    paddle.set_rng_state(state)
+    return cfg, model, params
+
+
+def build_serving_fleet(cfg, params_host, *, target=2, scripts=None,
+                        step_timeout_s=0.0, engine_kwargs=None,
+                        router_cfg=None, clock=None,
+                        max_transient_bytes=64 << 20, sleep=_time.sleep):
+    """A FleetRouter over FakeReplicas.  ``scripts`` maps replica id
+    (spawn order: 0, 1, ... — replacements continue the sequence) to
+    its ReplicaFaultEvent list.  ``engine_kwargs`` override the tiny
+    default engine geometry; ``self_draft=True`` turns on oracle
+    self-draft speculative decoding (draft_params = the replica's own
+    delivered params)."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    ekw = dict(max_slots=2, num_pages=33, page_size=16, max_seq_len=128,
+               prefill_token_budget=16, enable_prefix_cache=True)
+    ekw.update(engine_kwargs or {})
+    scripts = scripts or {}
+
+    def engine_factory(params):
+        kw = dict(ekw)
+        if kw.pop("self_draft", False):
+            kw["draft_params"] = params
+        return ContinuousBatchingEngine(cfg, params, **kw)
+
+    def replica_factory(rid, engine_factory, step_timeout_s=0.0):
+        return FakeReplica(rid, engine_factory,
+                           step_timeout_s=step_timeout_s,
+                           script=scripts.get(rid, ()), sleep=sleep)
+
+    rs = ReplicaSet(
+        params_host, engine_factory,
+        FleetConfig(target_replicas=target,
+                    step_timeout_s=step_timeout_s,
+                    max_transient_bytes=max_transient_bytes),
+        replica_factory=replica_factory)
+    kw = {} if clock is None else {"clock": clock}
+    router = FleetRouter(rs, router_cfg
+                         or RouterConfig(admission_token_cap=64), **kw)
+    return router, rs
+
+
+def run_fleet_trace(router, requests, bursts=(), *, seed=0,
+                    max_iters=2000, vocab=64):
+    """Deterministic trace driver shared by tests and the bench leg:
+    ``requests`` is a list of (tick, prompt, max_new_tokens) submitted
+    at their tick; ``bursts`` expand into uniform submissions.  Rejected
+    submissions (the ladder's stage-3 signal) are COUNTED, never
+    retried.  Returns per-token latency samples, the rejection count and
+    the rid list so callers can assert zero loss + parity."""
+    rng = np.random.default_rng(seed)
+    by_tick: Dict[int, list] = {}
+    for t, prompt, mnew in requests:
+        by_tick.setdefault(int(t), []).append((prompt, mnew))
+    burst_by_tick: Dict[int, list] = {}
+    for b in bursts:
+        for t in range(b.tick, b.tick + b.duration):
+            burst_by_tick.setdefault(t, []).append(b)
+    submitted, rejected, lat = [], 0, []
+    tick = 0
+    while True:
+        for prompt, mnew in by_tick.pop(tick, []):
+            try:
+                submitted.append((router.submit(prompt,
+                                                max_new_tokens=mnew),
+                                  prompt, mnew))
+            except OverloadRejected:
+                rejected += 1
+        for b in burst_by_tick.pop(tick, []):
+            for _ in range(b.n_requests):
+                p = rng.integers(1, vocab,
+                                 (b.prompt_len,)).astype(np.int32)
+                try:
+                    submitted.append((router.submit(
+                        p, max_new_tokens=b.max_new_tokens), p,
+                        b.max_new_tokens))
+                except OverloadRejected:
+                    rejected += 1
+        t0 = _time.perf_counter()
+        produced = router.step()
+        dt = _time.perf_counter() - t0
+        if produced:
+            lat.extend([dt / produced] * produced)
+        tick += 1
+        if not by_tick and not burst_by_tick and not router.pending():
+            break
+        if tick > max_iters:
+            raise RuntimeError("fleet trace did not drain")
+    return {"rids": [s[0] for s in submitted], "submitted": submitted,
+            "rejected": rejected, "per_token_lat": lat, "ticks": tick}

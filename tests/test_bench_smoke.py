@@ -45,6 +45,13 @@ def test_bench_smoke_green():
                 # bit-parity under a bounded transient cap + MEM001
                 # budget, and a fault-injected kill recovering to a
                 # loss-parity resume within the replay budget
-                "reshard_parity", "elastic_recovery"):
+                "reshard_parity", "elastic_recovery",
+                # round-13: serving resilience — a scripted mid-decode
+                # replica kill loses zero requests with bit-identical
+                # greedy streams (router_parity), and the replacement
+                # replica's weights arrive through the cached
+                # MEM001-budgeted reshard plan within one router tick
+                # (replica_recovery)
+                "router_parity", "replica_recovery"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]

@@ -579,3 +579,129 @@ def test_unified_teardown_catches_leaks(tiny_model):
     assert leaked is not None
     with pytest.raises(AssertionError, match="leak"):
         eng.shutdown()
+
+
+# =====================================================================
+# Round-13: int8 KV cache on the unified path + request withdrawal
+# =====================================================================
+
+
+def test_unified_int8_kv_cache_close_to_bf16(tiny_model):
+    """int8 KV cache on the UNIFIED plane (the PR-6 follow-up): the
+    first admission runs the calibration pass the legacy chunked path
+    already had (absmax per (layer, kv head), 2x headroom, frozen), the
+    ragged step quantizes every scattered K/V row with those scales,
+    and the greedy streams must mostly agree with the fp-cache engine
+    (parity under tolerance — int8 may flip rare near-ties)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (6, 11)]
+
+    outs = {}
+    for dt in (None, jnp.int8):
+        eng = _unified(cfg, params, cache_dtype=dt)
+        if dt == jnp.int8:
+            # the doctor entry must be traceable BEFORE calibration
+            # (placeholder unit scales with the real pytree shape)
+            from paddle_tpu.analysis import check
+
+            fn, args, kwargs, options = eng.analysis_entry()
+            assert check(fn, *args, kwargs=kwargs, options=options).ok
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=8)
+        done = eng.run()
+        outs[dt] = {f.rid: f.tokens for f in done}
+        if dt == jnp.int8:
+            assert all(kp.dtype == jnp.int8 for kp in eng.k_pages)
+            assert eng.kv_scales is not None
+            # the FLOPs-skip contract still holds under int8
+            stats = eng.serving_stats()["prefill"]
+            assert all(v["prefilled"] == v["prompt_len"]
+                       for v in stats.values())
+        eng.shutdown()
+
+    assert sorted(outs[None]) == sorted(outs[jnp.int8])
+    match = sum(
+        (np.asarray(a[:len(b)]) == np.asarray(b[:len(a)])).mean()
+        for a, b in ((outs[None][r], outs[jnp.int8][r])
+                     for r in sorted(outs[None]))) / len(prompts)
+    assert match > 0.7, (outs, match)
+
+
+@pytest.mark.slow
+def test_unified_int8_kv_prefix_cache_consistent(tiny_model):
+    """int8 KV + prefix cache: shared pages hold int8 quantized with
+    the SAME frozen scales, so a warm request's stream equals the cold
+    one's bit-for-bit (the cache serves self-consistent quantized
+    pages, not a re-quantization)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(24)
+    sysp = rng.integers(1, cfg.vocab_size, (16,)).astype(np.int32)
+    body = rng.integers(1, cfg.vocab_size, (6,)).astype(np.int32)
+    prompt = np.concatenate([sysp, body])
+    eng = _unified(cfg, params, cache_dtype=jnp.int8,
+                   enable_prefix_cache=True)
+    eng.add_request(prompt, max_new_tokens=6)          # cold
+    for _ in range(3):                     # commit the cold full pages
+        eng.step()
+    eng.add_request(prompt.copy(), max_new_tokens=6)   # warm (hit)
+    done = eng.run()
+    assert eng.prefix_cache.hits >= 1
+    np.testing.assert_array_equal(done[0].tokens, done[1].tokens)
+    eng.shutdown()
+
+
+def test_unified_cancel_withdraws_without_finished(tiny_model):
+    """engine.cancel (the router's migration/retry primitive): a
+    queued request leaves the queue, an active one releases its slot
+    and pages, NO Finished record is written, the survivor's stream is
+    untouched, and teardown stays leak-free."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(25)
+    p0 = rng.integers(1, cfg.vocab_size, (7,)).astype(np.int32)
+    p1 = rng.integers(1, cfg.vocab_size, (9,)).astype(np.int32)
+    p2 = rng.integers(1, cfg.vocab_size, (5,)).astype(np.int32)
+    eng = _unified(cfg, params, max_slots=2)
+    r0 = eng.add_request(p0, max_new_tokens=8)
+    r1 = eng.add_request(p1, max_new_tokens=8)
+    r2 = eng.add_request(p2, max_new_tokens=8)   # waits in queue
+    eng.step()
+    eng.step()                                   # r0/r1 mid-decode
+    assert eng.cancel(r2) is True                # queued withdrawal
+    assert eng.cancel(r0) is True                # active withdrawal
+    assert eng.cancel(999) is False              # unknown rid
+    done = eng.run()
+    assert [f.rid for f in done] == [r1]
+    ref = generate(model, p1[None], max_new_tokens=8, do_sample=False)
+    ref_new = np.asarray(ref._value if hasattr(ref, "_value") else ref
+                         )[0, len(p1):]
+    np.testing.assert_array_equal(done[0].tokens, ref_new)
+    eng.shutdown()                               # leak check passes
+
+
+def test_unified_throttle_sheds_and_restores(tiny_model):
+    """throttle(): spec_k/prefill budget shrink at runtime (no
+    retrace, greedy parity intact) and restore to the constructor
+    shapes; out-of-range values are rejected."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(26)
+    p = rng.integers(1, cfg.vocab_size, (21,)).astype(np.int32)
+    eng = _unified(cfg, params, draft_params=params, speculative_k=2)
+    eng.throttle(speculative_k=0, prefill_token_budget=4)
+    assert eng.spec_k == 0 and eng.prefill_budget == 4
+    eng.add_request(p, max_new_tokens=6)
+    done = eng.run()
+    ref = generate(model, p[None], max_new_tokens=6, do_sample=False)
+    ref_new = np.asarray(ref._value if hasattr(ref, "_value") else ref
+                         )[0, len(p):]
+    np.testing.assert_array_equal(done[0].tokens, ref_new)
+    eng.throttle(speculative_k=2, prefill_token_budget=16)
+    assert eng.spec_k == 2 and eng.prefill_budget == 16
+    with pytest.raises(ValueError):
+        eng.throttle(speculative_k=3)            # above the static cap
+    with pytest.raises(ValueError):
+        eng.throttle(prefill_token_budget=0)     # below the floor
+    with pytest.raises(ValueError):
+        eng.throttle(prefill_token_budget=32)    # above the static cap
+    eng.shutdown()

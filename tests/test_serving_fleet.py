@@ -1,0 +1,576 @@
+"""Serving resilience plane (round-13 tentpole): replica fleet manager,
+SLO-aware router, request-level fault tolerance.
+
+The acceptance contract these tests pin:
+
+- under a scripted trace with replica kill/hang/slow and an overload
+  burst, ZERO requests are lost, every greedy completion is
+  BIT-IDENTICAL to an unfaulted run, and the degradation ladder engages
+  IN ORDER (shed speculation → shrink prefill → reject) — asserted, not
+  logged;
+- the replica weight-delivery plan is built once per topology, streamed
+  per replica, and passes check_reshard_budget (the seeded over-budget
+  fixture MEM001[replica_delivery] rides tests/test_analysis_passes.py's
+  SEEDED sweep);
+- router edge cases: admission at EXACTLY the token budget,
+  retry-after-timeout idempotence (no duplicate emitted tokens),
+  drain-with-in-flight completes before removal.
+"""
+
+import numpy as np
+import pytest
+
+from fault_injection import (OverloadBurst, ReplicaFaultEvent,
+                             build_serving_fleet, run_fleet_trace,
+                             toy_llama)
+from paddle_tpu.inference.fleet import (DRAINING, REMOVED,
+                                        OverloadRejected, RouterConfig)
+from paddle_tpu.models.generation import generate
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    return toy_llama()
+
+
+def _refs(model, prompts, n):
+    outs = []
+    for p in prompts:
+        ref = generate(model, p[None], max_new_tokens=n, do_sample=False)
+        outs.append(np.asarray(ref._value if hasattr(ref, "_value")
+                               else ref)[0, len(p):])
+    return outs
+
+
+def _prompts(rng, lens, shared=None):
+    out = []
+    for n in lens:
+        body = rng.integers(1, 64, (n,)).astype(np.int32)
+        out.append(np.concatenate([shared, body])
+                   if shared is not None else body)
+    return out
+
+
+class _Clock:
+    """Deterministic router clock for the deadline/backoff tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# =====================================================================
+# fleet manager: lifecycle + weight delivery
+# =====================================================================
+
+
+def test_delivery_plan_once_per_topology_stream_per_replica(tiny_model):
+    """The redistribution plan is built ONCE and re-executed per
+    replica (spawn + replacement), and it passes the doctor's MEM001
+    budget (check_reshard_budget) under the fleet's declared cap."""
+    cfg, model, params = tiny_model
+    router, rs = build_serving_fleet(cfg, params, target=2)
+    assert rs.telemetry["plans_built"] == 1
+    assert rs.telemetry["deliveries"] == 2
+    plan = rs.delivery_plan()
+    assert plan.moved_bytes > 0            # host weights really move
+    rep = rs.check_delivery_budget()
+    assert rep.ok, [str(f) for f in rep.findings]
+    # a replacement spawn re-executes the SAME cached plan
+    rs.spawn()
+    assert rs.telemetry["plans_built"] == 1
+    assert rs.telemetry["deliveries"] == 3
+
+
+@pytest.mark.slow
+def test_fleet_router_parity_no_fault(tiny_model):
+    """Baseline: requests routed across 2 replicas reproduce one-shot
+    generate() greedy output exactly.  Tier-2: the same parity bar is
+    held tier-1 by the kill/migration test (a superset) and the
+    router_parity smoke leg."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(100)
+    prompts = _prompts(rng, (5, 9, 17, 7))
+    router, rs = build_serving_fleet(cfg, params, target=2)
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    out = router.run()
+    assert sorted(out) == sorted(rids)
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 6)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])
+        assert len(out[rid]) == 6
+
+
+def test_prefix_affinity_pins_shared_prompt(tiny_model):
+    """Requests sharing a full-page system prompt route to ONE replica
+    (the trie warms once per replica, not per request): the pinned
+    replica's prefix cache records the hits."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(101)
+    sysp = rng.integers(1, 64, (16,)).astype(np.int32)   # one full page
+    # bodies of DIFFERENT lengths, incl. one spanning an extra full
+    # page: the affinity key is the first page only, so body length
+    # must not split the pin
+    prompts = _prompts(rng, (5, 7, 9, 20), shared=sysp)
+    router, rs = build_serving_fleet(
+        cfg, params, target=2,
+        router_cfg=RouterConfig(admission_token_cap=256))
+    rids = [router.submit(prompts[0], max_new_tokens=4)]
+    for _ in range(3):                     # warm the pinned trie
+        router.step()
+    rids += [router.submit(p, max_new_tokens=4) for p in prompts[1:]]
+    out = router.run()
+    assert sorted(out) == sorted(rids)
+    served = sorted(len(r.engine.prefill_stats) for r in rs.live())
+    assert served == [0, 4], served        # ONE replica took all four
+    hits = sorted(r.engine.prefix_cache.stats()["hits"]
+                  for r in rs.live())
+    # the three later arrivals hit the trie the first request warmed
+    assert hits == [0, 3], hits
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 4)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])
+
+
+# =====================================================================
+# request migration on failure
+# =====================================================================
+
+
+def test_kill_migrates_and_stays_bit_identical(tiny_model):
+    """Replica 0 dies mid-decode: its in-flight requests re-enqueue on
+    survivors, replay from prompt + committed tokens, and the final
+    greedy streams are bit-identical to the unfaulted references."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(102)
+    prompts = _prompts(rng, (5, 9, 17, 7))
+    router, rs = build_serving_fleet(
+        cfg, params, target=2,
+        scripts={0: [ReplicaFaultEvent(step=2, kind="kill")]})
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    out = router.run()
+    assert sorted(out) == sorted(rids)          # zero requests lost
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 6)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])],
+                                      err_msg=f"rid {rid} corrupted by "
+                                              f"migration")
+        assert len(out[rid]) == 6               # no duplicates either
+    recs = router.telemetry["recoveries"]
+    assert [ev.fault for ev in recs] == ["ReplicaKilled"]
+    assert recs[0].migrated_requests >= 1
+    assert recs[0].replacement_id is not None
+    assert recs[0].recovery_ticks == 0          # respawn same tick
+    assert rs.telemetry["deaths"] == {"ReplicaKilled": 1}
+    assert rs.telemetry["spawns"] == 3          # 2 initial + replacement
+
+
+def test_hang_flagged_by_watchdog_and_migrated(tiny_model):
+    """A stall past step_timeout_s inside the watch window: the
+    watchdog scanner flags the step, the replica raises ReplicaHung,
+    the suspect step's output is discarded and the requests replay
+    elsewhere — still bit-identical."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(103)
+    prompts = _prompts(rng, (6, 11, 8))
+    router, rs = build_serving_fleet(
+        cfg, params, target=2, step_timeout_s=0.1,
+        scripts={1: [ReplicaFaultEvent(step=1, kind="hang",
+                                       stall_s=0.5)]})
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    out = router.run()
+    assert sorted(out) == sorted(rids)
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 6)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])
+        assert len(out[rid]) == 6
+    assert [ev.fault for ev in router.telemetry["recoveries"]] \
+        == ["ReplicaHung"]
+
+
+@pytest.mark.slow
+def test_slow_rides_through_without_recovery(tiny_model):
+    """A stall UNDER the step timeout is absorbed: no recovery event,
+    no migration, full parity.  Tier-2: the acceptance trace holds the
+    same property tier-1 (its scripted slow event must produce NO
+    recovery — faults are asserted to be exactly the kill + hang)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(104)
+    prompts = _prompts(rng, (6, 9))
+    router, rs = build_serving_fleet(
+        cfg, params, target=2, step_timeout_s=5.0,
+        scripts={0: [ReplicaFaultEvent(step=1, kind="slow",
+                                       stall_s=0.02)]})
+    rids = [router.submit(p, max_new_tokens=4) for p in prompts]
+    out = router.run()
+    assert sorted(out) == sorted(rids)
+    assert not router.telemetry["recoveries"]
+    assert router.telemetry["migrations"] == 0
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 4)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])
+
+
+def test_preempt_graceful_migration(tiny_model):
+    """Advance notice: the preempted replica's requests migrate inside
+    the grace window with zero loss, and the fleet respawns to
+    target."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(105)
+    prompts = _prompts(rng, (5, 13))
+    router, rs = build_serving_fleet(
+        cfg, params, target=2,
+        scripts={1: [ReplicaFaultEvent(step=2, kind="preempt")]})
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    out = router.run()
+    assert sorted(out) == sorted(rids)
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 6)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])
+    assert [ev.fault for ev in router.telemetry["recoveries"]] \
+        == ["ReplicaPreempted"]
+    assert len(rs.serving()) == 2
+
+
+# =====================================================================
+# router edge cases
+# =====================================================================
+
+
+def test_admission_at_exactly_full_token_budget(tiny_model):
+    """A request landing EXACTLY at admission_token_cap is admitted;
+    one token over stays queued."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(106)
+    p = rng.integers(1, 64, (8,)).astype(np.int32)      # footprint 16
+    router, rs = build_serving_fleet(
+        cfg, params, target=1,
+        router_cfg=RouterConfig(admission_token_cap=16))
+    r0 = router.submit(p, max_new_tokens=8)
+    r1 = router.submit(p.copy(), max_new_tokens=8)
+    router.step()
+    assigned = sum(len(m) for m in router._assigned.values())
+    assert assigned == 1                  # exactly-at-cap admitted
+    assert len(router.queue) == 1         # the second waits for capacity
+    out = router.run()                    # capacity frees as r0 finishes
+    assert sorted(out) == [r0, r1]
+
+    # one token over the cap can NEVER dispatch: submit rejects it
+    # with the typed livelock guard instead of queueing it forever
+    router2, _ = build_serving_fleet(
+        cfg, params, target=1,
+        router_cfg=RouterConfig(admission_token_cap=15))
+    with pytest.raises(ValueError, match="admission_token_cap"):
+        router2.submit(p, max_new_tokens=8)
+    assert len(router2.queue) == 0
+
+
+def test_retry_after_timeout_is_idempotent(tiny_model):
+    """A request whose assignment outlives its SLO deadline is
+    withdrawn (engine.cancel — no Finished record) and retried after a
+    jittered backoff; committed tokens survive, so the final stream has
+    NO duplicates and stays bit-identical."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(107)
+    p = rng.integers(1, 64, (6,)).astype(np.int32)
+    clock = _Clock()
+    router, rs = build_serving_fleet(cfg, params, target=2, clock=clock)
+    rid = router.submit(p, max_new_tokens=6, timeout_s=50.0)
+    for _ in range(3):                     # dispatch + a few tokens
+        clock.t += 1.0
+        router.step()
+    req = router.requests[rid]
+    committed_before = list(req.emitted)
+    assert 0 < len(committed_before) < 6   # genuinely mid-decode
+    clock.t += 100.0                       # blow the deadline
+    router.step()                          # harvest, then withdraw
+    assert router.telemetry["retries"] == 1
+    assert req.replica is None and not req.done
+    # committed tokens kept (the tick's harvest may add one more
+    # BEFORE the withdrawal — commits only ever extend)
+    assert req.emitted[:len(committed_before)] == committed_before
+    assert len(req.emitted) < 6
+    clock.t += 10.0                        # clear the backoff gate
+    out = router.run()
+    ref = _refs(model, [p], 6)[0]
+    np.testing.assert_array_equal(out[rid], ref)   # no dupes, no gaps
+    assert len(out[rid]) == 6
+    # the withdrawn engine copy left no Finished record behind
+    assert router.telemetry["completed"] == 1
+
+
+def test_drain_with_in_flight_completes_before_removal(tiny_model):
+    """drain(): no new admissions, in-flight requests COMPLETE on the
+    draining replica (zero migrations), and removal happens only after
+    its last request finished — through the engine's leak-checked
+    shutdown."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(108)
+    prompts = _prompts(rng, (6, 9, 7, 11))
+    router, rs = build_serving_fleet(cfg, params, target=2)
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    router.step()                          # dispatch across both
+    victim = next(r for r in rs.live()
+                  if router._assigned.get(r.id))
+    drained_rids = [req.rid
+                    for req in router._assigned[victim.id].values()]
+    assert drained_rids                    # it really has in-flight work
+    router.drain(victim.id)
+    assert victim.state == DRAINING
+    out = router.run()
+    assert sorted(out) == sorted(rids)
+    assert victim.state == REMOVED
+    assert router.telemetry["migrations"] == 0   # completed in place
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 6)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])
+    # target respawned around the drained replica
+    assert len(rs.serving()) == 2
+
+
+# =====================================================================
+# degradation ladder + the flagship fault trace
+# =====================================================================
+
+
+@pytest.mark.slow
+def test_overload_ladder_engages_in_order(tiny_model):
+    """Sustained pressure walks the ladder ONE stage per tick — shed
+    speculation (spec_k -> 0), shrink the prefill chunk budget, then
+    reject with a typed error — and de-escalates as the queue drains,
+    restoring the constructor knobs.  Tier-2 (heavy deterministic
+    sweep): the ladder-ORDER acceptance gate stays tier-1 via
+    test_fault_trace_end_to_end; this adds the mid-run engine-knob and
+    restore-on-de-escalation assertions over a longer drain."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(109)
+    router, rs = build_serving_fleet(
+        cfg, params, target=1,
+        engine_kwargs={"self_draft": True, "speculative_k": 2},
+        router_cfg=RouterConfig(admission_token_cap=48))
+    eng = rs.serving()[0].engine
+    assert eng.spec_k == 2 and eng.prefill_budget == 16
+    seen_stages = []
+    rejected = 0
+    rids = []
+    for tick in range(150):
+        if tick < 6:                        # the sustained burst
+            for _ in range(3):
+                p = rng.integers(1, 64, (20,)).astype(np.int32)
+                try:
+                    rids.append(router.submit(p, max_new_tokens=4))
+                except OverloadRejected:
+                    rejected += 1
+        router.step()
+        seen_stages.append(router.stage)
+        live = rs.serving()
+        if live:
+            e = live[0].engine
+            if router.stage >= 1:
+                assert e.spec_k == 0        # speculation shed FIRST
+            if router.stage >= 2:
+                assert e.prefill_budget == 8   # then prefill shrunk
+        if not router.pending() and tick > 8:
+            break
+    # the ladder engaged strictly in order (one stage per tick)
+    log = router.telemetry["ladder_log"]
+    ups = [(ev["from"], ev["to"]) for ev in log
+           if ev["to"] > ev["from"]]
+    assert ups[:3] == [(0, 1), (1, 2), (2, 3)], log
+    assert 3 in seen_stages
+    assert rejected > 0                    # explicit overload signal
+    assert router.telemetry["rejected"] == rejected
+    # pressure cleared: stages walk back down (one per tick, same as
+    # the way up) and the constructor knobs are restored
+    for _ in range(5):
+        router.step()
+    assert router.stage == 0
+    e = rs.serving()[0].engine
+    assert e.spec_k == 2 and e.prefill_budget == 16
+    # every ACCEPTED request completed — no silent loss under overload
+    out = router.results()
+    assert sorted(out) == sorted(rids)
+
+
+def test_fault_trace_end_to_end(tiny_model):
+    """The acceptance trace: kill + hang + slow + an overload burst in
+    ONE run — zero accepted requests lost, greedy completions
+    bit-identical to unfaulted references, ladder engaged in order,
+    recovery telemetry recorded."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(110)
+    sysp = rng.integers(1, 64, (16,)).astype(np.int32)
+    named = _prompts(rng, (5, 9, 13), shared=sysp) \
+        + _prompts(rng, (7, 11))
+    requests = [(t, p, 6) for t, p in enumerate(named)]
+    router, rs = build_serving_fleet(
+        cfg, params, target=2, step_timeout_s=0.3,
+        scripts={0: [ReplicaFaultEvent(step=3, kind="kill")],
+                 1: [ReplicaFaultEvent(step=2, kind="slow",
+                                       stall_s=0.01),
+                     ReplicaFaultEvent(step=5, kind="hang",
+                                       stall_s=0.8)]},
+        router_cfg=RouterConfig(admission_token_cap=48))
+    res = run_fleet_trace(
+        router, requests,
+        bursts=[OverloadBurst(tick=2, n_requests=4, duration=5,
+                              prompt_len=20, max_new_tokens=4)],
+        seed=110)
+    out = router.results()
+    # ZERO accepted requests lost
+    assert sorted(out) == sorted(res["rids"])
+    # bit-identical to the unfaulted run, request by request
+    for rid, prompt, mnew in res["submitted"]:
+        ref = _refs(model, [prompt], mnew)[0]
+        np.testing.assert_array_equal(
+            out[rid], ref[:len(out[rid])],
+            err_msg=f"rid {rid} diverged under faults")
+        assert len(out[rid]) == mnew
+    # both scripted deaths happened and were recovered
+    faults = sorted(ev.fault for ev in router.telemetry["recoveries"])
+    assert faults == ["ReplicaHung", "ReplicaKilled"]
+    # the burst shed load explicitly (ladder top stage) and in order
+    assert res["rejected"] > 0
+    ups = [(ev["from"], ev["to"])
+           for ev in router.telemetry["ladder_log"]
+           if ev["to"] > ev["from"]]
+    assert ups[:3] == [(0, 1), (1, 2), (2, 3)]
+    # fleet healed back to target
+    assert len(rs.serving()) == 2
+
+
+@pytest.mark.slow
+def test_serving_fleet_trace_full():
+    """Tier-2 (heavy deterministic sweep, per the ROADMAP tiering
+    policy): the FULL bench.py --serving-fleet-trace leg — 12 named
+    requests + an 8-tick burst + kill/hang — must pass all its gates
+    (zero loss, bit parity, ladder order, MEM001-budgeted delivery)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    res = bench.serving_fleet_trace(smoke=False)
+    assert res["ok"], res
+    assert res["lost"] == 0 and res["bit_identical"]
+    assert res["shed_rate"] > 0
+
+
+def test_raw_engine_error_is_replica_death_not_fleet_death(tiny_model):
+    """Any exception out of a replica's engine (not just the typed
+    ReplicaFault family) is that REPLICA's death: requests migrate and
+    complete bit-identically, the fleet heals, the router survives."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(111)
+    prompts = _prompts(rng, (6, 9))
+    router, rs = build_serving_fleet(cfg, params, target=2)
+    rids = [router.submit(p, max_new_tokens=4) for p in prompts]
+    router.step()                          # dispatch
+    victim = next(r for r in rs.live() if router._assigned.get(r.id))
+
+    def boom():
+        raise RuntimeError("XLA device lost (simulated)")
+
+    victim._engine_step = boom
+    out = router.run()
+    assert sorted(out) == sorted(rids)
+    assert [ev.fault for ev in router.telemetry["recoveries"]] \
+        == ["RuntimeError"]
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 4)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])
+    assert len(rs.serving()) == 2
+
+
+def test_ladder_clamps_to_engine_static_prefill_budget(tiny_model):
+    """Stage-2 shed on an engine whose constructor prefill budget is
+    BELOW the router's min_prefill_budget floor clamps to the engine's
+    own static shape instead of raising out of the router tick."""
+    cfg, model, params = tiny_model
+    router, rs = build_serving_fleet(
+        cfg, params, target=1,
+        engine_kwargs={"prefill_token_budget": 2})
+    eng = rs.serving()[0].engine
+    router._set_stage(2, 9.9)              # would floor at 4 unclamped
+    assert eng.prefill_budget == 2         # clamped to the static shape
+    router._set_stage(0, 0.0)
+    assert eng.prefill_budget == 2
+
+
+def test_warmup_does_not_calibrate_int8(tiny_model):
+    """The WARMING dummy request must not freeze the one-shot int8 K/V
+    scales: the first REAL admission calibrates on real activations."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.fleet import Replica
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    cfg, model, params = tiny_model
+    jparams = {k: jnp.asarray(v) for k, v in params.items()}
+    rep = Replica(0, lambda p: ContinuousBatchingEngine(
+        cfg, p, max_slots=2, num_pages=33, page_size=16, max_seq_len=128,
+        prefill_token_budget=16, cache_dtype=jnp.int8))
+    rep.warm(jparams)
+    assert rep.engine.kv_scales is None    # dummy scales dropped
+    rng = np.random.default_rng(112)
+    rep.engine.add_request(rng.integers(1, 64, (9,)).astype(np.int32),
+                           max_new_tokens=4)
+    done_tokens = rep.engine.run()
+    assert rep.engine.kv_scales is not None   # real prompt calibrated
+    assert len(done_tokens) == 1
+    rep.engine.shutdown()
+
+
+def test_submit_rejects_undispatchable_footprint(tiny_model):
+    """A request whose prompt+generation footprint can NEVER fit the
+    per-replica admission cap is rejected at submit with a typed error
+    instead of livelocking at the head of the queue."""
+    cfg, model, params = tiny_model
+    router, rs = build_serving_fleet(
+        cfg, params, target=1,
+        router_cfg=RouterConfig(admission_token_cap=32))
+    with pytest.raises(ValueError, match="admission_token_cap"):
+        router.submit(np.arange(1, 30, dtype=np.int32),
+                      max_new_tokens=8)       # footprint 37 > 32
+
+
+def test_spawn_failure_is_retried_not_fatal(tiny_model):
+    """A replacement replica whose spawn/warm raises must not crash
+    the router tick: the failure is counted, the survivor keeps
+    serving, and the NEXT tick's respawn heals the fleet."""
+    from paddle_tpu.inference.fleet import (FleetConfig, FleetRouter,
+                                            ReplicaSet, RouterConfig)
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from fault_injection import FakeReplica, ReplicaFaultEvent
+
+    cfg, model, params = tiny_model
+    fail_ids = {2}                         # the FIRST replacement only
+
+    def factory(p):
+        return ContinuousBatchingEngine(
+            cfg, p, max_slots=2, num_pages=33, page_size=16,
+            max_seq_len=128, prefill_token_budget=16,
+            enable_prefix_cache=True)
+
+    def replica_factory(rid, engine_factory, step_timeout_s=0.0):
+        script = ([ReplicaFaultEvent(step=2, kind="kill")]
+                  if rid == 0 else ())
+        rep = FakeReplica(rid, engine_factory,
+                          step_timeout_s=step_timeout_s, script=script)
+        if rid in fail_ids:
+            fail_ids.discard(rid)
+            orig_warm = rep.warm
+
+            def bad_warm(params):
+                raise RuntimeError("replacement warm OOM (simulated)")
+
+            rep.warm = bad_warm
+        return rep
+
+    rs = ReplicaSet(params, factory, FleetConfig(target_replicas=2),
+                    replica_factory=replica_factory)
+    router = FleetRouter(rs, RouterConfig(admission_token_cap=64))
+    rng = np.random.default_rng(113)
+    prompts = _prompts(rng, (6, 9, 7))
+    rids = [router.submit(p, max_new_tokens=4) for p in prompts]
+    out = router.run()                     # survives the failed spawn
+    assert sorted(out) == sorted(rids)
+    assert rs.telemetry["deaths"].get("SpawnFailed") == 1
+    assert len(rs.serving()) == 2          # healed by a later respawn
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 4)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])

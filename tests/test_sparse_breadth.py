@@ -34,7 +34,12 @@ UNARY = [
 class TestUnaryZoo:
     @pytest.mark.parametrize("name,npf", UNARY)
     def test_forward_and_grad(self, name, npf):
-        rng = np.random.default_rng(hash(name) % 2**31)
+        # crc32, not hash(): str hashing is randomized per process
+        # (PYTHONHASHSEED), so hash-seeded values changed every run —
+        # tan occasionally drew near pi/2 and its gradient check flaked
+        import zlib
+
+        rng = np.random.default_rng(zlib.crc32(name.encode()) % 2**31)
         if name in ("log1p", "sqrt"):
             vals = rng.uniform(0.1, 2.0, 6).astype(np.float32)
         elif name in ("asin", "atan", "atanh"):

@@ -116,13 +116,28 @@ _ROUND11_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND11_TRANCHE
 
+# names added by the round-13 tranche (manipulation/structural method
+# forms, the remaining linalg surface, introspection + apply, and the
+# sampling/diagonal fills — uniform_ CLOSES the standing exemption) —
+# appended into _REQUIRED_METHODS AND counted against the ~30 floor by
+# test_method_count_tranche_round13
+_ROUND13_TRANCHE = [
+    "atleast_1d", "atleast_2d", "atleast_3d", "unstack", "crop", "pad",
+    "reverse", "increment", "multiplex", "slice", "strided_slice",
+    "one_hot", "eigh", "cholesky_inverse", "matrix_norm", "vector_norm",
+    "pca_lowrank", "floor_mod", "rint", "equal_all", "is_empty",
+    "bernoulli", "poisson", "fill_diagonal_tensor",
+    "uniform_", "exponential_", "cauchy_", "fill_diagonal_",
+    "fill_diagonal_tensor_", "addmm_", "floor_mod_", "sinc_",
+    "polygamma_", "t_",
+    "dim", "ndimension", "element_size", "apply", "apply_",
+]
+_REQUIRED_METHODS += _ROUND13_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
 _METHOD_EXEMPT = {
-    "uniform_": "random FILL semantics need the op-level RNG key plumb "
-                "(bernoulli_/normal_ shipped; uniform_ tracked for the "
-                "next tranche)",
     "coalesce": "sparse-COO method; sparse Tensors live in paddle.sparse "
                 "with their own classes here",
     "rows": "SelectedRows carrier method — selected-rows is emulated at "
@@ -350,3 +365,76 @@ def test_round9_inplace_scan_methods():
     r = w.cumprod_(0)
     assert r is w
     np.testing.assert_allclose(np.asarray(w._value), [1.0, 2.0, 6.0])
+
+
+def test_method_count_tranche_round13():
+    """The round-13 tranche satisfies the ~30-new-names floor (ISSUE 8
+    satellite: manipulation/structural + remaining-linalg method forms,
+    introspection/apply, and the sampling + diagonal fills) over the
+    round-11 surface."""
+    wired = [n for n in _ROUND13_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 30, (len(wired),
+                              sorted(set(_ROUND13_TRANCHE) - set(wired)))
+
+
+def test_round13_structural_method_values():
+    t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    assert np.asarray(t.atleast_2d()._value).shape == (1, 2)
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    parts = m.unstack()
+    assert [tuple(np.asarray(p_._value).shape) for p_ in parts] \
+        == [(3,), (3,)]
+    assert m.dim() == 2 and m.ndimension() == 2
+    assert m.element_size() == 4
+    sym = paddle.to_tensor(np.array([[2.0, 1.0], [1.0, 2.0]], np.float32))
+    w = np.asarray(sym.eigh()[0]._value)
+    np.testing.assert_allclose(np.sort(w.reshape(-1)), [1.0, 3.0],
+                               rtol=1e-5)
+    a = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    b = paddle.to_tensor(np.array([[1.0, 1.0], [1.0, 1.0]], np.float32))
+    assert not bool(np.asarray(a.equal_all(b)._value))
+    assert bool(np.asarray(a.equal_all(a.clone())._value))
+
+
+def test_round13_fill_and_apply_method_values():
+    t = paddle.to_tensor(np.zeros((64,), np.float32))
+    r = t.uniform_(0.0, 1.0)                  # the closed exemption
+    assert r is t
+    v = np.asarray(t._value)
+    assert (v >= 0.0).all() and (v < 1.0).all() and v.std() > 0.0
+    # a NONZERO seed is the reference's fixed deterministic stream
+    a1 = paddle.to_tensor(np.zeros((8,), np.float32)).uniform_(seed=123)
+    a2 = paddle.to_tensor(np.zeros((8,), np.float32)).uniform_(seed=123)
+    np.testing.assert_array_equal(np.asarray(a1._value),
+                                  np.asarray(a2._value))
+    e = paddle.to_tensor(np.zeros((64,), np.float32))
+    assert (np.asarray(e.exponential_(2.0)._value) > 0.0).all()
+    m = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    m.fill_diagonal_(7.0)
+    np.testing.assert_allclose(np.asarray(m._value), np.eye(3) * 7.0)
+    off = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    off.fill_diagonal_(2.0, offset=1)
+    np.testing.assert_allclose(np.asarray(off._value),
+                               np.diag([2.0, 2.0], k=1))
+    # unsupported combinations raise instead of silently filling the
+    # main diagonal
+    with pytest.raises(NotImplementedError):
+        paddle.to_tensor(np.zeros((2, 2, 2), np.float32)) \
+            .fill_diagonal_(1.0, offset=1)
+    with pytest.raises(NotImplementedError):
+        paddle.to_tensor(np.zeros((4, 2), np.float32)) \
+            .fill_diagonal_(1.0, offset=1, wrap=True)
+    y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out = m.fill_diagonal_tensor(y)
+    np.testing.assert_allclose(np.diag(np.asarray(out._value)),
+                               [1.0, 2.0, 3.0])
+    a = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+    doubled = a.apply(lambda x: x * 2)
+    np.testing.assert_allclose(np.asarray(doubled._value), [[2.0, 4.0]])
+    r = a.apply_(lambda x: x + 1)
+    assert r is a
+    np.testing.assert_allclose(np.asarray(a._value), [[2.0, 3.0]])
+    g = paddle.to_tensor(np.array([1.0], np.float32))
+    g.stop_gradient = False
+    with pytest.raises(RuntimeError):
+        g.apply(lambda x: x)
