@@ -1473,8 +1473,13 @@ def doctor():
     its finding code, the flagship entry points (build_train_step in
     both accum regimes, llama fwd/bwd, the serving decode chunk) must
     report zero findings, and every tracked exemption must still match a
-    live suppressed finding.  Writes DOCTOR.json; exits non-zero from
-    the CLI on any failure (see ANALYSIS.md for the finding codes)."""
+    live suppressed finding.  Round-14: DOCTOR.json additionally carries
+    the ``sharding`` block (per-stack reshard audits + the cross-stack
+    SpecLayout agreement gate) and ``sharding_canonical_table`` — the
+    flagship's canonical per-tensor spec table, the input artifact of
+    the ROADMAP's unified-partitioning refactor.  Writes DOCTOR.json;
+    exits non-zero from the CLI on any failure (see ANALYSIS.md for the
+    finding codes)."""
     from paddle_tpu.analysis import self_check
 
     res = self_check()
@@ -1780,6 +1785,15 @@ def smoke():
     except Exception as e:  # noqa: BLE001
         legs["router_parity"] = {"ok": False, "error": repr(e)}
         legs["replica_recovery"] = {"ok": False, "error": repr(e)}
+
+    # 17. round-14 Sharding Doctor: the SHARD fixtures fire exactly
+    #     their codes and the GSPMD/overlap/hybrid stacks' canonical
+    #     SpecLayout tables agree on the llama flagship parameter tree
+    #     (SHARD003 empty — the unified-partitioning precondition)
+    try:
+        legs["sharding_doctor"] = _smoke_sharding_doctor()
+    except Exception as e:  # noqa: BLE001
+        legs["sharding_doctor"] = {"ok": False, "error": repr(e)}
 
     return {"smoke": True,
             "backend": jax.default_backend(),
@@ -2096,6 +2110,63 @@ def _smoke_memory_budget():
             "findings": [f.format() for f in rep.findings]}
     except Exception as e:  # noqa: BLE001
         out["flagship_hbm_budget"] = {"ok": False, "error": repr(e)}
+    return {"ok": all(v.get("ok") for v in out.values()), **out}
+
+
+def _smoke_sharding_doctor():
+    """Round-14 sharding_doctor leg: true-positive proofs for
+    SHARD001-005 plus the cross-stack agreement gate — the canonical
+    SpecLayout tables extracted from the GSPMD, overlap and hybrid
+    stacks must map the llama flagship parameter tree identically
+    (table-level, no extra compiles; the compiled reshard audits ride
+    the doctor_self_check leg's sharding section)."""
+    import jax
+    from paddle_tpu.analysis.fixtures import SEEDED, FixtureUnavailable
+
+    out = {}
+    for code in ("SHARD001", "SHARD002", "SHARD003", "SHARD004",
+                 "SHARD005"):
+        try:
+            rep = SEEDED[code]()
+            out[code] = {"ok": set(rep.codes()) == {code},
+                         "codes": sorted(set(rep.codes()))}
+        except FixtureUnavailable as e:
+            out[code] = {"ok": True, "skipped": str(e)}
+    try:
+        if len(jax.devices()) < 8:
+            out["cross_stack"] = {"ok": True,
+                                  "skipped": "needs >= 8 devices"}
+        else:
+            import numpy as _np
+            from jax.sharding import Mesh
+
+            from paddle_tpu.analysis.sharding import (
+                check_cross_stack, extract_gspmd_layout,
+                extract_hybrid_layout, extract_overlap_layout)
+            from paddle_tpu.analysis.self_check import _flagship
+            from paddle_tpu.models.llama import apply_llama_sharding
+            from paddle_tpu.models.llama_hybrid import hybrid_mesh
+
+            cfg, model, opt, params, ids, labels = _flagship()
+            mesh = Mesh(_np.asarray(jax.devices()[:8],
+                                    dtype=object).reshape(2, 2, 2),
+                        ("dp", "sharding", "mp"))
+            apply_llama_sharding(model, mesh)
+            layouts = {
+                "gspmd": extract_gspmd_layout(model, mesh),
+                "overlap": extract_overlap_layout(model, mesh),
+                "hybrid": extract_hybrid_layout(
+                    model, hybrid_mesh(jax.devices(), pp=2, dp=1,
+                                       sharding=2, sep=1, mp=2)),
+            }
+            rep = check_cross_stack(layouts)
+            n = min(len(lo.entries) for lo in layouts.values())
+            out["cross_stack"] = {
+                "ok": bool(rep.ok and n >= 10),
+                "tensors": n,
+                "findings": [f.format() for f in rep.findings]}
+    except Exception as e:  # noqa: BLE001
+        out["cross_stack"] = {"ok": False, "error": repr(e)}
     return {"ok": all(v.get("ok") for v in out.values()), **out}
 
 

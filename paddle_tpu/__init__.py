@@ -138,14 +138,16 @@ from .ops.compat_ops import (  # noqa: F401
     slice_scatter, tensordot, trapezoid, vander,
 )
 from .frontend_compat import (  # noqa: F401
-    CUDAPinnedPlace, CUDAPlace, LazyGuard, ParamAttr, cauchy_,
+    CUDAPinnedPlace, CUDAPlace, LazyGuard, ParamAttr, baddbmm,
+    bitwise_invert, cauchy_,
     create_parameter, log_normal_, as_complex, as_real, atleast_1d,
     atleast_2d, atleast_3d, broadcast_shape, broadcast_tensors, check_shape,
     column_stack, complex, crop, cublas, cuda_nvrtc, cuda_runtime, cudnn,
     cufft, curand, cusolver, cusparse, disable_signal_handler, dsplit,
     dstack, equal_all, finfo, get_cuda_rng_state, hsplit, hstack,
-    iinfo, is_complex, is_empty, is_floating_point, is_integer, is_tensor,
-    log_normal, numel, nvjitlink, randint_like, rank, row_stack,
+    iinfo, index_reduce, is_complex, is_empty, is_floating_point,
+    is_integer, is_tensor,
+    log_normal, lu_solve, numel, nvjitlink, randint_like, rank, row_stack,
     set_cuda_rng_state, set_grad_enabled, set_printoptions, shape, slice,
     standard_gamma, strided_slice, take, tensor_split, tolist, unflatten,
     view, view_as, vsplit, vstack,
@@ -168,7 +170,9 @@ for _n in ("gammaln", "gammaincc", "i0", "i0e", "i1", "i1e", "polygamma",
            "logit", "logcumsumexp", "kthvalue", "mode", "nanmedian",
            "trace", "diag_embed", "renorm", "multiplex", "index_sample",
            "unique_consecutive", "reverse", "increment", "shard_index",
-           "bitwise_left_shift", "bitwise_right_shift"):
+           "bitwise_left_shift", "bitwise_right_shift",
+           # round-14 tranche: nucleus sampling rides the registered op
+           "top_p_sampling"):
     if _n not in globals():
         globals()[_n] = _registry_export(_n)
 

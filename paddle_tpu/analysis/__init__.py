@@ -17,7 +17,14 @@ silently give back the won milliseconds or deadlock a pod:
 - retrace_sentinel  — RT001/RT002: a call-driven wrapper counting
   compilations per signature, flagging weak-type/static-arg churn;
 - hlo_post_checks   — HLO001/HLO002: involuntary-full-rematerialization
-  compile warnings, unexpected full-param all-gathers in stage-3 steps.
+  compile warnings, unexpected full-param all-gathers in stage-3 steps;
+- sharding_consistency — SHARD001-005 (round-14, the Sharding Doctor):
+  GSPMD-inserted resharding beyond the declared schedule, replication
+  waste, cross-stack canonical-spec divergence, non-divisible shard
+  padding, and the missing 2004.13336 flat-update sharding pin.  The
+  canonical SpecLayout tables come from ``analysis.sharding`` (one
+  extractor per stack) — the groundwork for the ROADMAP's
+  unified-partitioning refactor.
 
 See ANALYSIS.md for finding codes, the exemption workflow, and
 ``bench.py --doctor`` / ``python -m paddle_tpu.analysis --self-check``.

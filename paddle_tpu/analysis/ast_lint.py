@@ -1,6 +1,6 @@
 """AST-level repo lint: host-library leaks into traced code.
 
-Two bug classes keep re-entering jit-adjacent code by muscle memory:
+Three bug classes keep re-entering jit-adjacent code by muscle memory:
 
 - AST001 — ``np.*`` calls: numpy executes on HOST at trace time.  Inside
   a traced function the result is silently baked in as a constant (wrong
@@ -13,26 +13,40 @@ Two bug classes keep re-entering jit-adjacent code by muscle memory:
   under jit these raise ConcretizationTypeError, and the "fix" people
   reach for (``bool(...)`` + an isinstance guard) belongs behind an
   allowlist entry, not scattered unreviewed.
+- AST003 (round-14, the Sharding Doctor satellite) — hand-written
+  ``PartitionSpec(...)`` literals inside ``models/`` and ``inference/``:
+  partition specs are SCHEDULE decisions and belong in the parallel/
+  layer (the canonical SpecLayout the unified-partitioning refactor
+  derives the stacks from).  Every spec scattered through a model body
+  is a site the refactor must find and a chance for two stacks to
+  diverge (SHARD003's beat at the source level).  Today's legitimate
+  sites — the declared plans themselves and the batch/activation
+  constraints the entry layers still own — are the seeded allowlist;
+  the list is the refactor's work-list.
 
-Scope: ``ops/pallas/``, ``models/``, ``parallel/`` — the traced/kernel
-layers (ISSUE 3 satellite).  Run as a tier-1 pytest
-(tests/test_ast_lint.py) against the explicit allowlist
-``ast_allowlist.txt``; unused allowlist entries fail the test too, so
-the list cannot rot.
+Scope: AST001/AST002 over ``ops/pallas/``, ``models/``, ``parallel/``
+(the traced/kernel layers, ISSUE 3 satellite); AST003 over ``models/``
+and ``inference/``.  Run as a tier-1 pytest (tests/test_ast_lint.py)
+against the explicit allowlist ``ast_allowlist.txt``; unused allowlist
+entries fail the test too, so the list cannot rot.
 """
 
 from __future__ import annotations
 
 import ast
 import os
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .findings import Finding
 
 LINT_DIRS = ("ops/pallas", "models", "parallel")
+#: AST003 scope — spec literals belong in the parallel/ schedule layer
+SPEC_DIRS = ("models", "inference")
+ALL_CODES = frozenset({"AST001", "AST002", "AST003"})
 NUMPY_ROOTS = ("np", "numpy")
 TRACED_ROOTS = ("jnp", "lax")
 TRACER_METHODS = ("any", "all", "item")
+SPEC_NAME = "PartitionSpec"
 # jnp.* predicates that operate on DTYPES, not values — never a tracer
 # bool, so branching on them is fine
 HOST_SAFE_ATTRS = ("issubdtype", "dtype", "result_type", "promote_types")
@@ -58,10 +72,22 @@ def _dotted(node) -> str:
 
 
 class _Visitor(ast.NodeVisitor):
-    def __init__(self, rel: str):
+    def __init__(self, rel: str, codes: Optional[Set[str]] = None):
         self.rel = rel
+        self.codes = set(ALL_CODES if codes is None else codes)
         self.scope: List[str] = []
         self.findings: List[Finding] = []
+        #: names the module binds to jax.sharding.PartitionSpec
+        #: ("P" by repo idiom; the bare name counts too)
+        self.spec_aliases: Set[str] = {SPEC_NAME}
+
+    # -- import tracking (AST003 alias resolution) --------------------------
+
+    def visit_ImportFrom(self, node):
+        for alias in node.names:
+            if alias.name == SPEC_NAME:
+                self.spec_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
 
     # -- scope tracking -----------------------------------------------------
 
@@ -80,10 +106,11 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
         self.scope.pop()
 
-    # -- AST001: np.* calls -------------------------------------------------
+    # -- AST001: np.* calls / AST003: PartitionSpec literals ----------------
 
     def visit_Call(self, node):
-        if isinstance(node.func, ast.Attribute) \
+        if "AST001" in self.codes \
+                and isinstance(node.func, ast.Attribute) \
                 and _attr_root(node.func) in NUMPY_ROOTS:
             self.findings.append(Finding(
                 code="AST001", pass_name="ast_lint",
@@ -94,7 +121,25 @@ class _Visitor(ast.NodeVisitor):
                          f"precompute"),
                 where=f"{self.rel}:{node.lineno} ({self._qual()})",
                 data={"function": self._qual(), "line": node.lineno}))
+        if "AST003" in self.codes and self._is_spec_literal(node.func):
+            self.findings.append(Finding(
+                code="AST003", pass_name="ast_lint",
+                message=(f"hand-written {_dotted(node.func) or SPEC_NAME}"
+                         f"(...) literal in the model/serving layer — "
+                         f"partition specs are schedule decisions and "
+                         f"belong in parallel/ (the canonical SpecLayout "
+                         f"the unified-partitioning refactor derives the "
+                         f"stacks from); route through the plan/spec "
+                         f"helpers, or allowlist this function as a "
+                         f"declared plan / entry-layer constraint"),
+                where=f"{self.rel}:{node.lineno} ({self._qual()})",
+                data={"function": self._qual(), "line": node.lineno}))
         self.generic_visit(node)
+
+    def _is_spec_literal(self, func) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id in self.spec_aliases
+        return isinstance(func, ast.Attribute) and func.attr == SPEC_NAME
 
     # -- AST002: python branch on tracer-suspect test -----------------------
 
@@ -112,6 +157,8 @@ class _Visitor(ast.NodeVisitor):
         return None
 
     def _check_branch(self, node, kind: str):
+        if "AST002" not in self.codes:
+            return
         sus = self._tracer_suspect(node.test)
         if sus is not None:
             self.findings.append(Finding(
@@ -132,9 +179,10 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_source(source: str, rel: str) -> List[Finding]:
+def lint_source(source: str, rel: str,
+                codes: Optional[Set[str]] = None) -> List[Finding]:
     tree = ast.parse(source, filename=rel)
-    v = _Visitor(rel)
+    v = _Visitor(rel, codes)
     v.visit(tree)
     return v.findings
 
@@ -168,24 +216,31 @@ def _entry_matches(entry, finding: Finding) -> bool:
 
 
 def lint_repo(root: Optional[str] = None,
-              dirs: Sequence[str] = LINT_DIRS,
+              dirs: Optional[Sequence[str]] = None,
               allowlist: Optional[Iterable[Tuple[str, str, str]]] = None):
-    """Lint the traced-layer dirs.  Returns (active_findings,
+    """Lint the traced-layer dirs (AST001/AST002) and the spec-literal
+    dirs (AST003) — each file linted ONCE with the union of the codes
+    its directories opt into.  Returns (active_findings,
     allowlisted_findings, unused_allowlist_entries)."""
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     entries = list(load_allowlist() if allowlist is None else allowlist)
-    findings: List[Finding] = []
-    for d in dirs:
+    scopes = ([(d, {"AST001", "AST002"}) for d in LINT_DIRS]
+              + [(d, {"AST003"}) for d in SPEC_DIRS]) \
+        if dirs is None else [(d, set(ALL_CODES)) for d in dirs]
+    per_file: Dict[str, Set[str]] = {}
+    for d, codes in scopes:
         base = os.path.join(root, d)
         for dirpath, _, names in sorted(os.walk(base)):
             for name in sorted(names):
                 if not name.endswith(".py"):
                     continue
-                path = os.path.join(dirpath, name)
-                rel = os.path.relpath(path, root)
-                with open(path) as f:
-                    findings.extend(lint_source(f.read(), rel))
+                rel = os.path.relpath(os.path.join(dirpath, name), root)
+                per_file.setdefault(rel, set()).update(codes)
+    findings: List[Finding] = []
+    for rel in sorted(per_file):
+        with open(os.path.join(root, rel)) as f:
+            findings.extend(lint_source(f.read(), rel, per_file[rel]))
     active, allowed, used = [], [], set()
     for f in findings:
         hit = next((e for e in entries if _entry_matches(e, f)), None)

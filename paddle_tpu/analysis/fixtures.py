@@ -460,6 +460,110 @@ ENTRY %main.42 (a.1: f32[128,8]) -> f32[128,8] {
                   passes_run=("hlo_post_checks",))
 
 
+# ---------------------------------------------------------------------------
+# sharding_consistency (round-14: the Sharding Doctor)
+# ---------------------------------------------------------------------------
+
+
+def seeded_gspmd_reshard() -> Report:
+    """SHARD001: a step whose body re-constrains a sharded operand to
+    the TRANSPOSED spec — GSPMD silently lowers the layout conversion
+    to an all-to-all no schedule ever declared (the reshard class the
+    unified-partitioning refactor must see, not discover on a TPU
+    profile)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh(2)
+    x = jax.device_put(jnp.ones((8, 8), jnp.float32),
+                       NamedSharding(mesh, P("x", None)))
+
+    @jax.jit
+    def bug(a):
+        b = jax.lax.with_sharding_constraint(
+            a * 2.0, NamedSharding(mesh, P(None, "x")))   # spec transpose
+        return b.sum()
+
+    return check(bug, x, passes=["sharding_consistency"], exemptions=(),
+                 target="seeded:SHARD001",
+                 options={"sharding_consistency":
+                          {"audit_resharding": True}})
+
+
+def seeded_replication_waste() -> Report:
+    """SHARD002: a 1 MB leaf left fully replicated on a 4-way axis its
+    dims divide — 0.75 MB of per-device residency the plan ignores."""
+    from ..parallel.specs import SpecLayout, TensorSpec
+    from .sharding import check_layout
+
+    layout = SpecLayout(
+        mesh_axes=(("x", 4),),
+        entries={"model.layers.*.mlp.up_proj.weight": TensorSpec(
+            shape=(512, 512), dtype="float32", dim_axes=((), ()))})
+    return check_layout(layout, replicated_min_bytes=256 << 10,
+                        exemptions=(), target="seeded:SHARD002")
+
+
+def seeded_cross_stack_divergence() -> Report:
+    """SHARD003: two stacks mapping the same logical parameter to
+    TRANSPOSED specs — every cross-stack handoff of that leaf pays a
+    silent reshard."""
+    from ..parallel.specs import SpecLayout, TensorSpec
+    from .sharding import check_cross_stack
+
+    key = "model.layers.*.self_attn.q_proj.weight"
+    a = SpecLayout(mesh_axes=(("sharding", 2), ("mp", 2)),
+                   entries={key: TensorSpec(
+                       shape=(64, 64), dtype="float32",
+                       dim_axes=(("sharding",), ("mp",)))})
+    b = SpecLayout(mesh_axes=(("sharding", 2), ("mp", 2)),
+                   entries={key: TensorSpec(
+                       shape=(64, 64), dtype="float32",
+                       dim_axes=(("mp",), ("sharding",)))})
+    return check_cross_stack({"gspmd": a, "overlap": b}, exemptions=(),
+                             target="seeded:SHARD003")
+
+
+def seeded_shard_padding() -> Report:
+    """SHARD004: a hand-written spec sharding a 129-row leaf 4 ways —
+    XLA pads every shard to 33 rows; the at-rest rule would have fallen
+    back to replication, a hand-rolled NamedSharding bypasses it (jax
+    refuses such a device_put, but jit in_shardings and manual specs
+    still reach it)."""
+    from ..parallel.specs import SpecLayout, TensorSpec
+    from .sharding import check_layout
+
+    layout = SpecLayout(
+        mesh_axes=(("x", 4),),
+        entries={"lm_head.weight": TensorSpec(
+            shape=(129, 64), dtype="float32",
+            dim_axes=(("x",), ()))})
+    return check_layout(layout, exemptions=(), target="seeded:SHARD004")
+
+
+def seeded_unsharded_update() -> Report:
+    """SHARD005: a flat optimizer update chain on a mesh with NO
+    cross-replica sharding pin — the update runs replicated
+    (2004.13336) and the unconstrained concat→update→slice layout is
+    the exact region the 0.4.x GSPMD partitioner mis-lowers (PR 5's
+    hand fix; Adam.apply_flat's flat_sharding is the pin this proves
+    the doctor demands)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh(2)
+    m = jax.device_put(jnp.ones((1 << 15,), jnp.float32),
+                       NamedSharding(mesh, P()))
+
+    @jax.jit
+    def bug(master, g):
+        return master - 0.1 * g        # no flat_sharding pin anywhere
+
+    return check(bug, m, m * 0.5, passes=["sharding_consistency"],
+                 exemptions=(), target="seeded:SHARD005",
+                 options={"sharding_consistency":
+                          {"expect_update_pin": True,
+                           "update_min_bytes": 1 << 10}})
+
+
 SEEDED = {
     "COLL001": seeded_collective_order,
     "COLL002": seeded_ppermute_race,
@@ -488,4 +592,10 @@ SEEDED = {
     # unbounded fleet delivery plan overruns its declared budget
     "MEM001[replica_delivery]": seeded_replica_delivery_over_budget,
     "MEM002": seeded_host_round_trip,
+    # round-14: the Sharding Doctor (cross-stack partition consistency)
+    "SHARD001": seeded_gspmd_reshard,
+    "SHARD002": seeded_replication_waste,
+    "SHARD003": seeded_cross_stack_divergence,
+    "SHARD004": seeded_shard_padding,
+    "SHARD005": seeded_unsharded_update,
 }

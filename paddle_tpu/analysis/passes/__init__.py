@@ -8,4 +8,5 @@ from . import donation  # noqa: F401
 from . import dtype_promotion  # noqa: F401
 from . import hlo_checks  # noqa: F401
 from . import memory_budget  # noqa: F401
+from . import sharding_consistency  # noqa: F401
 from .retrace import RetraceSentinel, retrace_sentinel  # noqa: F401

@@ -1,0 +1,326 @@
+"""SHARD — cross-stack partition-consistency analysis.
+
+Three stacks hand-encode sharding (flat GSPMD ``build_train_step``, the
+full-manual overlap engine, the hybrid gpipe/sched bodies) and nothing
+checked that they agree, that GSPMD didn't silently insert a reshard, or
+that the weight update is cross-replica sharded (PAPERS.md 2004.13336).
+This pass is the static-analysis groundwork for the unified-partitioning
+refactor (PartIR, 2401.11202): the canonical SpecLayout tables come from
+``paddle_tpu.analysis.sharding`` (one per stack); this pass audits them
+and the compiled programs.
+
+Codes:
+- SHARD001: the compiled HLO carries MORE reshard-class collectives
+  (``all-to-all`` / ``collective-permute`` / spec-changing
+  ``all-gather``) than the entry point's declared schedule.  The
+  declared schedule defaults to the MANUAL jaxpr-level collectives (the
+  overlap/hybrid engines' own ops, attributed exactly like
+  collective_budget counts them); GSPMD-boundary extras are declared
+  per entry (``options={"sharding_consistency": {"declared":
+  {"alltoall": n, ...}}}``, an upper bound like COMM001's budgets).
+  Anything above that is a reshard GSPMD inserted silently — layout
+  conversions the schedule never planned.
+- SHARD002: a leaf over ``replicated_min_bytes`` sits REPLICATED along
+  a mesh axis its dims are divisible by — memory the at-rest plan left
+  on the table, reported bytes-weighted.  Runs over a canonical
+  ``layout`` table.
+- SHARD003: the same logical parameter maps to DIFFERENT canonical
+  specs in two stacks' tables (``layouts={"gspmd": ..., "overlap":
+  ...}``) — compared after restriction to the mesh axes both stacks
+  know, so a hybrid table's pp layer-stacking doesn't false-diverge
+  against a pp-less mesh.
+- SHARD004: a shard dim not divisible by its axis degree — XLA pads
+  every shard to the ceiling; the padded bytes are dead weight on every
+  transfer of that leaf.  The at-rest extractors can't produce this
+  (their rule falls back to replication); concrete arrays and
+  hand-written specs can.
+- SHARD005: the optimizer update chain runs replicated where the
+  2004.13336 cross-replica weight-update sharding applies — the exact
+  miscompile-adjacent region PR 5 pinned by hand (``Adam.apply_flat``'s
+  ``flat_sharding``).  With ``expect_update_pin`` declared, the entry
+  must carry at least one ``sharding_constraint`` over a large fp32
+  1-D buffer (the flat update wire format) whose spec actually names a
+  mesh axis; a qualifying buffer pinned to REPLICATED fires too.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+from ..core import (AnalysisContext, AnalysisPass, SkipPass, format_where,
+                    register_pass, walk_eqns)
+from ..findings import Finding
+from .collective_budget import scan_hlo_collectives
+
+# the reshard-class HLO collective kinds (COMM001's naming): layout
+# conversions, not reductions — an all-reduce never changes a spec
+RESHARD_KINDS = ("alltoall", "collectivepermute", "allgather")
+
+# manual jaxpr primitive -> reshard kind (the attribution machinery
+# collective_budget uses, specialized to the reshard classes)
+MANUAL_RESHARD_PRIMS = {
+    "all_to_all": "alltoall",
+    "ppermute": "collectivepermute",
+    "pshuffle": "collectivepermute",
+    "all_gather": "allgather",
+    "all_gather_invariant": "allgather",
+    "pgather": "allgather",
+}
+
+#: production default for SHARD002 (debug-shaped sweeps pass their own)
+REPLICATED_MIN_BYTES = 1 << 20
+#: production default for SHARD005's qualifying-buffer floor
+UPDATE_MIN_BYTES = 64 << 10
+
+
+def _finding(code, message, **kw) -> Finding:
+    return Finding(code=code, message=message, severity="error",
+                   pass_name="sharding_consistency", **kw)
+
+
+def _itemsize(dtype: str) -> int:
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype).itemsize
+
+
+# ---------------------------------------------------------------------------
+# table-level checks (pure functions over SpecLayout — the analysis
+# helpers in analysis/sharding.py reuse them without a traced target)
+# ---------------------------------------------------------------------------
+
+
+def replication_waste_findings(layout, min_bytes: int = REPLICATED_MIN_BYTES,
+                               ignore_axes=()) -> List[Finding]:
+    """SHARD002 over one canonical table.  ``ignore_axes`` names the
+    pure DATA axes (dp, pp, sep) the plan replicates params over BY
+    DESIGN — the grad all-reduce rides them; only replication along a
+    weights-capable axis is left-on-the-table memory."""
+    findings = []
+    sizes = {a: n for a, n in layout.mesh_axes
+             if n > 1 and a not in set(ignore_axes)}
+    for name, ts in sorted(layout.items()):
+        if ts.nbytes < min_bytes:
+            continue
+        candidates = {}
+        for axis, n in sizes.items():
+            if axis in ts.axes_used:
+                continue
+            if any(not axes and d % n == 0 and d >= n
+                   for d, axes in zip(ts.shape, ts.dim_axes)):
+                candidates[axis] = ts.nbytes - ts.nbytes // n
+        if not candidates:
+            continue
+        best_axis = max(candidates, key=lambda a: candidates[a])
+        findings.append(_finding(
+            "SHARD002",
+            f"{name} ({ts.describe()}, {ts.nbytes} bytes) is replicated "
+            f"along mesh axis '{best_axis}' "
+            f"(x{sizes[best_axis]}) though a replicated dim divides it — "
+            f"{candidates[best_axis]} bytes of per-device residency the "
+            f"at-rest plan leaves on the table"
+            + (f" (also applicable: "
+               f"{sorted(set(candidates) - {best_axis})})"
+               if len(candidates) > 1 else ""),
+            arg_path=name,
+            data={"tensor": name, "bytes": ts.nbytes,
+                  "wasted_bytes": candidates[best_axis],
+                  "axes": {a: candidates[a] for a in sorted(candidates)}}))
+    return findings
+
+
+def shard_padding_findings(layout) -> List[Finding]:
+    """SHARD004 over one canonical table."""
+    findings = []
+    sizes = dict(layout.mesh_axes)
+    for name, ts in sorted(layout.items()):
+        for d, (dim, axes) in enumerate(zip(ts.shape, ts.dim_axes)):
+            if not axes:
+                continue
+            ways = math.prod(sizes.get(a, 1) for a in axes)
+            if ways <= 1 or dim % ways == 0:
+                continue
+            per_shard = -(-dim // ways)            # ceil
+            pad_elems = (per_shard * ways - dim) * max(
+                1, math.prod(ts.shape) // max(dim, 1))
+            pad_bytes = pad_elems * _itemsize(ts.dtype)
+            findings.append(_finding(
+                "SHARD004",
+                f"{name} dim {d} (size {dim}) shards over "
+                f"{'/'.join(axes)} ({ways} ways) without dividing — "
+                f"XLA pads every shard to {per_shard} "
+                f"(~{pad_bytes} padded bytes riding every transfer of "
+                f"this leaf); re-plan the dim or fall back to "
+                f"replication like the at-rest rule",
+                arg_path=name,
+                data={"tensor": name, "dim": d, "size": dim,
+                      "ways": ways, "padded_bytes": pad_bytes}))
+    return findings
+
+
+def cross_stack_findings(layouts: Dict[str, object]) -> List[Finding]:
+    """SHARD003 over two or more stacks' canonical tables: every
+    logical tensor present in a pair of tables must carry the SAME spec
+    after restriction to the axes both tables know."""
+    findings = []
+    names = sorted(layouts)
+    for i, a_name in enumerate(names):
+        for b_name in names[i + 1:]:
+            a, b = layouts[a_name], layouts[b_name]
+            shared = a.active_axes() & b.active_axes()
+            for key in sorted(set(a.entries) & set(b.entries)):
+                ta = a[key].restrict(shared)
+                tb = b[key].restrict(shared)
+                diffs = []
+                if ta.shape != tb.shape:
+                    diffs.append(f"shape {ta.shape} vs {tb.shape}")
+                if ta.dim_axes != tb.dim_axes:
+                    diffs.append(f"dims ({ta.describe()}) vs "
+                                 f"({tb.describe()})")
+                if ta.memory_kind != tb.memory_kind:
+                    diffs.append(f"memory {ta.memory_kind} vs "
+                                 f"{tb.memory_kind}")
+                if not diffs:
+                    continue
+                findings.append(_finding(
+                    "SHARD003",
+                    f"{key}: stacks '{a_name}' and '{b_name}' map the "
+                    f"same logical parameter to different canonical "
+                    f"specs over shared axes {sorted(shared)} — "
+                    f"{'; '.join(diffs)}.  Divergent at-rest layouts "
+                    f"mean every cross-stack handoff (checkpoint "
+                    f"restore, replica delivery, the future unified "
+                    f"schedule) pays a silent reshard",
+                    arg_path=key,
+                    data={"tensor": key, "stacks": [a_name, b_name],
+                          "shared_axes": sorted(shared),
+                          a_name: a[key].describe(),
+                          b_name: b[key].describe()}))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the registered pass (program-level SHARD001/SHARD005 + table plumbing)
+# ---------------------------------------------------------------------------
+
+
+@register_pass
+class ShardingConsistencyPass(AnalysisPass):
+    name = "sharding_consistency"
+    codes = ("SHARD001", "SHARD002", "SHARD003", "SHARD004", "SHARD005")
+    # SHARD001 compiles, but only when the entry opts into the reshard
+    # audit — table/jaxpr checks stay cheap (COMM-pass convention)
+    requires = "jaxpr"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        opts = ctx.options.get(self.name, {}) if ctx.options else {}
+        findings: List[Finding] = []
+        ran = False
+        if opts.get("audit_resharding") or "declared" in opts:
+            ran = True
+            findings.extend(self._check_resharding(
+                ctx, opts.get("declared", {})))
+        if "layout" in opts:
+            ran = True
+            mb = opts.get("replicated_min_bytes", REPLICATED_MIN_BYTES)
+            findings.extend(replication_waste_findings(
+                opts["layout"], mb,
+                ignore_axes=opts.get("replication_ignore_axes", ())))
+            findings.extend(shard_padding_findings(opts["layout"]))
+        if "layouts" in opts:
+            ran = True
+            findings.extend(cross_stack_findings(opts["layouts"]))
+        if opts.get("expect_update_pin"):
+            ran = True
+            findings.extend(self._check_update_pin(
+                ctx, opts.get("update_min_bytes", UPDATE_MIN_BYTES)))
+        if not ran:
+            raise SkipPass(
+                "no sharding contract declared (audit_resharding / "
+                "declared / layout / layouts / expect_update_pin) — a "
+                "partition contract is per-entry-point, like the "
+                "collective and memory budgets")
+        return findings
+
+    # ---- SHARD001 ---------------------------------------------------------
+
+    def _manual_counts(self, ctx) -> Dict[str, int]:
+        counts = {k: 0 for k in RESHARD_KINDS}
+        for eqn, _ in walk_eqns(ctx.jaxpr):
+            kind = MANUAL_RESHARD_PRIMS.get(eqn.primitive.name)
+            if kind is not None:
+                counts[kind] += 1
+        return counts
+
+    def _check_resharding(self, ctx, declared) -> List[Finding]:
+        hlo = scan_hlo_collectives(ctx.compiled_text)
+        manual = self._manual_counts(ctx)
+        findings = []
+        for kind in RESHARD_KINDS:
+            got = hlo.get(kind, {"count": 0, "bytes": 0})
+            allowed = int(declared.get(kind, manual[kind]))
+            if got["count"] <= allowed:
+                continue
+            findings.append(self.finding(
+                "SHARD001",
+                f"{kind}: {got['count']} in the compiled HLO "
+                f"({got['bytes']} bytes) against a declared reshard "
+                f"schedule of {allowed} "
+                f"({manual[kind]} manual jaxpr-level"
+                f"{', declared override ' + str(declared[kind]) if kind in declared else ''}) "
+                f"— GSPMD inserted layout conversions this entry point "
+                f"never scheduled; pin the producing specs or declare "
+                f"the reshard deliberately",
+                data={"kind": kind, "hlo": dict(got),
+                      "manual": manual[kind], "allowed": allowed}))
+        return findings
+
+    # ---- SHARD005 ---------------------------------------------------------
+
+    def _check_update_pin(self, ctx, min_bytes: int) -> List[Finding]:
+        import jax.numpy as jnp
+
+        findings = []
+        sharded_pin = False
+        for eqn, _ in walk_eqns(ctx.jaxpr):
+            if eqn.primitive.name != "sharding_constraint":
+                continue
+            aval = eqn.invars[0].aval
+            try:
+                if aval.ndim != 1 or aval.dtype != jnp.float32:
+                    continue
+                nbytes = int(aval.size) * 4
+            except Exception:
+                continue
+            if nbytes < min_bytes:
+                continue
+            spec = getattr(eqn.params.get("sharding"), "spec", None)
+            entries = tuple(spec) if spec is not None else ()
+            if any(e is not None for e in entries):
+                sharded_pin = True
+                continue
+            where, data = format_where(eqn)
+            findings.append(self.finding(
+                "SHARD005",
+                f"flat update buffer ({nbytes} bytes fp32) explicitly "
+                f"pinned REPLICATED — the optimizer read-modify-write "
+                f"runs in full on every device instead of sharding "
+                f"cross-replica (arxiv 2004.13336), and the unpinned "
+                f"concat→update→slice chain is the exact region the "
+                f"0.4.x GSPMD partitioner mis-lowers (see "
+                f"Adam.apply_flat)",
+                where=where, data={**data, "bytes": nbytes}))
+        if not sharded_pin and not findings:
+            findings.append(self.finding(
+                "SHARD005",
+                f"entry declares a sharded weight update "
+                f"(expect_update_pin) but carries NO sharding_constraint "
+                f"over any fp32 1-D buffer >= {min_bytes} bytes — the "
+                f"flat optimizer chain runs wherever GSPMD propagation "
+                f"lands it: replicated update traffic (2004.13336) and "
+                f"the unconstrained concat→update→slice layout the "
+                f"0.4.x toolchain mis-compiles (build_train_step must "
+                f"supply flat_sharding whenever a mesh is present)",
+                data={"min_bytes": min_bytes}))
+        return findings

@@ -276,6 +276,222 @@ def _overlap_target():
         target="overlap_train_step[dp2,sharding2,mp2]")
 
 
+# ---------------------------------------------------------------------------
+# round-14: the Sharding Doctor section (cross-stack partition
+# consistency).  Each flagship stack's entry is audited for
+# GSPMD-inserted resharding (SHARD001) against a DECLARED allowance,
+# its canonical SpecLayout table for replication waste / shard padding
+# (SHARD002/004), the flat-update entries for the 2004.13336
+# cross-replica pin (SHARD005), and the stacks' tables against each
+# other (SHARD003 — must be EMPTY on the llama flagship tree; this
+# table is the artifact the unified-partitioning refactor consumes).
+# ---------------------------------------------------------------------------
+
+# SHARD001 allowances for the debug-shaped flagship entries, measured
+# on the container toolchain and pinned as COMM001-style upper bounds.
+# These numbers ARE the finding of the round: the flat GSPMD stack pays
+# this many silent layout conversions per step — the unified schedule
+# derives its win from driving them down, and a regression ABOVE them
+# fails the doctor today.
+SHARDING_RESHARD_ALLOWANCES = {
+    "gspmd[accum1]": {"alltoall": 6, "collectivepermute": 0,
+                      "allgather": 33},
+    "gspmd[accum4]": {"alltoall": 23, "collectivepermute": 148,
+                      "allgather": 75},
+    # overlap: 2 manual bucket gathers; the rest is the GSPMD boundary
+    # (embedding/norm/head/loss outside the manual region)
+    "overlap": {"alltoall": 6, "collectivepermute": 0, "allgather": 7},
+    "hybrid[gpipe]": {"alltoall": 4, "collectivepermute": 8,
+                      "allgather": 3},
+    "hybrid[1F1B]": {"alltoall": 0, "collectivepermute": 2,
+                     "allgather": 3},
+}
+
+# SHARD002 floor for the debug-shaped tables (production default is
+# 1 MB; debug leaves top out at ~64 KB) — at this floor an accidentally
+# replicated projection leaf (16 KB) FAILS the sweep
+SHARDING_REPLICATED_MIN_BYTES = 4 << 10
+
+# params are replicated over the pure data axes by design (the grad
+# all-reduce rides them); only sharding/mp replication is waste
+SHARDING_DATA_AXES = ("dp", "pp", "sep")
+
+_SHARDING_MEMO: Dict = {}
+
+
+def _sharding_section() -> Dict[str, dict]:
+    """The per-stack sharding sweeps; memoized per backend (the hybrid
+    entries each compile the whole flagship, and the section is reached
+    from self_check, the smoke leg and the test suite in one process)."""
+    key = (jax.default_backend(), len(jax.devices()))
+    if key in _SHARDING_MEMO:
+        return _SHARDING_MEMO[key]
+    if len(jax.devices()) < 8:
+        return {"_skipped": {
+            "ok": True,
+            "skipped": f"needs >= 8 devices, have {len(jax.devices())} "
+                       f"(run under "
+                       f"XLA_FLAGS=--xla_force_host_platform_device_count"
+                       f"=8)"}}
+    out: Dict[str, dict] = {}
+    try:
+        for name, rep in _sharding_targets():
+            out[name] = {"ok": rep.ok,
+                         "findings": [f.format() for f in rep.findings],
+                         "suppressed": len(rep.suppressed),
+                         "skipped_passes": dict(rep.skipped)}
+    except Exception as e:  # noqa: BLE001 - structured failure, not a crash
+        # report the failure but do NOT memoize it: a one-off compile
+        # hiccup must not pin the doctor red for the process lifetime
+        out["_sweep_error"] = {"ok": False, "error": repr(e)}
+        return out
+    _SHARDING_MEMO[key] = out
+    return out
+
+
+def _sharding_targets():
+    """Yield (name, report) for the sharding sweeps + the cross-stack
+    table check; also stashes the canonical table on the section via
+    flagship_sharding_table()."""
+    from jax.sharding import Mesh
+
+    from .core import check
+    from .sharding import (check_cross_stack, check_layout,
+                           extract_gspmd_layout, extract_hybrid_layout,
+                           extract_overlap_layout)
+    from paddle_tpu.models import build_train_step
+    from paddle_tpu.models.llama import apply_llama_sharding, llama_decay_mask
+    from paddle_tpu.parallel.overlap import OverlapConfig
+
+    cfg, model, opt, params0, ids, labels = _flagship()
+    mesh = Mesh(np.asarray(jax.devices()[:8], dtype=object).reshape(
+        2, 2, 2), ("dp", "sharding", "mp"))
+    apply_llama_sharding(model, mesh)
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+    mask_all = llama_decay_mask(model)
+
+    glayout = extract_gspmd_layout(model, mesh)
+    table = {"layout": glayout,
+             "replicated_min_bytes": SHARDING_REPLICATED_MIN_BYTES,
+             "replication_ignore_axes": SHARDING_DATA_AXES}
+
+    # 1. flat GSPMD, single-batch (per-param optimizer: no flat pin to
+    # demand — the per-param update shards with the params themselves)
+    step1 = build_train_step(model, opt, mesh=mesh,
+                             compute_dtype=jnp.bfloat16)
+    yield "gspmd_train_step[accum1]", check(
+        step1, params, opt.init_state(params), 0, 1e-4, ids, labels,
+        passes=["sharding_consistency"],
+        options={"sharding_consistency": {
+            **table,
+            "declared": SHARDING_RESHARD_ALLOWANCES["gspmd[accum1]"]}},
+        target="sharding:gspmd_train_step[accum1]")
+
+    # 2. flat GSPMD, grad-accum + fused flat optimizer: the entry that
+    # must carry the 2004.13336 flat-update pin (deleting
+    # build_train_step's flat_sharding fails SHARD005 here, not a
+    # wrong-values session on the 0.4.x toolchain)
+    step4 = build_train_step(model, opt, mesh=mesh,
+                             compute_dtype=jnp.bfloat16, accum_steps=4)
+    yield "gspmd_train_step[accum4]", check(
+        step4, params, opt.init_flat_state(params, decay_mask=mask_all),
+        0, 1e-4, ids.reshape(4, 1, 16), labels.reshape(4, 1, 16),
+        passes=["sharding_consistency"],
+        options={"sharding_consistency": {
+            **table, "expect_update_pin": True,
+            "declared": SHARDING_RESHARD_ALLOWANCES["gspmd[accum4]"]}},
+        target="sharding:gspmd_train_step[accum4]")
+
+    # 3. the overlap engine: manual bucket gathers attribute via the
+    # jaxpr; the declared extras are the GSPMD-land boundary
+    olayout = extract_overlap_layout(model, mesh)
+    ostep = build_train_step(model, opt, mesh=mesh,
+                             compute_dtype=jnp.bfloat16,
+                             overlap=OverlapConfig())
+    yield "overlap_train_step", check(
+        ostep, params, opt.init_state(params), 0, 1e-4, ids, labels,
+        passes=["sharding_consistency"],
+        options={"sharding_consistency": {
+            "layout": olayout,
+            "replicated_min_bytes": SHARDING_REPLICATED_MIN_BYTES,
+            "replication_ignore_axes": SHARDING_DATA_AXES,
+            "declared": SHARDING_RESHARD_ALLOWANCES["overlap"]}},
+        target="sharding:overlap_train_step")
+
+    # 4. both hybrid bodies on the 5-axis mesh (pp2 x sharding2 x mp2)
+    from paddle_tpu.models.llama_hybrid import (hybrid_mesh,
+                                                shard_hybrid_state,
+                                                stack_llama_state)
+
+    hmesh = hybrid_mesh(jax.devices(), pp=2, dp=1, sharding=2, sep=1,
+                        mp=2)
+    hlayout = extract_hybrid_layout(model, hmesh)
+    # one stacked+placed state serves both schedule sweeps: check()
+    # only traces/compiles, never executes or donates the buffers
+    hstate = shard_hybrid_state(
+        stack_llama_state(dict(params), cfg.num_hidden_layers), hmesh)
+    for sched, tag in (("gpipe", "hybrid[gpipe]"), ("1F1B",
+                                                    "hybrid[1F1B]")):
+        from paddle_tpu.models.llama_hybrid import build_hybrid_train_step
+
+        hstep = build_hybrid_train_step(cfg, opt, hmesh,
+                                        num_microbatches=2,
+                                        compute_dtype=jnp.float32,
+                                        schedule=sched)
+        yield f"hybrid_train_step[{sched}]", check(
+            hstep, hstate, opt.init_state(hstate), 0, 1e-4, ids, labels,
+            passes=["sharding_consistency"],
+            options={"sharding_consistency": {
+                "layout": hlayout,
+                "replicated_min_bytes": SHARDING_REPLICATED_MIN_BYTES,
+                "replication_ignore_axes": SHARDING_DATA_AXES,
+                "declared": SHARDING_RESHARD_ALLOWANCES[tag]}},
+            target=f"sharding:hybrid_train_step[{sched}]")
+
+    # 5. serving stack: the engine's CONCRETE committed params — the
+    # single-chip flagship (params0, not the training-mesh copies; the
+    # compiled unified step's zero-reshard contract rides the
+    # serving_unified_step clean sweep via analysis_entry's options)
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    eng = ContinuousBatchingEngine(cfg, params0, max_slots=2,
+                                   num_pages=9, page_size=16,
+                                   max_seq_len=64,
+                                   prefill_token_budget=8)
+    yield "serving_param_layout", check_layout(
+        eng.param_layout(),
+        replicated_min_bytes=SHARDING_REPLICATED_MIN_BYTES,
+        target="sharding:serving_param_layout")
+
+    # 6. the cross-stack agreement gate: GSPMD, overlap and hybrid must
+    # map the llama flagship parameter tree to the SAME canonical specs
+    # (SHARD003 empty) — the precondition for deriving all three from
+    # one schedule object
+    yield "cross_stack", check_cross_stack(
+        {"gspmd": glayout, "overlap": olayout, "hybrid": hlayout},
+        target="sharding:cross_stack")
+
+
+def flagship_sharding_table() -> dict:
+    """The canonical SpecLayout table of the flagship GSPMD stack on
+    the 8-device hybrid-compatible mesh — DOCTOR.json's
+    ``sharding.canonical_table``, the artifact the future unified
+    partitioning schedule consumes (ROADMAP)."""
+    from jax.sharding import Mesh
+
+    from .sharding import extract_gspmd_layout
+    from paddle_tpu.models.llama import apply_llama_sharding
+
+    if len(jax.devices()) < 8:
+        return {"skipped": "needs >= 8 devices"}
+    cfg, model, opt, params, ids, labels = _flagship()
+    mesh = Mesh(np.asarray(jax.devices()[:8], dtype=object).reshape(
+        2, 2, 2), ("dp", "sharding", "mp"))
+    apply_llama_sharding(model, mesh)
+    return extract_gspmd_layout(model, mesh).to_table()
+
+
 def _probe_masked_grad_accum():
     """Liveness probe for EX-DT003-masked-grad-accum: the masked accum
     branch still carries its by-design fp32 buffer and the audit still
@@ -372,12 +588,26 @@ def self_check(clean: bool = True) -> dict:
         except Exception as e:  # noqa: BLE001
             result["exemptions"] = {"_liveness_error": {"ok": False,
                                                         "error": repr(e)}}
+        # round-14: the Sharding Doctor section — per-stack reshard
+        # audits, canonical-table checks and the cross-stack agreement
+        # gate; DOCTOR.json additionally carries the canonical table
+        # itself (the unified-partitioning refactor's input artifact)
+        try:
+            result["sharding"] = _sharding_section()
+        except Exception as e:  # noqa: BLE001
+            result["sharding"] = {"_section_error": {"ok": False,
+                                                     "error": repr(e)}}
+        try:
+            result["sharding_canonical_table"] = flagship_sharding_table()
+        except Exception as e:  # noqa: BLE001
+            result["sharding_canonical_table"] = {"error": repr(e)}
 
     def _all_ok(d):
         return all(v.get("ok") for v in d.values()) if d else True
 
     result["ok"] = all(_all_ok(result.get(k, {}))
-                       for k in ("seeded", "clean", "exemptions"))
+                       for k in ("seeded", "clean", "exemptions",
+                                 "sharding"))
     result["backend"] = jax.default_backend()
     result["num_devices"] = len(jax.devices())
     return result

@@ -1,0 +1,225 @@
+"""The canonical SpecLayout extractor — one per-logical-tensor
+``(mesh_axes, dim_map, memory_kind)`` table per stack.
+
+Three stacks hand-encode sharding independently; the ROADMAP's
+unified-partitioning item (PartIR, PAPERS.md 2401.11202) says they
+should all be DERIVED from one canonical per-tensor spec table.  Before
+that refactor can land safely, the specs have to be pinned: this module
+walks each entry point's placement rule — the GSPMD path's
+``NamedSharding``/``PartitionSpec`` plan, the overlap engine's manual
+shard_map layout + bucket plan, the hybrid bodies' axis choices, the
+serving engine's concrete arrays — and produces one comparable
+``parallel.specs.SpecLayout`` per stack.  The tables are what
+``passes/sharding_consistency.py`` audits (SHARD002-004 directly,
+SHARD003 across stacks) and the artifact the future unified schedule
+will consume (DOCTOR.json carries the flagship table).
+
+Canonical keys collapse the layer index: every stack places all decoder
+layers identically, so ``model.layers.3.self_attn.q_proj.weight``
+canonicalizes to ``model.layers.*.self_attn.q_proj.weight`` — one
+logical tensor per layer ROLE.  The hybrid stack's leading [L] stacking
+dim (sharded over pp) is layer-SET placement, not tensor placement, so
+its canonical per-layer entries drop it; ``hybrid_param_spec`` still
+exposes the full stacked spec for callers that place state.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional
+
+from ..parallel.specs import (SpecLayout, TensorSpec, layout_from_arrays,
+                              layout_mesh_axes, spec_to_dim_axes)
+from .exemptions import apply_exemptions
+from .findings import Report
+
+_LAYER_RE = re.compile(r"^(model\.layers\.)(\d+)\.")
+_PASS = "sharding_consistency"
+
+
+def canonical_key(name: str) -> str:
+    """Collapse the layer index: ``model.layers.<i>.X`` ->
+    ``model.layers.*.X``."""
+    return _LAYER_RE.sub(r"\g<1>*.", name)
+
+
+def collapse_layers(layout: SpecLayout) -> SpecLayout:
+    """Fold per-layer entries onto their canonical key, asserting every
+    layer carries the SAME spec — a per-layer divergence inside one
+    stack is a broken plan, not a cross-stack finding."""
+    entries: Dict[str, TensorSpec] = {}
+    for name, ts in layout.items():
+        key = canonical_key(name)
+        prev = entries.get(key)
+        if prev is not None and prev != ts:
+            raise ValueError(
+                f"{key}: layers disagree within one stack "
+                f"({prev.describe()} vs {ts.describe()}) — the "
+                f"canonical table assumes one spec per layer role")
+        entries[key] = ts
+    return SpecLayout(mesh_axes=layout.mesh_axes, entries=entries)
+
+
+# ---------------------------------------------------------------------------
+# per-stack extractors
+# ---------------------------------------------------------------------------
+
+
+def extract_gspmd_layout(model, mesh, plan=None) -> SpecLayout:
+    """Canonical table of the flat GSPMD stack (``build_train_step``
+    without overlap): the declared plan (``LLAMA_SHARDING_PLAN`` by
+    default) under the shared at-rest divisibility rule — exactly what
+    ``apply_llama_sharding`` places."""
+    from ..models.llama import plan_spec_for
+    from ..parallel.specs import filter_divisible_spec
+
+    entries: Dict[str, TensorSpec] = {}
+    for name, p in model.named_parameters():
+        shape = tuple(int(d) for d in p.shape)
+        spec = filter_divisible_spec(plan_spec_for(name, plan), shape,
+                                     mesh)
+        entries[name] = TensorSpec(
+            shape=shape, dtype=str(p.dtype),
+            dim_axes=spec_to_dim_axes(spec, len(shape)))
+    return collapse_layers(
+        SpecLayout(mesh_axes=layout_mesh_axes(mesh), entries=entries))
+
+
+def extract_overlap_layout(model, mesh, oc=None, plan=None) -> SpecLayout:
+    """Canonical table of the communication-overlap stack: decoder-layer
+    leaves from the engine's own layout plan
+    (``overlap.stack_layout_plan`` — the at-rest ZeRO-3/TP shard_map
+    in_specs the region slices by, bucket plan included in the
+    introspection), and the GSPMD rule for the leaves that stay outside
+    the manual region (embedding, final norm, LM head)."""
+    from ..models.llama import _LAYER_PREFIX, plan_spec_for
+    from ..parallel import overlap as _ov
+    from ..parallel.specs import filter_divisible_spec
+
+    oc = oc if oc is not None else _ov.OverlapConfig()
+    cfg = model.cfg
+    shapes = _ov.llama_layer_shapes(cfg)
+
+    def spec_for(suffix):
+        from ..models.llama import _filter_spec_to_mesh
+
+        return _filter_spec_to_mesh(plan_spec_for(suffix, plan), mesh)
+
+    layout, buckets, sync = _ov.stack_layout_plan(shapes, mesh, spec_for,
+                                                  oc)
+    entries: Dict[str, TensorSpec] = {}
+    params = dict(model.named_parameters())
+    for suffix, place in layout.items():
+        dims = [() for _ in place.shape]
+        if place.sh_dim is not None:
+            dims[place.sh_dim] = ("sharding",)
+        if place.mp_dim is not None:
+            dims[place.mp_dim] = ("mp",)
+        dtype = str(params[f"{_LAYER_PREFIX}0.{suffix}"].dtype)
+        entries[f"{_LAYER_PREFIX}*.{suffix}"] = TensorSpec(
+            shape=place.shape, dtype=dtype, dim_axes=tuple(dims))
+    for name, p in params.items():
+        if name.startswith(_LAYER_PREFIX):
+            continue          # decoder leaves: engine-owned, done above
+        shape = tuple(int(d) for d in p.shape)
+        spec = filter_divisible_spec(plan_spec_for(name, plan), shape,
+                                     mesh)
+        entries[name] = TensorSpec(
+            shape=shape, dtype=str(p.dtype),
+            dim_axes=spec_to_dim_axes(spec, len(shape)))
+    out = SpecLayout(mesh_axes=layout_mesh_axes(mesh), entries=entries)
+    out.buckets = buckets          # introspection riders (not compared)
+    out.sync_suffixes = sync
+    return out
+
+
+def extract_hybrid_layout(model, mesh, plan=None) -> SpecLayout:
+    """Canonical table of the hybrid gpipe/sched stack: the
+    ``hybrid_param_spec`` placement hook over the stacked state's
+    shapes.  Stacked leaves drop the leading [L] dim (layer-set
+    placement over pp, not tensor placement) so each per-layer role
+    compares 1:1 against the other stacks."""
+    from ..models.llama import _LAYER_PREFIX
+    from ..models.llama_hybrid import hybrid_param_spec
+
+    L = model.cfg.num_hidden_layers
+    entries: Dict[str, TensorSpec] = {}
+    for name, p in model.named_parameters():
+        shape = tuple(int(d) for d in p.shape)
+        if name.startswith(_LAYER_PREFIX):
+            suffix = name[len(_LAYER_PREFIX):].split(".", 1)[1]
+            full = hybrid_param_spec(_LAYER_PREFIX + suffix, (L,) + shape,
+                                     mesh, plan)
+            dims = spec_to_dim_axes(full, len(shape) + 1)[1:]   # drop [L]
+            entries[name] = TensorSpec(
+                shape=shape, dtype=str(p.dtype), dim_axes=dims)
+        else:
+            spec = hybrid_param_spec(name, shape, mesh, plan)
+            entries[name] = TensorSpec(
+                shape=shape, dtype=str(p.dtype),
+                dim_axes=spec_to_dim_axes(spec, len(shape)))
+    return collapse_layers(
+        SpecLayout(mesh_axes=layout_mesh_axes(mesh), entries=entries))
+
+
+def extract_serving_layout(engine) -> SpecLayout:
+    """Canonical table of the serving stack: the CONCRETE at-rest truth
+    of the engine's committed params (single-chip today: replicated
+    specs, quantized dtypes visible)."""
+    return collapse_layers(layout_from_arrays(engine.params))
+
+
+# ---------------------------------------------------------------------------
+# Report-producing helpers (table-level checks without a traced target —
+# the check_reshard_budget convention)
+# ---------------------------------------------------------------------------
+
+
+def check_layout(layout: SpecLayout, *, replicated_min_bytes=None,
+                 ignore_axes=(), exemptions=None,
+                 target: str = "layout") -> Report:
+    """SHARD002 (replication waste) + SHARD004 (shard padding) over one
+    canonical table.  ``ignore_axes``: the pure data axes the plan
+    replicates params over by design (dp/pp/sep)."""
+    from .passes.sharding_consistency import (REPLICATED_MIN_BYTES,
+                                              replication_waste_findings,
+                                              shard_padding_findings)
+
+    mb = (REPLICATED_MIN_BYTES if replicated_min_bytes is None
+          else replicated_min_bytes)
+    findings = replication_waste_findings(layout, mb,
+                                          ignore_axes=ignore_axes) \
+        + shard_padding_findings(layout)
+    active, suppressed = apply_exemptions(findings, exemptions)
+    return Report(target=target, findings=active, suppressed=suppressed,
+                  passes_run=(_PASS,))
+
+
+def check_cross_stack(layouts: Dict[str, SpecLayout], *, exemptions=None,
+                      target: str = "cross_stack") -> Report:
+    """SHARD003 across two or more stacks' canonical tables."""
+    from .passes.sharding_consistency import cross_stack_findings
+
+    findings = cross_stack_findings(layouts)
+    active, suppressed = apply_exemptions(findings, exemptions)
+    return Report(target=target, findings=active, suppressed=suppressed,
+                  passes_run=(_PASS,))
+
+
+def flagship_layouts(model, mesh, overlap_config=None
+                     ) -> Dict[str, SpecLayout]:
+    """The three training stacks' canonical tables for one model on one
+    mesh — the SHARD003 cross-stack probe and DOCTOR.json's
+    ``sharding.canonical_table`` source.  The hybrid table is only
+    extracted when the mesh carries the hybrid axes."""
+    out = {"gspmd": extract_gspmd_layout(model, mesh),
+           "overlap": extract_overlap_layout(model, mesh,
+                                             oc=overlap_config)}
+    try:
+        from ..models.llama_hybrid import HYBRID_AXES
+
+        if all(a in mesh.axis_names for a in HYBRID_AXES):
+            out["hybrid"] = extract_hybrid_layout(model, mesh)
+    except Exception:
+        pass
+    return out

@@ -349,12 +349,10 @@ def mesh_spans_slices(mesh: Mesh, axis: str,
     return hierarchical_axis(mesh, axis, slice_map) is not None
 
 
-def mesh_device_ids(mesh: Mesh) -> frozenset:
-    """The device-id set a mesh addresses.  Two meshes with EQUAL sets
-    can redistribute in-place (portable collectives, no host staging);
-    unequal sets are the elastic shrink/grow case — the reshard engine
-    (parallel/reshard.py) routes those through bounded host chunks."""
-    return frozenset(d.id for d in np.asarray(mesh.devices).flat)
+# canonical home is parallel/specs.py (mesh introspection shared with
+# the Sharding Doctor's extractor); re-exported here so the reshard
+# engine and fleet keep their ``topo.mesh_device_ids`` call sites
+from ..parallel.specs import mesh_device_ids  # noqa: F401, E402
 
 
 _hcg: Optional[HybridCommunicateGroup] = None

@@ -85,6 +85,8 @@ _INPLACE_BASES = [
     # reference defines (completes each family already partly wired)
     "asin", "cosh", "asinh", "acosh", "atanh", "log1p", "erfinv",
     "not_equal", "logical_xor",
+    # round-14 tranche: in-place partners of the new bases
+    "baddbmm", "index_reduce", "bitwise_invert",
 ]
 
 
@@ -511,6 +513,88 @@ def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1):
     _guard_inplace_fill(x, "fill_diagonal_tensor_")
     out = fill_diagonal_tensor(x, y, offset=offset, dim1=dim1, dim2=dim2)
     return _fill_inplace(x, _val(out))
+
+
+# --------------------------------------------------------------------------
+# round-14 tranche: the remaining method bases (lu_solve / baddbmm /
+# index_reduce and the bitwise_invert aliases; their method forms bind
+# in ops/tensor_methods.py, asserted by tests/test_tensor_method_parity)
+# --------------------------------------------------------------------------
+
+def lu_solve(b, lu, pivots, trans="N"):
+    """Solve ``A x = b`` from the (LU, pivots) pair ``paddle.linalg.lu``
+    produced (reference paddle.linalg.lu_solve; pivots follow this
+    build's lu convention — 0-based lu_factor output)."""
+    import jax
+
+    tr = {"N": 0, "T": 1, "H": 2}.get(str(trans).upper())
+    if tr is None:
+        raise ValueError(f"lu_solve: trans must be N/T/H, got {trans!r}")
+    out = jax.scipy.linalg.lu_solve(
+        (_val(lu), _val(pivots).astype(np.int32)), _val(b), trans=tr)
+    return _wrap(out.astype(_val(b).dtype))
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    """``beta * input + alpha * (x @ y)`` over batched matrices
+    (reference paddle.baddbmm)."""
+    iv, xv, yv = _val(input), _val(x), _val(y)
+    if xv.ndim != 3 or yv.ndim != 3:
+        raise ValueError(
+            f"baddbmm: x and y must be 3-D batched matrices, got "
+            f"{xv.ndim}-D and {yv.ndim}-D")
+    return _wrap(beta * iv + alpha * jnp.matmul(xv, yv))
+
+
+def index_reduce(x, index, axis, source, reduce, include_self=True):  # noqa: A002
+    """Scatter-reduce ``source`` rows into ``x`` along ``axis`` at
+    ``index`` (reference paddle.index_reduce; reduce in
+    prod/mean/amax/amin).  ``include_self=False`` seeds the reduction
+    from the scattered values alone, matching the reference."""
+    import builtins
+
+    v = _val(x)
+    idxv = _val(index).astype(jnp.int32)
+    src = _val(source).astype(v.dtype)
+    axis = int(axis) % v.ndim
+    loc = (builtins.slice(None),) * axis + (idxv,)
+    kinds = {"prod": "multiply", "amax": "max", "amin": "min",
+             "mean": "add"}
+    if reduce not in kinds:
+        raise ValueError(f"index_reduce: reduce must be one of "
+                         f"{sorted(kinds)}, got {reduce!r}")
+
+    def neutral(a):
+        if reduce == "prod":
+            return jnp.ones_like(a)
+        if reduce == "mean":
+            return jnp.zeros_like(a)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            lim = -jnp.inf if reduce == "amax" else jnp.inf
+        else:
+            info = jnp.iinfo(a.dtype)
+            lim = info.min if reduce == "amax" else info.max
+        return jnp.full_like(a, lim)
+
+    base = v if include_self else v.at[loc].set(neutral(v)[loc])
+    out = getattr(base.at[loc], kinds[reduce])(src)
+    if reduce == "mean":
+        counts = jnp.zeros((v.shape[axis],), jnp.float32) \
+            .at[idxv].add(1.0)
+        denom = counts + (1.0 if include_self else 0.0)
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        shape = [1] * v.ndim
+        shape[axis] = v.shape[axis]
+        out = (out.astype(jnp.float32)
+               / denom.reshape(shape)).astype(v.dtype)
+    return _wrap(out)
+
+
+def bitwise_invert(x, out=None, name=None):
+    """Alias of ``bitwise_not`` (reference paddle.bitwise_invert)."""
+    import paddle_tpu as _p
+
+    return _p.bitwise_not(x)
 
 
 def create_parameter(shape, dtype="float32", name=None, attr=None,

@@ -1588,8 +1588,21 @@ class ContinuousBatchingEngine:
                          for k in self.k_pages)
         options = {"donation": {"persistent": (0, 5, 6),
                                 "min_bytes": min(1 << 20,
-                                                 max(1, pool_bytes // 2))}}
+                                                 max(1, pool_bytes // 2))},
+                   # round-14 sharding contract: the single-chip serving
+                   # hot path schedules ZERO reshard-class collectives —
+                   # a GSPMD-inserted all-to-all/permute/gather here
+                   # means a spec leaked into the unified step
+                   "sharding_consistency": {"audit_resharding": True}}
         return fn, args, kwargs, options
+
+    def param_layout(self):
+        """Canonical SpecLayout of the engine's committed params (the
+        Sharding Doctor's serving-stack extractor entry; see
+        paddle_tpu.analysis.sharding.extract_serving_layout)."""
+        from ..analysis.sharding import extract_serving_layout
+
+        return extract_serving_layout(self)
 
     # ---------------- bench helper ----------------
 

@@ -398,35 +398,25 @@ def plan_spec_for(name: str, plan: Optional[Dict[str, P]] = None) -> P:
 
 
 def _filter_spec_to_mesh(spec: P, mesh: Mesh) -> P:
-    """Drop axes absent from the mesh (e.g. mp when running pure FSDP)."""
-    names = set(mesh.axis_names)
+    """Drop axes absent from the mesh (e.g. mp when running pure FSDP).
+    Canonical home: ``parallel.specs.filter_spec_to_mesh`` (shared with
+    the hybrid path and the Sharding Doctor's extractor)."""
+    from ..parallel.specs import filter_spec_to_mesh
 
-    def keep(e):
-        if e is None:
-            return None
-        if isinstance(e, tuple):
-            kept = tuple(a for a in e if a in names and mesh.shape[a] > 1)
-            return kept if kept else None
-        return e if (e in names and mesh.shape[e] > 1) else None
-
-    return P(*(keep(e) for e in tuple(spec)))
+    return filter_spec_to_mesh(spec, mesh)
 
 
 def apply_llama_sharding(model: Layer, mesh: Mesh,
                          plan: Optional[Dict[str, P]] = None) -> None:
     """Place every parameter per the plan (divisibility-checked; falls back
-    to replication for non-divisible dims)."""
+    to replication for non-divisible dims — the shared at-rest rule,
+    ``parallel.specs.filter_divisible_spec``)."""
+    from ..parallel.specs import filter_divisible_spec
+
     for name, p in model.named_parameters():
-        spec = _filter_spec_to_mesh(plan_spec_for(name, plan), mesh)
-        entries = list(tuple(spec))
-        for i, e in enumerate(entries):
-            if e is None:
-                continue
-            axes = e if isinstance(e, tuple) else (e,)
-            size = int(np.prod([mesh.shape[a] for a in axes]))
-            if i >= p.ndim or p.shape[i] % size != 0:
-                entries[i] = None
-        p.set_value(jax.device_put(p._value, NamedSharding(mesh, P(*entries))))
+        spec = filter_divisible_spec(plan_spec_for(name, plan),
+                                     tuple(p.shape), mesh)
+        p.set_value(jax.device_put(p._value, NamedSharding(mesh, spec)))
 
 
 # --------------------------------------------------------------------------

@@ -110,35 +110,42 @@ def unstack_llama_state(hstate: Dict[str, Any], num_layers: int
     return out
 
 
+def hybrid_param_spec(name: str, shape: Tuple[int, ...], mesh: Mesh,
+                      plan: Optional[Dict[str, P]] = None) -> P:
+    """At-rest PartitionSpec of ONE hybrid-state leaf — the placement
+    rule of ``shard_hybrid_state``, exposed as a pure shape-level hook
+    so the Sharding Doctor's extractor can read this stack's canonical
+    layout without materializing state.  Stacked leaves
+    (``model.layers.<suffix>``, leading [L] dim) ride P('pp',
+    *plan-dims); non-layer leaves get their plan spec directly
+    (replicated over pp/sep).  Non-divisible dims fall back to
+    replication via the shared rule (parallel.specs)."""
+    from ..parallel.specs import filter_divisible_spec
+
+    stacked = name.startswith(_LAYER_PREFIX)
+    inner = tuple(shape[1:]) if stacked else tuple(shape)
+    spec = filter_divisible_spec(plan_spec_for(name, plan), inner, mesh)
+    if not stacked:
+        return spec
+    if shape[0] % mesh.shape["pp"]:
+        raise ValueError(
+            f"{name}: {shape[0]} layers not divisible by pp degree "
+            f"{mesh.shape['pp']}")
+    lead = "pp" if mesh.shape["pp"] > 1 else None
+    return P(lead, *tuple(spec))
+
+
 def shard_hybrid_state(hstate: Dict[str, Any], mesh: Mesh,
                        plan: Optional[Dict[str, P]] = None) -> Dict[str, Any]:
-    """Place the stacked state on the hybrid mesh: stacked leaves get
-    P('pp', *plan-dims); non-layer leaves get their plan spec directly
-    (replicated over pp/sep).  Non-divisible dims fall back to
-    replication, mirroring apply_llama_sharding."""
-    out = {}
-    for name, v in hstate.items():
-        stacked = name.startswith(_LAYER_PREFIX)
-        spec = _filter_spec_to_mesh(plan_spec_for(name, plan), mesh)
-        entries = list(tuple(spec))
-        shape = v.shape[1:] if stacked else v.shape
-        for i, e in enumerate(entries):
-            if e is None:
-                continue
-            axes = e if isinstance(e, tuple) else (e,)
-            size = int(np.prod([mesh.shape[a] for a in axes]))
-            if i >= len(shape) or shape[i] % size != 0:
-                entries[i] = None
-        if stacked:
-            full = P("pp", *entries) if mesh.shape["pp"] > 1 else P(None, *entries)
-            if v.shape[0] % mesh.shape["pp"]:
-                raise ValueError(
-                    f"{name}: {v.shape[0]} layers not divisible by pp degree "
-                    f"{mesh.shape['pp']}")
-        else:
-            full = P(*entries)
-        out[name] = jax.device_put(v, NamedSharding(mesh, full))
-    return out
+    """Place the stacked state on the hybrid mesh per
+    ``hybrid_param_spec`` (single copy of the placement rule — the
+    extractor reads the same hook)."""
+    return {
+        name: jax.device_put(
+            v, NamedSharding(mesh,
+                             hybrid_param_spec(name, tuple(v.shape), mesh,
+                                               plan)))
+        for name, v in hstate.items()}
 
 
 def init_hybrid_state(model, mesh: Mesh) -> Dict[str, Any]:
@@ -475,11 +482,11 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
     def _pick_batch_axes(mb: int) -> Tuple[str, ...]:
         """Largest data_axes prefix whose degree product tiles mb
         exactly (manual in_specs demand exact tiling; 'sharding' drops
-        first and falls back to a weights-only axis)."""
-        used = batch_axes
-        while used and mb % int(np.prod([mesh.shape[a] for a in used])):
-            used = used[:-1]
-        return used
+        first and falls back to a weights-only axis).  Single copy of
+        the rule: parallel.specs.pick_batch_axes."""
+        from ..parallel.specs import pick_batch_axes
+
+        return pick_batch_axes(mesh, batch_axes, mb)
 
     # ---- schedule-explicit runtime (1F1B / ZBH1 / FThenB) ----
     sched = None

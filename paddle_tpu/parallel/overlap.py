@@ -274,28 +274,18 @@ def plan_layer_layout(shapes: Dict[str, Tuple[int, ...]], mesh: Mesh,
     """Per-suffix placement of one decoder layer's leaves on the mesh:
     which dim rides 'sharding' (ZeRO-3, gathered by the engine) and
     which rides 'mp' (TP, stays local).  Non-divisible dims fall back to
-    replication — the same rule as apply_llama_sharding, recomputed here
-    because the manual region must KNOW the layout, not infer it."""
+    replication per axis — the single copy of the pick rule lives in
+    parallel.specs.axis_dim_picks (shared with the Sharding Doctor's
+    extractor), because the manual region must KNOW the layout, not
+    infer it."""
+    from .specs import axis_dim_picks
+
     out: Dict[str, _LeafPlace] = {}
     for suffix, shape in shapes.items():
-        spec = spec_for(suffix)
-        sh_dim = mp_dim = None
-        for i, entry in enumerate(tuple(spec)):
-            if entry is None or i >= len(shape):
-                continue
-            axes = entry if isinstance(entry, tuple) else (entry,)
-            for a in axes:
-                if a not in mesh.axis_names or mesh.shape[a] <= 1:
-                    continue
-                if shape[i] % int(mesh.shape[a]):
-                    continue          # replication fallback
-                if a == "sharding" and sh_dim is None:
-                    sh_dim = i
-                elif a == "mp" and mp_dim is None:
-                    mp_dim = i
-        if sh_dim is not None and sh_dim == mp_dim:
-            mp_dim = None
-        out[suffix] = _LeafPlace(suffix, tuple(shape), sh_dim, mp_dim)
+        picks = axis_dim_picks(spec_for(suffix), shape, mesh,
+                               axes=("sharding", "mp"))
+        out[suffix] = _LeafPlace(suffix, tuple(shape),
+                                 picks["sharding"], picks["mp"])
     return out
 
 
@@ -717,6 +707,28 @@ OVERLAP_REGION_FUNCS = frozenset({
 })
 
 
+def stack_layout_plan(shapes: Dict[str, Tuple[int, ...]], mesh: Mesh,
+                      spec_for: Callable[[str], P], oc: OverlapConfig,
+                      compute_dtype=jnp.bfloat16):
+    """The engine's at-rest layout decision as a pure shape-level plan:
+    (layout, buckets, sync_suffixes) — the leaf placements
+    (sharding/mp dim picks), the size-capped gather-bucket plan, and
+    the non-gathered (grad-sync) leaves.  ``build_overlap_stack``
+    consumes exactly this (single copy — no behavior change), and the
+    Sharding Doctor's extractor reads the same hook to build this
+    stack's canonical SpecLayout table without tracing the region."""
+    layout = plan_layer_layout(shapes, mesh, spec_for)
+    order = sorted(shapes)
+    sh = int(mesh.shape.get("sharding", 1))
+    mp = int(mesh.shape.get("mp", 1))
+    itemsize = jnp.dtype(compute_dtype).itemsize
+    buckets = plan_buckets(layout, order, sh, mp, oc.bucket_bytes,
+                           itemsize)
+    gathered = {s for b in buckets for s in b}
+    sync_suffixes = [s for s in order if s not in gathered]
+    return layout, buckets, sync_suffixes
+
+
 def build_overlap_stack(cfg, mesh: Mesh,
                         shapes: Dict[str, Tuple[int, ...]],
                         spec_for: Callable[[str], P],
@@ -749,13 +761,9 @@ def build_overlap_stack(cfg, mesh: Mesh,
     psum_axes = tuple(a for a in data_axes if a != "sharding")
     hier = oc.resolve_hier(mesh, sh_ax)
 
-    layout = plan_layer_layout(shapes, mesh, spec_for)
+    layout, buckets, sync_suffixes = stack_layout_plan(
+        shapes, mesh, spec_for, oc, compute_dtype)
     order = sorted(shapes)
-    itemsize = jnp.dtype(compute_dtype).itemsize
-    buckets = plan_buckets(layout, order, sh, mp, oc.bucket_bytes,
-                           itemsize)
-    gathered = {s for b in buckets for s in b}
-    sync_suffixes = [s for s in order if s not in gathered]
 
     gather_fns = [make_bucket_gather(sh_ax, hier, psum_axes)
                   for _ in buckets]

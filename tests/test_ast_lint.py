@@ -1,8 +1,11 @@
 """Tier-1 repo lint (ISSUE 3 satellite): no host-numpy calls and no
 python branches on tracer-suspect values inside the traced/kernel layers
-(ops/pallas/, models/, parallel/) — except the explicitly-reviewed
-entries in paddle_tpu/analysis/ast_allowlist.txt, every one of which must
-still be LIVE (unused entries fail too, so the allowlist cannot rot)."""
+(ops/pallas/, models/, parallel/), and — round-14 (the Sharding Doctor
+satellite) — no hand-written PartitionSpec literals inside models/ and
+inference/ (AST003: specs are schedule decisions and belong in the
+parallel/ layer) — except the explicitly-reviewed entries in
+paddle_tpu/analysis/ast_allowlist.txt, every one of which must still be
+LIVE (unused entries fail too, so the allowlist cannot rot)."""
 
 import textwrap
 
@@ -19,6 +22,10 @@ def test_repo_lint_is_clean_against_allowlist():
     assert not unused, f"stale allowlist entries (remove them): {unused}"
     # the allowlist is meaningful, not vestigial
     assert allowed, "expected known host-precompute allowlist hits"
+    # the AST003 seed is live too: the declared plans themselves are the
+    # reviewed residue (and the unified-partitioning work-list)
+    assert any(f.code == "AST003" for f in allowed), \
+        "expected the seeded AST003 plan/constraint sites to be hit"
 
 
 def test_lint_flags_numpy_call_in_function():
@@ -56,6 +63,47 @@ def test_lint_allows_dtype_predicates_and_host_code():
         PI = 3.14159  # module-level host math is not a call
     """)
     assert lint_source(src, "models/fake.py") == []
+
+
+def test_lint_flags_partition_spec_literal_in_models():
+    src = textwrap.dedent("""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        def place(x, mesh):
+            return NamedSharding(mesh, P("dp", None))
+    """)
+    findings = lint_source(src, "models/fake.py")
+    assert [f.code for f in findings] == ["AST003"]
+    assert findings[0].data["function"] == "place"
+    # the un-aliased spelling is flagged too
+    src2 = textwrap.dedent("""
+        import jax.sharding as jsh
+        SPEC = jsh.PartitionSpec("mp", None)
+    """)
+    assert [f.code for f in lint_source(src2, "inference/fake.py")] \
+        == ["AST003"]
+
+
+def test_spec_literal_scope_is_models_and_inference_only():
+    """AST003 must NOT fire in parallel/ — that layer is where specs
+    BELONG (lint_repo's per-dir scoping; direct lint_source defaults to
+    all codes, so scope through the codes parameter here)."""
+    src = textwrap.dedent("""
+        from jax.sharding import PartitionSpec as P
+        def schedule():
+            return P("sharding", "mp")
+    """)
+    assert lint_source(src, "parallel/fake.py",
+                       codes={"AST001", "AST002"}) == []
+    # and inference/ opts into AST003 only: a tracer-suspect branch
+    # there is out of scope for this lint (engines run eager host loops)
+    host = textwrap.dedent("""
+        import jax.numpy as jnp
+        def sched(x):
+            if jnp.any(x > 0):
+                return x
+    """)
+    assert lint_source(host, "inference/fake.py",
+                       codes={"AST003"}) == []
 
 
 def test_malformed_allowlist_line_raises(tmp_path):

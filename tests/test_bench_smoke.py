@@ -52,6 +52,11 @@ def test_bench_smoke_green():
                 # replica's weights arrive through the cached
                 # MEM001-budgeted reshard plan within one router tick
                 # (replica_recovery)
-                "router_parity", "replica_recovery"):
+                "router_parity", "replica_recovery",
+                # round-14: the Sharding Doctor — SHARD001-005 seeded
+                # fixtures fire exactly, and the GSPMD/overlap/hybrid
+                # canonical SpecLayout tables agree on the llama
+                # flagship parameter tree (SHARD003 empty)
+                "sharding_doctor"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]

@@ -134,6 +134,20 @@ _ROUND13_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND13_TRANCHE
 
+# names added by the round-14 tranche (the Sharding Doctor round's
+# satellite: scaled-tanh/complex construction method forms, the
+# sampling methods, the lu_solve/baddbmm linalg tail, scatter-reduce +
+# the bitwise_invert alias pair, and the cpu/pin_memory place methods)
+# — appended into _REQUIRED_METHODS AND counted against the ~15 floor
+# by test_method_count_tranche_round14
+_ROUND14_TRANCHE = [
+    "stanh", "polar", "complex", "binomial", "standard_gamma",
+    "top_p_sampling", "lu_solve", "baddbmm", "baddbmm_",
+    "index_reduce", "index_reduce_", "bitwise_invert",
+    "bitwise_invert_", "pin_memory", "contiguous", "is_contiguous",
+]
+_REQUIRED_METHODS += _ROUND14_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -394,6 +408,81 @@ def test_round13_structural_method_values():
     b = paddle.to_tensor(np.array([[1.0, 1.0], [1.0, 1.0]], np.float32))
     assert not bool(np.asarray(a.equal_all(b)._value))
     assert bool(np.asarray(a.equal_all(a.clone())._value))
+
+
+def test_method_count_tranche_round14():
+    """The round-14 tranche satisfies the ~15-new-names floor (ISSUE 9
+    satellite) over the round-13 surface."""
+    wired = [n for n in _ROUND14_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 15, (len(wired),
+                              sorted(set(_ROUND14_TRANCHE) - set(wired)))
+
+
+def test_round14_method_values():
+    t = paddle.to_tensor(np.array([0.5, -1.0], np.float32))
+    # stanh = scale_b * tanh(scale_a * x)
+    np.testing.assert_allclose(
+        np.asarray(t.stanh(0.67, 1.7159)._value),
+        1.7159 * np.tanh(0.67 * np.array([0.5, -1.0])), rtol=1e-6)
+    mag = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    ang = paddle.to_tensor(np.array([0.0, np.pi / 2], np.float32))
+    pol = np.asarray(mag.polar(ang)._value)
+    np.testing.assert_allclose(pol.real, [1.0, 0.0], atol=1e-6)
+    np.testing.assert_allclose(pol.imag, [0.0, 2.0], atol=1e-6)
+    comp = np.asarray(mag.complex(ang)._value)
+    assert comp.dtype == np.complex64
+    inv = paddle.to_tensor(np.array([0, 255], np.uint8)).bitwise_invert()
+    np.testing.assert_array_equal(np.asarray(inv._value), [255, 0])
+    # lu_solve round-trips through this build's lu convention
+    a = paddle.to_tensor(np.array([[3.0, 1.0], [1.0, 2.0]], np.float32))
+    b = paddle.to_tensor(np.array([9.0, 8.0], np.float32))
+    lu, piv = a.lu()
+    x = np.asarray(b.lu_solve(lu, piv)._value)
+    np.testing.assert_allclose(a._value @ x, [9.0, 8.0], rtol=1e-5)
+    # baddbmm: beta*input + alpha*(x@y), batched
+    i3 = paddle.to_tensor(np.ones((1, 2, 2), np.float32))
+    x3 = paddle.to_tensor(np.full((1, 2, 3), 2.0, np.float32))
+    y3 = paddle.to_tensor(np.full((1, 3, 2), 1.0, np.float32))
+    out = np.asarray(i3.baddbmm(x3, y3, beta=0.5, alpha=2.0)._value)
+    np.testing.assert_allclose(out, np.full((1, 2, 2), 12.5))
+    # nucleus sampling: with p tight enough, greedy == argmax
+    probs = paddle.to_tensor(np.array([[0.05, 0.9, 0.05]], np.float32))
+    ps = paddle.to_tensor(np.array([0.5], np.float32))
+    scores, ids = probs.top_p_sampling(ps)
+    assert int(np.asarray(ids._value)[0, 0]) == 1
+    np.testing.assert_allclose(np.asarray(scores._value)[0, 0], 0.9,
+                               rtol=1e-6)
+    # sampling method forms draw with the right support
+    draws = paddle.to_tensor(np.full((64,), 8.0, np.float32)) \
+        .standard_gamma()
+    assert (np.asarray(draws._value) > 0.0).all()
+    bin_ = paddle.to_tensor(np.full((64,), 10.0, np.float32)) \
+        .binomial(paddle.to_tensor(np.full((64,), 0.5, np.float32)))
+    bv = np.asarray(bin_._value)
+    assert (bv >= 0).all() and (bv <= 10).all()
+    # place/stride methods are identity on committed jax buffers
+    assert t.pin_memory() is t and t.contiguous() is t
+    assert t.is_contiguous() is True
+
+
+def test_round14_index_reduce_values():
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    idx = paddle.to_tensor(np.array([0, 2, 0], np.int64))
+    src = paddle.to_tensor(np.array([[2.0, 2.0], [3.0, 3.0],
+                                     [4.0, 4.0]], np.float32))
+    out = np.asarray(x.index_reduce(idx, 0, src, "prod")._value)
+    np.testing.assert_allclose(out, [[8.0, 8.0], [1.0, 1.0],
+                                     [3.0, 3.0]])
+    mean = np.asarray(
+        x.index_reduce(idx, 0, src, "mean",
+                       include_self=False)._value)
+    np.testing.assert_allclose(mean, [[3.0, 3.0], [1.0, 1.0],
+                                      [3.0, 3.0]])
+    y = paddle.to_tensor(np.ones((3, 2), np.float32))
+    r = y.index_reduce_(idx, 0, src, "amax")
+    assert r is y
+    np.testing.assert_allclose(np.asarray(y._value),
+                               [[4.0, 4.0], [1.0, 1.0], [3.0, 3.0]])
 
 
 def test_round13_fill_and_apply_method_values():
