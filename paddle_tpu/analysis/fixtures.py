@@ -308,6 +308,49 @@ def seeded_ppermute_ring_order() -> Report:
                  target="seeded:COMM003")
 
 
+def seeded_codec_disabled() -> Report:
+    """COMM004: a fake-2-slice hierarchical reduce-scatter whose codec
+    is silently DISABLED, checked against the DCN wire budget its
+    QUANTIZED schedule honors — the packed int8 payload prices at ~1/4
+    the fp32 bytes, so the unquantized DCN stage blows straight through
+    the post-codec contract (the regression class the codec knob makes
+    possible: one dropped ``codec=`` kwarg re-inflates every DCN hop)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+    from ..distributed.topology import hierarchical_axis
+    from ..parallel.codec import CollectiveCodec
+    from ..parallel.overlap import hier_psum_scatter
+    from .passes.collective_budget import collect_wire_table
+
+    mesh = _mesh(4)
+    if mesh.shape["x"] < 4:
+        raise FixtureUnavailable("fake 2-slice split needs an axis of 4")
+    sm = (0, 0, 1, 1)
+    hier = hierarchical_axis(mesh, "x", slice_map=sm)
+    codec = CollectiveCodec(block=64)
+
+    def coded(v):
+        return hier_psum_scatter(v, "x", hier, codec=codec)
+
+    def uncoded(v):                      # the seeded bug: codec dropped
+        return hier_psum_scatter(v, "x", hier)
+
+    def wrap(body):
+        return shard_map(body, mesh=mesh, in_specs=(P(),),
+                         out_specs=P("x"), check_vma=False)
+
+    x = jnp.ones((16, 64), jnp.float32)
+    # the declared budget IS the quantized schedule's measured DCN bytes
+    coded_jaxpr = jax.make_jaxpr(wrap(coded))(x).jaxpr
+    budget = collect_wire_table(coded_jaxpr, {"x": sm})["dcn"]["bytes"]
+    return check(wrap(uncoded), x, passes=["collective_budget"],
+                 exemptions=(), target="seeded:COMM004",
+                 options={"collective_budget":
+                          {"wire": {"dcn_axes": {"x": list(sm)},
+                                    "dcn_bytes": budget}}})
+
+
 # ---------------------------------------------------------------------------
 # memory_budget
 # ---------------------------------------------------------------------------
@@ -570,6 +613,9 @@ SEEDED = {
     "COMM001": seeded_collective_budget,
     "COMM002": seeded_unscheduled_collective,
     "COMM003": seeded_ppermute_ring_order,
+    # round-15: post-codec bytes-on-the-wire — a silently-disabled
+    # quantized-DCN codec blows the declared DCN wire budget
+    "COMM004": seeded_codec_disabled,
     "DT001": seeded_fp32_matmul,
     "DT002": seeded_f64_leak,
     "DT003": seeded_fp32_carry,

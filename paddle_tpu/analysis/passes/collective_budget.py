@@ -29,12 +29,28 @@ Codes:
   receives from a different relative neighbour than stage s' sends
   toward, the cross-stage pairing bug that deadlocks a static pipeline
   schedule.  (Repeated sources/destinations are COLL002's beat.)
+- COMM004 (round-15, the quantized-collective gate): POST-CODEC
+  bytes-on-the-wire per axis stage (ICI vs DCN) exceed the declared
+  wire budget.  The entry declares ``{"wire": {"dcn_axes": {axis:
+  slice_map}, "dcn_bytes": n[, "ici_bytes": m]}}``; the pass walks the
+  jaxpr's manual collectives, prices each with the standard ring cost
+  model on its ACTUAL payload dtype (an int8 packed payload bills 1
+  byte/element — quantization shows up as measured savings, a codec
+  silently disabled as a budget blowout), multiplies by enclosing scan
+  trip counts, and classifies each collective's stage from its
+  axis_index_groups against the declared per-axis slice map (a group
+  whose positions span >= 2 slices crosses DCN; a flat collective over
+  a slice-spanning axis crosses DCN).  ``collect_wire_table`` is the
+  reusable accounting entry (bench --comm-bytes-trace, DOCTOR.json's
+  per-stage bytes table).
 """
 
 from __future__ import annotations
 
 import re
 from typing import Dict, List
+
+import numpy as np
 
 from ..core import (AnalysisContext, AnalysisPass, SkipPass, format_where,
                     register_pass, sub_jaxprs, walk_eqns)
@@ -94,6 +110,123 @@ def scan_hlo_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
     return out
 
 
+# jaxpr collective primitive -> budget-kind name (the wire table's keys
+# match COMM001's HLO kinds so the two tallies read side-by-side)
+_WIRE_PRIMS = {
+    "psum": "allreduce", "psum2": "allreduce",
+    "all_gather": "allgather", "all_gather_invariant": "allgather",
+    "psum_scatter": "reducescatter", "reduce_scatter": "reducescatter",
+    "all_to_all": "alltoall",
+    "ppermute": "collectivepermute", "pshuffle": "collectivepermute",
+}
+
+
+def _eqn_axes(eqn):
+    ax = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if ax is None:
+        ax = ()
+    return tuple(ax) if isinstance(ax, (tuple, list)) else (ax,)
+
+
+def _eqn_in_bytes(eqn) -> int:
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", None)
+        dtype = getattr(aval, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * np.dtype(dtype).itemsize
+    return total
+
+
+def _ring_wire_cost(kind: str, nbytes: int, g: int) -> int:
+    """Bytes each participant SENDS under the standard ring cost model
+    (the structural bytes-on-the-wire currency; constant factors cancel
+    in the codec-on/off ratio COMM004 budgets)."""
+    if g <= 1:
+        return 0
+    if kind == "allgather":
+        return nbytes * (g - 1)              # shard relayed g-1 times
+    if kind == "reducescatter":
+        return nbytes * (g - 1) // g
+    if kind == "allreduce":
+        return 2 * nbytes * (g - 1) // g     # RS + AG halves
+    if kind == "alltoall":
+        return nbytes * (g - 1) // g
+    return nbytes                            # permute: one hop
+
+
+def _wire_group_size(eqn, axis_sizes, axes) -> int:
+    groups = eqn.params.get("axis_index_groups")
+    if groups:
+        return len(groups[0])
+    g = 1
+    for a in axes:
+        g *= int(axis_sizes.get(str(a), 1))
+    return g
+
+
+def _wire_stage(eqn, axes, dcn_axes) -> str:
+    """"dcn" when the collective's communication pattern crosses slices
+    per the declared per-axis slice maps, else "ici".  With
+    axis_index_groups, a group whose positions land on >= 2 distinct
+    slices crosses DCN (the two-stage schedule's ICI groups stay within
+    one slice by construction); without groups, a flat collective over
+    a slice-spanning axis crosses DCN."""
+    for a in axes:
+        sm = dcn_axes.get(str(a))
+        if sm is None:
+            continue
+        groups = eqn.params.get("axis_index_groups")
+        if groups:
+            for grp in groups:
+                if len({sm[int(p)] for p in grp}) > 1:
+                    return "dcn"
+        elif len(set(sm)) > 1:
+            return "dcn"
+    return "ici"
+
+
+def collect_wire_table(jaxpr, dcn_axes: Dict) -> Dict[str, Dict]:
+    """Post-codec bytes-on-the-wire per (stage, collective kind) from a
+    jaxpr's MANUAL (shard_map) collectives.  ``dcn_axes`` maps axis
+    name -> slice index per axis position (the fake-2-slice test shape
+    and topology.axis_slice_map's output).  Scan-nested collectives
+    multiply by their trip counts.  Bytes follow the payload's ACTUAL
+    dtype — the whole point: an int8 packed payload prices at 1
+    byte/element."""
+    table = {s: {"count": 0, "bytes": 0, "kinds": {}}
+             for s in ("ici", "dcn")}
+    for eqn, stack in walk_eqns(jaxpr):
+        kind = _WIRE_PRIMS.get(eqn.primitive.name)
+        if kind is None:
+            continue
+        shard_maps = [e for e in stack if e.primitive.name == "shard_map"]
+        if not shard_maps:
+            continue              # GSPMD-land; COMM001's HLO tally covers
+        axes = _eqn_axes(eqn)
+        g = _wire_group_size(eqn, _shard_map_axis_sizes(shard_maps[-1]),
+                             axes)
+        if g <= 1:
+            continue
+        mult = 1
+        for e in stack:
+            if e.primitive.name == "scan":
+                mult *= int(e.params.get("length", 1) or 1)
+        cost = _ring_wire_cost(kind, _eqn_in_bytes(eqn), g) * mult
+        stage = table[_wire_stage(eqn, axes, dcn_axes or {})]
+        stage["count"] += mult
+        stage["bytes"] += cost
+        ent = stage["kinds"].setdefault(kind, {"count": 0, "bytes": 0})
+        ent["count"] += mult
+        ent["bytes"] += cost
+    return table
+
+
 def _overlap_region_funcs(extra=()) -> frozenset:
     from ...parallel.overlap import OVERLAP_REGION_FUNCS
 
@@ -115,9 +248,9 @@ def _shard_map_axis_sizes(eqn) -> Dict[str, int]:
 @register_pass
 class CollectiveBudgetPass(AnalysisPass):
     name = "collective_budget"
-    codes = ("COMM001", "COMM002", "COMM003")
+    codes = ("COMM001", "COMM002", "COMM003", "COMM004")
     # the budget needs the compiled HLO, but the pass only compiles when
-    # a budget is actually declared (COMM002/COMM003 are jaxpr-level)
+    # a budget is actually declared (COMM002/3/4 are jaxpr-level)
     requires = "jaxpr"
 
     def run(self, ctx: AnalysisContext) -> List[Finding]:
@@ -126,19 +259,23 @@ class CollectiveBudgetPass(AnalysisPass):
                   if k in set(_HLO_KINDS.values())}
         overlap_active = bool(opts.get("overlap_active"))
         extra_funcs = tuple(opts.get("overlap_region_functions", ()))
-        if not budget and not overlap_active:
+        wire = opts.get("wire") or {}
+        if not budget and not overlap_active and not wire:
             # COMM003 still applies (it needs no declaration), but a
             # target with no shard_map region has nothing to check
             if not self._has_shard_map(ctx):
                 raise SkipPass(
                     "no collective budget declared, no overlap engine "
-                    "active, and no shard_map region to ring-check")
+                    "active, no wire budget, and no shard_map region "
+                    "to ring-check")
         findings: List[Finding] = []
         if budget:
             findings.extend(self._check_budget(ctx, budget))
         if overlap_active:
             findings.extend(self._check_overlap_regions(ctx, extra_funcs))
         findings.extend(self._check_ring_order(ctx))
+        if wire:
+            findings.extend(self._check_wire(ctx, wire))
         return findings
 
     # ---- COMM001 ----------------------------------------------------------
@@ -189,6 +326,27 @@ class CollectiveBudgetPass(AnalysisPass):
                 f"prefetch/bucket plan (stack: "
                 f"{sorted(fns) or ['<no provenance>']})",
                 where=where, data=data))
+        return findings
+
+    # ---- COMM004 ----------------------------------------------------------
+
+    def _check_wire(self, ctx, wire) -> List[Finding]:
+        table = collect_wire_table(ctx.jaxpr, wire.get("dcn_axes", {}))
+        findings = []
+        for stage in ("dcn", "ici"):
+            lim = wire.get(f"{stage}_bytes")
+            got = table[stage]["bytes"]
+            if lim is not None and got > int(lim):
+                findings.append(self.finding(
+                    "COMM004",
+                    f"{stage.upper()} stage moves {got} post-codec "
+                    f"bytes-on-the-wire per step against a declared "
+                    f"budget of {int(lim)} (per-kind: "
+                    f"{table[stage]['kinds']}) — either the codec is "
+                    f"silently disabled on this entry or the schedule "
+                    f"grew past its wire contract",
+                    data={"stage": stage, "measured": got,
+                          "budget": int(lim), "table": table}))
         return findings
 
     # ---- COMM003 ----------------------------------------------------------

@@ -50,6 +50,16 @@ DONATION_MIN_BYTES = 4 << 10
 FLAGSHIP_HBM_BUDGET = 3 << 20
 FLAGSHIP_STREAM_BUDGET = 6 << 20
 
+# Round-15 wire contract for the debug-shaped flagship on the fake
+# 2-slice hierarchical mesh (dp1 x sharding4[2 slices] x mp2) with the
+# DCN codec ON: the quantized schedule measures ~19.5 KB of post-codec
+# DCN bytes per step (int8 payload + bf16 scale sidecars; the
+# unquantized schedule moves ~56 KB).  24 KB pins it with ~20%
+# headroom — silently dropping the codec (or re-inflating a DCN hop to
+# a float dtype) blows COMM004 here, not a multislice TPU session.
+FLAGSHIP_DCN_WIRE_BUDGET = 24 << 10
+FLAGSHIP_SLICE_MAP = (0, 0, 1, 1)
+
 # Round-11 capacity contract for the debug-shaped UNIFIED serving step
 # (radix prefix cache + chunked prefill + speculative verify in one
 # ragged launch): the self-check engine (2 slots, 9 pages, chunk 8)
@@ -275,6 +285,32 @@ def _overlap_target():
         declared_dtype=jnp.bfloat16,
         target="overlap_train_step[dp2,sharding2,mp2]")
 
+    # round-15: the hierarchical fake-2-slice step with the quantized-
+    # DCN codec ON, pinned to its post-codec wire budget (COMM004) —
+    # and every coded collective still engine-attributed (COMM002)
+    from paddle_tpu.parallel.codec import CollectiveCodec
+
+    hmesh = Mesh(np.asarray(jax.devices()[:8], dtype=object).reshape(
+        1, 4, 2), ("dp", "sharding", "mp"))
+    apply_llama_sharding(model, hmesh)
+    hoc = OverlapConfig(hierarchical="on",
+                        slice_map=FLAGSHIP_SLICE_MAP,
+                        codec=CollectiveCodec())
+    hstep = build_train_step(model, opt, mesh=hmesh,
+                             compute_dtype=jnp.bfloat16, overlap=hoc)
+    hparams = {k: jnp.asarray(v)
+               for k, v in model.functional_state().items()}
+    yield "overlap_train_step[hier2slice,codec]", check(
+        hstep, hparams, opt.init_state(hparams), 0, 1e-4, ids, labels,
+        passes=["collective_budget"],
+        options={"collective_budget": {
+            "overlap_active": True,
+            "wire": {"dcn_axes":
+                     {"sharding": list(FLAGSHIP_SLICE_MAP)},
+                     "dcn_bytes": FLAGSHIP_DCN_WIRE_BUDGET}}},
+        declared_dtype=jnp.bfloat16,
+        target="overlap_train_step[hier2slice,codec]")
+
 
 # ---------------------------------------------------------------------------
 # round-14: the Sharding Doctor section (cross-stack partition
@@ -473,6 +509,59 @@ def _sharding_targets():
         target="sharding:cross_stack")
 
 
+_WIRE_MEMO: Dict = {}
+
+
+def flagship_wire_table() -> dict:
+    """Pre/post-codec ICI/DCN bytes-on-the-wire tables for the flagship
+    overlap step on the fake-2-slice hierarchical mesh — DOCTOR.json's
+    ``comm_wire`` per-stage bytes artifact (round-15).  Memoized per
+    backend: both the bench smoke leg and the test suite read it in one
+    process, and each variant traces the whole flagship."""
+    from jax.sharding import Mesh
+
+    from .core import AnalysisContext
+    from .passes.collective_budget import collect_wire_table
+    from paddle_tpu.models import build_train_step
+    from paddle_tpu.models.llama import apply_llama_sharding
+    from paddle_tpu.parallel.codec import CollectiveCodec
+    from paddle_tpu.parallel.overlap import OverlapConfig
+
+    if len(jax.devices()) < 8:
+        return {"skipped": "needs >= 8 devices"}
+    key = (jax.default_backend(), len(jax.devices()))
+    if key in _WIRE_MEMO:
+        return _WIRE_MEMO[key]
+    cfg, model, opt, params0, ids, labels = _flagship()
+    mesh = Mesh(np.asarray(jax.devices()[:8], dtype=object).reshape(
+        1, 4, 2), ("dp", "sharding", "mp"))
+    apply_llama_sharding(model, mesh)
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+    dcn_axes = {"sharding": list(FLAGSHIP_SLICE_MAP)}
+    out: Dict[str, dict] = {"slice_map": list(FLAGSHIP_SLICE_MAP),
+                            "dcn_budget": FLAGSHIP_DCN_WIRE_BUDGET}
+    for name, codec in (("codec_off", None),
+                        ("codec_on", CollectiveCodec())):
+        oc = OverlapConfig(hierarchical="on",
+                           slice_map=FLAGSHIP_SLICE_MAP, codec=codec)
+        step = build_train_step(model, opt, mesh=mesh,
+                                compute_dtype=jnp.bfloat16, overlap=oc)
+        ctx = AnalysisContext(step, (params, opt.init_state(params), 0,
+                                     1e-4, ids, labels), {})
+        out[name] = collect_wire_table(ctx.jaxpr, dcn_axes)
+    off_dcn, on_dcn = out["codec_off"]["dcn"], out["codec_on"]["dcn"]
+    out["dcn_ratio"] = (off_dcn["bytes"] / on_dcn["bytes"]
+                        if on_dcn["bytes"] else None)
+    # the acceptance metric: the bucketed grad reduce-scatter's DCN leg
+    # (fp-wire psum_scatter off, packed int8 all_to_all on)
+    rs_off = off_dcn["kinds"].get("reducescatter", {}).get("bytes", 0)
+    rs_on = on_dcn["kinds"].get("alltoall", {}).get("bytes", 0)
+    out["reducescatter_ratio"] = rs_off / rs_on if rs_on else None
+    _WIRE_MEMO[key] = out
+    return out
+
+
 def flagship_sharding_table() -> dict:
     """The canonical SpecLayout table of the flagship GSPMD stack on
     the 8-device hybrid-compatible mesh — DOCTOR.json's
@@ -601,6 +690,13 @@ def self_check(clean: bool = True) -> dict:
             result["sharding_canonical_table"] = flagship_sharding_table()
         except Exception as e:  # noqa: BLE001
             result["sharding_canonical_table"] = {"error": repr(e)}
+        # round-15: the per-stage (ICI/DCN) bytes-on-the-wire table for
+        # the flagship hierarchical step, codec off vs on — the COMM004
+        # contract's measurement artifact
+        try:
+            result["comm_wire"] = flagship_wire_table()
+        except Exception as e:  # noqa: BLE001
+            result["comm_wire"] = {"error": repr(e)}
 
     def _all_ok(d):
         return all(v.get("ok") for v in d.values()) if d else True

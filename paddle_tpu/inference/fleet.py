@@ -210,6 +210,14 @@ class FleetConfig:
     # against (None = use the cap)
     max_transient_bytes: Optional[int] = 64 << 20
     delivery_budget_bytes: Optional[int] = None
+    # round-15: the quantized weight-delivery codec
+    # (parallel/codec.CollectiveCodec, weight profile).  When set, every
+    # spawn's delivery streams host-route float leaves as block-scaled
+    # packed int8 payloads and decodes replica-side — the ROADMAP's
+    # "int8 weight path at serving load time".  LOSSY (block-scaled
+    # quantization error); check_delivery_budget then prices the
+    # POST-codec transient.  None keeps delivery bit-exact.
+    delivery_codec: Optional[Any] = None
 
 
 class ReplicaSet:
@@ -276,10 +284,23 @@ class ReplicaSet:
             self.telemetry["plans_built"] += 1
         return plan
 
+    def _deliver(self):
+        """Execute the cached plan — through the quantized
+        weight-delivery path when a delivery codec is configured."""
+        plan = self.delivery_plan()
+        codec = self.config.delivery_codec
+        if codec is None:
+            return plan.execute(self.params)
+        from ..parallel.reshard import execute_encoded
+
+        return execute_encoded(plan, self.params, codec)
+
     def check_delivery_budget(self, budget_bytes: Optional[int] = None,
                               exemptions=(), target: Optional[str] = None):
         """Price the delivery plan's worst step through the Graph
-        Doctor's MEM001 budget (``check_reshard_budget``).  An
+        Doctor's MEM001 budget (``check_reshard_budget``).  With a
+        delivery codec the entry is priced on its POST-codec packed
+        payloads — the bytes an encoded delivery actually stages.  An
         unbounded plan against a real budget fires MEM001 — the seeded
         ``MEM001[replica_delivery]`` fixture keeps that honest."""
         from ..parallel.reshard import check_reshard_budget
@@ -291,7 +312,8 @@ class ReplicaSet:
         return check_reshard_budget(self.delivery_plan(), self.params,
                                     budget_bytes=budget,
                                     exemptions=exemptions,
-                                    target=target or "replica_delivery")
+                                    target=target or "replica_delivery",
+                                    codec=self.config.delivery_codec)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -305,7 +327,7 @@ class ReplicaSet:
         self._next_id += 1
         self.replicas[rep.id] = rep
         try:
-            delivered = self.delivery_plan().execute(self.params)
+            delivered = self._deliver()
             self.telemetry["deliveries"] += 1
             rep.warm(delivered)
         except Exception:

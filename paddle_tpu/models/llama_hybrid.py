@@ -351,6 +351,9 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
     sh_ax = "sharding" if sh_deg > 1 else None
     mp_ax = "mp" if mp_deg > 1 else None
     hier = oc.resolve_hier(mesh, sh_ax)
+    # quantized-DCN codec: only with a resolved hierarchical axis (the
+    # quantize-across-DCN placement rule, overlap.py docstring §5)
+    codec = oc.codec if hier is not None else None
     shapes = _ov.llama_layer_shapes(cfg)
     layout = _ov.plan_layer_layout(
         shapes, mesh, lambda sfx: _filter_spec_to_mesh(
@@ -426,9 +429,10 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         in_bucket = {s for b in buckets for s in b}
         sync_sfx = [s for s in suffix_order if s not in in_bucket]
         gather_fns = [_ov.make_bucket_gather(sh_ax, hier, gather_psum,
-                                             grad_mode)
+                                             grad_mode, codec=codec)
                       for _ in buckets]
-        sync_fn = _ov.make_grad_sync(sync_axes)
+        sync_fn = _ov.make_grad_sync(sync_axes, hier_axis=sh_ax,
+                                     hier=hier, codec=codec)
         # x is replicated over pp (only stage 0 consumes it; the other
         # ranks' cotangents are zero) and over mp (column-parallel
         # backward emits PARTIAL x-cotangents per mp rank)
@@ -556,7 +560,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         x, cos, sin = _wire_body(x), _wire_body(cos), _wire_body(sin)
         chunked_full = _ov.gather_tree_over_sharding(
             chunked, layout, lead_ndim=2, sh=sh_deg, mp=mp_deg,
-            axis=sh_ax, hier=hier, bucket_bytes=oc.bucket_bytes)
+            axis=sh_ax, hier=hier, bucket_bytes=oc.bucket_bytes,
+            codec=codec)
 
         def layer_step(h, lp):
             return _ov.decoder_layer_tp(lp, h, cos, sin, cfg, mp_ax,

@@ -13,6 +13,8 @@ from .ring_attention import ring_flash_attention
 from .sep import ulysses_attention
 from .pipelining import pipeline_apply
 from .overlap import OverlapConfig
-from .memory import MemoryConfig, tune_memory_config
+from .codec import CollectiveCodec
+from .memory import (JointConfig, MemoryConfig,
+                     joint_memory_codec_lattice, tune_memory_config)
 from .reshard import (ReshardPlan, check_reshard_budget, plan_reshard,
                       reshard)

@@ -1,0 +1,336 @@
+"""Quantized-collective codec (round-15 tentpole).
+
+At multislice scale the DCN stage of the hierarchical collectives is
+the wall (ROADMAP "Quantized collectives for the DCN-bound regime"):
+inter-slice links carry ~an order of magnitude less bandwidth than the
+intra-slice ICI torus, and the two-stage schedule (parallel/overlap.py
+``hier_psum_scatter`` / ``hier_all_gather``) already isolates exactly
+the bytes that cross them — the 1/per_slice residue.  EQuARX (PAPERS.md
+2506.17615) shows block-scaled int8/fp8 all-reduce at ~no quality loss;
+because our collective schedule is explicit, we implement the codec
+ourselves instead of waiting on XLA:
+
+- **block-scaled encode** — the payload is flattened, split into
+  ``block``-sized blocks (the last block zero-padded), and each block
+  quantized against its own absmax: ``scale = absmax / qmax``,
+  ``q = round(x / scale)``.  Per-block scaling keeps the dynamic range
+  of gradients (which span orders of magnitude across a bucket) without
+  per-tensor saturation.
+- **deterministic seeded stochastic rounding** — gradient payloads
+  round ``floor(r + u)`` with ``u`` drawn from a counter-based hash of
+  (seed, element position, payload bits): unbiased in expectation, and
+  because the PAYLOAD BITS feed the hash, a slowly-moving gradient
+  draws a fresh rounding offset every step — the accumulated error
+  does not develop the systematic per-position drift a position-only
+  hash (or round-to-nearest) would.  Still BITWISE deterministic
+  across runs: no PRNG state threads through the scan bodies, ``u``
+  is a pure function of the data.
+- **bf16 scale sidecar packed with the payload** — the per-block bf16
+  scales are bitcast to bytes and concatenated onto the int8 payload,
+  so one collective moves one ``int8[packed_width]`` array; no second
+  launch, no scale/payload ordering hazard.
+
+Wire format of one encoded row of ``n`` elements (``nb`` blocks)::
+
+    int8[nb*block + 2*nb]  =  payload[nb*block] ++ bf16_scales[nb].bytes
+
+Profiles: ``"int8"`` (qmax 127, supports stochastic rounding — the
+gradient default), ``"fp8"`` (e4m3, round-to-nearest-even via the cast
+— the non-stochastic weights-gather profile), ``"none"`` (that
+direction stays unquantized).  Hosts whose toolchain lacks the fp8
+dtype degrade fp8 to int8 (same wire bytes, more mantissa).
+
+Placement rule (enforced by the callers in parallel/overlap.py, see its
+module docstring §5): quantize ONLY across DCN — the intra-slice (ICI)
+stage accumulates in full precision, the residue is encoded once,
+decoded at the receiver, and never re-quantized through a reduction
+chain.  Non-finite guards: NaN encodes to 0, ±inf saturates to the
+block's finite absmax; all-zero blocks round-trip to exact zeros.
+
+The same codec backs serving weight delivery
+(``parallel/reshard.execute_encoded`` / inference/fleet.py): host-side
+numpy encode (``encode_rows_host``), device-side jitted decode — the
+ROADMAP's "int8 weight path at serving load time".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PROFILES = ("int8", "fp8", "none")
+
+# the fp8 wire dtype (e4m3: max dynamic range per byte for payloads
+# whose blocks are absmax-rescaled anyway); None on toolchains without
+# ml_dtypes fp8 support — CollectiveCodec.resolve degrades to int8
+FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+INT8_QMAX = 127.0
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveCodec:
+    """Per-direction quantization profiles for the DCN collective hop.
+
+    ``grad_profile`` — the reduce path (bucketed grad reduce-scatter
+    backward, hierarchical grad-sync psum).  ``weight_profile`` — the
+    gather path (ZeRO-3 bucket/tree weights all-gather prefetch);
+    non-stochastic by construction (weights are re-encoded from the
+    same master every step — stochastic rounding would make the FORWARD
+    nondeterministic across runs for zero benefit).  ``stochastic``
+    applies to int8 gradient encodes only; fp8 rounds to nearest even
+    via the hardware cast.  ``seed`` salts the position hash — two
+    codecs with different seeds draw different (still deterministic)
+    rounding patterns.
+    """
+
+    grad_profile: str = "int8"
+    weight_profile: str = "fp8"
+    block: int = 256
+    stochastic: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("grad_profile", "weight_profile"):
+            p = getattr(self, name)
+            if p not in PROFILES:
+                raise ValueError(
+                    f"CollectiveCodec.{name}={p!r}; expected one of "
+                    f"{PROFILES}")
+        if self.block < 2:
+            raise ValueError(
+                f"CollectiveCodec.block={self.block}; blocks need >= 2 "
+                f"elements for a meaningful absmax scale")
+
+    def resolve(self, kind: str) -> Optional[Tuple[str, bool]]:
+        """(profile, stochastic) for ``kind`` in {"grad", "weight"}, or
+        None when that direction is unquantized.  The single translation
+        point: fp8 degrades to int8 on toolchains without the dtype, and
+        stochastic rounding is gated to int8 gradient encodes."""
+        if kind not in ("grad", "weight"):
+            raise ValueError(f"codec kind {kind!r}")
+        profile = self.grad_profile if kind == "grad" else \
+            self.weight_profile
+        if profile == "none":
+            return None
+        if profile == "fp8" and FP8_DTYPE is None:
+            profile = "int8"
+        stochastic = bool(self.stochastic and kind == "grad"
+                          and profile == "int8")
+        return profile, stochastic
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+    def label(self) -> str:
+        g = self.grad_profile + ("/sr" if self.stochastic
+                                 and self.grad_profile == "int8" else "")
+        return f"codec[g={g},w={self.weight_profile},b={self.block}]"
+
+
+# ---------------------------------------------------------------------------
+# wire-format arithmetic (shared with the bytes-on-the-wire accounting)
+# ---------------------------------------------------------------------------
+
+
+def num_blocks(n: int, block: int) -> int:
+    return -(-int(n) // int(block))
+
+
+def packed_width(n: int, block: int) -> int:
+    """Bytes of one encoded row of ``n`` elements: 1-byte payload per
+    (padded) element + the 2-byte bf16 scale per block."""
+    nb = num_blocks(n, block)
+    return nb * block + 2 * nb
+
+
+def wire_ratio(n: int, block: int, itemsize: int = 4) -> float:
+    """Raw-bytes / packed-bytes for one row — the structural DCN-bytes
+    win the COMM004 table and the bench trace report."""
+    return (int(n) * int(itemsize)) / float(packed_width(n, block))
+
+
+def _qmax(profile: str) -> float:
+    if profile == "int8":
+        return INT8_QMAX
+    if profile == "fp8":
+        return float(jnp.finfo(FP8_DTYPE).max)
+    raise ValueError(f"profile {profile!r} has no qmax")
+
+
+# ---------------------------------------------------------------------------
+# deterministic seeded stochastic rounding
+# ---------------------------------------------------------------------------
+
+# SplitMix32-style finalizer: a counter-based hash over (seed, element
+# position, payload bits).  No PRNG key threads through scan bodies —
+# u is a pure function of the data, which is what makes two runs of
+# the same step BITWISE identical (the determinism contract
+# tests/test_codec.py pins) — while the payload-bit term makes the
+# rounding offsets vary step-to-step for a moving gradient (a
+# position-only hash would re-apply the SAME offset to a stable
+# element every step: a systematic accumulating bias, not stochastic
+# rounding).
+_GOLDEN = np.uint32(0x9E3779B9)
+_MIX1 = np.uint32(0x7FEB352D)
+_MIX2 = np.uint32(0x846CA68B)
+
+
+def _hash_uniform(rows: int, cols: int, seed: int, value_bits=None):
+    """[rows, cols] uniforms in [0, 1) from a hash of position (and,
+    when given, the uint32 payload bits — the avalanche decorrelates
+    ``u`` from the value's own fraction)."""
+    r = lax.broadcasted_iota(jnp.uint32, (rows, cols), 0)
+    c = lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
+    x = (r * jnp.uint32(cols) + c) ^ (jnp.uint32(np.uint32(seed))
+                                      * _GOLDEN)
+    if value_bits is not None:
+        x = x ^ (value_bits * _GOLDEN)
+    x = x ^ (x >> 16)
+    x = x * _MIX1
+    x = x ^ (x >> 15)
+    x = x * _MIX2
+    x = x ^ (x >> 16)
+    # 24 mantissa-safe bits -> [0, 1)
+    return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+# ---------------------------------------------------------------------------
+# encode / decode (jax; shard-level, trace-safe)
+# ---------------------------------------------------------------------------
+
+
+def _block_scales(xb, qmax: float):
+    """Per-block bf16 absmax scales with the zero/inf/NaN guards:
+    non-finite values contribute nothing to the absmax (NaN payloads
+    encode to 0, ±inf saturates at the finite absmax), an all-zero (or
+    all-non-finite) block gets scale 1 so its payload decodes to exact
+    zeros, and the bf16 cast is applied BEFORE the divide so encoder
+    and decoder agree on the exact scale value."""
+    finite = jnp.isfinite(xb)
+    amax = jnp.max(jnp.where(finite, jnp.abs(xb), 0.0), axis=-1)
+    scale = jnp.where(amax > 0,
+                      jnp.maximum(amax / qmax, 1e-30), 1.0)
+    scale_b = scale.astype(jnp.bfloat16)
+    return scale_b, scale_b.astype(jnp.float32)
+
+
+def encode_rows(x, codec: CollectiveCodec, profile: str,
+                stochastic: bool = False):
+    """[rows, n] floats -> [rows, packed_width(n, block)] int8.
+
+    Each row is encoded independently (rows are per-destination
+    payloads in the DCN reduce-scatter, independent gather sources in
+    the all-gather path)."""
+    rows, n = x.shape
+    block = codec.block
+    nb = num_blocks(n, block)
+    qmax = _qmax(profile)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (0, nb * block - n)))
+    xb = xp.reshape(rows, nb, block)
+    scale_b, scale_f = _block_scales(xb, qmax)
+    r = xb / scale_f[..., None]
+    r = jnp.where(jnp.isnan(r), 0.0, r)          # NaN -> 0
+    r = jnp.clip(r, -qmax, qmax)                 # +-inf saturates
+    if profile == "int8":
+        if stochastic:
+            bits = lax.bitcast_convert_type(xp, jnp.uint32)
+            u = _hash_uniform(rows, nb * block, codec.seed,
+                              value_bits=bits)
+            q = jnp.floor(r + u.reshape(rows, nb, block))
+        else:
+            q = jnp.round(r)                     # round-half-even
+        q = jnp.clip(q, -qmax, qmax)
+        payload = q.astype(jnp.int8).reshape(rows, nb * block)
+    elif profile == "fp8":
+        payload = lax.bitcast_convert_type(
+            r.astype(FP8_DTYPE), jnp.int8).reshape(rows, nb * block)
+    else:
+        raise ValueError(f"cannot encode with profile {profile!r}")
+    sbytes = lax.bitcast_convert_type(scale_b, jnp.int8).reshape(
+        rows, 2 * nb)
+    return jnp.concatenate([payload, sbytes], axis=-1)
+
+
+def decode_rows(packed, n: int, codec: CollectiveCodec, profile: str,
+                out_dtype=jnp.float32):
+    """Inverse of encode_rows: [rows, packed_width] int8 -> [rows, n]."""
+    rows = packed.shape[0]
+    block = codec.block
+    nb = num_blocks(n, block)
+    payload = packed[:, :nb * block]
+    sbytes = packed[:, nb * block:].reshape(rows, nb, 2)
+    scale = lax.bitcast_convert_type(sbytes, jnp.bfloat16).astype(
+        jnp.float32)
+    if profile == "int8":
+        q = payload.astype(jnp.float32)
+    elif profile == "fp8":
+        q = lax.bitcast_convert_type(payload, FP8_DTYPE).astype(
+            jnp.float32)
+    else:
+        raise ValueError(f"cannot decode with profile {profile!r}")
+    x = (q.reshape(rows, nb, block) * scale[..., None]).reshape(
+        rows, nb * block)[:, :n]
+    return x.astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side (numpy) encode — the serving weight-delivery path
+# ---------------------------------------------------------------------------
+
+
+def encode_rows_host(x: np.ndarray, codec: CollectiveCodec,
+                     profile: str) -> np.ndarray:
+    """Numpy mirror of encode_rows (deterministic rounding only — the
+    delivery path encodes WEIGHTS).  Runs on the host so the packed
+    int8 buffer, not the fp32 leaf, is what transits host->device;
+    the receiver decodes with the SAME decode_rows the collectives use
+    (one wire format, two producers)."""
+    import ml_dtypes
+
+    if profile == "fp8" and FP8_DTYPE is None:
+        profile = "int8"
+    rows, n = x.shape
+    block = codec.block
+    nb = num_blocks(n, block)
+    qmax = _qmax(profile)
+    xp = np.zeros((rows, nb * block), np.float32)
+    xp[:, :n] = np.asarray(x, np.float32)
+    xb = xp.reshape(rows, nb, block)
+    finite = np.isfinite(xb)
+    amax = np.max(np.where(finite, np.abs(xb), 0.0), axis=-1)
+    scale = np.where(amax > 0, np.maximum(amax / qmax, 1e-30), 1.0)
+    scale_b = scale.astype(ml_dtypes.bfloat16)
+    r = xb / scale_b.astype(np.float32)[..., None]
+    r = np.where(np.isnan(r), 0.0, r)
+    r = np.clip(r, -qmax, qmax)
+    if profile == "int8":
+        payload = np.clip(np.round(r), -qmax, qmax).astype(
+            np.int8).reshape(rows, nb * block)
+    else:
+        payload = r.astype(ml_dtypes.float8_e4m3fn).view(
+            np.int8).reshape(rows, nb * block)
+    sbytes = scale_b.view(np.int8).reshape(rows, 2 * nb)
+    return np.concatenate([payload, sbytes], axis=-1)
+
+
+def decode_jit(shape: Tuple[int, ...], dtype, codec: CollectiveCodec,
+               profile: str, out_sharding=None):
+    """A jitted device-side decoder for one host-encoded leaf/chunk:
+    packed int8 [1, packed_width] -> array of ``shape``/``dtype`` placed
+    per ``out_sharding``.  The compiled program's arguments are the
+    POST-codec bytes — what check_delivery_budget prices."""
+    n = int(np.prod(shape)) if shape else 1
+
+    def _dec(packed):
+        return decode_rows(packed, n, codec, profile,
+                           out_dtype=dtype).reshape(shape)
+
+    if out_sharding is not None:
+        return jax.jit(_dec, out_shardings=out_sharding)
+    return jax.jit(_dec)

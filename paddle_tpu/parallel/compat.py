@@ -31,21 +31,25 @@ def ppermute(x, axis_name, perm):
     return lax.ppermute(x, axis_name, perm)
 
 
-def psum(x, axis_name):
+def psum(x, axis_name, *, axis_index_groups=None):
     if _promote(x):
-        return lax.psum(x.astype(jnp.float32),
-                        axis_name).astype(jnp.bfloat16)
-    return lax.psum(x, axis_name)
+        return lax.psum(
+            x.astype(jnp.float32), axis_name,
+            axis_index_groups=axis_index_groups).astype(jnp.bfloat16)
+    return lax.psum(x, axis_name, axis_index_groups=axis_index_groups)
 
 
-def all_to_all(x, axis_name, split_axis, concat_axis, *, tiled=False):
+def all_to_all(x, axis_name, split_axis, concat_axis, *, tiled=False,
+               axis_index_groups=None):
     if _promote(x):
         return lax.all_to_all(x.astype(jnp.float32), axis_name,
                               split_axis=split_axis,
-                              concat_axis=concat_axis,
-                              tiled=tiled).astype(jnp.bfloat16)
+                              concat_axis=concat_axis, tiled=tiled,
+                              axis_index_groups=axis_index_groups
+                              ).astype(jnp.bfloat16)
     return lax.all_to_all(x, axis_name, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=tiled)
+                          concat_axis=concat_axis, tiled=tiled,
+                          axis_index_groups=axis_index_groups)
 
 
 def psum_scatter(x, axis_name, *, scatter_dimension=0, tiled=True,

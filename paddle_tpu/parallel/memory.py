@@ -512,27 +512,104 @@ def choose_memory_config(records: Sequence[Dict[str, Any]],
     return None
 
 
-def tune_memory_config(step_builder: Callable[[MemoryConfig], Tuple],
+@dataclasses.dataclass(frozen=True)
+class JointConfig:
+    """One point on the JOINT MemoryConfig × OverlapConfig(codec)
+    lattice (round-15): the autotuner walks memory residency AND the
+    quantized-DCN-collective knob together, so a config that fits HBM
+    but blows the DCN wire budget loses to one that trades a little
+    codec error for 4× fewer DCN bytes.  ``overlap`` is an
+    OverlapConfig (kept opaque here — parallel/memory stays independent
+    of the overlap engine's types)."""
+
+    memory: MemoryConfig
+    overlap: Optional[Any] = None
+
+    def label(self) -> str:
+        lab = self.memory.label()
+        codec = getattr(self.overlap, "codec", None)
+        lab += "/" + (codec.label() if codec is not None else "codec-off")
+        return lab
+
+    def to_json(self) -> Dict[str, Any]:
+        codec = getattr(self.overlap, "codec", None)
+        return {"memory": self.memory.to_json(),
+                "codec": codec.to_json() if codec is not None else None}
+
+
+def codec_lattice_points() -> Tuple:
+    """The codec knob's walk order: off (exact) first, then the int8
+    stochastic grad profile (block-scaled — the tighter error bound),
+    then all-fp8 (same wire bytes, cheaper en/decode, looser error) —
+    increasing error tolerance, decreasing only when a DCN wire budget
+    forces the trade."""
+    from .codec import CollectiveCodec
+
+    return (None,
+            CollectiveCodec(),
+            CollectiveCodec(grad_profile="fp8", weight_profile="fp8",
+                            stochastic=False))
+
+
+def joint_memory_codec_lattice(overlap,
+                               memory_lattice: Optional[Sequence] = None,
+                               codec_points: Optional[Sequence] = None
+                               ) -> Tuple[JointConfig, ...]:
+    """MemoryConfig × codec joint lattice over a base OverlapConfig:
+    per memory point (cheapest recompute first), the codec points in
+    increasing-error order — the walk a pod-scale config uses to trade
+    codec error tolerance against DCN bytes alongside remat/offload."""
+    import dataclasses as _dc
+
+    mem = tuple(MEMORY_LATTICE if memory_lattice is None
+                else memory_lattice)
+    pts = tuple(codec_lattice_points() if codec_points is None
+                else codec_points)
+    return tuple(JointConfig(m, _dc.replace(overlap, codec=c))
+                 for m in mem for c in pts)
+
+
+def tune_memory_config(step_builder: Callable[[Any], Tuple],
                        hbm_bytes: int,
-                       lattice: Optional[Sequence[MemoryConfig]] = None):
+                       lattice: Optional[Sequence] = None, *,
+                       dcn_wire_bytes: Optional[int] = None,
+                       dcn_bytes_fn: Optional[Callable] = None):
     """Walk the remat/offload lattice (cheapest predicted step time
     first), measure each built step's compiled peak, and return
-    ``(config, records)`` — ``config`` the cheapest fitting
-    MemoryConfig (None if even the most aggressive point exceeds the
-    budget), ``records`` the full per-point measurement list (what
-    bench.py --profile surfaces as ``memory_levers`` / MEMCONFIG.json).
+    ``(config, records)`` — ``config`` the cheapest fitting point
+    (None if even the most aggressive point exceeds the budget),
+    ``records`` the full per-point measurement list (what bench.py
+    --profile surfaces as ``memory_levers`` / MEMCONFIG.json).
 
     ``step_builder(cfg)`` returns ``(fn, args)`` — typically
     ``build_train_step(model, opt, memory=cfg)`` plus example inputs
-    with the real shapes/dtypes/shardings."""
+    with the real shapes/dtypes/shardings.  ``lattice`` entries may be
+    MemoryConfig or JointConfig (memory × overlap-codec) points.
+
+    ``dcn_wire_bytes`` adds the round-15 second budget axis: each
+    point's post-codec DCN bytes (measured by ``dcn_bytes_fn(cfg, fn,
+    args) -> int`` — typically collect_wire_table over the traced
+    step) must ALSO fit, so the walk lands on the cheapest point that
+    satisfies capacity AND the wire contract — the codec-error-vs-
+    DCN-bytes trade made by the same cheapest-first rule as
+    remat/offload."""
+    if dcn_wire_bytes is not None and dcn_bytes_fn is None:
+        raise ValueError(
+            "tune_memory_config: dcn_wire_bytes declared but no "
+            "dcn_bytes_fn to measure it — a budget with no measurement "
+            "would silently pass every point")
     lattice = tuple(MEMORY_LATTICE if lattice is None else lattice)
     records: List[Dict[str, Any]] = []
     for cfg in lattice:
         fn, args = step_builder(cfg)
         stats = measure_step_memory(fn, *args)
-        records.append({"config": cfg.to_json(), "label": cfg.label(),
-                        **stats,
-                        "fits": stats["peak_bytes"] <= hbm_bytes})
-    idx = choose_memory_config(records, hbm_bytes)
+        rec = {"config": cfg.to_json(), "label": cfg.label(), **stats,
+               "fits": stats["peak_bytes"] <= hbm_bytes}
+        if dcn_wire_bytes is not None:
+            dcn = int(dcn_bytes_fn(cfg, fn, args))
+            rec["dcn_wire_bytes"] = dcn
+            rec["fits"] = bool(rec["fits"] and dcn <= dcn_wire_bytes)
+        records.append(rec)
+    idx = next((i for i, rec in enumerate(records) if rec["fits"]), None)
     chosen = lattice[idx] if idx is not None else None
     return chosen, records

@@ -31,10 +31,29 @@ async collective fusion, PAPERS.md) as four composable levers:
    reduce-scatters run two-stage: intra-slice (ICI) first, inter-slice
    (DCN) on the 1/per_slice residue — DCN bytes drop by the intra-slice
    degree versus a flat ring that crosses DCN per hop.
+5. **Quantized DCN collectives** (round-15; parallel/codec.py) — with
+   ``OverlapConfig.codec`` set AND a hierarchical axis resolved, the
+   residue that crosses DCN moves as a block-scaled int8/fp8 payload
+   (per-block bf16 absmax scales packed into the same wire buffer).
+   The placement rule is strict: quantize ONLY across DCN.  Stage-1
+   intra-slice collectives accumulate in full precision over ICI; the
+   1/per_slice residue is encoded exactly once; the DCN exchange runs
+   on the packed payload (reduce-scatter becomes encode → one int8
+   all_to_all over the DCN groups → decode → fp32 sum at the receiver;
+   all-gather/psum become encode → int8 all-gather → decode); nothing
+   is ever re-quantized through a reduction chain.  Gradients use the
+   deterministic seeded stochastic-rounding int8 profile, the ZeRO-3
+   weights-gather the non-stochastic fp8 profile
+   (``CollectiveCodec.grad_profile`` / ``weight_profile``).  Without a
+   hierarchical axis the codec is inert — flat collectives ride ICI,
+   where quantization costs accuracy for bandwidth we are not short
+   of.  ``codec=None`` (the default) leaves every schedule bit-
+   identical to the unquantized engine.
 
 Every lever has a flat/GSPMD fallback (toggle via OverlapConfig) and
 CPU parity coverage on 8 fake devices (tests/test_overlap.py); the
-Graph Doctor's ``collective_budget`` pass (COMM001/COMM002) audits the
+Graph Doctor's ``collective_budget`` pass (COMM001/COMM002, and
+COMM004 for post-codec bytes-on-the-wire per ICI/DCN stage) audits the
 resulting collective schedule per entry point.
 
 The module is deliberately model-agnostic at the EDGES (bucketing,
@@ -57,6 +76,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..common.jax_compat import shard_map, axis_size
 from . import compat as _compat
+from .codec import CollectiveCodec, decode_rows, encode_rows
 
 
 # ---------------------------------------------------------------------------
@@ -78,6 +98,12 @@ class OverlapConfig:
     only when the sharding axis actually spans slices), "on" requires an
     explicit ``slice_map`` (the fake-2-slice test path), "off" forces
     flat collectives.
+
+    ``codec`` — the quantized-DCN-collective codec (parallel/codec.py,
+    module docstring §5).  Only active when a hierarchical axis
+    resolves: the codec's whole contract is "quantize across DCN only",
+    so without a DCN stage there is nothing to encode.  None (default)
+    keeps every schedule bit-identical to the unquantized engine.
     """
 
     prefetch: bool = True
@@ -86,6 +112,7 @@ class OverlapConfig:
     collective_matmul_min_out_elems: int = COLLECTIVE_MATMUL_MIN_OUT_ELEMS
     hierarchical: str = "auto"          # "auto" | "on" | "off"
     slice_map: Optional[Tuple[int, ...]] = None   # fake/explicit slices
+    codec: Optional[CollectiveCodec] = None
 
     def resolve_hier(self, mesh: Mesh, axis: Optional[str]):
         from ..distributed.topology import hierarchical_axis
@@ -134,29 +161,113 @@ def _split_blocks(x, n):
     return x.reshape((n, lead // n) + x.shape[1:])
 
 
-def hier_psum_scatter(x, axis: str, hier):
+def _codec_resolve(codec: Optional[CollectiveCodec], kind: str):
+    """(profile, stochastic) when the codec quantizes ``kind``'s
+    direction, else None (codec off / direction profile "none")."""
+    if codec is None:
+        return None
+    return codec.resolve(kind)
+
+
+def hier_psum_scatter(x, axis: str, hier,
+                      codec: Optional[CollectiveCodec] = None,
+                      kind: str = "grad"):
     """Two-stage reduce-scatter over ``axis``; result matches
     ``lax.psum_scatter(x, axis, tiled=True)`` exactly (same chunk at the
     same axis position), with the inter-slice stage running on the
-    1/per_slice intra-slice residue."""
+    1/per_slice intra-slice residue.  With ``codec``, stage 1 still
+    accumulates in full precision over ICI and the residue crosses DCN
+    as the block-scaled packed payload (codec placement rule, module
+    docstring §5)."""
     order = _hier_block_order(hier)
     blocks = _split_blocks(x, hier.size)[order]
     x2 = blocks.reshape((-1,) + x.shape[1:])
     y = _compat.psum_scatter(x2, axis, axis_index_groups=hier.ici_groups)
-    z = _compat.psum_scatter(y, axis, axis_index_groups=hier.dcn_groups)
-    return z
+    rp = _codec_resolve(codec, kind)
+    if rp is None:
+        return _compat.psum_scatter(y, axis,
+                                    axis_index_groups=hier.dcn_groups)
+    return _dcn_psum_scatter_coded(y, axis, hier, codec, rp)
 
 
-def hier_all_gather(x, axis: str, hier):
+def _dcn_psum_scatter_coded(y, axis: str, hier, codec, rp):
+    """The DCN reduce-scatter on the packed payload: encode the S
+    per-destination residue rows, ONE int8 all_to_all over the DCN
+    groups, decode the S received rows in fp32 and sum — exactly
+    ``psum_scatter(y, axis_index_groups=dcn_groups)`` up to
+    quantization, at ~itemsize-fold fewer bytes on the DCN wire (plus
+    the bf16 scale sidecar)."""
+    profile, stochastic = rp
+    S = hier.num_slices
+    rows = _split_blocks(y, S)                     # [S, m/S, ...]
+    row_shape = rows.shape[1:]
+    n = int(np.prod(row_shape))
+    packed = encode_rows(rows.reshape(S, n), codec, profile,
+                         stochastic=stochastic)
+    ex = _compat.all_to_all(packed, axis, split_axis=0, concat_axis=0,
+                            tiled=True, axis_index_groups=hier.dcn_groups)
+    dec = decode_rows(ex, n, codec, profile)       # [S, n] fp32
+    return dec.sum(axis=0).reshape(row_shape).astype(y.dtype)
+
+
+def hier_all_gather(x, axis: str, hier,
+                    codec: Optional[CollectiveCodec] = None,
+                    kind: str = "weight"):
     """Two-stage all-gather, the exact inverse of hier_psum_scatter (and
     layout-compatible with flat ``lax.all_gather(..., tiled=True)``):
     inter-slice residue gather (DCN) first, then the intra-slice (ICI)
-    stage, then a static block un-permute."""
+    stage, then a static block un-permute.  With ``codec`` the DCN
+    stage gathers the block-scaled packed payload and decodes at the
+    receiver; the ICI stage re-gathers the DECODED values at full
+    precision (quantize-across-DCN-only, module docstring §5)."""
     order = _hier_block_order(hier)
-    y = _compat.all_gather(x, axis, axis_index_groups=hier.dcn_groups)
+    rp = _codec_resolve(codec, kind)
+    if rp is None:
+        y = _compat.all_gather(x, axis, axis_index_groups=hier.dcn_groups)
+    else:
+        y = _dcn_all_gather_coded(x, axis, hier, codec, rp)
     z = _compat.all_gather(y, axis, axis_index_groups=hier.ici_groups)
     blocks = _split_blocks(z, hier.size)[np.argsort(order)]
     return blocks.reshape((-1,) + x.shape[1:])
+
+
+def _dcn_all_gather_coded(x, axis: str, hier, codec, rp):
+    """DCN all-gather on the packed payload: encode the local shard as
+    one row, int8 all-gather over the DCN groups, decode every received
+    row — tiled-layout-compatible with the unquantized stage."""
+    profile, stochastic = rp
+    n = int(np.prod(x.shape))
+    packed = encode_rows(x.reshape(1, n), codec, profile,
+                         stochastic=stochastic)
+    g = _compat.all_gather(packed, axis,
+                           axis_index_groups=hier.dcn_groups)  # [S, L]
+    dec = decode_rows(g, n, codec, profile)
+    return dec.reshape((hier.num_slices * x.shape[0],)
+                       + x.shape[1:]).astype(x.dtype)
+
+
+def hier_psum(x, axis: str, hier,
+              codec: Optional[CollectiveCodec] = None,
+              kind: str = "grad"):
+    """Two-stage all-reduce over ``axis``: fp32-accumulate psum
+    intra-slice (ICI), then the per-slice residue crosses DCN as the
+    packed payload (encode → int8 all-gather over the DCN groups →
+    decode → sum) — every rank decodes the SAME payloads, so the result
+    is identical on all ranks like a flat psum.  Falls back to the flat
+    psum when no codec applies (the flat schedule is already optimal
+    without the bytes trade)."""
+    rp = _codec_resolve(codec, kind)
+    if rp is None:
+        return _compat.psum(x, axis)
+    profile, stochastic = rp
+    y = _compat.psum(x, axis, axis_index_groups=hier.ici_groups)
+    n = int(np.prod(y.shape))
+    packed = encode_rows(y.reshape(1, n), codec, profile,
+                         stochastic=stochastic)
+    g = _compat.all_gather(packed, axis,
+                           axis_index_groups=hier.dcn_groups)  # [S, L]
+    dec = decode_rows(g, n, codec, profile)
+    return dec.sum(axis=0).reshape(y.shape).astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -166,7 +277,8 @@ def hier_all_gather(x, axis: str, hier):
 
 def make_bucket_gather(axis: Optional[str], hier=None,
                        batch_psum_axes: Tuple[str, ...] = (),
-                       grad_mode: str = "scatter"):
+                       grad_mode: str = "scatter",
+                       codec: Optional[CollectiveCodec] = None):
     """Factory for the bucket transport: a custom_vjp identity-of-layout
     whose forward ALL-GATHERS a flat local bucket over ``axis`` and
     whose backward REDUCE-SCATTERS the bucket cotangent (then psums the
@@ -185,7 +297,11 @@ def make_bucket_gather(axis: Optional[str], hier=None,
     The custom_vjp (rather than relying on all_gather's transpose) is
     what pins the SEGMENTATION: one collective per bucket, issued
     exactly when that bucket's backward segment completes, and routed
-    hierarchically when the axis spans slices."""
+    hierarchically when the axis spans slices.  ``codec`` (only
+    meaningful with ``hier``) quantizes the DCN stage of both
+    directions: the forward weights-gather under the non-stochastic
+    weight profile, the backward grad reduce-scatter under the
+    stochastic grad profile."""
     if grad_mode not in ("scatter", "slice"):
         raise ValueError(f"grad_mode {grad_mode!r}")
     if axis is None:
@@ -197,7 +313,8 @@ def make_bucket_gather(axis: Optional[str], hier=None,
 
     def _fwd_impl(bucket_local):
         if hier is not None:
-            return hier_all_gather(bucket_local, axis, hier)
+            return hier_all_gather(bucket_local, axis, hier,
+                                   codec=codec, kind="weight")
         return _compat.all_gather(bucket_local, axis)
 
     @jax.custom_vjp
@@ -213,7 +330,8 @@ def make_bucket_gather(axis: Optional[str], hier=None,
             r = lax.axis_index(axis)
             gs = lax.dynamic_slice_in_dim(g, r * n_local, n_local, axis=0)
         elif hier is not None:
-            gs = hier_psum_scatter(g, axis, hier)
+            gs = hier_psum_scatter(g, axis, hier, codec=codec,
+                                   kind="grad")
         else:
             gs = _compat.psum_scatter(g, axis)
         for a in batch_psum_axes:
@@ -224,15 +342,40 @@ def make_bucket_gather(axis: Optional[str], hier=None,
     return bucket_gather
 
 
-def make_grad_sync(reduce_axes: Tuple[str, ...]):
+def make_grad_sync(reduce_axes: Tuple[str, ...], hier_axis=None,
+                   hier=None, codec: Optional[CollectiveCodec] = None):
     """Identity whose backward psums the cotangent over ``reduce_axes``
     — the replicated-param (norm weights) grad reduction, issued in the
     owning layer's backward segment instead of after the whole
-    backward."""
+    backward.  When ``hier_axis`` (with its ``hier`` structure and a
+    ``codec``) is among the reduce axes, that axis's psum runs
+    two-stage with the residue quantized across DCN (``hier_psum``);
+    the codec-off path is bit-identical to before."""
     if not reduce_axes:
         return lambda x: x
     axes = tuple(reduce_axes)
-    return lambda x: _grad_sync(x, axes)
+    use_codec = (hier is not None and hier_axis in axes
+                 and _codec_resolve(codec, "grad") is not None)
+    if not use_codec:
+        return lambda x: _grad_sync(x, axes)
+
+    @jax.custom_vjp
+    def coded_sync(x):
+        return x
+
+    def _coded_sync_fwd(x):
+        return x, None
+
+    def _coded_sync_bwd(_, g):
+        for a in axes:
+            if a == hier_axis:
+                g = hier_psum(g, a, hier, codec=codec, kind="grad")
+            else:
+                g = _compat.psum(g, a)
+        return (g,)
+
+    coded_sync.defvjp(_coded_sync_fwd, _coded_sync_bwd)
+    return coded_sync
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -389,7 +532,9 @@ def gather_tree_over_sharding(tree: Dict[str, Any],
                               layout: Dict[str, _LeafPlace],
                               lead_ndim: int, sh: int, mp: int,
                               axis: Optional[str], hier=None,
-                              bucket_bytes: int = 4 << 20) -> Dict[str, Any]:
+                              bucket_bytes: int = 4 << 20,
+                              codec: Optional[CollectiveCodec] = None
+                              ) -> Dict[str, Any]:
     """Gather a whole param tree's sharding-sharded leaves at once (the
     schedule-explicit pipeline path: the executor's divergent branches
     cannot host per-layer gathers, so the chunk gathers ONCE per step at
@@ -414,7 +559,8 @@ def gather_tree_over_sharding(tree: Dict[str, Any],
     for bucket in buckets:
         flat = jnp.concatenate([tree[s].reshape(-1) for s in bucket])
         if hier is not None:
-            full = hier_all_gather(flat, axis, hier)
+            full = hier_all_gather(flat, axis, hier, codec=codec,
+                                   kind="weight")
         else:
             full = _compat.all_gather(flat, axis)
         seg = full.reshape(sh, -1)
@@ -704,6 +850,10 @@ OVERLAP_REGION_FUNCS = frozenset({
     "_grad_sync_bwd", "ring_collective_matmul", "tp_row_matmul",
     "hier_psum_scatter", "hier_all_gather", "gathered_layer_scan",
     "gather_tree_over_sharding", "slice_tree_own_shard",
+    # round-15 quantized-DCN entries (codec.py's encode/decode issue no
+    # collectives themselves; the int8 exchanges live in these frames)
+    "hier_psum", "_dcn_psum_scatter_coded", "_dcn_all_gather_coded",
+    "_coded_sync_bwd",
 })
 
 
@@ -765,10 +915,14 @@ def build_overlap_stack(cfg, mesh: Mesh,
         shapes, mesh, spec_for, oc, compute_dtype)
     order = sorted(shapes)
 
-    gather_fns = [make_bucket_gather(sh_ax, hier, psum_axes)
+    # the codec rides the hierarchical axis only (quantize-across-DCN
+    # placement rule): no resolved hier -> no DCN stage -> codec inert
+    codec = oc.codec if hier is not None else None
+    gather_fns = [make_bucket_gather(sh_ax, hier, psum_axes, codec=codec)
                   for _ in buckets]
     # every batch axis (incl. sharding) reduces the replicated leaves
-    sync_fn = make_grad_sync(data_axes)
+    sync_fn = make_grad_sync(data_axes, hier_axis=sh_ax, hier=hier,
+                             codec=codec)
 
     in_specs = (
         {sfx: leaf_partition_spec(layout[sfx]) for sfx in order},

@@ -411,6 +411,77 @@ def _execute_leaf(lp: LeafPlan, val, dst_mesh: Mesh):
     return dst
 
 
+# ---------------------------------------------------------------------------
+# quantized (codec) execution — the int8 serving weight-delivery path
+# ---------------------------------------------------------------------------
+
+
+def _leaf_codec_applies(lp: LeafPlan) -> bool:
+    """The codec streams HOST-route float leaves only: a device-route
+    step is a live relayout on the same chips (no slow wire to save),
+    and integer/bool leaves have no block-scale representation."""
+    return (lp.moved and lp.route == "host" and lp.dtype is not None
+            and np.issubdtype(np.dtype(lp.dtype), np.floating))
+
+
+def _execute_leaf_encoded(lp: LeafPlan, val, dst_mesh: Mesh, codec):
+    """Codec-route execution of one host leaf: each chunk is encoded
+    host-side (numpy) into the block-scaled packed payload, the packed
+    int8 buffer is what transits host->device, and a jitted decode with
+    destination out_shardings reconstructs the chunk — LOSSY by
+    construction (block-scaled quantization error bounded by
+    absmax/qmax per block), which is the int8-weight-delivery trade."""
+    from .codec import decode_jit, encode_rows_host
+
+    rp = codec.resolve("weight")
+    if rp is None:
+        return _execute_leaf(lp, val, dst_mesh)
+    profile, _ = rp
+    sh = NamedSharding(dst_mesh, lp.dst_spec)
+    if lp.chunk_axis is None:
+        packed = encode_rows_host(
+            np.asarray(val, np.float32).reshape(1, -1), codec, profile)
+        dec = decode_jit(lp.shape, lp.dtype, codec, profile,
+                         out_sharding=sh)
+        return dec(jax.device_put(packed))
+    dst = jax.jit(functools.partial(jnp.zeros, lp.shape, lp.dtype),
+                  out_shardings=sh)()
+    decoders = {}     # chunk shape -> compiled decoder (chunks mostly
+    for a, b in lp.chunks:  # share one shape; don't recompile per chunk)
+        piece = np.asarray(_slice_on(val, lp.chunk_axis, a, b),
+                           np.float32)
+        dec = decoders.get(piece.shape)
+        if dec is None:
+            dec = decoders[piece.shape] = decode_jit(
+                piece.shape, lp.dtype, codec, profile, out_sharding=sh)
+        packed = encode_rows_host(piece.reshape(1, -1), codec, profile)
+        dst = _chunk_update(dst, dec(jax.device_put(packed)),
+                            lp.chunk_axis, a)
+    return dst
+
+
+def execute_encoded(plan: ReshardPlan, tree, codec):
+    """Execute ``plan`` with host-route float leaves streamed as
+    block-scaled packed payloads and decoded at the destination
+    (parallel/codec.py; the ROADMAP's "int8 weight path at serving
+    load time").  Device-route, noop and non-float leaves ride the
+    plain bit-exact path.  ``codec.weight_profile == "none"`` degrades
+    to ``plan.execute`` exactly."""
+    flat, treedef = path_leaves(tree)
+    by_path = {lp.path: lp for lp in plan.leaf_plans}
+    out = []
+    for path, val in flat:
+        lp = by_path.get(path)
+        if lp is None:
+            raise KeyError(f"leaf {path!r} was not in the planned tree")
+        if _leaf_codec_applies(lp):
+            out.append(_execute_leaf_encoded(lp, val, plan.dst_mesh,
+                                             codec))
+        else:
+            out.append(_execute_leaf(lp, val, plan.dst_mesh))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def reshard(tree, dst_mesh: Mesh, dst_specs=None, *,
             max_transient_bytes: Optional[int] = DEFAULT_TRANSIENT_BYTES,
             slice_map: Optional[Dict[str, Sequence[int]]] = None):
@@ -426,15 +497,22 @@ def reshard(tree, dst_mesh: Mesh, dst_specs=None, *,
 # ---------------------------------------------------------------------------
 
 
-def reshard_step_entry(plan: ReshardPlan, step: ReshardStep, tree):
-    """(fn, args) for the doctor: a jitted identity whose outputs carry
+def reshard_step_entry(plan: ReshardPlan, step: ReshardStep, tree,
+                       codec=None):
+    """(fn, args) for the doctor: a jitted program whose outputs carry
     the destination shardings of every moved leaf's FIRST chunk — the
     compiled program is the redistribution XLA would run for that step,
     and its ``memory_analysis`` peak is the step's transient footprint.
+    With ``codec``, the codec-routed leaves enter as their PACKED int8
+    payloads and the program decodes them — pricing the POST-codec
+    transient, which is what an encoded delivery actually moves.
     Returns None when the step moves nothing."""
+    from .codec import decode_rows, encode_rows_host
+
+    rp = codec.resolve("weight") if codec is not None else None
     flat, _ = path_leaves(tree)
     values = dict(flat)
-    args, shardings = [], []
+    args, shardings, decoders = [], [], []
     for lp in step.leaves:
         if not lp.moved:
             continue
@@ -442,27 +520,47 @@ def reshard_step_entry(plan: ReshardPlan, step: ReshardStep, tree):
         if lp.chunk_axis is not None:
             a, b = lp.chunks[0]
             val = _slice_on(val, lp.chunk_axis, a, b)
-        if lp.route == "host" or not isinstance(val, jax.Array):
-            val = np.asarray(val)
-        args.append(val)
+        if rp is not None and _leaf_codec_applies(lp):
+            profile = rp[0]
+            chunk_shape = tuple(int(s) for s in np.shape(val))
+            packed = encode_rows_host(
+                np.asarray(val, np.float32).reshape(1, -1), codec,
+                profile)
+            args.append(packed)
+            n = int(np.prod(chunk_shape)) if chunk_shape else 1
+
+            def _dec(p, n=n, shape=chunk_shape, dtype=lp.dtype,
+                     profile=profile):
+                return decode_rows(p, n, codec, profile,
+                                   out_dtype=dtype).reshape(shape)
+
+            decoders.append(_dec)
+        else:
+            if lp.route == "host" or not isinstance(val, jax.Array):
+                val = np.asarray(val)
+            args.append(val)
+            decoders.append(lambda x: x)
         shardings.append(NamedSharding(plan.dst_mesh, lp.dst_spec))
     if not args:
         return None
 
-    fn = jax.jit(lambda *xs: tuple(xs), out_shardings=tuple(shardings))
+    fn = jax.jit(lambda *xs: tuple(d(x) for d, x in zip(decoders, xs)),
+                 out_shardings=tuple(shardings))
     return fn, tuple(args)
 
 
 def check_reshard_budget(plan: ReshardPlan, tree, *,
                          budget_bytes: Optional[int] = None,
                          step_index: Optional[int] = None,
-                         exemptions=None, target: Optional[str] = None):
+                         exemptions=None, target: Optional[str] = None,
+                         codec=None):
     """Run the Graph Doctor ``memory_budget`` pass (MEM001 family) over
     one plan step's redistribution entry.  ``budget_bytes`` defaults to
     the plan's declared transient cap; ``step_index`` defaults to the
-    worst (largest-transient) step.  Returns the findings Report — an
-    unbounded plan against a real budget fires MEM001, a bounded plan
-    sweeps clean."""
+    worst (largest-transient) step.  ``codec`` prices the entry on its
+    POST-codec packed payloads (the encoded-delivery transient).
+    Returns the findings Report — an unbounded plan against a real
+    budget fires MEM001, a bounded plan sweeps clean."""
     from ..analysis import check
     from ..analysis.findings import Report
 
@@ -479,7 +577,7 @@ def check_reshard_budget(plan: ReshardPlan, tree, *,
         step_index = max(range(len(plan.steps)),
                          key=lambda i: plan.steps[i].transient_bytes)
     step = plan.steps[step_index]
-    entry = reshard_step_entry(plan, step, tree)
+    entry = reshard_step_entry(plan, step, tree, codec=codec)
     if entry is None:
         return Report(target=target or f"reshard_step[{step_index}]",
                       findings=(), passes_run=("memory_budget",))

@@ -57,6 +57,12 @@ def test_bench_smoke_green():
                 # fixtures fire exactly, and the GSPMD/overlap/hybrid
                 # canonical SpecLayout tables agree on the llama
                 # flagship parameter tree (SHARD003 empty)
-                "sharding_doctor"):
+                "sharding_doctor",
+                # round-15: quantized DCN collectives — the COMM004
+                # fixture fires exactly, and the flagship bucketed
+                # reduce-scatter's DCN bytes shrink >= 3x with the
+                # int8 codec (per-bucket structural table + the traced
+                # per-stage wire tables)
+                "comm_bytes_trace"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]
