@@ -1467,6 +1467,207 @@ def serving_fleet_trace(smoke: bool = False, seed: int = 0):
     }
 
 
+def _drive_router_trace(router, schedule):
+    """Deterministic driver shared by the disagg bench runs: submit
+    each (tick, prompt, max_new) at its tick, step to drain, and record
+    per-token latency plus per-request TTFT (wall from submit to the
+    first COMMITTED token — for the disaggregated fleet that spans
+    prefill, KV handoff and the first decode harvest)."""
+    from paddle_tpu.inference.fleet import OverloadRejected
+
+    by_tick = {}
+    for t, prompt, mnew in schedule:
+        by_tick.setdefault(int(t), []).append((prompt, mnew))
+    submitted = {}          # rid -> (prompt, mnew, t_submit)
+    ttft = {}
+    lat = []
+    rejected = 0
+    tick = 0
+    while True:
+        for prompt, mnew in by_tick.pop(tick, []):
+            try:
+                rid = router.submit(prompt, max_new_tokens=mnew)
+            except OverloadRejected:     # ladder stage 3: explicit shed
+                rejected += 1
+                continue
+            submitted[rid] = (prompt, mnew, time.perf_counter())
+        t0 = time.perf_counter()
+        produced = router.step()
+        dt = time.perf_counter() - t0
+        if produced:
+            lat.extend([dt / produced] * produced)
+        now = time.perf_counter()
+        for rid, (_, _, ts) in submitted.items():
+            if rid not in ttft:
+                req = router.requests.get(rid)
+                if req is not None and req.emitted:
+                    ttft[rid] = now - ts
+        tick += 1
+        if not by_tick and not router.pending():
+            break
+        if tick > 3000:
+            raise RuntimeError("disagg trace did not drain")
+    return {"submitted": submitted, "ttft": ttft, "per_token_lat": lat,
+            "rejected": rejected, "ticks": tick}
+
+
+def serving_disagg_trace(smoke: bool = False, seed: int = 0):
+    """Disaggregated prefill/decode bench (round-16): the SAME
+    prompt-burst trace through (a) the round-13 unified fleet and
+    (b) the two-pool disaggregated fleet, plus (full mode) the int8-KV
+    disaggregated fleet — bench.py --serving-disagg-trace ->
+    SERVING_DISAGG_r01.json.
+
+    Records what the round-16 BASELINE entry predicts against:
+
+    - p50/p99 per-token latency and TTFT, unified vs disaggregated
+      (CPU sessions run interpret-mode kernels: the absolute numbers
+      are structural, the unified-vs-disagg SHAPE is the prediction —
+      decode p99 flat under the prompt burst);
+    - KV-handoff bytes pre/post the int8 KV form (the quantized wire:
+      int8 pages move ~1 byte/element bit-exactly; the float-cache
+      handoff is the raw denominator), with the plan-once/stream-per-
+      handoff telemetry and the MEM001 + wire budget doctor gates;
+    - the zero-loss + bit-parity gates: disaggregated greedy streams
+      identical to one-shot generate() on every completed request.
+
+    Smoke mode runs the disaggregated float fleet only and computes
+    the int8 wire ratio structurally from the same page geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    _ensure_tests_path()
+    from fault_injection import (build_disagg_fleet, build_serving_fleet,
+                                 toy_llama)
+    from paddle_tpu.models.generation import generate
+
+    cfg, model, params = toy_llama()
+    rng = np.random.default_rng(seed)
+    n_req = 5 if smoke else 12
+    max_new = 4 if smoke else 6
+    sysp = rng.integers(1, cfg.vocab_size, (16,)).astype(np.int32)
+    schedule = []
+    for i in range(n_req):
+        n = int(np.clip(rng.lognormal(2.0, 0.5), 4, 24))
+        body = rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+        prompt = np.concatenate([sysp, body]) if i % 2 == 0 else body
+        # a prompt BURST: everything lands on ticks 0-2
+        schedule.append((i % 3, prompt, max_new))
+
+    def check_parity(router, res):
+        ok = True
+        for rid, (prompt, mnew, _) in res["submitted"].items():
+            out = router.results().get(rid)
+            if out is None:
+                return False, 1
+            ref = generate(model, prompt[None], max_new_tokens=mnew,
+                           do_sample=False)
+            ref_new = np.asarray(ref._value if hasattr(ref, "_value")
+                                 else ref)[0, len(prompt):]
+            ok &= (len(out) == mnew and np.array_equal(out, ref_new))
+        return ok, 0
+
+    def pcts(xs):
+        a = np.asarray(list(xs)) if xs else np.zeros(1)
+        return {"p50_ms": float(np.percentile(a, 50) * 1e3),
+                "p99_ms": float(np.percentile(a, 99) * 1e3)}
+
+    t0 = time.perf_counter()
+    runs = {}
+    routers = {}
+    # (a) unified fleet baseline (full mode only — the smoke leg's
+    # parity bar is the disagg run against one-shot generate)
+    if not smoke:
+        router_u, _ = build_serving_fleet(cfg, params, target=2)
+        res_u = _drive_router_trace(router_u, schedule)
+        par_u, lost_u = check_parity(router_u, res_u)
+        runs["unified"] = {
+            "parity": par_u, "lost": lost_u, "ticks": res_u["ticks"],
+            "per_token": pcts(res_u["per_token_lat"]),
+            "ttft": pcts(res_u["ttft"].values())}
+    # (b) disaggregated fleet, float KV (the raw-handoff denominator)
+    router_d, rs_d = build_disagg_fleet(cfg, params, prefill=1,
+                                        decode=2 if not smoke else 1)
+    res_d = _drive_router_trace(router_d, schedule)
+    par_d, lost_d = check_parity(router_d, res_d)
+    hd = dict(router_d.planner.telemetry)
+    runs["disagg"] = {
+        "parity": par_d, "lost": lost_d, "ticks": res_d["ticks"],
+        "per_token": pcts(res_d["per_token_lat"]),
+        "ttft": pcts(res_d["ttft"].values()),
+        "handoffs": router_d.telemetry["handoffs"],
+        "handoffs_mid_decode": router_d.telemetry["handoffs_mid_decode"],
+        "handoff_bytes": hd}
+    routers["disagg"] = router_d
+    # (c) the int8-KV wire: real fleet in full mode, structural page
+    # arithmetic in smoke (same geometry, 1 byte/elem + the engine's
+    # frozen scale sidecar living OUTSIDE the per-handoff wire)
+    raw_bytes = hd["bytes_wire"]
+    if smoke:
+        itemsize = np.dtype(np.float32).itemsize
+        int8_bytes = raw_bytes // itemsize
+        runs["disagg_int8"] = {"structural": True,
+                               "handoff_bytes_wire": int8_bytes}
+        par_i = True
+    else:
+        router_i, _ = build_disagg_fleet(cfg, params, prefill=1,
+                                         decode=2,
+                                         cache_dtype=jnp.int8)
+        res_i = _drive_router_trace(router_i, schedule)
+        int8_bytes = router_i.planner.telemetry["bytes_wire"]
+        # int8 parity is against the int8 unified ENGINE (the quantized
+        # cache shifts near-ties vs the float reference by design); the
+        # tier-1 test pins it bit-for-bit — here the gate is completion
+        par_i = len(router_i.results()) == len(res_i["submitted"])
+        runs["disagg_int8"] = {
+            "completed_all": par_i, "ticks": res_i["ticks"],
+            "per_token": pcts(res_i["per_token_lat"]),
+            "ttft": pcts(res_i["ttft"].values()),
+            "handoffs": router_i.telemetry["handoffs"],
+            "handoff_bytes": dict(router_i.planner.telemetry)}
+        routers["disagg_int8"] = router_i
+    ratio = raw_bytes / int8_bytes if int8_bytes else 0.0
+
+    # the doctor gates on the last real handoff payload; the wire
+    # budget is PER-PAYLOAD and derived from the payload GEOMETRY (the
+    # int8 page form: 1 byte/element), never from the measured plan
+    # itself — so a silently-dropped int8 cache (4 bytes/element on
+    # the wire) fires the gate instead of re-deriving its own budget
+    doctor_router = routers.get("disagg_int8", router_d)
+    tree = doctor_router.planner.last_tree
+    delivery_ok = True
+    if tree is not None:
+        if doctor_router is router_d:
+            # smoke mode has only the float fleet: gate MEM001 alone
+            # (the wire gate's fire/clean behavior is pinned tier-1 in
+            # tests/test_serving_disagg.py on the int8 payload)
+            rep = doctor_router.planner.check_handoff_budget(tree)
+        else:
+            int8_form_bytes = sum(int(np.prod(np.shape(v)))
+                                  for v in tree.values())
+            rep = doctor_router.planner.check_handoff_budget(
+                tree, wire_budget_bytes=int8_form_bytes)
+        delivery_ok = rep.ok
+    ok = (par_d and par_i and not lost_d
+          and runs["disagg"]["handoffs"] > 0
+          and ratio > 1.5 and delivery_ok
+          and (smoke or (runs["unified"]["parity"]
+                         and not runs["unified"]["lost"])))
+    return {
+        "ok": bool(ok),
+        "backend": jax.default_backend(),
+        "interpret_mode": jax.default_backend() == "cpu",
+        "runs": runs,
+        "handoff_bytes_raw": int(raw_bytes),
+        "handoff_bytes_int8": int(int8_bytes),
+        "handoff_wire_ratio": round(float(ratio), 3),
+        "handoff_doctor_ok": bool(delivery_ok),
+        "elapsed_s": time.perf_counter() - t0,
+        "trace": {"n_requests": n_req, "max_new_tokens": max_new,
+                  "burst_ticks": 3, "seed": seed},
+    }
+
+
 def comm_bytes_trace(smoke=False):
     """bench.py --comm-bytes-trace — structural (CPU-runnable) pre/post-
     codec bytes-on-the-wire report for the flagship hierarchical overlap
@@ -1909,6 +2110,20 @@ def smoke():
         legs["sharding_doctor"] = _smoke_sharding_doctor()
     except Exception as e:  # noqa: BLE001
         legs["sharding_doctor"] = {"ok": False, "error": repr(e)}
+
+    # 19. round-16 disaggregated serving: the prompt-burst trace through
+    #     the two-pool fleet — every stream bit-identical to one-shot
+    #     generate(), handoffs > 0 through the MEM001-budgeted cached
+    #     plan, and the int8 KV wire measurably below the raw form
+    try:
+        tr = serving_disagg_trace(smoke=True)
+        legs["serving_disagg"] = {
+            "ok": bool(tr["ok"]),
+            "handoffs": tr["runs"]["disagg"]["handoffs"],
+            "handoff_wire_ratio": tr["handoff_wire_ratio"],
+            "handoff_doctor_ok": tr["handoff_doctor_ok"]}
+    except Exception as e:  # noqa: BLE001
+        legs["serving_disagg"] = {"ok": False, "error": repr(e)}
 
     # 18. round-15 quantized DCN collectives: the COMM004 fixture fires
     #     exactly, and the flagship bucketed reduce-scatter's DCN bytes
@@ -2390,6 +2605,15 @@ if __name__ == "__main__":
         res = serving_fleet_trace(smoke="--smoke-trace" in sys.argv)
         try:
             with open("SERVING_FLEET_r01.json", "w") as f:
+                json.dump(res, f, indent=1, default=str)
+        except OSError:
+            pass
+        print(json.dumps(res, default=str))
+        sys.exit(0 if res["ok"] else 1)
+    if "--serving-disagg-trace" in sys.argv:
+        res = serving_disagg_trace(smoke="--smoke-trace" in sys.argv)
+        try:
+            with open("SERVING_DISAGG_r01.json", "w") as f:
                 json.dump(res, f, indent=1, default=str)
         except OSError:
             pass

@@ -467,6 +467,25 @@ def seeded_replica_delivery_over_budget() -> Report:
         target="seeded:MEM001[replica_delivery]")
 
 
+def seeded_kv_handoff_over_budget() -> Report:
+    """MEM001 on the round-16 disaggregated KV-handoff entry: an
+    UNBOUNDED handoff plan (``max_transient_bytes=None`` — whole page
+    tree in one step, the shape an ad-hoc per-handoff device_put sweep
+    degenerates to) streams a 256 KB fp32 KV page tree against a 64 KB
+    declared budget.  ``DisaggRouter`` always streams through the
+    planner's size-capped cached plan; this proves the budget pin
+    fires when someone bypasses the cap."""
+    from ..inference.disagg import KVHandoffPlanner
+
+    # [L=2, npages=8, kvh=2, page=16, d=64] fp32 = 128 KB per pool side
+    tree = {"k": np.ones((2, 8, 2, 16, 64), np.float32),
+            "v": np.ones((2, 8, 2, 16, 64), np.float32)}
+    planner = KVHandoffPlanner(max_transient_bytes=None)
+    return planner.check_handoff_budget(
+        tree, budget_bytes=64 << 10, exemptions=(),
+        target="seeded:MEM001[kv_handoff]")
+
+
 def seeded_while_peeling() -> Report:
     """HLO003 over a captured-HLO sample: a scanned body's all-gather
     duplicated TWICE into the hosting computation (XLA's peel+unroll
@@ -637,6 +656,9 @@ SEEDED = {
     # a fourth on the round-13 replica weight-delivery entry: an
     # unbounded fleet delivery plan overruns its declared budget
     "MEM001[replica_delivery]": seeded_replica_delivery_over_budget,
+    # a fifth on the round-16 disaggregated KV-handoff entry: an
+    # unbounded handoff plan overruns its declared transient budget
+    "MEM001[kv_handoff]": seeded_kv_handoff_over_budget,
     "MEM002": seeded_host_round_trip,
     # round-14: the Sharding Doctor (cross-stack partition consistency)
     "SHARD001": seeded_gspmd_reshard,

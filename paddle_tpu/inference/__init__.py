@@ -344,3 +344,8 @@ from .serving import (ContinuousBatchingEngine, PageAllocator,  # noqa: E402
 # router + request-level fault tolerance
 from .fleet import (FleetConfig, FleetRouter, OverloadRejected,  # noqa: E402
                     Replica, ReplicaSet, RouterConfig)
+# round-16 disaggregated prefill/decode serving over the tiered KV
+# plane: role-split pools, KV handoff as a reshard-engine route,
+# two-pool scheduling + load-driven autoscale
+from .disagg import (AutoscaleConfig, DisaggRouter,  # noqa: E402
+                     KVHandoffPlanner)

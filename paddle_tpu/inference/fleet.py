@@ -107,10 +107,17 @@ class Replica:
     step's output as suspect and migrate."""
 
     def __init__(self, replica_id: int, engine_factory: Callable,
-                 step_timeout_s: float = 0.0):
+                 step_timeout_s: float = 0.0, role: str = "unified"):
         self.id = int(replica_id)
         self._factory = engine_factory
         self.step_timeout_s = float(step_timeout_s)
+        # round-16 disaggregated serving: which POOL this replica
+        # serves — "prefill" (prompt-only engine, KV hands off),
+        # "decode" (continuation-only by routing) or "unified" (both).
+        # The ReplicaSet stamps it at spawn; the engine's own
+        # prefill_only flag is the enforcement, the role is the
+        # router's scheduling key.
+        self.role = role
         self.state = SPAWNING
         self.engine = None
         self.fault: Optional[BaseException] = None
@@ -149,6 +156,12 @@ class Replica:
                               max_new_tokens=3)
         for _ in range(64):
             eng.step()
+            # a prefill-only engine parks the completed dummy for KV
+            # handoff: drain it through the export path, warming the
+            # page-gather dispatch the real handoffs use
+            for slot in list(getattr(eng, "handoff_ready", ())):
+                eng.export_handoff(slot)
+                eng.release_handoff(slot)
             if not eng.active.any() and not eng.queue:
                 break
         eng.finished.clear()
@@ -204,6 +217,12 @@ class Replica:
 @dataclasses.dataclass
 class FleetConfig:
     target_replicas: int = 2
+    # round-16 disaggregated pools: role -> target replica count (None
+    # keeps the classic single unified pool at ``target_replicas``).
+    # The autoscale policy (inference/disagg.py) MUTATES this mapping;
+    # ensure_target respawns per pool, so a dead prefill replica is
+    # replaced by a prefill replica.
+    pool_targets: Optional[Dict[str, int]] = None
     step_timeout_s: float = 0.0            # 0 = heartbeat watchdog off
     # weight-delivery plan transient cap (the reshard planner's
     # size-capped steps) and the doctor budget the plan is priced
@@ -236,9 +255,15 @@ class ReplicaSet:
     def __init__(self, params, engine_factory: Callable,
                  config: Optional[FleetConfig] = None, *,
                  dst_mesh=None, dst_specs=None,
-                 replica_factory: Optional[Callable] = None):
+                 replica_factory: Optional[Callable] = None,
+                 engine_factories: Optional[Dict[str, Callable]] = None):
         self.params = params
         self.engine_factory = engine_factory
+        # per-ROLE engine factories (round-16 disaggregation): a
+        # prefill pool builds prompt-only engines, decode/unified pools
+        # build full engines; a role without its own factory falls back
+        # to the default
+        self.engine_factories = engine_factories or {}
         self.config = config or FleetConfig()
         self.dst_mesh = dst_mesh
         self.dst_specs = dst_specs
@@ -317,13 +342,17 @@ class ReplicaSet:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def spawn(self) -> Replica:
+    def spawn(self, role: str = "unified") -> Replica:
         """spawn → deliver weights (cached plan) → warm → SERVING.
         A delivery/warmup failure marks the half-spawned replica DEAD
         (reaped like any other death) and re-raises — callers that must
-        survive spawn failure (``ensure_target``) catch and retry."""
-        rep = self.replica_factory(self._next_id, self.engine_factory,
+        survive spawn failure (``ensure_target``) catch and retry.
+        ``role`` picks the pool (and with it the per-role engine
+        factory); the default keeps the classic unified fleet."""
+        factory = self.engine_factories.get(role, self.engine_factory)
+        rep = self.replica_factory(self._next_id, factory,
                                    step_timeout_s=self.config.step_timeout_s)
+        rep.role = role
         self._next_id += 1
         self.replicas[rep.id] = rep
         try:
@@ -357,29 +386,43 @@ class ReplicaSet:
         self.replicas.pop(rep.id, None)
         self.telemetry["removed"] += 1
 
-    def serving(self) -> List[Replica]:
-        return [r for r in self.replicas.values() if r.state == SERVING]
+    def serving(self, role: Optional[str] = None) -> List[Replica]:
+        return [r for r in self.replicas.values() if r.state == SERVING
+                and (role is None or r.role == role)]
 
-    def live(self) -> List[Replica]:
+    def live(self, role: Optional[str] = None) -> List[Replica]:
         return [r for r in self.replicas.values()
-                if r.state in (SERVING, DRAINING)]
+                if r.state in (SERVING, DRAINING)
+                and (role is None or r.role == role)]
+
+    def pool_targets(self) -> Dict[str, int]:
+        """The per-role target map (the classic single-pool fleet is
+        {"unified": target_replicas})."""
+        if self.config.pool_targets is not None:
+            return self.config.pool_targets
+        return {"unified": self.config.target_replicas}
 
     def ensure_target(self) -> List[Replica]:
-        """Spawn until SPAWNING+WARMING+SERVING meets the target
-        (DRAINING replicas are on their way out and do not count).  A
-        spawn failure is a REPLICA death, never the caller's: it is
+        """Spawn until each pool's SPAWNING+WARMING+SERVING count meets
+        its target (DRAINING replicas are on their way out and do not
+        count) — a dead prefill replica respawns as a prefill replica.
+        A spawn failure is a REPLICA death, never the caller's: it is
         logged, counted (deaths["SpawnFailed"]) and retried on the next
         call — the router tick that triggered the respawn survives."""
         spawned = []
-        while len([r for r in self.replicas.values()
-                   if r.state in (SPAWNING, WARMING, SERVING)]) \
-                < self.config.target_replicas:
-            try:
-                spawned.append(self.spawn())
-            except Exception:  # noqa: BLE001 — logged + retried
-                logger.exception("[fleet] replica spawn failed; will "
-                                 "retry next tick")
-                break
+        for role, target in self.pool_targets().items():
+            while len([r for r in self.replicas.values()
+                       if r.state in (SPAWNING, WARMING, SERVING)
+                       and r.role == role]) < int(target):
+                try:
+                    spawned.append(self.spawn(role))
+                except Exception:  # noqa: BLE001 — logged + retried
+                    # THIS pool retries next tick; a persistently
+                    # failing pool must never block the other pools'
+                    # healing, so move on rather than returning
+                    logger.exception("[fleet] %s replica spawn failed; "
+                                     "will retry next tick", role)
+                    break
         return spawned
 
 
@@ -786,11 +829,10 @@ class FleetRouter:
 
     # -- the tick ----------------------------------------------------------
 
-    def step(self) -> int:
-        """One router tick.  Returns tokens committed this tick."""
-        self._tick += 1
-        self._update_ladder()
-        self._dispatch()
+    def _step_replicas(self) -> None:
+        """Step every live replica, treating ANY engine exception as
+        that replica's death (migrate + heal) — the shared middle of
+        the base and disaggregated router ticks."""
         for rep in list(self.set.live()):
             try:
                 rep.step()
@@ -813,6 +855,13 @@ class FleetRouter:
                 logger.warning("[fleet] replica %d %s at tick %d; "
                                "migrated %d in-flight requests",
                                rep.id, kind, self._tick, moved)
+
+    def step(self) -> int:
+        """One router tick.  Returns tokens committed this tick."""
+        self._tick += 1
+        self._update_ladder()
+        self._dispatch()
+        self._step_replicas()
         produced = self._harvest()
         self._check_deadlines()
         self._reap_and_respawn()

@@ -232,9 +232,15 @@ class PageAllocator:
 
 
 class _TrieNode:
-    """One committed full page of tokens in the prefix cache."""
+    """One committed full page of tokens in the prefix cache.
 
-    __slots__ = ("children", "key", "page", "parent", "tick")
+    Round 16 (the tiered KV plane): a node lives in one of two TIERS —
+    ``device`` (``page`` is a live pool page id, the trie holds one
+    allocator ref on it) or ``host`` (``page`` is None and ``host_kv``
+    carries the page's per-layer K/V stacked [L, kvh, page, d] pair,
+    placed in the pinned-host memory space)."""
+
+    __slots__ = ("children", "key", "page", "parent", "tick", "host_kv")
 
     def __init__(self, key=None, page=None, parent=None):
         self.children: Dict[tuple, "_TrieNode"] = {}
@@ -242,6 +248,11 @@ class _TrieNode:
         self.page = page
         self.parent = parent
         self.tick = 0
+        self.host_kv = None
+
+    @property
+    def tier(self) -> str:
+        return "device" if self.host_kv is None else "host"
 
 
 class PrefixCache:
@@ -263,9 +274,23 @@ class PrefixCache:
     Eviction is LRU over refcount-0 leaves (allocator refcount 1 = the
     trie's own reference, no live request) under pool pressure — interior
     nodes become leaves as their children evict, so a cold chain drains
-    bottom-up."""
+    bottom-up.
 
-    def __init__(self, page_size: int, alloc: PageAllocator):
+    Round 16 — the TIERED cache (``host_tier_pages > 0``): under pool
+    pressure, LRU refcount-0 pages are DEMOTED to the pinned-host
+    memory space (``demote_fn`` — parallel/memory.place_on_host through
+    the engine's pool gather) instead of evicted; a later lookup that
+    reaches a host-tier node PROMOTES it back into a device page
+    (``promote_fn``) and the hit proceeds exactly as a device hit — the
+    demote→promote round trip is bit-identical (pure residency moves,
+    no re-quantization).  Demotion needs no leaf-ness (the trie
+    structure is untouched), so interior pages demote too; only when
+    the host tier itself overflows its cap are LRU host-tier LEAVES
+    truly dropped, bottom-up like classic eviction."""
+
+    def __init__(self, page_size: int, alloc: PageAllocator, *,
+                 host_tier_pages: int = 0, demote_fn=None,
+                 promote_fn=None):
         self.page_size = int(page_size)
         self.alloc = alloc
         self.root = _TrieNode()
@@ -275,6 +300,19 @@ class PrefixCache:
         self.hit_tokens = 0
         self.inserted_pages = 0
         self.evicted_pages = 0
+        # host tier (round 16)
+        self.host_tier_pages = int(host_tier_pages)
+        self.demote_fn = demote_fn
+        self.promote_fn = promote_fn
+        if self.host_tier_pages > 0 and (demote_fn is None
+                                         or promote_fn is None):
+            raise ValueError(
+                "host_tier_pages > 0 needs demote_fn/promote_fn (the "
+                "engine's pool residency hooks)")
+        self.host_pages = 0
+        self.host_hits = 0
+        self.demoted_pages = 0
+        self.promoted_pages = 0
 
     def _chunks(self, tokens, npages: int):
         ps = self.page_size
@@ -302,11 +340,42 @@ class PrefixCache:
             child = node.children.get(key)
             if child is None:
                 break
+            # freshen recency FIRST: the promote hook may itself demote
+            # under pool pressure and trim the host tier — the node
+            # being promoted must never be the LRU drop candidate
+            child.tick = self._tick
+            if child.host_kv is not None:
+                # host-tier hit: promote back into a device page before
+                # handing it out.  No capacity to promote into (even
+                # after the promote hook's own demotion attempt) ends
+                # the walk — the suffix simply prefills cold.
+                page = self.promote_fn(child.host_kv)
+                if page is None:
+                    break
+                child.page, child.host_kv = int(page), None
+                self.host_pages -= 1
+                self.promoted_pages += 1
+                self.host_hits += 1
             self.alloc.acquire(child.page)
             pages.append(child.page)
-            child.tick = self._tick
             node = child
         return pages, len(pages) * self.page_size
+
+    def probe(self, prompt) -> int:
+        """Matched FULL-PAGE tokens for ``prompt`` across BOTH tiers,
+        with no refs acquired and no stats/LRU mutation — the fleet
+        router's cross-replica reachability query (a host-tier page on
+        any replica makes that replica the preferred prefill target)."""
+        limit = max(0, (len(prompt) - 1) // self.page_size)
+        node = self.root
+        matched = 0
+        for key in self._chunks(prompt, limit):
+            child = node.children.get(key)
+            if child is None:
+                break
+            matched += self.page_size
+            node = child
+        return matched
 
     def record_hit(self, matched_tokens: int) -> None:
         if matched_tokens > 0:
@@ -351,7 +420,14 @@ class PrefixCache:
         heap; a parent that becomes an evictable leaf when its last
         child is freed is pushed then — O(nodes + m log m) for m freed
         pages instead of re-walking the trie per page.  Ticks are
-        stable within the call (no lookup/insert runs concurrently)."""
+        stable within the call (no lookup/insert runs concurrently).
+
+        With the host tier enabled this DEMOTES instead: LRU refcount-0
+        DEVICE pages (leaf or interior — demotion keeps the trie
+        structure) move to pinned host, freeing their pool pages; the
+        host tier's own overflow then drops LRU host LEAVES."""
+        if self.host_tier_pages > 0:
+            return self._demote_lru(pages_needed)
         freed = 0
         seq = 0                      # tie-break: heap never compares nodes
         heap = []
@@ -374,22 +450,75 @@ class PrefixCache:
                 heapq.heappush(heap, heap_entry)
         return freed
 
+    def _demote_lru(self, pages_needed: int) -> int:
+        """Tiered pressure relief: demote up to ``pages_needed`` LRU
+        refcount-0 device pages to the host tier (their pool pages
+        free), then trim the host tier back under its cap by dropping
+        LRU host LEAVES.  Returns device pages freed."""
+        freed = 0
+        seq = 0
+        heap = []
+        for n in self._nodes():
+            if n.host_kv is None and self.alloc.refs[n.page] == 1:
+                heap.append((n.tick, seq, n))
+                seq += 1
+        heapq.heapify(heap)
+        while freed < pages_needed and heap:
+            _, _, victim = heapq.heappop(heap)
+            victim.host_kv = self.demote_fn(victim.page)
+            victim.page = None
+            self.host_pages += 1
+            self.demoted_pages += 1
+            freed += 1
+        # host-tier overflow: drop LRU host LEAVES, one traversal + a
+        # heap (the evict() shape) — a parent that becomes a droppable
+        # host leaf is pushed as its child goes.  tick == _tick marks
+        # the lookup path currently being promoted (recency set before
+        # the promote hook runs) — never a drop candidate.
+        if self.host_pages > self.host_tier_pages:
+            trim = []
+            for n in self._nodes():
+                if (n.host_kv is not None and not n.children
+                        and n.tick < self._tick):
+                    trim.append((n.tick, seq, n))
+                    seq += 1
+            heapq.heapify(trim)
+            while self.host_pages > self.host_tier_pages and trim:
+                _, _, drop = heapq.heappop(trim)
+                parent = drop.parent
+                del parent.children[drop.key]
+                self.host_pages -= 1
+                self.evicted_pages += 1
+                if (parent is not self.root and not parent.children
+                        and parent.host_kv is not None
+                        and parent.tick < self._tick):
+                    heapq.heappush(trim, (parent.tick, seq, parent))
+                    seq += 1
+        return freed
+
     def clear(self) -> None:
-        """Drop every trie reference (engine teardown)."""
+        """Drop every trie reference (engine teardown); host-tier
+        payloads (no allocator ref) just drop."""
         for n in list(self._nodes()):
-            self.alloc.release([n.page])
+            if n.host_kv is None:
+                self.alloc.release([n.page])
         self.root = _TrieNode()
+        self.host_pages = 0
 
     @property
     def cached_pages(self) -> int:
-        return sum(1 for _ in self._nodes())
+        return sum(1 for n in self._nodes() if n.host_kv is None)
 
     def stats(self) -> Dict[str, int]:
         return {"lookups": self.lookups, "hits": self.hits,
                 "hit_tokens": self.hit_tokens,
                 "cached_pages": self.cached_pages,
                 "inserted_pages": self.inserted_pages,
-                "evicted_pages": self.evicted_pages}
+                "evicted_pages": self.evicted_pages,
+                "host_pages": self.host_pages,
+                "host_hits": self.host_hits,
+                "demoted_pages": self.demoted_pages,
+                "promoted_pages": self.promoted_pages}
 
 
 class ContinuousBatchingEngine:
@@ -408,7 +537,9 @@ class ContinuousBatchingEngine:
                  prefill_token_budget: Optional[int] = None,
                  enable_prefix_cache: bool = False,
                  draft_params=None, draft_cfg=None,
-                 speculative_k: int = 0):
+                 speculative_k: int = 0,
+                 prefill_only: bool = False,
+                 host_tier_pages: int = 0):
         from ..models.generation import _CFGS, register_config
         from ..ops.pallas.decode_attention import tune_pages_per_step
 
@@ -512,8 +643,41 @@ class ContinuousBatchingEngine:
                 "the prefix cache requires the unified engine "
                 "(prefill_token_budget > 0): cache hits enter decode "
                 "mid-prompt, which only the ragged step can serve")
-        self.prefix_cache = (PrefixCache(self.page_size, self.alloc)
-                             if enable_prefix_cache else None)
+        # ---- round-16 disaggregated serving (inference/disagg.py) ----
+        # prefill_only: prompt-only ragged steps — a completed prompt
+        # parks in ``handoff_ready`` (KV pages + first sampled token)
+        # for the fleet's KV handoff instead of entering decode.
+        self.prefill_only = bool(prefill_only)
+        if self.prefill_only and not self.unified:
+            raise ValueError(
+                "prefill_only requires the unified engine "
+                "(prefill_token_budget > 0): the prompt-only step IS "
+                "the ragged prefill chunk")
+        if self.prefill_only and self.spec_k:
+            raise ValueError(
+                "prefill_only excludes speculative decoding: a prefill "
+                "replica never runs a verify window")
+        # slot -> handoff record (kept until the router streams the KV
+        # out or the request is canceled; pages stay reserved)
+        self.handoff_ready: Dict[int, Dict[str, Any]] = {}
+        self.host_tier_pages = int(host_tier_pages)
+        if self.host_tier_pages > 0 and not enable_prefix_cache:
+            raise ValueError(
+                "host_tier_pages > 0 is a prefix-cache tier — enable "
+                "the prefix cache")
+        if self.host_tier_pages > 0 and draft_params is not None:
+            raise ValueError(
+                "the host-tier prefix cache does not compose with a "
+                "draft model: demotion moves only the target's pools, "
+                "so a promoted page's draft mirror would be stale")
+        self.prefix_cache = (PrefixCache(
+            self.page_size, self.alloc,
+            host_tier_pages=self.host_tier_pages,
+            demote_fn=(self._demote_page if self.host_tier_pages
+                       else None),
+            promote_fn=(self._promote_page if self.host_tier_pages
+                        else None))
+            if enable_prefix_cache else None)
         # static packed-row capacity of one unified launch: one decode
         # row per slot (k+1 under speculation) + the prefill chunk
         self.rows_cap = self.max_slots * (1 + self.spec_k) \
@@ -730,6 +894,71 @@ class ContinuousBatchingEngine:
                       for i in range(L))
         return new_k, new_v
 
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def _set_page_jit(k_pages, v_pages, k, v, page):
+        """Write ONE page's per-layer K/V ([L, kvh, page, d]) into the
+        (donated) pools — the prefix-cache host-tier PROMOTE scatter."""
+        L = len(k_pages)
+        nk = tuple(k_pages[i].at[page].set(k[i].astype(k_pages[i].dtype))
+                   for i in range(L))
+        nv = tuple(v_pages[i].at[page].set(v[i].astype(v_pages[i].dtype))
+                   for i in range(L))
+        return nk, nv
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def _adopt_pages_jit(k_pages, v_pages, k, v, pg):
+        """Write an adopted handoff's per-layer page block
+        ([L, npages, kvh, page, d]) into the (donated) pools at the
+        destination page ids — one batched scatter per pool, the
+        decode-side landing of the round-16 KV handoff."""
+        L = len(k_pages)
+        nk = tuple(k_pages[i].at[pg].set(k[i].astype(k_pages[i].dtype))
+                   for i in range(L))
+        nv = tuple(v_pages[i].at[pg].set(v[i].astype(v_pages[i].dtype))
+                   for i in range(L))
+        return nk, nv
+
+    # ---- round-16 host-tier residency hooks (the prefix cache calls
+    # these through its demote_fn/promote_fn; parallel/memory.py owns
+    # the residency primitive) ----
+
+    def _demote_page(self, page: int):
+        """Gather one pool page's per-layer K/V to the pinned-host
+        memory space and free the device page.  jax arrays are
+        immutable, so the gathered copy is safe against later pool
+        writes; the host placement degrades to identity on backends
+        without memory kinds (the residency contract still exercises
+        the same code path — parallel/memory.py's CPU rule)."""
+        from ..parallel.memory import place_on_host
+
+        pg = int(page)
+        k = place_on_host(jnp.stack([kp[pg] for kp in self.k_pages]))
+        v = place_on_host(jnp.stack([vp[pg] for vp in self.v_pages]))
+        self.alloc.release([pg])
+        return (k, v)
+
+    def _promote_page(self, host_kv):
+        """Inverse of ``_demote_page``: allocate a device page (demoting
+        a colder page if the pool is full), fetch the host payload back
+        and scatter it in.  Returns the page id at trie-refcount 1, or
+        None when no device page could be found (the lookup then treats
+        the node as a miss)."""
+        from ..parallel.memory import place_on_device
+
+        p = self.alloc.alloc()
+        if p is None and self.prefix_cache is not None:
+            # ancestors on the lookup path hold extra refs, so this can
+            # never demote the chain being promoted
+            self.prefix_cache.evict(1)
+            p = self.alloc.alloc()
+        if p is None:
+            return None
+        k, v = host_kv
+        self.k_pages, self.v_pages = ContinuousBatchingEngine._set_page_jit(
+            self.k_pages, self.v_pages, place_on_device(k),
+            place_on_device(v), jnp.asarray(p, jnp.int32))
+        return p
+
     @staticmethod
     def _quant(x, scale):
         """x [L, tokens, kvh, d] x per-(L, kvh) scale -> int8."""
@@ -850,11 +1079,13 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
                 f"max_seq_len {self.max_seq_len}")
-        if self._pages_needed(len(prompt) + max_new_tokens) \
-                > self.alloc.total:
+        # prefill-only engines reserve prompt pages alone — decode-side
+        # budget pages belong to the replica the KV hands off to
+        reserve = len(prompt) + (0 if self.prefill_only
+                                 else max_new_tokens)
+        if self._pages_needed(reserve) > self.alloc.total:
             raise ValueError(
-                f"request needs "
-                f"{self._pages_needed(len(prompt) + max_new_tokens)} pages "
+                f"request needs {self._pages_needed(reserve)} pages "
                 f"but the pool only has {self.alloc.total} — it could "
                 f"never be admitted (head-of-line livelock)")
         if temperature > 0 and not self.unified:
@@ -955,6 +1186,7 @@ class ContinuousBatchingEngine:
         if slot in self.prefill_order:
             self.prefill_order.remove(slot)
         self.req_info.pop(slot, None)
+        self.handoff_ready.pop(slot, None)
 
     def _finish(self, slot: int):
         rid = int(self.slot_rid[slot])
@@ -1008,6 +1240,156 @@ class ContinuousBatchingEngine:
                     f"[1, {self._init_prefill_budget}] (the constructor's "
                     f"static chunk capacity)")
             self.prefill_budget = b
+
+    # ---------------- round-16 KV handoff (disaggregated serving) ----
+
+    def export_handoff(self, slot: int):
+        """Gather a handoff-ready slot's committed KV to HOST and
+        return ``(tree, meta)`` — the reshard-planner payload of the
+        disaggregated KV handoff (inference/disagg.KVHandoffPlanner).
+
+        ``tree`` is ``{"k", "v"}``, each ``[L, npages, kvh, page, d]``
+        host numpy in the CACHE dtype — int8 pools export their int8
+        pages (the round-15-precedented quantized-wire form: 1 byte per
+        element on the handoff wire, bit-exact because no re-encode
+        happens), float pools export bit-exact float pages.  ``meta``
+        carries the scheduler state the decode side needs (first
+        sampled token, committed length, frozen int8 scales).  Pages
+        stay reserved until ``release_handoff``."""
+        info = self.handoff_ready[slot]
+        npg = self._pages_needed(info["seq_len"])
+        pg = jnp.asarray(np.asarray(self.slot_pages[slot][:npg],
+                                    np.int32))
+        tree = {
+            "k": np.asarray(jnp.stack([kp[pg] for kp in self.k_pages])),
+            "v": np.asarray(jnp.stack([vp[pg] for vp in self.v_pages])),
+        }
+        meta = dict(info, page_size=self.page_size,
+                    cache_dtype=str(np.dtype(self.cache_dtype)))
+        if self.kv_scales is not None:
+            meta["kv_scales"] = {k: np.asarray(v)
+                                 for k, v in self.kv_scales.items()}
+        return tree, meta
+
+    def release_handoff(self, slot: int) -> None:
+        """Free a handed-off (or abandoned) prefill slot WITHOUT a
+        Finished record — the request continues on the decode replica
+        (or replays elsewhere); prefix-cache refs on shared pages are
+        the trie's own and survive."""
+        info = self.handoff_ready.pop(slot)
+        self.prompt_lens.pop(info["rid"], None)
+        self.out_tokens.pop(info["rid"], None)
+        self._release_slot(slot)
+
+    def can_adopt(self, seq_len: int, max_new_tokens: int) -> bool:
+        """Capacity probe for a KV handoff: a free slot plus enough
+        free (or prefix-evictable refcount-1) pages for the committed
+        prefix and the generation budget.  The router gates the
+        EXPENSIVE side of a handoff (page export + reshard stream) on
+        this, so a no-capacity replica costs a parked slot, never a
+        delivered-then-discarded payload.  Slightly optimistic for the
+        classic (non-tiered) cache — interior trie pages free only as
+        their chains drain — so ``adopt_request`` keeps its own None
+        return as the authoritative answer."""
+        if not self.unified or self.prefill_only:
+            return False
+        if self.active.all():
+            return False
+        if int(seq_len) + int(max_new_tokens) > self.max_seq_len:
+            return False
+        need = self._pages_needed(int(seq_len) + int(max_new_tokens))
+        avail = self.alloc.available
+        if self.prefix_cache is not None:
+            avail += sum(1 for n in self.prefix_cache._nodes()
+                         if n.host_kv is None
+                         and self.alloc.refs[n.page] == 1)
+        return need <= avail
+
+    def adopt_request(self, kv, meta, max_new_tokens: int, rid=None):
+        """Decode-side landing of a KV handoff: allocate pages for the
+        committed prefix PLUS the generation budget, scatter the
+        delivered page block in, and enter the slot directly in DECODE
+        state (seq_len = committed prefix, cur_tok = the prefill
+        replica's first sampled token — already part of the stream, so
+        ``out_tokens`` starts with it).  Frozen int8 K/V scales ride
+        ``meta`` and install on a still-uncalibrated engine, keeping
+        the fleet's quant/dequant pair single-sourced.  Returns the
+        engine rid, or None when no slot/pages are free (the router's
+        backpressure signal — retry next tick)."""
+        if not self.unified or self.prefill_only:
+            raise ValueError("adopt_request needs a decode-capable "
+                             "unified engine")
+        plen = int(meta["seq_len"])
+        first = int(meta["first_token"])
+        if int(meta["page_size"]) != self.page_size:
+            raise ValueError(
+                f"handoff page_size {meta['page_size']} != this "
+                f"engine's {self.page_size} — pools are incompatible")
+        src_dtype = meta.get("cache_dtype")
+        if (src_dtype is not None
+                and np.dtype(src_dtype) != np.dtype(self.cache_dtype)):
+            # a raw int8 payload astype'd into a float pool (or vice
+            # versa) would be silently-wrong KV, not an error — refuse
+            raise ValueError(
+                f"handoff cache_dtype {src_dtype} != this engine's "
+                f"{np.dtype(self.cache_dtype)} — pools are "
+                f"incompatible")
+        if plen + int(max_new_tokens) > self.max_seq_len:
+            raise ValueError("adopted prefix + budget exceeds "
+                             "max_seq_len")
+        free = [s for s in range(self.max_slots) if not self.active[s]]
+        if not free:
+            return None
+        need = self._pages_needed(plen + int(max_new_tokens))
+        npg = int(np.shape(kv["k"])[1])
+        if need > self.alloc.available and self.prefix_cache is not None:
+            self.prefix_cache.evict(need - self.alloc.available)
+        if need > self.alloc.available:
+            return None
+        scales = meta.get("kv_scales")
+        if scales is not None:
+            if self.kv_scales is None:
+                self.kv_scales = {k: jnp.asarray(v)
+                                  for k, v in scales.items()}
+            elif any(not np.array_equal(np.asarray(self.kv_scales[k]),
+                                        np.asarray(v))
+                     for k, v in scales.items()):
+                # int8 pages quantized under DIFFERENT frozen scales
+                # would dequantize wrong — one fleet, ONE calibration
+                # (DisaggRouter shares the first calibration fleet-wide;
+                # this guard turns any leak past that into a loud error)
+                raise ValueError(
+                    "handoff kv_scales diverge from this engine's "
+                    "frozen calibration — the fleet must share one "
+                    "int8 K/V calibration")
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        slot = free[0]
+        pages = [self.alloc.alloc() for _ in range(need)]
+        self.slot_pages[slot] = pages
+        self.tables[slot] = -1
+        self.tables[slot, :need] = pages
+        pg = jnp.asarray(np.asarray(pages[:npg], np.int32))
+        self.k_pages, self.v_pages = \
+            ContinuousBatchingEngine._adopt_pages_jit(
+                self.k_pages, self.v_pages, jnp.asarray(kv["k"]),
+                jnp.asarray(kv["v"]), pg)
+        self.active[slot] = True
+        self.seq_lens[slot] = plen
+        self.cur_tok[slot] = first
+        self.budget[slot] = int(max_new_tokens) - 1
+        self.slot_rid[slot] = rid
+        self.out_tokens[rid] = [first]
+        self.prompt_lens[rid] = plen
+        req = Request(int(rid), np.zeros(0, np.int32),
+                      int(max_new_tokens),
+                      temperature=float(meta.get("temperature", 0.0)))
+        req.rng = np.random.default_rng(req.seed)
+        self.req_info[slot] = req
+        if self.budget[slot] <= 0 or first == self.eos_id:
+            self._finish(slot)
+        return rid
 
     @staticmethod
     def _kv_calibration_scales(ks, vs, s: int):
@@ -1078,7 +1460,8 @@ class ContinuousBatchingEngine:
         while self.queue and si < len(free_slots):
             req = self.queue[0]
             plen = len(req.prompt)
-            need = self._pages_needed(plen + req.max_new_tokens)
+            need = self._pages_needed(
+                plen if self.prefill_only else plen + req.max_new_tokens)
             shared: List[int] = []
             matched = 0
             if self.prefix_cache is not None:
@@ -1261,7 +1644,8 @@ class ContinuousBatchingEngine:
         this_dec = np.zeros(self.max_slots, np.int32)
 
         decoding = [s for s in range(self.max_slots)
-                    if self.active[s] and s not in self.pending_prompt]
+                    if self.active[s] and s not in self.pending_prompt
+                    and s not in self.handoff_ready]
         props = {}
         if self.draft is not None and self.spec_k > 0 and decoding:
             props = self._propose(decoding)
@@ -1358,6 +1742,18 @@ class ContinuousBatchingEngine:
             if self.prefix_cache is not None:
                 self.prefix_cache.insert(req.prompt, self.slot_pages[s])
             tok = self._sample_row(logits[gstart], req)
+            if self.prefill_only:
+                # park for KV handoff: pages stay reserved, the first
+                # sampled token rides the handoff record (committed by
+                # the DECODE side, so the router never double-counts it)
+                self.cur_tok[s] = tok
+                self.handoff_ready[s] = {
+                    "rid": rid, "first_token": int(tok),
+                    "seq_len": int(self.seq_lens[s]),
+                    "temperature": float(req.temperature),
+                    "max_new_tokens": int(req.max_new_tokens),
+                }
+                continue
             self.cur_tok[s] = tok
             self.out_tokens[rid] = [tok]
             self.budget[s] = req.max_new_tokens - 1

@@ -193,6 +193,103 @@ def _install():
         T.contiguous = _contiguous
         T.is_contiguous = _is_contiguous
 
+    # ---- round-16 tranche: tensor lifecycle / place / layout surface
+    # (reference tensor_patch_methods cuda()/detach_()/gradient() and
+    # the storage-introspection properties data/T/mT/strides/offset/
+    # grad_fn; the carrier-kind queries is_dense/is_dist/is_sparse*
+    # answer for the DENSE tensors this build serves — sparse carriers
+    # live in paddle.sparse with their own classes) ----
+    def _cuda(self, device_id=None, blocking=True):
+        """Reference paddle.Tensor.cuda(): raises on builds without a
+        CUDA backend — this build is TPU/CPU-native, so like a
+        CPU-only reference build the place move is refused (use the
+        jax device APIs for TPU placement)."""
+        import jax
+
+        try:
+            jax.devices("gpu")
+        except RuntimeError:
+            raise RuntimeError(
+                "paddle_tpu is TPU/CPU-native: no CUDA backend in "
+                "this build (the reference raises the same way when "
+                "not compiled with CUDA)")
+        return self
+
+    def _detach_(self):
+        """In-place detach (reference Tensor.detach_): cut the autograd
+        history and return self."""
+        self._grad_node = None
+        self._grad_slot = None
+        self.stop_gradient = True
+        return self
+
+    def _gradient(self):
+        """Legacy dygraph Tensor.gradient(): the accumulated grad as
+        numpy, or None before any backward."""
+        import numpy as _np
+
+        g = self.grad
+        if g is None:
+            return None
+        return _np.asarray(g._value if isinstance(g, Tensor) else g)
+
+    def _strides(self):
+        """Contiguous element strides (jax buffers are always dense
+        row-major — see contiguous())."""
+        shape = tuple(int(s) for s in jnp.shape(self._value))
+        out, acc = [], 1
+        for n in reversed(shape):
+            out.append(acc)
+            acc *= max(int(n), 1)
+        return list(reversed(out))
+
+    def _T(self):
+        """Reference Tensor.T: perm-reversed view (rank < 2 returns
+        the tensor itself, matching the reference)."""
+        nd = int(jnp.ndim(self._value))
+        if nd < 2:
+            return self
+        return self.transpose(list(range(nd - 1, -1, -1)))
+
+    def _mT(self):
+        """Reference Tensor.mT: the batched matrix transpose (swap the
+        last two dims); rank < 2 raises like the reference."""
+        nd = int(jnp.ndim(self._value))
+        if nd < 2:
+            raise ValueError("Tensor.mT needs ndim >= 2")
+        perm = list(range(nd))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return self.transpose(perm)
+
+    def _set_data(self, other):
+        self.set_value(other)
+
+    if not hasattr(T, "cuda"):
+        T.cuda = _cuda
+    if not hasattr(T, "detach_"):
+        T.detach_ = _detach_
+    if not hasattr(T, "gradient"):
+        T.gradient = _gradient
+    if not hasattr(T, "is_dense"):
+        T.is_dense = lambda self: True
+        T.is_dist = lambda self: False
+        T.is_sparse = lambda self: False
+        T.is_sparse_coo = lambda self: False
+        T.is_sparse_csr = lambda self: False
+        T.to_dense = lambda self: self
+    if not hasattr(T, "data"):
+        T.data = property(lambda self: self, _set_data)
+    if not hasattr(T, "T"):
+        T.T = property(_T)
+    if not hasattr(T, "mT"):
+        T.mT = property(_mT)
+    if not hasattr(T, "strides"):
+        T.strides = property(_strides)
+        T.offset = property(lambda self: 0)
+    if not hasattr(T, "grad_fn"):
+        T.grad_fn = property(
+            lambda self: getattr(self, "_grad_node", None))
+
     # ---- round-7 tranche: elementwise / reduction / indexing methods
     # (VERDICT r5 put the Tensor METHOD surface at 107/385 of the
     # reference's tensor_method_func).  These delegate to the TOP-LEVEL
@@ -278,6 +375,11 @@ def _install():
         "stanh", "polar", "complex", "binomial", "standard_gamma",
         "top_p_sampling", "lu_solve", "baddbmm", "index_reduce",
         "bitwise_invert",
+        # ---- round-16 tranche: the scatter_nd method form (the one
+        # remaining manipulation-family name whose top-level already
+        # exists); the lifecycle/place/layout surface is installed
+        # above with explicit implementations
+        "scatter_nd",
     ]
 
     def mk_top(opname):

@@ -224,20 +224,31 @@ MEMORY_LATTICE: Tuple[MemoryConfig, ...] = (
 # ---------------------------------------------------------------------------
 
 
-def _to_host(x):
+def place_on_host(x):
+    """Place ``x`` in the host (``pinned_host``) memory space — THE
+    residency primitive of the offload engine, shared since round 16
+    with the serving prefix cache's host tier (inference/serving.py
+    demotes cold full pages through this instead of evicting them).
+    Identity on toolchains/backends without memory kinds."""
     from ..core.device import host_memory_kind
 
     return _jc.device_put_memory_kind(x, host_memory_kind())
 
 
-def _to_device(x):
-    # the compute-resident memory kind; on CPU this equals the host
-    # kind, so the fetch is a traced alias — still routed through
-    # device_put_memory_kind so the transfer eqn is visible to the
-    # MEM002 audit on every backend
+def place_on_device(x):
+    """Fetch ``x`` back into the compute-resident memory kind; on CPU
+    this equals the host kind, so the fetch is a traced alias — still
+    routed through device_put_memory_kind so the transfer eqn is
+    visible to the MEM002 audit on every backend."""
     from ..core.device import default_memory_kind
 
     return _jc.device_put_memory_kind(x, default_memory_kind())
+
+
+# internal aliases (the optimizer-offload stream predates the public
+# names; one implementation either way)
+_to_host = place_on_host
+_to_device = place_on_device
 
 
 def stream_bucket_plan(n_elems: int, itemsize: int, cap: int

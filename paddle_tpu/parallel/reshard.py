@@ -482,6 +482,39 @@ def execute_encoded(plan: ReshardPlan, tree, codec):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def plan_wire_bytes(plan: ReshardPlan, codec=None) -> Dict[str, Any]:
+    """Structural bytes-on-the-wire accounting for a plan's moved leaves
+    — the COMM004-style number for host→device deliveries (weight
+    delivery, the round-16 KV handoff): per chunk, the payload that
+    actually transits.  A codec'd host-route float leaf moves its
+    block-scaled packed width (payload + bf16 scale sidecar per
+    ``encode_rows_host``); every other moved leaf moves its raw bytes —
+    which is exactly why an int8 KV page tree beats a bf16/fp32 one on
+    the wire with NO codec loss (integer leaves ride the bit-exact
+    path).  Pure leaf-plan arithmetic: no tree values needed."""
+    from .codec import packed_width
+
+    rp = codec.resolve("weight") if codec is not None else None
+    raw = wire = 0
+    for lp in plan.leaf_plans:
+        if not lp.moved:
+            continue
+        raw += lp.nbytes
+        if rp is None or not _leaf_codec_applies(lp):
+            wire += lp.nbytes
+            continue
+        itemsize = np.dtype(lp.dtype).itemsize
+        if lp.chunk_axis is None:
+            n = lp.nbytes // itemsize
+            wire += packed_width(n, codec.block)
+        else:
+            per_row = (lp.nbytes // itemsize) // lp.shape[lp.chunk_axis]
+            wire += sum(packed_width((b - a) * per_row, codec.block)
+                        for a, b in lp.chunks)
+    return {"raw_bytes": int(raw), "wire_bytes": int(wire),
+            "ratio": (raw / wire) if wire else 1.0}
+
+
 def reshard(tree, dst_mesh: Mesh, dst_specs=None, *,
             max_transient_bytes: Optional[int] = DEFAULT_TRANSIENT_BYTES,
             slice_map: Optional[Dict[str, Sequence[int]]] = None):

@@ -324,6 +324,67 @@ def build_serving_fleet(cfg, params_host, *, target=2, scripts=None,
     return router, rs
 
 
+def build_disagg_fleet(cfg, params_host, *, prefill=1, decode=1,
+                       unified=0, scripts=None, step_timeout_s=0.0,
+                       engine_kwargs=None, router_cfg=None, clock=None,
+                       cache_dtype=None, host_tier_pages=0,
+                       autoscale=None, handoff_codec=None,
+                       handoff_budget=None, handoff_wire_budget=None,
+                       max_transient_bytes=64 << 20, sleep=_time.sleep):
+    """A DisaggRouter over FakeReplicas (round-16): ``prefill``
+    prompt-only replicas, ``decode`` full replicas fed by KV handoff,
+    optional ``unified`` fallback replicas.  Spawn order follows the
+    pool map (prefill ids first, then decode, then unified;
+    replacements continue the sequence within their pool), so
+    ``scripts`` keys by the same ids as build_serving_fleet."""
+    from paddle_tpu.inference.disagg import (AutoscaleConfig,
+                                             DisaggRouter,
+                                             KVHandoffPlanner)
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    ekw = dict(max_slots=2, num_pages=33, page_size=16, max_seq_len=128,
+               prefill_token_budget=16, enable_prefix_cache=True)
+    ekw.update(engine_kwargs or {})
+    if cache_dtype is not None:
+        ekw["cache_dtype"] = cache_dtype
+    scripts = scripts or {}
+
+    def decode_factory(params):
+        return ContinuousBatchingEngine(cfg, params, **ekw)
+
+    def prefill_factory(params):
+        return ContinuousBatchingEngine(
+            cfg, params, prefill_only=True,
+            host_tier_pages=host_tier_pages, **ekw)
+
+    def replica_factory(rid, engine_factory, step_timeout_s=0.0):
+        return FakeReplica(rid, engine_factory,
+                           step_timeout_s=step_timeout_s,
+                           script=scripts.get(rid, ()), sleep=sleep)
+
+    pool_targets = {"prefill": prefill, "decode": decode}
+    if unified:
+        pool_targets["unified"] = unified
+    rs = ReplicaSet(
+        params_host, decode_factory,
+        FleetConfig(pool_targets=pool_targets,
+                    step_timeout_s=step_timeout_s,
+                    max_transient_bytes=max_transient_bytes),
+        engine_factories={"prefill": prefill_factory,
+                          "decode": decode_factory,
+                          "unified": decode_factory},
+        replica_factory=replica_factory)
+    planner = KVHandoffPlanner(codec=handoff_codec,
+                               budget_bytes=handoff_budget,
+                               wire_budget_bytes=handoff_wire_budget)
+    kw = {} if clock is None else {"clock": clock}
+    router = DisaggRouter(
+        rs, router_cfg or RouterConfig(admission_token_cap=64),
+        planner=planner,
+        autoscale=autoscale or AutoscaleConfig(enabled=False), **kw)
+    return router, rs
+
+
 def run_fleet_trace(router, requests, bursts=(), *, seed=0,
                     max_iters=2000, vocab=64):
     """Deterministic trace driver shared by tests and the bench leg:

@@ -63,6 +63,12 @@ def test_bench_smoke_green():
                 # reduce-scatter's DCN bytes shrink >= 3x with the
                 # int8 codec (per-bucket structural table + the traced
                 # per-stage wire tables)
-                "comm_bytes_trace"):
+                "comm_bytes_trace",
+                # round-16: disaggregated prefill/decode serving — the
+                # prompt-burst trace through the two-pool fleet stays
+                # bit-identical to one-shot generate() with handoffs
+                # flowing through the MEM001-budgeted cached plan, and
+                # the int8 KV wire measurably beats the raw form
+                "serving_disagg"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]

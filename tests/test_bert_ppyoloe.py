@@ -92,7 +92,10 @@ class TestPPYOLOE:
         net.eval()
         return cfg, net
 
+    @pytest.mark.slow
     def test_anchor_geometry(self):
+        # tier-2 (round-16 re-tier): deterministic geometry breadth; tier-1
+        # home: test_loss_finite_and_jits keeps the model live
         cfg, net = self._setup()
         x = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
         cls_l, reg_l, pts, strides = net(x)
@@ -190,7 +193,10 @@ class TestReviewRegressions:
 
 
 class TestBertTrainStepRegressions:
+    @pytest.mark.slow
     def test_dropout_varies_per_step(self):
+        # tier-2 (round-16 re-tier): dropout-regression breadth; tier-1
+        # home: test_step_honors_attention_mask keeps the regression class
         """The compiled step must draw FRESH dropout masks per step: same
         params/data at two different step_no values give different losses
         (a trace-time host key would bake one mask in)."""

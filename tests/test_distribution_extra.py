@@ -241,7 +241,10 @@ def test_continuous_bernoulli_cdf_icdf_sample():
                                atol=0.02)
 
 
+@pytest.mark.slow
 def test_lkj_cholesky_sample_and_logprob():
+    # tier-2 (round-16 re-tier): heavy sampling breadth; tier-1 home:
+    # the remaining distribution legs in this file
     from paddle_tpu.distribution import LKJCholesky
 
     for method in ("onion", "cvine"):

@@ -7,6 +7,7 @@ vs full-attention equivalence).
 """
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -61,7 +62,10 @@ def test_sampling_deterministic_per_seed_and_varied():
     assert not np.array_equal(a, c)
 
 
+@pytest.mark.slow
 def test_top_k_one_is_greedy():
+    # tier-2 (round-16 re-tier): sampling-knob breadth; tier-1 home:
+    # greedy recompute parity + the temperature spec drain leg
     model, cfg = _model()
     ids = np.random.RandomState(3).randint(0, cfg.vocab_size,
                                            (1, 4)).astype(np.int32)
@@ -120,7 +124,10 @@ def test_beam1_equals_greedy():
     np.testing.assert_array_equal(greedy, beam1)
 
 
+@pytest.mark.slow
 def test_beam_search_beats_or_ties_greedy_logp():
+    # tier-2 (round-16 re-tier): beam-vs-greedy comparative breadth;
+    # tier-1 home: the beam-width-1==greedy check + greedy recompute parity
     model, cfg = _model()
     ids = np.random.RandomState(4).randint(0, cfg.vocab_size,
                                            (1, 4)).astype(np.int32)

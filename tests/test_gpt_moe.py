@@ -42,7 +42,10 @@ def test_moe_blocks_alternate():
     assert flags == [False, True]
 
 
+@pytest.mark.slow
 def test_hybrid_train_step_on_mesh():
+    # tier-2 (round-16 re-tier): MoE x hybrid mesh breadth; tier-1 home:
+    # test_moe_pipeline_ep_mp_composition + the llama_hybrid 1F1B leg
     cfg = GPTMoEConfig.debug()
     model = GPTMoEForCausalLM(cfg)
     devices = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
@@ -73,6 +76,7 @@ def test_hybrid_train_step_on_mesh():
     assert params["blocks.1.mlp.w_up"].sharding.spec[0] == "ep"
 
 
+@pytest.mark.slow
 def test_single_device_vs_mesh_parity():
     cfg = GPTMoEConfig.debug()
     model = GPTMoEForCausalLM(cfg)
@@ -101,7 +105,7 @@ def test_single_device_vs_mesh_parity():
 
 
 def test_fused_moe_matches_manual_topk():
-    """incubate.nn.functional.fused_moe (dense no-drop evaluation) vs a
+    """Tier-2 (round-16 re-tier: mesh-parity breadth; tier-1 home: test_hybrid_train_step_on_mesh + the dropless grad leg).  incubate.nn.functional.fused_moe (dense no-drop evaluation) vs a
     per-token manual loop golden (reference fused_moe.py semantics)."""
     import scipy.special as S
 

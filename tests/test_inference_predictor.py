@@ -121,7 +121,10 @@ def test_clone_threaded_serving(saved_bert):
         np.testing.assert_allclose(results[i], ref, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_layer_backed_int8_convert():
+    # tier-2 (round-16 re-tier): int8 convert-on-load breadth; tier-1
+    # home: the quantization suite + the int8_weight_serving smoke leg
     """Precision convert must work for live-Layer predictors too (review
     finding): int8 weight-only via the registered weight_quantize math."""
     cfg = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=1,

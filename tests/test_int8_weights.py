@@ -77,7 +77,10 @@ def test_int8_generate_mostly_matches_fp(tiny):
     assert (fp == q8).mean() > 0.6, (fp, q8)
 
 
+@pytest.mark.slow
 def test_int8_weights_through_serving_engine(tiny):
+    # tier-2 (round-16 re-tier): duplicate of the int8_weight_serving
+    # smoke leg (same property, same engine path)
     """int8 weights AND int8 KV cache composed in the serving engine —
     the exact configuration of the bench 8B leg, at toy scale, with
     greedy parity against int8-weight generate()."""

@@ -48,7 +48,11 @@ def test_llama_sharding_plan_applied():
     assert specs["model.norm.weight"] in ((), (None,))
 
 
+@pytest.mark.slow
 def test_llama_train_step_compiled_sharded():
+    # tier-2 (round-16 re-tier): GSPMD sharded-step twin; tier-1 home:
+    # the smoke overlap_parity leg + the memory-lattice mesh point +
+    # the doctor flagship sharding sweeps
     cfg = LlamaConfig.debug()
     model = LlamaForCausalLM(cfg)
     mesh = _mesh()
@@ -120,6 +124,7 @@ def test_position_ids_honored():
                            np.asarray(prefix._value), atol=1e-3)
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     import jax.numpy as jnp
     cfg = LlamaConfig.debug(layers=2)
@@ -166,7 +171,7 @@ def test_llama_eager_vs_compiled_loss_parity():
 
 
 def test_grad_accum_matches_full_batch():
-    """accum=2 over [2, b, s] must match one step over the concatenated
+    """Tier-2 (round-16 re-tier: remat parity twin; tier-1 home: the memory engine's named-policy lattice point on the same decoder).  accum=2 over [2, b, s] must match one step over the concatenated
     [2b, s] batch: per-micro mean losses average to the global mean and
     accumulated grads are averaged, so params after AdamW agree."""
     cfg = LlamaConfig.debug(layers=1, hidden=32, heads=2, kv_heads=1, inter=64)

@@ -147,7 +147,10 @@ def test_multi_output(tmp_path):
     _roundtrip(Net(), [x], tmp_path)
 
 
+@pytest.mark.slow
 def test_resnet18_roundtrip(tmp_path):
+    # tier-2 (round-16 re-tier): model-zoo-scale roundtrip breadth;
+    # tier-1 home: the op/layer roundtrip legs in this file
     from paddle_tpu.vision.models import resnet18
 
     net = resnet18(num_classes=10)

@@ -863,8 +863,9 @@ class TestYoloBox:
 
 
 class TestYoloLoss:
+    @pytest.mark.slow
     def test_constructed_case_parity(self):
-        """Reference-trace parity on a 1-gt case: hand-compute the three
+        """Tier-2 (round-16 re-tier: constructed-case breadth; tier-1 home: the yolo_loss:0 yaml golden + the ppyoloe loss leg).  Reference-trace parity on a 1-gt case: hand-compute the three
         loss terms (location + class at the matched cell, objectness
         everywhere) per cpu/yolo_loss_kernel.cc."""
         rng = np.random.default_rng(7)

@@ -100,16 +100,26 @@ def _assert_parity(got_loss, got_params, ref_loss, ref_params):
 # ---------------------------------------------------------------------------
 
 
+# round-16 tier policy: tier-1 keeps the all-levers-on point (it
+# exercises prefetch + bucketing + collective matmul + hierarchy at
+# once); the single-lever ablations re-assert under ``-m slow``
 @pytest.mark.parametrize("lever,oc", [
     ("full", OverlapConfig(collective_matmul_min_out_elems=1)),
-    ("no_prefetch", OverlapConfig(prefetch=False,
-                                  collective_matmul_min_out_elems=1)),
-    ("unbucketed", OverlapConfig(bucket_bytes=0,
-                                 collective_matmul_min_out_elems=1)),
-    ("no_collective_matmul", OverlapConfig(collective_matmul=False)),
-    ("flat_collectives", OverlapConfig(prefetch=False,
-                                       collective_matmul=False,
-                                       hierarchical="off")),
+    pytest.param("no_prefetch",
+                 OverlapConfig(prefetch=False,
+                               collective_matmul_min_out_elems=1),
+                 marks=pytest.mark.slow),
+    pytest.param("unbucketed",
+                 OverlapConfig(bucket_bytes=0,
+                               collective_matmul_min_out_elems=1),
+                 marks=pytest.mark.slow),
+    pytest.param("no_collective_matmul",
+                 OverlapConfig(collective_matmul=False),
+                 marks=pytest.mark.slow),
+    pytest.param("flat_collectives",
+                 OverlapConfig(prefetch=False, collective_matmul=False,
+                               hierarchical="off"),
+                 marks=pytest.mark.slow),
 ])
 def test_overlap_lever_parity(flat_ref, lever, oc):
     _need(8)
@@ -117,8 +127,9 @@ def test_overlap_lever_parity(flat_ref, lever, oc):
     _assert_parity(loss, params, flat_ref[5], flat_ref[6])
 
 
+@pytest.mark.slow
 def test_overlap_hierarchical_parity(flat_ref):
-    """Two-stage ICI/DCN collectives on a fake 2-slice sharding axis
+    """Tier-2 (round-16 re-tier: hier-schedule twin; tier-1 home: test_codec fake-2-slice coded/uncoded parity on the same schedule).  Two-stage ICI/DCN collectives on a fake 2-slice sharding axis
     (sharding=4 split 2x2 via slice_map) — exact parity with the flat
     baseline."""
     _need(8)
@@ -138,7 +149,11 @@ def test_overlap_remat_parity(flat_ref):
     _assert_parity(loss, params, flat_ref[5], flat_ref[6])
 
 
+@pytest.mark.slow
 def test_overlap_masked_parity(flat_ref):
+    # tier-2 (round-16 re-tier): masked x overlap composition breadth;
+    # tier-1 home: flat masked accum (test_llama) + the full-lever
+    # overlap parity leg
     """Segment-id attention masks ride into the manual region's flash
     kernel; parity vs the flat masked step."""
     _need(8)
@@ -160,8 +175,9 @@ def test_overlap_masked_parity(flat_ref):
                    {k: np.asarray(v) for k, v in rp.items()})
 
 
+@pytest.mark.slow
 def test_overlap_accum_parity(flat_ref):
-    """The overlap engine under gradient accumulation (the scan of
+    """Tier-2 (round-16 re-tier: accum x overlap breadth; tier-1 home: the memory-engine accum parity + the full-lever leg).  The overlap engine under gradient accumulation (the scan of
     micro fwd+bwd re-gathers per micro-step, ZeRO-3 semantics)."""
     _need(8)
     cfg, model, state0, ids, labels, _, _ = flat_ref

@@ -48,7 +48,10 @@ def test_flash_small_seq():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_gqa_native():
+    # tier-2 (round-16 re-tier): GQA fwd twin; tier-1 home:
+    # test_flash_unpadded_gqa_and_grads (GQA incl. grads)
     """Native GQA routing: kv heads != q heads, no upstream repeat."""
     q, k, v = _rand_qkv(b=2, s=128, h=8, d=32, kv_heads=2)
     out = flash_attention_raw(q, k, v, causal=True, interpret=True)
@@ -141,7 +144,10 @@ def test_flash_segment_padding_mask(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_segment_grads_match_reference():
+    # tier-2 (round-16 re-tier): segment-grad breadth; tier-1 home: the
+    # segment padding-mask fwd legs + unpadded GQA grads
     import jax.numpy as jnp
 
     from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw

@@ -43,8 +43,12 @@ def _engine(cfg, params, **kw):
     return ContinuousBatchingEngine(cfg, params, **kw)
 
 
+
+@pytest.mark.slow
 def test_serving_matches_oneshot_generate(tiny_model):
-    """Every request's greedy tokens == the plain generate() output for
+    """Tier-2 (round-16 re-tier: legacy chunked-path parity; tier-1 home: the serving_pipeline_parity smoke leg + test_unified_matches_oneshot_generate).
+
+    Every request's greedy tokens == the plain generate() output for
     that prompt alone — continuous batching must not change results."""
     cfg, model, params = tiny_model
     rng = np.random.default_rng(0)
@@ -91,8 +95,12 @@ def test_serving_admission_waits_for_pages(tiny_model):
     assert not eng.active.any()
 
 
+
+@pytest.mark.slow
 def test_serving_page_reuse_and_growth(tiny_model):
-    """Sequences spanning multiple pages get them up front; released page
+    """Tier-2 (round-16 re-tier: legacy-path page growth; tier-1 home: the smoke leg drives the same allocator/scheduler path).
+
+    Sequences spanning multiple pages get them up front; released page
     ids are reused by later requests (LIFO)."""
     cfg, model, params = tiny_model
     rng = np.random.default_rng(2)
@@ -114,7 +122,10 @@ def test_serving_page_reuse_and_growth(tiny_model):
     eng.run()
 
 
+@pytest.mark.slow
 def test_serving_mixed_arrivals_report(tiny_model):
+    # tier-2 (round-16 re-tier): legacy-path report breadth; tier-1
+    # home: the unified report semantics + the smoke pipeline leg
     """Requests arriving mid-decode join the running batch; the step
     report carries the reference's seq_lens_encoder/decoder/this_time
     semantics."""
@@ -158,8 +169,12 @@ def test_serving_rejects_oversized_prompt(tiny_model):
         eng.add_request(np.zeros(30, np.int32), max_new_tokens=8)
 
 
+
+@pytest.mark.slow
 def test_serving_int8_cache_close_to_bf16(tiny_model):
-    """cache_dtype=int8: frozen auto-calibrated per-(layer, head) scales;
+    """Tier-2 (round-16 re-tier: legacy int8-KV tolerance leg; tier-1 home: the EXACT int8 gates (disagg int8 bit-parity + warmup-no-calibrate)).
+
+    cache_dtype=int8: frozen auto-calibrated per-(layer, head) scales;
     the greedy token streams should match the fp32-cache engine for most
     steps (quantization may flip rare near-ties, but the run must
     complete and mostly agree) — the serving-side composition of the
@@ -189,8 +204,12 @@ def test_serving_int8_cache_close_to_bf16(tiny_model):
     assert total_matching_tokens > 0.7, (outs, total_matching_tokens)
 
 
+
+@pytest.mark.slow
 def test_serving_slot_reuse_under_lookahead(tiny_model):
-    """Round-6 pipelined scheduler: with ONE slot, requests run strictly
+    """Tier-2 (round-16 re-tier: legacy pipelined-lookahead breadth; tier-1 home: the smoke leg's pipelined run + allocator leak checks).
+
+    Round-6 pipelined scheduler: with ONE slot, requests run strictly
     one after another through slot 0 — the stale lookahead chunk of a
     finished request must never leak tokens into (or corrupt the pages
     of) the request that reuses its slot.  Greedy parity with one-shot
@@ -389,8 +408,12 @@ def test_prefix_cache_cow_isolation(tiny_model):
     warm.shutdown()
 
 
+
+@pytest.mark.slow
 def test_prefix_cache_eviction_under_pressure(tiny_model):
-    """With the pool mostly held by refcount-0 trie pages, a new
+    """Tier-2 (round-16 re-tier: classic-evict breadth; tier-1 home: disagg host-tier pressure legs + the COW/teardown balance checks).
+
+    With the pool mostly held by refcount-0 trie pages, a new
     request that needs them is still admitted: LRU eviction frees the
     cold chain bottom-up, and the teardown balance still holds."""
     cfg, model, params = tiny_model
@@ -452,7 +475,10 @@ def test_chunked_prefill_decode_latency_bound(tiny_model):
     eng.shutdown()
 
 
+@pytest.mark.slow
 def test_chunked_prefill_splits_across_requests(tiny_model):
+    # tier-2 (round-16 re-tier): chunk-splitting breadth; tier-1 home:
+    # the serving_trace smoke leg drives chunked prefill over a trace
     """One step's prefill chunk packs tokens from MORE than one admitted
     request when the budget allows (ragged multi-request chunk)."""
     cfg, model, params = tiny_model
@@ -471,8 +497,12 @@ def test_chunked_prefill_splits_across_requests(tiny_model):
     eng.shutdown()
 
 
+
+@pytest.mark.slow
 def test_speculative_greedy_exact_match(tiny_model):
-    """Speculative decoding with a greedy target emits EXACTLY the
+    """Tier-2 (round-16 re-tier: exact-acceptance breadth; tier-1 home: the serving_trace smoke leg (oracle self-draft mean accepted length > 1 REQUIRES exact greedy prefix acceptance) + the temperature drain leg).
+
+    Speculative decoding with a greedy target emits EXACTLY the
     non-speculative greedy stream across accept/reject boundaries —
     with a layer-truncated self-draft (imperfect proposer: both
     accepts and rejects occur) and with an oracle draft (all-accept)."""
@@ -544,8 +574,12 @@ def test_unified_guard_rails(tiny_model):
         self_draft_params(cfg, params, cfg.num_hidden_layers + 1)
 
 
+
+@pytest.mark.slow
 def test_unified_int8_weights(tiny_model):
-    """Weight-only int8 params ride the unified plane (dequant at the
+    """Tier-2 (round-16 re-tier: int8-weights breadth; tier-1 home: tests/test_int8_weights.py + the int8_weight_serving smoke leg).
+
+    Weight-only int8 params ride the unified plane (dequant at the
     consumer dots, same scheduler): the run drains and mostly agrees
     with the fp engine (int8 may flip rare near-ties)."""
     from paddle_tpu.models.generation import quantize_params_int8
@@ -586,8 +620,12 @@ def test_unified_teardown_catches_leaks(tiny_model):
 # =====================================================================
 
 
+
+@pytest.mark.slow
 def test_unified_int8_kv_cache_close_to_bf16(tiny_model):
-    """int8 KV cache on the UNIFIED plane (the PR-6 follow-up): the
+    """Tier-2 (round-16 re-tier: unified int8-KV tolerance leg; tier-1 home: the EXACT int8 parity gates in tests/test_serving_disagg.py).
+
+    int8 KV cache on the UNIFIED plane (the PR-6 follow-up): the
     first admission runs the calibration pass the legacy chunked path
     already had (absmax per (layer, kv head), 2x headroom, frozen), the
     ragged step quantizes every scattered K/V row with those scales,

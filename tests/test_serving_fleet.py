@@ -164,7 +164,10 @@ def test_kill_migrates_and_stays_bit_identical(tiny_model):
     assert rs.telemetry["spawns"] == 3          # 2 initial + replacement
 
 
+@pytest.mark.slow
 def test_hang_flagged_by_watchdog_and_migrated(tiny_model):
+    # tier-2 (round-16 re-tier): hang recovery is re-asserted by the
+    # tier-1 fault trace (kill + hang in one run, same assertions)
     """A stall past step_timeout_s inside the watch window: the
     watchdog scanner flags the step, the replica raises ReplicaHung,
     the suspect step's output is discarded and the requests replay
@@ -477,8 +480,9 @@ def test_raw_engine_error_is_replica_death_not_fleet_death(tiny_model):
     assert len(rs.serving()) == 2
 
 
+@pytest.mark.slow
 def test_ladder_clamps_to_engine_static_prefill_budget(tiny_model):
-    """Stage-2 shed on an engine whose constructor prefill budget is
+    """Tier-2 (round-16 re-tier: knob-clamp edge (fresh engine shape = fresh compiles); tier-1 home: the throttle range-check unit contract + the fault-trace ladder gate).  Stage-2 shed on an engine whose constructor prefill budget is
     BELOW the router's min_prefill_budget floor clamps to the engine's
     own static shape instead of raising out of the router tick."""
     cfg, model, params = tiny_model

@@ -198,7 +198,10 @@ class TestSoftmaxAttention:
         np.testing.assert_allclose(np.asarray(out.numpy()), want,
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_sparse_attention_block_trains(self):
+        # tier-2 (round-16 re-tier): train-e2e breadth; tier-1 home: the
+        # sparse softmax/attention unit legs in this file
         """A sparse-attention block end-to-end: grads flow to the dense
         projections through SDDMM + sparse softmax + spmm."""
         rng = np.random.default_rng(7)

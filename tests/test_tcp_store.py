@@ -76,7 +76,10 @@ def test_delete_and_num_keys():
     s.close()
 
 
+@pytest.mark.slow
 def test_barrier_across_processes(master):
+    # tier-2 (round-16 re-tier): multi-process spawn leg, same class as
+    # the ROADMAP tier-2 (a) gang tests; in-process store legs stay tier-1
     """2 subprocess workers + this process rendezvous through the store."""
     code = (
         "import sys\n"

@@ -148,6 +148,20 @@ _ROUND14_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND14_TRANCHE
 
+# names added by the round-16 tranche (the disaggregated-serving
+# round's satellite: the tensor lifecycle/place surface of
+# tensor_patch_methods — cuda/detach_/gradient — the carrier-kind
+# queries answered for dense tensors, the storage-introspection
+# properties data/T/mT/strides/offset/grad_fn, and the scatter_nd
+# method form) — appended into _REQUIRED_METHODS AND counted against
+# the ~15 floor by test_method_count_tranche_round16
+_ROUND16_TRANCHE = [
+    "cuda", "detach_", "gradient", "is_dense", "is_dist", "is_sparse",
+    "is_sparse_coo", "is_sparse_csr", "to_dense", "scatter_nd", "data",
+    "T", "mT", "strides", "offset", "grad_fn",
+]
+_REQUIRED_METHODS += _ROUND16_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -463,6 +477,58 @@ def test_round14_method_values():
     # place/stride methods are identity on committed jax buffers
     assert t.pin_memory() is t and t.contiguous() is t
     assert t.is_contiguous() is True
+
+
+def test_method_count_tranche_round16():
+    """The round-16 tranche satisfies the ~15-new-names floor (ISSUE 12
+    satellite) over the round-14 surface."""
+    wired = [n for n in _ROUND16_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 15, (len(wired),
+                              sorted(set(_ROUND16_TRANCHE) - set(wired)))
+
+
+def test_round16_method_values():
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    # storage introspection: dense row-major buffers
+    assert m.strides == [3, 1] and m.offset == 0
+    assert np.asarray(m.T._value).shape == (3, 2)
+    np.testing.assert_allclose(np.asarray(m.mT._value),
+                               np.arange(6, dtype=np.float32)
+                               .reshape(2, 3).T)
+    b = paddle.to_tensor(np.arange(12, dtype=np.float32)
+                         .reshape(2, 2, 3))
+    assert np.asarray(b.mT._value).shape == (2, 3, 2)
+    with pytest.raises(ValueError):
+        paddle.to_tensor(np.array([1.0], np.float32)).mT
+    # carrier-kind queries on a dense tensor
+    assert m.is_dense() and not m.is_dist()
+    assert not m.is_sparse() and not m.is_sparse_coo() \
+        and not m.is_sparse_csr()
+    assert m.to_dense() is m
+    # data property reads back the tensor itself; assignment rebinds
+    assert m.data is m
+    m.data = np.zeros((2, 3), np.float32)
+    np.testing.assert_allclose(np.asarray(m._value), 0.0)
+    # cuda() refuses on this TPU/CPU-native build (reference contract
+    # for builds without the CUDA backend)
+    with pytest.raises(RuntimeError):
+        m.cuda()
+    # autograd lifecycle: gradient() None before backward, numpy after;
+    # detach_ cuts history in place; grad_fn mirrors leaf-ness
+    g = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    g.stop_gradient = False
+    assert g.gradient() is None
+    h = (g * g).sum()
+    assert h.grad_fn is not None and g.grad_fn is None
+    h.backward()
+    np.testing.assert_allclose(g.gradient(), [4.0, 6.0])
+    r = h.detach_()
+    assert r is h and h.stop_gradient and h.grad_fn is None
+    # scatter_nd method form
+    idx = paddle.to_tensor(np.array([[1], [3]], np.int64))
+    upd = paddle.to_tensor(np.array([9.0, 10.0], np.float32))
+    out = np.asarray(idx.scatter_nd(upd, [5])._value)
+    np.testing.assert_allclose(out, [0.0, 9.0, 0.0, 10.0, 0.0])
 
 
 def test_round14_index_reduce_values():
