@@ -1668,6 +1668,151 @@ def serving_disagg_trace(smoke: bool = False, seed: int = 0):
     }
 
 
+def health_trace(smoke: bool = False, seed: int = 0):
+    """bench.py --health-trace -> HEALTH_r01.json (round-17 training
+    health guardian): scripted numeric-fault traces through the armed
+    ``resilient_train_loop`` on the deterministic toy problem, plus the
+    SDC checksum legs.  Records what BASELINE.md round-17 predicts
+    against:
+
+    - detection latency in STEPS per fired rule (the in-step gates make
+      it 0 — the faulted update never applies);
+    - response-ladder stage counts (skip / lr-backoff / rollback /
+      forced replay skips) per trace;
+    - steps replayed by the rollback leg (bounded by
+      checkpoint_every) with the skip leg's bit-identical-params gate;
+    - the codec-checksum legs: a flipped coded payload raises
+      ChecksumError on the host delivery path and NaN-poisons (probe
+      catches) inside jit;
+    - the HEALTH001/002 fixtures firing exactly."""
+    import tempfile
+
+    import jax
+
+    _ensure_tests_path()
+    from fault_injection import (FaultEvent, NumericFaultEvent, flip_bit,
+                                 run_toy_health_loop, toy_init,
+                                 toy_mesh_builder, toy_step_builder,
+                                 toy_target)
+    from paddle_tpu.distributed.health import HealthConfig
+
+    t0 = time.perf_counter()
+    steps = 12 if smoke else 24
+    out = {"backend": jax.default_backend(),
+           "trace": {"steps": steps, "seed": seed}}
+
+    # leg 1 — NaN batch: in-step skip, params BIT-IDENTICAL to a clean
+    # run that never saw the quarantined batch
+    with tempfile.TemporaryDirectory() as d:
+        res = run_toy_health_loop(
+            d, num_steps=steps,
+            numeric_faults=[NumericFaultEvent(offset=5, kind="nan")])[0]
+    mesh, specs = toy_mesh_builder(jax.devices())
+    state = toy_init(mesh, specs)
+    fold = toy_step_builder(mesh, specs)
+    for t in range(steps):
+        if t != 5:
+            state = fold(state, toy_target(t))[1]
+    skip_parity = bool(
+        np.array_equal(np.asarray(res.state["w"]),
+                       np.asarray(state["w"]))
+        and np.array_equal(np.asarray(res.state["opt"]["m"]),
+                           np.asarray(state["opt"]["m"])))
+    out["skip"] = {
+        "parity_bit_identical": skip_parity,
+        "stage_counts": res.health["stage_counts"],
+        "detection_latency_steps": res.health["detection_latency_steps"],
+        "quarantined": [(r["data_offset"], r["rule"])
+                        for r in res.health["quarantined"]]}
+
+    # leg 2 — loss-spike burst straddling a checkpoint window: skip ->
+    # lr-backoff -> rollback, genuine replay bounded by the interval
+    with tempfile.TemporaryDirectory() as d:
+        res2 = run_toy_health_loop(
+            d, num_steps=max(14, steps),
+            numeric_faults=[NumericFaultEvent(offset=5, kind="spike"),
+                            NumericFaultEvent(offset=6, kind="spike"),
+                            NumericFaultEvent(offset=7, kind="spike")])[0]
+    ev = res2.recoveries[0] if res2.recoveries else None
+    sc2 = res2.health["stage_counts"]
+    out["ladder"] = {
+        "stage_counts": sc2,
+        "detection_latency_steps": res2.health["detection_latency_steps"],
+        "rollback_fault": ev.fault if ev else None,
+        "resume_step": ev.resume_step if ev else None,
+        "steps_replayed": ev.steps_replayed if ev else None,
+        "checkpoint_every": 4}
+    ladder_ok = (ev is not None and ev.fault == "NumericFault"
+                 and 0 < ev.steps_replayed <= 4
+                 and sc2["skip"] == 1 and sc2["backoff"] == 1
+                 and sc2["rollback"] == 1
+                 and res2.final_step == max(14, steps))
+
+    # leg 3 — SDC spot-check: a diverging peer crc rolls back
+    with tempfile.TemporaryDirectory() as d:
+        res3 = run_toy_health_loop(
+            d, num_steps=max(14, steps),
+            health=HealthConfig(warmup_steps=3, spot_check_every=4,
+                                spot_check_slices=2),
+            faults=[FaultEvent(step=8, kind="sdc")])[0]
+    sdc_ok = (len(res3.recoveries) == 1
+              and res3.recoveries[0].fault == "SDCError"
+              and res3.final_step == max(14, steps))
+    out["sdc"] = {"fault": (res3.recoveries[0].fault
+                            if res3.recoveries else None),
+                  "steps_replayed": (res3.recoveries[0].steps_replayed
+                                     if res3.recoveries else None)}
+
+    # leg 4 — codec checksums: host path raises, jit path poisons
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from paddle_tpu.parallel.codec import (ChecksumError, CollectiveCodec,
+                                           decode_rows, encode_rows)
+    from paddle_tpu.parallel.reshard import execute_encoded, plan_reshard
+
+    codec = CollectiveCodec(block=64, weight_profile="int8",
+                            checksum=True)
+    host = {"w": np.random.RandomState(seed).randn(64, 32).astype(
+        np.float32)}
+    m1 = Mesh(np.asarray(jax.devices()[:1], dtype=object), ("r",))
+    plan = plan_reshard(host, m1, None)
+    caught = False
+    try:
+        execute_encoded(plan, host, codec,
+                        corrupt=lambda p, path, ci: flip_bit(p, 17))
+    except ChecksumError:
+        caught = True
+    packed = np.asarray(encode_rows(
+        jnp.asarray(host["w"].reshape(2, -1)), codec, "int8"))
+    poisoned = np.asarray(decode_rows(
+        jnp.asarray(flip_bit(packed, 9)), host["w"].size // 2, codec,
+        "int8"))
+    poison_ok = bool(np.isnan(poisoned[0]).all()
+                     and np.isfinite(poisoned[1]).all())
+    out["checksum"] = {"host_flip_caught": caught,
+                       "jit_flip_poisons_nan": poison_ok,
+                       "wire_overhead_bytes_per_row": 4}
+
+    # leg 5 — the doctor's HEALTH fixtures fire exactly
+    from paddle_tpu.analysis.fixtures import SEEDED
+
+    fixtures = {}
+    for code in ("HEALTH001", "HEALTH002"):
+        try:
+            rep = SEEDED[code]()
+            fixtures[code] = sorted(set(rep.codes())) == [code]
+        except Exception as e:  # noqa: BLE001
+            fixtures[code] = False
+            out.setdefault("fixture_errors", {})[code] = repr(e)
+    out["fixtures"] = fixtures
+
+    out["ok"] = bool(skip_parity and ladder_ok and sdc_ok and caught
+                     and poison_ok and all(fixtures.values()))
+    out["elapsed_s"] = time.perf_counter() - t0
+    return out
+
+
 def comm_bytes_trace(smoke=False):
     """bench.py --comm-bytes-trace — structural (CPU-runnable) pre/post-
     codec bytes-on-the-wire report for the flagship hierarchical overlap
@@ -1803,13 +1948,31 @@ def doctor():
     return res
 
 
-def smoke():
+class _FastSkip(Exception):
+    """Round-17 tier-1 wall management: a smoke leg skipped in fast
+    mode because a DEDICATED tier-1 suite asserts the same property in
+    the same run (the annotation names it).  The CLI ``--smoke`` keeps
+    full mode."""
+
+    def __init__(self, home: str):
+        self.home = home
+
+
+def smoke(fast: bool = False):
     """CPU-safe tier-1 gate over the serving/varlen dispatch hot paths
     (round-6 satellite: dispatch-layer regressions must fail the suite,
-    not surface one round later in the BENCH json).  Tiny shapes,
-    interpret-mode kernels, <60s on a laptop CPU.  Returns a dict with
-    an overall ``ok`` plus one entry per leg; raises nothing (failures
-    are reported in the dict so the CLI can print a useful JSON)."""
+    not surface one round later in the next BENCH json).  Tiny shapes,
+    interpret-mode kernels.  Returns a dict with an overall ``ok`` plus
+    one entry per leg; raises nothing (failures are reported in the
+    dict so the CLI can print a useful JSON).
+
+    ``fast=True`` (what tests/test_bench_smoke.py runs since round 17 —
+    the tier-1 wall sat at the 870 s cliff again) skips the six
+    round-6/7 dispatch legs whose properties are each asserted by a
+    dedicated tier-1 suite in the same run (annotated per leg via
+    ``fast_skipped``); every round-8+ leg — the doctor gate and the
+    per-round trace gates — still runs.  The CLI ``--smoke`` mode runs
+    everything."""
     import jax
     import jax.numpy as jnp
 
@@ -1838,6 +2001,9 @@ def smoke():
     # 1. pipelined continuous-batching engine: greedy parity vs the
     #    one-shot generate path (the whole scheduler + paged kernel)
     try:
+        if fast:
+            raise _FastSkip("tests/test_serving.py (one-shot parity + "
+                            "scheduler suite)")
         eng = ContinuousBatchingEngine(cfg, params, max_slots=2,
                                        num_pages=17, page_size=16,
                                        max_seq_len=64,
@@ -1853,12 +2019,18 @@ def smoke():
                              else ref)[0, len(p):]
             ok = ok and (done[i].tokens == ref[:len(done[i].tokens)]).all()
         legs["serving_pipeline_parity"] = {"ok": bool(ok)}
+    except _FastSkip as s:
+        legs["serving_pipeline_parity"] = {"ok": True,
+                                           "fast_skipped": s.home}
     except Exception as e:  # noqa: BLE001
         legs["serving_pipeline_parity"] = {"ok": False, "error": repr(e)}
 
     # 2. padding-aware varlen dispatch: both branches numerically match
     #    the reference at their respective padding regimes
     try:
+        if fast:
+            raise _FastSkip("tests/test_attention_dispatch.py (both "
+                            "branches + crossover)")
         b, s, h, d = 2, 32, 4, 16
         q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
         res = {}
@@ -1876,12 +2048,18 @@ def smoke():
                 okl = okl and np.abs(got[i, :n] - want[0]).max() < 2e-4
             res[name] = bool(okl)
         legs["varlen_auto_dispatch"] = {"ok": all(res.values()), **res}
+    except _FastSkip as s:
+        legs["varlen_auto_dispatch"] = {"ok": True, "fast_skipped": s.home}
     except Exception as e:  # noqa: BLE001
         legs["varlen_auto_dispatch"] = {"ok": False, "error": repr(e)}
 
     # 3. multi-page paged decode kernel == dense decode kernel on the
     #    same logical cache (shuffled physical pages)
     try:
+        if fast:
+            raise _FastSkip("tests/test_decode_attention.py + "
+                            "tests/test_flash_decoding.py (paged == "
+                            "dense decode)")
         b, h, kvh, d, page, mp = 2, 4, 2, 32, 8, 4
         lens = np.array([9, 26], np.int32)
         kc = rng.standard_normal((b, kvh, mp * page, d)).astype(np.float32)
@@ -1902,6 +2080,9 @@ def smoke():
             jnp.asarray(tables), pages_per_step=2))
         legs["paged_multipage_kernel"] = {
             "ok": bool(np.abs(dense_o - paged_o).max() < 2e-4)}
+    except _FastSkip as s:
+        legs["paged_multipage_kernel"] = {"ok": True,
+                                          "fast_skipped": s.home}
     except Exception as e:  # noqa: BLE001
         legs["paged_multipage_kernel"] = {"ok": False, "error": repr(e)}
 
@@ -1910,6 +2091,10 @@ def smoke():
     #    full-batch step with the legacy per-param optimizer — one leg
     #    covers all three training levers end to end
     try:
+        if fast:
+            raise _FastSkip("tests/test_grad_accum_bf16_carry.py + "
+                            "tests/test_fused_adamw.py (accum/fused "
+                            "parity at tighter bounds)")
         from paddle_tpu.models import build_train_step
         from paddle_tpu.models.llama import llama_decay_mask
 
@@ -1949,12 +2134,18 @@ def smoke():
         legs["train_accum_fused_step"] = {
             "ok": bool(okl and okp and np.isfinite(float(l_acc))),
             "loss_match": bool(okl), "param_match": bool(okp)}
+    except _FastSkip as s:
+        legs["train_accum_fused_step"] = {"ok": True,
+                                          "fast_skipped": s.home}
     except Exception as e:  # noqa: BLE001
         legs["train_accum_fused_step"] = {"ok": False, "error": repr(e)}
 
     # 6. flash attention fwd+bwd in interpret mode vs the XLA reference
     #    (covers the default head-batched route: b/s/h/kvh give rep=2)
     try:
+        if fast:
+            raise _FastSkip("tests/test_pallas_flash.py (fwd+bwd "
+                            "interpret parity incl. head-batched)")
         import jax as _j
 
         b, s, h, d = 2, 32, 4, 16
@@ -1979,6 +2170,9 @@ def smoke():
                               rtol=2e-3, atol=2e-4)
                   for a, b_ in zip(gf, gr))
         legs["flash_fwdbwd_interpret"] = {"ok": bool(okg)}
+    except _FastSkip as s:
+        legs["flash_fwdbwd_interpret"] = {"ok": True,
+                                          "fast_skipped": s.home}
     except Exception as e:  # noqa: BLE001
         legs["flash_fwdbwd_interpret"] = {"ok": False, "error": repr(e)}
 
@@ -2001,6 +2195,9 @@ def smoke():
     #    against the int8-weight ONE-SHOT generate on the same params
     #    (int8 KV there vs fp cache here can flip rare near-ties only)
     try:
+        if fast:
+            raise _FastSkip("tests/test_int8_weights.py (int8-weight "
+                            "serving/generate parity)")
         from paddle_tpu.models.generation import (_generate_jit,
                                                   register_config)
 
@@ -2022,6 +2219,8 @@ def smoke():
         legs["int8_weight_serving"] = {
             "ok": bool(len(toks) == 4 and match >= 0.75),
             "match_vs_oneshot": match}
+    except _FastSkip as s:
+        legs["int8_weight_serving"] = {"ok": True, "fast_skipped": s.home}
     except Exception as e:  # noqa: BLE001
         legs["int8_weight_serving"] = {"ok": False, "error": repr(e)}
 
@@ -2124,6 +2323,22 @@ def smoke():
             "handoff_doctor_ok": tr["handoff_doctor_ok"]}
     except Exception as e:  # noqa: BLE001
         legs["serving_disagg"] = {"ok": False, "error": repr(e)}
+
+    # 20. round-17 training health guardian: the scripted numeric-fault
+    #     trace — NaN skip is bit-identical to the clean run, the spike
+    #     burst walks skip → backoff → rollback with bounded replay, a
+    #     flipped coded payload is caught at decode, and the
+    #     HEALTH001/002 fixtures fire exactly
+    try:
+        tr = health_trace(smoke=True)
+        legs["health_trace"] = {
+            "ok": bool(tr["ok"]),
+            "skip_parity": tr["skip"]["parity_bit_identical"],
+            "ladder_stage_counts": tr["ladder"]["stage_counts"],
+            "steps_replayed": tr["ladder"]["steps_replayed"],
+            "checksum_caught": tr["checksum"]["host_flip_caught"]}
+    except Exception as e:  # noqa: BLE001
+        legs["health_trace"] = {"ok": False, "error": repr(e)}
 
     # 18. round-15 quantized DCN collectives: the COMM004 fixture fires
     #     exactly, and the flagship bucketed reduce-scatter's DCN bytes
@@ -2605,6 +2820,15 @@ if __name__ == "__main__":
         res = serving_fleet_trace(smoke="--smoke-trace" in sys.argv)
         try:
             with open("SERVING_FLEET_r01.json", "w") as f:
+                json.dump(res, f, indent=1, default=str)
+        except OSError:
+            pass
+        print(json.dumps(res, default=str))
+        sys.exit(0 if res["ok"] else 1)
+    if "--health-trace" in sys.argv:
+        res = health_trace(smoke="--smoke-trace" in sys.argv)
+        try:
+            with open("HEALTH_r01.json", "w") as f:
                 json.dump(res, f, indent=1, default=str)
         except OSError:
             pass

@@ -523,6 +523,74 @@ ENTRY %main.42 (a.1: f32[128,8]) -> f32[128,8] {
 
 
 # ---------------------------------------------------------------------------
+# health_probe (round-17: the training health guardian)
+# ---------------------------------------------------------------------------
+
+
+def seeded_unfused_health_probe() -> Report:
+    """HEALTH001: a "probe" whose output carries TREE-SIZED buffers —
+    per-leaf finite masks returned alongside the scalars (the classic
+    host-style detector ported naively: materialize, then look).  The
+    fused contract is a handful of scalars + one bucket vector; the
+    budget here is the UNPROBED step's measured peak + a deliberately
+    small overhead, so the mask tree blows straight through it."""
+    from .core import AnalysisContext
+    from .passes.health_probe import compiled_peak_bytes
+
+    params = {f"w{i}": jnp.ones((128, 128), jnp.float32)
+              for i in range(8)}
+    grads = {k: v * 1e-3 for k, v in params.items()}
+
+    @jax.jit
+    def base(params, grads):
+        new = {k: v - 1e-3 * grads[k] for k, v in params.items()}
+        return sum(jnp.sum(g) for g in grads.values()), new
+
+    @jax.jit
+    def bug(params, grads):
+        new = {k: v - 1e-3 * grads[k] for k, v in params.items()}
+        loss = sum(jnp.sum(g) for g in grads.values())
+        probe = {
+            "grad_norm": jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                      for g in grads.values())),
+            # the seeded bug: the probe OUTPUT is a full tree of masks
+            "finite_mask": {k: jnp.isfinite(g) for k, g in grads.items()},
+        }
+        return loss, new, probe
+
+    baseline = compiled_peak_bytes(
+        AnalysisContext(base, (params, grads), {}))
+    return check(bug, params, grads, passes=["health_probe"],
+                 exemptions=(), target="seeded:HEALTH001",
+                 options={"health_probe":
+                          {"baseline_peak_bytes": baseline,
+                           "probe_overhead_bytes": 16 << 10}})
+
+
+def seeded_collective_health_probe() -> Report:
+    """HEALTH002: a probe that psums its grad-norm across the mesh
+    inside an entry whose declared baseline carries ZERO collectives —
+    communication the probe added (on the single-chip flagship, ANY
+    collective is the regression)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+
+    mesh = _mesh(2)
+
+    def body(g):
+        gnorm = jnp.sqrt(jax.lax.psum(jnp.sum(g * g), "x"))  # the bug
+        return g * 2.0, gnorm
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                   out_specs=(P("x"), P()), check_vma=False)
+    x = jnp.ones((4 * mesh.shape["x"], 8), jnp.float32)
+    return check(fn, x, passes=["health_probe"], exemptions=(),
+                 target="seeded:HEALTH002",
+                 options={"health_probe": {"baseline_collectives": {}}})
+
+
+# ---------------------------------------------------------------------------
 # sharding_consistency (round-14: the Sharding Doctor)
 # ---------------------------------------------------------------------------
 
@@ -645,6 +713,11 @@ SEEDED = {
     "HLO001": seeded_involuntary_remat,
     "HLO002": seeded_full_param_allgather,
     "HLO003": seeded_while_peeling,
+    # round-17: the training health guardian's probe-fusion contract —
+    # a tree-sized probe output blows the fusion budget, a psum'd probe
+    # adds collectives the baseline never had
+    "HEALTH001": seeded_unfused_health_probe,
+    "HEALTH002": seeded_collective_health_probe,
     "MEM001": seeded_peak_over_budget,
     # a second MEM001 proof on the round-11 serving entry — registry
     # keys carry a [variant] suffix; consumers expect the BARE code
@@ -667,3 +740,32 @@ SEEDED = {
     "SHARD004": seeded_shard_padding,
     "SHARD005": seeded_unsharded_update,
 }
+
+
+# Every fixture compiles a small seeded program, and one tier-1 process
+# reaches the registry from THREE consumers (the parametrized fixture
+# test, self_check inside the doctor smoke leg, and the per-round trace
+# legs).  Reports are read-only, the programs deterministic — memoize
+# per (code, backend) so the sweep is paid once per process (round-17
+# tier-1 wall management).  FixtureUnavailable is never cached: an
+# environment gaining devices mid-process should un-skip.
+_REPORT_MEMO: dict = {}
+
+
+def _memoized_fixture(code, fn):
+    def run() -> Report:
+        key = (code, jax.default_backend(), len(jax.devices()))
+        rep = _REPORT_MEMO.get(key)
+        if rep is None:
+            rep = fn()
+            _REPORT_MEMO[key] = rep
+        return rep
+
+    run.__name__ = fn.__name__
+    run.__doc__ = fn.__doc__
+    run.__wrapped__ = fn
+    return run
+
+
+SEEDED = {code: _memoized_fixture(code, fn)
+          for code, fn in SEEDED.items()}

@@ -6,6 +6,7 @@ from . import collective_budget  # noqa: F401
 from . import collective_order  # noqa: F401
 from . import donation  # noqa: F401
 from . import dtype_promotion  # noqa: F401
+from . import health_probe  # noqa: F401
 from . import hlo_checks  # noqa: F401
 from . import memory_budget  # noqa: F401
 from . import sharding_consistency  # noqa: F401

@@ -1,0 +1,123 @@
+"""HEALTH — the round-17 probe-fusion contract.
+
+The training health guardian (distributed/health.py) fuses its probe —
+global grad-norm, per-bucket nonfinite counts, loss, update/param ratio
+— INTO the train step so detection costs one tiny transfer.  That claim
+only stays true if the probe remains REDUCTIONS over buffers the step
+already holds; this pass pins it the doctor's way, against the
+UNPROBED entry's measured numbers:
+
+- HEALTH001: the probed entry's compiled peak exceeds
+  ``baseline_peak_bytes + probe_overhead_bytes`` — the probe (or its
+  no-op guard) materialized something tree-sized (the classic
+  regression: a host-style probe that concatenates every grad leaf
+  into one fp32 buffer, or casts the full tree to fp32 "for the
+  norm").  ``options={"health_probe": {"baseline_peak_bytes": N,
+  "probe_overhead_bytes": M}}``; the baseline is the SAME entry built
+  without ``health=`` (self_check measures it in-process).
+- HEALTH002: the probed entry's compiled HLO carries MORE collectives
+  of some kind than ``baseline_collectives`` declares — the probe
+  added communication (a psum'd scalar probe on the single-chip entry,
+  an all-gathered grad tree "for the global norm").  On the flagship
+  single-chip step the baseline is zero of every kind, so ANY
+  collective fires.  ``options={"health_probe":
+  {"baseline_collectives": {kind: count}}}`` (missing kinds default
+  to 0).
+
+Both checks need a declared option to run (a budget is a per-entry
+contract); with neither, the pass skips.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import AnalysisContext, AnalysisPass, SkipPass, register_pass
+from ..findings import Finding
+from .collective_budget import scan_hlo_collectives
+
+
+def compiled_peak_bytes(ctx: AnalysisContext) -> int:
+    """arguments + outputs + temporaries − donation aliasing, the same
+    peak MEM001 prices (shared so self_check can measure the unprobed
+    baseline with the identical formula)."""
+    compiled, _ = ctx.compile()
+    ma = compiled.memory_analysis()
+    return (int(ma.argument_size_in_bytes) + int(ma.output_size_in_bytes)
+            + int(ma.temp_size_in_bytes) - int(ma.alias_size_in_bytes))
+
+
+@register_pass
+class HealthProbePass(AnalysisPass):
+    name = "health_probe"
+    codes = ("HEALTH001", "HEALTH002")
+    requires = "compiled"
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        opts = ctx.options.get(self.name, {}) if ctx.options else {}
+        baseline_peak = opts.get("baseline_peak_bytes")
+        baseline_coll = opts.get("baseline_collectives")
+        if baseline_peak is None and baseline_coll is None:
+            raise SkipPass(
+                "no probe-fusion contract declared for this entry point "
+                "(options={'health_probe': {'baseline_peak_bytes': ..., "
+                "'probe_overhead_bytes': ..., "
+                "'baseline_collectives': {...}}})")
+        findings: List[Finding] = []
+        if baseline_peak is not None:
+            findings.extend(self._check_peak(
+                ctx, int(baseline_peak),
+                int(opts.get("probe_overhead_bytes", 64 << 10))))
+        if baseline_coll is not None:
+            findings.extend(self._check_collectives(ctx, baseline_coll))
+        return findings
+
+    # ---- HEALTH001: no extra full-tree materialization -------------------
+
+    def _check_peak(self, ctx, baseline: int, overhead: int):
+        try:
+            peak = compiled_peak_bytes(ctx)
+        except Exception as e:  # noqa: BLE001 — gate red, never skip
+            return [self.finding(
+                "HEALTH001",
+                f"probed target failed to XLA-compile — the fusion "
+                f"check is moot and the step cannot run: {e!r}"[:500],
+                data={"error": repr(e)[:300]})]
+        budget = baseline + overhead
+        if peak <= budget:
+            return []
+        return [self.finding(
+            "HEALTH001",
+            f"probed step's compiled peak {peak / 1e6:.2f} MB exceeds "
+            f"the unprobed baseline {baseline / 1e6:.2f} MB by more "
+            f"than the declared probe overhead {overhead / 1e6:.2f} MB "
+            f"— the health probe materialized tree-sized intermediates "
+            f"instead of fusing its reductions into buffers the step "
+            f"already holds (distributed/health.make_probe is the "
+            f"reductions-only reference)",
+            data={"peak_bytes": peak, "baseline_bytes": baseline,
+                  "overhead_bytes": overhead, "budget_bytes": budget})]
+
+    # ---- HEALTH002: zero added collectives -------------------------------
+
+    def _check_collectives(self, ctx, baseline):
+        counts = scan_hlo_collectives(ctx.compiled_text)
+        over = {}
+        for kind, c in counts.items():
+            allowed = int(baseline.get(kind, 0))
+            if c["count"] > allowed:
+                over[kind] = {"count": c["count"], "allowed": allowed,
+                              "bytes": c["bytes"]}
+        if not over:
+            return []
+        detail = ", ".join(f"{k} {v['count']}>{v['allowed']}"
+                           for k, v in sorted(over.items()))
+        return [self.finding(
+            "HEALTH002",
+            f"probed step's compiled HLO carries collectives beyond "
+            f"the unprobed baseline ({detail}) — the health probe "
+            f"added communication; on the single-chip flagship the "
+            f"probe must add ZERO collectives (scalar reductions over "
+            f"local shards only; a mesh entry's probe rides the "
+            f"reductions GSPMD already schedules for the loss)",
+            data={"over": over})]

@@ -60,6 +60,15 @@ FLAGSHIP_STREAM_BUDGET = 6 << 20
 FLAGSHIP_DCN_WIRE_BUDGET = 24 << 10
 FLAGSHIP_SLICE_MAP = (0, 0, 1, 1)
 
+# Round-17 probe-fusion contract (HEALTH001) for the health-probed
+# flagship step: the probed entry's compiled peak may exceed the
+# UNPROBED entry's measured peak by at most this allowance.  Measured
+# delta on the container toolchain: ~82 KB on the accum1 entry (probe
+# scalars + the no-op guard's select slack); 192 KB pins it with ~2x
+# headroom while a tree-sized probe regression (fp32 grad concat
+# ~560 KB, even bool masks ~200 KB at debug shapes) fails loudly.
+HEALTH_PROBE_OVERHEAD = 192 << 10
+
 # Round-11 capacity contract for the debug-shaped UNIFIED serving step
 # (radix prefix cache + chunked prefill + speculative verify in one
 # ragged launch): the self-check engine (2 slots, 9 pages, chunk 8)
@@ -171,6 +180,45 @@ def _clean_targets():
                  "memory_budget": {"hbm_bytes": FLAGSHIP_HBM_BUDGET}},
         declared_dtype=jnp.bfloat16,
         target="build_train_step[bf16,accum4]")
+
+    # 2c. round-17: the health-probed flagship step — the probe-fusion
+    # contract pinned against the UNPROBED accum1 entry's peak measured
+    # in-process (HEALTH001), zero added collectives on the single-chip
+    # probe (HEALTH002: every baseline kind is 0), plus donation + the
+    # dtype audit over the probed program.  The probed entry runs with
+    # the production all-open gates array so the audited program IS the
+    # one the guardian drives.  Memoized per backend like the sharding
+    # section: the target compiles the flagship TWICE (baseline +
+    # probed) and is reached from self_check, the doctor smoke leg and
+    # the analysis test suite in one tier-1 process.
+    key = (jax.default_backend(), len(jax.devices()))
+    rep = _HEALTH_MEMO.get(key)
+    if rep is None:
+        from .core import AnalysisContext
+        from .passes.health_probe import compiled_peak_bytes
+        from paddle_tpu.distributed.health import (HealthConfig,
+                                                   default_gates)
+
+        base_peak = compiled_peak_bytes(AnalysisContext(
+            step1, (deep(params), opt.init_state(deep(params)), 0, 1e-4,
+                    ids, labels), {}))
+        hstep = build_train_step(model, opt, compute_dtype=jnp.bfloat16,
+                                 health=HealthConfig())
+        rep = check(
+            hstep, deep(params), opt.init_state(deep(params)), 0, 1e-4,
+            ids, labels,
+            kwargs={"health_gates": jnp.asarray(default_gates())},
+            passes=["health_probe", "dtype_promotion", "donation"],
+            options={**donation,
+                     "health_probe": {
+                         "baseline_peak_bytes": base_peak,
+                         "probe_overhead_bytes": HEALTH_PROBE_OVERHEAD,
+                         "baseline_collectives": {}}},
+            declared_dtype=jnp.bfloat16,
+            target="health_probed_step[bf16]")
+        if rep.ok:          # never memoize a one-off compile hiccup red
+            _HEALTH_MEMO[key] = rep
+    yield "health_probed_step[bf16]", rep
 
     # 2a. the HBM memory engine's train step (round-10): named-policy
     # remat + host-offloaded bucket-streamed AdamW, audited under BOTH
@@ -353,6 +401,7 @@ SHARDING_REPLICATED_MIN_BYTES = 4 << 10
 SHARDING_DATA_AXES = ("dp", "pp", "sep")
 
 _SHARDING_MEMO: Dict = {}
+_HEALTH_MEMO: Dict = {}
 
 
 def _sharding_section() -> Dict[str, dict]:
@@ -632,11 +681,22 @@ def _exemption_liveness() -> Dict[str, dict]:
     return out
 
 
-def self_check(clean: bool = True) -> dict:
-    """Run the full self-check; returns a JSON-able dict with ``ok``."""
+_SEEDED_MEMO: Dict = {}
+
+
+def _seeded_section() -> Dict[str, dict]:
+    """The seeded-fixture sweep, memoized per backend: every fixture
+    compiles a small program, the sweep is reached from self_check AND
+    the parametrized test suite runs the same fixtures in the same
+    tier-1 process — one payment is enough (a fixture regression still
+    fails: the parametrized sweep calls the fixtures directly)."""
     from .fixtures import SEEDED, FixtureUnavailable
 
+    key = (jax.default_backend(), len(jax.devices()))
+    if key in _SEEDED_MEMO:
+        return _SEEDED_MEMO[key]
     seeded = {}
+    ok_all = True
     for code, fx in SEEDED.items():
         try:
             rep = fx()
@@ -645,6 +705,7 @@ def self_check(clean: bool = True) -> dict:
             continue
         except Exception as e:  # noqa: BLE001 - report, don't crash the CLI
             seeded[code] = {"ok": False, "error": repr(e)}
+            ok_all = False
             continue
         codes = set(rep.codes())
         # registry keys may carry a "[variant]" suffix (two proofs of
@@ -654,24 +715,48 @@ def self_check(clean: bool = True) -> dict:
         seeded[code] = {"ok": codes == {expect},
                         "codes": sorted(codes),
                         "n": len(rep.findings)}
+        ok_all = ok_all and seeded[code]["ok"]
+    if ok_all:          # never memoize a red sweep (one-off hiccups)
+        _SEEDED_MEMO[key] = seeded
+    return seeded
 
-    result = {"seeded": seeded}
+
+_CLEAN_MEMO: Dict = {}
+
+
+def _clean_section() -> Dict[str, dict]:
+    """The clean-flagship sweep as a JSON-able dict, memoized per
+    backend (the targets compile several flagship variants and the
+    section is reached from self_check, the doctor smoke leg and
+    tests/test_analysis_passes.py in one tier-1 process)."""
+    key = (jax.default_backend(), len(jax.devices()))
+    if key in _CLEAN_MEMO:
+        return _CLEAN_MEMO[key]
+    clean_out = {}
+    try:
+        for name, rep in _clean_targets():
+            clean_out[name] = {
+                "ok": rep.ok,
+                "findings": [f.format() for f in rep.findings],
+                "suppressed": len(rep.suppressed),
+                "skipped_passes": dict(rep.skipped)}
+    except Exception as e:  # noqa: BLE001
+        clean_out["_sweep_error"] = {"ok": False, "error": repr(e)}
+        return clean_out
+    if all(v.get("ok") for v in clean_out.values()):
+        _CLEAN_MEMO[key] = clean_out
+    return clean_out
+
+
+def self_check(clean: bool = True) -> dict:
+    """Run the full self-check; returns a JSON-able dict with ``ok``."""
+    result = {"seeded": _seeded_section()}
     if clean:
         # a sweep blowing up (toolchain drift, engine construction) must
         # degrade to a structured failure, not a raw traceback — the CLI
         # contract is "JSON report + non-zero exit", and DOCTOR.json
         # still gets written for the targets that did run
-        clean_out = {}
-        try:
-            for name, rep in _clean_targets():
-                clean_out[name] = {
-                    "ok": rep.ok,
-                    "findings": [f.format() for f in rep.findings],
-                    "suppressed": len(rep.suppressed),
-                    "skipped_passes": dict(rep.skipped)}
-        except Exception as e:  # noqa: BLE001
-            clean_out["_sweep_error"] = {"ok": False, "error": repr(e)}
-        result["clean"] = clean_out
+        result["clean"] = _clean_section()
         try:
             result["exemptions"] = _exemption_liveness()
         except Exception as e:  # noqa: BLE001

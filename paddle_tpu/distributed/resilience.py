@@ -151,6 +151,15 @@ class ResilienceConfig:
     backoff_jitter: float = 0.25       # +- fraction of the delay
     max_transient_bytes: Optional[int] = 64 << 20   # reshard step cap
     seed: int = 0                      # jitter determinism
+    # round-17 training health guardian (distributed/health.py): a
+    # HealthConfig arms the numeric-fault detector + response ladder —
+    # the step_builder contract then becomes
+    #   step_fn(state, batch, health_gates=..., lr_scale=...)
+    #       -> (loss, new_state, probe)
+    # (the probe from health.make_probe; the in-step guard makes a
+    # fired step's update a bit-exact no-op).  None keeps the classic
+    # machine-fault-only loop.
+    health: Optional[Any] = None
 
 
 def backoff_delay(cfg: ResilienceConfig, attempt: int,
@@ -183,6 +192,16 @@ class ClusterView:
     def rendezvous(self, generation: int, timeout_s: float) -> None:
         """Gate a recovery generation; raise RendezvousTimeout when the
         gang fails to assemble within ``timeout_s``."""
+
+    def peer_spot_crc(self, step: int, slice_index: int,
+                      crc: int) -> Optional[int]:
+        """Round-17 SDC spot-check exchange: publish this rank's
+        rotating param-slice crc and return a peer replica's crc for
+        the same (step, slice), or None when no peer answers (single
+        replica, peer not yet at this step).  The default view has no
+        peers; the multi-process path rides the rendezvous store, and
+        the fault harness scripts divergent answers."""
+        return None
 
 
 class LocalCluster(ClusterView):
@@ -239,6 +258,7 @@ class ResilienceResult:
     recoveries: List[RecoveryEvent]
     steps_run: int             # total step executions incl. replays
     final_step: int
+    health: Optional[Dict[str, Any]] = None   # monitor.report() when armed
 
 
 def resilient_train_loop(*, mesh_builder: Callable,
@@ -274,6 +294,18 @@ def resilient_train_loop(*, mesh_builder: Callable,
     mgr = CheckpointManager(config.checkpoint_dir, keep=config.keep)
     elastic = ElasticManager(max_restart=config.max_restarts)
 
+    monitor = spot = None
+    numeric_fault = FaultError                 # rebound when armed
+    if config.health is not None:
+        from . import health as _health
+
+        numeric_fault = _health.NumericFault
+        monitor = _health.HealthMonitor(config.health)
+        if config.health.spot_check_every > 0:
+            spot = _health.ParamSpotChecker(
+                config.health.spot_check_every,
+                config.health.spot_check_slices)
+
     devices = cluster.devices()
     mesh, specs = mesh_builder(devices)
     state, start_step, _deg = _restore_or_init(mgr, mesh, specs, init_fn,
@@ -285,8 +317,26 @@ def resilient_train_loop(*, mesh_builder: Callable,
     steps_run = 0
     step = start_step
 
+    def _consume(cur: int) -> int:
+        """Advance past a consumed data offset, honoring checkpoint
+        boundaries on EVERY path: a skipped/quarantined offset advances
+        the step counter too, and a boundary save must not be lost
+        because the ladder skipped the batch that landed on it — the
+        state is simply unchanged since the last applied update."""
+        cur += 1
+        if cur % config.checkpoint_every == 0 or cur == num_steps:
+            mgr.save(state, cur)
+        return cur
+
     while step < num_steps:
         try:
+            if monitor is not None and monitor.is_quarantined(step):
+                # an offset the ladder already quarantined (pre-rollback)
+                # is force-skipped on replay — deterministic data-offset
+                # replay must not re-poison the restored state
+                monitor.note_forced_skip(step)
+                step = _consume(step)
+                continue
             stall = cluster.before_step(step) or 0.0
             batch = data_fn(step)
             with comm_watch(f"resilient_step[{step}]",
@@ -295,17 +345,40 @@ def resilient_train_loop(*, mesh_builder: Callable,
                     # a hung/slow collective stalls INSIDE the watch
                     # window — exactly where the watchdog scanner looks
                     sleep(stall)
-                loss, state = step_fn(state, batch)
+                if monitor is not None:
+                    loss, state, probe = step_fn(
+                        state, batch,
+                        health_gates=monitor.gates(step),
+                        lr_scale=monitor.lr_scale(step))
+                else:
+                    loss, state = step_fn(state, batch)
+                    probe = None
                 loss = float(loss)          # blocks: the step really ran
             if task.timed_out:
                 raise StepHang(
                     f"watchdog flagged step {step} after "
                     f"{task.elapsed():.2f}s > {task.timeout_s:.2f}s")
+            if monitor is not None:
+                # may raise HealthExhausted (the ladder's floor)
+                verdict = monitor.observe(step, probe)
+                if verdict == "rollback":
+                    raise numeric_fault(
+                        f"health ladder escalated to rollback at step "
+                        f"{step} (see monitor.events)")
+                if verdict != "ok":
+                    # skip / backoff: the in-step guard already made the
+                    # update a no-op; consume the offset and move on
+                    step = _consume(step)
+                    continue
+            if spot is not None and spot.due(step):
+                sc = spot.check(state, step)
+                # compare() raises SDCError (a NumericFault) on a
+                # divergent peer — the rollback path handles it below
+                spot.compare(sc, cluster.peer_spot_crc(
+                    step, sc.slice_index, sc.crc))
             losses[step] = loss
             steps_run += 1
-            step += 1
-            if step % config.checkpoint_every == 0 or step == num_steps:
-                mgr.save(state, step)
+            step = _consume(step)
         except FaultError as fault:
             state, step, mesh, specs, step_fn = _recover(
                 fault, step, state, mesh, specs, cluster, mgr, elastic,
@@ -313,7 +386,9 @@ def resilient_train_loop(*, mesh_builder: Callable,
                 recoveries)
     return ResilienceResult(state=state, losses=losses,
                             recoveries=recoveries, steps_run=steps_run,
-                            final_step=step)
+                            final_step=step,
+                            health=(monitor.report()
+                                    if monitor is not None else None))
 
 
 def _restore_or_init(mgr, mesh, specs, init_fn, config):

@@ -87,6 +87,9 @@ _INPLACE_BASES = [
     "not_equal", "logical_xor",
     # round-14 tranche: in-place partners of the new bases
     "baddbmm", "index_reduce", "bitwise_invert",
+    # round-17 tranche: in-place partners of the binary extremum family
+    # (maximum/minimum and their NaN-propagation duals)
+    "maximum", "minimum", "fmax", "fmin",
 ]
 
 

@@ -303,26 +303,17 @@ class DisaggRouter(FleetRouter):
 
     # -- dispatch: prefill admission by token budget -----------------------
 
-    def submit(self, prompt, max_new_tokens: int = 32, *,
-               temperature: float = 0.0, seed: int = 0,
-               timeout_s: Optional[float] = None) -> int:
-        if temperature > 0 and "unified" not in self.set.pool_targets():
-            raise ValueError(
-                "temperature sampling needs a unified pool: the KV "
-                "handoff replays the greedy stream exactly, but a "
-                "sampled stream's RNG state cannot migrate mid-request")
-        return super().submit(prompt, max_new_tokens,
-                              temperature=temperature, seed=seed,
-                              timeout_s=timeout_s)
-
     def _pick_replica(self, req) -> Optional[Replica]:
         """Prefill-pool pick: fleet-wide prefix reachability first (the
         replica whose radix trie — device OR host tier — holds the
         longest full-page prefix of this prompt), then the base
         affinity-pin/least-loaded rule, always under the per-replica
-        outstanding-token admission budget."""
-        cands = (self.set.serving("unified") if req.temperature > 0
-                 else self._prefill_pool())
+        outstanding-token admission budget.  Sampled (temperature>0)
+        requests route through the SAME pools since round-17: the
+        per-slot PRNG state rides the handoff payload
+        (serving.export_handoff meta), so the decode side resumes the
+        seeded stream mid-state instead of pinning to a unified pool."""
+        cands = self._prefill_pool()
         if not cands:
             return None
         cap = self.cfg.admission_token_cap

@@ -501,10 +501,24 @@ class FleetRouter:
 
     def __init__(self, replica_set: ReplicaSet,
                  config: Optional[RouterConfig] = None, *,
+                 autoscale=None,
                  clock: Callable[[], float] = time.monotonic):
         self.set = replica_set
         self.cfg = config or RouterConfig()
         self.clock = clock
+        # round-17 (ROADMAP fleet item (b) remainder): the classic
+        # single-pool autoscale — an AutoscaleConfig
+        # (inference/disagg.py) pointed at FleetConfig.target_replicas.
+        # Same policy as the disagg pools: scale-up on sustained
+        # admission pressure, scale-down through the drain path after
+        # sustained idleness, one cooldown window for both directions
+        # (hysteresis — pinned on the fake clock).  DisaggRouter sets
+        # its own per-pool autoscale_cfg BEFORE delegating here.
+        if autoscale is not None or not hasattr(self, "autoscale_cfg"):
+            self.autoscale_cfg = autoscale
+        self._uas_up_streak = 0
+        self._uas_idle_streak = 0
+        self._uas_cooldown_until = 0
         self.queue: Deque[RouterRequest] = deque()
         self.requests: Dict[int, RouterRequest] = {}
         self._done_order: Deque[int] = deque()   # retirement FIFO
@@ -816,6 +830,47 @@ class FleetRouter:
                 ev.recovery_ticks = self._tick - ev.died_at_tick
                 ev.wall_s = time.monotonic() - rep.spawned_at
 
+    def _autoscale(self) -> None:
+        """Classic single-pool autoscale: move
+        ``FleetConfig.target_replicas`` from the router's own pressure
+        signals (the disagg router overrides this with its per-pool
+        policy).  Scale-up after ``up_sustain_ticks`` consecutive ticks
+        of admission pressure (undispatched queue or an engaged
+        ladder); scale-down through the drain path after
+        ``down_idle_ticks`` idle ticks; ``cooldown_ticks`` of
+        hysteresis after any action in either direction."""
+        cfg = self.autoscale_cfg
+        if cfg is None or not getattr(cfg, "enabled", False) \
+                or self.set.config.pool_targets is not None:
+            return
+        pressured = bool(self.queue) or self.stage >= 1
+        idle = not self.queue and not any(
+            self._assigned.get(r.id) for r in self.set.live())
+        self._uas_up_streak = self._uas_up_streak + 1 if pressured else 0
+        self._uas_idle_streak = self._uas_idle_streak + 1 if idle else 0
+        if self._tick < self._uas_cooldown_until:
+            return
+        log = self.telemetry.setdefault("autoscale_log", [])
+        target = int(self.set.config.target_replicas)
+        if (self._uas_up_streak >= cfg.up_sustain_ticks
+                and target < cfg.max_replicas):
+            self.set.config.target_replicas = target + 1
+            self._uas_cooldown_until = self._tick + cfg.cooldown_ticks
+            self._uas_up_streak = 0
+            log.append({"tick": self._tick, "pool": "unified",
+                        "dir": "up", "target": target + 1})
+        elif (self._uas_idle_streak >= cfg.down_idle_ticks
+                and target > cfg.min_replicas):
+            self.set.config.target_replicas = target - 1
+            self._uas_cooldown_until = self._tick + cfg.cooldown_ticks
+            self._uas_idle_streak = 0
+            victim = next((r for r in self.set.serving()
+                           if not self._assigned.get(r.id)), None)
+            if victim is not None:
+                self.drain(victim.id)   # scale-down IS the drain path
+            log.append({"tick": self._tick, "pool": "unified",
+                        "dir": "down", "target": target - 1})
+
     def drain(self, replica_id: int) -> None:
         """Graceful removal: stop routing to the replica; its in-flight
         requests COMPLETE there before removal.  (The fleet respawns to
@@ -864,6 +919,7 @@ class FleetRouter:
         self._step_replicas()
         produced = self._harvest()
         self._check_deadlines()
+        self._autoscale()
         self._reap_and_respawn()
         return produced
 

@@ -1384,8 +1384,15 @@ class ContinuousBatchingEngine:
         self.prompt_lens[rid] = plen
         req = Request(int(rid), np.zeros(0, np.int32),
                       int(max_new_tokens),
-                      temperature=float(meta.get("temperature", 0.0)))
+                      temperature=float(meta.get("temperature", 0.0)),
+                      seed=int(meta.get("seed", 0)))
         req.rng = np.random.default_rng(req.seed)
+        if meta.get("rng_state") is not None:
+            # resume the prefill side's seeded stream mid-state: the
+            # handoff carries the PRNG exactly as the KV pages carry
+            # the committed prefix (seeded-sampling parity across the
+            # handoff is pinned in tests/test_serving_disagg.py)
+            req.rng.bit_generator.state = meta["rng_state"]
         self.req_info[slot] = req
         if self.budget[slot] <= 0 or first == self.eos_id:
             self._finish(slot)
@@ -1752,6 +1759,14 @@ class ContinuousBatchingEngine:
                     "seq_len": int(self.seq_lens[s]),
                     "temperature": float(req.temperature),
                     "max_new_tokens": int(req.max_new_tokens),
+                    # round-17: the per-slot PRNG migrates WITH the KV —
+                    # the first token above consumed one draw, so the
+                    # decode side resumes the seeded stream mid-state
+                    # instead of restarting it (sampled requests no
+                    # longer pin to the unified pool)
+                    "seed": int(req.seed),
+                    "rng_state": (req.rng.bit_generator.state
+                                  if req.temperature > 0 else None),
                 }
                 continue
             self.cur_tok[s] = tok

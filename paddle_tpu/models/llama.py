@@ -535,7 +535,8 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
                      data_axes: Tuple[str, ...] = ("dp", "sharding"),
                      remat: bool = False, remat_policy=None,
                      compute_dtype=jnp.bfloat16, accum_steps: int = 1,
-                     accum_dtype=None, overlap=None, memory=None):
+                     accum_dtype=None, overlap=None, memory=None,
+                     health=None):
     """Build a single donated, jitted train step:
 
         step_fn(params, opt_state, step_no, lr, input_ids, labels)
@@ -583,7 +584,18 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
       ``optimizer_residency='host'`` routes the update through the
       bucket-streamed ``apply_flat_offloaded`` when ``opt_state`` was
       built by ``parallel.memory.init_offloaded_state`` (detection is
-      structural, like the flat state).
+      structural, like the flat state),
+    - ``health`` (a ``distributed.health.HealthConfig``) fuses the
+      round-17 health probe INTO this step: the step additionally takes
+      a ``health_gates`` fp32[3] cutoff vector (loss / grad-norm /
+      update-ratio; None = all-open) and returns a 4th output — the
+      probe dict (loss, global grad-norm, per-bucket nonfinite counts,
+      update/param ratio, ok flag) — while GUARDING the update in-step:
+      a probe that trips any gate makes params and optimizer state pass
+      through untouched (bit-exact skip-and-quarantine; the host
+      monitor in distributed/health.py decides the ladder response).
+      The probe is reductions only — HEALTH001/002 prove it adds no
+      full-tree materialization and no collectives.
     """
     from ..autograd import no_grad
     from ..parallel import memory as _memory
@@ -654,6 +666,16 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
         flat_sharding = NamedSharding(
             mesh, P(flat_axes if flat_axes else None))
 
+    def _health_tail(loss, grads, params, opt_state, new_params,
+                     new_opt_state, health_gates):
+        """The fused probe + in-step no-op guard (round-17) —
+        distributed/health.py owns the contract and the implementation."""
+        from ..distributed import health as _health
+
+        return _health.probe_and_guard(loss, grads, params, opt_state,
+                                       new_params, new_opt_state,
+                                       health_gates, health)
+
     def apply_update(params, grads, opt_state, lr, step_no):
         # host-offloaded bucketed state (parallel/memory.py) routes the
         # streamed fused AdamW; flat (fused multi-tensor) state the
@@ -674,7 +696,7 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
             decay_mask=decay_mask)
 
     def step_fn(params, opt_state, step_no, lr, input_ids, labels,
-                attention_mask=None):
+                attention_mask=None, health_gates=None):
         if batch_sharding is not None:
             input_ids = jax.lax.with_sharding_constraint(input_ids, batch_sharding)
             labels = jax.lax.with_sharding_constraint(labels, batch_sharding)
@@ -684,10 +706,13 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
         loss, grads = grad_fn(params, input_ids, labels, attention_mask)
         new_params, new_opt_state = apply_update(params, grads, opt_state,
                                                  lr, step_no)
+        if health is not None:
+            return _health_tail(loss, grads, params, opt_state,
+                                new_params, new_opt_state, health_gates)
         return loss, new_params, new_opt_state
 
     def accum_step_fn(params, opt_state, step_no, lr, input_ids, labels,
-                      attention_mask=None):
+                      attention_mask=None, health_gates=None):
         """Gradient accumulation (reference: strategy gradient-merge /
         GradientMergeOptimizer): ids/labels carry a leading [accum_steps]
         micro-batch axis; one fp32 grad buffer is accumulated by a
@@ -806,6 +831,9 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
             mean_loss = wlosses.sum() / wsum
         new_params, new_opt_state = apply_update(params, grads, opt_state,
                                                  lr, step_no)
+        if health is not None:
+            return _health_tail(mean_loss, grads, params, opt_state,
+                                new_params, new_opt_state, health_gates)
         return mean_loss, new_params, new_opt_state
 
     fn = step_fn if accum_steps <= 1 else accum_step_fn
@@ -815,7 +843,7 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
     # wrapper must NOT inherit the pjit's aot methods — the doctor
     # reaches them through __wrapped__
     def step(params, opt_state, step_no, lr, input_ids, labels,
-             attention_mask=None):
+             attention_mask=None, health_gates=None):
         # scalar-signature pinning (Graph Doctor retrace sentinel, RT001):
         # callers alternate python ints/floats (weak-typed avals) with
         # arrays (strong) for step_no/lr, and every flip retraces and
@@ -825,11 +853,16 @@ def build_train_step(model: LlamaForCausalLM, optimizer, mesh: Optional[Mesh] = 
         # the inner entry via __wrapped__).
         step_no = jnp.asarray(step_no, jnp.int32)
         lr = jnp.asarray(lr, jnp.float32)
+        kw = {}
+        if health is not None:
+            from ..distributed import health as _health
+
+            kw["health_gates"] = _health.normalize_gates(health_gates)
         if attention_mask is None:
             return jit_step(params, opt_state, step_no, lr, input_ids,
-                            labels)
+                            labels, **kw)
         return jit_step(params, opt_state, step_no, lr, input_ids, labels,
-                        attention_mask)
+                        attention_mask, **kw)
 
     return step
 

@@ -230,7 +230,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
                             virtual_chunks: int = 1,
                             data_axes: Tuple[str, ...] = ("dp", "sharding"),
                             cpu_bf16: str = "promote",
-                            overlap=None):
+                            overlap=None, health=None):
     """Build the fully-composed hybrid train step:
 
         step(params, opt_state, step_no, lr, input_ids, labels)
@@ -677,7 +677,26 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             params, grads, opt_state, lr, step_no + 1,
             decay_mask={n: n not in no_decay for n in names})
 
-    def step_fn(params, opt_state, step_no, lr, input_ids, labels):
+    def _finish(loss, grads, params, opt_state, lr, step_no,
+                health_gates):
+        """Shared optimizer tail of both schedule paths — and, with
+        ``health``, the round-17 fused probe + in-step no-op guard
+        (same contract as build_train_step: a fired gate passes params
+        and optimizer state through bit-identically and the probe
+        rides out as a 4th output)."""
+        new_params, new_opt_state = _apply_optimizer(params, grads,
+                                                     opt_state, lr,
+                                                     step_no)
+        if health is None:
+            return loss, new_params, new_opt_state
+        from ..distributed import health as _health
+
+        return _health.probe_and_guard(loss, grads, params, opt_state,
+                                       new_params, new_opt_state,
+                                       health_gates, health)
+
+    def step_fn(params, opt_state, step_no, lr, input_ids, labels,
+                health_gates=None):
         outer_batch = (batch_axes if len(batch_axes) > 1
                        else (batch_axes[0] if batch_axes else None))
         if outer_batch is not None or sep_entry is not None:
@@ -685,11 +704,11 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             input_ids = lax.with_sharding_constraint(input_ids, bs)
             labels = lax.with_sharding_constraint(labels, bs)
         loss, grads = grad_fn(params, input_ids, labels)
-        new_params, new_opt_state = _apply_optimizer(params, grads,
-                                                     opt_state, lr, step_no)
-        return loss, new_params, new_opt_state
+        return _finish(loss, grads, params, opt_state, lr, step_no,
+                       health_gates)
 
-    def sched_step_fn(params, opt_state, step_no, lr, input_ids, labels):
+    def sched_step_fn(params, opt_state, step_no, lr, input_ids, labels,
+                      health_gates=None):
         """Schedule-explicit train step: grads come from the executor's
         in-schedule vjps (stages), loss-params channel (norm + head) and
         x-grad channel (embedding), not from an outer jax.grad."""
@@ -743,17 +762,23 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         grads["model.norm.weight"] = hgrads["norm"]
         grads["lm_head.weight"] = hgrads["head"]
         grads["model.embed_tokens.weight"] = d_embed.astype(jnp.float32)
-        new_params, new_opt_state = _apply_optimizer(params, grads,
-                                                     opt_state, lr, step_no)
-        return loss, new_params, new_opt_state
+        return _finish(loss, grads, params, opt_state, lr, step_no,
+                       health_gates)
 
     jstep = jax.jit(step_fn if sched is None else sched_step_fn,
                     donate_argnums=(0, 1))
 
-    def step(params, opt_state, step_no, lr, input_ids, labels):
+    def step(params, opt_state, step_no, lr, input_ids, labels,
+             health_gates=None):
         from ..common.jax_compat import set_mesh as _set_mesh
 
+        kw = {}
+        if health is not None:
+            from ..distributed import health as _health
+
+            kw["health_gates"] = _health.normalize_gates(health_gates)
         with _set_mesh(mesh):
-            return jstep(params, opt_state, step_no, lr, input_ids, labels)
+            return jstep(params, opt_state, step_no, lr, input_ids,
+                         labels, **kw)
 
     return step

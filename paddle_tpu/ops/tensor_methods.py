@@ -436,6 +436,8 @@ def _install():
         "polygamma_", "t_",
         # round-14 tranche: in-place partners of the new bases
         "baddbmm_", "index_reduce_", "bitwise_invert_",
+        # round-17 tranche: the binary extremum in-place family
+        "maximum_", "minimum_", "fmax_", "fmin_",
     ]
     def mk_in(opname):
         def method(self, *args, **kwargs):
@@ -452,6 +454,78 @@ def _install():
     for name in inplace_methods:
         if not hasattr(T, name):
             setattr(T, name, mk_in(name))
+
+    # ---- round-17 tranche: explicit implementations ----------------------
+    # stacking-family method forms: the reference patches the list-taking
+    # top-level (hstack/vstack/dstack/column_stack/row_stack/block_diag)
+    # onto Tensor; the method form prepends self to the operand list
+    # (``t.hstack(others)`` == ``paddle.hstack([t, *others])``)
+    def mk_stack(opname):
+        def method(self, others=()):
+            import paddle_tpu as _p
+
+            if isinstance(others, T):
+                others = (others,)
+            return getattr(_p, opname)([self, *others])
+
+        method.__name__ = opname
+        method.__doc__ = (f"Tensor method form of ``paddle.{opname}`` "
+                          f"(self prepended to the operand list).")
+        return method
+
+    for name in ("hstack", "vstack", "dstack", "column_stack",
+                 "row_stack", "block_diag"):
+        if not hasattr(T, name):
+            setattr(T, name, mk_stack(name))
+
+    # the nan*-reduction completions of the nansum/nanmean/nanmedian
+    # family already wired.  nanstd/nanvar default unbiased=True
+    # (ddof=1) to agree with std/var — the nan-tolerant variant of a
+    # reduction must match its base on NaN-free data
+    def _nan_reduce(jnp_name):
+        def method(self, axis=None, keepdim=False, **kw):
+            import jax.numpy as jnp
+
+            fn = getattr(jnp, jnp_name)
+            if jnp_name in ("nanargmax", "nanargmin"):
+                return T(fn(self._value, axis=axis, keepdims=keepdim))
+            ddof = 1 if kw.pop("unbiased", True) else 0
+            return T(fn(self._value, axis=axis, keepdims=keepdim,
+                        ddof=ddof))
+
+        method.__name__ = jnp_name
+        return method
+
+    for name in ("nanstd", "nanvar", "nanargmax", "nanargmin"):
+        if not hasattr(T, name):
+            setattr(T, name, _nan_reduce(name))
+
+    # dense -> sparse-carrier conversions (reference Tensor.to_sparse_coo
+    # / to_sparse_csr; the carriers live in paddle_tpu.sparse and their
+    # to_dense() round-trips — the round-16 is_sparse_* queries' duals)
+    def _to_sparse_coo(self, sparse_dim=None):
+        from jax.experimental import sparse as jsparse
+
+        from ..sparse import SparseCooTensor
+
+        ndim = self._value.ndim
+        n_dense = 0 if sparse_dim is None else ndim - int(sparse_dim)
+        return SparseCooTensor(jsparse.BCOO.fromdense(
+            self._value, n_dense=n_dense))
+
+    def _to_sparse_csr(self):
+        from jax.experimental import sparse as jsparse
+
+        from ..sparse import SparseCsrTensor
+
+        if self._value.ndim != 2:
+            raise ValueError("to_sparse_csr needs a 2-D tensor")
+        return SparseCsrTensor(jsparse.BCSR.fromdense(self._value))
+
+    if not hasattr(T, "to_sparse_coo"):
+        T.to_sparse_coo = _to_sparse_coo
+    if not hasattr(T, "to_sparse_csr"):
+        T.to_sparse_csr = _to_sparse_csr
 
 
 _install()

@@ -65,6 +65,16 @@ from jax import lax
 
 PROFILES = ("int8", "fp8", "none")
 
+
+class ChecksumError(RuntimeError):
+    """A checksummed payload failed verification at decode — silent
+    data corruption on the wire (round-17 SDC defense).  Host-mediated
+    paths (``reshard.execute_encoded`` delivery/handoff) raise this
+    LOUDLY; in-collective decodes cannot raise from inside jit, so
+    ``decode_rows`` POISONS the corrupted row to NaN instead — the
+    health probe's nonfinite counter fires the same step and the
+    guardian's ladder responds (distributed/health.py)."""
+
 # the fp8 wire dtype (e4m3: max dynamic range per byte for payloads
 # whose blocks are absmax-rescaled anyway); None on toolchains without
 # ml_dtypes fp8 support — CollectiveCodec.resolve degrades to int8
@@ -94,6 +104,11 @@ class CollectiveCodec:
     block: int = 256
     stochastic: bool = True
     seed: int = 0
+    # round-17 SDC defense: append a 4-byte position-weighted byte sum
+    # to every encoded row, verified at decode (ChecksumError on the
+    # host paths, NaN-poisoning inside collectives).  Costs 4 bytes per
+    # row on the wire — off by default so existing wire budgets hold.
+    checksum: bool = False
 
     def __post_init__(self):
         for name in ("grad_profile", "weight_profile"):
@@ -130,7 +145,8 @@ class CollectiveCodec:
     def label(self) -> str:
         g = self.grad_profile + ("/sr" if self.stochastic
                                  and self.grad_profile == "int8" else "")
-        return f"codec[g={g},w={self.weight_profile},b={self.block}]"
+        cs = ",cs" if self.checksum else ""
+        return f"codec[g={g},w={self.weight_profile},b={self.block}{cs}]"
 
 
 # ---------------------------------------------------------------------------
@@ -142,11 +158,12 @@ def num_blocks(n: int, block: int) -> int:
     return -(-int(n) // int(block))
 
 
-def packed_width(n: int, block: int) -> int:
+def packed_width(n: int, block: int, checksum: bool = False) -> int:
     """Bytes of one encoded row of ``n`` elements: 1-byte payload per
-    (padded) element + the 2-byte bf16 scale per block."""
+    (padded) element + the 2-byte bf16 scale per block (+ the 4-byte
+    row checksum when the codec carries one)."""
     nb = num_blocks(n, block)
-    return nb * block + 2 * nb
+    return nb * block + 2 * nb + (4 if checksum else 0)
 
 
 def wire_ratio(n: int, block: int, itemsize: int = 4) -> float:
@@ -198,6 +215,54 @@ def _hash_uniform(rows: int, cols: int, seed: int, value_bits=None):
     x = x ^ (x >> 16)
     # 24 mantissa-safe bits -> [0, 1)
     return (x >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+# ---------------------------------------------------------------------------
+# per-row checksums (round-17 SDC defense)
+# ---------------------------------------------------------------------------
+#
+# A position-weighted byte sum in uint32 (weight i+1 on byte i, natural
+# mod-2^32 wrap): every single-bit flip changes the sum (the weight is
+# nonzero), byte transpositions change it too (distinct weights), and it
+# is a handful of fused integer ops — cheap enough to ride every coded
+# DCN payload.  The 4 sum bytes append to the row AFTER the scale
+# sidecar, so the checksum covers payload AND scales.
+
+
+def _checksum_rows(packed):
+    """[rows, w] int8 -> [rows] uint32 position-weighted byte sums."""
+    b = (packed.astype(jnp.int32) & 0xFF).astype(jnp.uint32)
+    w = lax.broadcasted_iota(jnp.uint32, packed.shape, 1) + jnp.uint32(1)
+    return (b * w).sum(axis=-1, dtype=jnp.uint32)
+
+
+def _checksum_rows_host(packed: np.ndarray) -> np.ndarray:
+    b = packed.view(np.uint8).astype(np.uint32)
+    w = (np.arange(packed.shape[-1], dtype=np.uint64) + 1)
+    return (b.astype(np.uint64) * w).sum(axis=-1).astype(np.uint32)
+
+
+def append_checksum_host(packed: np.ndarray) -> np.ndarray:
+    cs = _checksum_rows_host(packed)
+    return np.concatenate([packed, cs[:, None].view(np.int8)], axis=-1)
+
+
+def check_rows_host(packed: np.ndarray) -> np.ndarray:
+    """[rows, w+4] int8 -> [rows] bool corruption mask (True = the
+    recomputed sum disagrees with the stored one)."""
+    body, stored = packed[:, :-4], packed[:, -4:]
+    return _checksum_rows_host(np.ascontiguousarray(body)) \
+        != np.ascontiguousarray(stored).view(np.uint32).reshape(-1)
+
+
+def verify_rows_host(packed: np.ndarray, where: str = "payload") -> None:
+    bad = check_rows_host(packed)
+    if bad.any():
+        raise ChecksumError(
+            f"coded {where}: checksum mismatch on {int(bad.sum())}/"
+            f"{len(bad)} rows at decode — the payload was corrupted in "
+            f"flight (bit flip / truncation); refusing to decode "
+            f"silently-wrong values")
 
 
 # ---------------------------------------------------------------------------
@@ -254,15 +319,34 @@ def encode_rows(x, codec: CollectiveCodec, profile: str,
         raise ValueError(f"cannot encode with profile {profile!r}")
     sbytes = lax.bitcast_convert_type(scale_b, jnp.int8).reshape(
         rows, 2 * nb)
-    return jnp.concatenate([payload, sbytes], axis=-1)
+    packed = jnp.concatenate([payload, sbytes], axis=-1)
+    if codec.checksum:
+        cs = lax.bitcast_convert_type(
+            _checksum_rows(packed)[:, None], jnp.int8).reshape(rows, 4)
+        packed = jnp.concatenate([packed, cs], axis=-1)
+    return packed
 
 
 def decode_rows(packed, n: int, codec: CollectiveCodec, profile: str,
                 out_dtype=jnp.float32):
-    """Inverse of encode_rows: [rows, packed_width] int8 -> [rows, n]."""
+    """Inverse of encode_rows: [rows, packed_width] int8 -> [rows, n].
+
+    With ``codec.checksum`` the trailing 4 bytes are verified; a
+    mismatching row decodes to NaN (jit cannot raise — the poisoned
+    values trip the health probe's nonfinite counter the same step, so
+    an in-flight bit flip is a detected fault, never silent
+    divergence).  Host-mediated callers that CAN raise should verify
+    first via ``verify_rows_host``."""
     rows = packed.shape[0]
     block = codec.block
     nb = num_blocks(n, block)
+    bad = None
+    if codec.checksum:
+        body, stored = packed[:, :-4], packed[:, -4:]
+        cs = lax.bitcast_convert_type(
+            stored.reshape(rows, 1, 4), jnp.uint32).reshape(rows)
+        bad = _checksum_rows(body) != cs
+        packed = body
     payload = packed[:, :nb * block]
     sbytes = packed[:, nb * block:].reshape(rows, nb, 2)
     scale = lax.bitcast_convert_type(sbytes, jnp.bfloat16).astype(
@@ -276,6 +360,8 @@ def decode_rows(packed, n: int, codec: CollectiveCodec, profile: str,
         raise ValueError(f"cannot decode with profile {profile!r}")
     x = (q.reshape(rows, nb, block) * scale[..., None]).reshape(
         rows, nb * block)[:, :n]
+    if bad is not None:
+        x = jnp.where(bad[:, None], jnp.float32(jnp.nan), x)
     return x.astype(out_dtype)
 
 
@@ -316,7 +402,10 @@ def encode_rows_host(x: np.ndarray, codec: CollectiveCodec,
         payload = r.astype(ml_dtypes.float8_e4m3fn).view(
             np.int8).reshape(rows, nb * block)
     sbytes = scale_b.view(np.int8).reshape(rows, 2 * nb)
-    return np.concatenate([payload, sbytes], axis=-1)
+    packed = np.concatenate([payload, sbytes], axis=-1)
+    if codec.checksum:
+        packed = append_checksum_host(packed)
+    return packed
 
 
 def decode_jit(shape: Tuple[int, ...], dtype, codec: CollectiveCodec,

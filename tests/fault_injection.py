@@ -29,6 +29,17 @@ Fault kinds (``FaultEvent.kind``):
   and the driver raises StepHang (state suspect → checkpoint reuse).
 - ``slow``    — same stall mechanics but meant to stay UNDER the step
   timeout: training must ride through with NO recovery event.
+- ``sdc``     — round-17: the peer replica's param spot-check crc
+  DIVERGES at this step (silent data corruption on a peer); the health
+  guardian must raise SDCError and take the rollback path.
+
+Round-17 adds NUMERIC faults (``NumericFaultEvent``, injected through
+the data stream rather than the cluster view — a bad batch is data,
+not machinery): ``nan``/``inf`` poison one element of the target
+batch, ``spike`` scales the whole batch by ``scale`` (a loss/grad
+spike the EMA z-gates catch).  ``run_toy_health_loop`` drives the
+health-armed ``resilient_train_loop`` over them, and ``flip_bit``
+corrupts a coded wire payload for the codec-checksum tests.
 
 Each event fires exactly once (consumed at its step boundary), so the
 post-recovery replay of the same step proceeds cleanly — matching the
@@ -71,11 +82,17 @@ class FakeCluster(ClusterView):
         self.device_count = device_count or avail
         assert self.device_count <= avail, "FakeCluster needs real devices"
         self._faults: Dict[int, List[FaultEvent]] = {}
+        self._sdc_steps: set = set()
         for ev in faults:
+            if ev.kind == "sdc":
+                # consumed by peer_spot_crc, not the step boundary
+                self._sdc_steps.add(ev.step)
+                continue
             self._faults.setdefault(ev.step, []).append(ev)
         self._rendezvous_failures = rendezvous_failures
         self.rendezvous_log: List[int] = []   # generation per attempt
         self.fired: List[FaultEvent] = []
+        self.spot_check_log: List[int] = []   # steps a crc was exchanged
 
     # -- ClusterView -------------------------------------------------------
     def devices(self):
@@ -107,6 +124,16 @@ class FakeCluster(ClusterView):
             self._rendezvous_failures -= 1
             raise RendezvousTimeout(
                 f"injected rendezvous failure (gen {generation})")
+
+    def peer_spot_crc(self, step: int, slice_index: int, crc: int):
+        """An agreeing peer (echoes the local crc) — unless a scripted
+        ``sdc`` event makes the peer's copy diverge at this step (fires
+        once: the rollback replaces the 'corrupted' state)."""
+        self.spot_check_log.append(step)
+        if step in self._sdc_steps:
+            self._sdc_steps.discard(step)
+            return (crc ^ 0x5DC5DC) & 0xFFFFFFFF
+        return crc
 
 
 # ---------------------------------------------------------------------------
@@ -187,6 +214,124 @@ def run_toy_loop(tmpdir: str, num_steps: int = 12, *,
         step_builder=toy_step_builder, data_fn=toy_target,
         num_steps=num_steps, config=cfg, cluster=cluster, **kw)
     return res, cluster
+
+
+# ===========================================================================
+# Round-17: numeric-fault injection (the training health guardian)
+# ===========================================================================
+#
+# Numeric faults enter through the DATA STREAM (a bad batch is data, not
+# machinery): ``toy_numeric_data_fn`` wraps ``toy_target`` with scripted
+# NaN/Inf poisoning and loss-spike scaling, ``toy_health_step_builder``
+# is the health-contract toy step (fused probe + in-step no-op guard +
+# the lr_scale backoff lever), and ``run_toy_health_loop`` drives the
+# armed resilient_train_loop end to end.  ``flip_bit`` is the coded-
+# payload corruption hook for the codec-checksum tests.
+
+
+@dataclass
+class NumericFaultEvent:
+    offset: int                  # data offset (== step) to poison
+    kind: str                    # nan | inf | spike
+    scale: float = 1e4           # for spike
+
+
+def toy_numeric_data_fn(faults: List[NumericFaultEvent]):
+    """``data_fn`` over ``toy_target`` with scripted numeric poison.
+    Deterministic: replaying an offset re-produces the same bad batch —
+    which is exactly why the monitor force-skips quarantined offsets on
+    post-rollback replay."""
+    evs: Dict[int, NumericFaultEvent] = {e.offset: e for e in faults}
+
+    def data_fn(step: int) -> np.ndarray:
+        t = toy_target(step)
+        ev = evs.get(step)
+        if ev is None:
+            return t
+        t = t.copy()
+        if ev.kind == "nan":
+            t[0, 0] = np.nan
+        elif ev.kind == "inf":
+            t[0, 0] = np.inf
+        elif ev.kind == "spike":
+            t *= ev.scale
+        else:
+            raise AssertionError(f"unknown numeric fault {ev.kind!r}")
+        return t
+
+    return data_fn
+
+
+def toy_health_step_builder(mesh, specs):
+    """The health-contract toy step: same SGD-with-momentum math as
+    ``toy_step_builder``, plus the fused probe and the in-step no-op
+    guard — ``step_fn(state, batch, health_gates=..., lr_scale=...) ->
+    (loss, new_state, probe)`` (the resilient loop's health contract).
+    With all-open gates and no faults it is bit-identical to the plain
+    toy step (the guard selects the new values)."""
+    from paddle_tpu.distributed import health as _health
+
+    lr_mom = 0.9
+
+    @jax.jit
+    def _step(w, m, lr, target, gates):
+        grad = 2.0 * (w - target)
+        m2 = lr_mom * m + grad
+        w2 = w - lr * m2
+        loss = jnp.sum((w2 - target) ** 2)
+        probe = _health.make_probe(loss, {"w": grad},
+                                   {"w": w, "m": m},
+                                   {"w": w2, "m": m2}, gates, buckets=4)
+        w2 = _health.guard_tree(probe["ok"], w2, w)
+        m2 = _health.guard_tree(probe["ok"], m2, m)
+        return loss, w2, m2, probe
+
+    def step_fn(state, batch, health_gates=None, lr_scale=1.0):
+        target = jax.device_put(
+            batch, NamedSharding(mesh, P(*specs["w"])))
+        gates = jnp.asarray(_health.default_gates()
+                            if health_gates is None else health_gates)
+        loss, w, m, probe = _step(
+            state["w"], state["opt"]["m"],
+            jnp.float32(state["lr"] * float(lr_scale)), target, gates)
+        return loss, {"w": w, "opt": {"m": m}, "lr": state["lr"]}, probe
+
+    return step_fn
+
+
+def run_toy_health_loop(tmpdir: str, num_steps: int = 16, *,
+                        numeric_faults: List[NumericFaultEvent] = (),
+                        faults: List[FaultEvent] = (),
+                        health=None, checkpoint_every: int = 4,
+                        max_restarts: int = 4, seed: int = 0):
+    """One health-armed resilient run over the toy problem; returns
+    (result, cluster)."""
+    from paddle_tpu.distributed.health import HealthConfig
+    from paddle_tpu.distributed.resilience import (ResilienceConfig,
+                                                   resilient_train_loop)
+
+    cluster = FakeCluster(faults=list(faults))
+    cfg = ResilienceConfig(
+        checkpoint_dir=tmpdir, checkpoint_every=checkpoint_every,
+        max_restarts=max_restarts, backoff_base_s=0.01,
+        backoff_max_s=0.05, seed=seed,
+        health=health or HealthConfig(warmup_steps=3))
+    res = resilient_train_loop(
+        mesh_builder=toy_mesh_builder, init_fn=toy_init,
+        step_builder=toy_health_step_builder,
+        data_fn=toy_numeric_data_fn(list(numeric_faults)),
+        num_steps=num_steps, config=cfg, cluster=cluster)
+    return res, cluster
+
+
+def flip_bit(packed: np.ndarray, byte_index: int = 0,
+             bit: int = 3) -> np.ndarray:
+    """Flip one bit of a coded wire payload — the SDC the per-row
+    checksum must catch at decode."""
+    out = np.array(packed)
+    flat = out.reshape(-1)
+    flat[byte_index] ^= np.int8(1 << bit)
+    return out
 
 
 # ===========================================================================
@@ -286,7 +431,7 @@ def toy_llama(seed: int = 20240806):
 
 def build_serving_fleet(cfg, params_host, *, target=2, scripts=None,
                         step_timeout_s=0.0, engine_kwargs=None,
-                        router_cfg=None, clock=None,
+                        router_cfg=None, clock=None, autoscale=None,
                         max_transient_bytes=64 << 20, sleep=_time.sleep):
     """A FleetRouter over FakeReplicas.  ``scripts`` maps replica id
     (spawn order: 0, 1, ... — replacements continue the sequence) to
@@ -319,6 +464,8 @@ def build_serving_fleet(cfg, params_host, *, target=2, scripts=None,
                     max_transient_bytes=max_transient_bytes),
         replica_factory=replica_factory)
     kw = {} if clock is None else {"clock": clock}
+    if autoscale is not None:
+        kw["autoscale"] = autoscale      # round-17 single-pool policy
     router = FleetRouter(rs, router_cfg
                          or RouterConfig(admission_token_cap=64), **kw)
     return router, rs

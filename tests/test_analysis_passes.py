@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import paddle_tpu as paddle  # noqa: F401 - registers ops
 import paddle_tpu.analysis as A
 from paddle_tpu.analysis.fixtures import SEEDED, FixtureUnavailable
-from paddle_tpu.analysis.self_check import _clean_targets, _flagship
+from paddle_tpu.analysis.self_check import _flagship
 
 
 # ---------------------------------------------------------------------------
@@ -51,8 +51,16 @@ def test_seeded_fixture_triggers_exactly_its_code(code):
 
 
 def test_flagship_entry_points_are_clean():
-    for name, rep in _clean_targets():
-        assert rep.ok, f"{name} is not doctor-clean:\n" + rep.summary()
+    # the memoized section (one set of flagship compiles per tier-1
+    # process — the doctor smoke leg reuses it through self_check)
+    from paddle_tpu.analysis.self_check import _clean_section
+
+    section = _clean_section()
+    assert section, "clean sweep yielded no targets"
+    for name, rep in section.items():
+        assert rep.get("ok"), (f"{name} is not doctor-clean:\n"
+                               + "\n".join(rep.get("findings", [])
+                                           or [rep.get("error", "")]))
 
 
 # ---------------------------------------------------------------------------

@@ -13,7 +13,11 @@ import bench  # noqa: E402
 
 
 def test_bench_smoke_green():
-    res = bench.smoke()
+    # fast mode (round-17 tier-1 wall management): the six round-6/7
+    # dispatch legs report fast_skipped with their dedicated tier-1
+    # home suite named; every round-8+ leg runs for real.  The CLI
+    # `python bench.py --smoke` still runs everything.
+    res = bench.smoke(fast=True)
     assert res["smoke"] is True
     # each leg reports ok + optional error detail; assert them
     # individually so a regression names its leg
@@ -69,6 +73,17 @@ def test_bench_smoke_green():
                 # bit-identical to one-shot generate() with handoffs
                 # flowing through the MEM001-budgeted cached plan, and
                 # the int8 KV wire measurably beats the raw form
-                "serving_disagg"):
+                "serving_disagg",
+                # round-17: the training health guardian — NaN skip
+                # bit-identical to the clean run, spike burst walks the
+                # ladder with bounded rollback replay, flipped coded
+                # payload caught at decode, HEALTH fixtures fire
+                "health_trace"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]
+    # the fast-skipped legs must name their tier-1 home (skip with a
+    # paper trail, never silently)
+    for leg in ("serving_pipeline_parity", "varlen_auto_dispatch",
+                "paged_multipage_kernel", "int8_weight_serving",
+                "train_accum_fused_step", "flash_fwdbwd_interpret"):
+        assert res[leg].get("fast_skipped"), (leg, res[leg])

@@ -1,0 +1,478 @@
+"""Training health guardian (round-17): in-step anomaly detection, the
+quarantine/rollback response ladder, and the SDC checksum layer.
+
+Acceptance gates (ISSUE 13):
+- a NaN-injected run converges to BIT-IDENTICAL params vs a clean run
+  that never saw the quarantined batch (the in-step no-op guard);
+- a loss-spike burst escalates skip → lr-backoff → rollback, replays
+  at most checkpoint_every steps, and rejoins with EXACT loss parity;
+- a flipped coded payload is caught at decode (ChecksumError on the
+  host path, NaN-poisoning + probe nonfinite inside jit);
+- the probed flagship entries stay fused (HEALTH001/002 — asserted via
+  the parametrized fixture sweep in tests/test_analysis_passes.py and
+  the doctor smoke leg).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fault_injection import (FaultEvent, NumericFaultEvent,  # noqa: E402
+                             flip_bit, run_toy_health_loop,
+                             toy_health_step_builder, toy_init,
+                             toy_mesh_builder, toy_numeric_data_fn,
+                             toy_step_builder, toy_target)
+from paddle_tpu.distributed.health import (HealthConfig,  # noqa: E402
+                                           HealthMonitor, HealthExhausted,
+                                           ParamSpotChecker, SDCError,
+                                           default_gates,
+                                           replay_quarantined,
+                                           summarize_probe)
+
+
+def _fold_reference(offsets, mesh=None, specs=None):
+    """Ground truth: the plain toy step folded over exactly ``offsets``
+    (the clean run that never saw the quarantined batches)."""
+    if mesh is None:
+        mesh, specs = toy_mesh_builder(jax.devices())
+    state = toy_init(mesh, specs)
+    step_fn = toy_step_builder(mesh, specs)
+    losses = {}
+    for t in offsets:
+        loss, state = step_fn(state, toy_target(t))
+        losses[t] = float(loss)
+    return state, losses
+
+
+# ---------------------------------------------------------------------------
+# the probe + in-step guard
+# ---------------------------------------------------------------------------
+
+
+def test_health_toy_step_bit_matches_plain_step():
+    mesh, specs = toy_mesh_builder(jax.devices())
+    plain = toy_step_builder(mesh, specs)
+    health = toy_health_step_builder(mesh, specs)
+    s1 = toy_init(mesh, specs)
+    s2 = toy_init(mesh, specs)
+    l1, s1 = plain(s1, toy_target(0))
+    l2, s2, probe = health(s2, toy_target(0))
+    p = summarize_probe(probe)
+    assert float(l1) == float(l2)
+    assert np.array_equal(np.asarray(s1["w"]), np.asarray(s2["w"]))
+    assert p["ok"] and p["nonfinite_total"] == 0
+    assert np.isfinite(p["grad_norm"]) and p["update_ratio"] > 0
+
+
+def test_guard_noop_is_bit_exact_on_fired_gate():
+    mesh, specs = toy_mesh_builder(jax.devices())
+    health = toy_health_step_builder(mesh, specs)
+    s0 = toy_init(mesh, specs)
+    w0 = np.asarray(s0["w"]).copy()
+    m0 = np.asarray(s0["opt"]["m"]).copy()
+    tight = np.zeros(3, np.float32)          # every gate trips
+    _, s1, probe = health(s0, toy_target(0), health_gates=tight)
+    assert not bool(probe["ok"])
+    assert np.array_equal(np.asarray(s1["w"]), w0)
+    assert np.array_equal(np.asarray(s1["opt"]["m"]), m0)
+
+
+def test_flagship_probe_parity_and_guard():
+    """build_train_step(health=...) on the debug llama: same loss and
+    params as the unprobed step; a NaN param makes the probe fire and
+    the step a bit-exact no-op."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, \
+        build_train_step
+
+    paddle.seed(20260804)
+    cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
+                            kv_heads=2, inter=64, max_pos=32)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+
+    def deep(t):
+        return jax.tree_util.tree_map(jnp.copy, t)
+
+    base = build_train_step(model, opt, compute_dtype=jnp.float32)
+    l0, p0, _ = base(deep(params), opt.init_state(deep(params)), 0,
+                     1e-3, ids, labels)
+    probed = build_train_step(model, opt, compute_dtype=jnp.float32,
+                              health=HealthConfig())
+    l1, p1, _, probe = probed(deep(params), opt.init_state(deep(params)),
+                              0, 1e-3, ids, labels)
+    assert float(l0) == float(l1)
+    assert all(np.array_equal(np.asarray(p0[k]), np.asarray(p1[k]))
+               for k in p0)
+    assert summarize_probe(probe)["ok"]
+
+    bad = deep(params)
+    bad["model.norm.weight"] = bad["model.norm.weight"].at[0].set(jnp.nan)
+    ref = {k: np.asarray(v).copy() for k, v in bad.items()}
+    _, p2, _, probe2 = probed(bad, opt.init_state(deep(params)), 0,
+                              1e-3, ids, labels)
+    sp = summarize_probe(probe2)
+    assert sp["nonfinite_total"] > 0 and not sp["ok"]
+    assert all(np.array_equal(ref[k], np.asarray(p2[k]),
+                              equal_nan=True) for k in ref)
+
+
+@pytest.mark.slow
+def test_flagship_accum_probe_fires_on_nan():
+    """The accum entry carries the same probe (merged grads).  Tier-2:
+    one extra whole-step compile whose property is held tier-1 by
+    test_flagship_probe_parity_and_guard (same _health_tail on the
+    same grads) and the doctor's health_probed_step sweep."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, \
+        build_train_step
+
+    paddle.seed(20260804)
+    cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
+                            kv_heads=2, inter=64, max_pos=32)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 1, 8)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (2, 1, 8)).astype(np.int32)
+    step = build_train_step(model, opt, compute_dtype=jnp.float32,
+                            accum_steps=2, health=HealthConfig())
+    _, _, _, probe = step(params, opt.init_state(params), 0, 1e-3,
+                          ids, labels)
+    assert summarize_probe(probe)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# the response ladder end to end (resilient_train_loop + harness)
+# ---------------------------------------------------------------------------
+
+
+def test_nan_batch_skip_parity_bit_identical(tmp_path):
+    """THE acceptance gate: a NaN batch is skipped in-step and the run
+    converges to BIT-IDENTICAL params vs a clean run that never saw
+    that batch."""
+    res, _ = run_toy_health_loop(
+        str(tmp_path), num_steps=12,
+        numeric_faults=[NumericFaultEvent(offset=5, kind="nan")])
+    assert res.final_step == 12 and not res.recoveries
+    assert res.health["stage_counts"]["skip"] == 1
+    assert res.health["stage_counts"]["rollback"] == 0
+    [rec] = res.health["quarantined"]
+    assert rec["data_offset"] == 5 and rec["rule"] == "nonfinite"
+    assert rec["probe"]["nonfinite_total"] > 0
+    assert 5 not in res.losses
+    ref_state, ref_losses = _fold_reference(
+        [t for t in range(12) if t != 5])
+    assert np.array_equal(np.asarray(res.state["w"]),
+                          np.asarray(ref_state["w"]))
+    assert np.array_equal(np.asarray(res.state["opt"]["m"]),
+                          np.asarray(ref_state["opt"]["m"]))
+    for t, loss in res.losses.items():
+        assert loss == ref_losses[t]
+
+
+def test_inf_batch_skips_too(tmp_path):
+    res, _ = run_toy_health_loop(
+        str(tmp_path), num_steps=10,
+        numeric_faults=[NumericFaultEvent(offset=6, kind="inf")])
+    assert res.health["stage_counts"]["skip"] == 1
+    [rec] = res.health["quarantined"]
+    assert rec["rule"] == "nonfinite"
+
+
+def test_spike_burst_walks_ladder_and_rolls_back(tmp_path):
+    """Three consecutive spike batches straddling a checkpoint window:
+    skip -> lr-backoff -> rollback.  The rollback restores the last
+    checkpoint (step 4), REPLAYS the steps since it (<= checkpoint
+    interval) with EXACT loss parity, force-skips the quarantined
+    offsets, and completes."""
+    res, _ = run_toy_health_loop(
+        str(tmp_path), num_steps=14,
+        numeric_faults=[NumericFaultEvent(offset=5, kind="spike"),
+                        NumericFaultEvent(offset=6, kind="spike"),
+                        NumericFaultEvent(offset=7, kind="spike")])
+    sc = res.health["stage_counts"]
+    assert sc["skip"] == 1 and sc["backoff"] == 1 and sc["rollback"] == 1
+    assert res.final_step == 14
+    [ev] = res.recoveries
+    assert ev.fault == "NumericFault"
+    assert ev.resume_step == 4
+    assert 0 < ev.steps_replayed <= 4          # genuine bounded replay
+    # quarantined offsets were force-skipped on replay (no re-poisoning)
+    assert sc["forced_skip"] == 3
+    quarantined = {r["data_offset"] for r in res.health["quarantined"]}
+    assert quarantined == {5, 6, 7}
+    # exact parity: the whole surviving trajectory equals the clean run
+    # that never saw the three quarantined batches — replayed steps
+    # included (loss parity at rejoin)
+    ref_state, ref_losses = _fold_reference(
+        [t for t in range(14) if t not in quarantined])
+    for t, loss in res.losses.items():
+        assert loss == ref_losses[t], (t, loss, ref_losses[t])
+    assert np.array_equal(np.asarray(res.state["w"]),
+                          np.asarray(ref_state["w"]))
+
+
+def test_skip_on_checkpoint_boundary_still_saves(tmp_path):
+    """A quarantined batch landing exactly on a checkpoint boundary
+    must not lose that boundary's save: a later rollback resumes from
+    the boundary, not a full window earlier (the round-17 review
+    catch)."""
+    # the nan-skip at 7 consumes it and step 8 (a boundary) must save;
+    # the spike-skip at 11 likewise produces the step-12 save.  The
+    # burst at 11..13 (spaced past the escalation window of the nan
+    # fire) then rolls back at 13 and must find the step-12 checkpoint
+    # the SKIP path wrote — losing the skip-path saves would resume at
+    # 4 and replay 9 steps, over the checkpoint interval.
+    res, _ = run_toy_health_loop(
+        str(tmp_path), num_steps=16,
+        numeric_faults=[NumericFaultEvent(offset=7, kind="nan"),
+                        NumericFaultEvent(offset=11, kind="spike"),
+                        NumericFaultEvent(offset=12, kind="spike"),
+                        NumericFaultEvent(offset=13, kind="spike")])
+    [ev] = res.recoveries
+    assert ev.fault == "NumericFault"
+    assert ev.resume_step == 12 and ev.steps_replayed == 1
+    assert res.final_step == 16
+
+
+def test_isolated_spikes_never_escalate(tmp_path):
+    """Hysteresis: spikes spaced wider than the escalation window stay
+    at the cheapest response (skip) forever — no rollback, no backoff."""
+    res, _ = run_toy_health_loop(
+        str(tmp_path), num_steps=16,
+        numeric_faults=[NumericFaultEvent(offset=6, kind="spike"),
+                        NumericFaultEvent(offset=12, kind="spike")])
+    sc = res.health["stage_counts"]
+    assert sc["skip"] == 2 and sc["backoff"] == 0 and sc["rollback"] == 0
+    assert not res.recoveries
+
+
+def test_backoff_window_scales_lr(tmp_path):
+    """Two adjacent spikes engage the lr-backoff window; the following
+    clean steps run at lr_backoff x lr (asserted against the reference
+    fold with the same scaled lr)."""
+    hc = HealthConfig(warmup_steps=3, lr_backoff=0.5, lr_backoff_steps=2)
+    res, _ = run_toy_health_loop(
+        str(tmp_path), num_steps=12, health=hc,
+        numeric_faults=[NumericFaultEvent(offset=6, kind="spike"),
+                        NumericFaultEvent(offset=7, kind="spike")])
+    sc = res.health["stage_counts"]
+    assert sc["skip"] == 1 and sc["backoff"] == 1 and sc["rollback"] == 0
+    # the window covers steps 8..9: their losses must differ from the
+    # unscaled reference (the lever actually moved the lr)
+    ref_state, ref_losses = _fold_reference(
+        [t for t in range(12) if t not in (6, 7)])
+    assert res.losses[8] != ref_losses[8]
+    # and once the window expires training re-accelerates at full lr
+    assert res.final_step == 12
+
+
+def test_rollback_budget_exhausts_loudly(tmp_path):
+    with pytest.raises(HealthExhausted):
+        run_toy_health_loop(
+            str(tmp_path), num_steps=14,
+            health=HealthConfig(warmup_steps=3, max_rollbacks=0),
+            numeric_faults=[NumericFaultEvent(offset=6, kind="spike"),
+                            NumericFaultEvent(offset=7, kind="spike"),
+                            NumericFaultEvent(offset=8, kind="spike")])
+
+
+def test_replay_quarantined_standalone(tmp_path):
+    """A quarantine record replays standalone for debugging: the same
+    offset re-fires the same rule, without touching training state."""
+    res, _ = run_toy_health_loop(
+        str(tmp_path), num_steps=10,
+        numeric_faults=[NumericFaultEvent(offset=5, kind="nan")])
+    from paddle_tpu.distributed.health import QuarantineRecord
+
+    rec = QuarantineRecord(**res.health["quarantined"][0])
+    mesh, specs = toy_mesh_builder(jax.devices())
+    step_fn = toy_health_step_builder(mesh, specs)
+    data_fn = toy_numeric_data_fn([NumericFaultEvent(offset=5,
+                                                     kind="nan")])
+    out = replay_quarantined(rec, step_fn, toy_init(mesh, specs),
+                             data_fn)
+    assert out["replayed"]["nonfinite_total"] > 0
+    assert not out["replayed"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# SDC: spot-check + codec checksums
+# ---------------------------------------------------------------------------
+
+
+def test_spot_checker_rotation_catches_corrupted_leaf():
+    tree_a = {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+              "opt": {"m": np.ones((4, 4), np.float32)},
+              "lr": 0.05}
+    tree_b = {"w": tree_a["w"].copy(),
+              "opt": {"m": tree_a["opt"]["m"].copy()},
+              "lr": 0.05}
+    spot = ParamSpotChecker(every=1, slices=2)
+    # identical replicas agree across a full rotation
+    for step in range(1, 5):
+        a = spot.check(tree_a, step)
+        b = spot.check(tree_b, step)
+        assert a.crc == b.crc
+        spot.compare(a, b.crc)
+    # one flipped bit on one replica is caught within one rotation
+    tree_b["opt"]["m"][0, 0] = np.float32(1.0000001)
+    caught = 0
+    for step in range(1, 5):
+        a, b = spot.check(tree_a, step), spot.check(tree_b, step)
+        if a.crc != b.crc:
+            with pytest.raises(SDCError):
+                spot.compare(a, b.crc)
+            caught += 1
+    assert caught >= 1
+
+
+def test_spot_checker_covers_tuple_states():
+    """A tuple/list-shaped training state must not degrade the spot
+    check to a vacuous crc over zero leaves."""
+    state = ({"w": np.ones((4, 4), np.float32)},
+             [np.zeros((2, 2), np.float32)])
+    spot = ParamSpotChecker(every=1, slices=1)
+    sc = spot.check(state, 1)
+    assert len(sc.paths) == 2 and sc.crc != 0
+
+
+def test_sdc_spot_check_rolls_back(tmp_path):
+    """A diverging peer crc at a spot-check step raises SDCError and
+    takes the rollback path; the run completes after recovery."""
+    hc = HealthConfig(warmup_steps=3, spot_check_every=4,
+                      spot_check_slices=2)
+    res, cluster = run_toy_health_loop(
+        str(tmp_path), num_steps=14, health=hc,
+        faults=[FaultEvent(step=8, kind="sdc")])
+    assert cluster.spot_check_log, "spot checks never ran"
+    [ev] = res.recoveries
+    assert ev.fault == "SDCError"
+    assert ev.steps_replayed <= 4 + 1
+    assert res.final_step == 14
+
+
+def test_codec_checksum_catches_bit_flip_on_delivery():
+    """A flipped coded wire payload raises ChecksumError at decode on
+    the host-mediated path (reshard.execute_encoded) — loud error, not
+    silent divergence."""
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel.codec import ChecksumError, CollectiveCodec
+    from paddle_tpu.parallel.reshard import execute_encoded, plan_reshard
+
+    mesh = Mesh(np.asarray(jax.devices()[:1], dtype=object), ("r",))
+    host = {"w": np.random.RandomState(0).randn(64, 32).astype(
+        np.float32)}
+    plan = plan_reshard(host, mesh, None)
+    codec = CollectiveCodec(block=64, weight_profile="int8",
+                            checksum=True)
+    # clean delivery decodes fine (and within codec tolerance)
+    out = execute_encoded(plan, host, codec)
+    assert np.abs(np.asarray(out["w"]) - host["w"]).max() < 0.2
+
+    with pytest.raises(ChecksumError):
+        execute_encoded(plan, host, codec,
+                        corrupt=lambda p, path, ci: flip_bit(p, 17))
+
+
+def test_codec_checksum_poisons_inside_jit():
+    """The in-collective decode cannot raise: a corrupted row decodes
+    to NaN and the health probe's nonfinite counter fires — detection
+    is guaranteed the same step."""
+    from paddle_tpu.distributed.health import make_probe
+    from paddle_tpu.parallel.codec import (CollectiveCodec, decode_rows,
+                                           encode_rows)
+
+    codec = CollectiveCodec(block=32, checksum=True)
+    x = np.random.RandomState(1).randn(2, 100).astype(np.float32)
+    packed = np.asarray(encode_rows(jnp.asarray(x), codec, "int8"))
+    flipped = flip_bit(packed, byte_index=5)
+    y = decode_rows(jnp.asarray(flipped), 100, codec, "int8")
+    y_np = np.asarray(y)
+    assert np.isnan(y_np[0]).all() and np.isfinite(y_np[1]).all()
+    probe = make_probe(jnp.float32(1.0), {"g": y}, None, None, None,
+                       buckets=4)
+    assert summarize_probe(probe)["nonfinite_total"] > 0
+    assert not summarize_probe(probe)["ok"]
+
+    # unflipped round-trips finite and verifies clean
+    clean = np.asarray(decode_rows(jnp.asarray(packed), 100, codec,
+                                   "int8"))
+    assert np.isfinite(clean).all()
+
+
+def test_checksum_wire_cost_is_4_bytes_per_row():
+    from paddle_tpu.parallel.codec import packed_width
+
+    assert packed_width(256, 256, True) == packed_width(256, 256) + 4
+    assert packed_width(257, 256, False) == packed_width(257, 256)
+
+
+# ---------------------------------------------------------------------------
+# the hybrid stack's probe (one compile of the flagship — tier-2; the
+# probe contract itself is held tier-1 by the GSPMD entries above and
+# the doctor's health_probed_step sweep)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_hybrid_probed_step_parity():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama_hybrid import (build_hybrid_train_step,
+                                                hybrid_mesh,
+                                                shard_hybrid_state,
+                                                stack_llama_state)
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    paddle.seed(20260804)
+    cfg = LlamaConfig.debug(vocab=64, hidden=32, layers=2, heads=4,
+                            kv_heads=2, inter=64, max_pos=32)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    params = {k: jnp.asarray(v)
+              for k, v in model.functional_state().items()}
+    mesh = hybrid_mesh(jax.devices(), pp=2, dp=1, sharding=2, sep=1,
+                       mp=2)
+    state = shard_hybrid_state(
+        stack_llama_state(dict(params), cfg.num_hidden_layers), mesh)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+
+    def deep(t):
+        return jax.tree_util.tree_map(jnp.copy, t)
+
+    base = build_hybrid_train_step(cfg, opt, mesh, num_microbatches=2,
+                                   compute_dtype=jnp.float32)
+    l0, p0, _ = base(deep(state), opt.init_state(deep(state)), 0, 1e-3,
+                     ids, labels)
+    probed = build_hybrid_train_step(cfg, opt, mesh, num_microbatches=2,
+                                     compute_dtype=jnp.float32,
+                                     health=HealthConfig())
+    l1, p1, _, probe = probed(deep(state), opt.init_state(deep(state)),
+                              0, 1e-3, ids, labels)
+    assert float(l0) == float(l1)
+    assert all(np.array_equal(np.asarray(p0[k]), np.asarray(p1[k]))
+               for k in p0)
+    sp = summarize_probe(probe)
+    assert sp["ok"] and sp["nonfinite_total"] == 0

@@ -24,8 +24,13 @@ def _grad_of(net, out_sum):
 @pytest.mark.parametrize("mode,tcls", [("LSTM", torch.nn.LSTM),
                                        ("GRU", torch.nn.GRU),
                                        ("RNN", torch.nn.RNN)])
-@pytest.mark.parametrize("layers,direction", [(1, "forward"),
-                                              (2, "bidirect")])
+# the 2-layer-bidirect grid re-asserts under ``-m slow`` (round-17
+# tier-1 wall management); the 1-layer-forward point per cell mode is
+# the kept tier-1 home — same kernels, same torch parity
+@pytest.mark.parametrize("layers,direction", [
+    (1, "forward"),
+    pytest.param(2, "bidirect", marks=pytest.mark.slow),
+])
 def test_rnn_matches_torch(mode, tcls, layers, direction):
     paddle.seed(42)
     cls = {"LSTM": nn.LSTM, "GRU": nn.GRU, "RNN": nn.SimpleRNN}[mode]

@@ -115,6 +115,46 @@ def test_disagg_bit_parity_with_unified(tiny_model):
     assert len(rs.serving("decode")) == 2       # fleet healed in-pool
 
 
+def test_sampled_request_hands_off_with_rng_state(tiny_model):
+    """Round-17 (ROADMAP disagg leftover): temperature>0 requests no
+    longer pin to a unified pool — the per-slot PRNG key rides the
+    handoff payload, so a sampled stream crossing a MID-DECODE handoff
+    is token-identical to the same (temperature, seed) request on one
+    unified engine (the prefill side's first-token draw advances the
+    stream; the decode side resumes it mid-state)."""
+    cfg, model, params = tiny_model
+    rng = np.random.default_rng(209)
+    filler = rng.integers(1, 64, (9,)).astype(np.int32)
+    prompt = rng.integers(1, 64, (13,)).astype(np.int32)
+
+    # reference: ONE unified engine, same seeds, same sampling machinery
+    ekw = dict(max_slots=2, num_pages=33, page_size=16, max_seq_len=128,
+               prefill_token_budget=16, enable_prefix_cache=True)
+    ref_eng = ContinuousBatchingEngine(cfg, params, **ekw)
+    r0 = ref_eng.add_request(filler, max_new_tokens=6)
+    r1 = ref_eng.add_request(prompt, max_new_tokens=6, temperature=0.8,
+                             seed=42)
+    ref = {f.rid: list(f.tokens) for f in ref_eng.run()}
+
+    # disaggregated: no unified pool anywhere — the sampled request
+    # MUST cross the prefill→decode handoff to complete
+    router, rs = build_disagg_fleet(cfg, params, prefill=1, decode=1)
+    assert "unified" not in rs.pool_targets()
+    d0 = router.submit(filler, max_new_tokens=6)
+    d1 = router.submit(prompt, max_new_tokens=6, temperature=0.8,
+                       seed=42)
+    out = router.run()
+    assert sorted(out) == sorted([d0, d1])
+    np.testing.assert_array_equal(out[d0], np.asarray(ref[r0]))
+    np.testing.assert_array_equal(
+        out[d1], np.asarray(ref[r1]),
+        err_msg="sampled stream diverged across the KV handoff — the "
+                "PRNG state did not migrate")
+    assert router.telemetry["handoffs"] >= 2
+    # the second handoff lands while the first request decodes
+    assert router.telemetry["handoffs_mid_decode"] >= 1
+
+
 def test_kv_handoff_budget_and_int8_wire(tiny_model):
     """The handoff leg: the int8-KV fleet's handoff stream moves
     measurably fewer bytes than the float-cache form of the SAME page

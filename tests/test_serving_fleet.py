@@ -533,6 +533,78 @@ def test_submit_rejects_undispatchable_footprint(tiny_model):
                       max_new_tokens=8)       # footprint 37 > 32
 
 
+def test_single_pool_autoscale_hysteresis(tiny_model):
+    """Round-17 (ROADMAP fleet item (b) remainder): the classic
+    single-pool autoscale — AutoscaleConfig pointed at
+    ``FleetConfig.target_replicas``.  Sustained admission pressure
+    scales the unified pool up, sustained idleness scales it down
+    through the drain path, and the cooldown window pins hysteresis on
+    the fake clock: events in either direction are spaced at least
+    ``cooldown_ticks`` apart, so an oscillating load cannot flap the
+    fleet.  Zero requests lost throughout."""
+    cfg, model, params = tiny_model
+    from paddle_tpu.inference.disagg import AutoscaleConfig
+
+    clock = _Clock()
+    asc = AutoscaleConfig(min_replicas=1, max_replicas=2,
+                          up_sustain_ticks=2, down_idle_ticks=3,
+                          cooldown_ticks=4)
+    router, rs = build_serving_fleet(
+        cfg, params, target=1,
+        router_cfg=RouterConfig(admission_token_cap=32),
+        autoscale=asc, clock=clock)
+    assert len(rs.serving()) == 1
+
+    rng = np.random.default_rng(114)
+    prompts = _prompts(rng, (20, 22, 24, 21))   # footprints ~26 > cap/2:
+    rids = [router.submit(p, max_new_tokens=4)  # one per replica at a
+            for p in prompts]                   # time -> queue backlog
+    scale_up_tick = None
+    for _ in range(60):
+        clock.t += 1.0
+        router.step()
+        if scale_up_tick is None \
+                and rs.config.target_replicas == 2:
+            scale_up_tick = router._tick
+        if not router.pending():
+            break
+    assert scale_up_tick is not None, "sustained pressure never scaled up"
+    assert len(rs.serving()) == 2
+    out = router.results()
+    assert sorted(out) == sorted(rids)          # zero loss under scaling
+    for rid, p, ref in zip(rids, prompts, _refs(model, prompts, 4)):
+        np.testing.assert_array_equal(out[rid], ref[:len(out[rid])])
+
+    # idle ticks walk the pool back down through the DRAIN path
+    for _ in range(asc.down_idle_ticks + asc.cooldown_ticks + 4):
+        clock.t += 1.0
+        router.step()
+    assert rs.config.target_replicas == 1
+    assert len(rs.serving()) == 1
+
+    # hysteresis pinned: same-direction or opposite events spaced by at
+    # least the cooldown window; the log shows exactly one up + one down
+    log = router.telemetry["autoscale_log"]
+    assert [ev["dir"] for ev in log] == ["up", "down"]
+    assert log[1]["tick"] - log[0]["tick"] >= asc.cooldown_ticks
+
+
+@pytest.mark.slow
+def test_autoscale_disabled_by_default(tiny_model):
+    """Tier-2: a config-surface pin (one extra fleet spawn/warm); the
+    autoscale feature itself is held tier-1 by
+    test_single_pool_autoscale_hysteresis."""
+    cfg, model, params = tiny_model
+    router, rs = build_serving_fleet(cfg, params, target=1)
+    rng = np.random.default_rng(115)
+    rids = [router.submit(p, max_new_tokens=4)
+            for p in _prompts(rng, (8, 10, 9, 7))]
+    out = router.run()
+    assert sorted(out) == sorted(rids)
+    assert rs.config.target_replicas == 1          # nothing moved it
+    assert "autoscale_log" not in router.telemetry
+
+
 def test_spawn_failure_is_retried_not_fatal(tiny_model):
     """A replacement replica whose spawn/warm raises must not crash
     the router tick: the failure is counted, the survivor keeps

@@ -162,6 +162,22 @@ _ROUND16_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND16_TRANCHE
 
+# names added by the round-17 tranche (the health-guardian round's
+# satellite): the stacking-family method forms (self prepended to the
+# operand list), the nan*-reduction completions of the already-wired
+# nansum/nanmean/nanmedian family, the dense→sparse-carrier conversions
+# (duals of round-16's is_sparse_*/to_dense queries; carriers live in
+# paddle_tpu.sparse), and the binary extremum in-place family — appended
+# into _REQUIRED_METHODS AND counted against the ~15 floor by
+# test_method_count_tranche_round17
+_ROUND17_TRANCHE = [
+    "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "block_diag", "nanstd", "nanvar", "nanargmax", "nanargmin",
+    "to_sparse_coo", "to_sparse_csr", "maximum_", "minimum_", "fmax_",
+    "fmin_",
+]
+_REQUIRED_METHODS += _ROUND17_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -593,3 +609,63 @@ def test_round13_fill_and_apply_method_values():
     g.stop_gradient = False
     with pytest.raises(RuntimeError):
         g.apply(lambda x: x)
+
+
+def test_method_count_tranche_round17():
+    """The round-17 tranche satisfies the ~15-new-names floor (ISSUE 13
+    satellite) over the round-16 surface."""
+    wired = [n for n in _ROUND17_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 15, (len(wired),
+                              sorted(set(_ROUND17_TRANCHE) - set(wired)))
+
+
+def test_round17_method_values():
+    a = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+    b = paddle.to_tensor(np.array([[3.0, 4.0]], np.float32))
+    # stacking family: self prepended to the operand list
+    np.testing.assert_allclose(np.asarray(a.vstack(b)._value),
+                               [[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(a.hstack(b)._value),
+                               [[1.0, 2.0, 3.0, 4.0]])
+    assert np.asarray(a.dstack(b)._value).shape == (1, 2, 2)
+    col = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    assert np.asarray(col.column_stack(col)._value).shape == (2, 2)
+    assert np.asarray(col.row_stack(col)._value).shape == (4,) \
+        or np.asarray(col.row_stack(col)._value).shape == (2, 2)
+    bd = a.block_diag(b)
+    np.testing.assert_allclose(
+        np.asarray(bd._value),
+        [[1.0, 2.0, 0.0, 0.0], [0.0, 0.0, 3.0, 4.0]])
+    # nan* reductions ignore the NaN holes and agree with std/var on
+    # the ddof convention (unbiased=True default, like t.std())
+    n = paddle.to_tensor(np.array([1.0, np.nan, 3.0], np.float32))
+    np.testing.assert_allclose(float(n.nanstd()._value),
+                               np.nanstd([1.0, 3.0], ddof=1), rtol=1e-6)
+    np.testing.assert_allclose(float(n.nanvar()._value),
+                               np.nanvar([1.0, 3.0], ddof=1), rtol=1e-6)
+    clean = paddle.to_tensor(np.array([1.0, 2.0, 4.0], np.float32))
+    np.testing.assert_allclose(float(clean.nanstd()._value),
+                               float(clean.std()._value), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(clean.nanvar(unbiased=False)._value),
+        np.nanvar([1.0, 2.0, 4.0]), rtol=1e-6)
+    assert int(n.nanargmax()._value) == 2
+    assert int(n.nanargmin()._value) == 0
+    # dense -> sparse carriers round-trip through to_dense
+    d = paddle.to_tensor(np.array([[0.0, 5.0], [7.0, 0.0]], np.float32))
+    coo = d.to_sparse_coo(2)
+    assert coo.nnz() == 2
+    np.testing.assert_allclose(np.asarray(coo.to_dense()._value),
+                               np.asarray(d._value))
+    csr = d.to_sparse_csr()
+    np.testing.assert_allclose(np.asarray(csr.to_dense()._value),
+                               np.asarray(d._value))
+    # binary extremum in-place: mutates and returns self
+    x = paddle.to_tensor(np.array([1.0, 5.0], np.float32))
+    y = paddle.to_tensor(np.array([4.0, 2.0], np.float32))
+    out = x.maximum_(y)
+    assert out is x
+    np.testing.assert_allclose(np.asarray(x._value), [4.0, 5.0])
+    z = paddle.to_tensor(np.array([np.nan, 1.0], np.float32))
+    z.fmin_(paddle.to_tensor(np.array([2.0, 0.5], np.float32)))
+    np.testing.assert_allclose(np.asarray(z._value), [2.0, 0.5])
