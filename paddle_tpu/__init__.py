@@ -151,6 +151,8 @@ from .frontend_compat import (  # noqa: F401
     set_cuda_rng_state, set_grad_enabled, set_printoptions, shape, slice,
     standard_gamma, strided_slice, take, tensor_split, tolist, unflatten,
     view, view_as, vsplit, vstack,
+    # round-18 tranche: axis-movement aliases + msort/logdet
+    logdet, movedim, msort, swapdims,
 )
 
 # registry-only ops that the reference exposes at top level

@@ -351,6 +351,49 @@ def seeded_codec_disabled() -> Report:
                                     "dcn_bytes": budget}}})
 
 
+def seeded_moe_dispatch_codec_off() -> Report:
+    """COMM004 on the round-18 EP dispatch: a fake-2-slice expert
+    all-to-all whose codec is silently DISABLED, checked against the
+    DCN wire budget its QUANTIZED schedule honors — the EP twin of the
+    reduce-scatter fixture (one dropped ``codec=`` kwarg on the MoE
+    dispatch re-inflates every DCN-crossing token payload to fp wire,
+    blowing the post-codec contract the EP step is pinned to)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+    from ..distributed.topology import hierarchical_axis
+    from ..parallel.codec import CollectiveCodec
+    from ..parallel.expert import make_ep_all_to_all
+    from .passes.collective_budget import collect_wire_table
+
+    mesh = _mesh(4)
+    if mesh.shape["x"] < 4:
+        raise FixtureUnavailable("fake 2-slice split needs an axis of 4")
+    sm = (0, 0, 1, 1)
+    hier = hierarchical_axis(mesh, "x", slice_map=sm)
+    codec = CollectiveCodec(block=64)
+
+    def coded(v):
+        return make_ep_all_to_all("x", hier=hier, codec=codec)(v)
+
+    def uncoded(v):                      # the seeded bug: codec dropped
+        return make_ep_all_to_all("x", hier=hier)(v)
+
+    def wrap(body):
+        return shard_map(body, mesh=mesh, in_specs=(P(),),
+                         out_specs=P("x"), check_vma=False)
+
+    x = jnp.ones((16, 64), jnp.float32)   # [E, C*d]-shaped send buffer
+    # the declared budget IS the quantized dispatch's measured DCN bytes
+    coded_jaxpr = jax.make_jaxpr(wrap(coded))(x).jaxpr
+    budget = collect_wire_table(coded_jaxpr, {"x": sm})["dcn"]["bytes"]
+    return check(wrap(uncoded), x, passes=["collective_budget"],
+                 exemptions=(), target="seeded:COMM004[moe_dispatch]",
+                 options={"collective_budget":
+                          {"wire": {"dcn_axes": {"x": list(sm)},
+                                    "dcn_bytes": budget}}})
+
+
 # ---------------------------------------------------------------------------
 # memory_budget
 # ---------------------------------------------------------------------------
@@ -703,6 +746,10 @@ SEEDED = {
     # round-15: post-codec bytes-on-the-wire — a silently-disabled
     # quantized-DCN codec blows the declared DCN wire budget
     "COMM004": seeded_codec_disabled,
+    # round-18: a second COMM004 proof on the EP MoE dispatch — the
+    # codec silently off on the expert all-to-all blows the DCN wire
+    # budget the quantized dispatch schedule honors
+    "COMM004[moe_dispatch]": seeded_moe_dispatch_codec_off,
     "DT001": seeded_fp32_matmul,
     "DT002": seeded_f64_leak,
     "DT003": seeded_fp32_carry,

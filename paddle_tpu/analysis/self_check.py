@@ -60,6 +60,18 @@ FLAGSHIP_STREAM_BUDGET = 6 << 20
 FLAGSHIP_DCN_WIRE_BUDGET = 24 << 10
 FLAGSHIP_SLICE_MAP = (0, 0, 1, 1)
 
+# Round-18 wire contract for the debug-shaped EP MoE train step on the
+# fake-2-slice dp1 x sharding2 x ep4 mesh (ep spans the slices) with
+# the block-64 DCN codec ON: the quantized dispatch/combine schedule
+# measures ~1.9 KB of post-codec DCN bytes per step (int8 token
+# payloads + bf16 scale sidecars on the all-to-alls, plus the tiny
+# uncoded fp32 gate-grad psum) vs ~4.6 KB uncoded — the dispatch
+# all-to-alls alone shrink 3.88x (the >= 3x acceptance bar).  2.25 KB
+# pins it with ~20% headroom: silently dropping the codec on the EP
+# dispatch blows COMM004 here, not a multislice TPU session.
+MOE_DCN_WIRE_BUDGET = 2304
+MOE_SLICE_MAP = (0, 0, 1, 1)
+
 # Round-17 probe-fusion contract (HEALTH001) for the health-probed
 # flagship step: the probed entry's compiled peak may exceed the
 # UNPROBED entry's measured peak by at most this allowance.  Measured
@@ -236,6 +248,11 @@ def _clean_targets():
     if len(jax.devices()) >= 8:
         for name, rep in _overlap_target():
             yield name, rep
+        # 2d. round-18: the EP MoE train step under its pinned
+        # post-codec DCN wire budget (COMM004) on the fake-2-slice
+        # dp1 x sharding2 x ep4 mesh
+        for name, rep in _moe_ep_target():
+            yield name, rep
 
     # 3. llama forward/backward in isolation (no optimizer): params are
     # read-only here, so they are declared persistent for the donation
@@ -292,6 +309,50 @@ def _clean_targets():
         options={**uoptions, "collective_budget": zero_budget,
                  "memory_budget": {"hbm_bytes": SERVING_HBM_BUDGET}},
         target="serving_unified_step")
+
+
+def _moe_ep_flagship():
+    """Debug-shaped EP MoE bundle shared by the EP clean sweep, the
+    sharding section and the bench moe trace (fake-2-slice
+    dp1 x sharding2 x ep4 mesh; shapes shrink, structure doesn't)."""
+    from jax.sharding import Mesh
+
+    from paddle_tpu.parallel.expert import MoEEPConfig, init_moe_ep_params
+
+    cfg = MoEEPConfig(d_model=16, d_hidden=32, num_expert=8, top_k=2,
+                      capacity_factor=2.0, aux_weight=0.01)
+    mesh = Mesh(np.asarray(jax.devices()[:8], dtype=object).reshape(
+        1, 2, 4), ("dp", "sharding", "ep"))
+    params = init_moe_ep_params(cfg, mesh)
+    rng = np.random.default_rng(7)
+    x2d = jnp.asarray(rng.standard_normal((64, 16), np.float32))
+    tgt = jnp.asarray(rng.standard_normal((64, 16), np.float32))
+    return cfg, mesh, params, x2d, tgt
+
+
+def _moe_ep_target():
+    """Round-18 EP clean sweep: the expert-parallel MoE train step on
+    the fake-2-slice mesh with the DCN codec ON, pinned to its
+    post-codec wire budget (COMM004 — a silently-dropped codec on the
+    dispatch all-to-alls fails here) with every manual collective
+    engine-attributed (COMM002)."""
+    from .core import check
+    from paddle_tpu.parallel.codec import CollectiveCodec
+    from paddle_tpu.parallel.expert import build_moe_ep_train_step
+    from paddle_tpu.parallel.overlap import OverlapConfig
+
+    cfg, mesh, params, x2d, tgt = _moe_ep_flagship()
+    oc = OverlapConfig(hierarchical="on", slice_map=MOE_SLICE_MAP,
+                       codec=CollectiveCodec(block=64))
+    step = build_moe_ep_train_step(cfg, mesh, oc=oc)
+    yield "moe_ep_train_step[hier2slice,codec]", check(
+        step, params, x2d, tgt,
+        passes=["collective_budget"],
+        options={"collective_budget": {
+            "overlap_active": True,
+            "wire": {"dcn_axes": {"ep": list(MOE_SLICE_MAP)},
+                     "dcn_bytes": MOE_DCN_WIRE_BUDGET}}},
+        target="moe_ep_train_step[hier2slice,codec]")
 
 
 def _overlap_target():
@@ -557,6 +618,29 @@ def _sharding_targets():
         {"gspmd": glayout, "overlap": olayout, "hybrid": hlayout},
         target="sharding:cross_stack")
 
+    # 7. round-18: the EP MoE stack — the DECLARED plan table
+    # (expert.moe_ep_layout: leading [E] on ``ep``, shared gate
+    # replicated) vs the CONCRETE at-rest placement of the placed
+    # params; SHARD003 must be empty with ``ep`` among the canonical
+    # mesh axes (the fourth named tactic covered by the same gate),
+    # plus the SHARD002/004 table checks on the plan
+    from paddle_tpu.parallel.expert import moe_ep_layout
+    from paddle_tpu.parallel.specs import layout_from_arrays
+
+    mcfg, mmesh, mparams, _, _ = _moe_ep_flagship()
+    mplan = moe_ep_layout(mcfg, mmesh)
+    mrest = layout_from_arrays(mparams, mesh=mmesh)
+    # in the EP stack 'sharding' is a PURE batch axis (tokens ride it
+    # into the dispatch; there is no ZeRO layer here) — expert weights
+    # replicate over it by design, exactly like dp
+    yield "moe_ep_layout", check_layout(
+        mplan, replicated_min_bytes=SHARDING_REPLICATED_MIN_BYTES,
+        ignore_axes=SHARDING_DATA_AXES + ("sharding",),
+        target="sharding:moe_ep_layout")
+    yield "moe_ep_cross_stack", check_cross_stack(
+        {"moe_ep_plan": mplan, "moe_ep_at_rest": mrest},
+        target="sharding:moe_ep_cross_stack")
+
 
 _WIRE_MEMO: Dict = {}
 
@@ -628,6 +712,19 @@ def flagship_sharding_table() -> dict:
         2, 2, 2), ("dp", "sharding", "mp"))
     apply_llama_sharding(model, mesh)
     return extract_gspmd_layout(model, mesh).to_table()
+
+
+def moe_ep_sharding_table() -> dict:
+    """The canonical SpecLayout table of the EP MoE stack on the
+    fake-2-slice dp x sharding x ep mesh — DOCTOR.json's round-18
+    rider: ``ep`` appears as a first-class axis in the canonical
+    vocabulary the unified partitioning schedule consumes."""
+    from .sharding import extract_moe_ep_layout
+
+    if len(jax.devices()) < 8:
+        return {"skipped": "needs >= 8 devices"}
+    cfg, mesh, _, _, _ = _moe_ep_flagship()
+    return extract_moe_ep_layout(cfg, mesh).to_table()
 
 
 def _probe_masked_grad_accum():
@@ -775,6 +872,12 @@ def self_check(clean: bool = True) -> dict:
             result["sharding_canonical_table"] = flagship_sharding_table()
         except Exception as e:  # noqa: BLE001
             result["sharding_canonical_table"] = {"error": repr(e)}
+        # round-18: the EP MoE stack's canonical table — ``ep`` as a
+        # first-class axis in the vocabulary (the fourth named tactic)
+        try:
+            result["moe_ep_canonical_table"] = moe_ep_sharding_table()
+        except Exception as e:  # noqa: BLE001
+            result["moe_ep_canonical_table"] = {"error": repr(e)}
         # round-15: the per-stage (ICI/DCN) bytes-on-the-wire table for
         # the flagship hierarchical step, codec off vs on — the COMM004
         # contract's measurement artifact

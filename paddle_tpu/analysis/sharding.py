@@ -169,6 +169,20 @@ def extract_serving_layout(engine) -> SpecLayout:
     return collapse_layers(layout_from_arrays(engine.params))
 
 
+def extract_moe_ep_layout(cfg, mesh, dtype: str = "float32") -> SpecLayout:
+    """Canonical table of the round-18 EP MoE stack: the declared plan
+    (``parallel.expert.moe_ep_spec_for`` — expert-stacked leaves lead
+    [E] on ``ep`` via the shared ``specs.expert_leaf_spec`` rule,
+    shared leaves replicate) under the at-rest divisibility rule.
+    ``ep`` rides ``mesh_axes`` like any other axis, so SHARD002-004 and
+    the SHARD003 cross-stack gate cover expert parallelism for free;
+    self_check diffs this table against ``layout_from_arrays`` of the
+    placed params (``moe_ep_cross_stack``)."""
+    from ..parallel.expert import moe_ep_layout
+
+    return moe_ep_layout(cfg, mesh, dtype=dtype)
+
+
 # ---------------------------------------------------------------------------
 # Report-producing helpers (table-level checks without a traced target —
 # the check_reshard_budget convention)

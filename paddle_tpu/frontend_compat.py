@@ -90,6 +90,11 @@ _INPLACE_BASES = [
     # round-17 tranche: in-place partners of the binary extremum family
     # (maximum/minimum and their NaN-propagation duals)
     "maximum", "minimum", "fmax", "fmin",
+    # round-18 tranche: the axis-movement family (incl. the movedim/
+    # swapdims alias pair) and the remaining elementwise-pair in-place
+    # partners whose bases shipped in earlier rounds
+    "moveaxis", "movedim", "swapaxes", "swapdims", "deg2rad", "rad2deg",
+    "heaviside", "nextafter", "logaddexp", "conj",
 ]
 
 
@@ -131,6 +136,30 @@ def atleast_2d(*inputs):
 def atleast_3d(*inputs):
     outs = [_wrap(jnp.atleast_3d(_val(t))) for t in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+def movedim(x, source, destination):
+    """Alias of ``moveaxis`` (reference exposes both names)."""
+    return _wrap(jnp.moveaxis(_val(x), source, destination))
+
+
+def swapdims(x, axis1, axis2):
+    """Alias of ``swapaxes`` (reference exposes both names)."""
+    return _wrap(jnp.swapaxes(_val(x), int(axis1), int(axis2)))
+
+
+def msort(x):
+    """Sort along the FIRST axis (reference paddle.msort ==
+    sort(x, axis=0))."""
+    return _wrap(jnp.sort(_val(x), axis=0))
+
+
+def logdet(x):
+    """log(det(x)) of a (batch of) square matrices (reference
+    paddle.linalg-flavoured logdet; NaN where det <= 0, like the
+    real-dtype reference)."""
+    sign, ld = jnp.linalg.slogdet(_val(x))
+    return _wrap(jnp.where(sign > 0, ld, jnp.nan).astype(ld.dtype))
 
 
 def broadcast_shape(x_shape, y_shape):

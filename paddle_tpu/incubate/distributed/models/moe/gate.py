@@ -24,6 +24,14 @@ def _one_hot(idx, num):
     return jax.nn.one_hot(idx, num, dtype=jnp.float32)
 
 
+def moe_capacity(num_tokens: int, topk: int, num_expert: int,
+                 capacity_factor: float) -> int:
+    """THE per-expert slot-count rule (reference naive_gate semantics):
+    the single copy shared by the gate zoo and the round-18 EP engine's
+    per-(rank, expert) capacity, so the two can never desynchronize."""
+    return int(capacity_factor * num_tokens * topk / num_expert + 1)
+
+
 def load_balance_aux_loss(probs):
     """GShard eq.(4) / Switch: E * sum(frac_top1_tokens * mean_prob)."""
     e = probs.shape[-1]
@@ -40,12 +48,26 @@ def top_k_masks(probs, topk: int, capacity: int):
     """Greedy top-k routing with per-expert capacity.
 
     probs: [G, E].  Returns (combine [G,E,C], dispatch [G,E,C]); tokens
-    beyond an expert's capacity are dropped (reference semantics)."""
+    beyond an expert's capacity are dropped (reference semantics).
+    Callers that need the overflow surfaced use
+    ``top_k_masks_with_drops``."""
+    combine, dispatch, _ = top_k_masks_with_drops(probs, topk, capacity)
+    return combine, dispatch
+
+
+def top_k_masks_with_drops(probs, topk: int, capacity: int):
+    """``top_k_masks`` plus the capacity-overflow count: ``dropped`` is
+    the number of (token, expert) routing assignments that exceeded the
+    expert's capacity and silently vanished from combine/dispatch — the
+    round-18 telemetry contract (a capacity-overflow is a MODEL QUALITY
+    event, never a silent one; MoELayer surfaces it as
+    ``tokens_dropped`` and the EP bench trace reports the rate)."""
     g, e = probs.shape
     combine = jnp.zeros((g, e, capacity), jnp.float32)
     dispatch = jnp.zeros((g, e, capacity), jnp.float32)
     remaining = probs
     position_in_expert = jnp.zeros((e,), jnp.int32)
+    dropped = jnp.zeros((), jnp.int32)
     for _ in range(topk):
         idx = jnp.argmax(remaining, axis=-1)          # [G]
         mask = _one_hot(idx, e)                       # [G, E]
@@ -53,6 +75,8 @@ def top_k_masks(probs, topk: int, capacity: int):
         pos = (jnp.cumsum(mask, axis=0) - 1) * mask + \
             position_in_expert[None, :] * mask
         keep = (pos < capacity) & (mask > 0)
+        # routed assignments past capacity: mask selected, keep refused
+        dropped = dropped + ((mask > 0) & ~keep).sum().astype(jnp.int32)
         w = (probs * mask).sum(-1, keepdims=True)     # [G, 1] gate weight
         oh_pos = _one_hot(jnp.where(keep, pos.astype(jnp.int32), 0), capacity)
         sel = keep.astype(jnp.float32)[..., None] * oh_pos  # [G, E, C]
@@ -60,7 +84,7 @@ def top_k_masks(probs, topk: int, capacity: int):
         dispatch = jnp.maximum(dispatch, sel)
         position_in_expert = position_in_expert + mask.sum(0).astype(jnp.int32)
         remaining = remaining * (1.0 - mask)
-    return combine, dispatch
+    return combine, dispatch, dropped
 
 
 class NaiveGate(Layer):
@@ -77,8 +101,8 @@ class NaiveGate(Layer):
             jnp.zeros((d_model, self.num_expert), dtype=jnp.float32))
 
     def capacity(self, num_tokens: int, capacity_factor: float) -> int:
-        return int(capacity_factor * num_tokens * self.topk
-                   / self.num_expert + 1)
+        return moe_capacity(num_tokens, self.topk, self.num_expert,
+                            capacity_factor)
 
 
 class GShardGate(NaiveGate):

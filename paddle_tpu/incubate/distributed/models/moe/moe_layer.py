@@ -19,22 +19,28 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .....core.tensor import Tensor
 from .....nn.layer import Layer, Parameter
 from .....ops.registry import register
-from .gate import GShardGate, NaiveGate, SwitchGate, top_k_masks
+from .gate import (GShardGate, NaiveGate, SwitchGate,
+                   top_k_masks_with_drops)
 
 
 @register("moe_forward", amp="white")
 def _moe_forward_op(x2d, gate_w, w_up, b_up, w_down, b_down, *,
                     topk: int, capacity: int, aux_fn=None, activation="gelu"):
     """x2d: [G, m]; gate_w: [m, E]; w_up: [E, m, h]; w_down: [E, h, m].
-    Returns (y [G, m], aux_loss scalar)."""
+    Returns (y [G, m], aux_loss scalar, dropped fp32 scalar — the count
+    of routing assignments the capacity factor silently refused;
+    round-18 surfaces it instead of letting tokens vanish.  Float so
+    the eager tape's vjp sees a normal-cotangent output)."""
     logits = x2d.astype(jnp.float32) @ gate_w.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
-    combine, dispatch = top_k_masks(probs, topk, capacity)
+    combine, dispatch, dropped = top_k_masks_with_drops(probs, topk,
+                                                       capacity)
     aux = aux_fn(probs) if aux_fn is not None else jnp.asarray(0.0)
     cdt = combine.astype(x2d.dtype)
     ddt = dispatch.astype(x2d.dtype)
@@ -49,7 +55,7 @@ def _moe_forward_op(x2d, gate_w, w_up, b_up, w_down, b_down, *,
         h = jax.nn.silu(a) * b
     eo = jnp.einsum("ech,ehm->ecm", h, w_down) + b_down[:, None, :]
     y = jnp.einsum("gec,ecm->gm", cdt, eo)              # combine alltoall
-    return y, aux
+    return y, aux, lax.stop_gradient(dropped).astype(jnp.float32)
 
 
 @register("moe_dropless_forward", amp="white")
@@ -90,7 +96,8 @@ def _moe_dropless_op(x2d, gate_w, w_up, b_up, w_down, b_down, *,
         + b_down.astype(h.dtype)[sorted_ids]
     wgt = top_p.reshape(-1)[order].astype(x2d.dtype)
     y = jnp.zeros_like(x2d).at[token_of].add(eo * wgt[:, None])
-    return y, aux
+    # dropless by construction: the overflow count is structurally zero
+    return y, aux, jnp.zeros((), jnp.float32)
 
 
 class MoELayer(Layer):
@@ -122,6 +129,7 @@ class MoELayer(Layer):
         self.activation = activation
         self.dropless = dropless
         self.l_aux = None
+        self.tokens_dropped = None
         scale = 1.0 / (d_model ** 0.5)
         import numpy as np
         rng = np.random.RandomState(0)
@@ -160,7 +168,7 @@ class MoELayer(Layer):
         d = shape[-1]
         x2d = x.reshape([-1, d])
         if self.dropless:
-            y, aux = _moe_dropless_op(
+            y, aux, dropped = _moe_dropless_op(
                 x2d, self.gate.weight, self.w_up, self.b_up, self.w_down,
                 self.b_down, topk=self.gate.topk,
                 aux_fn=type(self.gate).aux_loss_fn,
@@ -168,10 +176,14 @@ class MoELayer(Layer):
         else:
             g = x2d.shape[0]
             capacity = self.gate.capacity(g, self.capacity_factor)
-            y, aux = _moe_forward_op(
+            y, aux, dropped = _moe_forward_op(
                 x2d, self.gate.weight, self.w_up, self.b_up, self.w_down,
                 self.b_down, topk=self.gate.topk, capacity=capacity,
                 aux_fn=type(self.gate).aux_loss_fn,
                 activation=self.activation)
         self.l_aux = aux
+        # round-18: capacity overflow surfaced, never silent — the count
+        # of routing assignments refused by the capacity factor this
+        # forward (0 in the dropless formulation by construction)
+        self.tokens_dropped = dropped
         return y.reshape(shape)

@@ -745,7 +745,7 @@ class ContinuousBatchingEngine:
         back); slots the host rewrote since that launch (admissions,
         evictions) take their restart token from the sched upload
         instead."""
-        from ..models.generation import _CFGS, _Weights
+        from ..models.generation import _CFGS, _Weights, _ffn
 
         cfg, _, _ = _CFGS[self_cfg_id]
         w = _Weights(cfg, params)
@@ -824,10 +824,7 @@ class ContinuousBatchingEngine:
                          @ w.layer(i, "self_attn.o_proj.weight"))
                 xm = _rms_norm(x, w.layer(i, "post_attention_layernorm"
                                              ".weight"), cfg.rms_norm_eps)
-                gate = xm @ w.layer(i, "mlp.gate_proj.weight")
-                up = xm @ w.layer(i, "mlp.up_proj.weight")
-                x = x + (jax.nn.silu(gate) * up) @ w.layer(
-                    i, "mlp.down_proj.weight")
+                x = x + _ffn(w, i, xm)
             x = _rms_norm(x, w["model.norm.weight"], cfg.rms_norm_eps)
             logits = w.head(x[:, 0]).astype(jnp.float32)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -991,7 +988,7 @@ class ContinuousBatchingEngine:
         (greedy argmax, temperature, and speculative accept/reject all
         read the same array)."""
         from ..models.generation import (_CFGS, _Weights, _apply_rope,
-                                         _rms_norm)
+                                         _ffn, _rms_norm)
         from ..ops.pallas.decode_attention import ragged_paged_decode_raw
 
         cfg, _, _ = _CFGS[self_cfg_id]
@@ -1047,10 +1044,11 @@ class ContinuousBatchingEngine:
                      @ w.layer(i, "self_attn.o_proj.weight"))
             xm = _rms_norm(x, w.layer(i, "post_attention_layernorm"
                                          ".weight"), cfg.rms_norm_eps)
-            gate = xm @ w.layer(i, "mlp.gate_proj.weight")
-            up = xm @ w.layer(i, "mlp.up_proj.weight")
-            x = x + (jax.nn.silu(gate) * up) @ w.layer(
-                i, "mlp.down_proj.weight")
+            # round-18 sparse serving: the shared FFN entry routes MoE
+            # layers through top-k expert gather-then-dequant (the int8
+            # _Weights expert view), dense layers through SwiGLU — the
+            # unified ragged step serves sparse checkpoints unchanged
+            x = x + _ffn(w, i, xm)
         if not with_head:
             # draft cache-mirror launches only need the K/V scatter side
             # effect: skip the [T, hidden] x [hidden, vocab] head matmul

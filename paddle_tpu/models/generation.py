@@ -89,6 +89,27 @@ class _Weights:
     def layer(self, i, name):
         return self._deq(f"model.layers.{i}.{name}")
 
+    def is_moe_layer(self, i) -> bool:
+        """A layer is MoE iff the checkpoint carries its stacked expert
+        weights (sparse checkpoints may mix dense and MoE layers)."""
+        return f"model.layers.{i}.mlp.experts.gate_proj.weight" in self.p
+
+    def expert(self, i, proj, idx):
+        """Gather-then-dequant expert slices from the stacked
+        ``[E, in, out]`` weight: int8 expert ROWS are gathered by
+        ``idx`` (expert ids) FIRST and dequantized after with their
+        per-(expert, out-channel) scales, so the full fp bank is never
+        materialized — ``_moe_ffn`` passes one expert id at a time,
+        bounding live memory to a single dequantized slice."""
+        name = f"model.layers.{i}.mlp.experts.{proj}.weight"
+        w = self.p[name]
+        rows = jnp.take(w, idx, axis=0)              # [T, in, out]
+        sc = self.p.get(name + "._scale")
+        if sc is None:
+            return rows
+        return rows.astype(self._dt) * jnp.take(
+            sc.astype(self._dt), idx, axis=0)[:, None, :]
+
     def embed(self, ids):
         """Token embedding lookup: gather rows, then dequantize the
         gathered rows only (per-row scales for the [vocab, hidden]
@@ -120,24 +141,35 @@ class _Weights:
         return self._deq(k)
 
 
-def quantize_params_int8(params, keep=("norm", "layernorm")):
+def quantize_params_int8(params, keep=("norm", "layernorm", "router")):
     """Weight-only int8 quantization of a functional_state dict:
     2D floating weights become int8 with a per-output-channel
     (symmetric absmax) fp32 ``<name>._scale`` sibling; 1D weights
-    (norm gains) and anything matching ``keep`` stay in fp.  The
-    embedding matrix is quantized per ROW (its rows are gathered, its
-    transpose is the tied head's [hidden, vocab])."""
+    (norm gains) and anything matching ``keep`` stay in fp (the MoE
+    router is tiny and its logits gate everything — it stays fp like
+    the norms).  The embedding matrix is quantized per ROW (its rows
+    are gathered, its transpose is the tied head's [hidden, vocab]).
+    Stacked ``[E, in, out]`` expert banks quantize per (expert,
+    out-channel) — the ``_Weights.expert`` gather-then-dequant view
+    reads exactly this layout."""
     out = {}
     for name, w in params.items():
         is_embed = name.endswith("embed_tokens.weight")
-        if (w.ndim != 2 or not jnp.issubdtype(w.dtype, jnp.floating)
+        is_expert = ".mlp.experts." in name and w.ndim == 3
+        if ((w.ndim != 2 and not is_expert)
+                or not jnp.issubdtype(w.dtype, jnp.floating)
                 or any(s in name for s in keep)):
             out[name] = w
             continue
-        axis = 1 if is_embed else 0          # reduce over the in-dim
-        absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis)
-        scale = jnp.maximum(absmax, 1e-8) / 127.0
-        den = scale[:, None] if is_embed else scale[None, :]
+        if is_expert:
+            absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1)
+            scale = jnp.maximum(absmax, 1e-8) / 127.0    # [E, out]
+            den = scale[:, None, :]
+        else:
+            axis = 1 if is_embed else 0      # reduce over the in-dim
+            absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis)
+            scale = jnp.maximum(absmax, 1e-8) / 127.0
+            den = scale[:, None] if is_embed else scale[None, :]
         q = jnp.round(w.astype(jnp.float32) / den)
         out[name] = jnp.clip(q, -127, 127).astype(jnp.int8)
         out[name + "._scale"] = scale
@@ -170,6 +202,78 @@ def self_draft_params(cfg, params, num_layers: int):
                 continue
         dparams[k] = v
     return dcfg, dparams
+
+
+def _moe_ffn(w: _Weights, i, xm):
+    """Top-k expert routing for one MoE layer on the ``_Weights`` view
+    (round-18 sparse serving): fp32 router logits -> top-k softmax
+    weights (normalized over the selected experts, the reference
+    ``fused_moe`` semantics) -> per-EXPERT gather-then-dequant of one
+    ``[in, out]`` slice at a time from the stacked int8 bank -> SwiGLU
+    expert FFN, accumulated under the per-token combine weights.
+    Iterating experts (not top-k selections) bounds live memory to ONE
+    dequantized expert slice — a per-selection weight gather would
+    materialize [T, in, out] per projection, which dwarfs the bank
+    itself whenever T*k > E — at the cost of pushing every token
+    through every expert (masked-dense compute, the static-shape
+    idiom; flops scale E/k-fold but the expert bank is read exactly
+    once per call).  ``xm`` is any [..., hidden] batch (the unified
+    step's packed [T, h] rows, a decode chunk's [slots, 1, h],
+    prefill's [b, s, h]); routing is per token row."""
+    cfg = w.cfg
+    shape = xm.shape
+    x2 = xm.reshape(-1, shape[-1])
+    router = w.layer(i, "mlp.router.weight")          # [h, E], fp
+    # E comes from the CHECKPOINT (MoE-ness is checkpoint-driven, via
+    # is_moe_layer) — a cfg.num_experts desync must be loud, not a
+    # silently zeroed expert output
+    e = int(router.shape[-1])
+    bank_e = int(
+        w.p[f"model.layers.{i}.mlp.experts.gate_proj.weight"].shape[0])
+    if bank_e != e:
+        raise ValueError(
+            f"layer {i}: router routes {e} experts but the stacked bank "
+            f"holds {bank_e}")
+    k = int(cfg.moe_top_k)
+    if not 1 <= k <= e:
+        raise ValueError(
+            f"layer {i}: moe_top_k={k} outside [1, {e}] — set "
+            f"LlamaConfig.moe_top_k for this sparse checkpoint")
+    logits = x2.astype(jnp.float32) @ router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_ids = lax.top_k(probs, k)              # [T, k]
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    # per-(token, expert) combine weight: sum of the normalized top-k
+    # weights routed to that expert (0 for unrouted experts)
+    combine = jnp.zeros((x2.shape[0], e), jnp.float32)
+    for j in range(k):
+        combine = combine + top_p[:, j, None] * jax.nn.one_hot(
+            top_ids[:, j], e, dtype=jnp.float32)
+    y = jnp.zeros_like(x2)
+    for eid in range(e):
+        sel = jnp.asarray([eid])
+        wg = w.expert(i, "gate_proj", sel)[0]         # [h, it]
+        wu = w.expert(i, "up_proj", sel)[0]
+        wd = w.expert(i, "down_proj", sel)[0]         # [it, h]
+        gate = x2 @ wg.astype(x2.dtype)
+        up = x2 @ wu.astype(x2.dtype)
+        eo = (jax.nn.silu(gate) * up) @ wd.astype(x2.dtype)
+        y = y + combine[:, eid, None].astype(x2.dtype) * eo
+    return y.reshape(shape)
+
+
+def _ffn(w: _Weights, i, xm):
+    """Layer ``i``'s FFN on the ``_Weights`` view: dense SwiGLU, or —
+    when the checkpoint carries this layer's stacked expert weights —
+    top-k expert routing (``_moe_ffn``).  The ONE implementation the
+    prefill/decode ``_block``, the serving decode chunk and the
+    unified ragged step all share, so a sparse checkpoint serves
+    through every path that serves a dense one."""
+    if w.is_moe_layer(i):
+        return _moe_ffn(w, i, xm)
+    gate = xm @ w.layer(i, "mlp.gate_proj.weight")
+    up = xm @ w.layer(i, "mlp.up_proj.weight")
+    return (jax.nn.silu(gate) * up) @ w.layer(i, "mlp.down_proj.weight")
 
 
 def _block(w: _Weights, i, x, cos, sin, mask, k_all=None, v_all=None,
@@ -233,9 +337,7 @@ def _block(w: _Weights, i, x, cos, sin, mask, k_all=None, v_all=None,
             ctx = ctx.reshape(b, s, h * d).astype(x.dtype)
     x = x + ctx @ w.layer(i, "self_attn.o_proj.weight")
     xm = _rms_norm(x, w.layer(i, "post_attention_layernorm.weight"), eps)
-    gate = xm @ w.layer(i, "mlp.gate_proj.weight")
-    up = xm @ w.layer(i, "mlp.up_proj.weight")
-    x = x + (jax.nn.silu(gate) * up) @ w.layer(i, "mlp.down_proj.weight")
+    x = x + _ffn(w, i, xm)
     return x, k_all, v_all
 
 

@@ -148,18 +148,21 @@ class GPTMoEForCausalLM(Layer):
 def _param_specs(name: str) -> P:
     """PartitionSpec per parameter name — the Megatron/GShard hybrid:
     attention+dense-FFN weights mp-column/row-sharded, expert stacks
-    Shard(0) over ep with expert hidden over mp, embeddings mp-sharded on
-    vocab/hidden, norms replicated."""
-    if ".mlp.w_up" in name:
-        return P("ep", None, "mp")
-    if ".mlp.b_up" in name:
-        return P("ep", "mp")
-    if ".mlp.w_down" in name:
-        return P("ep", "mp", None)
-    if ".mlp.b_down" in name:
-        return P("ep", None)
+    placed by the CANONICAL ep rule (parallel.specs.expert_leaf_spec:
+    leading [E] on ``ep``, expert hidden over mp — the same vocabulary
+    the EP engine and the Sharding Doctor consume), embeddings
+    mp-sharded on vocab/hidden, norms replicated."""
+    from ..parallel.specs import expert_leaf_spec, is_expert_leaf
+
     if ".mlp.gate.weight" in name:
         return P()
+    if is_expert_leaf(name):
+        tails = {".mlp.w_up": P(None, "mp"), ".mlp.b_up": P("mp"),
+                 ".mlp.w_down": P("mp", None), ".mlp.b_down": P(None)}
+        for marker, tail in tails.items():
+            if marker in name:
+                return expert_leaf_spec(tail)
+        return expert_leaf_spec()
     if ".qkv_proj.weight" in name or ".mlp.0.weight" in name:
         return P(None, "mp")  # column parallel
     if ".qkv_proj.bias" in name or ".mlp.0.bias" in name:

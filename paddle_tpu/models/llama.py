@@ -53,6 +53,13 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     dtype: str = "float32"  # param dtype; compute casts via amp
+    # round-18 sparse-serving surface: a checkpoint whose decoder FFNs
+    # are mixtures of experts (stacked ``model.layers.i.mlp.experts.*``
+    # weights + a ``mlp.router.weight`` gate per MoE layer).  The layer
+    # set is checkpoint-driven (a layer is MoE iff its expert stack is
+    # present); these fields size the routing (generation._moe_ffn).
+    num_experts: int = 0
+    moe_top_k: int = 2
 
     @property
     def head_dim(self) -> int:

@@ -380,6 +380,11 @@ def _install():
         # exists); the lifecycle/place/layout surface is installed
         # above with explicit implementations
         "scatter_nd",
+        # ---- round-18 tranche: the movedim/swapdims alias pair,
+        # first-axis msort, and the logdet linalg tail; their in-place
+        # partners (and the axis-movement/elementwise-pair in-place
+        # family) ride inplace_methods below
+        "movedim", "swapdims", "msort", "logdet",
     ]
 
     def mk_top(opname):
@@ -438,6 +443,10 @@ def _install():
         "baddbmm_", "index_reduce_", "bitwise_invert_",
         # round-17 tranche: the binary extremum in-place family
         "maximum_", "minimum_", "fmax_", "fmin_",
+        # round-18 tranche: axis-movement in-place forms (incl. the
+        # alias pair) + the remaining elementwise-pair partners
+        "moveaxis_", "movedim_", "swapaxes_", "swapdims_", "deg2rad_",
+        "rad2deg_", "heaviside_", "nextafter_", "logaddexp_", "conj_",
     ]
     def mk_in(opname):
         def method(self, *args, **kwargs):

@@ -14,6 +14,8 @@ from .sep import ulysses_attention
 from .pipelining import pipeline_apply
 from .overlap import OverlapConfig
 from .codec import CollectiveCodec
+from .expert import (MoEEPConfig, build_moe_ep_train_step,
+                     make_ep_all_to_all)
 from .memory import (JointConfig, MemoryConfig,
                      joint_memory_codec_lattice, tune_memory_config)
 from .reshard import (ReshardPlan, check_reshard_budget, plan_reshard,

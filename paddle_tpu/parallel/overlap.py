@@ -854,6 +854,11 @@ OVERLAP_REGION_FUNCS = frozenset({
     # collectives themselves; the int8 exchanges live in these frames)
     "hier_psum", "_dcn_psum_scatter_coded", "_dcn_all_gather_coded",
     "_coded_sync_bwd",
+    # round-18 expert-parallel entries (parallel/expert.py): the EP
+    # dispatch/combine all-to-alls and their custom_vjp transposes, plus
+    # the region entry whose name the shard_map transpose re-binds
+    "ep_exchange", "_ep_exchange_impl", "_dcn_a2a_coded",
+    "_ep_exchange_fwd", "_ep_exchange_bwd", "moe_ep_body", "moe_ep_entry",
 })
 
 

@@ -30,6 +30,42 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 # ---------------------------------------------------------------------------
+# the expert-parallel axis (round-18: MoE expert parallelism)
+# ---------------------------------------------------------------------------
+
+# canonical name of the expert-parallel mesh axis.  ``ep`` is a WEIGHT
+# axis for expert-stacked leaves (their leading [E] dim shards over it)
+# and a BATCH axis for everything else (tokens ride it into the
+# dispatch all-to-all; shared params replicate over it and their grads
+# reduce over it) — the fourth named tactic of the unified-partitioning
+# vocabulary (dp / sharding / tp / ep), not a fourth hand-coded stack.
+EXPERT_AXIS = "ep"
+
+# name markers of expert-stacked leaves: the MoELayer/gpt_moe stacked
+# parameter names (w_up/b_up/w_down/b_down with a leading [E] dim) and
+# the serving sparse-checkpoint naming (model.layers.*.mlp.experts.*).
+# One predicate shared by the EP engine's plan, the gpt_moe GSPMD plan
+# and the Sharding Doctor's extractor — the single copy of "what is an
+# expert leaf".
+_EXPERT_LEAF_MARKERS = (".experts.", "mlp.w_up", "mlp.b_up",
+                        "mlp.w_down", "mlp.b_down")
+
+
+def is_expert_leaf(name: str) -> bool:
+    """True when ``name`` denotes an expert-stacked leaf (leading [E]
+    dim placed on the ``ep`` axis)."""
+    return any(m in name for m in _EXPERT_LEAF_MARKERS) \
+        or name in ("w_up", "b_up", "w_down", "b_down")
+
+
+def expert_leaf_spec(tail: P = P()) -> P:
+    """THE expert placement rule: the leading [E] dim rides ``ep``, the
+    remaining dims follow ``tail`` (the existing dp/sharding/tp rules —
+    e.g. the expert hidden dim Megatron-sharded over mp)."""
+    return P(EXPERT_AXIS, *tuple(tail))
+
+
+# ---------------------------------------------------------------------------
 # mesh introspection
 # ---------------------------------------------------------------------------
 

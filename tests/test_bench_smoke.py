@@ -78,7 +78,14 @@ def test_bench_smoke_green():
                 # bit-identical to the clean run, spike burst walks the
                 # ladder with bounded rollback replay, flipped coded
                 # payload caught at decode, HEALTH fixtures fire
-                "health_trace"):
+                "health_trace",
+                # round-18: MoE expert parallelism — the EP train step
+                # on the fake-2-slice mesh trains through the coded
+                # dispatch (loss decreases), the dispatch all-to-alls'
+                # DCN bytes shrink >= 3x under the pinned COMM004 wire
+                # budget, and the COMM004[moe_dispatch] fixture fires
+                # exactly
+                "moe_trace"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]
     # the fast-skipped legs must name their tier-1 home (skip with a

@@ -178,6 +178,21 @@ _ROUND17_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND17_TRANCHE
 
+# names added by the round-18 tranche (the MoE-EP round's satellite):
+# the axis-movement alias pair (movedim==moveaxis, swapdims==swapaxes)
+# with the whole family's in-place forms, first-axis msort, the logdet
+# linalg tail, and the remaining elementwise-pair in-place partners
+# whose bases shipped in earlier rounds — appended into
+# _REQUIRED_METHODS AND counted against the ~12 floor by
+# test_method_count_tranche_round18
+_ROUND18_TRANCHE = [
+    "movedim", "swapdims", "msort", "logdet",
+    "moveaxis_", "movedim_", "swapaxes_", "swapdims_",
+    "deg2rad_", "rad2deg_", "heaviside_", "nextafter_", "logaddexp_",
+    "conj_",
+]
+_REQUIRED_METHODS += _ROUND18_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -669,3 +684,46 @@ def test_round17_method_values():
     z = paddle.to_tensor(np.array([np.nan, 1.0], np.float32))
     z.fmin_(paddle.to_tensor(np.array([2.0, 0.5], np.float32)))
     np.testing.assert_allclose(np.asarray(z._value), [2.0, 0.5])
+
+
+def test_method_count_tranche_round18():
+    """The round-18 tranche satisfies the ~12-new-names floor (ISSUE 14
+    satellite) over the round-17 surface."""
+    wired = [n for n in _ROUND18_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 12, (len(wired),
+                              sorted(set(_ROUND18_TRANCHE) - set(wired)))
+
+
+def test_round18_method_values():
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    # movedim/swapdims are exact aliases of moveaxis/swapaxes
+    np.testing.assert_array_equal(
+        np.asarray(m.movedim(0, 1)._value),
+        np.asarray(m.moveaxis(0, 1)._value))
+    np.testing.assert_array_equal(
+        np.asarray(m.swapdims(0, 1)._value),
+        np.moveaxis(np.arange(6, dtype=np.float32).reshape(2, 3), 0, 1))
+    s = paddle.to_tensor(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(s.msort()._value),
+                               [[2.0, 1.0], [3.0, 4.0]])
+    d = paddle.to_tensor(np.array([[2.0, 0.0], [0.0, 4.0]], np.float32))
+    np.testing.assert_allclose(float(d.logdet()._value), np.log(8.0),
+                               rtol=1e-6)
+    neg = paddle.to_tensor(np.array([[-1.0, 0.0], [0.0, 1.0]],
+                                    np.float32))
+    assert np.isnan(float(neg.logdet()._value))
+    # in-place axis movement mutates and returns self
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    r = t.swapaxes_(0, 1)
+    assert r is t
+    assert tuple(np.asarray(t._value).shape) == (3, 2)
+    a = paddle.to_tensor(np.array([180.0, 90.0], np.float32))
+    r = a.deg2rad_()
+    assert r is a
+    np.testing.assert_allclose(np.asarray(a._value),
+                               [np.pi, np.pi / 2], rtol=1e-6)
+    b = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+    b.logaddexp_(paddle.to_tensor(np.array([0.0, 1.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(b._value),
+                               np.logaddexp([0.0, 1.0], [0.0, 1.0]),
+                               rtol=1e-6)
