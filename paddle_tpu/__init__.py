@@ -153,6 +153,9 @@ from .frontend_compat import (  # noqa: F401
     view, view_as, vsplit, vstack,
     # round-18 tranche: axis-movement aliases + msort/logdet
     logdet, movedim, msort, swapdims,
+    # round-19 tranche: special-pair tail + manipulation bases
+    argwhere, fliplr, flipud, float_power, logaddexp2, mvlgamma, narrow,
+    ravel, take_along_dim, true_divide, xlogy,
 )
 
 # registry-only ops that the reference exposes at top level

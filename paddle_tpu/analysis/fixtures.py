@@ -737,6 +737,36 @@ def seeded_unsharded_update() -> Report:
                            "update_min_bytes": 1 << 10}})
 
 
+def seeded_schedule_divergence() -> Report:
+    """SCHED001: a hand-written stack table whose q_proj placement is
+    TRANSPOSED against the unified schedule's derivation — the
+    byte-identity gate of the round-19 unified-partitioning refactor
+    (deriving three stacks from one schedule object is only safe while
+    the derivation moves NO placement)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..parallel.schedule import PartitionSchedule
+    from ..parallel.specs import SpecLayout, TensorSpec
+    from .sharding import check_schedule_derivation
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise FixtureUnavailable("needs >= 4 devices")
+    mesh = Mesh(np.asarray(devs[:4], dtype=object).reshape(2, 2),
+                ("sharding", "mp"))
+    key = "model.layers.*.self_attn.q_proj.weight"
+    sched = PartitionSchedule.from_plan(
+        mesh, {key: (64, 64)}, lambda n: P("sharding", "mp"))
+    hand = SpecLayout(
+        mesh_axes=(("sharding", 2), ("mp", 2)),
+        entries={key: TensorSpec(shape=(64, 64), dtype="float32",
+                                 dim_axes=(("mp",), ("sharding",)))})
+    return check_schedule_derivation(sched, {"gspmd": hand},
+                                     exemptions=(),
+                                     target="seeded:SCHED001")
+
+
 SEEDED = {
     "COLL001": seeded_collective_order,
     "COLL002": seeded_ppermute_race,
@@ -786,6 +816,11 @@ SEEDED = {
     "SHARD003": seeded_cross_stack_divergence,
     "SHARD004": seeded_shard_padding,
     "SHARD005": seeded_unsharded_update,
+    # round-19: the unified partitioning schedule's byte-identity gate —
+    # a derivation that moves any placement against the hand-written
+    # stack tables must fire, or deriving three stacks from one
+    # schedule object is unverified
+    "SCHED001": seeded_schedule_divergence,
 }
 
 

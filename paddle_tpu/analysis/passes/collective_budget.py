@@ -191,16 +191,14 @@ def _wire_stage(eqn, axes, dcn_axes) -> str:
     return "ici"
 
 
-def collect_wire_table(jaxpr, dcn_axes: Dict) -> Dict[str, Dict]:
-    """Post-codec bytes-on-the-wire per (stage, collective kind) from a
-    jaxpr's MANUAL (shard_map) collectives.  ``dcn_axes`` maps axis
-    name -> slice index per axis position (the fake-2-slice test shape
-    and topology.axis_slice_map's output).  Scan-nested collectives
-    multiply by their trip counts.  Bytes follow the payload's ACTUAL
-    dtype — the whole point: an int8 packed payload prices at 1
-    byte/element."""
-    table = {s: {"count": 0, "bytes": 0, "kinds": {}}
-             for s in ("ici", "dcn")}
+def priced_manual_collectives(jaxpr, dcn_axes: Dict):
+    """The single copy of the manual-collective pricing walk: yield
+    ``(kind, axes, stage, cost, mult)`` per shard_map collective —
+    ring-model bytes on the payload's ACTUAL dtype, multiplied by
+    enclosing scan trip counts, staged ICI/DCN against the per-axis
+    slice maps.  ``collect_wire_table`` (COMM004's per-stage tally) and
+    ``collect_wire_by_axis`` (the schedule trace's per-tactic
+    attribution) both consume this, so the cost model cannot fork."""
     for eqn, stack in walk_eqns(jaxpr):
         kind = _WIRE_PRIMS.get(eqn.primitive.name)
         if kind is None:
@@ -218,13 +216,47 @@ def collect_wire_table(jaxpr, dcn_axes: Dict) -> Dict[str, Dict]:
             if e.primitive.name == "scan":
                 mult *= int(e.params.get("length", 1) or 1)
         cost = _ring_wire_cost(kind, _eqn_in_bytes(eqn), g) * mult
-        stage = table[_wire_stage(eqn, axes, dcn_axes or {})]
+        yield kind, axes, _wire_stage(eqn, axes, dcn_axes or {}), \
+            cost, mult
+
+
+def collect_wire_table(jaxpr, dcn_axes: Dict) -> Dict[str, Dict]:
+    """Post-codec bytes-on-the-wire per (stage, collective kind) from a
+    jaxpr's MANUAL (shard_map) collectives.  ``dcn_axes`` maps axis
+    name -> slice index per axis position (the fake-2-slice test shape
+    and topology.axis_slice_map's output).  Scan-nested collectives
+    multiply by their trip counts.  Bytes follow the payload's ACTUAL
+    dtype — the whole point: an int8 packed payload prices at 1
+    byte/element."""
+    table = {s: {"count": 0, "bytes": 0, "kinds": {}}
+             for s in ("ici", "dcn")}
+    for kind, _axes, stage_name, cost, mult in \
+            priced_manual_collectives(jaxpr, dcn_axes):
+        stage = table[stage_name]
         stage["count"] += mult
         stage["bytes"] += cost
         ent = stage["kinds"].setdefault(kind, {"count": 0, "bytes": 0})
         ent["count"] += mult
         ent["bytes"] += cost
     return table
+
+
+def collect_wire_by_axis(jaxpr, dcn_axes: Dict) -> Dict[str, Dict]:
+    """The same priced walk keyed by the collective's AXIS TUPLE
+    (``"sharding"``, ``"dp+sharding"``, ...) — a multi-axis collective
+    is ONE entry under its joint key, so the per-axis table sums to the
+    per-stage table exactly (no double counting).  The schedule trace
+    maps the keys onto named tactics."""
+    out: Dict[str, Dict] = {}
+    for kind, axes, stage, cost, mult in \
+            priced_manual_collectives(jaxpr, dcn_axes):
+        key = "+".join(str(a) for a in axes)
+        ent = out.setdefault(key, {"ici_bytes": 0, "dcn_bytes": 0,
+                                   "count": 0, "kinds": {}})
+        ent[stage + "_bytes"] += cost
+        ent["count"] += mult
+        ent["kinds"][kind] = ent["kinds"].get(kind, 0) + mult
+    return out
 
 
 def _overlap_region_funcs(extra=()) -> frozenset:

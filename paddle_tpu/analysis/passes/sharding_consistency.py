@@ -208,7 +208,12 @@ def cross_stack_findings(layouts: Dict[str, object]) -> List[Finding]:
 @register_pass
 class ShardingConsistencyPass(AnalysisPass):
     name = "sharding_consistency"
-    codes = ("SHARD001", "SHARD002", "SHARD003", "SHARD004", "SHARD005")
+    # SCHED001 (round-19) is table-level only: the unified
+    # PartitionSchedule's derivations vs the hand-written stacks'
+    # extracted tables (analysis/sharding.check_schedule_derivation) —
+    # byte-identity is the refactor's acceptance gate
+    codes = ("SHARD001", "SHARD002", "SHARD003", "SHARD004", "SHARD005",
+             "SCHED001")
     # SHARD001 compiles, but only when the entry opts into the reshard
     # audit — table/jaxpr checks stay cheap (COMM-pass convention)
     requires = "jaxpr"

@@ -25,22 +25,17 @@ exposes the full stacked spec for callers that place state.
 
 from __future__ import annotations
 
-import re
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
+from ..parallel.schedule import canonical_key  # noqa: F401 (re-export —
+# the layer-collapse rule moved to the schedule layer in round 19; the
+# extractor and the schedule must key tables identically)
 from ..parallel.specs import (SpecLayout, TensorSpec, layout_from_arrays,
                               layout_mesh_axes, spec_to_dim_axes)
 from .exemptions import apply_exemptions
-from .findings import Report
+from .findings import Finding, Report
 
-_LAYER_RE = re.compile(r"^(model\.layers\.)(\d+)\.")
 _PASS = "sharding_consistency"
-
-
-def canonical_key(name: str) -> str:
-    """Collapse the layer index: ``model.layers.<i>.X`` ->
-    ``model.layers.*.X``."""
-    return _LAYER_RE.sub(r"\g<1>*.", name)
 
 
 def collapse_layers(layout: SpecLayout) -> SpecLayout:
@@ -215,6 +210,112 @@ def check_cross_stack(layouts: Dict[str, SpecLayout], *, exemptions=None,
     from .passes.sharding_consistency import cross_stack_findings
 
     findings = cross_stack_findings(layouts)
+    active, suppressed = apply_exemptions(findings, exemptions)
+    return Report(target=target, findings=active, suppressed=suppressed,
+                  passes_run=(_PASS,))
+
+
+# ---------------------------------------------------------------------------
+# round-19: the SCHED doctor entry — the unified PartitionSchedule's
+# derivations must be BYTE-IDENTICAL to the hand-written stacks' tables
+# (the acceptance gate of the unified-partitioning refactor: deriving
+# from one schedule object must not move a single placement)
+# ---------------------------------------------------------------------------
+
+
+def schedule_divergence_findings(schedule, layouts: Dict[str, SpecLayout]
+                                 ) -> List[Finding]:
+    """SCHED001: the schedule-derived canonical table differs from a
+    hand-written stack's extracted table — EXACT comparison (key set +
+    TensorSpec equality), stronger than SHARD003's shared-axis
+    restriction: a derivation that moves any placement is a broken
+    derivation, not a tolerable divergence."""
+    findings = []
+    st = schedule.table
+    for stack, lo in sorted(layouts.items()):
+        only_sched = sorted(set(st.entries) - set(lo.entries))
+        only_stack = sorted(set(lo.entries) - set(st.entries))
+        for name in only_sched:
+            findings.append(Finding(
+                code="SCHED001", pass_name=_PASS, severity="error",
+                message=f"{name}: in the schedule's table but absent "
+                        f"from stack '{stack}' — the derivation and "
+                        f"the hand-written table disagree on the "
+                        f"tensor set", arg_path=name,
+                data={"tensor": name, "stack": stack,
+                      "kind": "missing_in_stack"}))
+        for name in only_stack:
+            findings.append(Finding(
+                code="SCHED001", pass_name=_PASS, severity="error",
+                message=f"{name}: stack '{stack}' places a tensor the "
+                        f"schedule does not know — the canonical table "
+                        f"is incomplete", arg_path=name,
+                data={"tensor": name, "stack": stack,
+                      "kind": "missing_in_schedule"}))
+        for name in sorted(set(st.entries) & set(lo.entries)):
+            a, b = st[name], lo[name]
+            if a == b:
+                continue
+            findings.append(Finding(
+                code="SCHED001", pass_name=_PASS, severity="error",
+                message=f"{name}: schedule derives "
+                        f"({a.describe()}) but stack '{stack}' "
+                        f"hand-writes ({b.describe()}) — the unified "
+                        f"derivation moved a placement; byte-identity "
+                        f"is the refactor's acceptance gate",
+                arg_path=name,
+                data={"tensor": name, "stack": stack,
+                      "schedule": a.describe(), "stack_spec": b.describe()}))
+    return findings
+
+
+def check_schedule_derivation(schedule, layouts: Dict[str, SpecLayout],
+                              *, exemptions=None,
+                              target: str = "schedule_derivation"
+                              ) -> Report:
+    """SCHED001 over the schedule vs one or more extracted stack
+    tables (Report form, the check_cross_stack convention)."""
+    findings = schedule_divergence_findings(schedule, layouts)
+    active, suppressed = apply_exemptions(findings, exemptions)
+    return Report(target=target, findings=active, suppressed=suppressed,
+                  passes_run=(_PASS,))
+
+
+def check_stack_plan_derivation(schedule, model, mesh, oc=None,
+                                *, exemptions=None,
+                                target: str = "schedule_stack_plan"
+                                ) -> Report:
+    """SCHED001 over the OVERLAP derivation: the schedule's
+    ``stack_plan`` (leaf layout, bucket plan, sync leaves) must be
+    byte-identical to the hand path (``overlap.stack_layout_plan``
+    seeded from the model's own spec rule)."""
+    from ..models.llama import _filter_spec_to_mesh, plan_spec_for
+    from ..parallel import overlap as _ov
+
+    oc = oc if oc is not None else _ov.OverlapConfig()
+    shapes = _ov.llama_layer_shapes(model.cfg)
+    layout, buckets, sync = _ov.stack_layout_plan(
+        shapes, mesh,
+        lambda sfx: _filter_spec_to_mesh(plan_spec_for(sfx), mesh), oc)
+    plan = schedule.stack_plan(oc, shapes=shapes)
+    findings = []
+    if (plan.layout, plan.buckets, plan.sync_suffixes) \
+            != (layout, buckets, sync):
+        diffs = []
+        if plan.layout != layout:
+            moved = [s for s in sorted(shapes)
+                     if plan.layout.get(s) != layout.get(s)]
+            diffs.append(f"leaf placements differ on {moved}")
+        if plan.buckets != buckets:
+            diffs.append(f"bucket plan {plan.buckets} vs {buckets}")
+        if plan.sync_suffixes != sync:
+            diffs.append(f"sync leaves {plan.sync_suffixes} vs {sync}")
+        findings.append(Finding(
+            code="SCHED001", pass_name=_PASS, severity="error",
+            message="schedule.stack_plan diverges from the overlap "
+                    "engine's hand-written stack_layout_plan: "
+                    + "; ".join(diffs),
+            data={"diffs": diffs}))
     active, suppressed = apply_exemptions(findings, exemptions)
     return Report(target=target, findings=active, suppressed=suppressed,
                   passes_run=(_PASS,))

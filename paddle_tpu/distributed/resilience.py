@@ -261,6 +261,20 @@ class ResilienceResult:
     health: Optional[Dict[str, Any]] = None   # monitor.report() when armed
 
 
+def _planner_specs(specs):
+    """The reshard-planner view of ``mesh_builder``'s second return:
+    the classic dotted-path -> PartitionSpec dict passes through; a
+    ``parallel.schedule.PartitionSchedule`` (round-19) exposes its
+    per-leaf at-rest rule as the planner callable — so after an
+    elastic shrink/grow the loop re-derives the WHOLE schedule from
+    the new mesh (``step_builder`` receives the schedule itself and
+    derives bucket plans / prefetch windows / ring order from it, not
+    just the GSPMD specs)."""
+    if hasattr(specs, "reshard_spec"):
+        return specs.reshard_spec
+    return specs
+
+
 def resilient_train_loop(*, mesh_builder: Callable,
                          init_fn: Callable,
                          step_builder: Callable,
@@ -274,9 +288,12 @@ def resilient_train_loop(*, mesh_builder: Callable,
 
     - ``mesh_builder(devices) -> (mesh, specs)``: derive the mesh and
       the per-leaf at-rest PartitionSpecs (reshard-planner form: dotted
-      path → P) from whatever devices the fleet currently has — called
-      once at start and again after every recovery (the "re-derive
-      mesh" stage; an elastic shrink/grow changes its input).
+      path → P, or — round-19 — a ``PartitionSchedule``, from which the
+      loop reads the planner rule and ``step_builder`` derives the
+      whole stack schedule) from whatever devices the fleet currently
+      has — called once at start and again after every recovery (the
+      "re-derive mesh" stage; an elastic shrink/grow changes its
+      input).
     - ``init_fn(mesh, specs) -> state``: fresh state placed per specs.
     - ``step_builder(mesh, specs) -> step_fn(state, batch) ->
       (loss, new_state)``: the compiled step for THIS mesh.
@@ -393,7 +410,8 @@ def resilient_train_loop(*, mesh_builder: Callable,
 
 def _restore_or_init(mgr, mesh, specs, init_fn, config):
     state, ck_step, degraded = mgr.restore_latest(
-        mesh, specs, max_transient_bytes=config.max_transient_bytes)
+        mesh, _planner_specs(specs),
+        max_transient_bytes=config.max_transient_bytes)
     if state is None:
         return init_fn(mesh, specs), 0, degraded
     return state, ck_step, degraded
@@ -455,13 +473,13 @@ def _recover(fault, step, state, mesh, specs, cluster, mgr, elastic,
         # autoscale will reuse exactly this path for weight delivery
         from ..parallel.reshard import plan_reshard
 
-        plan = plan_reshard(state, new_mesh, new_specs,
+        plan = plan_reshard(state, new_mesh, _planner_specs(new_specs),
                             max_transient_bytes=config.max_transient_bytes)
         state, resume_step = plan.execute(state), step
         reshard_bytes = plan.moved_bytes
     else:
         state, resume_step, degraded = mgr.restore_latest(
-            new_mesh, new_specs,
+            new_mesh, _planner_specs(new_specs),
             max_transient_bytes=config.max_transient_bytes)
         if state is None:
             logger.warning("[resilience] no restorable checkpoint; "

@@ -95,6 +95,11 @@ _INPLACE_BASES = [
     # partners whose bases shipped in earlier rounds
     "moveaxis", "movedim", "swapaxes", "swapdims", "deg2rad", "rad2deg",
     "heaviside", "nextafter", "logaddexp", "conj",
+    # round-19 tranche: the special-pair tail (xlogy/logaddexp2/
+    # float_power/mvlgamma) and the in-place partners of long-shipped
+    # bases (sign, true_divide)
+    "xlogy", "logaddexp2", "float_power", "mvlgamma", "sign",
+    "true_divide",
 ]
 
 
@@ -160,6 +165,97 @@ def logdet(x):
     real-dtype reference)."""
     sign, ld = jnp.linalg.slogdet(_val(x))
     return _wrap(jnp.where(sign > 0, ld, jnp.nan).astype(ld.dtype))
+
+
+# ---- round-19 tranche: special-pair tail + manipulation method bases ----
+
+
+def xlogy(x, y):
+    """x * log(y) with the 0 * log(0) = 0 convention (reference
+    paddle.xlogy)."""
+    from jax.scipy.special import xlogy as _xlogy
+
+    return _wrap(_xlogy(_val(x), _val(y)))
+
+
+def logaddexp2(x, y):
+    """log2(2**x + 2**y) (reference paddle.logaddexp2)."""
+    return _wrap(jnp.logaddexp2(_val(x), _val(y)))
+
+
+def float_power(x, y):
+    """Elementwise power computed in fp64-free float promotion
+    (reference float_power promotes to the default float dtype; here
+    the widest non-x64 float, fp32)."""
+    xv, yv = _val(x), _val(y)
+    return _wrap(jnp.power(xv.astype(jnp.float32),
+                           jnp.asarray(yv).astype(jnp.float32)))
+
+
+def mvlgamma(x, p=1):
+    """Multivariate log-gamma of order ``p`` (reference
+    paddle.mvlgamma): multigammaln over the trailing elementwise
+    values."""
+    from jax.scipy.special import multigammaln
+
+    return _wrap(multigammaln(_val(x), int(p)))
+
+
+def true_divide(x, y):
+    """Alias of ``divide`` (always-float division; reference exposes
+    both names)."""
+    import paddle_tpu as _p
+
+    return _p.divide(x, y)
+
+
+def ravel(x):
+    """Contiguous 1-D view (alias of flatten; reference exposes both)."""
+    return _wrap(jnp.ravel(_val(x)))
+
+
+def narrow(x, axis, start, length):
+    """Length-``length`` slice of ``x`` along ``axis`` starting at
+    ``start`` (reference paddle.narrow / torch.narrow semantics;
+    negative ``start`` counts from the end)."""
+    import jax.lax as _lax
+
+    v = _val(x)
+    axis = int(axis)
+    start = int(start)
+    if start < 0:
+        start += v.shape[axis]
+    return _wrap(_lax.slice_in_dim(v, start, start + int(length),
+                                   axis=axis))
+
+
+def fliplr(x):
+    """Flip along axis 1 (the reference requires ndim >= 2, like
+    numpy)."""
+    return _wrap(jnp.fliplr(_val(x)))
+
+
+def flipud(x):
+    """Flip along axis 0."""
+    return _wrap(jnp.flipud(_val(x)))
+
+
+def take_along_dim(x, indices, dim=None):
+    """Alias of ``take_along_axis`` under the torch-flavoured name the
+    reference also exposes; ``dim=None`` gathers from the flattened
+    input."""
+    v, iv = _val(x), _val(indices)
+    if dim is None:
+        return _wrap(jnp.take(v.reshape(-1), iv.reshape(-1).astype(
+            jnp.int32), mode="clip"))
+    return _wrap(jnp.take_along_axis(v, iv.astype(jnp.int32), int(dim)))
+
+
+def argwhere(x):
+    """Coordinates of nonzero elements, [n, ndim] (reference
+    paddle.argwhere == nonzero(as_tuple=False); host-sync like
+    nonzero — data-dependent shapes cannot trace)."""
+    return _wrap(jnp.asarray(np.argwhere(np.asarray(_val(x)))))
 
 
 def broadcast_shape(x_shape, y_shape):

@@ -156,10 +156,10 @@ def build_bert_train_step(model: BertForSequenceClassification, optimizer,
 
     batch_sharding = None
     if mesh is not None:
-        axes = tuple(a for a in data_axes
-                     if a in mesh.axis_names and mesh.shape[a] > 1)
+        from ..parallel.specs import batch_partition_spec
+
         batch_sharding = NamedSharding(
-            mesh, P(axes if len(axes) > 1 else (axes[0] if axes else None)))
+            mesh, batch_partition_spec(mesh, data_axes))
 
     def loss_fn(params, input_ids, labels, attention_mask, rng_key):
         gen = _random.default_generator()

@@ -178,10 +178,10 @@ def apply_gpt_moe_sharding(model: GPTMoEForCausalLM, mesh: Mesh) -> None:
     """Place every parameter per the dp×ep×mp plan (GSPMD propagates the
     activation layouts; the moe_forward einsums then lower to ep-axis
     alltoalls, the qkv/out matmuls to mp-axis collectives)."""
+    from ..parallel.specs import filter_spec_to_mesh
+
     for name, p_ in model.named_parameters():
-        spec = _param_specs(name)
-        spec = P(*[ax if (ax is None or ax in mesh.axis_names) else None
-                   for ax in spec])
+        spec = filter_spec_to_mesh(_param_specs(name), mesh)
         p_.set_value(jax.device_put(p_._value, NamedSharding(mesh, spec)))
 
 
@@ -198,9 +198,11 @@ def build_moe_train_step(model: GPTMoEForCausalLM, optimizer,
     cfg = model.cfg
     batch_sharding = None
     if mesh is not None:
-        axes = tuple(a for a in data_axes if a in mesh.axis_names)
-        if axes:
-            batch_sharding = NamedSharding(mesh, P(axes))
+        from ..parallel.specs import batch_partition_spec
+
+        spec = batch_partition_spec(mesh, data_axes)
+        if tuple(spec) != (None,):
+            batch_sharding = NamedSharding(mesh, spec)
 
     def loss_fn(params, input_ids, labels):
         cast = {k: (v.astype(compute_dtype)

@@ -115,24 +115,15 @@ def hybrid_param_spec(name: str, shape: Tuple[int, ...], mesh: Mesh,
     """At-rest PartitionSpec of ONE hybrid-state leaf — the placement
     rule of ``shard_hybrid_state``, exposed as a pure shape-level hook
     so the Sharding Doctor's extractor can read this stack's canonical
-    layout without materializing state.  Stacked leaves
-    (``model.layers.<suffix>``, leading [L] dim) ride P('pp',
-    *plan-dims); non-layer leaves get their plan spec directly
-    (replicated over pp/sep).  Non-divisible dims fall back to
-    replication via the shared rule (parallel.specs)."""
-    from ..parallel.specs import filter_divisible_spec
+    layout without materializing state.  Since round 19 the rule
+    itself lives in the schedule layer
+    (``parallel.schedule.hybrid_leaf_spec`` — the pp tactic's stacking
+    rule, shared with ``PartitionSchedule.hybrid_spec``); this hook
+    only binds the llama plan."""
+    from ..parallel.schedule import hybrid_leaf_spec
 
-    stacked = name.startswith(_LAYER_PREFIX)
-    inner = tuple(shape[1:]) if stacked else tuple(shape)
-    spec = filter_divisible_spec(plan_spec_for(name, plan), inner, mesh)
-    if not stacked:
-        return spec
-    if shape[0] % mesh.shape["pp"]:
-        raise ValueError(
-            f"{name}: {shape[0]} layers not divisible by pp degree "
-            f"{mesh.shape['pp']}")
-    lead = "pp" if mesh.shape["pp"] > 1 else None
-    return P(lead, *tuple(spec))
+    return hybrid_leaf_spec(name, shape, mesh,
+                            lambda n: plan_spec_for(n, plan))
 
 
 def shard_hybrid_state(hstate: Dict[str, Any], mesh: Mesh,
@@ -536,10 +527,8 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
 
     dpd = mesh.shape["dp"]
     dp_entry = "dp" if dpd > 1 else None
-    chunk_specs = {
-        sfx: P("pp", None,
-               *tuple(_ov.leaf_partition_spec(layout[sfx]))[1:])
-        for sfx in suffix_order}
+    chunk_specs = {sfx: _ov.chunk_leaf_spec(layout[sfx])
+                   for sfx in suffix_order}
 
     def pipeline_body_sched(chunked, x, y, cos, sin, head_params):
         """chunked leaves arrive [v, L/(pp*v), *zero3/tp-local] per rank
@@ -633,8 +622,11 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         # for the SPMD partitioner on hybrid meshes
         x = jnp.take(outer["model.embed_tokens.weight"], ids, axis=0,
                      mode="clip")
+        from ..parallel.specs import microbatched
+
         x = lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(None, batch_entry, sep_entry, None)))
+            x, NamedSharding(mesh,
+                             microbatched(batch_entry, sep_entry, None)))
         cos = cos_full[:S].astype(compute_dtype)
         sin = sin_full[:S].astype(compute_dtype)
         h = shmap(jax.tree_util.tree_map(_wire_in, stacked), _wire_in(x),
@@ -646,7 +638,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         else:
             logits = h @ outer["lm_head.weight"]
         logits = lax.with_sharding_constraint(
-            logits, NamedSharding(mesh, P(None, batch_entry)))
+            logits, NamedSharding(mesh, microbatched(batch_entry)))
         lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32),
                                           axis=-1)
         ylb = labels.reshape(m, mb, S)
@@ -656,7 +648,7 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             # without it GSPMD mixes the lse/gold operand shardings and
             # falls back to involuntary full rematerialization on the add
             nll = lax.with_sharding_constraint(
-                nll, NamedSharding(mesh, P(None, batch_entry)))
+                nll, NamedSharding(mesh, microbatched(batch_entry)))
         return nll.mean()
 
     grad_fn = jax.value_and_grad(loss_fn)
@@ -700,7 +692,10 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         outer_batch = (batch_axes if len(batch_axes) > 1
                        else (batch_axes[0] if batch_axes else None))
         if outer_batch is not None or sep_entry is not None:
-            bs = NamedSharding(mesh, P(outer_batch, sep_entry))
+            from ..parallel.specs import token_batch_spec
+
+            bs = NamedSharding(mesh, token_batch_spec(outer_batch,
+                                                      sep_entry))
             input_ids = lax.with_sharding_constraint(input_ids, bs)
             labels = lax.with_sharding_constraint(labels, bs)
         loss, grads = grad_fn(params, input_ids, labels)
@@ -715,7 +710,10 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
         if sep_entry is not None or dp_entry is not None:
             # batch splits over MANUAL dp (and sep); 'sharding' stays a
             # weights-only (FSDP-at-rest) axis on this path
-            bs = NamedSharding(mesh, P(dp_entry, sep_entry))
+            from ..parallel.specs import token_batch_spec
+
+            bs = NamedSharding(mesh, token_batch_spec(dp_entry,
+                                                      sep_entry))
             input_ids = lax.with_sharding_constraint(input_ids, bs)
             labels = lax.with_sharding_constraint(labels, bs)
         cast = _cast(params)
@@ -732,8 +730,11 @@ def build_hybrid_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh,
             return jnp.take(w, ids, axis=0, mode="clip")
 
         x, embed_vjp = jax.vjp(embed_fn, outer["model.embed_tokens.weight"])
+        from ..parallel.specs import microbatched
+
         x = lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(None, dp_entry, sep_entry, None)))
+            x, NamedSharding(mesh,
+                             microbatched(dp_entry, sep_entry, None)))
         cos = cos_full[:S].astype(compute_dtype)
         sin = sin_full[:S].astype(compute_dtype)
         nstage = pp * sched.v

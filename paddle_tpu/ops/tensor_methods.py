@@ -385,6 +385,12 @@ def _install():
         # partners (and the axis-movement/elementwise-pair in-place
         # family) ride inplace_methods below
         "movedim", "swapdims", "msort", "logdet",
+        # ---- round-19 tranche: the special-pair tail (xlogy /
+        # logaddexp2 / float_power / mvlgamma), the manipulation bases
+        # (ravel / narrow / fliplr / flipud / take_along_dim /
+        # argwhere); in-place partners ride inplace_methods below
+        "xlogy", "logaddexp2", "float_power", "mvlgamma", "ravel",
+        "narrow", "fliplr", "flipud", "take_along_dim", "argwhere",
     ]
 
     def mk_top(opname):
@@ -447,6 +453,10 @@ def _install():
         # alias pair) + the remaining elementwise-pair partners
         "moveaxis_", "movedim_", "swapaxes_", "swapdims_", "deg2rad_",
         "rad2deg_", "heaviside_", "nextafter_", "logaddexp_", "conj_",
+        # round-19 tranche: special-pair in-place partners + the
+        # long-shipped bases' missing in-place forms
+        "xlogy_", "logaddexp2_", "float_power_", "mvlgamma_", "sign_",
+        "true_divide_",
     ]
     def mk_in(opname):
         def method(self, *args, **kwargs):

@@ -323,10 +323,27 @@ class Adam(Optimizer):
     # stay on the per-param path — ``apply_flat`` rejects those configs
     # loudly instead of silently diverging.
 
-    def _flat_groups(self, params, decay_mask=None):
+    def _flat_groups(self, params, decay_mask=None, flat_layout=None):
         """Deterministic float-param grouping: list of dicts with keys
         ``name/keys/shapes/sizes/dtype/decay`` (sorted, so init and
-        every subsequent apply agree)."""
+        every subsequent apply agree).
+
+        ``flat_layout`` (a ``parallel.schedule.FlatUpdateLayout``)
+        switches a group to the schedule-derived SHARD-MAJOR wire
+        format when every leaf in it decomposes: the group gains
+        ``layout``/``plans`` entries and its NAME carries the layout
+        signature — the element order of the flat buffers is part of
+        the state's pytree identity, so a layout mismatch fails on
+        structure, never silently misorders the master.  A group with
+        any non-decomposable leaf stays row-major (mixed orders inside
+        one buffer would be a bug, not a layout)."""
+        # a layout with no parallel axes has nothing to cut (its element
+        # order IS row-major): ignore it, so states built against an
+        # all-size-1 mesh keep the legacy naming and match a step that
+        # dropped the layout for the same reason
+        if flat_layout is not None and not getattr(flat_layout, "axes",
+                                                   ()):
+            flat_layout = None
         by_group: Dict[Any, List[str]] = {}
         for k in sorted(params):
             v = params[k]
@@ -340,27 +357,70 @@ class Adam(Optimizer):
         for (decay, dt), keys in sorted(by_group.items()):
             shapes = [tuple(jnp.asarray(params[k]).shape) for k in keys]
             sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-            out.append({"name": ("decay" if decay else "nodecay") + "|" + dt,
-                        "keys": keys, "shapes": shapes, "sizes": sizes,
-                        "dtype": dt, "decay": decay})
+            g = {"name": ("decay" if decay else "nodecay") + "|" + dt,
+                 "keys": keys, "shapes": shapes, "sizes": sizes,
+                 "dtype": dt, "decay": decay}
+            if flat_layout is not None:
+                plans = {k: flat_layout.leaf_plan(k, s)
+                         for k, s in zip(keys, shapes)}
+                if keys and all(p is not None for p in plans.values()):
+                    g["name"] += "|" + flat_layout.signature
+                    g["layout"] = flat_layout
+                    g["plans"] = plans
+            out.append(g)
         return out
 
-    def init_flat_state(self, params, decay_mask=None, master_from=None):
+    def _match_flat_groups(self, params, state, decay_mask, flat_layout):
+        """Groups whose names match the STATE's keys: try the
+        schedule-derived shard-major naming first, fall back to the
+        legacy row-major naming (states built without a layout keep
+        working through a schedule-built step), and fail loudly on
+        anything else — a state whose wire format cannot be identified
+        must never reach the elementwise update."""
+        candidates = [flat_layout] if flat_layout is not None else []
+        candidates.append(None)
+        want = set(state["__flat__"])
+        tried = []
+        for lo in candidates:
+            groups = self._flat_groups(params, decay_mask, lo)
+            names = {g["name"] for g in groups}
+            if names == want:
+                return groups
+            tried.append(sorted(names))
+        raise ValueError(
+            f"flat state's groups {sorted(want)} match neither the "
+            f"schedule-derived shard-major naming nor the legacy "
+            f"row-major naming {tried} — the state was built under a "
+            f"different flat layout (mesh/schedule changed?); rebuild "
+            f"it with init_flat_state(params, ..., flat_layout=...) "
+            f"for THIS step's schedule")
+
+    def init_flat_state(self, params, decay_mask=None, master_from=None,
+                        flat_layout=None):
         """Flat per-group state: {'__flat__': {group: {moment1, moment2
         [, master]}}}.  ``master_from`` optionally seeds fp32 masters
         from UNROUNDED source values (bench.py casts params to bf16 at
-        rest but wants exact fp32 masters)."""
+        rest but wants exact fp32 masters).  ``flat_layout`` (a
+        ``parallel.schedule.FlatUpdateLayout``) builds the state in the
+        schedule-derived shard-major wire format — the master's element
+        order then matches a step built from the same schedule, and the
+        group names carry the layout signature (see _flat_groups)."""
         st = {}
-        for g in self._flat_groups(params, decay_mask):
+        for g in self._flat_groups(params, decay_mask, flat_layout):
             n = sum(g["sizes"])
             gs = {"moment1": jnp.zeros((n,), jnp.float32),
                   "moment2": jnp.zeros((n,), jnp.float32)}
             if self._multi_precision and g["dtype"] != "float32":
                 src = master_from if master_from is not None else params
-                gs["master"] = jnp.concatenate(
-                    [jnp.asarray(src[k]).astype(jnp.float32).reshape(-1)
-                     for k in g["keys"]]) if g["keys"] else \
-                    jnp.zeros((0,), jnp.float32)
+                if "layout" in g:
+                    gs["master"] = g["layout"].pack_group(
+                        g["plans"], g["keys"],
+                        {k: src[k] for k in g["keys"]})
+                else:
+                    gs["master"] = jnp.concatenate(
+                        [jnp.asarray(src[k]).astype(jnp.float32)
+                         .reshape(-1) for k in g["keys"]]) \
+                        if g["keys"] else jnp.zeros((0,), jnp.float32)
             st[g["name"]] = gs
         return {"__flat__": st}
 
@@ -390,7 +450,7 @@ class Adam(Optimizer):
 
     def apply_flat(self, params, grads, state, lr, step: int = 0,
                    decay_mask: Optional[Dict[str, bool]] = None,
-                   flat_sharding=None):
+                   flat_sharding=None, flat_layout=None):
         """Fused multi-tensor Adam/AdamW update over flat groups.
         Returns (new_params, new_state) with new_state flat again.
 
@@ -404,7 +464,16 @@ class Adam(Optimizer):
         round-10 memory-engine parity tests: concat of two sharded
         leaves + elementwise chain + slice-back returns wrong VALUES
         without the constraint; build_train_step supplies it whenever a
-        mesh is present)."""
+        mesh is present).
+
+        ``flat_layout`` (a ``parallel.schedule.FlatUpdateLayout``)
+        routes groups whose STATE was built in the schedule-derived
+        shard-major wire format: the at-rest -> flat boundary becomes a
+        local relayout (no GSPMD reshard per leaf — the round-19
+        SHARD001 bill cut) while the update math and the 2004.13336
+        cross-replica pin are unchanged.  States built without a
+        layout keep the legacy row-major path (detected by group
+        names)."""
         if not self.state_is_flat(state):
             raise ValueError("apply_flat needs a state from "
                              "init_flat_state (got per-param pytree)")
@@ -418,7 +487,8 @@ class Adam(Optimizer):
             raise NotImplementedError(
                 "apply_flat: optimizer-level regularizer instances ride "
                 "the per-param apply; pass weight_decay as a float")
-        groups = self._flat_groups(params, decay_mask)
+        groups = self._match_flat_groups(params, state, decay_mask,
+                                         flat_layout)
         missing = [k for g in groups for k in g["keys"]
                    if grads.get(k) is None]
         if missing:
@@ -430,28 +500,47 @@ class Adam(Optimizer):
         new_flat = {}
         for g in groups:
             gs = state["__flat__"][g["name"]]
-            gflat = _pin_flat(jnp.concatenate(
-                [jnp.asarray(grads[k]).astype(jnp.float32).reshape(-1)
-                 for k in g["keys"]]))
+            lo = g.get("layout")
+            pin = lo.pin if lo is not None else _pin_flat
+            if lo is not None:
+                gflat = pin(lo.pack_group(
+                    g["plans"], g["keys"],
+                    {k: grads[k] for k in g["keys"]}))
+            else:
+                gflat = pin(jnp.concatenate(
+                    [jnp.asarray(grads[k]).astype(jnp.float32)
+                     .reshape(-1) for k in g["keys"]]))
             master = gs.get("master")
             if master is None:
-                master = jnp.concatenate(
-                    [jnp.asarray(params[k]).astype(jnp.float32)
-                     .reshape(-1) for k in g["keys"]])
-            master = _pin_flat(master)
+                if lo is not None:
+                    master = lo.pack_group(
+                        g["plans"], g["keys"],
+                        {k: params[k] for k in g["keys"]})
+                else:
+                    master = jnp.concatenate(
+                        [jnp.asarray(params[k]).astype(jnp.float32)
+                         .reshape(-1) for k in g["keys"]])
+            master = pin(master)
             new_master, m1, m2 = self._flat_group_update(
-                gflat, _pin_flat(gs["moment1"]), _pin_flat(gs["moment2"]),
+                gflat, pin(gs["moment1"]), pin(gs["moment2"]),
                 master, lr, step, g["decay"])
             ngs = {"moment1": m1, "moment2": m2}
             if "master" in gs:
                 ngs["master"] = new_master
             new_flat[g["name"]] = ngs
-            off = 0
             out_dtype = jnp.dtype(g["dtype"])
-            for k, shape, size in zip(g["keys"], g["shapes"], g["sizes"]):
-                new_params[k] = new_master[off:off + size].reshape(
-                    shape).astype(out_dtype)
-                off += size
+            if lo is not None:
+                leaves = lo.unpack_group(g["plans"], g["keys"],
+                                         new_master, pin_leaves=True)
+                for k in g["keys"]:
+                    new_params[k] = leaves[k].astype(out_dtype)
+            else:
+                off = 0
+                for k, shape, size in zip(g["keys"], g["shapes"],
+                                          g["sizes"]):
+                    new_params[k] = new_master[off:off + size].reshape(
+                        shape).astype(out_dtype)
+                    off += size
         return new_params, {"__flat__": new_flat}
 
 

@@ -20,3 +20,7 @@ from .memory import (JointConfig, MemoryConfig,
                      joint_memory_codec_lattice, tune_memory_config)
 from .reshard import (ReshardPlan, check_reshard_budget, plan_reshard,
                       reshard)
+from .schedule import (FlatUpdateLayout, JointScheduleConfig,
+                       PartitionPoint, PartitionSchedule, StackSchedule,
+                       choose_joint_config, joint_schedule_lattice,
+                       tactics_for_mesh, tune_schedule_config)

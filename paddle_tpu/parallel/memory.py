@@ -320,12 +320,17 @@ def offload_flat_state(flat_state: Dict[str, Any],
 
 def init_offloaded_state(optimizer, params, decay_mask=None,
                          master_from=None,
-                         bucket_bytes: int = 4 << 20) -> Dict[str, Any]:
+                         bucket_bytes: int = 4 << 20,
+                         flat_layout=None) -> Dict[str, Any]:
     """init_flat_state + offload_flat_state in one call — what
     build_train_step callers use when
-    MemoryConfig.optimizer_residency == 'host'."""
+    MemoryConfig.optimizer_residency == 'host'.  ``flat_layout``
+    builds the flat buffers in the schedule-derived shard-major wire
+    format (parallel/schedule.py) before bucketing — bucket streaming
+    is elementwise, so the split composes with any layout."""
     flat = optimizer.init_flat_state(params, decay_mask=decay_mask,
-                                     master_from=master_from)
+                                     master_from=master_from,
+                                     flat_layout=flat_layout)
     return offload_flat_state(flat, bucket_bytes)
 
 
@@ -348,7 +353,7 @@ def gather_offloaded_state(state) -> Dict[str, Any]:
 
 def apply_flat_offloaded(optimizer, params, grads, state, lr,
                          step: int = 0, decay_mask=None,
-                         flat_sharding=None):
+                         flat_sharding=None, flat_layout=None):
     """Fused multi-tensor AdamW over HOST-RESIDENT bucketed flat groups.
 
     Per group: the (device-resident) grads concatenate once; then each
@@ -366,19 +371,19 @@ def apply_flat_offloaded(optimizer, params, grads, state, lr,
     ``flat_sharding`` pins the flat-buffer layout on mesh-sharded
     steps — same contract (and same GSPMD mis-lowering guard) as
     Adam.apply_flat; build_train_step supplies it whenever a mesh is
-    present."""
+    present.  ``flat_layout`` routes groups whose state was built in
+    the schedule-derived shard-major wire format (parallel/schedule.py;
+    detected by group names like apply_flat) — the streamed update is
+    elementwise, so bucketing composes with either layout."""
     from ..optimizer.optimizer import _pin_lr_f32 as pin_lr_f32
-
-    def _pin_flat(x):
-        if flat_sharding is None:
-            return x
-        return jax.lax.with_sharding_constraint(x, flat_sharding)
 
     if not state_is_offloaded(state):
         raise ValueError("apply_flat_offloaded needs a state from "
                          "init_offloaded_state / offload_flat_state")
     lr = pin_lr_f32(lr)
-    groups = optimizer._flat_groups(params, decay_mask)
+    groups = optimizer._match_flat_groups(
+        params, {"__flat__": state["__offload__"]}, decay_mask,
+        flat_layout)
     missing = [k for g in groups for k in g["keys"]
                if grads.get(k) is None]
     if missing:
@@ -388,13 +393,27 @@ def apply_flat_offloaded(optimizer, params, grads, state, lr,
     new_params = dict(params)
     new_off: Dict[str, Dict[str, Tuple]] = {}
     for g in groups:
+        lo = g.get("layout")
+
+        def _pin_flat(x, _lo=lo):
+            if _lo is not None:
+                return _lo.pin(x)
+            if flat_sharding is None:
+                return x
+            return jax.lax.with_sharding_constraint(x, flat_sharding)
+
         gs = state["__offload__"][g["name"]]
         m1_b, m2_b = gs["moment1"], gs["moment2"]
         master_b = gs.get("master")
-        gflat = _pin_flat(jnp.concatenate(
-            [jnp.asarray(grads[k]).astype(jnp.float32).reshape(-1)
-             for k in g["keys"]])) if g["keys"] else \
-            jnp.zeros((0,), jnp.float32)
+        if g["keys"] and lo is not None:
+            gflat = _pin_flat(lo.pack_group(
+                g["plans"], g["keys"], {k: grads[k] for k in g["keys"]}))
+        elif g["keys"]:
+            gflat = _pin_flat(jnp.concatenate(
+                [jnp.asarray(grads[k]).astype(jnp.float32).reshape(-1)
+                 for k in g["keys"]]))
+        else:
+            gflat = jnp.zeros((0,), jnp.float32)
         # bucket offsets come from the state leaves themselves; plain
         # Python accumulation — these are static trace-time ints, and
         # the repo AST lint (AST001) bans host-numpy in traced bodies
@@ -422,10 +441,16 @@ def apply_flat_offloaded(optimizer, params, grads, state, lr,
 
         pflat = None
         if master_b is None:
-            pflat = _pin_flat(jnp.concatenate(
-                [jnp.asarray(params[k]).astype(jnp.float32).reshape(-1)
-                 for k in g["keys"]])) if g["keys"] else \
-                jnp.zeros((0,), jnp.float32)
+            if g["keys"] and lo is not None:
+                pflat = _pin_flat(lo.pack_group(
+                    g["plans"], g["keys"],
+                    {k: params[k] for k in g["keys"]}))
+            elif g["keys"]:
+                pflat = _pin_flat(jnp.concatenate(
+                    [jnp.asarray(params[k]).astype(jnp.float32)
+                     .reshape(-1) for k in g["keys"]]))
+            else:
+                pflat = jnp.zeros((0,), jnp.float32)
 
         nm1_out, nm2_out, nmst_out, master_parts = [], [], [], []
         cur = fetch(0) if sizes else None
@@ -451,12 +476,19 @@ def apply_flat_offloaded(optimizer, params, grads, state, lr,
         if master_b is not None:
             ngs["master"] = tuple(nmst_out)
         new_off[g["name"]] = ngs
-        off2 = 0
         out_dtype = jnp.dtype(g["dtype"])
-        for k, shape, size in zip(g["keys"], g["shapes"], g["sizes"]):
-            new_params[k] = new_master_full[off2:off2 + size].reshape(
-                shape).astype(out_dtype)
-            off2 += size
+        if lo is not None:
+            leaves = lo.unpack_group(g["plans"], g["keys"],
+                                     new_master_full, pin_leaves=True)
+            for k in g["keys"]:
+                new_params[k] = leaves[k].astype(out_dtype)
+        else:
+            off2 = 0
+            for k, shape, size in zip(g["keys"], g["shapes"],
+                                      g["sizes"]):
+                new_params[k] = new_master_full[off2:off2 + size].reshape(
+                    shape).astype(out_dtype)
+                off2 += size
     return new_params, {"__offload__": new_off}
 
 

@@ -446,6 +446,14 @@ def leaf_partition_spec(place: _LeafPlace, lead: Optional[str] = None) -> P:
     return P(None, *entries)        # leading stacked-layer dim, replicated
 
 
+def chunk_leaf_spec(place: _LeafPlace) -> P:
+    """[v, blk, *local] chunked-leaf spec of the schedule-explicit
+    hybrid path: the chunk dim shards over pp (device-major VPP
+    placement), the block dim replicates, the inner dims keep the
+    leaf's own placement."""
+    return P("pp", None, *tuple(leaf_partition_spec(place))[1:])
+
+
 def split_by_bytes(items: Sequence[str], bytes_of, cap: int
                    ) -> List[List[str]]:
     """Greedy size-capped accumulate-and-split (the ONE bucketing rule:

@@ -75,9 +75,13 @@ def path_leaves(tree) -> Tuple[List[Tuple[str, Any]], Any]:
 def _resolve_spec(specs, path: str, leaf) -> P:
     """One destination PartitionSpec for ``path``: ``specs`` is a dict of
     dotted paths (missing → replicated), a callable ``(path, leaf) → P``,
-    a single P applied to every leaf, or None (replicate everything)."""
+    a single P applied to every leaf, None (replicate everything), or —
+    round-19 — a ``parallel.schedule.PartitionSchedule``, whose
+    per-leaf at-rest rule (``reshard_spec``) the planner reads."""
     if specs is None:
         return P()
+    if hasattr(specs, "reshard_spec"):
+        specs = specs.reshard_spec
     if isinstance(specs, P):
         return specs
     if isinstance(specs, dict):

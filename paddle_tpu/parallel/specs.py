@@ -66,6 +66,66 @@ def expert_leaf_spec(tail: P = P()) -> P:
 
 
 # ---------------------------------------------------------------------------
+# the entry-layer spec vocabulary (round-19, AST003 migration): model
+# bodies reference these named schedule decisions instead of
+# hand-writing PartitionSpec literals — every helper is one reviewed
+# placement rule with a name, not a scattering of P(...) calls
+# ---------------------------------------------------------------------------
+
+#: the replicated placement (plan defaults, unplanned names)
+REPLICATED = P()
+
+
+def batch_entry(axes: Sequence[str]):
+    """Axes tuple -> one PartitionSpec ENTRY (None when empty, the bare
+    axis when single — the repo-wide batch-entry convention)."""
+    axes = tuple(axes)
+    if not axes:
+        return None
+    return axes if len(axes) > 1 else axes[0]
+
+
+def batch_partition_spec(mesh: Mesh,
+                         data_axes: Sequence[str] = ("dp", "sharding")
+                         ) -> P:
+    """THE [B, ...]-leading batch placement: the data axes present on
+    the mesh with real degree, folded into one leading entry (single
+    copy of the rule ``make_batch_shardings`` and the bert/gpt_moe
+    batch pins shared by hand before round 19)."""
+    axes = tuple(a for a in data_axes
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+    return P(batch_entry(axes))
+
+
+def lead_batch_spec(spec: P, ndim: int = 1) -> P:
+    """Keep only the LEADING (batch) entry of an existing batch spec,
+    replicating ``ndim - 1`` trailing dims — the loss-reduction and
+    activation layout pins."""
+    entries = tuple(spec)
+    return P(entries[0] if entries else None, *([None] * (ndim - 1)))
+
+
+def activation_spec(entry, ndim: int = 3) -> P:
+    """[B, S, H]-shaped activation pin: the batch entry leads, every
+    other dim replicated (the Megatron convention the GSPMD stacks pin
+    layer boundaries to)."""
+    return P(entry, *([None] * (ndim - 1)))
+
+
+def microbatched(*entries) -> P:
+    """A leading micro/accum-batch axis is NEVER sharded (micro-steps
+    are a sequential schedule, not data to place); the remaining dims
+    follow ``entries``."""
+    return P(None, *entries)
+
+
+def token_batch_spec(batch, sep=None) -> P:
+    """[B, S] ids/labels pin: batch entry on dim 0, the sequence
+    (sep) entry on dim 1."""
+    return P(batch, sep)
+
+
+# ---------------------------------------------------------------------------
 # mesh introspection
 # ---------------------------------------------------------------------------
 

@@ -85,7 +85,14 @@ def test_bench_smoke_green():
                 # DCN bytes shrink >= 3x under the pinned COMM004 wire
                 # budget, and the COMM004[moe_dispatch] fixture fires
                 # exactly
-                "moe_trace"):
+                "moe_trace",
+                # round-19: the unified partitioning schedule — the
+                # schedule-derived accum-4 reshard bill within the NEW
+                # pinned allowances (>= 3x fewer collective-permutes /
+                # all-to-alls than the row-major wire format), and the
+                # joint partition x memory x overlap autotune's
+                # three-way budget forcing holds
+                "schedule_trace"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]
     # the fast-skipped legs must name their tier-1 home (skip with a

@@ -284,6 +284,110 @@ def test_checkpoint_save_commits_via_manifest(tmp_path):
     assert not [n for n in os.listdir(path) if n.startswith(".state.tmp")]
 
 
+# ---------------------------------------------------------------------------
+# round-19: elastic recovery re-derives the WHOLE partitioning schedule
+# (bucket plan / prefetch window / ring order), not just GSPMD specs
+# ---------------------------------------------------------------------------
+
+
+def _sched_mesh_builder(record):
+    """mesh_builder returning (mesh, PartitionSchedule): a
+    ('sharding', 'mp') mesh whose sharding degree follows the fleet
+    size, and THE schedule object the loop hands the planner and the
+    step builder.  ``record`` collects what each build derived."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.parallel.schedule import PartitionSchedule
+
+    def mesh_builder(devices):
+        n = max(2, len(devices))
+        mesh = Mesh(np.asarray(devices[:n], dtype=object).reshape(
+            n // 2, 2), ("sharding", "mp"))
+        sched = PartitionSchedule.from_plan(
+            mesh, {"w": (64, 4), "opt.m": (64, 4)},
+            lambda name: P("sharding", None))
+        record.append(("mesh", dict(zip(mesh.axis_names,
+                                        (int(s) for s in
+                                         mesh.devices.shape)))))
+        return mesh, sched
+
+    return mesh_builder
+
+
+def _sched_step_builder(record):
+    """step_builder(mesh, schedule): derives the OVERLAP stack schedule
+    from the schedule object (bucket plan + local shard shapes +
+    prefetch window + ring order) and records it — the assertion that
+    elastic recovery re-derives the whole schedule, not just specs —
+    then runs the toy SGD step placed per the schedule."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fault_injection import toy_step_builder
+
+    def step_builder(mesh, sched):
+        from paddle_tpu.parallel.schedule import PartitionSchedule
+
+        assert isinstance(sched, PartitionSchedule), type(sched)
+        plan = sched.stack_plan(shapes={"w": (64, 4)})
+        sh = dict(sched.table.mesh_axes).get("sharding", 1)
+        mp = dict(sched.table.mesh_axes).get("mp", 1)
+        record.append(("stack_plan", {
+            "buckets": [list(b) for b in plan.buckets],
+            "local_shapes": {s: plan.layout[s].local_shape(sh, mp)
+                             for s in plan.layout},
+            "prefetch_window": plan.prefetch_window,
+            "ring_order": list(plan.ring_order),
+        }))
+        return toy_step_builder(mesh, {"w": P("sharding", None),
+                                       "opt.m": P("sharding", None)})
+
+    return step_builder
+
+
+def test_elastic_scale_rederives_whole_schedule(ref12, tmp_path):
+    """Scripted 8 -> 4 -> 8 scale through resilient_train_loop with a
+    schedule-returning mesh_builder: every recovery re-derives the
+    overlap schedule from the NEW mesh (shrunk shard sizes at 4
+    devices, restored at 8), the reshard planner reads the schedule's
+    own at-rest rule, and the resumes stay loss-parity."""
+    _need(8)
+    from fault_injection import FakeCluster, FaultEvent, toy_init, toy_target
+    from paddle_tpu.distributed.resilience import (ResilienceConfig,
+                                                   resilient_train_loop)
+
+    record = []
+    cluster = FakeCluster(faults=[
+        FaultEvent(step=5, kind="scale", device_count=4),
+        FaultEvent(step=9, kind="scale", device_count=8)])
+    cfg = ResilienceConfig(checkpoint_dir=str(tmp_path),
+                           checkpoint_every=4, backoff_base_s=0.01,
+                           backoff_max_s=0.05)
+    res = resilient_train_loop(
+        mesh_builder=_sched_mesh_builder(record),
+        init_fn=toy_init,
+        step_builder=_sched_step_builder(record),
+        data_fn=toy_target, num_steps=12, config=cfg, cluster=cluster)
+    assert res.final_step == 12
+    assert [r.fault for r in res.recoveries] == ["Preemption"] * 2
+    assert all(r.steps_replayed == 0 for r in res.recoveries)
+    plans = [v for k, v in record if k == "stack_plan"]
+    meshes = [v for k, v in record if k == "mesh"]
+    assert len(plans) == 3 and len(meshes) == 3
+    assert [m["sharding"] for m in meshes] == [4, 2, 4]
+    # the whole schedule re-derived, not just specs: the shrunk mesh
+    # yields BIGGER local shards (64/2 vs 64/4) in the bucket plan...
+    assert plans[0]["local_shapes"]["w"] == (16, 4)
+    assert plans[1]["local_shapes"]["w"] == (32, 4)
+    # ...and growth restores the original derivation exactly
+    assert plans[2] == plans[0]
+    assert plans[0]["buckets"] == [["w"]]
+    assert plans[0]["prefetch_window"] == 1
+    assert plans[0]["ring_order"]          # mp ring present on every mesh
+    # loss parity: elementwise toy math, graceful scales replay nothing
+    for s, loss in ref12.losses.items():
+        assert abs(res.losses[s] - loss) < 1e-4, s
+
+
 def test_manifest_records_source_sharding(tmp_path):
     _need(8)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

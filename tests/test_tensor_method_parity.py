@@ -193,6 +193,21 @@ _ROUND18_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND18_TRANCHE
 
+# names added by the round-19 tranche (the unified-partitioning round's
+# satellite): the special-pair elementwise tail (xlogy / logaddexp2 /
+# float_power / mvlgamma) with in-place partners, the manipulation
+# bases (ravel / narrow / fliplr / flipud / take_along_dim / argwhere),
+# and the missing in-place forms of long-shipped bases (sign_,
+# true_divide_) — appended into _REQUIRED_METHODS AND counted against
+# the ~12 floor by test_method_count_tranche_round19
+_ROUND19_TRANCHE = [
+    "xlogy", "logaddexp2", "float_power", "mvlgamma",
+    "xlogy_", "logaddexp2_", "float_power_", "mvlgamma_",
+    "ravel", "narrow", "fliplr", "flipud", "take_along_dim",
+    "argwhere", "sign_", "true_divide_",
+]
+_REQUIRED_METHODS += _ROUND19_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -684,6 +699,66 @@ def test_round17_method_values():
     z = paddle.to_tensor(np.array([np.nan, 1.0], np.float32))
     z.fmin_(paddle.to_tensor(np.array([2.0, 0.5], np.float32)))
     np.testing.assert_allclose(np.asarray(z._value), [2.0, 0.5])
+
+
+def test_method_count_tranche_round19():
+    """The round-19 tranche satisfies the ~12-new-names floor (ISSUE 15
+    satellite) over the round-18 surface."""
+    wired = [n for n in _ROUND19_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 12, (len(wired),
+                              sorted(set(_ROUND19_TRANCHE) - set(wired)))
+
+
+def test_round19_method_values():
+    x = paddle.to_tensor(np.array([0.0, 0.5, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([0.0, 2.0, 3.0], np.float32))
+    # xlogy: the 0 * log(0) = 0 convention
+    np.testing.assert_allclose(np.asarray(x.xlogy(y)._value),
+                               [0.0, 0.5 * np.log(2.0),
+                                2.0 * np.log(3.0)], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(x.logaddexp2(y)._value),
+                               np.logaddexp2([0.0, 0.5, 2.0],
+                                             [0.0, 2.0, 3.0]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(x.float_power(paddle.to_tensor(
+            np.array([2.0, 2.0, 3.0], np.float32)))._value),
+        [0.0, 0.25, 8.0], rtol=1e-6)
+    import scipy.special as S
+
+    v = paddle.to_tensor(np.array([2.0, 3.5], np.float32))
+    np.testing.assert_allclose(np.asarray(v.mvlgamma(2)._value),
+                               S.multigammaln([2.0, 3.5], 2), rtol=1e-5)
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_array_equal(np.asarray(m.ravel()._value),
+                                  np.arange(6, dtype=np.float32))
+    np.testing.assert_array_equal(np.asarray(m.narrow(1, 1, 2)._value),
+                                  np.asarray(m._value)[:, 1:3])
+    np.testing.assert_array_equal(np.asarray(m.narrow(1, -2, 2)._value),
+                                  np.asarray(m._value)[:, 1:3])
+    np.testing.assert_array_equal(np.asarray(m.fliplr()._value),
+                                  np.fliplr(np.asarray(m._value)))
+    np.testing.assert_array_equal(np.asarray(m.flipud()._value),
+                                  np.flipud(np.asarray(m._value)))
+    idx = paddle.to_tensor(np.array([[2, 0, 1]], np.int64))
+    np.testing.assert_array_equal(
+        np.asarray(m.take_along_dim(idx, 1)._value),
+        np.take_along_axis(np.asarray(m._value),
+                           np.array([[2, 0, 1]]), 1))
+    z = paddle.to_tensor(np.array([[0.0, 3.0], [4.0, 0.0]], np.float32))
+    np.testing.assert_array_equal(np.asarray(z.argwhere()._value),
+                                  [[0, 1], [1, 0]])
+    # in-place partners mutate and return self
+    s = paddle.to_tensor(np.array([-2.0, 0.0, 5.0], np.float32))
+    out = s.sign_()
+    assert out is s
+    np.testing.assert_array_equal(np.asarray(s._value), [-1.0, 0.0, 1.0])
+    d = paddle.to_tensor(np.array([6.0, 9.0], np.float32))
+    d.true_divide_(paddle.to_tensor(np.array([3.0, 2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(d._value), [2.0, 4.5])
+    w = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    w.xlogy_(paddle.to_tensor(np.array([2.0, 2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(w._value),
+                               [np.log(2.0), 2 * np.log(2.0)], rtol=1e-6)
 
 
 def test_method_count_tranche_round18():
