@@ -2253,6 +2253,159 @@ def schedule_trace(smoke: bool = False):
     return out
 
 
+def roofline_trace(smoke: bool = False):
+    """bench.py --roofline-trace -> ROOFLINE_r01.json (round-20 roofline
+    step-time estimator + enumerated partitioning search):
+
+    - the ENUMERATED search space: candidate tactic compositions
+      (pp / dp / sharding3 / sep / tp — and ep on the MoE sheet) on a
+      (2, 32)-slice v5p pod, divisibility- and HBM-pruned, ranked by
+      the analytic step-time estimate — llama3-8B top-10 table plus
+      the MoE sheet's ep-point counts;
+    - the estimator-vs-measured DRIFT gate on the fake-2-slice joint
+      lattice (analysis.self_check.roofline_drift_section): the
+      predicted winner under the pinned budgets must equal the
+      measured joint pick, per-record fit/no-fit frontier parity, and
+      predicted DCN wire within 10% of the pins;
+    - predict-mode autotune (full mode, 8 devices): the estimator
+      re-ranks the flagship lattice and ``tune_schedule_config(
+      predict=True, top_k=1)`` compiles ONLY the top-ranked point,
+      which must pass the measured MEM001 + COMM004 budget gates and
+      match the recorded joint pick — the ISSUE-17 acceptance leg
+      ("top candidate verified by actual compile without compiling
+      the rest").
+
+    ``ok`` requires >= 20 feasible llama3-8B candidates, ep points on
+    the MoE sheet, the drift gate green, and (full mode) the predict
+    walk choosing the pinned pick with exactly one compile.  ``smoke``
+    is fully compile-free: the drift gate reads the memoized joint
+    section when a CLI run already paid it, else the RECORDED pins
+    (tests/test_roofline.py asserts the same contract tier-1; the
+    compiled walk rides this CLI and ``-m slow``)."""
+    import jax
+
+    import paddle_tpu as paddle  # noqa: F401 (registers ops)
+    from paddle_tpu.analysis.self_check import (
+        JOINT_DCN_WIRE_BUDGET, JOINT_FLAGSHIP_BATCH, JOINT_FLAGSHIP_SEQ,
+        JOINT_HBM_BUDGET, RECORDED_JOINT_RECORDS, joint_flagship_config,
+        joint_schedule_points, roofline_drift_section)
+    from paddle_tpu.models import LlamaConfig
+    from paddle_tpu.parallel import roofline as rf
+
+    # --- leg 1: enumerated partitioning search (always compile-free)
+    cands = rf.enumerate_partitionings((2, 32), LlamaConfig.llama3_8b(),
+                                       batch=16, seq=4096, chip="v5p")
+    sheet_8b = rf.llama_cost_sheet(LlamaConfig.llama3_8b())
+    ranked = rf.rank_partitionings(cands, sheet_8b, batch=16, seq=4096,
+                                   chip="v5p")
+    top10 = [{"label": pt.label(), "estimate": est.to_json()}
+             for est, pt in ranked[:10]]
+
+    moe_sheet = rf.ModelCostSheet(
+        name="moe_debug", num_layers=4, hidden=256, intermediate=512,
+        num_heads=8, num_kv_heads=4, head_dim=32, vocab=1024,
+        num_experts=8)
+    moe_cands = rf.enumerate_partitionings((2, 32), moe_sheet, batch=16,
+                                           seq=4096, chip="v5p")
+    n_ep = sum(1 for pt in moe_cands
+               if dict(pt.axes).get("ep", 1) > 1)
+
+    # --- leg 2: estimator-vs-measured drift gate (compile-free; full
+    # mode feeds the LIVE joint section so measured_source="compiled")
+    if smoke or len(jax.devices()) < 8:
+        drift = roofline_drift_section()       # memoized or recorded
+    else:
+        from paddle_tpu.analysis.self_check import joint_schedule_section
+
+        drift = roofline_drift_section(joint_schedule_section())
+
+    # --- leg 3: predict-mode autotune — compile ONLY the top-ranked
+    # point, gate it on the measured budgets (full mode)
+    if smoke:
+        predict = {"smoke_skipped":
+                   "the compiled predict-walk rides the CLI "
+                   "--roofline-trace and -m slow "
+                   "(tests/test_roofline.py); its walk CONTRACT "
+                   "(only top_k compiled, predicted order honored) is "
+                   "tier-1 via the fake-builder walk in "
+                   "tests/test_roofline.py"}
+        predict_ok = True
+    elif len(jax.devices()) < 8:
+        predict = {"skipped": f"needs 8 devices (have "
+                              f"{len(jax.devices())})"}
+        predict_ok = True
+    else:
+        import jax.numpy as jnp
+
+        from paddle_tpu.analysis.self_check import _joint_flagship
+        from paddle_tpu.models import build_train_step
+        from paddle_tpu.models.llama import apply_llama_sharding
+        from paddle_tpu.parallel.codec import CollectiveCodec
+        from paddle_tpu.parallel.memory import MemoryConfig
+        from paddle_tpu.parallel.schedule import (joint_schedule_lattice,
+                                                  tune_schedule_config)
+
+        cfg, model, ids, labels = _joint_flagship()
+        lattice = joint_schedule_lattice(
+            joint_schedule_points(),
+            memory_lattice=(MemoryConfig(remat="none"),),
+            codec_points=(None, CollectiveCodec()))
+        sheet = rf.llama_cost_sheet(joint_flagship_config())
+        by_label = {jc.label(): jc for jc in lattice}
+        anchor = RECORDED_JOINT_RECORDS[0]
+        cal = rf.calibration_offset_from(
+            anchor, by_label[anchor["label"]], sheet,
+            batch=JOINT_FLAGSHIP_BATCH, seq=JOINT_FLAGSHIP_SEQ)
+        estimator = rf.joint_estimator(
+            sheet, batch=JOINT_FLAGSHIP_BATCH, seq=JOINT_FLAGSHIP_SEQ,
+            hbm_budget=JOINT_HBM_BUDGET,
+            dcn_budget=JOINT_DCN_WIRE_BUDGET, calibration_offset=cal)
+
+        def builder(jc):
+            mesh = jc.partition.mesh()
+            apply_llama_sharding(model, mesh)
+            params = {k: jnp.asarray(v)
+                      for k, v in model.functional_state().items()}
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model.parameters())
+            step = build_train_step(model, opt, mesh=mesh,
+                                    compute_dtype=jnp.bfloat16,
+                                    overlap=jc.overlap, memory=jc.memory)
+            return step, (params, opt.init_state(params), jnp.int32(0),
+                          jnp.float32(1e-4), ids, labels)
+
+        chosen, recs = tune_schedule_config(
+            builder, JOINT_HBM_BUDGET, lattice,
+            dcn_wire_bytes=JOINT_DCN_WIRE_BUDGET, predict=True,
+            estimator=estimator, top_k=1)
+        n_compiled = sum(1 for r in recs if r.get("compiled"))
+        predict_ok = (chosen is not None and n_compiled == 1
+                      and chosen.label() == drift.get("measured_pick"))
+        predict = {"ok": bool(predict_ok),
+                   "chosen_label": chosen.label() if chosen else None,
+                   "n_compiled": n_compiled,
+                   "n_lattice": len(lattice),
+                   "records": [{"label": r["label"],
+                                "predicted_rank": r["predicted_rank"],
+                                "compiled": r["compiled"],
+                                "peak_bytes": r.get("peak_bytes"),
+                                "dcn_wire_bytes": r.get("dcn_wire_bytes"),
+                                "fits": r.get("fits")} for r in recs]}
+
+    ok = (len(cands) >= 20 and n_ep > 0 and bool(drift.get("ok"))
+          and predict_ok)
+    return {"ok": bool(ok),
+            "backend": jax.default_backend(),
+            "search": {"mesh": "(2 slices) x 32 v5p chips",
+                       "model": "llama3-8B b16 s4096",
+                       "n_candidates": len(cands),
+                       "top10": top10,
+                       "moe_n_candidates": len(moe_cands),
+                       "moe_n_ep_points": n_ep},
+            "drift": drift,
+            "predict_autotune": predict}
+
+
 def smoke(fast: bool = False):
     """CPU-safe tier-1 gate over the serving/varlen dispatch hot paths
     (round-6 satellite: dispatch-layer regressions must fail the suite,
@@ -2681,6 +2834,23 @@ def smoke(fast: bool = False):
         } if "skipped" not in tr else {"ok": True, **tr}
     except Exception as e:  # noqa: BLE001
         legs["schedule_trace"] = {"ok": False, "error": repr(e)}
+
+    # 23. round-20 roofline estimator + enumerated partitioning search:
+    #     >= 20 feasible candidates on the (2, 32) v5p pod with ep
+    #     points on the MoE sheet, and the estimator's predicted winner
+    #     on the fake-2-slice joint lattice equals the measured joint
+    #     pick (frontier parity, wire drift <= 10%) — compile-free
+    try:
+        tr = roofline_trace(smoke=True)
+        legs["roofline_trace"] = {
+            "ok": bool(tr["ok"]),
+            "n_candidates": tr["search"]["n_candidates"],
+            "moe_n_ep_points": tr["search"]["moe_n_ep_points"],
+            "predicted_winner": tr["drift"].get("predicted_winner"),
+            "drift_ok": tr["drift"].get("ok"),
+            "measured_source": tr["drift"].get("measured_source")}
+    except Exception as e:  # noqa: BLE001
+        legs["roofline_trace"] = {"ok": False, "error": repr(e)}
 
     return {"smoke": True,
             "backend": jax.default_backend(),
@@ -3176,6 +3346,15 @@ if __name__ == "__main__":
         res = schedule_trace(smoke="--smoke-trace" in sys.argv)
         try:
             with open("SCHEDULE_r01.json", "w") as f:
+                json.dump(res, f, indent=1, default=str)
+        except OSError:
+            pass
+        print(json.dumps(res, default=str))
+        sys.exit(0 if res["ok"] else 1)
+    if "--roofline-trace" in sys.argv:
+        res = roofline_trace(smoke="--smoke-trace" in sys.argv)
+        try:
+            with open("ROOFLINE_r01.json", "w") as f:
                 json.dump(res, f, indent=1, default=str)
         except OSError:
             pass

@@ -35,11 +35,12 @@ from .core import (AnalysisContext, AnalysisPass, PASS_REGISTRY, SkipPass,
 from .exemptions import EXEMPTIONS, Exemption, apply_exemptions
 from .findings import AnalysisError, Finding, Report
 from .passes import RetraceSentinel, retrace_sentinel
-from .self_check import self_check
+from .self_check import roofline_drift_section, self_check
 
 __all__ = [
     "AnalysisContext", "AnalysisError", "AnalysisPass", "EXEMPTIONS",
     "Exemption", "Finding", "PASS_REGISTRY", "Report", "RetraceSentinel",
     "SkipPass", "apply_exemptions", "capture_stderr", "check",
-    "register_pass", "resolve_passes", "retrace_sentinel", "self_check",
+    "register_pass", "resolve_passes", "retrace_sentinel",
+    "roofline_drift_section", "self_check",
 ]

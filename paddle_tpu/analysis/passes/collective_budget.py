@@ -146,18 +146,12 @@ def _eqn_in_bytes(eqn) -> int:
 def _ring_wire_cost(kind: str, nbytes: int, g: int) -> int:
     """Bytes each participant SENDS under the standard ring cost model
     (the structural bytes-on-the-wire currency; constant factors cancel
-    in the codec-on/off ratio COMM004 budgets)."""
-    if g <= 1:
-        return 0
-    if kind == "allgather":
-        return nbytes * (g - 1)              # shard relayed g-1 times
-    if kind == "reducescatter":
-        return nbytes * (g - 1) // g
-    if kind == "allreduce":
-        return 2 * nbytes * (g - 1) // g     # RS + AG halves
-    if kind == "alltoall":
-        return nbytes * (g - 1) // g
-    return nbytes                            # permute: one hop
+    in the codec-on/off ratio COMM004 budgets).  Round-20: the single
+    copy lives in parallel/roofline.py — the analytic estimator and
+    this measured pricing walk share arithmetic by construction."""
+    from ...parallel.roofline import ring_wire_cost
+
+    return ring_wire_cost(kind, nbytes, g)
 
 
 def _wire_group_size(eqn, axis_sizes, axes) -> int:

@@ -18,7 +18,7 @@ tests/test_analysis_passes.py.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -709,22 +709,35 @@ JOINT_SLICE_MAPS = {"hybrid4": (0, 1), "tp8": (0, 0, 1, 1)}
 _JOINT_MEMO: Dict = {}
 
 
+def joint_flagship_config():
+    """Shapes of the joint-autotune flagship (also the roofline drift
+    check's cost-sheet input — one copy)."""
+    from paddle_tpu.models import LlamaConfig
+
+    return LlamaConfig.debug(vocab=512, hidden=128, layers=2, heads=8,
+                             kv_heads=4, inter=256, max_pos=64)
+
+
+#: batch/seq of the joint flagship step (ids/labels shape)
+JOINT_FLAGSHIP_BATCH, JOINT_FLAGSHIP_SEQ = 8, 16
+
+
 def _joint_flagship():
     """The params-heavy debug flagship of the joint autotune section
     (partitioning must dominate the capacity picture, so vocab/hidden
     grow over _flagship's shapes; structure unchanged)."""
     import paddle_tpu as paddle
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import LlamaForCausalLM
 
     state = paddle.get_rng_state()
     paddle.seed(20260804)
-    cfg = LlamaConfig.debug(vocab=512, hidden=128, layers=2, heads=8,
-                            kv_heads=4, inter=256, max_pos=64)
+    cfg = joint_flagship_config()
     model = LlamaForCausalLM(cfg)
     paddle.set_rng_state(state)
     rng = np.random.default_rng(5)
-    ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
-    labels = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    shape = (JOINT_FLAGSHIP_BATCH, JOINT_FLAGSHIP_SEQ)
+    ids = rng.integers(0, cfg.vocab_size, shape).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, shape).astype(np.int32)
     return cfg, model, ids, labels
 
 
@@ -836,6 +849,116 @@ def r_fits(rec) -> bool:
     """One record against BOTH pinned joint budgets."""
     return (rec["peak_bytes"] <= JOINT_HBM_BUDGET
             and rec.get("dcn_wire_bytes", 0) <= JOINT_DCN_WIRE_BUDGET)
+
+
+#: The measured joint-autotune records (container toolchain, 8 fake
+#: devices) in lattice order — the compile-free reference the roofline
+#: drift check (and bench --roofline-trace --smoke-trace) falls back to
+#: when the memoized compiled section isn't available in-process.
+#: MUST track DOCTOR.json's ``unified_schedule.joint_autotune.records``.
+RECORDED_JOINT_RECORDS = (
+    {"label": "hybrid4(dp2xsharding2xmp2)[2slice]/none/device/"
+              "codec-off",
+     "peak_bytes": 3_618_908, "dcn_wire_bytes": 446_208},
+    {"label": "hybrid4(dp2xsharding2xmp2)[2slice]/none/device/"
+              "codec[g=int8/sr,w=fp8,b=256]",
+     "peak_bytes": 3_585_756, "dcn_wire_bytes": 150_916},
+    {"label": "tp8(sharding4xmp2)[2slice]/none/device/codec-off",
+     "peak_bytes": 3_037_660, "dcn_wire_bytes": 226_048},
+    {"label": "tp8(sharding4xmp2)[2slice]/none/device/"
+              "codec[g=int8/sr,w=fp8,b=256]",
+     "peak_bytes": 3_037_788, "dcn_wire_bytes": 76_612},
+)
+
+
+def roofline_drift_section(joint: Optional[dict] = None) -> dict:
+    """Round-20: estimator-vs-measured drift gate.  The analytic
+    roofline estimate re-ranks the fake-2-slice joint lattice and its
+    PREDICTED winner (cheapest predicted point whose predicted peak +
+    wire fit the pinned budgets, peak one-point-calibrated on the
+    first measured record) must equal the MEASURED joint-autotune pick;
+    per-record predicted fit/no-fit must agree with the measured
+    frontier, and the predicted DCN wire bytes must track the measured
+    pins (the wire model mirrors the overlap engine's collective
+    schedule — byte-exact today; drift here means the engine's
+    schedule and the estimator's mirror diverged).
+
+    Compile-free: reads the memoized joint section when available
+    (``joint`` argument / _JOINT_MEMO), else the RECORDED pins with a
+    paper trail."""
+    from paddle_tpu.parallel import roofline as rf
+    from paddle_tpu.parallel.codec import CollectiveCodec
+    from paddle_tpu.parallel.memory import MemoryConfig
+    from paddle_tpu.parallel.schedule import joint_schedule_lattice
+
+    if joint is None:
+        joint = _JOINT_MEMO.get((jax.default_backend(),
+                                 len(jax.devices())))
+    measured_src = "compiled"
+    records = (joint or {}).get("records")
+    if not records:
+        records = [dict(r) for r in RECORDED_JOINT_RECORDS]
+        measured_src = "recorded"
+    measured_pick = next((r["label"] for r in records if r_fits(r)),
+                         None)
+
+    lattice = joint_schedule_lattice(
+        joint_schedule_points(),
+        memory_lattice=(MemoryConfig(remat="none"),),
+        codec_points=(None, CollectiveCodec()))
+    by_label = {jc.label(): jc for jc in lattice}
+    if set(by_label) != {r["label"] for r in records}:
+        return {"ok": False, "target": "roofline:drift",
+                "error": "lattice/record label mismatch",
+                "lattice": sorted(by_label),
+                "records": [r["label"] for r in records]}
+
+    sheet = rf.llama_cost_sheet(joint_flagship_config())
+    cal = rf.calibration_offset_from(
+        records[0], by_label[records[0]["label"]], sheet,
+        batch=JOINT_FLAGSHIP_BATCH, seq=JOINT_FLAGSHIP_SEQ)
+    ests = {}
+    for rec in records:
+        ests[rec["label"]] = rf.estimate_joint_config(
+            by_label[rec["label"]], sheet,
+            batch=JOINT_FLAGSHIP_BATCH, seq=JOINT_FLAGSHIP_SEQ,
+            hbm_budget=JOINT_HBM_BUDGET,
+            dcn_budget=JOINT_DCN_WIRE_BUDGET,
+            calibration_offset=cal)
+    order = sorted(records, key=lambda r: ests[r["label"]].total_s)
+    predicted_pick = next((r["label"] for r in order
+                           if ests[r["label"]].fits), None)
+
+    table = []
+    frontier_ok = True
+    max_wire_err = 0.0
+    for rec in records:
+        e = ests[rec["label"]]
+        meas_fit = r_fits(rec)
+        frontier_ok = frontier_ok and (e.fits == meas_fit)
+        md = rec.get("dcn_wire_bytes") or 0
+        if md:
+            max_wire_err = max(max_wire_err,
+                               abs(e.dcn_wire_bytes - md) / md)
+        table.append({"label": rec["label"],
+                      "predicted": e.to_json(),
+                      "measured": {"peak_bytes": rec["peak_bytes"],
+                                   "dcn_wire_bytes": md,
+                                   "fits": meas_fit}})
+    # the wire mirror is structural: > 10% relative drift on any pin
+    # means the engine's schedule changed under the estimator
+    ok = (predicted_pick is not None
+          and predicted_pick == measured_pick
+          and frontier_ok and max_wire_err <= 0.10)
+    return {"ok": bool(ok), "target": "roofline:drift",
+            "measured_source": measured_src,
+            "predicted_winner": predicted_pick,
+            "measured_pick": measured_pick,
+            "frontier_parity": bool(frontier_ok),
+            "max_dcn_wire_rel_err": max_wire_err,
+            "calibration_offset": cal,
+            "predicted_order": [r["label"] for r in order],
+            "table": table}
 
 
 _WIRE_MEMO: Dict = {}
@@ -1095,16 +1218,21 @@ def self_check(clean: bool = True, joint: bool = True) -> dict:
         # round's acceptance artifact); the derivation gates themselves
         # ride the sharding section above
         try:
-            result["unified_schedule"] = {
-                "joint_autotune": (
-                    joint_schedule_section() if joint
+            jsec = (joint_schedule_section() if joint
                     else {"ok": True,
                           "skipped": "joint=False (tier-1 wall): the "
                                      "real walk rides --doctor / "
                                      "--schedule-trace and -m slow; "
                                      "the forcing contract is pinned "
                                      "by tests/test_schedule.py's "
-                                     "seeded walk"}),
+                                     "seeded walk"})
+            result["unified_schedule"] = {
+                "joint_autotune": jsec,
+                # round-20: the estimator-drift gate (compile-free —
+                # reads the joint records when compiled, else the
+                # recorded pins)
+                "roofline_drift": roofline_drift_section(
+                    jsec if jsec.get("records") else None),
                 "pinned_reshard_allowances":
                     {k: dict(v)
                      for k, v in SHARDING_RESHARD_ALLOWANCES.items()},
@@ -1120,8 +1248,11 @@ def self_check(clean: bool = True, joint: bool = True) -> dict:
                        for k in ("seeded", "clean", "exemptions",
                                  "sharding")) \
         and (not clean
-             or bool(result.get("unified_schedule", {})
-                     .get("joint_autotune", {}).get("ok")))
+             or (bool(result.get("unified_schedule", {})
+                      .get("joint_autotune", {}).get("ok"))
+                 and bool(result.get("unified_schedule", {})
+                          .get("roofline_drift", {"ok": True})
+                          .get("ok"))))
     result["backend"] = jax.default_backend()
     result["num_devices"] = len(jax.devices())
     return result

@@ -20,17 +20,34 @@ import numpy as np
 
 __all__ = ["CostModel"]
 
-# v5e-class defaults; overridable per CostModel instance
-_PEAK_BF16_FLOPS = 197e12
-_HBM_BYTES_PER_S = 819e9
+
+def _v5e():
+    """The chip table lives in parallel/roofline.py (round-20 dedup:
+    one copy of the peak-FLOPs/HBM-BW/link tables, per-generation
+    overridable).  Imported lazily — ``paddle_tpu`` pulls cost_model in
+    at package import and must not drag the parallel stack with it."""
+    from ..parallel.roofline import CHIP_SPECS
+
+    return CHIP_SPECS["v5e"]
+
+
+def __getattr__(name):          # legacy constant names, table-backed
+    if name == "_PEAK_BF16_FLOPS":
+        return _v5e().peak_bf16_flops
+    if name == "_HBM_BYTES_PER_S":
+        return _v5e().hbm_bytes_per_s
+    raise AttributeError(name)
 
 
 class CostModel:
-    def __init__(self, peak_flops: float = _PEAK_BF16_FLOPS,
-                 hbm_bandwidth: float = _HBM_BYTES_PER_S,
+    def __init__(self, peak_flops: Optional[float] = None,
+                 hbm_bandwidth: Optional[float] = None,
                  cache_path: Optional[str] = None):
-        self.peak_flops = peak_flops
-        self.hbm_bandwidth = hbm_bandwidth
+        # v5e-class defaults from the roofline chip table
+        self.peak_flops = (peak_flops if peak_flops is not None
+                           else _v5e().peak_bf16_flops)
+        self.hbm_bandwidth = (hbm_bandwidth if hbm_bandwidth is not None
+                              else _v5e().hbm_bytes_per_s)
         self._cache: Dict[str, float] = {}
         self._cache_path = cache_path
         if cache_path and os.path.isfile(cache_path):
@@ -113,29 +130,38 @@ class CostModel:
         return (time.perf_counter() - t0) / 5
 
     # ------------------------------------------------------------ estimate
+    # Thin delegates to parallel/roofline.py — the single copy of the
+    # roofline math (round-20 dedup; this module keeps only the live
+    # measurement path).
     def estimate_matmul_time(self, m: int, n: int, k: int,
                              bytes_per_el: int = 2) -> float:
         """MXU/HBM roofline: max(compute, memory) seconds."""
-        flops = 2.0 * m * n * k
-        bytes_moved = bytes_per_el * (m * k + k * n + m * n)
-        return max(flops / self.peak_flops,
-                   bytes_moved / self.hbm_bandwidth)
+        from ..parallel.roofline import matmul_time
+
+        return matmul_time(m, n, k, bytes_per_el=bytes_per_el,
+                           peak_flops=self.peak_flops,
+                           hbm_bytes_per_s=self.hbm_bandwidth)
 
     def estimate_elementwise_time(self, numel: int,
                                   bytes_per_el: int = 4) -> float:
         """HBM-bound: read + write each element once."""
-        return 2.0 * numel * bytes_per_el / self.hbm_bandwidth
+        from ..parallel.roofline import elementwise_time
+
+        return elementwise_time(numel, bytes_per_el,
+                                hbm_bytes_per_s=self.hbm_bandwidth)
 
     def estimate_collective_time(self, bytes_total: int, n_devices: int,
-                                 ici_bytes_per_s: float = 45e9,
+                                 ici_bytes_per_s: float = None,
                                  kind: str = "all_reduce") -> float:
         """Ring-model ICI estimate (scaling-book recipe): all_reduce moves
         2(n-1)/n of the data, all_gather/reduce_scatter (n-1)/n."""
-        if n_devices <= 1:
-            return 0.0
-        frac = {"all_reduce": 2.0, "all_gather": 1.0,
-                "reduce_scatter": 1.0, "all_to_all": 1.0}[kind]
-        return frac * (n_devices - 1) / n_devices * bytes_total / ici_bytes_per_s
+        from ..parallel.roofline import collective_time
+
+        if ici_bytes_per_s is None:
+            ici_bytes_per_s = _v5e().ici_bytes_per_s
+        return collective_time(bytes_total, n_devices,
+                               link_bytes_per_s=ici_bytes_per_s,
+                               kind=kind)
 
     # ------------------------------------------------------------- persist
     def _flush(self):

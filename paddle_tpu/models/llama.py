@@ -65,6 +65,14 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
 
+    def cost_sheet(self):
+        """Roofline ``ModelCostSheet`` for this config — the analytic
+        per-layer FLOP/byte/collective-element counts the round-20
+        partitioning search prices candidates with (lazy delegate so the
+        models package never imports the parallel stack eagerly)."""
+        from ..parallel.roofline import llama_cost_sheet
+        return llama_cost_sheet(self)
+
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
         return LlamaConfig(vocab_size=128256, hidden_size=4096,

@@ -20,6 +20,11 @@ from .memory import (JointConfig, MemoryConfig,
                      joint_memory_codec_lattice, tune_memory_config)
 from .reshard import (ReshardPlan, check_reshard_budget, plan_reshard,
                       reshard)
+from .roofline import (CHIP_SPECS, ChipSpec, ModelCostSheet,
+                       StepTimeEstimate, chip_spec,
+                       enumerate_partitionings, estimate_step_time,
+                       joint_estimator, llama_cost_sheet,
+                       rank_partitionings, ring_wire_cost)
 from .schedule import (FlatUpdateLayout, JointScheduleConfig,
                        PartitionPoint, PartitionSchedule, StackSchedule,
                        choose_joint_config, joint_schedule_lattice,

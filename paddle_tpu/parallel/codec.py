@@ -139,6 +139,13 @@ class CollectiveCodec:
                           and profile == "int8")
         return profile, stochastic
 
+    def wire_bytes(self, n_elems: int) -> int:
+        """Post-codec bytes of one encoded ``n_elems`` row under THIS
+        codec's block/checksum settings — the wire-dtype arithmetic the
+        roofline estimator prices predicted DCN traffic with (round-20;
+        same ``packed_width`` the COMM004 wire accounting uses)."""
+        return packed_width(int(n_elems), self.block, self.checksum)
+
     def to_json(self):
         return dataclasses.asdict(self)
 

@@ -145,7 +145,9 @@ def moe_ep_layout(cfg: MoEEPConfig, mesh: Mesh,
     """Canonical SpecLayout table of the EP stack — what the Sharding
     Doctor's SHARD003 gate diffs against the placed arrays and the
     declared plan (``ep`` appears in ``mesh_axes``; DOCTOR.json carries
-    the table)."""
+    the table).  ``PartitionSchedule.from_moe_ep`` wires this same
+    shapes/spec vocabulary into the unified schedule, which is how the
+    round-20 roofline enumerator emits composable ep points."""
     shapes = moe_ep_shapes(cfg)
     entries = {}
     for name, shape in shapes.items():

@@ -156,6 +156,29 @@ class MemoryConfig:
                 f"{self.optimizer_residency!r}; expected one of "
                 f"{RESIDENCIES}")
 
+    def act_keep_factor(self) -> float:
+        """Activation bytes kept per token-layer relative to the
+        no-remat baseline — the residency knob the roofline peak model
+        reads (round-20: the factor table lives beside the estimator in
+        roofline.py; THIS method is the policy-semantics owner, folding
+        ``activation_offload``'s host-residency halving on top the same
+        way ``resolve_remat`` folds it into the checkpoint policy)."""
+        from .roofline import _ACT_KEEP_FACTOR
+
+        keep = _ACT_KEEP_FACTOR.get(self.remat, 1.0)
+        if self.activation_offload:
+            keep *= 0.5
+        return keep
+
+    def recompute_fwd_passes(self) -> float:
+        """Extra forward passes the backward recomputes under this
+        remat policy — the roofline estimate's recompute FLOPs term
+        (round-20; "dots" saves every matmul so its recompute is
+        second-order, folded to 0)."""
+        from .roofline import REMAT_RECOMPUTE_FACTOR
+
+        return REMAT_RECOMPUTE_FACTOR.get(self.remat, 0.0)
+
     def resolve_remat(self):
         """(use_checkpoint, policy) for the decoder-layer wrap — the
         single translation point from policy NAME to jax.checkpoint

@@ -114,6 +114,14 @@ class OverlapConfig:
     slice_map: Optional[Tuple[int, ...]] = None   # fake/explicit slices
     codec: Optional[CollectiveCodec] = None
 
+    def hides_collectives(self) -> bool:
+        """Whether this schedule can hide collective time behind layer
+        compute — the roofline estimate's exposed-comm contract
+        (round-20: exposed = max(0, comm − compute) only when the
+        layer-ahead prefetch pipeline runs; prefetch=False serializes
+        gather → compute, so every wire second is exposed)."""
+        return bool(self.prefetch)
+
     def resolve_hier(self, mesh: Mesh, axis: Optional[str]):
         from ..distributed.topology import hierarchical_axis
 

@@ -467,6 +467,22 @@ class PartitionSchedule:
 
         return cls(mesh, plan_for, layout)
 
+    @classmethod
+    def from_moe_ep(cls, cfg, mesh: Mesh, dtype: str = "float32",
+                    tactics: Optional[Sequence[str]] = None
+                    ) -> "PartitionSchedule":
+        """The EP constructor: the MoE block's declared plan
+        (``expert.moe_ep_layout`` — expert-stacked leaves lead with
+        ``ep``, the shared gate replicates) wired through the unified
+        schedule so ``ep`` composes with dp/sharding/tp/pp in the
+        declared-plan vocabulary (and the roofline enumerator can emit
+        ep points that answer the same table queries).  ``cfg`` is a
+        ``MoEEPConfig``."""
+        from .expert import moe_ep_shapes, moe_ep_spec_for
+
+        return cls.from_plan(mesh, moe_ep_shapes(cfg), moe_ep_spec_for,
+                             dtype=dtype, tactics=tactics)
+
     # -- tactic/axis introspection -------------------------------------------
 
     def tactic_names(self) -> Tuple[str, ...]:
@@ -742,18 +758,88 @@ def tune_schedule_config(step_builder: Callable[[JointScheduleConfig],
                                                 Tuple],
                          hbm_bytes: int,
                          lattice: Sequence[JointScheduleConfig], *,
-                         dcn_wire_bytes: Optional[int] = None):
+                         dcn_wire_bytes: Optional[int] = None,
+                         predict: bool = False,
+                         estimator: Optional[Callable] = None,
+                         top_k: int = 1):
     """The full joint search: ``tune_memory_config``'s walk (cheapest
     first, measure compiled peak, first fit wins) over the
     partitioning x memory x overlap lattice, with the DCN wire budget
     measured through the Doctor's COMM004 machinery.  Returns
-    ``(chosen, records)`` exactly like the memory tuner."""
+    ``(chosen, records)`` exactly like the memory tuner.
+
+    ``predict=True`` (round-20): rank the lattice by the analytic
+    roofline estimate FIRST and compile only the top-K — the
+    estimator (``roofline.joint_estimator(sheet, ...)``; a callable
+    JointScheduleConfig -> StepTimeEstimate) orders the space and
+    optionally pre-filters by its predicted budget verdict
+    (``estimate.fits``), while the compiled MEM001 peak / COMM004 wire
+    gates stay the ground-truth verifier on every point that IS
+    compiled.  Records come back in lattice order, every point
+    carrying its ``predicted`` estimate + ``predicted_rank``; only
+    compiled points carry measured ``peak_bytes``/``fits``."""
     from .memory import tune_memory_config
 
-    if dcn_wire_bytes is None:
-        return tune_memory_config(step_builder, hbm_bytes,
-                                  lattice=tuple(lattice))
-    return tune_memory_config(
-        step_builder, hbm_bytes, lattice=tuple(lattice),
-        dcn_wire_bytes=dcn_wire_bytes,
-        dcn_bytes_fn=measure_dcn_wire_bytes)
+    if not predict:
+        if dcn_wire_bytes is None:
+            return tune_memory_config(step_builder, hbm_bytes,
+                                      lattice=tuple(lattice))
+        return tune_memory_config(
+            step_builder, hbm_bytes, lattice=tuple(lattice),
+            dcn_wire_bytes=dcn_wire_bytes,
+            dcn_bytes_fn=measure_dcn_wire_bytes)
+    if estimator is None:
+        raise ValueError(
+            "tune_schedule_config(predict=True) needs an estimator "
+            "(roofline.joint_estimator) — a predicted ranking with no "
+            "estimate would silently fall back to lattice order")
+    return _predicted_walk(step_builder, hbm_bytes, tuple(lattice),
+                           estimator, dcn_wire_bytes=dcn_wire_bytes,
+                           top_k=max(1, int(top_k)))
+
+
+def _predicted_walk(step_builder, hbm_bytes, lattice, estimator, *,
+                    dcn_wire_bytes=None, top_k=1):
+    """The predict-mode walk: estimate every point (cheap, analytic),
+    visit in predicted-cheapest order skipping points the estimator
+    predicts infeasible (when it renders a verdict), compile at most
+    ``top_k`` of them, and stop at the first point whose MEASURED peak
+    (and, when budgeted, measured DCN wire bytes) fits."""
+    from .memory import measure_step_memory
+
+    ests = [estimator(jc) for jc in lattice]
+
+    def _total(e):
+        return e.total_s if hasattr(e, "total_s") else e["total_s"]
+
+    order = sorted(range(len(lattice)), key=lambda i: _total(ests[i]))
+    records = []
+    for i, (jc, est) in enumerate(zip(lattice, ests)):
+        ej = est.to_json() if hasattr(est, "to_json") else dict(est)
+        records.append({"config": jc.to_json(), "label": jc.label(),
+                        "predicted": ej,
+                        "predicted_rank": order.index(i),
+                        "compiled": False})
+    chosen = None
+    compiled = 0
+    for idx in order:
+        if compiled >= top_k:
+            break
+        fits_pred = records[idx]["predicted"].get("fits")
+        if fits_pred is False:
+            continue            # predicted misfit: not worth a compile
+        jc = lattice[idx]
+        fn, args = step_builder(jc)
+        stats = measure_step_memory(fn, *args)
+        rec = records[idx]
+        rec.update(stats, compiled=True,
+                   fits=stats["peak_bytes"] <= hbm_bytes)
+        if dcn_wire_bytes is not None:
+            dcn = int(measure_dcn_wire_bytes(jc, fn, args))
+            rec["dcn_wire_bytes"] = dcn
+            rec["fits"] = bool(rec["fits"] and dcn <= dcn_wire_bytes)
+        compiled += 1
+        if rec["fits"]:
+            chosen = jc
+            break
+    return chosen, records

@@ -92,7 +92,15 @@ def test_bench_smoke_green():
                 # all-to-alls than the row-major wire format), and the
                 # joint partition x memory x overlap autotune's
                 # three-way budget forcing holds
-                "schedule_trace"):
+                "schedule_trace",
+                # round-20: the roofline estimator + enumerated
+                # partitioning search — >= 20 feasible candidates on
+                # the (2, 32) v5p pod (ep points on the MoE sheet),
+                # and the estimator's predicted winner on the
+                # fake-2-slice joint lattice equals the measured joint
+                # pick (frontier parity, DCN wire drift <= 10%),
+                # compile-free via the recorded pins
+                "roofline_trace"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]
     # the fast-skipped legs must name their tier-1 home (skip with a

@@ -66,6 +66,9 @@ class TestBert:
             ln, params, st = step(params, st, i + 1, 1e-3, ids, labs)
         assert float(ln) < float(l0)
 
+    @pytest.mark.slow  # round-20 tier policy: tier-1 homes = this
+    # class's masked train-step regression legs (same loss/step path);
+    # the multi-step eager finetune re-asserts here
     def test_finetune_eager(self):
         import dataclasses
 

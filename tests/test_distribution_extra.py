@@ -170,6 +170,9 @@ class TestNewKLs:
         np.testing.assert_allclose(float(D.kl_divergence(p, q).numpy()),
                                    0.0, atol=1e-5)
 
+    @pytest.mark.slow  # round-20 tier policy: tier-1 homes =
+    # test_kl_mvn_zero_for_identical + test_kl_mvn_batched_loc_shared_cov
+    # (closed-form anchors); the torch cross-check re-asserts here
     def test_kl_mvn_vs_torch(self):
         torch = pytest.importorskip("torch")
         mu_p = np.array([0.0, 1.0], "float32")

@@ -8,6 +8,7 @@ guarantees between its prefill and masked-decode modes.
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.incubate.nn import FusedMultiTransformer
@@ -36,6 +37,9 @@ def test_prefill_shapes_and_mask():
                                np.asarray(out2._value)[:, 0], rtol=1e-5)
 
 
+@pytest.mark.slow  # round-20 tier policy: tier-1 homes = the
+# decode/prefill-agreement charters of test_decode_attention +
+# test_flash_decoding and this file's forward parity legs
 def test_decode_matches_prefill():
     net = _layer()
     rng = np.random.RandomState(1)

@@ -30,6 +30,9 @@ def _model():
     return LlamaForCausalLM(cfg), cfg
 
 
+@pytest.mark.slow  # round-20 tier policy: tier-1 home = the serving
+# plane's test_unified_matches_oneshot_generate (greedy kv-cache parity
+# through the same generate path) + this file's kv-cache unit legs
 def test_greedy_matches_full_recompute():
     model, cfg = _model()
     ids = np.random.RandomState(0).randint(0, cfg.vocab_size,

@@ -238,6 +238,9 @@ def test_dropless_processes_skewed_routing():
     assert np.abs(np.asarray(y)).sum() > 0
 
 
+@pytest.mark.slow  # round-20 tier policy: tier-1 homes = the dropless
+# forward parity legs above (loop reference + capacity-path agreement)
+# and the EP grad-sync parity suite in tests/test_expert_parallel.py
 def test_dropless_grads():
     from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
         _moe_dropless_op

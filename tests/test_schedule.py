@@ -498,3 +498,31 @@ def test_real_joint_section_three_way_forcing():
     assert len({picked["hbm_only"], picked["dcn_only"],
                 picked["joint"]}) == 3
     assert picked["joint"] == sec["chosen_label"]
+
+
+def test_from_moe_ep_round_trips_through_doctor_table():
+    """Round-20 satellite, schedule-vocabulary side: the EP constructor
+    is a first-class citizen of the declared-plan table — its to_json
+    canonical table recovers (from_table) a schedule that answers the
+    same spec queries, with ``ep`` in the mesh axes.  (The layout-rule
+    assertions live in tests/test_roofline.py's constructor test.)"""
+    _need(8)
+    from paddle_tpu.parallel.expert import MoEEPConfig
+
+    mesh = Mesh(np.asarray(jax.devices()[:8], dtype=object).reshape(
+        2, 4), ("dp", "ep"))
+    cfg = MoEEPConfig(d_model=32, d_hidden=64, num_expert=4, top_k=2)
+    sched = PartitionSchedule.from_moe_ep(cfg, mesh)
+    js = sched.to_json()
+    assert ["ep", 4] in js["mesh_axes"]
+    back = PartitionSchedule.from_table(
+        {"mesh_axes": js["mesh_axes"], "tensors": js["table"]["tensors"]},
+        mesh=mesh)
+    for name in ("w_up", "w_down", "gate_w"):
+        # canonical-table equality (spec_for only differs by trailing
+        # Nones, which place identically)
+        assert back.table[name].dim_axes == sched.table[name].dim_axes
+        shape = sched.table[name].shape
+        assert (back.named_sharding(name, shape)
+                .is_equivalent_to(sched.named_sharding(name, shape),
+                                  len(shape)))

@@ -73,6 +73,9 @@ def test_serving_matches_oneshot_generate(tiny_model):
         assert len(got) == new
 
 
+@pytest.mark.slow  # round-20 tier policy: tier-1 home = the backpressure
+# family's test_unified_throttle_sheds_and_restores + the page-leak
+# shutdown assertion every kept serving leg exercises
 def test_serving_admission_waits_for_pages(tiny_model):
     """With pages for only ~one sequence, requests are admitted one at a
     time; eviction frees pages and the next request proceeds."""
@@ -372,6 +375,9 @@ def test_prefix_cache_hit_bit_identical_seeded_temperature(tiny_model):
     warm.shutdown()
 
 
+@pytest.mark.slow  # round-20 tier policy: tier-1 homes = the kept
+# test_prefix_cache_hit_bit_identical_greedy leg + the disagg host-tier
+# roundtrip/cross-replica trie legs (same page-sharing machinery)
 def test_prefix_cache_cow_isolation(tiny_model):
     """Two live requests share prefix pages copy-on-write while their
     suffixes diverge — and a THIRD request re-reading the shared prefix

@@ -155,6 +155,9 @@ def test_sampled_request_hands_off_with_rng_state(tiny_model):
     assert router.telemetry["handoffs_mid_decode"] >= 1
 
 
+@pytest.mark.slow  # round-20 tier policy: tier-1 homes = the seeded
+# MEM001[kv_handoff] fixture + handoff COMM004 gate (test_analysis_passes)
+# and the disagg bit-parity leg above; the wire-ratio breadth re-asserts here
 def test_kv_handoff_budget_and_int8_wire(tiny_model):
     """The handoff leg: the int8-KV fleet's handoff stream moves
     measurably fewer bytes than the float-cache form of the SAME page
