@@ -2852,6 +2852,15 @@ def smoke(fast: bool = False):
     except Exception as e:  # noqa: BLE001
         legs["roofline_trace"] = {"ok": False, "error": repr(e)}
 
+    # 24. round-21 Concurrency Doctor: the RACE fixtures fire exactly,
+    #     the control-plane lock-discipline sweep is clean under the
+    #     reviewed allowlist, and the sanitizer's deterministic
+    #     self-test + threaded allocator/watchdog hammers run green
+    try:
+        legs["concurrency_doctor"] = _smoke_concurrency_doctor()
+    except Exception as e:  # noqa: BLE001
+        legs["concurrency_doctor"] = {"ok": False, "error": repr(e)}
+
     return {"smoke": True,
             "backend": jax.default_backend(),
             "ok": all(leg.get("ok") for leg in legs.values()),
@@ -3224,6 +3233,54 @@ def _smoke_sharding_doctor():
                 "findings": [f.format() for f in rep.findings]}
     except Exception as e:  # noqa: BLE001
         out["cross_stack"] = {"ok": False, "error": repr(e)}
+    return {"ok": all(v.get("ok") for v in out.values()), **out}
+
+
+def _smoke_concurrency_doctor():
+    """Round-21 concurrency_doctor leg: the RACE001-004 fixtures fire
+    exactly their codes (RACE004 = the minimized pre-fix watchdog
+    race), the lock-discipline sweep over the control plane is clean
+    under the reviewed allowlist (no stale entries), and the dynamic
+    sanitizer's deterministic self-test + small genuinely-threaded
+    hammers (PageAllocator storm, watchdog scanner-vs-completion race)
+    run green.  Shares the memoized doctor section — one sweep per
+    process."""
+    from paddle_tpu.analysis.fixtures import SEEDED, FixtureUnavailable
+    from paddle_tpu.analysis.lock_sanitizer import (hammer_page_allocator,
+                                                    hammer_watchdog)
+    from paddle_tpu.analysis.self_check import _concurrency_section
+
+    out = {}
+    for code in ("RACE001", "RACE002", "RACE003", "RACE004"):
+        try:
+            rep = SEEDED[code]()
+            out[code] = {"ok": set(rep.codes()) == {code},
+                         "codes": sorted(set(rep.codes()))}
+        except FixtureUnavailable as e:
+            out[code] = {"ok": True, "skipped": str(e)}
+    try:
+        sec = _concurrency_section()
+        out["sweep"] = {"ok": bool(sec.get("sweep", {}).get("ok")),
+                        "findings": sec.get("sweep", {}).get("findings"),
+                        "unused_allowlist":
+                            sec.get("sweep", {}).get("unused_allowlist")}
+        out["sanitizer_self_test"] = {
+            "ok": bool(sec.get("sanitizer", {}).get("ok"))}
+    except Exception as e:  # noqa: BLE001
+        out["sweep"] = {"ok": False, "error": repr(e)}
+    try:
+        h = hammer_page_allocator(num_pages=8, threads=4, ops=80, seed=3)
+        out["allocator_hammer"] = {
+            "ok": bool(h["ok"]), "acquisitions": h["acquisitions"],
+            "order_violations": h["order_violations"]}
+        w = hammer_watchdog(threads=4, tasks_per_thread=10, seed=3)
+        out["watchdog_hammer"] = {
+            "ok": bool(w["ok"]), "timed_out": w["timed_out"],
+            "completed": w["completed"],
+            "both_terminal": w["both_terminal"],
+            "neither_terminal": w["neither_terminal"]}
+    except Exception as e:  # noqa: BLE001
+        out["hammer"] = {"ok": False, "error": repr(e)}
     return {"ok": all(v.get("ok") for v in out.values()), **out}
 
 

@@ -156,6 +156,9 @@ from .frontend_compat import (  # noqa: F401
     # round-19 tranche: special-pair tail + manipulation bases
     argwhere, fliplr, flipud, float_power, logaddexp2, mvlgamma, narrow,
     ravel, take_along_dim, true_divide, xlogy,
+    # round-21 tranche: blas-flavoured adds + the elementwise tail
+    addbmm, addmv, addr, divide_no_nan, erfc, fix, fmod, negative,
+    positive, vdot,
 )
 
 # registry-only ops that the reference exposes at top level

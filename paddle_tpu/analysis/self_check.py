@@ -1164,6 +1164,26 @@ def _clean_section() -> Dict[str, dict]:
     return clean_out
 
 
+_CONC_MEMO: Dict = {}
+
+
+def _concurrency_section() -> dict:
+    """Round-21 Concurrency Doctor block: the lock-discipline sweep over
+    the host-side control plane plus the deterministic sanitizer
+    self-test.  Backend-independent (pure AST + a barrier-stepped
+    single-thread hammer) and reached from self_check, the smoke leg and
+    tests in one tier-1 process — memoized per process, green runs
+    only."""
+    if "x" in _CONC_MEMO:
+        return _CONC_MEMO["x"]
+    from .concurrency import concurrency_section
+
+    out = concurrency_section()
+    if all(isinstance(v, dict) and v.get("ok") for v in out.values()):
+        _CONC_MEMO["x"] = out
+    return out
+
+
 def self_check(clean: bool = True, joint: bool = True) -> dict:
     """Run the full self-check; returns a JSON-able dict with ``ok``.
 
@@ -1175,6 +1195,14 @@ def self_check(clean: bool = True, joint: bool = True) -> dict:
     ``--schedule-trace`` (DOCTOR.json / SCHEDULE_r01.json carry the
     chosen schedule) and re-asserts under ``-m slow``)."""
     result = {"seeded": _seeded_section()}
+    # round-21: the Concurrency Doctor — static lock-discipline sweep
+    # over the control plane + the deterministic sanitizer self-test.
+    # Cheap (no compiles) and host-side, so it runs in EVERY mode.
+    try:
+        result["concurrency"] = _concurrency_section()
+    except Exception as e:  # noqa: BLE001
+        result["concurrency"] = {"_section_error": {"ok": False,
+                                                    "error": repr(e)}}
     if clean:
         # a sweep blowing up (toolchain drift, engine construction) must
         # degrade to a structured failure, not a raw traceback — the CLI
@@ -1246,7 +1274,7 @@ def self_check(clean: bool = True, joint: bool = True) -> dict:
 
     result["ok"] = all(_all_ok(result.get(k, {}))
                        for k in ("seeded", "clean", "exemptions",
-                                 "sharding")) \
+                                 "sharding", "concurrency")) \
         and (not clean
              or (bool(result.get("unified_schedule", {})
                       .get("joint_autotune", {}).get("ok"))

@@ -82,7 +82,8 @@ class CommTaskManager:
             return cls._instance
 
     def add_handler(self, fn: Callable[[CommTask], None]):
-        self._handlers.append(fn)
+        with self._lock:
+            self._handlers.append(fn)
 
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
@@ -136,6 +137,7 @@ class CommTaskManager:
         while not self._stop.wait(self._scan_interval):
             now = time.monotonic()
             expired = []
+            handlers = ()
             with self._lock:
                 for seq, t in list(self._tasks.items()):
                     if t.done:          # completed between scans
@@ -145,14 +147,19 @@ class CommTaskManager:
                         t.timed_out = True
                         expired.append(t)
                         del self._tasks[seq]
+                # the public trace list and the handler table share the
+                # manager lock with the timeout flag — readers see the
+                # flag and the trace record move together
+                self.timed_out.extend(expired)
+                if expired:
+                    handlers = tuple(self._handlers)
             for t in expired:
-                self.timed_out.append(t)
                 logger.error(
                     "[comm watchdog] task '%s' (group=%s, seq=%d) exceeded "
                     "%.1fs (elapsed %.1fs); started at:\n%s",
                     t.name, t.group_desc or "-", t.seq, t.timeout_s,
                     t.elapsed(), t.start_site)
-                for h in self._handlers:
+                for h in handlers:
                     try:
                         h(t)
                     except Exception:

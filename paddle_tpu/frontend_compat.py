@@ -100,6 +100,11 @@ _INPLACE_BASES = [
     # bases (sign, true_divide)
     "xlogy", "logaddexp2", "float_power", "mvlgamma", "sign",
     "true_divide",
+    # round-21 tranche: the elementwise tail (fmod/fix/negative/erfc/
+    # divide_no_nan) — positive has no in-place form (reference
+    # semantics: it RETURNS the input), and the blas-flavoured
+    # vdot/addbmm/addmv/addr are value-producing only
+    "fmod", "fix", "negative", "erfc", "divide_no_nan",
 ]
 
 
@@ -256,6 +261,78 @@ def argwhere(x):
     paddle.argwhere == nonzero(as_tuple=False); host-sync like
     nonzero — data-dependent shapes cannot trace)."""
     return _wrap(jnp.asarray(np.argwhere(np.asarray(_val(x)))))
+
+
+# ---- round-21 tranche: blas-flavoured adds + the elementwise tail ----
+
+
+def vdot(x, y):
+    """Dot product over FLATTENED inputs (reference paddle.vdot /
+    torch.vdot on real dtypes)."""
+    return _wrap(jnp.vdot(_val(x), _val(y)))
+
+
+def addbmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*sum_b(x[b] @ y[b]) — the batched-matmul
+    accumulate (reference addbmm: [b,n,m] x [b,m,p] -> [n,p])."""
+    prod = jnp.einsum("bnm,bmp->np", _val(x), _val(y))
+    return _wrap(beta * _val(input) + alpha * prod)
+
+
+def addmv(input, mat, vec, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(mat @ vec) (reference addmv:
+    [n,m] x [m] -> [n])."""
+    return _wrap(beta * _val(input) + alpha * (_val(mat) @ _val(vec)))
+
+
+def addr(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*outer(x, y) (reference addr:
+    [n] x [m] -> [n,m])."""
+    return _wrap(beta * _val(input)
+                 + alpha * jnp.outer(_val(x), _val(y)))
+
+
+def fmod(x, y):
+    """C-style elementwise remainder, result takes the DIVIDEND's sign
+    (reference paddle.fmod — unlike ``remainder``/``mod`` which take
+    the divisor's)."""
+    return _wrap(jnp.fmod(_val(x), _val(y)))
+
+
+def fix(x):
+    """Round toward zero (alias of trunc; reference exposes both)."""
+    return _wrap(jnp.fix(_val(x)))
+
+
+def negative(x):
+    """Alias of ``neg`` (reference exposes both names)."""
+    return _wrap(-_val(x))
+
+
+def positive(x):
+    """Identity on numeric tensors (reference positive: returns the
+    input unchanged; raises on bool like the reference)."""
+    v = _val(x)
+    if v.dtype == jnp.bool_:
+        raise TypeError("positive is not supported for bool tensors")
+    return _wrap(+v)
+
+
+def erfc(x):
+    """Complementary error function 1 - erf(x) (reference
+    paddle.erfc)."""
+    from jax.scipy.special import erfc as _erfc
+
+    return _wrap(_erfc(_val(x)))
+
+
+def divide_no_nan(x, y):
+    """x / y with 0 wherever y == 0 (reference divide_no_nan — the
+    safe-division op TF/Paddle expose for masked means)."""
+    xv, yv = _val(x), _val(y)
+    safe = jnp.where(yv == 0, 1, yv)
+    return _wrap(jnp.where(yv == 0, jnp.zeros_like(xv / safe),
+                           xv / safe))
 
 
 def broadcast_shape(x_shape, y_shape):

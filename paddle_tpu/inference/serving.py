@@ -87,6 +87,7 @@ from __future__ import annotations
 
 import dataclasses
 import heapq
+import threading
 from collections import deque
 from functools import partial
 from typing import Any, Deque, Dict, List, Optional
@@ -173,62 +174,92 @@ class PageAllocator:
     ``alloc`` hands out a page at refcount 1, every additional sharer
     ``acquire``\\ s it, and ``release`` only returns it to the free list
     when the count reaches zero.  The invariant ``available + live ==
-    num_pages`` is checkable at any point (``assert_balanced``) and is
-    exercised at engine teardown in tests, so a COW bug (double release,
-    leaked ref) surfaces as a hard failure instead of silent pool
-    exhaustion."""
+    num_pages`` is a CHECKED CONTRACT (``assert_consistent``) callable
+    at any point — under the race sanitizer's thread hammer and at
+    engine teardown — so a COW bug (double release, leaked ref)
+    surfaces as a hard failure instead of silent pool exhaustion.
+
+    Concurrency Doctor round: every mutation runs under ``_lock``
+    (whole method bodies — a bare ``if not self.free`` outside the lock
+    is exactly the check-then-act shape RACE004 flags).  The serving
+    tick itself is single-threaded; the lock is for the multi-host
+    control plane (hammer harness today, replica-per-host tomorrow) and
+    is uncontended — and therefore cheap — in the common path."""
 
     def __init__(self, num_pages: int):
         self.free: List[int] = list(range(num_pages - 1, -1, -1))
         self.total = num_pages
         self.refs: List[int] = [0] * num_pages
+        self._lock = threading.Lock()
 
     def alloc(self) -> Optional[int]:
-        if not self.free:
-            return None
-        p = self.free.pop()
-        self.refs[p] = 1
-        return p
+        with self._lock:
+            if not self.free:
+                return None
+            p = self.free.pop()
+            self.refs[p] = 1
+            return p
 
     def acquire(self, page: int) -> int:
         """Add a reference to an already-live page (prefix sharing)."""
-        if self.refs[page] <= 0:
-            raise AssertionError(
-                f"acquire of dead page {page} (refcount "
-                f"{self.refs[page]}) — prefix-cache/table corruption")
-        self.refs[page] += 1
-        return page
+        with self._lock:
+            if self.refs[page] <= 0:
+                raise AssertionError(
+                    f"acquire of dead page {page} (refcount "
+                    f"{self.refs[page]}) — prefix-cache/table corruption")
+            self.refs[page] += 1
+            return page
 
     def release(self, pages) -> None:
         """Drop one reference per page; a page returns to the free list
         only when its last reference is gone."""
-        for p in reversed(list(pages)):
-            p = int(p)
-            if self.refs[p] <= 0:
-                raise AssertionError(
-                    f"release of free page {p} — double release")
-            self.refs[p] -= 1
-            if self.refs[p] == 0:
-                self.free.append(p)
+        with self._lock:
+            for p in reversed(list(pages)):
+                p = int(p)
+                if self.refs[p] <= 0:
+                    raise AssertionError(
+                        f"release of free page {p} — double release")
+                self.refs[p] -= 1
+                if self.refs[p] == 0:
+                    self.free.append(p)
 
     @property
     def available(self) -> int:
+        # lock-free snapshot: advisory under concurrency, exact when the
+        # pool is quiescent (scheduler decisions re-check under alloc)
         return len(self.free)
 
     @property
     def live(self) -> int:
         return sum(1 for r in self.refs if r > 0)
 
+    def assert_consistent(self) -> None:
+        """The checked pool contract, atomically under the lock:
+        every page is exactly one of free or live
+        (``available + live == total``), no refcount is negative, free
+        pages carry no references, and the free list holds unique
+        in-range page ids."""
+        with self._lock:
+            live = sum(1 for r in self.refs if r > 0)
+            if len(self.free) + live != self.total:
+                raise AssertionError(
+                    f"page pool out of balance: available={len(self.free)} "
+                    f"+ live={live} != total={self.total}")
+            neg = [p for p, r in enumerate(self.refs) if r < 0]
+            if neg:
+                raise AssertionError(f"negative refcounts on pages {neg}")
+            bad = [p for p in self.free if self.refs[p] != 0]
+            if bad:
+                raise AssertionError(f"free pages with live refs: {bad}")
+            if len(set(self.free)) != len(self.free):
+                raise AssertionError("duplicate pages on the free list")
+            oob = [p for p in self.free if not 0 <= p < self.total]
+            if oob:
+                raise AssertionError(f"out-of-range pages on free list: {oob}")
+
     def assert_balanced(self) -> None:
-        """The leak-check assertion: every page is exactly one of free
-        or live, and free pages carry no references."""
-        if self.available + self.live != self.total:
-            raise AssertionError(
-                f"page pool out of balance: available={self.available} "
-                f"+ live={self.live} != total={self.total}")
-        bad = [p for p in self.free if self.refs[p] != 0]
-        if bad:
-            raise AssertionError(f"free pages with live refs: {bad}")
+        """Back-compat alias for the pre-round-18 leak check."""
+        self.assert_consistent()
 
 
 class _TrieNode:
@@ -504,6 +535,39 @@ class PrefixCache:
                 self.alloc.release([n.page])
         self.root = _TrieNode()
         self.host_pages = 0
+
+    def assert_consistent(self) -> None:
+        """The checked trie/tier contract (hammer + teardown): every
+        node lives in EXACTLY one tier (device page XOR host payload),
+        device pages are unique across the trie with a live allocator
+        refcount (the trie's own reference), and the ``host_pages``
+        counter matches the actual host-tier node count."""
+        seen_device: Dict[int, int] = {}
+        host_nodes = 0
+        for n in self._nodes():
+            has_page = n.page is not None
+            has_host = n.host_kv is not None
+            if has_page == has_host:
+                raise AssertionError(
+                    f"trie node {n.key!r} in "
+                    f"{'both tiers' if has_page else 'no tier'} — "
+                    f"page={n.page!r} host_kv set={has_host}")
+            if has_host:
+                host_nodes += 1
+                continue
+            if n.page in seen_device:
+                raise AssertionError(
+                    f"device page {n.page} held by two trie nodes "
+                    f"({seen_device[n.page]!r} and {n.key!r})")
+            seen_device[n.page] = n.key
+            if self.alloc.refs[n.page] <= 0:
+                raise AssertionError(
+                    f"trie node {n.key!r} holds dead page {n.page} "
+                    f"(refcount {self.alloc.refs[n.page]})")
+        if host_nodes != self.host_pages:
+            raise AssertionError(
+                f"host-tier counter drift: counter={self.host_pages} "
+                f"actual={host_nodes}")
 
     @property
     def cached_pages(self) -> int:
@@ -1790,8 +1854,9 @@ class ContinuousBatchingEngine:
             raise AssertionError(
                 "shutdown with live requests — drain via run() first")
         if self.prefix_cache is not None:
+            self.prefix_cache.assert_consistent()
             self.prefix_cache.clear()
-        self.alloc.assert_balanced()
+        self.alloc.assert_consistent()
         if self.alloc.available != self.alloc.total:
             raise AssertionError(
                 f"page leak at teardown: {self.alloc.total - self.alloc.available} "

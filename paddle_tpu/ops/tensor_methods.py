@@ -391,6 +391,13 @@ def _install():
         # argwhere); in-place partners ride inplace_methods below
         "xlogy", "logaddexp2", "float_power", "mvlgamma", "ravel",
         "narrow", "fliplr", "flipud", "take_along_dim", "argwhere",
+        # ---- round-21 tranche: the blas-flavoured adds (vdot / addbmm
+        # / addmv / addr) and the elementwise tail (fmod / fix /
+        # negative / positive / erfc / divide_no_nan); in-place
+        # partners ride inplace_methods below (positive has none —
+        # reference semantics return the input)
+        "vdot", "addbmm", "addmv", "addr", "fmod", "fix", "negative",
+        "positive", "erfc", "divide_no_nan",
     ]
 
     def mk_top(opname):
@@ -457,6 +464,8 @@ def _install():
         # long-shipped bases' missing in-place forms
         "xlogy_", "logaddexp2_", "float_power_", "mvlgamma_", "sign_",
         "true_divide_",
+        # round-21 tranche: the elementwise tail's in-place partners
+        "fmod_", "fix_", "negative_", "erfc_", "divide_no_nan_",
     ]
     def mk_in(opname):
         def method(self, *args, **kwargs):

@@ -100,7 +100,14 @@ def test_bench_smoke_green():
                 # fake-2-slice joint lattice equals the measured joint
                 # pick (frontier parity, DCN wire drift <= 10%),
                 # compile-free via the recorded pins
-                "roofline_trace"):
+                "roofline_trace",
+                # round-21: the Concurrency Doctor — RACE001-004
+                # fixtures fire exactly (RACE004 = the minimized
+                # pre-fix watchdog race), the control-plane
+                # lock-discipline sweep is clean under the reviewed
+                # allowlist, and the sanitizer self-test + threaded
+                # allocator/watchdog hammers run green
+                "concurrency_doctor"):
         assert res[leg].get("ok"), (leg, res[leg])
     assert res["ok"]
     # the fast-skipped legs must name their tier-1 home (skip with a

@@ -208,6 +208,20 @@ _ROUND19_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND19_TRANCHE
 
+# names added by the round-21 tranche (the Concurrency Doctor round's
+# satellite): the blas-flavoured adds (vdot / addbmm / addmv / addr),
+# the elementwise tail (fmod / fix / negative / positive / erfc /
+# divide_no_nan) and its in-place partners (positive has none —
+# reference semantics return the input) — appended into
+# _REQUIRED_METHODS AND counted against the ~14 floor by
+# test_method_count_tranche_round21
+_ROUND21_TRANCHE = [
+    "vdot", "addbmm", "addmv", "addr",
+    "fmod", "fix", "negative", "positive", "erfc", "divide_no_nan",
+    "fmod_", "fix_", "negative_", "erfc_", "divide_no_nan_",
+]
+_REQUIRED_METHODS += _ROUND21_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -707,6 +721,58 @@ def test_method_count_tranche_round19():
     wired = [n for n in _ROUND19_TRANCHE if hasattr(Tensor, n)]
     assert len(wired) >= 12, (len(wired),
                               sorted(set(_ROUND19_TRANCHE) - set(wired)))
+
+
+def test_method_count_tranche_round21():
+    """The round-21 tranche satisfies the ~14-new-names floor (ISSUE 18
+    satellite) over the round-19 surface."""
+    wired = [n for n in _ROUND21_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 14, (len(wired),
+                              sorted(set(_ROUND21_TRANCHE) - set(wired)))
+
+
+def test_round21_method_values():
+    a = paddle.to_tensor(np.array([7.0, -7.0, 3.5], np.float32))
+    b = paddle.to_tensor(np.array([3.0, 3.0, -2.0], np.float32))
+    # fmod takes the DIVIDEND's sign (unlike remainder)
+    np.testing.assert_allclose(np.asarray(a.fmod(b)._value),
+                               np.fmod([7.0, -7.0, 3.5], [3.0, 3.0, -2.0]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.fix()._value),
+                               [7.0, -7.0, 3.0])
+    np.testing.assert_allclose(np.asarray(a.negative()._value),
+                               [-7.0, 7.0, -3.5])
+    assert a.positive() is not None
+    # moderate arguments: 1 - erf(x) in fp32 loses all precision in the
+    # far tail where erfc keeps it (which is erfc's point)
+    e = paddle.to_tensor(np.array([0.5, -0.75, 1.25], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(e.erfc()._value),
+        1.0 - np.asarray(e.erf()._value), rtol=1e-5)
+    z = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    d = paddle.to_tensor(np.array([2.0, 0.0, 4.0], np.float32))
+    np.testing.assert_allclose(np.asarray(z.divide_no_nan(d)._value),
+                               [0.5, 0.0, 0.75])
+    # blas-flavoured adds
+    v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    w = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    np.testing.assert_allclose(np.asarray(v.vdot(w)._value), 11.0)
+    base = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(base.addr(v, w)._value),
+                               np.outer([1.0, 2.0], [3.0, 4.0]))
+    mat = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+    np.testing.assert_allclose(
+        np.asarray(v.addmv(mat, w)._value),
+        np.asarray([1.0, 2.0]) + np.arange(4).reshape(2, 2) @ [3.0, 4.0])
+    bm = paddle.to_tensor(np.ones((3, 2, 2), np.float32))
+    np.testing.assert_allclose(
+        np.asarray(base.addbmm(bm, bm)._value),
+        np.einsum("bnm,bmp->np", np.ones((3, 2, 2)), np.ones((3, 2, 2))))
+    # in-place partner mutates and returns self
+    t = paddle.to_tensor(np.array([5.5, -1.25], np.float32))
+    r = t.fix_()
+    assert r is t
+    np.testing.assert_allclose(np.asarray(t._value), [5.0, -1.0])
 
 
 def test_round19_method_values():
