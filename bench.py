@@ -1929,24 +1929,27 @@ def comm_bytes_trace(smoke=False):
 
 
 def moe_trace(smoke: bool = False):
-    """bench.py --moe-trace -> MOE_r01.json (round-18 MoE expert
-    parallelism): the EP train step on the fake-2-slice
+    """bench.py --moe-trace -> MOE_r02.json (round-18 MoE expert
+    parallelism + the round-20 DROPLESS engine): the capacity AND
+    dropless EP train steps, side by side, on the fake-2-slice
     dp1 x sharding2 x ep4 mesh —
 
-    - tokens/s through the coded EP step (structural on CPU; the TPU
-      confirmation rides BASELINE checklist (k));
-    - dispatch bytes pre/post codec: the traced per-stage (ICI/DCN)
-      wire tables with the codec off vs on, and the dispatch
-      all-to-all DCN ratio (>= 3x is the round-18 acceptance bar —
-      COMM004 pins the same contract in self_check);
-    - dropped-token rate: capacity-overflow telemetry per step
-      (assignments refused / assignments routed);
+    - tokens/s through both coded EP steps (structural on CPU; the TPU
+      confirmation rides BASELINE checklist (k)/(n));
+    - dispatch bytes pre/post codec PER ENGINE: the traced per-stage
+      (ICI/DCN) wire tables with the codec off vs on, and each
+      engine's dispatch all-to-all DCN ratio (>= 3x is the acceptance
+      bar — COMM004 pins the same contracts in self_check);
+    - dropped-token rate: capacity-overflow telemetry per step for the
+      capacity engine; STRUCTURALLY zero for the dropless engine
+      (asserted, not observed — no [E, C, d] buffer exists);
     - load-balance entropy: normalized entropy of the global
       per-expert top-1 routing fraction (1.0 = perfectly balanced).
     """
     import time
 
     import jax
+    import jax.numpy as jnp
 
     import paddle_tpu as paddle  # noqa: F401 (registers ops)
 
@@ -1958,80 +1961,116 @@ def moe_trace(smoke: bool = False):
                            f"CPU mesh"}
     from paddle_tpu.analysis.passes.collective_budget import \
         collect_wire_table
-    from paddle_tpu.analysis.self_check import (MOE_DCN_WIRE_BUDGET,
-                                                MOE_SLICE_MAP,
-                                                _moe_ep_flagship)
+    from paddle_tpu.analysis.self_check import (
+        MOE_DCN_WIRE_BUDGET, MOE_DROPLESS_DCN_WIRE_BUDGET,
+        MOE_SLICE_MAP, _moe_ep_flagship)
     from paddle_tpu.parallel.codec import CollectiveCodec
-    from paddle_tpu.parallel.expert import build_moe_ep_train_step
+    from paddle_tpu.parallel.expert import (
+        build_moe_ep_dropless_train_step, build_moe_ep_train_step)
     from paddle_tpu.parallel.overlap import OverlapConfig
 
-    cfg, mesh, params, x2d, tgt = _moe_ep_flagship()
+    cfg, mesh, params0, x2d, tgt = _moe_ep_flagship()
     dcn_axes = {"ep": list(MOE_SLICE_MAP)}
-    wire = {}
-    for name, codec in (("codec_off", None),
-                        ("codec_on", CollectiveCodec(block=64))):
-        oc = OverlapConfig(hierarchical="on", slice_map=MOE_SLICE_MAP,
-                           codec=codec)
-        step = build_moe_ep_train_step(cfg, mesh, oc=oc)
-        wire[name] = collect_wire_table(
-            jax.make_jaxpr(step)(params, x2d, tgt).jaxpr, dcn_axes)
-    off_a2a = wire["codec_off"]["dcn"]["kinds"].get(
-        "alltoall", {}).get("bytes", 0)
-    on_a2a = wire["codec_on"]["dcn"]["kinds"].get(
-        "alltoall", {}).get("bytes", 0)
-    dispatch_ratio = off_a2a / on_a2a if on_a2a else None
-
-    # the wire loop's last iteration IS the codec-on step; time it on
-    # the flagship's placed params
     steps = 3 if smoke else 10
     g = int(x2d.shape[0])
-    losses, drops, loads = [], [], []
-    loss, aux, dropped, load, params = step(params, x2d, tgt)  # compile
-    jax.block_until_ready(loss)
-    # keep the timed loop ASYNC (file convention, cf. the train bench):
-    # device outputs are collected and converted to host values only
-    # after the clock stops, so wall measures pipelined throughput
-    t0 = time.perf_counter()
-    for _ in range(steps):
+
+    def run_engine(build):
+        """Wire tables (codec off/on) + a timed codec-on loop for one
+        EP engine; the wire loop's last iteration IS the coded step."""
+        wire = {}
+        for name, codec in (("codec_off", None),
+                            ("codec_on", CollectiveCodec(block=64))):
+            oc = OverlapConfig(hierarchical="on",
+                               slice_map=MOE_SLICE_MAP, codec=codec)
+            step = build(cfg, mesh, oc=oc)
+            wire[name] = collect_wire_table(
+                jax.make_jaxpr(step)(params0, x2d, tgt).jaxpr, dcn_axes)
+        off_a2a = wire["codec_off"]["dcn"]["kinds"].get(
+            "alltoall", {}).get("bytes", 0)
+        on_a2a = wire["codec_on"]["dcn"]["kinds"].get(
+            "alltoall", {}).get("bytes", 0)
+        ratio = off_a2a / on_a2a if on_a2a else None
+        # the steps donate their params arg — give each engine its own
+        # placed copy so the second engine doesn't read deleted buffers
+        params = jax.tree_util.tree_map(jnp.copy, params0)
+        losses, drops, loads = [], [], []
         loss, aux, dropped, load, params = step(params, x2d, tgt)
-        losses.append(loss)
-        drops.append(dropped)
-        loads.append(load)
-    jax.block_until_ready((losses, drops, loads))
-    wall = time.perf_counter() - t0
-    losses = [float(v) for v in losses]
-    drops = [float(v) for v in drops]
-    loads = [np.asarray(v) for v in loads]
-    load_mean = np.mean(loads, axis=0)
-    p = load_mean / max(load_mean.sum(), 1e-9)
-    entropy = float(-(p * np.log(np.maximum(p, 1e-12))).sum()
-                    / np.log(len(p)))
-    drop_rate = float(np.mean(drops) / (g * cfg.top_k))
-    ok = (dispatch_ratio is not None and dispatch_ratio >= 3.0
-          and wire["codec_on"]["dcn"]["bytes"] <= MOE_DCN_WIRE_BUDGET
-          and all(np.isfinite(losses)) and losses[-1] < losses[0]
-          and 0.0 <= drop_rate < 1.0 and 0.0 < entropy <= 1.0)
-    out = {"ok": bool(ok),
+        jax.block_until_ready(loss)     # compile outside the clock
+        # keep the timed loop ASYNC (file convention, cf. the train
+        # bench): device outputs are collected and converted to host
+        # values only after the clock stops, so wall measures
+        # pipelined throughput
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, aux, dropped, load, params = step(params, x2d, tgt)
+            losses.append(loss)
+            drops.append(dropped)
+            loads.append(load)
+        jax.block_until_ready((losses, drops, loads))
+        wall = time.perf_counter() - t0
+        losses = [float(v) for v in losses]
+        drops = [float(v) for v in drops]
+        loads = [np.asarray(v) for v in loads]
+        load_mean = np.mean(loads, axis=0)
+        p = load_mean / max(load_mean.sum(), 1e-9)
+        entropy = float(-(p * np.log(np.maximum(p, 1e-12))).sum()
+                        / np.log(len(p)))
+        return {"tokens_per_s": round(steps * g / wall, 1),
+                "loss_first_last": [losses[0], losses[-1]],
+                "losses_finite_decreasing":
+                    bool(all(np.isfinite(losses))
+                         and losses[-1] < losses[0]),
+                "dispatch_dcn_bytes_raw": off_a2a,
+                "dispatch_dcn_bytes_coded": on_a2a,
+                "dispatch_dcn_ratio": (round(ratio, 3) if ratio
+                                       else None),
+                "total_dcn_bytes": {k: wire[k]["dcn"]["bytes"]
+                                    for k in wire},
+                "dropped_token_rate":
+                    float(np.mean(drops) / (g * cfg.top_k)),
+                "load_balance_entropy": entropy,
+                "per_expert_load": [round(float(v), 4)
+                                    for v in load_mean],
+                "wire_tables": wire}
+
+    cap = run_engine(build_moe_ep_train_step)
+    drop = run_engine(build_moe_ep_dropless_train_step)
+    cap_ok = (cap["dispatch_dcn_ratio"] is not None
+              and cap["dispatch_dcn_ratio"] >= 3.0
+              and cap["total_dcn_bytes"]["codec_on"]
+              <= MOE_DCN_WIRE_BUDGET
+              and cap["losses_finite_decreasing"]
+              and 0.0 <= cap["dropped_token_rate"] < 1.0
+              and 0.0 < cap["load_balance_entropy"] <= 1.0)
+    drop_ok = (drop["dispatch_dcn_ratio"] is not None
+               and drop["dispatch_dcn_ratio"] >= 3.0
+               and drop["total_dcn_bytes"]["codec_on"]
+               <= MOE_DROPLESS_DCN_WIRE_BUDGET
+               and drop["losses_finite_decreasing"]
+               and drop["dropped_token_rate"] == 0.0
+               and 0.0 < drop["load_balance_entropy"] <= 1.0)
+    out = {"ok": bool(cap_ok and drop_ok),
            "backend": jax.default_backend(),
            "mesh": "dp1 x sharding2 x ep4 (fake 2-slice)",
            "slice_map": list(MOE_SLICE_MAP),
            "num_experts": cfg.num_expert, "top_k": cfg.top_k,
            "capacity_factor": cfg.capacity_factor,
            "steps": steps, "tokens_per_step": g,
-           "tokens_per_s": round(steps * g / wall, 1),
-           "loss_first_last": [losses[0], losses[-1]],
-           "dispatch_dcn_bytes_raw": off_a2a,
-           "dispatch_dcn_bytes_coded": on_a2a,
-           "dispatch_dcn_ratio": (round(dispatch_ratio, 3)
-                                  if dispatch_ratio else None),
-           "total_dcn_bytes": {k: wire[k]["dcn"]["bytes"]
-                               for k in wire},
            "dcn_wire_budget": MOE_DCN_WIRE_BUDGET,
-           "dropped_token_rate": drop_rate,
-           "load_balance_entropy": entropy,
-           "per_expert_load": [round(float(v), 4) for v in load_mean]}
-    if not smoke:
-        out["wire_tables"] = wire
+           "dropless_dcn_wire_budget": MOE_DROPLESS_DCN_WIRE_BUDGET,
+           "tokens_per_s_capacity_vs_dropless": [
+               cap["tokens_per_s"], drop["tokens_per_s"]]}
+    for name, leg in (("capacity", cap), ("dropless", drop)):
+        if smoke:
+            leg = {k: v for k, v in leg.items() if k != "wire_tables"}
+        out[name] = leg
+    # back-compat flat fields (round-18 consumers read the capacity leg)
+    for k in ("tokens_per_s", "loss_first_last",
+              "dispatch_dcn_bytes_raw", "dispatch_dcn_bytes_coded",
+              "dispatch_dcn_ratio", "total_dcn_bytes",
+              "dropped_token_rate", "load_balance_entropy",
+              "per_expert_load"):
+        out[k] = out["capacity"][k]
     return out
 
 
@@ -2807,8 +2846,10 @@ def smoke(fast: bool = False):
     #     fake-2-slice mesh — loss decreases through the coded
     #     dispatch, the dispatch all-to-alls' DCN bytes shrink >= 3x
     #     with the int8 codec under the pinned wire budget, overflow
-    #     telemetry and balance entropy well-formed, and the
-    #     COMM004[moe_dispatch] fixture fires exactly
+    #     telemetry and balance entropy well-formed, the round-20
+    #     DROPLESS engine under ITS pinned budget with a structurally
+    #     zero dropped rate, and the COMM004[moe_dispatch] +
+    #     COMM004[moe_dropless] fixtures fire exactly
     try:
         legs["moe_trace"] = _smoke_moe_trace()
     except Exception as e:  # noqa: BLE001
@@ -3307,26 +3348,34 @@ def _smoke_comm_bytes():
 
 
 def _smoke_moe_trace():
-    """Round-18 moe_trace gate: the COMM004[moe_dispatch] fixture fires
-    exactly its code, and the EP trace's >= 3x dispatch DCN reduction +
-    pinned wire budget + telemetry shape hold."""
+    """Round-18 + round-20 moe_trace gate: the COMM004[moe_dispatch]
+    AND COMM004[moe_dropless] fixtures each fire exactly their code,
+    and both EP engines' traces hold — >= 3x dispatch DCN reduction,
+    each engine under its own pinned wire budget, telemetry shape, and
+    the dropless leg's structurally-zero dropped rate."""
     from paddle_tpu.analysis.fixtures import SEEDED, FixtureUnavailable
 
     out = {}
-    try:
-        rep = SEEDED["COMM004[moe_dispatch]"]()
-        out["COMM004[moe_dispatch]"] = {
-            "ok": set(rep.codes()) == {"COMM004"},
-            "codes": sorted(set(rep.codes()))}
-    except FixtureUnavailable as e:
-        out["COMM004[moe_dispatch]"] = {"ok": True, "skipped": str(e)}
+    for code in ("COMM004[moe_dispatch]", "COMM004[moe_dropless]"):
+        try:
+            rep = SEEDED[code]()
+            out[code] = {"ok": set(rep.codes()) == {"COMM004"},
+                         "codes": sorted(set(rep.codes()))}
+        except FixtureUnavailable as e:
+            out[code] = {"ok": True, "skipped": str(e)}
     tr = moe_trace(smoke=True)
     out["trace"] = {"ok": bool(tr.get("ok")),
                     "skipped": tr.get("skipped"),
                     "dispatch_dcn_ratio": tr.get("dispatch_dcn_ratio"),
                     "dropped_token_rate": tr.get("dropped_token_rate"),
                     "load_balance_entropy":
-                        tr.get("load_balance_entropy")}
+                        tr.get("load_balance_entropy"),
+                    "dropless_dispatch_dcn_ratio": tr.get(
+                        "dropless", {}).get("dispatch_dcn_ratio"),
+                    "dropless_dropped_token_rate": tr.get(
+                        "dropless", {}).get("dropped_token_rate"),
+                    "tokens_per_s_capacity_vs_dropless": tr.get(
+                        "tokens_per_s_capacity_vs_dropless")}
     return {"ok": all(v.get("ok") for v in out.values()), **out}
 
 
@@ -3384,7 +3433,7 @@ if __name__ == "__main__":
     if "--moe-trace" in sys.argv:
         res = moe_trace(smoke="--smoke-trace" in sys.argv)
         try:
-            with open("MOE_r01.json", "w") as f:
+            with open("MOE_r02.json", "w") as f:
                 json.dump(res, f, indent=1, default=str)
         except OSError:
             pass

@@ -394,6 +394,52 @@ def seeded_moe_dispatch_codec_off() -> Report:
                                     "dcn_bytes": budget}}})
 
 
+def seeded_moe_dropless_codec_off() -> Report:
+    """COMM004 on the round-20 DROPLESS dispatch composite: the sorted
+    ragged dispatch is TWO exchanges — an uncoded int32 count exchange
+    (the control plane stays bit-exact) followed by the coded token
+    payload windows.  The seeded bug silently drops the codec on the
+    payload leg only; the cheap count leg stays put while every
+    DCN-crossing token window re-inflates to fp wire, blowing the
+    budget the dropless step is pinned to."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..common.jax_compat import shard_map
+    from ..distributed.topology import hierarchical_axis
+    from ..parallel.codec import CollectiveCodec
+    from ..parallel.expert import make_ep_all_to_all
+    from .passes.collective_budget import collect_wire_table
+
+    mesh = _mesh(4)
+    if mesh.shape["x"] < 4:
+        raise FixtureUnavailable("fake 2-slice split needs an axis of 4")
+    sm = (0, 0, 1, 1)
+    hier = hierarchical_axis(mesh, "x", slice_map=sm)
+    codec = CollectiveCodec(block=64)
+    counts_a2a = make_ep_all_to_all("x", hier=hier)   # always uncoded
+
+    def dispatch(payload_codec):
+        pay = make_ep_all_to_all("x", hier=hier, codec=payload_codec)
+
+        def body(c, v):
+            return counts_a2a(c), pay(v)
+
+        return shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                         out_specs=(P("x"), P("x")), check_vma=False)
+
+    c = jnp.ones((4, 4), jnp.int32)       # [ep, e_local] counts
+    x = jnp.ones((16, 64), jnp.float32)   # [ep*W, d] payload windows
+    # the declared budget IS the coded composite's measured DCN bytes
+    # (counts uncoded + payload coded)
+    coded_jaxpr = jax.make_jaxpr(dispatch(codec))(c, x).jaxpr
+    budget = collect_wire_table(coded_jaxpr, {"x": sm})["dcn"]["bytes"]
+    return check(dispatch(None), c, x, passes=["collective_budget"],
+                 exemptions=(), target="seeded:COMM004[moe_dropless]",
+                 options={"collective_budget":
+                          {"wire": {"dcn_axes": {"x": list(sm)},
+                                    "dcn_bytes": budget}}})
+
+
 # ---------------------------------------------------------------------------
 # memory_budget
 # ---------------------------------------------------------------------------
@@ -897,6 +943,10 @@ SEEDED = {
     # codec silently off on the expert all-to-all blows the DCN wire
     # budget the quantized dispatch schedule honors
     "COMM004[moe_dispatch]": seeded_moe_dispatch_codec_off,
+    # round-20: a third COMM004 proof on the DROPLESS dispatch
+    # composite — codec silently off on the payload leg (counts stay
+    # uncoded by design) blows the dropless step's measured DCN budget
+    "COMM004[moe_dropless]": seeded_moe_dropless_codec_off,
     "DT001": seeded_fp32_matmul,
     "DT002": seeded_f64_leak,
     "DT003": seeded_fp32_carry,

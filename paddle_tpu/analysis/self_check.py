@@ -72,6 +72,19 @@ FLAGSHIP_SLICE_MAP = (0, 0, 1, 1)
 MOE_DCN_WIRE_BUDGET = 2304
 MOE_SLICE_MAP = (0, 0, 1, 1)
 
+# Round-20 wire contract for the DROPLESS EP MoE train step (sorted
+# ragged dispatch + grouped matmul, no capacity buffer) on the same
+# fake-2-slice dp1 x sharding2 x ep4 mesh with the block-64 DCN codec
+# ON: the quantized dispatch/combine schedule measures ~2.4 KB of
+# post-codec DCN bytes per step (the int32 count exchange stays uncoded
+# by design — the control plane is bit-exact — while the token payload
+# windows ship int8 + bf16 scale sidecars; the tiny fp32 gate-grad psum
+# rides uncoded) vs ~6.9 KB uncoded, the dispatch all-to-alls alone
+# shrinking 3.85x (the >= 3x acceptance bar).  3 KB pins it with ~20%
+# headroom: silently dropping the codec on the payload leg blows
+# COMM004 here, not a multislice TPU session.
+MOE_DROPLESS_DCN_WIRE_BUDGET = 3072
+
 # Round-17 probe-fusion contract (HEALTH001) for the health-probed
 # flagship step: the probed entry's compiled peak may exceed the
 # UNPROBED entry's measured peak by at most this allowance.  Measured
@@ -254,6 +267,11 @@ def _clean_targets():
         for name, rep in _moe_ep_target():
             yield name, rep
 
+        # 2e. round-20: the DROPLESS EP train step under its own pinned
+        # post-codec DCN wire budget (COMM004) on the same mesh
+        for name, rep in _moe_ep_dropless_target():
+            yield name, rep
+
     # 3. llama forward/backward in isolation (no optimizer): params are
     # read-only here, so they are declared persistent for the donation
     # audit
@@ -353,6 +371,32 @@ def _moe_ep_target():
             "wire": {"dcn_axes": {"ep": list(MOE_SLICE_MAP)},
                      "dcn_bytes": MOE_DCN_WIRE_BUDGET}}},
         target="moe_ep_train_step[hier2slice,codec]")
+
+
+def _moe_ep_dropless_target():
+    """Round-20 dropless clean sweep: the sorted-ragged-dispatch EP
+    train step on the fake-2-slice mesh with the DCN codec ON, pinned
+    to its own measured post-codec wire budget (COMM004 — dropping the
+    codec on the payload windows fails here; the uncoded int32 count
+    exchange is part of the budget by design) with every manual
+    collective engine-attributed (COMM002)."""
+    from .core import check
+    from paddle_tpu.parallel.codec import CollectiveCodec
+    from paddle_tpu.parallel.expert import build_moe_ep_dropless_train_step
+    from paddle_tpu.parallel.overlap import OverlapConfig
+
+    cfg, mesh, params, x2d, tgt = _moe_ep_flagship()
+    oc = OverlapConfig(hierarchical="on", slice_map=MOE_SLICE_MAP,
+                       codec=CollectiveCodec(block=64))
+    step = build_moe_ep_dropless_train_step(cfg, mesh, oc=oc)
+    yield "moe_ep_dropless_train_step[hier2slice,codec]", check(
+        step, params, x2d, tgt,
+        passes=["collective_budget"],
+        options={"collective_budget": {
+            "overlap_active": True,
+            "wire": {"dcn_axes": {"ep": list(MOE_SLICE_MAP)},
+                     "dcn_bytes": MOE_DROPLESS_DCN_WIRE_BUDGET}}},
+        target="moe_ep_dropless_train_step[hier2slice,codec]")
 
 
 def _overlap_target():

@@ -204,22 +204,39 @@ def self_draft_params(cfg, params, num_layers: int):
     return dcfg, dparams
 
 
+#: row-block quantum of the serving grouped-matmul launches (segment
+#: alignment; serving batches are small, so a fine block keeps padding
+#: slack low while staying sublane-aligned)
+_MOE_FFN_BLOCK_ROWS = 8
+
+
 def _moe_ffn(w: _Weights, i, xm):
     """Top-k expert routing for one MoE layer on the ``_Weights`` view
-    (round-18 sparse serving): fp32 router logits -> top-k softmax
+    (round-20 dropless serving): fp32 router logits -> top-k softmax
     weights (normalized over the selected experts, the reference
-    ``fused_moe`` semantics) -> per-EXPERT gather-then-dequant of one
-    ``[in, out]`` slice at a time from the stacked int8 bank -> SwiGLU
-    expert FFN, accumulated under the per-token combine weights.
-    Iterating experts (not top-k selections) bounds live memory to ONE
-    dequantized expert slice — a per-selection weight gather would
-    materialize [T, in, out] per projection, which dwarfs the bank
-    itself whenever T*k > E — at the cost of pushing every token
-    through every expert (masked-dense compute, the static-shape
-    idiom; flops scale E/k-fold but the expert bank is read exactly
-    once per call).  ``xm`` is any [..., hidden] batch (the unified
-    step's packed [T, h] rows, a decode chunk's [slots, 1, h],
-    prefill's [b, s, h]); routing is per token row."""
+    ``fused_moe`` semantics) -> token copies argsorted by expert into
+    block-aligned ragged segments -> ONE grouped-matmul launch per
+    projection (ops/pallas/grouped_matmul) applying each expert's
+    ``[in, out]`` slice to its row window, SwiGLU, then a weighted
+    scatter back to token order.
+
+    This replaces the round-18 masked-dense expert loop (every token
+    through every expert, flops scaling E/k-fold): compute is now the
+    ragged T*k rows — the same unified-ragged-step shape the training
+    dropless path uses — while the expert bank is still read exactly
+    once per call.  int8 banks stay int8 all the way into the kernel:
+    the raw stacked ``[E, in, out]`` bank plus its per-(expert,
+    out-channel) ``._scale`` ride as the kernel's ``w``/``w_scale``,
+    which widens one VMEM block at a time and folds the scale into the
+    fp32 accumulator — the gather-then-dequant view moved in-kernel, no
+    dequantized slice ever materialized in HBM.  ``xm`` is any
+    [..., hidden] batch (the unified step's packed [T, h] rows, a
+    decode chunk's [slots, 1, h], prefill's [b, s, h]); routing is per
+    token row."""
+    from ..ops.pallas.grouped_matmul import (align_rows,
+                                             grouped_matmul_raw,
+                                             segment_starts)
+
     cfg = w.cfg
     shape = xm.shape
     x2 = xm.reshape(-1, shape[-1])
@@ -243,22 +260,49 @@ def _moe_ffn(w: _Weights, i, xm):
     probs = jax.nn.softmax(logits, axis=-1)
     top_p, top_ids = lax.top_k(probs, k)              # [T, k]
     top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
-    # per-(token, expert) combine weight: sum of the normalized top-k
-    # weights routed to that expert (0 for unrouted experts)
-    combine = jnp.zeros((x2.shape[0], e), jnp.float32)
-    for j in range(k):
-        combine = combine + top_p[:, j, None] * jax.nn.one_hot(
-            top_ids[:, j], e, dtype=jnp.float32)
-    y = jnp.zeros_like(x2)
-    for eid in range(e):
-        sel = jnp.asarray([eid])
-        wg = w.expert(i, "gate_proj", sel)[0]         # [h, it]
-        wu = w.expert(i, "up_proj", sel)[0]
-        wd = w.expert(i, "down_proj", sel)[0]         # [it, h]
-        gate = x2 @ wg.astype(x2.dtype)
-        up = x2 @ wu.astype(x2.dtype)
-        eo = (jax.nn.silu(gate) * up) @ wd.astype(x2.dtype)
-        y = y + combine[:, eid, None].astype(x2.dtype) * eo
+
+    # ---- sorted ragged dispatch: copies argsorted by expert tile the
+    # block-aligned segment windows the kernel contract wants
+    bm = _MOE_FFN_BLOCK_ROWS
+    tk = x2.shape[0] * k
+    flat_ids = top_ids.reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(flat_ids)                     # stable
+    token_of = order // k
+    sorted_ids = flat_ids[order]
+    wsorted = top_p.reshape(-1)[order]
+    counts = jnp.bincount(flat_ids, length=e).astype(jnp.int32)
+    seg_st = segment_starts(counts, bm)
+    run_st = jnp.cumsum(counts) - counts              # unaligned starts
+    pos = jnp.arange(tk, dtype=jnp.int32) - run_st[sorted_ids]
+    dest = seg_st[sorted_ids] + pos
+    rpad = int(align_rows(tk, bm) + e * bm)           # static worst case
+    xr = jnp.zeros((rpad, x2.shape[1]), x2.dtype).at[dest].set(
+        x2[token_of])
+
+    def bank(proj):
+        name = f"model.layers.{i}.mlp.experts.{proj}.weight"
+        wq = w.p[name]
+        sc = w.p.get(name + "._scale")
+        if sc is None:
+            return wq.astype(x2.dtype), None
+        return wq, sc                                 # int8 + [E, out]
+
+    wids = jnp.arange(e, dtype=jnp.int32)
+
+    def gmm(xin, proj):
+        wq, sc = bank(proj)
+        return grouped_matmul_raw(xin, wq, seg_st, counts, wids,
+                                  block_rows=bm, w_scale=sc)
+
+    gate = gmm(xr, "gate_proj")
+    up = gmm(xr, "up_proj")
+    eo = gmm(jax.nn.silu(gate) * up, "down_proj")     # [rpad, h]
+
+    # ---- combine: gather each copy's expert output, weighted
+    # scatter-add back into token order
+    ys = eo[dest]
+    y = jnp.zeros_like(x2).at[token_of].add(
+        ys * wsorted.astype(x2.dtype)[:, None])
     return y.reshape(shape)
 
 

@@ -27,3 +27,6 @@ from .pallas import flashmask as _flashmask  # noqa: F401  (registers
 from .pallas import decode_attention as _flash_decode  # noqa: F401
 #                          (registers flash_decoding — the Pallas KV-cache
 #                          decode kernel)
+from .pallas import grouped_matmul as _grouped_matmul  # noqa: F401
+#                          (registers grouped_matmul — the ragged segmented
+#                          expert/adapter GEMM of the dropless MoE path)

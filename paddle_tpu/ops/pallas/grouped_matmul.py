@@ -1,0 +1,305 @@
+"""Grouped / segmented matmul (Pallas TPU kernel): one ragged launch
+applies a different ``[in, out]`` weight slice per variable-length row
+segment.
+
+This is the expert-compute half of the dropless MoE path (MegaBlocks'
+grouped GEMM, PAPERS.md; reference kernel family:
+paddle/phi/kernels/fusion/cutlass/moe kernels' grouped GEMM): tokens
+arrive argsorted by destination expert, so expert e owns one contiguous
+row window ``[seg_starts[e], seg_starts[e] + seg_lens[e])`` of the input
+and the kernel multiplies that window by ``w[seg_wids[e]]``.  No
+``[E, C, d]`` capacity buffer exists anywhere — cold experts cost their
+actual rows (an empty segment costs zero grid work beyond the skipped
+steps) and hot experts never drop.
+
+The ragged iteration is the scalar-prefetch index-map idiom this repo
+already ships for decode attention (decode_attention.py's
+clamp-to-last-valid-page maps, the Ragged Paged Attention shape): the
+grid is ``(S, nbmax)`` where ``nbmax`` is the worst case (one segment
+owning every row block), and per-segment block counts read via scalar
+prefetch both gate the MXU work (@pl.when) and drive the DMA (index
+maps).  Because segments are variable, a segment using fewer than
+``nbmax`` blocks must park its skipped steps somewhere safe: they map to
+a dedicated PAD row block appended past the real rows, so no live output
+block is ever flushed with stale VMEM.  The caller slices the pad block
+off.
+
+``seg_wids`` is an indirection, not an identity: several segments may
+reuse one weight slice.  That is exactly the per-row LoRA adapter shape
+(many small row groups, few adapters) — the backward pass scatter-adds
+per-segment dW into slices with ``.at[wids].add``, so repeated ids
+accumulate correctly and the same kernel serves the ROADMAP's
+multi-adapter item.
+
+Contract (callers: parallel/expert.py dropless body, models/generation.py
+``_moe_ffn``):
+- ``x`` [R, K] with R a multiple of ``block_rows``; ``seg_starts`` are
+  ``block_rows``-aligned and ascending (cumsum of block-aligned lens);
+- rows of x inside a segment's alignment slack ``[len, align(len))``
+  must be zero (the dispatch scatter guarantees it) — they then
+  contribute exact zeros to dW;
+- output rows outside ``[start, start+len)`` of some segment are
+  unspecified; callers only gather valid rows.
+- the whole [K, N] weight slice rides in one block (no K/N tiling): fine
+  for MoE FFN slices up to a few MB of VMEM; tile before lifting to
+  multi-thousand hidden sizes.
+
+int8 expert banks: pass the raw quantized bank as ``w`` plus the
+per-(slice, out-channel) dequant scales ``w_scale`` [E, N] — the kernel
+widens in VMEM and folds the scale into the fp32 accumulator, so serving
+never materialises a dequantized bank (the gather-then-dequant path of
+generation._Weights.expert, moved in-kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _CompilerParams, _sds
+
+
+def align_rows(n, block_rows: int):
+    """Round ``n`` up to a multiple of ``block_rows`` (works on ints and
+    traced int arrays)."""
+    return ((n + block_rows - 1) // block_rows) * block_rows
+
+
+def segment_starts(seg_lens, block_rows: int):
+    """Block-aligned exclusive cumsum of segment lengths: the
+    ``seg_starts`` the kernel contract wants (segments densely tile
+    ``[0, sum(align(len)))``)."""
+    aligned = align_rows(seg_lens, block_rows)
+    zero = jnp.zeros((1,), aligned.dtype)
+    return jnp.concatenate([zero, jnp.cumsum(aligned)[:-1]])
+
+
+def _gmm_kernel(*refs, block_rows: int, has_scale: bool):
+    starts_ref, lens_ref, wids_ref = refs[:3]
+    if has_scale:
+        x_ref, w_ref, scale_ref, o_ref = refs[3:]
+    else:
+        x_ref, w_ref, o_ref = refs[3:]
+        scale_ref = None
+    si = pl.program_id(0)
+    j = pl.program_id(1)
+    nblk = (lens_ref[si] + block_rows - 1) // block_rows
+
+    # steps past the segment's last block were parked on the PAD row
+    # block by the index maps; skip their MXU work too
+    @pl.when(j < nblk)
+    def _():
+        xb = x_ref[...]                     # [bm, K]
+        wb = w_ref[0]                       # [K, N]
+        if wb.dtype == jnp.int8:
+            # int8 expert bank: widen the slice in VMEM and fold the
+            # per-out-channel dequant scale into the fp32 accumulator
+            wb = wb.astype(xb.dtype)
+        acc = jax.lax.dot_general(
+            xb, wb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if scale_ref is not None:
+            acc = acc * scale_ref[0][None, :]
+        o_ref[...] = acc.astype(o_ref.dtype)
+
+
+def grouped_matmul_raw(x, w, seg_starts, seg_lens, seg_wids,
+                       block_rows: int = 128, w_scale=None,
+                       interpret=None):
+    """Ragged grouped matmul: ``y[start_s:start_s+len_s] =
+    x[start_s:start_s+len_s] @ w[wid_s]`` for every segment ``s`` in one
+    launch.  x [R, K] (R % block_rows == 0, see module contract);
+    w [E, K, N]; seg_starts/seg_lens/seg_wids [S] int32; optional
+    w_scale [E, N] dequant scales for an int8 ``w``.  Returns y [R, N]
+    in x's dtype (rows outside valid segments unspecified)."""
+    R, K = x.shape
+    E, Kw, N = w.shape
+    if Kw != K:
+        raise ValueError(f"x inner dim {K} != weight inner dim {Kw}")
+    S = seg_starts.shape[0]
+    bm = int(block_rows)
+    if R % bm:
+        raise ValueError(f"rows {R} not a multiple of block_rows {bm}")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if R == 0 or S == 0:
+        return jnp.zeros((R, N), x.dtype)
+    pad_blk = R // bm                       # the appended safe block
+    nbmax = R // bm                         # worst case: one segment owns all
+
+    xp = jnp.concatenate([x, jnp.zeros((bm, K), x.dtype)], axis=0)
+    starts = seg_starts.astype(jnp.int32)
+    lens = seg_lens.astype(jnp.int32)
+    wids = seg_wids.astype(jnp.int32)
+
+    def row_map(si, j, starts_ref, lens_ref, wids_ref):
+        # blocks past the segment end park on the PAD block: the skipped
+        # steps never touch a live output block, and consecutive parked
+        # steps revisit the same block so Mosaic elides the DMA
+        nblk = (lens_ref[si] + bm - 1) // bm
+        return (jnp.where(j < nblk, starts_ref[si] // bm + j, pad_blk), 0)
+
+    def w_map(si, j, starts_ref, lens_ref, wids_ref):
+        return (wids_ref[si], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((bm, K), row_map),
+        pl.BlockSpec((1, K, N), w_map),
+    ]
+    operands = [xp, w]
+    if w_scale is not None:
+        def scale_map(si, j, starts_ref, lens_ref, wids_ref):
+            return (wids_ref[si], 0)
+        in_specs.append(pl.BlockSpec((1, N), scale_map))
+        operands.append(w_scale.astype(jnp.float32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, nbmax),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, N), row_map),
+    )
+    out = pl.pallas_call(
+        functools.partial(_gmm_kernel, block_rows=bm,
+                          has_scale=w_scale is not None),
+        grid_spec=grid_spec,
+        out_shape=_sds((R + bm, N), x.dtype),
+        # segments share the PAD output block, so si is not parallel
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(starts, lens, wids, *operands)
+    return out[:R]
+
+
+def _outer_kernel(starts_ref, lens_ref, x_ref, dy_ref, o_ref, acc_scr, *,
+                  block_rows: int):
+    si = pl.program_id(0)
+    j = pl.program_id(1)
+    nblk = (lens_ref[si] + block_rows - 1) // block_rows
+
+    @pl.when(j == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j < nblk)
+    def _():
+        acc_scr[:] += jax.lax.dot_general(
+            x_ref[...], dy_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # store UNCONDITIONALLY: every (si, j) step rewrites segment si's
+    # output block, so empty segments emit exact zeros and skipped steps
+    # just restate the running value — no block is ever left with stale
+    # VMEM (the output-coverage dual of the PAD trick above)
+    o_ref[0] = acc_scr[:]
+
+
+def grouped_outer_raw(x, dy, seg_starts, seg_lens, block_rows: int = 128,
+                      interpret=None):
+    """Per-segment outer product ``out[s] = x[win_s].T @ dy[win_s]`` —
+    the dW half of the grouped matmul backward.  x [R, K]; dy [R, N];
+    returns [S, K, N] float32.  Alignment-slack rows of x are zero by
+    the module contract, so they contribute exact zeros regardless of
+    dy's content there."""
+    R, K = x.shape
+    Rd, N = dy.shape
+    if Rd != R:
+        raise ValueError(f"x rows {R} != dy rows {Rd}")
+    S = seg_starts.shape[0]
+    bm = int(block_rows)
+    if R % bm:
+        raise ValueError(f"rows {R} not a multiple of block_rows {bm}")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if S == 0:
+        return jnp.zeros((0, K, N), jnp.float32)
+    if R == 0:
+        return jnp.zeros((S, K, N), jnp.float32)
+    pad_blk = R // bm
+    nbmax = R // bm
+
+    xp = jnp.concatenate([x, jnp.zeros((bm, K), x.dtype)], axis=0)
+    dyp = jnp.concatenate([dy, jnp.zeros((bm, N), dy.dtype)], axis=0)
+    starts = seg_starts.astype(jnp.int32)
+    lens = seg_lens.astype(jnp.int32)
+
+    def row_map(si, j, starts_ref, lens_ref):
+        nblk = (lens_ref[si] + bm - 1) // bm
+        return (jnp.where(j < nblk, starts_ref[si] // bm + j, pad_blk), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, nbmax),
+        in_specs=[
+            pl.BlockSpec((bm, K), row_map),
+            pl.BlockSpec((bm, N), row_map),
+        ],
+        out_specs=pl.BlockSpec((1, K, N), lambda si, j, s, l: (si, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((K, N), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_outer_kernel, block_rows=bm),
+        grid_spec=grid_spec,
+        out_shape=_sds((S, K, N), jnp.float32),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(starts, lens, xp, dyp)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_grouped_matmul(block_rows: int):
+    @jax.custom_vjp
+    def gmm(x, w, seg_starts, seg_lens, seg_wids):
+        return grouped_matmul_raw(x, w, seg_starts, seg_lens, seg_wids,
+                                  block_rows=block_rows)
+
+    def fwd(x, w, seg_starts, seg_lens, seg_wids):
+        y = grouped_matmul_raw(x, w, seg_starts, seg_lens, seg_wids,
+                               block_rows=block_rows)
+        return y, (x, w, seg_starts, seg_lens, seg_wids)
+
+    def bwd(res, dy):
+        x, w, seg_starts, seg_lens, seg_wids = res
+        # dx: the same ragged launch against the transposed slices
+        dx = grouped_matmul_raw(
+            dy, w.swapaxes(1, 2), seg_starts, seg_lens, seg_wids,
+            block_rows=block_rows).astype(x.dtype)
+        # dW: per-segment outer products scatter-added into slices —
+        # repeated seg_wids (the adapter shape) accumulate correctly
+        dwseg = grouped_outer_raw(x, dy, seg_starts, seg_lens,
+                                  block_rows=block_rows)
+        dw = jnp.zeros(w.shape, jnp.float32).at[seg_wids].add(
+            dwseg).astype(w.dtype)
+        f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+        return (dx, dw, f0(seg_starts), f0(seg_lens), f0(seg_wids))
+
+    gmm.defvjp(fwd, bwd)
+    return gmm
+
+
+def grouped_matmul(x, w, seg_starts, seg_lens, seg_wids,
+                   block_rows: int = 128):
+    """Differentiable grouped matmul (float weight banks, training):
+    forward is ``grouped_matmul_raw``; backward runs the transposed
+    ragged launch for dx and per-segment outer products scatter-added
+    over ``seg_wids`` for dW."""
+    return _make_grouped_matmul(int(block_rows))(
+        x, w, seg_starts, seg_lens, seg_wids)
+
+
+# framework op registration
+from ..registry import register  # noqa: E402
+
+
+@register("grouped_matmul", amp="white")
+def grouped_matmul_op(x, w, seg_starts, seg_lens, seg_wids,
+                      block_rows: int = 128):
+    return grouped_matmul(x, w, seg_starts, seg_lens, seg_wids,
+                          block_rows=block_rows)

@@ -398,6 +398,15 @@ def _install():
         # reference semantics return the input)
         "vdot", "addbmm", "addmv", "addr", "fmod", "fix", "negative",
         "positive", "erfc", "divide_no_nan",
+        # ---- round-22 tranche: the activation method forms (stanh
+        # shipped round-14 — this closes the family the reference also
+        # patches onto Tensor) plus the true_divide base whose in-place
+        # form shipped round-19; none of these have reference in-place
+        # partners to ride inplace_methods
+        "relu", "silu", "gelu", "selu", "elu", "celu", "leaky_relu",
+        "softmax", "log_softmax", "softplus", "softsign", "softshrink",
+        "hardshrink", "hardsigmoid", "hardswish", "hardtanh",
+        "true_divide",
     ]
 
     def mk_top(opname):

@@ -80,7 +80,9 @@ from .specs import (EXPERT_AXIS, SpecLayout, TensorSpec, expert_leaf_spec,
 __all__ = ["EXPERT_AXIS", "MoEEPConfig", "make_ep_all_to_all",
            "moe_ep_shapes", "moe_ep_spec_for", "moe_ep_layout",
            "init_moe_ep_params", "build_moe_ep_forward",
-           "build_moe_ep_train_step", "build_moe_dense_train_step"]
+           "build_moe_ep_train_step", "build_moe_dense_train_step",
+           "build_moe_ep_dropless_forward",
+           "build_moe_ep_dropless_train_step"]
 
 
 # ---------------------------------------------------------------------------
@@ -451,6 +453,251 @@ def build_moe_ep_forward(cfg: MoEEPConfig, mesh: Mesh,
     moe_ep_entry.ep = ep
     moe_ep_entry.e_local = e_local
     return moe_ep_entry
+
+
+# ---------------------------------------------------------------------------
+# the DROPLESS EP forward: sorted ragged dispatch + grouped matmul
+# ---------------------------------------------------------------------------
+
+
+def build_moe_ep_dropless_forward(cfg: MoEEPConfig, mesh: Mesh,
+                                  oc: Optional[OverlapConfig] = None,
+                                  batch_axes: Tuple[str, ...] = (
+                                      "dp", "sharding", EXPERT_AXIS),
+                                  block_rows: int = 8):
+    """The dropless EP MoE region (round-20 tentpole; MegaBlocks'
+    dropless formulation on the repo's ragged-kernel idiom):
+
+        fwd(params, x2d) -> (y, aux, dropped, load)
+
+    Same signature, plan and stats contract as ``build_moe_ep_forward``
+    but NO ``[E, C, d]`` capacity buffer exists anywhere — ``dropped``
+    is structurally zero and no capacity-factor sweep is needed.  Per
+    rank:
+
+    1. **sorted ragged dispatch** — the top-k (expert, weight) pairs
+       come straight from ``lax.top_k`` (selection and raw-prob weights
+       identical to the capacity gate's iterative argmax), token copies
+       are argsorted by destination expert, and per-(rank, expert)
+       segment counts are exchanged FIRST through the two-stage
+       hierarchical all-to-all (codec=None — counts are int32 control
+       plane, bit-exactness mandatory).  The payload then moves as a
+       variable-split all-to-all emulated over the SAME coded exchange:
+       each destination rank owns a static window of ``T = g_local *
+       top_k`` rows (the dropless worst case) with only the first
+       ``counts`` rows live, so tokens crossing DCN still ride the
+       block-scaled stochastic-int8 stage (strict
+       quantize-across-DCN-only) and the ``custom_vjp`` involution
+       still makes backward combine the transposed dispatch.
+    2. **grouped matmul expert FFN** — received copies compact into
+       block-aligned ragged segments (one per local expert, lengths
+       from the counts exchange) and ``ops/pallas/grouped_matmul``
+       applies each expert's ``[in, out]`` slice to its row window in
+       one launch; alignment-slack rows stay zero per the kernel
+       contract.
+    3. **combine** — the transposed gather back through the same coded
+       exchange, then a weighted scatter-add into token order (for
+       top_k<=2 bit-equal to the capacity einsum's expert-ascending
+       summation by fp commutativity).
+
+    ``block_rows`` is the kernel's row-block size (segment alignment
+    quantum); tests run 8 to exercise multi-block segments at toy
+    sizes."""
+    EP = EXPERT_AXIS
+    oc = oc if oc is not None else OverlapConfig()
+    sizes = mesh_axis_sizes(mesh)
+    data_axes = tuple(a for a in batch_axes if sizes.get(a, 0) > 1)
+    ep = int(sizes.get(EP, 1))
+    ep_ax = EP if ep > 1 else None
+    e = cfg.num_expert
+    if e % ep:
+        raise ValueError(
+            f"num_expert {e} not divisible by ep degree {ep} — expert "
+            f"stacks Shard(0) over ep need equal local expert counts")
+    e_local = e // ep
+    hier = oc.resolve_hier(mesh, ep_ax) if ep_ax is not None else None
+    # quantize-across-DCN-only: no hierarchical ep axis -> codec inert
+    codec = oc.codec if hier is not None else None
+    exchange = make_ep_all_to_all(ep_ax, hier=hier, codec=codec)
+    # the control-plane exchange: int32 segment counts, never quantized
+    exchange_counts = make_ep_all_to_all(ep_ax, hier=hier, codec=None)
+    bm = int(block_rows)
+
+    from ..ops.pallas.grouped_matmul import (align_rows, grouped_matmul,
+                                             segment_starts)
+
+    batch_entry = (data_axes if len(data_axes) > 1
+                   else (data_axes[0] if data_axes else None))
+    in_specs = (
+        {name: filter_divisible_spec(moe_ep_spec_for(name),
+                                     moe_ep_shapes(cfg)[name], mesh)
+         for name in moe_ep_shapes(cfg)},
+        P(batch_entry, None),
+    )
+    out_specs = (P(batch_entry, None), P(batch_entry, None))
+
+    def moe_ep_dropless_body(params, x2d):
+        gate_w = params["gate_w"]
+        w_up, b_up = params["w_up"], params["b_up"]
+        w_down, b_down = params["w_down"], params["b_down"]
+
+        g_local, m = x2d.shape
+        logits = x2d.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        # lax.top_k == the capacity gate's iterative argmax (ties to the
+        # lowest index) with the same RAW-prob combine weights
+        top_p, top_ids = lax.top_k(probs, cfg.top_k)
+
+        T = g_local * cfg.top_k              # copies = dropless worst case
+        W = T                                # per-destination row window
+        flat_ids = top_ids.reshape(-1).astype(jnp.int32)
+        order = jnp.argsort(flat_ids)        # stable: ascending expert id
+        token_of = order // cfg.top_k
+        sorted_ids = flat_ids[order]
+        wsorted = top_p.reshape(-1)[order]
+
+        # ---- counts first: per-(source rank, local expert) segment
+        # lengths cross the wire before any payload — row p of
+        # counts_from is what source rank p routed to MY local experts
+        counts = jnp.bincount(flat_ids, length=e).astype(jnp.int32)
+        counts_from = exchange_counts(
+            counts.reshape(ep, e_local)).reshape(ep, e_local)
+
+        # ---- dispatch: destination-windowed scatter, one coded a2a.
+        # copies are expert-sorted, hence destination-rank-sorted: rank
+        # r's copies occupy [rank_starts[r], rank_starts[r]+rank_counts
+        # [r]) and land at the head of r's window; tail rows stay zero
+        rank_of = sorted_ids // e_local
+        rank_counts = counts.reshape(ep, e_local).sum(axis=1)
+        rank_starts = jnp.cumsum(rank_counts) - rank_counts
+        pos = jnp.arange(T, dtype=jnp.int32) - rank_starts[rank_of]
+        send = jnp.zeros((ep * W, m), x2d.dtype).at[
+            rank_of * W + pos].set(x2d[token_of])
+        recv = exchange(send)                # window p = rows FROM rank p
+
+        # ---- compact the windowed rows into block-aligned ragged
+        # segments (one per local expert): row q of window p belongs to
+        # local expert l = searchsorted(cumsum(counts_from[p]), q) and
+        # lands at segment_start[l] + (rows from earlier ranks for l) +
+        # (its index within the (p, l) run)
+        cum_in = jnp.cumsum(counts_from, axis=1)          # incl, within row
+        off_in = cum_in - counts_from                     # excl, within row
+        col_ex = jnp.cumsum(counts_from, axis=0) - counts_from
+        tot_l = counts_from.sum(axis=0)                   # [e_local] seg lens
+        seg_st = segment_starts(tot_l, bm)
+        rows_used = jnp.sum(align_rows(tot_l, bm))
+        # static padded row count: every segment's alignment slack
+        rpad = int(align_rows(ep * W, bm) + e_local * bm)
+        q = jnp.arange(W, dtype=jnp.int32)
+        l_pq = jax.vmap(
+            lambda c: jnp.searchsorted(c, q, side="right"))(cum_in)
+        l_c = jnp.minimum(l_pq, e_local - 1)              # [ep, W]
+        valid = q[None, :] < cum_in[:, -1:]               # [ep, W]
+        p_idx = jnp.arange(ep, dtype=jnp.int32)[:, None]
+        dest = (seg_st[l_c] + col_ex[p_idx, l_c]
+                + (q[None, :] - off_in[p_idx, l_c]))      # [ep, W]
+        destf = jnp.where(valid, dest, rpad).reshape(-1)
+        xr = jnp.zeros((rpad, m), x2d.dtype).at[destf].set(
+            recv, mode="drop")
+
+        # ---- grouped-matmul expert FFN over the ragged segments.
+        # rexp maps padded row -> owning local expert (bias gather);
+        # rows past the last segment are masked (kernel output there is
+        # unspecified), which also zeroes their backward flow
+        blk_cum = jnp.cumsum(align_rows(tot_l, bm))
+        rexp = jnp.minimum(
+            jnp.searchsorted(blk_cum, jnp.arange(rpad), side="right"),
+            e_local - 1)
+        row_valid = (jnp.arange(rpad) < rows_used)[:, None]
+        wids = jnp.arange(e_local, dtype=jnp.int32)
+        h = grouped_matmul(xr, w_up.astype(x2d.dtype), seg_st, tot_l,
+                           wids, block_rows=bm)
+        h = jnp.where(row_valid, h + b_up.astype(h.dtype)[rexp], 0.0)
+        h = _activation(h, cfg.activation)
+        eo = grouped_matmul(h, w_down.astype(h.dtype), seg_st, tot_l,
+                            wids, block_rows=bm)
+
+        # ---- combine: gather each window row's expert output (+ its
+        # expert bias) back into the windowed layout, transposed
+        # exchange, then the weighted scatter into token order
+        dest_cl = jnp.minimum(dest, rpad - 1).reshape(-1)
+        l_flat = l_c.reshape(-1)
+        back = jnp.where(valid.reshape(-1)[:, None],
+                         eo[dest_cl] + b_down.astype(eo.dtype)[l_flat],
+                         0.0)
+        recv2 = exchange(back.astype(x2d.dtype))
+        ys = recv2[rank_of * W + pos]
+        y = jnp.zeros((g_local, m), x2d.dtype).at[token_of].add(
+            ys * wsorted.astype(x2d.dtype)[:, None])
+
+        # ---- stats row: same contract as the capacity body; dropped
+        # is STRUCTURALLY zero — that is the point
+        top1 = jnp.argmax(probs, axis=-1)
+        frac = jax.nn.one_hot(top1, e, dtype=jnp.float32).mean(axis=0)
+        me = probs.mean(axis=0)
+        stats = jnp.concatenate(
+            [me, lax.stop_gradient(frac), jnp.zeros((1,), jnp.float32)])
+        return y, stats[None, :]
+
+    fwd = shard_map(moe_ep_dropless_body, mesh=mesh,
+                    axis_names=set(mesh.axis_names),
+                    in_specs=in_specs, out_specs=out_specs,
+                    check_vma=False)
+
+    # NOTE the name: the shard_map TRANSPOSE re-binds backward
+    # collectives with the provenance of the region call site — this
+    # wrapper must be in overlap.OVERLAP_REGION_FUNCS for COMM002 to
+    # attribute them to the engine (same gotcha as moe_ep_entry).
+    def moe_ep_dropless_entry(params, x2d):
+        y, stats = fwd(params, x2d)
+        me = stats[:, :e].mean(axis=0)
+        load = lax.stop_gradient(stats[:, e:2 * e]).mean(axis=0)
+        aux = e * jnp.sum(load * me)
+        dropped = lax.stop_gradient(stats[:, 2 * e]).sum()
+        return y, aux, dropped, load
+
+    moe_ep_dropless_entry.hier = hier
+    moe_ep_dropless_entry.codec = codec
+    moe_ep_dropless_entry.ep = ep
+    moe_ep_dropless_entry.e_local = e_local
+    moe_ep_dropless_entry.block_rows = bm
+    return moe_ep_dropless_entry
+
+
+def build_moe_ep_dropless_train_step(cfg: MoEEPConfig, mesh: Mesh,
+                                     oc: Optional[OverlapConfig] = None,
+                                     batch_axes: Tuple[str, ...] = (
+                                         "dp", "sharding", EXPERT_AXIS),
+                                     lr: float = 1e-2,
+                                     block_rows: int = 8):
+    """Jitted donated DROPLESS EP train step — the same residual MSE +
+    aux objective as ``build_moe_ep_train_step`` (1:1 loss comparisons,
+    ``dropped`` always 0), over the sorted-ragged-dispatch forward."""
+    fwd = build_moe_ep_dropless_forward(cfg, mesh, oc=oc,
+                                        batch_axes=batch_axes,
+                                        block_rows=block_rows)
+    sizes = mesh_axis_sizes(mesh)
+    data_axes = tuple(a for a in batch_axes if sizes.get(a, 0) > 1)
+    batch_entry = (data_axes if len(data_axes) > 1
+                   else (data_axes[0] if data_axes else None))
+    data_sharding = NamedSharding(mesh, P(batch_entry, None))
+
+    def loss_fn(params, x2d, tgt):
+        y, aux, dropped, load = fwd(params, x2d)
+        g = x2d.shape[0]
+        total, aux_term = _moe_loss(y, x2d, tgt, aux, cfg.aux_weight)
+        return total / g + aux_term, (aux, dropped, load)
+
+    def step(params, x2d, tgt):
+        x2d = jax.lax.with_sharding_constraint(x2d, data_sharding)
+        tgt = jax.lax.with_sharding_constraint(tgt, data_sharding)
+        (loss, (aux, dropped, load)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, x2d, tgt)
+        new_params = {k: v - lr * grads[k].astype(v.dtype)
+                      for k, v in params.items()}
+        return loss, aux, dropped, load, new_params
+
+    return jax.jit(step, donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------

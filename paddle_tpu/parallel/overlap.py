@@ -875,6 +875,10 @@ OVERLAP_REGION_FUNCS = frozenset({
     # the region entry whose name the shard_map transpose re-binds
     "ep_exchange", "_ep_exchange_impl", "_dcn_a2a_coded",
     "_ep_exchange_fwd", "_ep_exchange_bwd", "moe_ep_body", "moe_ep_entry",
+    # round-20 dropless entries (parallel/expert.py): the sorted ragged
+    # dispatch rides the SAME ep_exchange custom_vjp; these are the new
+    # region body/entry frames the shard_map transpose re-binds to
+    "moe_ep_dropless_body", "moe_ep_dropless_entry",
 })
 
 

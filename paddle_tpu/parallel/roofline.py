@@ -211,6 +211,21 @@ class ModelCostSheet:
     vocab: int
     num_experts: int = 0
     moe_top_k: int = 2
+    # -- round-20 MoE engine pricing knobs (defaults keep every pinned
+    #    prediction byte-identical: eff-rows-per-token = top_k) --------
+    #: price the DROPLESS engine: expert FLOPs and dispatch payload are
+    #: the variable segments actually routed — NO capacity padding term
+    moe_dropless: bool = False
+    #: measured balance point of the dropless engine (>= 1): ragged
+    #: wall-clock tracks the max-loaded ep shard, so variable-segment
+    #: work is priced at (balance * top_k) rows per token (1.0 =
+    #: perfectly balanced routing; bench --moe-trace measures it as
+    #: max/mean expert load)
+    moe_balance: float = 1.0
+    #: capacity engine's padding factor (cf): the static [E, C, d]
+    #: buffer computes/ships cf * top_k rows per token regardless of
+    #: routing.  0.0 = unpriced (legacy pins)
+    moe_capacity_factor: float = 0.0
 
     # -- per-layer element counts ------------------------------------------
 
@@ -236,6 +251,20 @@ class ModelCostSheet:
             return 0
         return (self.num_experts * 3 * self.hidden * self.intermediate
                 + self.hidden * self.num_experts)
+
+    @property
+    def moe_eff_rows_per_token(self) -> float:
+        """Expert-FFN rows computed (and dispatched) per token under the
+        declared MoE engine: the DROPLESS engine prices the variable
+        segments actually routed at the measured balance point —
+        ``balance * top_k``, no capacity padding term — while the
+        capacity engine prices its static padded buffer,
+        ``cf * top_k`` (cf == 0 keeps the legacy unpriced top_k)."""
+        if self.moe_dropless:
+            return self.moe_balance * self.moe_top_k
+        if self.moe_capacity_factor > 0:
+            return self.moe_capacity_factor * self.moe_top_k
+        return float(self.moe_top_k)
 
     @property
     def layer_gathered_elems(self) -> int:
@@ -280,7 +309,7 @@ class ModelCostSheet:
         tokens = batch * seq
         per_tok = 2.0 * (self.layer_attn_elems + self.layer_mlp_elems)
         if self.num_experts:
-            per_tok += 2.0 * self.moe_top_k * (
+            per_tok += 2.0 * self.moe_eff_rows_per_token * (
                 3 * self.hidden * self.intermediate) \
                 + 2.0 * self.hidden * self.num_experts
         attn = 4.0 * seq * self.hidden          # QK^T + AV per token
@@ -308,7 +337,11 @@ def llama_cost_sheet(cfg) -> ModelCostSheet:
         head_dim=hd,
         vocab=int(cfg.vocab_size),
         num_experts=int(getattr(cfg, "num_experts", 0) or 0),
-        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2))
+        moe_top_k=int(getattr(cfg, "moe_top_k", 2) or 2),
+        moe_dropless=bool(getattr(cfg, "moe_dropless", False)),
+        moe_balance=float(getattr(cfg, "moe_balance", 1.0) or 1.0),
+        moe_capacity_factor=float(
+            getattr(cfg, "moe_capacity_factor", 0.0) or 0.0))
 
 
 #: MemoryConfig.remat -> extra forward passes recomputed in backward
@@ -467,10 +500,12 @@ def predict_wire_table(axes, slice_map, sheet: ModelCostSheet, *,
         add(ici, "sep_alltoall",
             4 * L * ring_wire_cost("alltoall", act, sep))
 
-    # -- ep dispatch/return all-to-alls (ICI; capacity-factored tokens)
+    # -- ep dispatch/return all-to-alls (ICI; engine-factored tokens:
+    #    dropless ships balance*top_k rows, capacity ships cf*top_k)
     if ep > 1 and sheet.num_experts:
         tokens = (batch // max(1, dp)) * (seq // max(1, sep))
-        payload = tokens * sheet.moe_top_k * sheet.hidden
+        payload = int(tokens * sheet.moe_eff_rows_per_token
+                      * sheet.hidden)
         nbytes = (_packed(codec, payload) if codec is not None
                   else payload * isz)
         add(ici, "ep_dispatch",

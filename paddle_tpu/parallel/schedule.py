@@ -64,7 +64,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .specs import (SpecLayout, TensorSpec, _entry_axes,
+from .specs import (EXPERT_AXIS, SpecLayout, TensorSpec, _entry_axes,
                     filter_divisible_spec, filter_spec_to_mesh,
                     layout_mesh_axes, mesh_axis_sizes, spec_to_dim_axes)
 
@@ -101,9 +101,21 @@ TACTICS: Dict[str, Tactic] = {
     "sep": Tactic("sep", "sep", "data"),
     "tp": Tactic("tp", "mp", "weight"),
     "ep": Tactic("ep", "ep", "both"),
+    # round-20: the dropless-transport variant of ``ep``.  Placement is
+    # IDENTICAL (expert leaves Shard(ep), tokens batch over ep) — the
+    # name declares the TRANSPORT: sorted ragged dispatch + grouped
+    # matmul instead of the [E, C, d] capacity buffer, so schedules and
+    # Doctor tables can carry which MoE engine a plan means.
+    "ep_dropless": Tactic("ep_dropless", "ep", "both"),
 }
 
-_AXIS_TO_TACTIC = {t.axis: t for t in TACTICS.values()}
+# axis -> its PRIMARY tactic (first entry per axis wins: a mesh's bare
+# "ep" axis still derives the capacity-engine tactic by default;
+# "ep_dropless" is selected by name, e.g. from_moe_ep(dropless=True))
+_AXIS_TO_TACTIC: Dict[str, Tactic] = {}
+for _t in TACTICS.values():
+    _AXIS_TO_TACTIC.setdefault(_t.axis, _t)
+del _t
 
 
 def tactics_for_mesh(mesh: Mesh) -> Tuple[Tactic, ...]:
@@ -469,17 +481,27 @@ class PartitionSchedule:
 
     @classmethod
     def from_moe_ep(cls, cfg, mesh: Mesh, dtype: str = "float32",
-                    tactics: Optional[Sequence[str]] = None
-                    ) -> "PartitionSchedule":
+                    tactics: Optional[Sequence[str]] = None,
+                    dropless: bool = False) -> "PartitionSchedule":
         """The EP constructor: the MoE block's declared plan
         (``expert.moe_ep_layout`` — expert-stacked leaves lead with
         ``ep``, the shared gate replicates) wired through the unified
         schedule so ``ep`` composes with dp/sharding/tp/pp in the
         declared-plan vocabulary (and the roofline enumerator can emit
         ep points that answer the same table queries).  ``cfg`` is a
-        ``MoEEPConfig``."""
+        ``MoEEPConfig``.
+
+        ``dropless=True`` names the ``ep_dropless`` tactic on the ep
+        axis instead of ``ep``: the at-rest table is byte-identical
+        (the dropless engine changes the token TRANSPORT, not the
+        placement), but the schedule's tactic names — what DOCTOR.json
+        and the autotuner records carry — declare the sorted-ragged
+        engine, so a recovered plan rebuilds the right train step."""
         from .expert import moe_ep_shapes, moe_ep_spec_for
 
+        if tactics is None and dropless:
+            tactics = ["ep_dropless" if t.axis == EXPERT_AXIS else t.name
+                       for t in tactics_for_mesh(mesh)]
         return cls.from_plan(mesh, moe_ep_shapes(cfg), moe_ep_spec_for,
                              dtype=dtype, tactics=tactics)
 

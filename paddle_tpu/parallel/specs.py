@@ -41,6 +41,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 # vocabulary (dp / sharding / tp / ep), not a fourth hand-coded stack.
 EXPERT_AXIS = "ep"
 
+# the dropless-transport tactic NAME on the expert axis (round-20):
+# schedules/Doctor tables say "ep_dropless" to mean the sorted-ragged
+# dispatch + grouped-matmul engine instead of the [E, C, d] capacity
+# engine.  Placement vocabulary is unchanged — expert leaves still lead
+# with EXPERT_AXIS — which is why this is a tactic name, not a new axis.
+EXPERT_DROPLESS_TACTIC = "ep_dropless"
+
 # name markers of expert-stacked leaves: the MoELayer/gpt_moe stacked
 # parameter names (w_up/b_up/w_down/b_down with a leading [E] dim) and
 # the serving sparse-checkpoint naming (model.layers.*.mlp.experts.*).

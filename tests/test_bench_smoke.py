@@ -84,7 +84,11 @@ def test_bench_smoke_green():
                 # dispatch (loss decreases), the dispatch all-to-alls'
                 # DCN bytes shrink >= 3x under the pinned COMM004 wire
                 # budget, and the COMM004[moe_dispatch] fixture fires
-                # exactly
+                # exactly; round-20 adds the DROPLESS engine legs —
+                # capacity-vs-dropless tokens/s, the dropless dispatch
+                # a2a >= 3x coded under ITS pinned budget with a
+                # structurally zero dropped rate, and the
+                # COMM004[moe_dropless] fixture firing exactly
                 "moe_trace",
                 # round-19: the unified partitioning schedule — the
                 # schedule-derived accum-4 reshard bill within the NEW

@@ -1,0 +1,240 @@
+"""Dropless MoE end-to-end (round-20 tentpole; sorted ragged dispatch
++ grouped/segmented Pallas matmul over ep in parallel/expert.py).
+
+Covers, per the round-20 contract:
+- engine parity at ample capacity (cf -> inf): the dropless step's
+  step-0 loss and aux are BIT-EQUAL to the capacity engine's, and the
+  per-leaf gradients agree within 2e-7 (the engines share the gate and
+  the expert arithmetic; only the transport differs);
+- forced skew: the capacity engine drops > 0 assignments while the
+  dropless engine drops EXACTLY 0 — structurally, no [E, C, d] buffer
+  exists — with matched-or-fewer dispatch wire bytes (the variable
+  split beats the padded capacity payload precisely when routing
+  skews);
+- transport: the two-stage hierarchical dropless step with the codec
+  OFF is bit-identical to the flat exchange (same involution
+  custom_vjp as the capacity engine);
+- the declared-plan vocabulary: ``ep_dropless`` names the engine in
+  PartitionSchedule without moving a single placement (transport
+  choice, not a placement choice).
+
+Heavy breadth combos are pytest.mark.slow with their tier-1 home
+annotated in place (ROADMAP tier policy); the COMM004[moe_dropless]
+fixture + pinned-budget clean sweep ride tests/test_analysis_passes.py
+and the doctor/bench legs.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle  # noqa: F401 (registers ops)
+from paddle_tpu.analysis.passes.collective_budget import \
+    collect_wire_table
+from paddle_tpu.parallel.codec import CollectiveCodec
+from paddle_tpu.parallel.expert import (MoEEPConfig, _moe_loss,
+                                        build_moe_ep_dropless_forward,
+                                        build_moe_ep_dropless_train_step,
+                                        build_moe_ep_forward,
+                                        build_moe_ep_train_step,
+                                        init_moe_ep_params)
+from paddle_tpu.parallel.overlap import OverlapConfig
+
+_SM = (0, 0, 1, 1)
+
+
+def _ep_mesh():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest must force 8 host devices"
+    return Mesh(np.asarray(devs[:8], dtype=object).reshape(1, 2, 4),
+                ("dp", "sharding", "ep"))
+
+
+def _data(g, m, seed=7):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.standard_normal((g, m)), jnp.float32),
+            jnp.asarray(rng.standard_normal((g, m)), jnp.float32))
+
+
+def _ample_cfg(m=16, h=32, e=8, g=64):
+    """cf -> inf: capacity pinned ABOVE the token count, so the
+    capacity engine provably drops nothing and the engines compute the
+    same function."""
+    return MoEEPConfig(d_model=m, d_hidden=h, num_expert=e, top_k=2,
+                       capacity=g * 2, aux_weight=0.01)
+
+
+# ---------------------------------------------------------------------------
+# parity at cf -> inf
+# ---------------------------------------------------------------------------
+
+
+def test_dropless_step0_bitequal_at_ample_capacity():
+    """Dropless == capacity when nothing CAN drop: step-0 loss and aux
+    bit-equal on identical params/data (selection, weights and the
+    combine order all line up; fp addition commutes only because the
+    combine adds at most top_k=2 addends per token in a fixed
+    order)."""
+    mesh = _ep_mesh()
+    cfg = _ample_cfg()
+    x2d, tgt = _data(64, cfg.d_model)
+    lc, ac, dc, _, _ = build_moe_ep_train_step(cfg, mesh)(
+        init_moe_ep_params(cfg, mesh), x2d, tgt)
+    ld, ad, dd, _, _ = build_moe_ep_dropless_train_step(cfg, mesh)(
+        init_moe_ep_params(cfg, mesh), x2d, tgt)
+    assert np.asarray(lc).tobytes() == np.asarray(ld).tobytes()
+    assert np.asarray(ac).tobytes() == np.asarray(ad).tobytes()
+    assert float(dc) == 0.0 and float(dd) == 0.0
+
+
+@pytest.mark.parametrize("shape", [(16, 32, 8)])
+def test_dropless_grads_match_capacity(shape):
+    """Per-leaf gradient parity within 2e-7 at cf -> inf — an ep-axis
+    sync bug on the ragged path (double-counted expert grads, a
+    missing gate reduction, cotangent leakage through the alignment
+    slack rows) shows up orders of magnitude above this bound."""
+    m, h, e = shape
+    mesh = _ep_mesh()
+    cfg = _ample_cfg(m, h, e)
+    g = 64
+    x2d, tgt = _data(g, m)
+    params = init_moe_ep_params(cfg, mesh)
+    fc = build_moe_ep_forward(cfg, mesh)
+    fd = build_moe_ep_dropless_forward(cfg, mesh)
+
+    def loss(fwd, p):
+        y, aux, dropped, load = fwd(p, x2d)
+        tot, at = _moe_loss(y, x2d, tgt, aux, cfg.aux_weight)
+        return tot / g + at
+
+    gc = jax.jit(jax.grad(lambda p: loss(fc, p)))(params)
+    gd = jax.jit(jax.grad(lambda p: loss(fd, p)))(params)
+    for k in gc:
+        diff = np.abs(np.asarray(gc[k], np.float64)
+                      - np.asarray(gd[k], np.float64)).max()
+        assert diff <= 2e-7, (k, diff)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shape", [(8, 16, 4)])
+def test_dropless_grads_match_capacity_breadth(shape):
+    """Tier-2 breadth: the second toy scale of the grad-parity grid
+    (tier-1 home: test_dropless_grads_match_capacity on the flagship
+    shape in this file)."""
+    test_dropless_grads_match_capacity(shape)
+
+
+# ---------------------------------------------------------------------------
+# forced skew: the reason dropless exists
+# ---------------------------------------------------------------------------
+
+
+def test_forced_skew_capacity_drops_dropless_does_not():
+    """Route (almost) everything at one expert: the capacity engine's
+    [E, C, d] buffer overflows and REFUSES assignments; the dropless
+    engine routes every one of them — dropped is structurally zero —
+    and its dispatch moves FEWER bytes over the wire than the padded
+    capacity payload (counts sidecar included)."""
+    mesh = _ep_mesh()
+    # g_local = 8 tokens/rank; top_k=1, cf=6 -> C = 7 slots for 8
+    # skewed assignments: guaranteed >= 1 drop per rank
+    cfg = MoEEPConfig(d_model=16, d_hidden=32, num_expert=8, top_k=1,
+                      capacity_factor=6.0, aux_weight=0.01)
+    x2d, tgt = _data(64, cfg.d_model)
+    # positive features so the boosted gate column's logit 4 * sum(x)
+    # dominates EVERY token — all 8 local assignments hit expert 1
+    x2d = jnp.abs(x2d) + 0.1
+    params = init_moe_ep_params(cfg, mesh)
+    params["gate_w"] = params["gate_w"].at[:, 1].set(4.0)
+    oc = OverlapConfig(hierarchical="on", slice_map=_SM)
+    cstep = build_moe_ep_train_step(cfg, mesh, oc=oc)
+    dstep = build_moe_ep_dropless_train_step(cfg, mesh, oc=oc)
+    lc, _, dc, _, _ = cstep(
+        {k: jnp.copy(v) for k, v in params.items()}, x2d, tgt)
+    ld, _, dd, _, _ = dstep(
+        {k: jnp.copy(v) for k, v in params.items()}, x2d, tgt)
+    assert float(dc) > 0.0          # capacity refuses assignments
+    assert float(dd) == 0.0         # dropless routes all of them
+    assert np.isfinite(float(lc)) and np.isfinite(float(ld))
+    # wire: the variable split undercuts the padded capacity payload
+    dcn = {}
+    for name, step in (("capacity", cstep), ("dropless", dstep)):
+        jaxpr = jax.make_jaxpr(step)(params, x2d, tgt).jaxpr
+        dcn[name] = collect_wire_table(
+            jaxpr, {"ep": list(_SM)})["dcn"]["kinds"].get(
+                "alltoall", {}).get("bytes", 0)
+    assert 0 < dcn["dropless"] <= dcn["capacity"], dcn
+
+
+# ---------------------------------------------------------------------------
+# transport: hierarchical + codec
+# ---------------------------------------------------------------------------
+
+
+def test_dropless_two_stage_bitexact_and_coded_budget():
+    """Codec off, the two-stage hierarchical dropless step is
+    BIT-IDENTICAL to the flat exchange (counts and payload ride the
+    same involution transport); codec on, the step still trains and
+    its total post-codec DCN bytes sit under the round-20 pinned
+    budget while the dispatch all-to-alls shrink >= 3x."""
+    from paddle_tpu.analysis.self_check import \
+        MOE_DROPLESS_DCN_WIRE_BUDGET
+
+    mesh = _ep_mesh()
+    cfg = MoEEPConfig(d_model=16, d_hidden=32, num_expert=8, top_k=2,
+                      capacity_factor=2.0, aux_weight=0.01)
+    x2d, tgt = _data(64, cfg.d_model)
+    flat = build_moe_ep_dropless_train_step(cfg, mesh)
+    hier = build_moe_ep_dropless_train_step(
+        cfg, mesh, oc=OverlapConfig(hierarchical="on", slice_map=_SM))
+    coded = build_moe_ep_dropless_train_step(
+        cfg, mesh, oc=OverlapConfig(hierarchical="on", slice_map=_SM,
+                                    codec=CollectiveCodec(block=64)))
+    lf = flat(init_moe_ep_params(cfg, mesh), x2d, tgt)[0]
+    lh = hier(init_moe_ep_params(cfg, mesh), x2d, tgt)[0]
+    lc = coded(init_moe_ep_params(cfg, mesh), x2d, tgt)[0]
+    assert np.asarray(lf).tobytes() == np.asarray(lh).tobytes()
+    assert abs(float(lf) - float(lc)) < 0.05  # per-block quant noise
+    params = init_moe_ep_params(cfg, mesh)
+    on = collect_wire_table(
+        jax.make_jaxpr(coded)(params, x2d, tgt).jaxpr,
+        {"ep": list(_SM)})["dcn"]
+    off = collect_wire_table(
+        jax.make_jaxpr(hier)(params, x2d, tgt).jaxpr,
+        {"ep": list(_SM)})["dcn"]
+    assert on["bytes"] <= MOE_DROPLESS_DCN_WIRE_BUDGET
+    on_a2a = on["kinds"].get("alltoall", {}).get("bytes", 0)
+    off_a2a = off["kinds"].get("alltoall", {}).get("bytes", 0)
+    assert on_a2a and off_a2a / on_a2a >= 3.0
+
+
+# ---------------------------------------------------------------------------
+# the declared-plan vocabulary
+# ---------------------------------------------------------------------------
+
+
+def test_ep_dropless_tactic_names_transport_not_placement():
+    """``ep_dropless`` joins the tactic vocabulary: the dropless
+    schedule's placements are BYTE-IDENTICAL to the capacity
+    schedule's (same ep-leading expert stacks, replicated gate) — the
+    tactic name declares the transport, nothing moves — and the bare
+    ``ep`` axis default is untouched."""
+    from paddle_tpu.parallel.schedule import (TACTICS,
+                                              PartitionSchedule,
+                                              tactics_for_mesh)
+    from paddle_tpu.parallel.specs import (EXPERT_AXIS,
+                                           EXPERT_DROPLESS_TACTIC)
+
+    assert EXPERT_DROPLESS_TACTIC in TACTICS
+    assert TACTICS[EXPERT_DROPLESS_TACTIC].axis == EXPERT_AXIS
+    mesh = _ep_mesh()
+    # the axis default stays the bare capacity tactic
+    assert "ep" in [t.name for t in tactics_for_mesh(mesh)]
+    cfg = MoEEPConfig(d_model=16, d_hidden=32, num_expert=8)
+    cap = PartitionSchedule.from_moe_ep(cfg, mesh)
+    drl = PartitionSchedule.from_moe_ep(cfg, mesh, dropless=True)
+    assert "ep_dropless" in drl.tactic_names()
+    assert "ep_dropless" not in cap.tactic_names()
+    assert cap.table.to_table() == drl.table.to_table()

@@ -26,6 +26,8 @@ lattice (tier-1 home: the fake-builder walk here + the
 also rides the CLI ``bench.py --roofline-trace`` -> ROOFLINE_r01.json).
 """
 
+import types
+
 import numpy as np
 import pytest
 
@@ -462,6 +464,114 @@ def test_predict_walk_measured_gate_overrules_prediction():
     assert chosen is None
     assert compiled == [lattice[0].label(), lattice[1].label()]
     assert records[0]["fits"] is False
+
+
+def test_dropless_sheet_pricing():
+    """Round-20 satellite: the dropless cost sheet prices variable-
+    segment FLOPs at the measured balance point — ``balance * top_k``
+    effective rows per token, NO capacity padding term — while the
+    capacity engine prices its padded buffer at ``cf * top_k``; the
+    defaults stay byte-identical to the legacy pins (eff == top_k)."""
+    import dataclasses
+
+    base = rf.ModelCostSheet(
+        name="moe_debug", num_layers=4, hidden=256, intermediate=512,
+        num_heads=8, num_kv_heads=4, head_dim=32, vocab=1024,
+        num_experts=8)
+    drop = dataclasses.replace(base, moe_dropless=True,
+                               moe_balance=1.25)
+    cap = dataclasses.replace(base, moe_capacity_factor=2.0)
+    assert base.moe_eff_rows_per_token == float(base.moe_top_k)
+    assert drop.moe_eff_rows_per_token == 1.25 * base.moe_top_k
+    assert cap.moe_eff_rows_per_token == 2.0 * base.moe_top_k
+    # a perfectly-balanced dropless engine (balance=1) prices the ideal
+    # routed FLOPs — strictly under any padded capacity engine
+    ideal = dataclasses.replace(base, moe_dropless=True)
+    assert ideal.fwd_flops(16, 4096) == base.fwd_flops(16, 4096)
+    assert base.fwd_flops(16, 4096) < drop.fwd_flops(16, 4096) \
+        < cap.fwd_flops(16, 4096)
+    # the ep dispatch wire term scales by the same engine factor
+    axes = (("dp", 2), ("ep", 4))
+
+    def ep_bytes(sheet):
+        return rf.predict_wire_table(axes, None, sheet, batch=16,
+                                     seq=4096)["ici"]["by_part"][
+                                         "ep_dispatch"]
+
+    assert ep_bytes(ideal) == ep_bytes(base)
+    assert ep_bytes(base) < ep_bytes(drop) < ep_bytes(cap)
+    # llama_cost_sheet forwards the engine knobs from configs
+    ns = types.SimpleNamespace(
+        num_hidden_layers=4, hidden_size=256, intermediate_size=512,
+        num_attention_heads=8, num_key_value_heads=4, vocab_size=1024,
+        num_experts=8, moe_top_k=2, moe_dropless=True,
+        moe_balance=1.25, moe_capacity_factor=2.0)
+    fwd = rf.llama_cost_sheet(ns)
+    assert fwd.moe_dropless and fwd.moe_balance == 1.25 \
+        and fwd.moe_capacity_factor == 2.0
+
+
+def _dropless_step_builder(jc):
+    """REAL builder for the ep-lattice walk: the round-20 dropless EP
+    train step on the point's own mesh (toy flagship shapes)."""
+    from paddle_tpu.parallel.expert import (
+        MoEEPConfig, build_moe_ep_dropless_train_step,
+        init_moe_ep_params)
+
+    mesh = jc.partition.mesh()
+    cfg = MoEEPConfig(d_model=16, d_hidden=32, num_expert=8, top_k=2,
+                      capacity_factor=2.0, aux_weight=0.01)
+    step = build_moe_ep_dropless_train_step(cfg, mesh, oc=jc.overlap)
+    params = init_moe_ep_params(cfg, mesh)
+    rng = np.random.default_rng(7)
+    x2d = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+    tgt = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+    return step, (params, x2d, tgt)
+
+
+def test_predict_walk_dropless_ep_lattice():
+    """Satellite: the predict-mode walk searches a REAL ep lattice with
+    a DROPLESS cost sheet — ``enumerate_partitionings`` emits the ep
+    points, the dropless sheet prices them (balance-scaled segments,
+    no capacity padding term), and ``tune_schedule_config(
+    predict=True)`` compiles ONLY the top-K — a real dropless train
+    step — through the unchanged MEM001/COMM004 measured gates."""
+    _need(8)
+    sheet = rf.ModelCostSheet(
+        name="moe_debug", num_layers=4, hidden=256, intermediate=512,
+        num_heads=8, num_kv_heads=4, head_dim=32, vocab=1024,
+        num_experts=8, moe_dropless=True, moe_balance=1.25)
+    pts = rf.enumerate_partitionings(8, sheet, batch=16, seq=4096,
+                                     chip="v5p")
+    # the walk searches the dropless engine's own axis: real ep points
+    # (the toy step builder only speaks dp/sharding/ep)
+    ep_pts = [p for p in pts
+              if dict(p.axes).get("ep", 1) > 1
+              and all(dict(p.axes).get(a, 1) == 1
+                      for a in ("pp", "sep", "mp"))]
+    assert len(ep_pts) >= 3
+    lattice = joint_schedule_lattice(
+        ep_pts, memory_lattice=(MemoryConfig(remat="none"),),
+        codec_points=(None,))
+    estimator = rf.joint_estimator(sheet, batch=16, seq=4096,
+                                   chip="v5p")
+    compiled = []
+
+    def builder(jc):
+        compiled.append(jc.label())
+        return _dropless_step_builder(jc)
+
+    chosen, records = tune_schedule_config(
+        builder, 1 << 40, lattice, predict=True, estimator=estimator,
+        top_k=1)
+    # exactly the predicted winner compiled, and it PASSED the
+    # measured MEM001 gate (ground truth stays the compiled step)
+    assert chosen is not None
+    assert compiled == [chosen.label()]
+    assert dict(chosen.partition.axes)["ep"] > 1
+    rec = next(r for r in records if r["label"] == chosen.label())
+    assert rec["predicted_rank"] == 0 and rec["fits"] is True
+    assert rec["peak_bytes"] > 0
 
 
 @pytest.mark.slow

@@ -222,6 +222,20 @@ _ROUND21_TRANCHE = [
 ]
 _REQUIRED_METHODS += _ROUND21_TRANCHE
 
+# names added by the round-22 tranche (the dropless-MoE round's
+# satellite): the activation method forms — the family whose first
+# member (stanh) shipped round-14 — plus the true_divide base whose
+# in-place form shipped round-19; none of these have reference
+# in-place partners — appended into _REQUIRED_METHODS AND counted
+# against the ~15 floor by test_method_count_tranche_round22
+_ROUND22_TRANCHE = [
+    "relu", "silu", "gelu", "selu", "elu", "celu", "leaky_relu",
+    "softmax", "log_softmax", "softplus", "softsign", "softshrink",
+    "hardshrink", "hardsigmoid", "hardswish", "hardtanh",
+    "true_divide",
+]
+_REQUIRED_METHODS += _ROUND22_TRANCHE
+
 # Reference tensor_method_func names DELIBERATELY not provided, with the
 # decision record (same contract as test_namespace_parity's
 # _SUBMODULE_EXEMPT): an empty value would assert full parity.
@@ -773,6 +787,58 @@ def test_round21_method_values():
     r = t.fix_()
     assert r is t
     np.testing.assert_allclose(np.asarray(t._value), [5.0, -1.0])
+
+
+def test_method_count_tranche_round22():
+    """The round-22 tranche satisfies the ~15-new-names floor (ISSUE 20
+    satellite) over the round-21 surface."""
+    wired = [n for n in _ROUND22_TRANCHE if hasattr(Tensor, n)]
+    assert len(wired) >= 15, (len(wired),
+                              sorted(set(_ROUND22_TRANCHE) - set(wired)))
+
+
+def test_round22_method_values():
+    t = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32))
+    x = np.array([-1.0, 0.5, 2.0], np.float64)
+    np.testing.assert_allclose(np.asarray(t.relu()._value),
+                               np.maximum(x, 0.0))
+    np.testing.assert_allclose(np.asarray(t.silu()._value),
+                               x / (1.0 + np.exp(-x)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.softplus()._value),
+                               np.log1p(np.exp(x)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.softsign()._value),
+                               x / (1.0 + np.abs(x)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.hardtanh()._value),
+                               np.clip(x, -1.0, 1.0))
+    np.testing.assert_allclose(np.asarray(t.leaky_relu()._value),
+                               np.where(x > 0, x, 0.01 * x), rtol=1e-6)
+    # elu == celu at the default alpha=1.0
+    np.testing.assert_allclose(np.asarray(t.elu()._value),
+                               np.asarray(t.celu()._value), rtol=1e-6)
+    # the shrinks keep the tails and zero the [-l, l] core
+    np.testing.assert_allclose(np.asarray(t.hardshrink()._value),
+                               [-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(np.asarray(t.softshrink()._value),
+                               [-0.5, 0.0, 1.5])
+    # softmax normalizes; log_softmax is its log (same axis default)
+    sm = np.asarray(t.softmax()._value, np.float64)
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.log_softmax()._value),
+                               np.log(sm), rtol=1e-5, atol=1e-6)
+    # gelu/selu/hardsigmoid/hardswish: spot-pin one interior value
+    np.testing.assert_allclose(float(t.gelu()._value[1]),
+                               0.3457312, rtol=1e-5)
+    np.testing.assert_allclose(float(t.selu()._value[1]),
+                               0.5253505, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(t.hardsigmoid()._value),
+                               np.clip(x / 6.0 + 0.5, 0.0, 1.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(t.hardswish()._value[0]),
+                               -1.0 * (2.0 / 6.0), rtol=1e-5)
+    # true_divide == divide (the alias whose in-place form shipped r19)
+    d = paddle.to_tensor(np.array([2.0, 2.0, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(t.true_divide(d)._value),
+                               [-0.5, 0.25, 1.0])
 
 
 def test_round19_method_values():
